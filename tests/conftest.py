@@ -1,0 +1,25 @@
+"""Test harness config.
+
+- Force JAX onto a virtual 8-device CPU mesh (only the fleet-planner tests
+  use JAX; everything else is pure control-plane).
+- Keep the process-wide device backend isolated between tests.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from tpu_cc_manager.device import base as device_base
+
+
+@pytest.fixture(autouse=True)
+def _reset_device_backend():
+    device_base.set_backend(None)
+    yield
+    device_base.set_backend(None)

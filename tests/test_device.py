@@ -1,0 +1,149 @@
+"""L0 device layer tests: fake backend semantics + sysfs backend against a
+synthetic accel tree (the reference has no equivalent tests — SURVEY.md §4)."""
+
+import os
+
+import pytest
+
+from tpu_cc_manager import device
+from tpu_cc_manager.device.base import DeviceError, set_backend
+from tpu_cc_manager.device.fake import FakeChip, fake_backend
+from tpu_cc_manager.device.statefile import ModeStateStore
+from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+
+# ---------------------------------------------------------------- fake chip
+def test_fake_chip_mode_takes_effect_only_after_reset():
+    chip = FakeChip()
+    assert chip.query_cc_mode() == "off"
+    chip.set_cc_mode("on")
+    assert chip.query_cc_mode() == "off"  # staged, not yet effective
+    chip.reset()
+    chip.wait_ready()
+    assert chip.query_cc_mode() == "on"
+
+
+def test_fake_chip_fault_injection():
+    chip = FakeChip()
+    chip.fail_set = True
+    with pytest.raises(DeviceError):
+        chip.set_cc_mode("on")
+    chip.fail_set = False
+    chip.fail_reset = True
+    chip.set_cc_mode("on")
+    with pytest.raises(DeviceError):
+        chip.reset()
+    chip.fail_reset = False
+    chip.drop_staged_mode = True
+    chip.reset()
+    assert chip.query_cc_mode() == "off"  # verify-mismatch scenario
+
+
+def test_fake_chip_capability_gates():
+    chip = FakeChip(cc_capable=False)
+    with pytest.raises(DeviceError):
+        chip.query_cc_mode()
+    with pytest.raises(DeviceError):
+        chip.set_cc_mode("on")
+
+
+def test_fake_backend_enumeration_shape():
+    set_backend(fake_backend(n_chips=4, n_switches=2))
+    chips, err = device.find_tpus()
+    assert err is None
+    # find_tpus returns chips and switches (like find_gpus returns all
+    # devices, reference main.py:128-131); switches identified by predicate.
+    assert len(chips) == 6
+    assert sum(c.is_ici_switch() for c in chips) == 2
+    assert len(device.find_ici_switches()) == 2
+
+
+def test_fake_backend_enum_error():
+    from tpu_cc_manager.device.fake import FakeBackend
+
+    set_backend(FakeBackend(enum_error="no accel driver"))
+    chips, err = device.find_tpus()
+    assert chips == [] and err == "no accel driver"
+
+
+# ------------------------------------------------------------- state store
+def test_state_store_staged_vs_effective(tmp_path):
+    store = ModeStateStore(str(tmp_path))
+    assert store.effective("/dev/accel0", "cc") == "off"
+    store.stage("/dev/accel0", "cc", "on")
+    assert store.effective("/dev/accel0", "cc") == "off"
+    assert store.staged("/dev/accel0", "cc") == "on"
+    store.commit("/dev/accel0")
+    assert store.effective("/dev/accel0", "cc") == "on"
+    # durable across store instances (resumable flip, SURVEY.md §7.4)
+    store2 = ModeStateStore(str(tmp_path))
+    assert store2.effective("/dev/accel0", "cc") == "on"
+
+
+# ------------------------------------------------------------ sysfs backend
+def make_accel_tree(root, n=2, vendor="0x1ae0", device_id="0x0063", kinds=None):
+    sysfs = root / "sys_class_accel"
+    dev = root / "dev"
+    dev.mkdir(exist_ok=True)
+    for i in range(n):
+        d = sysfs / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text(vendor + "\n")
+        (d / "device").write_text(device_id + "\n")
+        if kinds and kinds[i]:
+            (d / "kind").write_text(kinds[i] + "\n")
+        (dev / f"accel{i}").write_text("")  # stand-in for the char device
+    return str(sysfs), str(dev)
+
+
+def test_sysfs_backend_enumerates_google_chips(tmp_path):
+    sysfs, dev = make_accel_tree(tmp_path, n=3)
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=str(tmp_path / "st"))
+    chips, err = be.find_tpus()
+    assert err is None
+    assert [c.path for c in chips] == [dev + f"/accel{i}" for i in range(3)]
+    assert all(c.name == "tpu-v5p" for c in chips)
+    assert all(c.is_cc_query_supported for c in chips)
+
+
+def test_sysfs_backend_skips_foreign_vendor(tmp_path):
+    sysfs, dev = make_accel_tree(tmp_path, n=2, vendor="0x10de")
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=str(tmp_path / "st"))
+    chips, err = be.find_tpus()
+    assert chips == [] and err is None
+
+
+def test_sysfs_backend_capability_allowlist(tmp_path, monkeypatch):
+    # analog of CC_CAPABLE_DEVICE_IDS filtering (cc-manager.sh:102-109)
+    sysfs, dev = make_accel_tree(tmp_path, n=2, device_id="0x005e")
+    monkeypatch.setenv("CC_CAPABLE_DEVICE_IDS", "0x0063,0x0062")
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=str(tmp_path / "st"))
+    chips, _ = be.find_tpus()
+    assert len(chips) == 2
+    assert not any(c.is_cc_query_supported for c in chips)
+    monkeypatch.setenv("CC_CAPABLE_DEVICE_IDS", "0x005E")  # case-insensitive hex
+    chips, _ = be.find_tpus()
+    assert all(c.is_cc_query_supported for c in chips)
+
+
+def test_sysfs_backend_ici_switch_kind(tmp_path):
+    sysfs, dev = make_accel_tree(tmp_path, n=3, kinds=[None, None, "ici-switch"])
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=str(tmp_path / "st"))
+    chips, _ = be.find_tpus()
+    assert len(chips) == 2  # switches excluded from find_tpus
+    switches = be.find_ici_switches()
+    assert len(switches) == 1 and switches[0].name == "ici-switch"
+    assert switches[0].is_ici_query_supported
+    assert not switches[0].is_cc_query_supported
+
+
+def test_sysfs_chip_full_mode_cycle(tmp_path):
+    sysfs, dev = make_accel_tree(tmp_path, n=1)
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=str(tmp_path / "st"))
+    (chip,), _ = be.find_tpus()
+    assert chip.query_cc_mode() == "off"
+    chip.set_cc_mode("devtools")
+    assert chip.query_cc_mode() == "off"
+    chip.reset()
+    chip.wait_ready(timeout_s=2)
+    assert chip.query_cc_mode() == "devtools"

@@ -1,0 +1,27 @@
+import pytest
+
+from tpu_cc_manager.modes import (
+    CC_MODES,
+    Mode,
+    InvalidModeError,
+    parse_mode,
+)
+
+
+def test_parse_valid_modes():
+    assert parse_mode("on") is Mode.ON
+    assert parse_mode("off") is Mode.OFF
+    assert parse_mode("devtools") is Mode.DEVTOOLS
+    assert parse_mode("ici") is Mode.ICI
+
+
+@pytest.mark.parametrize("bad", ["", "ON", "enabled", "ppcie", "true"])
+def test_parse_invalid_modes_loud(bad):
+    # invalid values are rejected, never defaulted (reference main.py:144-158)
+    with pytest.raises(InvalidModeError):
+        parse_mode(bad)
+
+
+def test_cc_modes_exclude_ici():
+    assert Mode.ICI not in CC_MODES
+    assert set(CC_MODES) == {Mode.ON, Mode.OFF, Mode.DEVTOOLS}

@@ -1,0 +1,32 @@
+"""tpu-cc-manager: a TPU-native confidential-computing mode manager for k8s.
+
+Built from scratch with the capabilities of NVIDIA's k8s-cc-manager
+(reference: /root/reference), retargeted from NVIDIA GPUs to Cloud TPU:
+
+- desired state arrives as a node label (``tpu.google.com/cc.mode``,
+  analog of ``nvidia.com/cc.mode``, reference cmd/main.go:39);
+- the agent drains TPU-consuming workloads (analog of
+  gpu_operator_eviction.py), flips the TPU attestation/CC mode via a
+  libtpu-style device layer (analog of gpu-admin-tools, reference
+  main.py:38-41), verifies, publishes an observed-state label
+  (``tpu.google.com/cc.mode.state``), and restores workloads;
+- multi-host TPU slices flip coherently via a slice-coordination layer
+  the reference never needed (one v5p slice spans many nodes).
+
+Zero NVML / ``nvidia-smi`` calls anywhere, by construction: all device
+access goes through :mod:`tpu_cc_manager.device`.
+
+Layer map (mirrors SURVEY.md §1):
+
+- L0 device access        -> tpu_cc_manager.device
+- L1 mode engine          -> tpu_cc_manager.engine
+- L2 drain / reschedule   -> tpu_cc_manager.drain
+- L3 control loop / watch -> tpu_cc_manager.watch, tpu_cc_manager.agent
+- L4 CLI / config / obs   -> tpu_cc_manager.config, tpu_cc_manager.cli,
+                             tpu_cc_manager.obs
+- slice coherence (new)   -> tpu_cc_manager.slice_coord
+- k8s API access          -> tpu_cc_manager.k8s (first-party stdlib client;
+                             replaces client-go / kubernetes-python)
+"""
+
+__version__ = "0.1.0"

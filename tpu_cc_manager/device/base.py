@@ -1,0 +1,96 @@
+"""Device protocol + backend registry for the L0 layer."""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import List, Optional, Tuple
+
+
+class DeviceError(Exception):
+    """Any device-layer failure (analog of GpuError, reference main.py:41)."""
+
+
+class TpuChip(abc.ABC):
+    """One TPU chip (or ICI switch) on this host.
+
+    ``path`` is the stable host-side identity (e.g. ``/dev/accel0``) — the
+    BDF analog (reference main.py:140). ``name`` is the human-readable chip
+    model (e.g. ``tpu-v5p``).
+    """
+
+    path: str
+    name: str
+
+    #: Whether CC/attestation mode can even be queried on this part
+    #: (capability analog of is_cc_query_supported, reference main.py:135).
+    is_cc_query_supported: bool = False
+    #: Whether protected-ICI mode is supported (reference main.py:177).
+    is_ici_query_supported: bool = False
+
+    @abc.abstractmethod
+    def is_ici_switch(self) -> bool:
+        """True for ICI switch parts (NVSwitch analog, main.py:131)."""
+
+    @abc.abstractmethod
+    def query_cc_mode(self) -> str:
+        """Current CC mode: 'on' | 'off' | 'devtools' (main.py:250)."""
+
+    @abc.abstractmethod
+    def set_cc_mode(self, mode: str) -> None:
+        """Stage the CC mode; takes effect after reset (main.py:282)."""
+
+    @abc.abstractmethod
+    def query_ici_mode(self) -> str:
+        """Current protected-ICI mode: 'on' | 'off' (main.py:362)."""
+
+    @abc.abstractmethod
+    def set_ici_mode(self, mode: str) -> None:
+        """Stage protected-ICI mode; takes effect after reset (main.py:393)."""
+
+    @abc.abstractmethod
+    def reset(self) -> None:
+        """Restart the TPU runtime / reset the chip so a staged mode takes
+        effect (reset_with_os analog, main.py:286)."""
+
+    @abc.abstractmethod
+    def wait_ready(self, timeout_s: float = 60.0) -> None:
+        """Block until the chip is healthy after a reset (wait_for_boot
+        analog, main.py:289). Raises DeviceError on timeout."""
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"<{type(self).__name__} {self.name} @ {self.path}>"
+
+
+class Backend(abc.ABC):
+    """Enumeration entry point — one per device-access mechanism."""
+
+    @abc.abstractmethod
+    def find_tpus(self) -> Tuple[List[TpuChip], Optional[str]]:
+        """-> (chips, error_or_none); mirrors find_gpus() (main.py:128)."""
+
+    @abc.abstractmethod
+    def find_ici_switches(self) -> List[TpuChip]:
+        """-> ICI switch parts only (main.py:185)."""
+
+
+_lock = threading.Lock()
+_backend: Optional[Backend] = None
+
+
+def set_backend(backend: Optional[Backend]) -> None:
+    """Install the process-wide device backend (tests install a fake)."""
+    global _backend
+    with _lock:
+        _backend = backend
+
+
+def get_backend() -> Backend:
+    """Return the installed backend, defaulting to the sysfs TPU backend."""
+    global _backend
+    with _lock:
+        if _backend is None:
+            from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+            _backend = SysfsTpuBackend()
+        return _backend

@@ -1,0 +1,101 @@
+"""Durable staged/effective attestation-mode store.
+
+On Cloud TPU the confidential/attestation mode is tied to the VM + runtime
+lifecycle rather than a device register, so the mode flip is an
+asynchronous, restart-spanning operation (SURVEY.md §7.4 "hard parts").
+This store makes it resumable: the *staged* mode survives agent crashes,
+and only a ``commit`` (performed by ``reset()``) moves staged → effective —
+the same externally visible contract as the reference's
+``set_cc_mode → reset_with_os → query`` sequence (reference
+main.py:282-296).
+
+On-disk layout (shared verbatim with the C++ ``libtpudev`` shim and the
+bash engine, so all three implementations interoperate on one host)::
+
+    <state_dir>/<device-key>/cc.staged
+    <state_dir>/<device-key>/cc.effective
+    <state_dir>/<device-key>/ici.staged
+    <state_dir>/<device-key>/ici.effective
+    <state_dir>/<device-key>/.lock
+
+where ``<device-key>`` is the device path with '/' mapped to '_'
+(``/dev/accel0`` → ``_dev_accel0``). Writes are atomic (tempfile +
+rename) and serialized by an ``fcntl`` lock per device, because the
+Python agent, the bash engine, and the C++ agent may race on one host.
+Unknown/absent state reads as ``off`` (a fresh chip is unprotected).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import tempfile
+from contextlib import contextmanager
+
+
+def device_key(path: str) -> str:
+    return path.replace("/", "_")
+
+
+class ModeStateStore:
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+
+    def _dev_dir(self, path: str) -> str:
+        d = os.path.join(self.state_dir, device_key(path))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @contextmanager
+    def _locked(self, path: str):
+        d = self._dev_dir(path)
+        lock_path = os.path.join(d, ".lock")
+        with open(lock_path, "a+") as lock:
+            fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+            try:
+                yield d
+            finally:
+                fcntl.flock(lock.fileno(), fcntl.LOCK_UN)
+
+    @staticmethod
+    def _read(d: str, name: str) -> str:
+        try:
+            with open(os.path.join(d, name), "r") as f:
+                return f.read().strip() or "off"
+        except OSError:
+            return "off"
+
+    @staticmethod
+    def _write_atomic(d: str, name: str, value: str) -> None:
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(value + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, os.path.join(d, name))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def effective(self, path: str, domain: str) -> str:
+        with self._locked(path) as d:
+            return self._read(d, f"{domain}.effective")
+
+    def staged(self, path: str, domain: str) -> str:
+        with self._locked(path) as d:
+            return self._read(d, f"{domain}.staged")
+
+    def stage(self, path: str, domain: str, mode: str) -> None:
+        with self._locked(path) as d:
+            self._write_atomic(d, f"{domain}.staged", mode)
+
+    def commit(self, path: str) -> None:
+        """Apply all staged modes for the device (runs at reset time)."""
+        with self._locked(path) as d:
+            for domain in ("cc", "ici"):
+                staged = self._read(d, f"{domain}.staged")
+                self._write_atomic(d, f"{domain}.effective", staged)

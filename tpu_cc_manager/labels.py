@@ -1,0 +1,64 @@
+"""Label / annotation keys — the cluster-visible protocol surface.
+
+The reference coordinates the whole fleet exclusively through node labels
+(SURVEY.md §2.3): a desired-state label written by the operator, an
+observed-state label written by the agent, and component pause labels used
+to drain the GPU operator's pods. This module is the TPU-native rename of
+that protocol; everything else in the framework refers to these constants.
+"""
+
+from __future__ import annotations
+
+#: Desired-state label (analog of ``nvidia.com/cc.mode``, reference
+#: cmd/main.go:39, main.py:50).
+CC_MODE_LABEL = "tpu.google.com/cc.mode"
+
+#: Observed-state label (analog of ``nvidia.com/cc.mode.state``, reference
+#: gpu_operator_eviction.py:279). Value: the achieved mode, or "failed".
+CC_MODE_STATE_LABEL = "tpu.google.com/cc.mode.state"
+
+#: Pause-label protocol for TPU-stack components (analog of the five
+#: ``nvidia.com/gpu.deploy.*`` labels, reference
+#: gpu_operator_eviction.py:23-29). A cooperating operator's DaemonSets
+#: carry nodeAffinity on these labels; setting the value to
+#: ``paused-for-cc-flip`` (with the original value preserved as a suffix)
+#: makes the operator remove the pod from the node.
+COMPONENT_LABELS = (
+    "tpu.google.com/pool.deploy.device-plugin",
+    "tpu.google.com/pool.deploy.metrics-exporter",
+    "tpu.google.com/pool.deploy.dra-driver",
+    "tpu.google.com/pool.deploy.workload-validator",
+    "tpu.google.com/pool.deploy.node-problem-detector",
+)
+
+#: App labels identifying the pods of each component above (analog of
+#: ``COMPONENT_APP_LABELS``, reference gpu_operator_eviction.py:32-38).
+COMPONENT_APP_LABELS = {
+    "tpu.google.com/pool.deploy.device-plugin": "tpu-device-plugin",
+    "tpu.google.com/pool.deploy.metrics-exporter": "tpu-metrics-exporter",
+    "tpu.google.com/pool.deploy.dra-driver": "tpu-dra-driver",
+    "tpu.google.com/pool.deploy.workload-validator": "tpu-workload-validator",
+    "tpu.google.com/pool.deploy.node-problem-detector": "tpu-node-problem-detector",
+}
+
+#: Pause marker prefix (analog of ``PAUSED_STR = "paused-for-cc-flip"``,
+#: reference gpu_operator_eviction.py:40-70).
+PAUSED_STR = "paused-for-cc-flip"
+
+#: Label selecting TPU nodes (set by GKE on TPU node pools); the DaemonSet
+#: nodeSelector keys on it, and the fleet controller uses it to scope
+#: listings.
+TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+
+#: GKE labels giving slice identity/topology on multi-host TPU node pools.
+#: All nodes of one multi-host slice share the same topology value and
+#: belong to one node pool; per-slice coherence keys off these.
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_SLICE_LABEL = "tpu.google.com/cc.slice"
+
+#: Slice-coordination annotations (new vs the reference — SURVEY.md §7.2
+#: step 7). See tpu_cc_manager.slice_coord for the protocol.
+SLICE_LEADER_ANNOTATION = "tpu.google.com/cc.slice.leader"
+SLICE_EPOCH_ANNOTATION = "tpu.google.com/cc.slice.epoch"
+SLICE_ACK_ANNOTATION = "tpu.google.com/cc.slice.ack"
+SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"
