@@ -1,0 +1,163 @@
+"""Agent end-to-end against the fake clientset + fake device backend —
+the minimum end-to-end slice of SURVEY.md §7.3: label a node → agent
+reconciles the device mode → state label flips."""
+
+import os
+import threading
+import time
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.agent import CCManagerAgent, with_default
+from tpu_cc_manager.config import AgentConfig
+from tpu_cc_manager.device.base import set_backend
+from tpu_cc_manager.device.fake import FakeBackend, FakeChip, fake_backend
+from tpu_cc_manager.k8s import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+
+
+def test_with_default():
+    # reference main.py:691-697
+    assert with_default("on", "off") == "on"
+    assert with_default(None, "off") == "off"
+    assert with_default("", "off") == "off"
+    assert with_default(None, None) is None
+
+
+def _agent(kube, tmp_path, node="n1", default_mode="on", **cfg_kw):
+    cfg = AgentConfig(
+        node_name=node,
+        default_mode=default_mode,
+        readiness_file=str(tmp_path / "ready"),
+        health_port=0,
+        drain_strategy=cfg_kw.pop("drain_strategy", "none"),
+        **cfg_kw,
+    )
+    agent = CCManagerAgent(kube, cfg)
+    # keep fake watch streams short so shutdown joins promptly
+    agent.watcher.watch_timeout_s = 1
+    agent.watcher.backoff_s = 0.05
+    return agent
+
+
+def test_agent_initial_reconcile_from_label(tmp_path):
+    backend = fake_backend(n_chips=2)
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "devtools"}))
+    agent = _agent(kube, tmp_path)
+    rc = agent.run(max_reconciles=1)
+    assert rc == 0
+    assert all(c.query_cc_mode() == "devtools" for c in backend.chips)
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "devtools"
+    assert os.path.exists(str(tmp_path / "ready"))  # readiness after initial
+
+
+def test_agent_applies_default_when_label_absent(tmp_path):
+    backend = fake_backend(n_chips=1)
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    agent = _agent(kube, tmp_path, default_mode="on")
+    rc = agent.run(max_reconciles=1)
+    assert rc == 0
+    assert backend.chips[0].query_cc_mode() == "on"
+
+
+def test_agent_follows_label_changes(tmp_path):
+    backend = fake_backend(n_chips=1)
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "off"}))
+    agent = _agent(kube, tmp_path)
+
+    t = threading.Thread(target=lambda: agent.run(max_reconciles=2))
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while agent.reconcile_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "on"})
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert backend.chips[0].query_cc_mode() == "on"
+        assert (
+            kube.get_node("n1")["metadata"]["labels"][L.CC_MODE_STATE_LABEL]
+            == "on"
+        )
+    finally:
+        agent.shutdown()
+        t.join(timeout=5)
+
+
+def test_agent_reconcile_failure_continues_and_reports(tmp_path):
+    backend = fake_backend(n_chips=1)
+    backend.chips[0].fail_set = True
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path)
+    rc = agent.run(max_reconciles=1)
+    assert rc == 0  # reconcile failure is not fatal (cmd/main.go:164-167)
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "failed"
+    assert agent.metrics.reconciles_total.value("failure") == 1
+
+
+def test_agent_invalid_label_value_reports_failed(tmp_path):
+    set_backend(fake_backend(n_chips=1))
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "bogus"}))
+    agent = _agent(kube, tmp_path)
+    rc = agent.run(max_reconciles=1)
+    assert rc == 0
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "failed"
+    assert agent.metrics.reconciles_total.value("invalid") == 1
+
+
+def test_agent_mixed_node_fatal_exit(tmp_path):
+    chips = [FakeChip(path="/dev/accel0"),
+             FakeChip(path="/dev/accel1", cc_capable=False, ici_capable=False)]
+    set_backend(FakeBackend(chips=chips))
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path)
+    rc = agent.run(max_reconciles=1)
+    assert rc == 1  # FatalModeError -> exit (main.py:214-217)
+
+
+def test_agent_startup_default_apply_failure_is_fatal(tmp_path):
+    backend = fake_backend(n_chips=1)
+    backend.chips[0].fail_set = True
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))  # no label -> default path
+    agent = _agent(kube, tmp_path, default_mode="on")
+    rc = agent.run(max_reconciles=1)
+    assert rc == 1  # cmd/main.go:141-145
+
+
+def test_agent_metrics_histogram_records(tmp_path):
+    set_backend(fake_backend(n_chips=1))
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path)
+    agent.run(max_reconciles=1)
+    assert agent.metrics.reconcile_duration.count == 1
+    assert agent.metrics.reconcile_duration.quantile(0.5) is not None
+
+
+def test_agent_drains_components_around_flip(tmp_path):
+    set_backend(fake_backend(n_chips=1))
+    kube = FakeKube()
+    dp = "tpu.google.com/pool.deploy.device-plugin"
+    kube.add_node(
+        make_node("n1", labels={L.CC_MODE_LABEL: "on", dp: "true"})
+    )
+    agent = _agent(kube, tmp_path, drain_strategy="components")
+    rc = agent.run(max_reconciles=1)
+    assert rc == 0
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[dp] == "true"  # paused then restored
+    assert labels[L.CC_MODE_STATE_LABEL] == "on"

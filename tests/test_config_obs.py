@@ -1,0 +1,134 @@
+"""L4 tests: flags-over-env config resolution, metrics rendering, and the
+health/metrics HTTP endpoints."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpu_cc_manager.config import AgentConfig, parse_config
+from tpu_cc_manager.obs import (
+    Counter,
+    Gauge,
+    HealthServer,
+    Histogram,
+    Metrics,
+    create_readiness_file,
+)
+
+
+# ------------------------------------------------------------------ config
+def test_flags_over_env_priority(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "env-node")
+    monkeypatch.setenv("DEFAULT_CC_MODE", "off")
+    cfg, args = parse_config([])
+    assert cfg.node_name == "env-node"
+    assert cfg.default_mode == "off"
+    # explicit flags beat env (reference cmd/main.go:83-99 EnvVars pattern)
+    cfg2, _ = parse_config(["--node-name", "flag-node", "-m", "devtools"])
+    assert cfg2.node_name == "flag-node"
+    assert cfg2.default_mode == "devtools"
+
+
+def test_node_name_required(monkeypatch):
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    with pytest.raises(SystemExit):
+        parse_config([])
+
+
+def test_env_toggles(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "n1")
+    monkeypatch.setenv("EVICT_OPERATOR_COMPONENTS", "false")
+    monkeypatch.setenv("OPERATOR_NAMESPACE", "custom-ns")
+    monkeypatch.setenv("DRAIN_STRATEGY", "node")
+    cfg, _ = parse_config([])
+    assert cfg.evict_components is False
+    assert cfg.operator_namespace == "custom-ns"
+    assert cfg.drain_strategy == "node"
+
+
+def test_invalid_drain_strategy_rejected():
+    with pytest.raises(ValueError):
+        AgentConfig(node_name="n1", drain_strategy="bogus")
+
+
+def test_subcommand_parsing(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "n1")
+    _, args = parse_config(["set-cc-mode", "-m", "on"])
+    assert args.command == "set-cc-mode" and args.mode == "on"
+    _, args = parse_config(["get-cc-mode"])
+    assert args.command == "get-cc-mode"
+
+
+# ----------------------------------------------------------------- metrics
+def test_counter_and_gauge_render():
+    c = Counter("c_total", "help", ("outcome",))
+    c.inc("success")
+    c.inc("success")
+    c.inc("failure")
+    text = "\n".join(c.render())
+    assert 'c_total{outcome="success"} 2' in text
+    assert 'c_total{outcome="failure"} 1' in text
+
+    g = Gauge("g", "help", ("mode",))
+    g.set(1.0, "on")
+    assert 'g{mode="on"} 1' in "\n".join(g.render())
+
+
+def test_histogram_buckets_and_quantiles():
+    h = Histogram("h_seconds", "help", buckets=(0.1, 1, 10))
+    for v in (0.05, 0.5, 5, 50):
+        h.observe(v)
+    text = "\n".join(h.render())
+    assert 'h_seconds_bucket{le="0.1"} 1' in text
+    assert 'h_seconds_bucket{le="1"} 2' in text
+    assert 'h_seconds_bucket{le="10"} 3' in text
+    assert 'h_seconds_bucket{le="+Inf"} 4' in text
+    assert "h_seconds_count 4" in text
+    assert h.quantile(0.5) == 5  # index 2 of sorted [0.05,0.5,5,50]
+
+
+def test_metrics_set_current_mode_one_hot():
+    m = Metrics()
+    m.set_current_mode("on")
+    assert m.current_mode.value("on") == 1.0
+    assert m.current_mode.value("off") == 0.0
+    m.set_current_mode("failed")
+    assert m.current_mode.value("on") == 0.0
+    assert m.current_mode.value("failed") == 1.0
+
+
+# ------------------------------------------------------------ health server
+def test_health_endpoints():
+    m = Metrics()
+    m.reconciles_total.inc("success")
+    srv = HealthServer(m, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(base + path) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        assert get("/healthz")[0] == 200
+        assert get("/readyz")[0] == 503  # not ready until initial reconcile
+        srv.ready = True
+        assert get("/readyz")[0] == 200
+        code, body = get("/metrics")
+        assert code == 200
+        assert 'tpu_cc_reconciles_total{outcome="success"} 1' in body
+        assert "tpu_cc_reconcile_duration_seconds_bucket" in body
+        assert get("/nope")[0] == 404
+    finally:
+        srv.stop()
+
+
+def test_readiness_file(tmp_path):
+    path = str(tmp_path / "sub" / ".ready")
+    create_readiness_file(path)
+    import os
+
+    assert os.path.exists(path)

@@ -1,0 +1,153 @@
+"""L2 drain tests: pause-label protocol, pod-wait, restore invariants, and
+the GKE cordon/evict variant with PDB blocking."""
+
+import threading
+import time
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.drain import (
+    ComponentDrainer,
+    NodeDrainer,
+    paused_value,
+    set_cc_mode_state_label,
+    unpaused_value,
+)
+from tpu_cc_manager.k8s import FakeKube
+from tpu_cc_manager.k8s.objects import make_node, make_pod
+
+DP = "tpu.google.com/pool.deploy.device-plugin"
+ME = "tpu.google.com/pool.deploy.metrics-exporter"
+
+
+def _node_with_components(kube, name="n1", components=(DP, ME)):
+    kube.add_node(make_node(name, labels={c: "true" for c in components}))
+
+
+def test_paused_value_roundtrip():
+    assert paused_value("true") == f"{L.PAUSED_STR}_true"
+    assert unpaused_value(paused_value("true")) == "true"
+    assert unpaused_value("true") == "true"  # idempotent
+
+
+def test_state_label_writer():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    set_cc_mode_state_label(kube, "n1", "on")
+    assert kube.get_node("n1")["metadata"]["labels"][L.CC_MODE_STATE_LABEL] == "on"
+
+
+def test_evict_pauses_only_deployed_components():
+    kube = FakeKube()
+    _node_with_components(kube, components=(DP,))
+    d = ComponentDrainer(kube, "n1", timeout_s=1, poll_s=0.05)
+    d.evict()
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[DP] == paused_value("true")
+    assert ME not in labels  # absent components untouched
+
+
+def test_evict_waits_for_pods_to_leave():
+    kube = FakeKube()
+    _node_with_components(kube, components=(DP,))
+    kube.add_pod(
+        make_pod("dp-pod", "tpu-system",
+                 labels={"app": L.COMPONENT_APP_LABELS[DP]}, node_name="n1")
+    )
+    d = ComponentDrainer(kube, "n1", timeout_s=5, poll_s=0.05)
+
+    def delete_later():
+        time.sleep(0.3)
+        kube.delete_pod("tpu-system", "dp-pod")
+
+    t = threading.Thread(target=delete_later)
+    t.start()
+    start = time.monotonic()
+    d.evict()
+    t.join()
+    assert 0.2 <= time.monotonic() - start < 5
+
+
+def test_evict_timeout_warns_and_continues():
+    # timeout is warn-and-continue, not fatal (gpu_operator_eviction.py:205-207)
+    kube = FakeKube()
+    _node_with_components(kube, components=(DP,))
+    kube.add_pod(
+        make_pod("dp-pod", "tpu-system",
+                 labels={"app": L.COMPONENT_APP_LABELS[DP]}, node_name="n1")
+    )
+    d = ComponentDrainer(kube, "n1", timeout_s=0.2, poll_s=0.05)
+    d.evict()  # must return despite the pod never leaving
+
+
+def test_reschedule_restores_original_values():
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={DP: "true", ME: "enabled"}))
+    d = ComponentDrainer(kube, "n1", timeout_s=0.1, poll_s=0.05)
+    d.evict()
+    d.reschedule()
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[DP] == "true"
+    assert labels[ME] == "enabled"
+
+
+def test_reschedule_after_agent_restart_uses_live_state():
+    # durable state lives in the labels: a fresh drainer (crashed agent)
+    # can still unpause (SURVEY.md §5.4)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={DP: paused_value("true")}))
+    d = ComponentDrainer(kube, "n1")
+    d.reschedule()
+    assert kube.get_node("n1")["metadata"]["labels"][DP] == "true"
+
+
+def test_evict_skips_false_and_already_paused():
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={DP: "false", ME: paused_value("true")}))
+    d = ComponentDrainer(kube, "n1", timeout_s=0.1, poll_s=0.05)
+    d.evict()
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[DP] == "false"  # disabled component never paused
+    assert labels[ME] == paused_value("true")  # not double-paused
+
+
+# ------------------------------------------------------------- NodeDrainer
+def test_node_drainer_cordons_evicts_uncordons():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    kube.add_pod(make_pod("w1", "default", labels={"tpu": "yes"}, node_name="n1"))
+    kube.add_pod(make_pod("w2", "default", labels={"tpu": "yes"}, node_name="other"))
+    d = NodeDrainer(kube, "n1", timeout_s=2, poll_s=0.05)
+    d.evict()
+    assert kube.get_node("n1")["spec"]["unschedulable"] is True
+    names = [p["metadata"]["name"] for p in kube.list_pods("default")]
+    assert names == ["w2"]  # only n1's pods evicted
+    d.reschedule()
+    assert kube.get_node("n1")["spec"]["unschedulable"] is False
+
+
+def test_node_drainer_retries_pdb_blocked_until_timeout():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    kube.add_pod(make_pod("w1", "default", node_name="n1"))
+    kube.pdb_blocked.add(("default", "w1"))
+
+    def unblock_later():
+        time.sleep(0.3)
+        kube.pdb_blocked.clear()
+
+    t = threading.Thread(target=unblock_later)
+    t.start()
+    d = NodeDrainer(kube, "n1", timeout_s=5, poll_s=0.05)
+    d.evict()
+    t.join()
+    assert kube.list_pods("default") == []
+
+
+def test_node_drainer_pdb_timeout_warns_and_continues():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    kube.add_pod(make_pod("w1", "default", node_name="n1"))
+    kube.pdb_blocked.add(("default", "w1"))
+    d = NodeDrainer(kube, "n1", timeout_s=0.2, poll_s=0.05)
+    d.evict()  # returns despite the PDB never unblocking
+    assert len(kube.list_pods("default")) == 1

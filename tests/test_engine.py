@@ -1,0 +1,156 @@
+"""L1 mode engine tests — the state machine the reference never tested.
+
+Each test pins one behavior documented in SURVEY.md §2.5/§3.4 with its
+reference file:line.
+"""
+
+import pytest
+
+from tpu_cc_manager.device.base import set_backend
+from tpu_cc_manager.device.fake import FakeBackend, FakeChip, fake_backend
+from tpu_cc_manager.engine import FatalModeError, ModeEngine, Drainer
+from tpu_cc_manager.modes import InvalidModeError
+
+
+class RecordingDrainer(Drainer):
+    def __init__(self):
+        self.events = []
+
+    def evict(self):
+        self.events.append("evict")
+
+    def reschedule(self):
+        self.events.append("reschedule")
+
+
+class Harness:
+    def __init__(self, backend, evict=True):
+        set_backend(backend)
+        self.backend = backend
+        self.states = []
+        self.drainer = RecordingDrainer()
+        self.engine = ModeEngine(
+            set_state_label=self.states.append,
+            drainer=self.drainer,
+            evict_components=evict,
+        )
+
+
+def test_set_mode_on_full_cycle():
+    h = Harness(fake_backend(n_chips=4))
+    assert h.engine.set_mode("on") is True
+    for c in h.backend.chips:
+        assert c.query_cc_mode() == "on"
+        assert c.resets == 1
+    assert h.states == ["on"]  # observed-state label (main.py:310)
+    assert h.drainer.events == ["evict", "reschedule"]
+
+
+def test_idempotent_fast_path_no_device_work():
+    # all chips already at target -> no set/reset, state still published
+    # (reference main.py:227-230; scripts/cc-manager.sh:342-346)
+    h = Harness(fake_backend(n_chips=2, cc_mode="on"))
+    assert h.engine.set_mode("on") is True
+    for c in h.backend.chips:
+        assert c.sets == 0 and c.resets == 0
+    assert h.states == ["on"]
+    assert h.drainer.events == []  # no eviction on fast path
+
+
+def test_zero_devices_is_success():
+    # 0 capable devices -> success, nothing to do (cc-manager.sh:338-340)
+    h = Harness(FakeBackend(chips=[]))
+    assert h.engine.set_mode("on") is True
+    assert h.states == []
+
+
+def test_mixed_capability_bailout_is_fatal():
+    chips = [FakeChip(path="/dev/accel0"), FakeChip(path="/dev/accel1", cc_capable=False, ici_capable=False)]
+    h = Harness(FakeBackend(chips=chips))
+    # protected mode on a mixed node -> hard abort (main.py:214-217)
+    with pytest.raises(FatalModeError):
+        h.engine.set_mode("on")
+    # but mode off is allowed on a mixed node
+    assert h.engine.set_mode("off") is True
+
+
+def test_invalid_mode_rejected():
+    h = Harness(fake_backend(n_chips=1))
+    with pytest.raises(InvalidModeError):
+        h.engine.set_mode("enabled")
+
+
+def test_device_failure_sets_failed_state_and_restores_components():
+    h = Harness(fake_backend(n_chips=2))
+    h.backend.chips[1].fail_set = True
+    assert h.engine.set_mode("on") is False
+    assert h.states == ["failed"]  # main.py:300-307
+    # never-leave-drained invariant (cc-manager.sh:210-215)
+    assert h.drainer.events == ["evict", "reschedule"]
+
+
+def test_verify_mismatch_fails():
+    h = Harness(fake_backend(n_chips=1))
+    h.backend.chips[0].drop_staged_mode = True
+    assert h.engine.set_mode("devtools") is False
+    assert h.states == ["failed"]  # main.py:291-296
+
+
+def test_boot_timeout_fails():
+    h = Harness(fake_backend(n_chips=1))
+    h.backend.chips[0].fail_boot = True
+    assert h.engine.set_mode("on") is False
+    assert h.states == ["failed"]
+
+
+def test_ici_mode_covers_switches_and_forces_cc_off():
+    h = Harness(fake_backend(n_chips=2, n_switches=1, cc_mode="on"))
+    assert h.engine.set_mode("ici") is True
+    for c in h.backend.chips:
+        if not c.is_ici_switch():
+            assert c.query_cc_mode() == "off"  # mutual exclusion (main.py:512-532)
+        assert c.query_ici_mode() == "on"
+    assert h.states[-1] == "ici"
+
+
+def test_cc_mode_forces_ici_off():
+    h = Harness(fake_backend(n_chips=2, ici_mode="on"))
+    assert h.engine.set_mode("on") is True
+    for c in h.backend.chips:
+        assert c.query_ici_mode() == "off"  # main.py:534-559
+        assert c.query_cc_mode() == "on"
+    assert h.states[-1] == "on"
+
+
+def test_off_disables_both_domains():
+    h = Harness(fake_backend(n_chips=2, cc_mode="on", ici_mode="on"))
+    assert h.engine.set_mode("off") is True
+    for c in h.backend.chips:
+        assert c.query_cc_mode() == "off"
+        assert c.query_ici_mode() == "off"
+    assert h.states[-1] == "off"  # main.py:561-583
+
+
+def test_evict_components_false_skips_drain():
+    # EVICT_OPERATOR_COMPONENTS=false analog (main.py:94-96,232-235)
+    h = Harness(fake_backend(n_chips=1), evict=False)
+    assert h.engine.set_mode("on") is True
+    assert h.drainer.events == []
+
+
+def test_get_modes_reports_all_domains():
+    h = Harness(fake_backend(n_chips=1, n_switches=1))
+    modes = h.engine.get_modes()
+    assert modes["/dev/accel0"] == {"cc": "off", "ici": "off"}
+    # find_tpus returns switches too; switch reports only ici
+    assert modes["/dev/ici-switch0"] == {"ici": "off"}
+
+
+def test_partial_failure_aborts_node_flip():
+    # first chip flips, second fails -> whole node reports failed, and the
+    # engine stops (no attempt to continue past the failure)
+    h = Harness(fake_backend(n_chips=3))
+    h.backend.chips[1].fail_reset = True
+    assert h.engine.set_mode("on") is False
+    assert h.backend.chips[2].sets == 0
+    assert h.states == ["failed"]

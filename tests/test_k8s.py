@@ -1,0 +1,241 @@
+"""k8s layer tests: fake store semantics, then the HTTP client against the
+HTTP API server (wire-protocol round trip)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.k8s import ApiException, ConflictError, FakeKube, HttpKubeClient
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.client import KubeConfig
+from tpu_cc_manager.k8s.objects import make_node, make_pod, match_selector, merge_patch
+
+
+# ------------------------------------------------------------------ objects
+def test_merge_patch_semantics():
+    base = {"metadata": {"labels": {"a": "1", "b": "2"}}}
+    out = merge_patch(base, {"metadata": {"labels": {"b": None, "c": "3"}}})
+    assert out["metadata"]["labels"] == {"a": "1", "c": "3"}
+    assert base["metadata"]["labels"] == {"a": "1", "b": "2"}  # no mutation
+
+
+def test_match_selector():
+    labels = {"app": "x", "tier": "gpu"}
+    assert match_selector(labels, "app=x")
+    assert match_selector(labels, "app==x,tier=gpu")
+    assert not match_selector(labels, "app=y")
+    assert match_selector(labels, "app!=y")
+    assert match_selector(labels, "app")
+    assert not match_selector(labels, "missing")
+    assert match_selector(labels, None)
+
+
+# --------------------------------------------------------------- fake store
+def test_fake_node_crud_and_rv_monotonic():
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={"x": "1"}))
+    n = kube.get_node("n1")
+    rv1 = int(n["metadata"]["resourceVersion"])
+    kube.set_node_labels("n1", {"x": "2"})
+    n2 = kube.get_node("n1")
+    assert n2["metadata"]["labels"]["x"] == "2"
+    assert int(n2["metadata"]["resourceVersion"]) > rv1
+    with pytest.raises(ApiException) as ei:
+        kube.get_node("missing")
+    assert ei.value.status == 404
+
+
+def test_fake_label_delete_via_none():
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={"x": "1"}))
+    kube.set_node_labels("n1", {"x": None})
+    assert "x" not in kube.get_node("n1")["metadata"]["labels"]
+
+
+def test_fake_replace_node_cas():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    n = kube.get_node("n1")
+    n["metadata"]["annotations"]["owner"] = "a"
+    kube.replace_node("n1", n)  # fresh rv: ok
+    n_stale = dict(n)  # still carries the old rv
+    with pytest.raises(ConflictError):
+        kube.replace_node("n1", n_stale)
+
+
+def test_fake_pods_list_delete_evict_pdb():
+    kube = FakeKube()
+    kube.add_pod(make_pod("p1", "ns1", labels={"app": "a"}, node_name="n1"))
+    kube.add_pod(make_pod("p2", "ns1", labels={"app": "b"}, node_name="n2"))
+    assert len(kube.list_pods("ns1")) == 2
+    assert [p["metadata"]["name"] for p in kube.list_pods("ns1", "app=a")] == ["p1"]
+    assert [
+        p["metadata"]["name"]
+        for p in kube.list_pods("ns1", field_selector="spec.nodeName=n2")
+    ] == ["p2"]
+    kube.pdb_blocked.add(("ns1", "p1"))
+    with pytest.raises(ApiException) as ei:
+        kube.evict_pod("ns1", "p1")
+    assert ei.value.status == 429
+    kube.pdb_blocked.clear()
+    kube.evict_pod("ns1", "p1")
+    kube.delete_pod("ns1", "p2")
+    assert kube.list_pods("ns1") == []
+
+
+def test_fake_watch_replays_history_then_streams():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    rv0 = kube.latest_rv
+    kube.set_node_labels("n1", {"step": "1"})
+
+    got = []
+
+    def run():
+        for etype, obj in kube.watch_nodes(name="n1", resource_version=rv0, timeout_s=5):
+            got.append((etype, obj["metadata"]["labels"].get("step")))
+            if len(got) == 2:
+                return
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.2)
+    kube.set_node_labels("n1", {"step": "2"})
+    t.join(timeout=5)
+    assert got == [("MODIFIED", "1"), ("MODIFIED", "2")]
+
+
+def test_fake_watch_scopes_to_node_name():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    kube.add_node(make_node("n2"))
+    rv0 = kube.latest_rv
+    kube.set_node_labels("n2", {"x": "1"})
+    kube.set_node_labels("n1", {"x": "1"})
+    events = []
+    for etype, obj in kube.watch_nodes(name="n1", resource_version=rv0, timeout_s=1):
+        events.append(obj["metadata"]["name"])
+        break
+    assert events == ["n1"]
+
+
+def test_fake_watch_410_after_compaction():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    rv0 = kube.latest_rv
+    kube.set_node_labels("n1", {"x": "1"})
+    kube.compact_watch_history()
+    with pytest.raises(ApiException) as ei:
+        next(iter(kube.watch_nodes(name="n1", resource_version=rv0, timeout_s=1)))
+    assert ei.value.status == 410
+
+
+def test_fake_watch_timeout_clean_end():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    rv = kube.latest_rv
+    start = time.monotonic()
+    events = list(kube.watch_nodes(name="n1", resource_version=rv, timeout_s=1))
+    assert events == []
+    assert time.monotonic() - start >= 0.9
+
+
+def test_fake_watch_error_injection():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    kube.fail_next_watches = 1
+    with pytest.raises(ApiException) as ei:
+        next(iter(kube.watch_nodes(name="n1", timeout_s=1)))
+    assert ei.value.status == 500
+    # next call succeeds
+    list(kube.watch_nodes(name="n1", resource_version=kube.latest_rv, timeout_s=1))
+
+
+# -------------------------------------------------- HTTP client <-> server
+@pytest.fixture()
+def server():
+    with FakeApiServer() as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    return HttpKubeClient(KubeConfig("127.0.0.1", server.port, use_tls=False))
+
+
+def test_http_node_roundtrip(server, client):
+    server.store.add_node(make_node("tpu-node-0", labels={"a": "1"}))
+    node = client.get_node("tpu-node-0")
+    assert node["metadata"]["labels"]["a"] == "1"
+    client.set_node_labels("tpu-node-0", {"a": "2", "b": None})
+    assert client.get_node("tpu-node-0")["metadata"]["labels"] == {"a": "2"}
+    nodes = client.list_nodes("a=2")
+    assert [n["metadata"]["name"] for n in nodes] == ["tpu-node-0"]
+    assert client.list_nodes("a=nope") == []
+    with pytest.raises(ApiException) as ei:
+        client.get_node("missing")
+    assert ei.value.status == 404
+
+
+def test_http_replace_conflict(server, client):
+    server.store.add_node(make_node("n1"))
+    n = client.get_node("n1")
+    client.replace_node("n1", n)
+    with pytest.raises(ConflictError):
+        client.replace_node("n1", n)
+
+
+def test_http_pods_and_eviction(server, client):
+    server.store.add_pod(make_pod("p1", "tpu-system", labels={"app": "dp"}))
+    pods = client.list_pods("tpu-system", label_selector="app=dp")
+    assert len(pods) == 1
+    server.store.pdb_blocked.add(("tpu-system", "p1"))
+    with pytest.raises(ApiException) as ei:
+        client.evict_pod("tpu-system", "p1")
+    assert ei.value.status == 429
+    server.store.pdb_blocked.clear()
+    client.evict_pod("tpu-system", "p1")
+    assert client.list_pods("tpu-system") == []
+
+
+def test_http_watch_stream_and_timeout(server, client):
+    server.store.add_node(make_node("n1"))
+    rv = server.store.latest_rv
+
+    got = []
+
+    def run():
+        for etype, obj in client.watch_nodes(
+            name="n1", resource_version=rv, timeout_s=3
+        ):
+            got.append(obj["metadata"]["labels"].get("m"))
+            if len(got) == 2:
+                return
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)
+    server.store.set_node_labels("n1", {"m": "on"})
+    time.sleep(0.3)
+    server.store.set_node_labels("n1", {"m": "off"})
+    t.join(timeout=10)
+    assert got == ["on", "off"]
+
+
+def test_http_watch_410_surfaces_as_api_exception(server, client):
+    server.store.add_node(make_node("n1"))
+    rv = server.store.latest_rv
+    server.store.set_node_labels("n1", {"x": "1"})
+    server.store.compact_watch_history()
+    with pytest.raises(ApiException) as ei:
+        for _ in client.watch_nodes(name="n1", resource_version=rv, timeout_s=2):
+            pass
+    assert ei.value.status == 410
+
+
+def test_http_watch_clean_timeout_eof(server, client):
+    server.store.add_node(make_node("n1"))
+    rv = server.store.latest_rv
+    events = list(client.watch_nodes(name="n1", resource_version=rv, timeout_s=1))
+    assert events == []

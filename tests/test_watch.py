@@ -1,0 +1,162 @@
+"""L3 watcher tests: lossy coalescing semantics and watch-stream
+robustness (resume, 410 resync, consecutive-error fatal)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.watch import (
+    FatalWatchError,
+    NodeWatcher,
+    SyncableModeConfig,
+)
+
+
+# ----------------------------------------------------- coalescing mailbox
+def test_mailbox_blocks_until_change():
+    m = SyncableModeConfig()
+    got, val = m.get(timeout=0.1)
+    assert not got
+    m.set("on")
+    got, val = m.get(timeout=1)
+    assert got and val == "on"
+    # same value again: no wakeup (cmd/main.go:68-76 blocks until change)
+    got, val = m.get(timeout=0.1)
+    assert not got
+
+
+def test_mailbox_coalesces_burst_to_latest():
+    # N rapid updates collapse to ONE read of the latest value
+    # (the deliberate lossy semantics, SURVEY.md §5.2)
+    m = SyncableModeConfig()
+    for v in ("on", "off", "devtools", "ici"):
+        m.set(v)
+    got, val = m.get(timeout=1)
+    assert got and val == "ici"
+    got, _ = m.get(timeout=0.1)
+    assert not got
+
+
+def test_mailbox_none_value_is_consumable():
+    # label removal publishes None, which is a real value (not a timeout)
+    m = SyncableModeConfig()
+    m.set("on")
+    assert m.get(timeout=1) == (True, "on")
+    m.set(None)
+    assert m.get(timeout=1) == (True, None)
+
+
+def test_mailbox_close_unblocks():
+    m = SyncableModeConfig()
+    results = []
+
+    def run():
+        results.append(m.get(timeout=5))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.1)
+    m.close()
+    t.join(timeout=2)
+    assert results == [(False, None)]
+
+
+# -------------------------------------------------------------- watcher
+def _watch_env(label=None):
+    kube = FakeKube()
+    labels = {L.CC_MODE_LABEL: label} if label else {}
+    kube.add_node(make_node("n1", labels=labels))
+    m = SyncableModeConfig()
+    w = NodeWatcher(kube, "n1", m, backoff_s=0.05, watch_timeout_s=2)
+    return kube, m, w
+
+
+def test_watcher_prime_reads_initial_label():
+    kube, m, w = _watch_env(label="on")
+    assert w.prime() == "on"
+    assert w.resource_version == kube.latest_rv
+
+
+def test_watcher_pushes_label_changes():
+    kube, m, w = _watch_env(label="off")
+    w.prime()
+    w.start()
+    try:
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "on"})
+        got, val = m.get(timeout=5)
+        assert got and val == "on"
+        # unrelated label change does not push (value dedup, main.py:651-661)
+        kube.set_node_labels("n1", {"other": "x"})
+        got, _ = m.get(timeout=0.3)
+        assert not got
+    finally:
+        w.stop()
+
+
+def test_watcher_survives_watch_timeout_and_resumes():
+    kube, m, w = _watch_env(label="off")
+    w.prime()
+    w.watch_timeout_s = 1  # quick server-side timeouts
+    w.start()
+    try:
+        time.sleep(1.5)  # at least one timeout/reconnect cycle
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "devtools"})
+        got, val = m.get(timeout=5)
+        assert got and val == "devtools"
+        assert w.consecutive_errors == 0
+    finally:
+        w.stop()
+
+
+def test_watcher_410_resync_reconciles_missed_change():
+    kube, m, w = _watch_env(label="off")
+    w.prime()
+    # change + compact while the watcher is NOT running: resume rv is stale
+    kube.set_node_labels("n1", {L.CC_MODE_LABEL: "on"})
+    kube.compact_watch_history()
+    w.start()
+    try:
+        got, val = m.get(timeout=5)  # re-list path must deliver the change
+        assert got and val == "on"
+    finally:
+        w.stop()
+
+
+def test_watcher_error_backoff_then_recovery():
+    kube, m, w = _watch_env(label="off")
+    w.prime()
+    kube.fail_next_watches = 3
+    w.start()
+    try:
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "ici"})
+        got, val = m.get(timeout=10)
+        assert got and val == "ici"
+    finally:
+        w.stop()
+
+
+def test_watcher_consecutive_errors_fatal():
+    kube, m, w = _watch_env(label="off")
+    w.prime()
+    kube.fail_next_watches = 10**6
+    fatal = []
+    w.on_fatal = fatal.append
+    w.max_consecutive_errors = 5
+    w.backoff_s = 0.01
+    w.run()  # returns after invoking on_fatal
+    assert len(fatal) == 1
+    assert isinstance(fatal[0], FatalWatchError)
+
+
+def test_watcher_fatal_raises_without_handler():
+    kube, m, w = _watch_env(label="off")
+    w.prime()
+    kube.fail_next_watches = 10**6
+    w.max_consecutive_errors = 3
+    w.backoff_s = 0.01
+    with pytest.raises(FatalWatchError):
+        w.run()

@@ -1,0 +1,70 @@
+"""CLI entrypoint: ``python -m tpu_cc_manager``.
+
+Modes of operation (parity with both reference CLIs):
+
+- no subcommand: run the long-lived agent (reference main.py:703-759,
+  cmd/main.go:78-117);
+- ``set-cc-mode -m <mode>``: one-shot engine invocation, the bash-engine
+  CLI surface (reference scripts/cc-manager.sh:472-533) — this is also
+  what the native C++ agent execs per reconcile;
+- ``get-cc-mode``: print per-device modes as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+from tpu_cc_manager.agent import CCManagerAgent
+from tpu_cc_manager.config import parse_config
+from tpu_cc_manager.drain import build_drainer, set_cc_mode_state_label
+from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
+from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+from tpu_cc_manager.obs import setup_logging
+
+log = logging.getLogger("tpu-cc-manager")
+
+
+def _kube_client(cfg):
+    return HttpKubeClient(KubeConfig.load(cfg.kubeconfig))
+
+
+def main(argv=None) -> int:
+    cfg, args = parse_config(argv)
+    setup_logging(cfg.debug)
+
+    if args.command == "get-cc-mode":
+        engine = ModeEngine(set_state_label=lambda v: None, drainer=NullDrainer(),
+                            evict_components=False)
+        print(json.dumps(engine.get_modes(), indent=2, sort_keys=True))
+        return 0
+
+    if args.command == "set-cc-mode":
+        kube = _kube_client(cfg)
+        engine = ModeEngine(
+            set_state_label=lambda v: set_cc_mode_state_label(
+                kube, cfg.node_name, v
+            ),
+            drainer=build_drainer(kube, cfg),
+            evict_components=cfg.evict_components and cfg.drain_strategy != "none",
+        )
+        try:
+            return 0 if engine.set_mode(args.mode) else 1
+        except FatalModeError as e:
+            log.error("fatal: %s", e)
+            return 1
+
+    # long-lived agent
+    kube = _kube_client(cfg)
+    slice_coordinator = None
+    if cfg.slice_coordination:
+        from tpu_cc_manager.slice_coord import SliceCoordinator
+
+        slice_coordinator = SliceCoordinator(kube, cfg.node_name)
+    agent = CCManagerAgent(kube, cfg, slice_coordinator=slice_coordinator)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

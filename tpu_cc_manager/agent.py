@@ -1,0 +1,217 @@
+"""L3 — the per-node agent: watch desired state, reconcile, publish status.
+
+Orchestration mirrors the union of the reference's Go agent (startup +
+coalesced reconcile loop, cmd/main.go:119-170) and Python agent
+(watch_and_apply, main.py:585-700), with the additions SURVEY.md §7.2
+step 5 calls for: metrics around every reconcile, /healthz, and optional
+slice coordination.
+
+Error philosophy (reference cmd/main.go:164-167 + main.py:300-307):
+
+- a *reconcile* failure is logged, published as ``cc.mode.state=failed``,
+  and the loop continues — the next label event retries;
+- a *fatal* condition (mixed-capability node, 10 consecutive watch
+  errors) exits the process; the DaemonSet restart policy is the
+  recovery mechanism (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from tpu_cc_manager.config import AgentConfig
+from tpu_cc_manager.drain import build_drainer, set_cc_mode_state_label
+from tpu_cc_manager.engine import FatalModeError, ModeEngine
+from tpu_cc_manager.k8s.client import KubeClient
+from tpu_cc_manager.modes import InvalidModeError
+from tpu_cc_manager.obs import HealthServer, Metrics, create_readiness_file
+from tpu_cc_manager.watch import FatalWatchError, NodeWatcher, SyncableModeConfig
+
+log = logging.getLogger("tpu-cc-manager.agent")
+
+
+def with_default(value: Optional[str], default: Optional[str]) -> Optional[str]:
+    """Empty/absent label falls back to the default mode (reference
+    main.py:691-697; cmd/main.go:158-161). Returns None when neither is
+    set, meaning 'nothing to reconcile'."""
+    if value:
+        return value
+    return default or None
+
+
+class CCManagerAgent:
+    def __init__(
+        self,
+        kube: KubeClient,
+        cfg: AgentConfig,
+        *,
+        metrics: Optional[Metrics] = None,
+        slice_coordinator=None,
+    ):
+        self.kube = kube
+        self.cfg = cfg
+        self.metrics = metrics or Metrics()
+        self.config_mailbox = SyncableModeConfig(
+            on_coalesced=lambda: self.metrics.coalesced_total.inc()
+        )
+        self.watcher = NodeWatcher(
+            kube,
+            cfg.node_name,
+            self.config_mailbox,
+            on_fatal=self._on_fatal_watch,
+            on_error=lambda: self.metrics.watch_errors_total.inc(),
+        )
+        self.slice_coordinator = slice_coordinator
+
+        self.engine = ModeEngine(
+            set_state_label=self._set_state_label,
+            drainer=build_drainer(kube, cfg),
+            evict_components=cfg.evict_components and cfg.drain_strategy != "none",
+        )
+        self.health: Optional[HealthServer] = None
+        self._fatal: Optional[Exception] = None
+        self._stop = threading.Event()
+        self.reconcile_count = 0
+
+    # ------------------------------------------------------------ plumbing
+    def _set_state_label(self, value: str) -> None:
+        set_cc_mode_state_label(self.kube, self.cfg.node_name, value)
+        self.metrics.set_current_mode(value)
+
+    def _on_fatal_watch(self, exc: Exception) -> None:
+        self._fatal = exc
+        self._stop.set()
+        self.config_mailbox.close()
+
+    def _prime_with_retry(self) -> Optional[str]:
+        """Initial node read with the watch loop's backoff/fatal policy
+        (reference main.py:664-689 applied to startup)."""
+        from tpu_cc_manager.k8s.client import ApiException
+
+        attempts = 0
+        while True:
+            try:
+                return self.watcher.prime()
+            except ApiException as e:
+                attempts += 1
+                self.metrics.watch_errors_total.inc()
+                if attempts >= self.watcher.max_consecutive_errors:
+                    raise FatalWatchError(
+                        f"{attempts} consecutive failures reading node "
+                        f"{self.cfg.node_name} at startup; last: {e}"
+                    ) from e
+                log.warning(
+                    "startup node read failed (%d): %s; retrying in %.1fs",
+                    attempts, e, self.watcher.backoff_s,
+                )
+                time.sleep(self.watcher.backoff_s)
+
+    # ----------------------------------------------------------- reconcile
+    def reconcile(self, raw_mode: str) -> bool:
+        """One mode application, instrumented. Never raises except
+        FatalModeError."""
+        start = time.monotonic()
+        outcome = "error"
+        try:
+            if self.slice_coordinator is not None:
+                ok = self.slice_coordinator.apply_slice_coherent(
+                    raw_mode, self.engine
+                )
+            else:
+                ok = self.engine.set_mode(raw_mode)
+            outcome = "success" if ok else "failure"
+            return ok
+        except InvalidModeError as e:
+            # bad label value: report, keep serving (the operator may fix it)
+            log.error("rejecting desired mode: %s", e)
+            try:
+                self._set_state_label("failed")
+            except Exception:
+                log.exception("failed to publish failed state")
+            outcome = "invalid"
+            return False
+        except FatalModeError:
+            outcome = "fatal"
+            raise
+        except Exception:
+            log.exception("reconcile crashed")
+            try:
+                self._set_state_label("failed")
+            except Exception:
+                log.exception("failed to publish failed state")
+            return False
+        finally:
+            dur = time.monotonic() - start
+            self.metrics.reconcile_duration.observe(dur)
+            self.metrics.reconciles_total.inc(outcome)
+            self.reconcile_count += 1
+            log.info("reconcile finished: %s in %.3fs", outcome, dur)
+
+    # ---------------------------------------------------------------- run
+    def run(self, max_reconciles: Optional[int] = None) -> int:
+        """Run the agent. Returns a process exit code. ``max_reconciles``
+        bounds loop iterations for tests/bench (None = forever)."""
+        cfg = self.cfg
+        if cfg.health_port:  # 0 disables (SURVEY.md §5.6 table)
+            try:
+                self.health = HealthServer(self.metrics, port=cfg.health_port).start()
+            except OSError as e:
+                log.warning("health server disabled: %s", e)
+
+        try:
+            # initial read + reconcile (reference cmd/main.go:131-149,
+            # main.py:614-617); transient API errors at startup get the
+            # same backoff treatment as the watch loop
+            initial = self._prime_with_retry()
+            mode = with_default(initial, cfg.default_mode)
+            if mode is not None:
+                ok = self.reconcile(mode)
+                if not ok and initial is None:
+                    # startup default-apply failure is fatal in the Go agent
+                    # (cmd/main.go:141-145)
+                    log.error("initial default-mode apply failed; exiting")
+                    return 1
+            # signal readiness only after the initial reconcile
+            # (reference main.py:617, scripts/cc-manager.sh:536)
+            create_readiness_file(cfg.readiness_file)
+            if self.health:
+                self.health.ready = True
+
+            self.watcher.start()
+            while not self._stop.is_set():
+                got, value = self.config_mailbox.get(timeout=1.0)
+                if not got:
+                    if max_reconciles is not None and self.reconcile_count >= max_reconciles:
+                        break
+                    continue
+                if self._stop.is_set():
+                    break
+                mode = with_default(value, cfg.default_mode)
+                if mode is None:
+                    continue
+                self.reconcile(mode)  # failure: log + continue (go :164-167)
+                if max_reconciles is not None and self.reconcile_count >= max_reconciles:
+                    break
+            if self._fatal is not None:
+                log.error("agent exiting on fatal error: %s", self._fatal)
+                return 1
+            return 0
+        except FatalModeError as e:
+            log.error("fatal: %s", e)
+            return 1
+        except FatalWatchError as e:
+            log.error("fatal: %s", e)
+            return 1
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.watcher.stop()
+        if self.health:
+            self.health.live = False
+            self.health.stop()
+            self.health = None

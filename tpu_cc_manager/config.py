@@ -1,0 +1,130 @@
+"""L4 — configuration: flags-over-env, no config files (SURVEY.md §5.6).
+
+Every flag defaults from an environment variable, exactly as the reference
+does across its three implementations (reference cmd/main.go:83-117,
+main.py:703-759, scripts/cc-manager.sh:5-6):
+
+========================  =============================  =======================
+flag                      env                            default
+========================  =============================  =======================
+--kubeconfig              KUBECONFIG                     in-cluster, else ~/.kube/config
+--default-cc-mode / -m    DEFAULT_CC_MODE                "on"
+--node-name               NODE_NAME                      (required)
+--debug                   CC_MANAGER_DEBUG               false
+(none)                    OPERATOR_NAMESPACE             "tpu-system"
+(none)                    EVICT_OPERATOR_COMPONENTS      "true"
+(none)                    DRAIN_STRATEGY                 "components" | "node" | "none"
+(none)                    CC_READINESS_FILE              /run/tpu/validations/.cc-manager-ctr-ready
+(none)                    CC_CAPABLE_DEVICE_IDS          "" (all Google chips capable)
+--health-port             HEALTH_PORT                    8089 (0 disables)
+(none)                    SLICE_COORDINATION             "false"
+========================  =============================  =======================
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import List, Optional
+
+#: Readiness file signalling "initial reconcile done" to the validation
+#: framework (reference main.py:64: /run/nvidia/validations/...).
+DEFAULT_READINESS_FILE = "/run/tpu/validations/.cc-manager-ctr-ready"
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    node_name: str
+    default_mode: str = "on"
+    kubeconfig: Optional[str] = None
+    debug: bool = False
+    operator_namespace: str = "tpu-system"
+    evict_components: bool = True
+    drain_strategy: str = "components"  # components | node | none
+    readiness_file: str = DEFAULT_READINESS_FILE
+    health_port: int = 8089
+    slice_coordination: bool = False
+
+    def __post_init__(self):
+        if self.drain_strategy not in ("components", "node", "none"):
+            raise ValueError(
+                f"invalid DRAIN_STRATEGY {self.drain_strategy!r}: "
+                "must be components|node|none"
+            )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-cc-manager",
+        description="TPU confidential-computing mode manager for Kubernetes",
+    )
+    p.add_argument(
+        "--kubeconfig",
+        default=os.environ.get("KUBECONFIG"),
+        help="path to kubeconfig; omit for in-cluster config",
+    )
+    p.add_argument(
+        "-m",
+        "--default-cc-mode",
+        default=os.environ.get("DEFAULT_CC_MODE", "on"),
+        help="mode applied when the node has no cc.mode label (default: on)",
+    )
+    p.add_argument(
+        "--node-name",
+        default=os.environ.get("NODE_NAME"),
+        help="this node's name (env NODE_NAME; required)",
+    )
+    p.add_argument(
+        "--health-port",
+        type=int,
+        default=int(os.environ.get("HEALTH_PORT", "8089")),
+        help="port for /healthz + /metrics (0 disables; default 8089)",
+    )
+    p.add_argument(
+        "--debug",
+        action="store_true",
+        default=_env_bool("CC_MANAGER_DEBUG", False),
+        help="enable debug logging",
+    )
+    # one-shot engine subcommands (parity with the bash engine CLI,
+    # reference scripts/cc-manager.sh:472-533)
+    sub = p.add_subparsers(dest="command")
+    set_p = sub.add_parser("set-cc-mode", help="apply a mode once and exit")
+    set_p.add_argument("-m", "--mode", required=True)
+    set_p.add_argument(
+        "-a", "--all-devices", action="store_true", default=True,
+        help="operate on all devices (the only supported scope)",
+    )
+    sub.add_parser("get-cc-mode", help="print per-device modes and exit")
+    return p
+
+
+def parse_config(argv: Optional[List[str]] = None):
+    """-> (AgentConfig, parsed_args). Validates NODE_NAME presence like the
+    reference (cmd/main.go:109-115, main.py:737-739)."""
+    args = build_parser().parse_args(argv)
+    if not args.node_name and args.command != "get-cc-mode":
+        raise SystemExit(
+            "NODE_NAME env or --node-name flag is required"
+        )
+    cfg = AgentConfig(
+        node_name=args.node_name or "",
+        default_mode=args.default_cc_mode,
+        kubeconfig=args.kubeconfig,
+        debug=args.debug,
+        operator_namespace=os.environ.get("OPERATOR_NAMESPACE", "tpu-system"),
+        evict_components=_env_bool("EVICT_OPERATOR_COMPONENTS", True),
+        drain_strategy=os.environ.get("DRAIN_STRATEGY", "components"),
+        readiness_file=os.environ.get("CC_READINESS_FILE", DEFAULT_READINESS_FILE),
+        health_port=args.health_port,
+        slice_coordination=_env_bool("SLICE_COORDINATION", False),
+    )
+    return cfg, args

@@ -48,6 +48,13 @@ class TpuChip(abc.ABC):
     def set_ici_mode(self, mode: str) -> None:
         """Stage protected-ICI mode; takes effect after reset (main.py:393)."""
 
+    def discard_staged(self) -> None:
+        """Drop any staged-but-uncommitted mode, reverting staged state to
+        the current effective modes. Called by the engine before staging a
+        fresh flip so a previous failed/crashed flip's intent cannot ride
+        along into this reset. Default: no-op for backends without durable
+        staging."""
+
     @abc.abstractmethod
     def reset(self) -> None:
         """Restart the TPU runtime / reset the chip so a staged mode takes

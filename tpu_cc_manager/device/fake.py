@@ -92,6 +92,11 @@ class FakeChip(TpuChip):
             self.sets += 1
             self._staged_ici = mode
 
+    def discard_staged(self) -> None:
+        with self._lock:
+            self._staged_cc = self._cc_mode
+            self._staged_ici = self._ici_mode
+
     def reset(self) -> None:
         if self.fail_reset:
             raise DeviceError(f"{self.path}: reset failed (injected)")

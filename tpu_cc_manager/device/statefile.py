@@ -99,3 +99,13 @@ class ModeStateStore:
             for domain in ("cc", "ici"):
                 staged = self._read(d, f"{domain}.staged")
                 self._write_atomic(d, f"{domain}.effective", staged)
+
+    def discard(self, path: str) -> None:
+        """Roll staged back to effective for every domain. The engine calls
+        this before staging a new flip so that stale intent from an earlier
+        failed/crashed flip can never ride along into the next reset (the
+        durable *desired* state lives in the node label, not here)."""
+        with self._locked(path) as d:
+            for domain in ("cc", "ici"):
+                effective = self._read(d, f"{domain}.effective")
+                self._write_atomic(d, f"{domain}.staged", effective)

@@ -85,7 +85,13 @@ def capable_device_ids() -> Optional[set]:
         tok = tok.strip().lower()
         if not tok:
             continue
-        ids.add(int(tok, 16))
+        try:
+            ids.add(int(tok, 16))
+        except ValueError:
+            raise DeviceError(
+                f"invalid CC_CAPABLE_DEVICE_IDS token {tok!r}: expected a "
+                f"comma-separated list of hex device ids (e.g. '0x0063')"
+            ) from None
     return ids
 
 
@@ -135,6 +141,9 @@ class SysfsTpuChip(TpuChip):
         if not self.is_ici_query_supported:
             raise DeviceError(f"{self.path}: ICI not supported")
         self._store.stage(self.path, "ici", mode)
+
+    def discard_staged(self) -> None:
+        self._store.discard(self.path)
 
     def reset(self) -> None:
         """Apply staged modes: unbind/rebind-style runtime restart.
@@ -217,7 +226,8 @@ class SysfsTpuBackend(Backend):
     def find_tpus(self) -> Tuple[List[TpuChip], Optional[str]]:
         try:
             chips = self._scan()
-        except OSError as e:  # enumeration error surface (find_gpus 2-tuple)
+        except (OSError, DeviceError) as e:
+            # enumeration error surface (find_gpus 2-tuple, main.py:128)
             return [], str(e)
         return [c for c in chips if not c.is_ici_switch()], None
 

@@ -1,0 +1,228 @@
+"""L2 — drain TPU workloads around a mode flip, and publish observed state.
+
+Two drain strategies, selectable per deployment:
+
+1. :class:`ComponentDrainer` — the pause-label protocol, a faithful
+   TPU-native rebuild of the reference's gpu-operator eviction module
+   (reference gpu_operator_eviction.py): flip each
+   ``tpu.google.com/pool.deploy.*`` node label to a paused marker that
+   preserves the original value, wait for the component's pods to leave
+   the node (2 s poll, 300 s timeout per component, warn-and-continue on
+   timeout — reference gpu_operator_eviction.py:174-208), and restore the
+   original labels afterwards.
+
+2. :class:`NodeDrainer` — the GKE-native strategy the reference lacks
+   (SURVEY.md §7.1): cordon the node (``spec.unschedulable``), evict
+   TPU-consuming pods through the Eviction API (respecting PDBs: 429 is
+   retried with backoff until the timeout), then uncordon. This is what
+   "drain a TPU node pool" actually means without a cooperating operator.
+
+Both preserve the reference's cardinal invariant: **restore is always
+attempted, even when the flip failed** (the engine calls ``reschedule()``
+in a ``finally`` — reference scripts/cc-manager.sh:210-215).
+
+The observed-state label writer lives here too, mirroring the reference's
+placement (gpu_operator_eviction.py:262-286).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Sequence
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.engine import Drainer, NullDrainer
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
+
+log = logging.getLogger("tpu-cc-manager.drain")
+
+#: Per-component pod-deletion wait (reference gpu_operator_eviction.py:136;
+#: scripts/cc-manager.sh:275 uses kubectl --timeout=5m).
+EVICTION_TIMEOUT_S = 300
+#: Poll interval while waiting for pods to go away
+#: (reference gpu_operator_eviction.py:200).
+EVICTION_POLL_S = 2
+
+
+def set_cc_mode_state_label(kube: KubeClient, node_name: str, value: str) -> None:
+    """Publish the observed-state label (reference
+    gpu_operator_eviction.py:262-286). Value is the achieved mode or
+    'failed' — the Python reference's convention, which we standardize on
+    (the bash engine's success/failed variant was a wart, SURVEY.md §5.5)."""
+    log.info("setting %s=%s on node %s", L.CC_MODE_STATE_LABEL, value, node_name)
+    kube.set_node_labels(node_name, {L.CC_MODE_STATE_LABEL: value})
+
+
+def paused_value(original: str) -> str:
+    """Encode the pause marker, preserving the original for restore
+    (reference gpu_operator_eviction.py:43-70 '<PAUSED_STR>_<original>')."""
+    return f"{L.PAUSED_STR}_{original}"
+
+
+def unpaused_value(value: str) -> str:
+    """Invert paused_value; idempotent on already-unpaused values."""
+    prefix = L.PAUSED_STR + "_"
+    return value[len(prefix):] if value.startswith(prefix) else value
+
+
+class ComponentDrainer(Drainer):
+    def __init__(
+        self,
+        kube: KubeClient,
+        node_name: str,
+        namespace: str = "tpu-system",
+        component_labels: Sequence[str] = L.COMPONENT_LABELS,
+        timeout_s: float = EVICTION_TIMEOUT_S,
+        poll_s: float = EVICTION_POLL_S,
+    ):
+        self.kube = kube
+        self.node_name = node_name
+        self.namespace = namespace
+        self.component_labels = tuple(component_labels)
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+
+    # -- reference gpu_operator_eviction.py:98-129 ----------------------
+    def fetch_current_component_labels(self) -> Dict[str, str]:
+        node = self.kube.get_node(self.node_name)
+        node_labels = node["metadata"].get("labels", {})
+        return {
+            k: node_labels[k] for k in self.component_labels if k in node_labels
+        }
+
+    # -- reference gpu_operator_eviction.py:131-215 ---------------------
+    def evict(self) -> None:
+        current = self.fetch_current_component_labels()
+        to_pause = {
+            k: paused_value(v)
+            for k, v in current.items()
+            if not v.startswith(L.PAUSED_STR) and v != "false"
+        }
+        if not to_pause:
+            log.info("no TPU-stack components deployed on %s; nothing to drain",
+                     self.node_name)
+            return
+        log.info("pausing components on %s: %s", self.node_name,
+                 sorted(to_pause))
+        self.kube.set_node_labels(self.node_name, to_pause)
+        for label_key in to_pause:
+            self._wait_component_gone(label_key)
+
+    def _wait_component_gone(self, label_key: str) -> None:
+        app = L.COMPONENT_APP_LABELS.get(label_key)
+        if app is None:
+            return
+        deadline = time.monotonic() + self.timeout_s
+        selector = f"app={app}"
+        while True:
+            pods = self.kube.list_pods(
+                self.namespace,
+                label_selector=selector,
+                field_selector=f"spec.nodeName={self.node_name}",
+            )
+            if not pods:
+                log.info("component %s drained from %s", app, self.node_name)
+                return
+            if time.monotonic() >= deadline:
+                # warn-and-continue, not fatal
+                # (reference gpu_operator_eviction.py:205-207)
+                log.warning(
+                    "timed out after %ss waiting for %d %s pod(s) to leave %s; "
+                    "continuing anyway", self.timeout_s, len(pods), app,
+                    self.node_name,
+                )
+                return
+            time.sleep(self.poll_s)
+
+    # -- reference gpu_operator_eviction.py:217-260 ---------------------
+    def reschedule(self) -> None:
+        """Unpause from live label state (not an in-memory snapshot), so a
+        crashed-and-restarted agent can still restore — durable state lives
+        in the labels (SURVEY.md §5.4)."""
+        restore = {}
+        live = self.fetch_current_component_labels()
+        for k, v in live.items():
+            if v.startswith(L.PAUSED_STR):
+                restore[k] = unpaused_value(v)
+        if restore:
+            log.info("restoring components on %s: %s", self.node_name,
+                     sorted(restore))
+            self.kube.set_node_labels(self.node_name, restore)
+
+
+class NodeDrainer(Drainer):
+    """Cordon + Eviction-API drain of TPU-consuming pods (GKE-native)."""
+
+    def __init__(
+        self,
+        kube: KubeClient,
+        node_name: str,
+        namespaces: Sequence[str] = ("default",),
+        pod_label_selector: Optional[str] = None,
+        timeout_s: float = EVICTION_TIMEOUT_S,
+        poll_s: float = EVICTION_POLL_S,
+    ):
+        self.kube = kube
+        self.node_name = node_name
+        self.namespaces = tuple(namespaces)
+        self.pod_label_selector = pod_label_selector
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+
+    def _cordon(self, value: bool) -> None:
+        self.kube.patch_node(self.node_name, {"spec": {"unschedulable": value}})
+
+    def _tpu_pods(self):
+        out = []
+        for ns in self.namespaces:
+            for pod in self.kube.list_pods(
+                ns,
+                label_selector=self.pod_label_selector,
+                field_selector=f"spec.nodeName={self.node_name}",
+            ):
+                out.append((ns, pod["metadata"]["name"]))
+        return out
+
+    def evict(self) -> None:
+        log.info("cordoning %s and evicting TPU pods", self.node_name)
+        self._cordon(True)
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            pods = self._tpu_pods()
+            if not pods:
+                return
+            blocked = 0
+            for ns, name in pods:
+                try:
+                    self.kube.evict_pod(ns, name)
+                except ApiException as e:
+                    if e.status == 429:  # PDB says not yet
+                        blocked += 1
+                    elif e.status != 404:
+                        raise
+            if blocked == 0 and not self._tpu_pods():
+                return
+            if time.monotonic() >= deadline:
+                log.warning(
+                    "timed out draining %s (%d pod(s) still blocked); "
+                    "continuing anyway", self.node_name, blocked,
+                )
+                return
+            time.sleep(self.poll_s)
+
+    def reschedule(self) -> None:
+        log.info("uncordoning %s", self.node_name)
+        self._cordon(False)
+
+
+def build_drainer(kube: KubeClient, cfg) -> Drainer:
+    """Map an AgentConfig's drain_strategy to a Drainer (single source of
+    truth for both the long-lived agent and the one-shot CLI)."""
+    if cfg.drain_strategy == "node":
+        return NodeDrainer(kube, cfg.node_name)
+    if cfg.drain_strategy == "components":
+        return ComponentDrainer(
+            kube, cfg.node_name, namespace=cfg.operator_namespace
+        )
+    return NullDrainer()

@@ -1,0 +1,227 @@
+"""L1 mode engine — the validate / plan / stage / reset / verify machine.
+
+Pure logic over the L0 device interface and two injected collaborators (a
+state-label writer and a drainer), so it is fully unit-testable — the
+design SURVEY.md §7.2 step 2 calls for. Semantics cover the reference's
+two engines:
+
+- mode validation + routing:                reference main.py:486-510
+- CC/ICI mutual exclusion:                  reference main.py:512-583
+- mixed-capability bailout:                 reference main.py:208-217
+- idempotent fast path:                     reference main.py:227-230,237-256
+- per-device stage→reset→wait→verify:       reference main.py:258-311
+- ICI (PPCIe-analog) over chips+switches:   reference main.py:369-484
+- 0-devices fast success, always-restore
+  drained components on failure:            reference scripts/cc-manager.sh:338-340,210-215
+
+One deliberate TPU-first improvement over the reference: instead of
+flipping domains sequentially (the reference runs a full
+evict→set→reset→restore cycle to turn PPCIe off, then a *second* full
+cycle to turn CC on — main.py:534-559), this engine computes the desired
+end state of BOTH domains up front, stages every divergent domain on a
+device, and performs ONE drain cycle and ONE reset per device. Mode
+transitions that cross domains cost one workload disruption instead of
+two, and each chip reboots once instead of twice.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tpu_cc_manager import device as devlayer
+from tpu_cc_manager.device.base import DeviceError, TpuChip
+from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
+
+log = logging.getLogger("tpu-cc-manager.engine")
+
+
+class FatalModeError(Exception):
+    """Unrecoverable condition: the agent must exit rather than retry.
+
+    The reference hard-exits (sys.exit(1)) when a node mixes CC-capable and
+    non-capable devices and a protected mode is requested
+    (reference main.py:214-217) — retrying can never succeed and leaving
+    the node half-protected is worse than crashing loudly.
+    """
+
+
+class Drainer:
+    """L2 collaborator interface; see tpu_cc_manager.drain for real impls."""
+
+    def evict(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def reschedule(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class NullDrainer(Drainer):
+    """No-op drainer (EVICT_OPERATOR_COMPONENTS=false, reference main.py:94-96)."""
+
+    def evict(self) -> None:
+        pass
+
+    def reschedule(self) -> None:
+        pass
+
+
+#: One unit of planned device work: the device and the per-domain targets
+#: it diverges on ({"cc": "on"} / {"ici": "off"} / both).
+PlanItem = Tuple[TpuChip, Dict[str, str]]
+
+
+class ModeEngine:
+    def __init__(
+        self,
+        *,
+        set_state_label: Callable[[str], None],
+        drainer: Optional[Drainer] = None,
+        evict_components: bool = True,
+        boot_timeout_s: float = 300.0,
+    ):
+        self._set_state_label = set_state_label
+        self._drainer = drainer or NullDrainer()
+        self._evict_components = evict_components
+        self._boot_timeout_s = boot_timeout_s
+
+    # ------------------------------------------------------------- queries
+    def get_modes(self) -> dict:
+        """Per-device current modes (get-cc-mode analog,
+        reference scripts/cc-manager.sh:407-450)."""
+        out = {}
+        for dev in self._all_devices():
+            entry = {}
+            if dev.is_cc_query_supported:
+                entry["cc"] = dev.query_cc_mode()
+            if dev.is_ici_query_supported:
+                entry["ici"] = dev.query_ici_mode()
+            out[dev.path] = entry
+        return out
+
+    # ------------------------------------------------------------ top level
+    def set_mode(self, raw_mode: str) -> bool:
+        """Validate, plan, apply. Returns True on success. Raises
+        FatalModeError on unrecoverable states and InvalidModeError on bad
+        input (reference main.py:486-510)."""
+        mode = parse_mode(raw_mode)
+        log.info("applying desired mode %r", mode.value)
+
+        # desired end state of both domains — mutual exclusion by
+        # construction (reference main.py:512-583)
+        desired_cc = mode.value if mode in CC_MODES else "off"
+        desired_ici = "on" if mode is Mode.ICI else "off"
+
+        devices = self._all_devices()
+        self._check_capability(devices, mode)
+
+        plan = self._plan(devices, desired_cc, desired_ici)
+        if not plan:
+            n = len(devices)
+            if n:
+                log.info("all %d device(s) already in mode %r", n, mode.value)
+                self._set_state_label(mode.value)
+            else:
+                # no devices at all -> success, nothing to do
+                # (reference scripts/cc-manager.sh:338-340)
+                log.info("no TPU devices on this node; nothing to do")
+            return True
+
+        log.info(
+            "mode plan: %s",
+            [(d.path, changes) for d, changes in plan],
+        )
+        return self._drain_wrapped(lambda: self._apply_plan(plan), mode.value)
+
+    # ------------------------------------------------------------- planning
+    def _all_devices(self) -> List[TpuChip]:
+        chips, err = devlayer.find_tpus()
+        if err:
+            raise DeviceError(f"device enumeration failed: {err}")
+        switches = [c for c in devlayer.find_ici_switches()
+                    if c.path not in {x.path for x in chips}]
+        return list(chips) + switches
+
+    def _check_capability(self, devices: Sequence[TpuChip], mode: Mode) -> None:
+        """Mixed-capability bailout (reference main.py:208-217): if any
+        non-switch chip cannot do CC and a protected mode is requested,
+        abort the agent — never leave a node partially protected."""
+        if mode is Mode.OFF:
+            return
+        incapable = [
+            c.path
+            for c in devices
+            if not c.is_ici_switch() and not c.is_cc_query_supported
+        ]
+        if incapable:
+            raise FatalModeError(
+                f"node mixes CC-capable and non-capable TPUs ({incapable}); "
+                f"refusing mode {mode.value!r} on a mixed node"
+            )
+
+    def _plan(
+        self, devices: Sequence[TpuChip], desired_cc: str, desired_ici: str
+    ) -> List[PlanItem]:
+        """Per-device divergence between current and desired domain modes.
+        Empty plan == the idempotent fast path (reference main.py:227-230)."""
+        plan: List[PlanItem] = []
+        for dev in devices:
+            changes: Dict[str, str] = {}
+            if dev.is_cc_query_supported and dev.query_cc_mode() != desired_cc:
+                changes["cc"] = desired_cc
+            if dev.is_ici_query_supported and dev.query_ici_mode() != desired_ici:
+                changes["ici"] = desired_ici
+            if changes:
+                plan.append((dev, changes))
+        return plan
+
+    # ------------------------------------------------------------ applying
+    def _drain_wrapped(self, apply: Callable[[], bool], state_on_success: str) -> bool:
+        """Evict around the flip; ALWAYS reschedule, even when evict or the
+        flip itself failed (reference scripts/cc-manager.sh:210-215)."""
+        ok = False
+        try:
+            if self._evict_components:
+                self._drainer.evict()
+            ok = apply()
+        except DeviceError as e:
+            log.error("mode flip failed: %s", e)
+            ok = False
+        finally:
+            if self._evict_components:
+                try:
+                    self._drainer.reschedule()
+                except Exception:
+                    log.exception("failed to reschedule drained components")
+        self._set_state_label(state_on_success if ok else STATE_FAILED)
+        return ok
+
+    def _apply_plan(self, plan: Sequence[PlanItem]) -> bool:
+        """Per-device hot loop (reference main.py:258-311): discard stale
+        staged state, stage every divergent domain, ONE reset, wait, verify
+        every staged domain. Any failure aborts the whole node flip."""
+        for dev, changes in plan:
+            try:
+                dev.discard_staged()
+                for domain, target in changes.items():
+                    if domain == "cc":
+                        dev.set_cc_mode(target)
+                    else:
+                        dev.set_ici_mode(target)
+                dev.reset()
+                dev.wait_ready(timeout_s=self._boot_timeout_s)
+                for domain, target in changes.items():
+                    achieved = (
+                        dev.query_cc_mode() if domain == "cc"
+                        else dev.query_ici_mode()
+                    )
+                    if achieved != target:
+                        log.error(
+                            "%s: %s mode verify mismatch: wanted %r got %r",
+                            dev.path, domain, target, achieved,
+                        )
+                        return False
+            except DeviceError as e:
+                log.error("%s: mode flip failed: %s", dev.path, e)
+                return False
+        return True

@@ -1,0 +1,233 @@
+"""A real HTTP API server fronting a FakeKube store.
+
+Speaks enough of the Kubernetes REST wire protocol for every client in
+this repo — the Python HttpKubeClient, the C++ native agent, the bash
+engine (via curl) — to run end-to-end without a cluster. This is the
+kind-cluster stand-in for BASELINE config 1 and the integration-test /
+bench substrate (SURVEY.md §4's "fake k8s API" requirement).
+
+Endpoints:
+
+- ``GET    /api/v1/nodes``               (list; labelSelector; watch=true)
+- ``GET    /api/v1/nodes/{name}``
+- ``PATCH  /api/v1/nodes/{name}``        (application/merge-patch+json)
+- ``PUT    /api/v1/nodes/{name}``        (optimistic replace -> 409)
+- ``GET    /api/v1/namespaces/{ns}/pods``
+- ``DELETE /api/v1/namespaces/{ns}/pods/{name}``
+- ``POST   /api/v1/namespaces/{ns}/pods/{name}/eviction``
+
+Watch responses are newline-delimited JSON event streams, ending when the
+``timeoutSeconds`` window elapses (clean EOF), or a single ERROR event for
+410, exactly as a real API server behaves.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpu_cc_manager.k8s.client import ApiException
+from tpu_cc_manager.k8s.fake import FakeKube
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: FakeKube  # set by server factory
+
+    # silence default stderr access logging
+    def log_message(self, fmt, *args):  # pragma: no cover
+        pass
+
+    # ---------------------------------------------------------- plumbing
+    def _send_json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_status(self, e: ApiException) -> None:
+        self._send_json(
+            e.status,
+            {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "message": e.reason,
+                "code": e.status,
+            },
+        )
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _parts(self):
+        parsed = urllib.parse.urlparse(self.path)
+        return parsed.path.strip("/").split("/"), dict(
+            urllib.parse.parse_qsl(parsed.query)
+        )
+
+    # ------------------------------------------------------------- verbs
+    def do_GET(self):
+        parts, q = self._parts()
+        try:
+            if parts[:3] == ["api", "v1", "nodes"]:
+                if len(parts) == 4:
+                    return self._send_json(200, self.store.get_node(parts[3]))
+                if q.get("watch") == "true":
+                    return self._stream_watch(q)
+                items = self.store.list_nodes(q.get("labelSelector"))
+                return self._send_json(
+                    200, {"kind": "NodeList", "apiVersion": "v1", "items": items}
+                )
+            if (
+                len(parts) >= 5
+                and parts[:3] == ["api", "v1", "namespaces"]
+                and parts[4] == "pods"
+            ):
+                ns = parts[3]
+                if len(parts) == 5:
+                    items = self.store.list_pods(
+                        ns, q.get("labelSelector"), q.get("fieldSelector")
+                    )
+                    return self._send_json(
+                        200, {"kind": "PodList", "apiVersion": "v1", "items": items}
+                    )
+            return self._send_error_status(ApiException(404, f"no route {self.path}"))
+        except ApiException as e:
+            return self._send_error_status(e)
+
+    def do_PATCH(self):
+        parts, _ = self._parts()
+        try:
+            if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
+                return self._send_json(
+                    200, self.store.patch_node(parts[3], self._read_body())
+                )
+            return self._send_error_status(ApiException(404, f"no route {self.path}"))
+        except ApiException as e:
+            return self._send_error_status(e)
+
+    def do_PUT(self):
+        parts, _ = self._parts()
+        try:
+            if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
+                return self._send_json(
+                    200, self.store.replace_node(parts[3], self._read_body())
+                )
+            return self._send_error_status(ApiException(404, f"no route {self.path}"))
+        except ApiException as e:
+            return self._send_error_status(e)
+
+    def do_DELETE(self):
+        parts, _ = self._parts()
+        try:
+            if (
+                len(parts) == 6
+                and parts[:3] == ["api", "v1", "namespaces"]
+                and parts[4] == "pods"
+            ):
+                self.store.delete_pod(parts[3], parts[5])
+                return self._send_json(200, {"kind": "Status", "status": "Success"})
+            return self._send_error_status(ApiException(404, f"no route {self.path}"))
+        except ApiException as e:
+            return self._send_error_status(e)
+
+    def do_POST(self):
+        parts, _ = self._parts()
+        try:
+            if (
+                len(parts) == 7
+                and parts[:3] == ["api", "v1", "namespaces"]
+                and parts[4] == "pods"
+                and parts[6] == "eviction"
+            ):
+                self._read_body()
+                self.store.evict_pod(parts[3], parts[5])
+                return self._send_json(201, {"kind": "Status", "status": "Success"})
+            return self._send_error_status(ApiException(404, f"no route {self.path}"))
+        except ApiException as e:
+            return self._send_error_status(e)
+
+    # ------------------------------------------------------------- watch
+    def _stream_watch(self, q: dict) -> None:
+        name: Optional[str] = None
+        fs = q.get("fieldSelector", "")
+        if fs.startswith("metadata.name="):
+            name = fs.split("=", 1)[1]
+        timeout_s = int(q.get("timeoutSeconds", "300"))
+        rv = q.get("resourceVersion")
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def _chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for etype, obj in self.store.watch_nodes(
+                name=name, resource_version=rv, timeout_s=timeout_s
+            ):
+                _chunk(json.dumps({"type": etype, "object": obj}).encode() + b"\n")
+        except ApiException as e:
+            err = {
+                "type": "ERROR",
+                "object": {
+                    "kind": "Status",
+                    "code": e.status,
+                    "reason": "Expired" if e.status == 410 else "InternalError",
+                    "message": e.reason,
+                },
+            }
+            _chunk(json.dumps(err).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):  # client went away
+            return
+        _chunk(b"")  # terminating chunk
+
+
+class FakeApiServer:
+    """Owns a ThreadingHTTPServer bound to 127.0.0.1:<port> over a FakeKube."""
+
+    def __init__(self, store: Optional[FakeKube] = None, port: int = 0):
+        self.store = store or FakeKube()
+        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "FakeApiServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fake-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,349 @@
+"""KubeClient interface + stdlib HTTP implementation.
+
+Covers exactly the API surface the reference agents use (SURVEY.md §3.5):
+node read/watch/patch, pod list/delete, eviction — nothing more. The HTTP
+implementation speaks to a real API server (in-cluster service account or
+kubeconfig) or to :mod:`tpu_cc_manager.k8s.apiserver` in tests.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import json
+import os
+import socket
+import ssl
+import tempfile
+import urllib.parse
+from http.client import HTTPConnection, HTTPSConnection
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class ApiException(Exception):
+    """HTTP-level API failure (status carries the k8s semantics: 404 absent,
+    409 conflict, 410 watch-history expired, 429 PDB-blocked eviction)."""
+
+    def __init__(self, status: int, reason: str = ""):
+        super().__init__(f"k8s API error {status}: {reason}")
+        self.status = status
+        self.reason = reason
+
+
+class ConflictError(ApiException):
+    def __init__(self, reason: str = "resourceVersion conflict"):
+        super().__init__(409, reason)
+
+
+class KubeClient(abc.ABC):
+    """The minimal clientset both agents are written against."""
+
+    @abc.abstractmethod
+    def get_node(self, name: str) -> dict: ...
+
+    @abc.abstractmethod
+    def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]: ...
+
+    @abc.abstractmethod
+    def patch_node(self, name: str, patch: dict) -> dict:
+        """JSON merge patch (labels/annotations/spec)."""
+
+    @abc.abstractmethod
+    def replace_node(self, name: str, node: dict) -> dict:
+        """Optimistic-concurrency replace: raises ConflictError when
+        node['metadata']['resourceVersion'] is stale. Used for slice
+        leader election CAS."""
+
+    @abc.abstractmethod
+    def list_pods(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> List[dict]: ...
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Eviction API (respects PDBs -> ApiException(429) when blocked)."""
+
+    @abc.abstractmethod
+    def watch_nodes(
+        self,
+        name: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+    ) -> Iterator[Tuple[str, dict]]:
+        """Yield (event_type, node) until server-side timeout. Raises
+        ApiException(410) when resource_version fell out of history —
+        callers re-list and resume (reference main.py:675-687)."""
+
+    # convenience built on the primitives -------------------------------
+    def set_node_labels(self, name: str, labels: Dict[str, Optional[str]]) -> dict:
+        return self.patch_node(name, {"metadata": {"labels": labels}})
+
+    def set_node_annotations(self, name: str, ann: Dict[str, Optional[str]]) -> dict:
+        return self.patch_node(name, {"metadata": {"annotations": ann}})
+
+
+# --------------------------------------------------------------------------
+# configuration loading
+# --------------------------------------------------------------------------
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeConfig:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        use_tls: bool = True,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        client_cert: Optional[str] = None,
+        client_key: Optional[str] = None,
+        insecure_skip_verify: bool = False,
+    ):
+        self.host = host
+        self.port = port
+        self.use_tls = use_tls
+        self.token = token
+        self.ca_file = ca_file
+        self.client_cert = client_cert
+        self.client_key = client_key
+        self.insecure_skip_verify = insecure_skip_verify
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        """Service-account config, the DaemonSet path (reference
+        main.py:105-110 uses load_incluster_config)."""
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = int(os.environ.get("KUBERNETES_SERVICE_PORT", "443"))
+        token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+        ca_path = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+        with open(token_path) as f:
+            token = f.read().strip()
+        return cls(host, port, token=token,
+                   ca_file=ca_path if os.path.exists(ca_path) else None)
+
+    @classmethod
+    def from_kubeconfig(cls, path: str) -> "KubeConfig":
+        """Parse a kubeconfig file (reference main.py:111-114 falls back to
+        load_kube_config when not in-cluster)."""
+        import yaml
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(c for c in cfg["contexts"] if c["name"] == ctx_name)["context"]
+        cluster = next(
+            c for c in cfg["clusters"] if c["name"] == ctx["cluster"]
+        )["cluster"]
+        user = next(u for u in cfg["users"] if u["name"] == ctx["user"])["user"]
+
+        url = urllib.parse.urlparse(cluster["server"])
+        use_tls = url.scheme == "https"
+        port = url.port or (443 if use_tls else 80)
+
+        def _inline(data_key: str, file_key: str, blob: dict) -> Optional[str]:
+            if blob.get(file_key):
+                return blob[file_key]
+            if blob.get(data_key):
+                fd, p = tempfile.mkstemp(prefix="kubecfg-")
+                with os.fdopen(fd, "wb") as f:
+                    f.write(base64.b64decode(blob[data_key]))
+                return p
+            return None
+
+        return cls(
+            url.hostname or "localhost",
+            port,
+            use_tls=use_tls,
+            token=user.get("token"),
+            ca_file=_inline("certificate-authority-data", "certificate-authority", cluster),
+            client_cert=_inline("client-certificate-data", "client-certificate", user),
+            client_key=_inline("client-key-data", "client-key", user),
+            insecure_skip_verify=bool(cluster.get("insecure-skip-tls-verify")),
+        )
+
+    @classmethod
+    def load(cls, kubeconfig: Optional[str] = None) -> "KubeConfig":
+        """In-cluster first, kubeconfig fallback — the same resolution
+        order as the reference (main.py:105-114)."""
+        if kubeconfig:
+            return cls.from_kubeconfig(kubeconfig)
+        if "KUBERNETES_SERVICE_HOST" in os.environ:
+            return cls.in_cluster()
+        default = os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config")
+        )
+        return cls.from_kubeconfig(default)
+
+
+# --------------------------------------------------------------------------
+# HTTP implementation
+# --------------------------------------------------------------------------
+
+
+class HttpKubeClient(KubeClient):
+    def __init__(self, config: KubeConfig):
+        self.config = config
+
+    # -- plumbing -------------------------------------------------------
+    def _connect(self, read_timeout: Optional[float]) -> HTTPConnection:
+        c = self.config
+        if c.use_tls:
+            ctx = ssl.create_default_context(cafile=c.ca_file)
+            if c.insecure_skip_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if c.client_cert:
+                ctx.load_cert_chain(c.client_cert, c.client_key)
+            return HTTPSConnection(c.host, c.port, context=ctx, timeout=read_timeout)
+        return HTTPConnection(c.host, c.port, timeout=read_timeout)
+
+    def _headers(self, content_type: Optional[str] = None) -> dict:
+        h = {"Accept": "application/json"}
+        if self.config.token:
+            h["Authorization"] = f"Bearer {self.config.token}"
+        if content_type:
+            h["Content-Type"] = content_type
+        return h
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+        read_timeout: Optional[float] = 30.0,
+    ) -> dict:
+        conn = self._connect(read_timeout)
+        try:
+            try:
+                conn.request(
+                    method,
+                    path,
+                    body=json.dumps(body) if body is not None else None,
+                    headers=self._headers(content_type if body is not None else None),
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+            except OSError as e:
+                # transport failure (refused/reset/timeout): surface as an
+                # API error (status 0) so callers' retry/backoff paths —
+                # not a raw traceback — handle it
+                raise ApiException(0, f"transport error: {e}") from e
+            if resp.status >= 400:
+                if resp.status == 409:
+                    raise ConflictError(data.decode("utf-8", "replace")[:200])
+                raise ApiException(resp.status, data.decode("utf-8", "replace")[:200])
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+    # -- nodes ----------------------------------------------------------
+    def get_node(self, name: str) -> dict:
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
+        q = ""
+        if label_selector:
+            q = "?labelSelector=" + urllib.parse.quote(label_selector)
+        return self._request("GET", f"/api/v1/nodes{q}").get("items", [])
+
+    def patch_node(self, name: str, patch: dict) -> dict:
+        return self._request(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            body=patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def replace_node(self, name: str, node: dict) -> dict:
+        return self._request("PUT", f"/api/v1/nodes/{name}", body=node)
+
+    # -- pods -----------------------------------------------------------
+    def list_pods(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> List[dict]:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        q = ("?" + urllib.parse.urlencode(params)) if params else ""
+        return self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods{q}"
+        ).get("items", [])
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+            body={
+                "apiVersion": "policy/v1",
+                "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace},
+            },
+        )
+
+    # -- watch ----------------------------------------------------------
+    def watch_nodes(
+        self,
+        name: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+    ) -> Iterator[Tuple[str, dict]]:
+        params = {"watch": "true", "timeoutSeconds": str(timeout_s)}
+        if name:
+            # node-scoped watch, exactly like the Go informer's fieldSelector
+            # metadata.name=<node> (reference cmd/main.go:185-190)
+            params["fieldSelector"] = f"metadata.name={name}"
+        if resource_version is not None:
+            params["resourceVersion"] = str(resource_version)
+        path = "/api/v1/nodes?" + urllib.parse.urlencode(params)
+
+        conn = self._connect(read_timeout=timeout_s + 30)
+        try:
+            try:
+                conn.request("GET", path, headers=self._headers())
+                resp = conn.getresponse()
+            except OSError as e:
+                raise ApiException(0, f"transport error: {e}") from e
+            if resp.status >= 400:
+                raise ApiException(resp.status, resp.read().decode("utf-8", "replace")[:200])
+            # newline-delimited JSON event stream
+            buf = b""
+            while True:
+                try:
+                    chunk = resp.read1(65536)
+                except (socket.timeout, ssl.SSLError) as e:
+                    raise ApiException(0, f"watch read timeout: {e}")
+                if not chunk:
+                    return  # server closed (watch timeout elapsed)
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    evt = json.loads(line)
+                    if evt.get("type") == "ERROR":
+                        status = evt.get("object", {})
+                        raise ApiException(
+                            int(status.get("code", 500)),
+                            status.get("message", "watch error"),
+                        )
+                    yield evt["type"], evt["object"]
+        finally:
+            conn.close()

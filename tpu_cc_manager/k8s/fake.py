@@ -1,0 +1,207 @@
+"""In-memory fake clientset with a faithful watch implementation.
+
+The reference has no test fixtures at all (SURVEY.md §4); this fake is the
+foundation of the test pyramid the TPU build adds. It reproduces the API
+server behaviors the agents' robustness code exists for:
+
+- monotonically increasing resourceVersion on every mutation;
+- watch streams that replay history from a given rv, then block for new
+  events until a server-side timeout;
+- bounded watch history with 410 Gone when a watcher resumes from a
+  compacted rv (reference main.py:675-687 handles this);
+- optimistic-concurrency replace (409) for leader-election CAS;
+- PDB-blocked eviction (429);
+- injectable watch errors to exercise the consecutive-error fatal path
+  (reference main.py:664-673).
+
+Thread-safe: N agent threads + test thread may mutate concurrently (the
+multi-node simulation in tests/test_multinode.py runs 32 agents against
+one instance).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tpu_cc_manager.k8s.client import ApiException, ConflictError, KubeClient
+from tpu_cc_manager.k8s.objects import match_selector, merge_patch
+
+
+class FakeKube(KubeClient):
+    def __init__(self, watch_history_limit: int = 1000):
+        self._lock = threading.Condition()
+        self._nodes: Dict[str, dict] = {}
+        self._pods: Dict[Tuple[str, str], dict] = {}
+        self._rv = 0
+        # watch history: list of (rv, type, node_snapshot)
+        self._events: List[Tuple[int, str, dict]] = []
+        self._history_limit = watch_history_limit
+        # fault injection
+        self.pdb_blocked: set = set()  # {(ns, name)} -> evict raises 429
+        self.fail_next_watches = 0  # next N watch_nodes calls raise 500
+        self.patch_delay_s = 0.0  # simulated API latency
+
+    # ------------------------------------------------------------ helpers
+    def _bump(self, obj: dict) -> None:
+        self._rv += 1
+        obj["metadata"]["resourceVersion"] = str(self._rv)
+
+    def _record(self, etype: str, node: dict) -> None:
+        self._events.append((self._rv, etype, copy.deepcopy(node)))
+        if len(self._events) > self._history_limit:
+            self._events = self._events[-self._history_limit:]
+        self._lock.notify_all()
+
+    # ------------------------------------------------------- test surface
+    def add_node(self, node: dict) -> dict:
+        with self._lock:
+            self._bump(node)
+            self._nodes[node["metadata"]["name"]] = node
+            self._record("ADDED", node)
+            return copy.deepcopy(node)
+
+    def add_pod(self, pod: dict) -> dict:
+        with self._lock:
+            self._bump(pod)
+            self._pods[(pod["metadata"]["namespace"], pod["metadata"]["name"])] = pod
+            return copy.deepcopy(pod)
+
+    def compact_watch_history(self) -> None:
+        """Drop all retained events: any resume from an old rv now 410s."""
+        with self._lock:
+            self._events = []
+
+    @property
+    def latest_rv(self) -> str:
+        with self._lock:
+            return str(self._rv)
+
+    # ------------------------------------------------------------- nodes
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiException(404, f"node {name} not found")
+            return copy.deepcopy(node)
+
+    def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(n)
+                for n in self._nodes.values()
+                if match_selector(n["metadata"].get("labels", {}), label_selector)
+            ]
+
+    def patch_node(self, name: str, patch: dict) -> dict:
+        if self.patch_delay_s:
+            time.sleep(self.patch_delay_s)
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiException(404, f"node {name} not found")
+            merged = merge_patch(node, patch)
+            merged["metadata"]["name"] = name  # name is immutable
+            self._nodes[name] = merged
+            self._bump(merged)
+            self._record("MODIFIED", merged)
+            return copy.deepcopy(merged)
+
+    def replace_node(self, name: str, node: dict) -> dict:
+        with self._lock:
+            cur = self._nodes.get(name)
+            if cur is None:
+                raise ApiException(404, f"node {name} not found")
+            if node["metadata"].get("resourceVersion") != cur["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f"rv {node['metadata'].get('resourceVersion')} != "
+                    f"{cur['metadata']['resourceVersion']}"
+                )
+            new = copy.deepcopy(node)
+            new["metadata"]["name"] = name
+            self._nodes[name] = new
+            self._bump(new)
+            self._record("MODIFIED", new)
+            return copy.deepcopy(new)
+
+    # -------------------------------------------------------------- pods
+    def list_pods(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> List[dict]:
+        node_name = None
+        if field_selector:
+            for term in field_selector.split(","):
+                if term.startswith("spec.nodeName="):
+                    node_name = term.split("=", 1)[1]
+        with self._lock:
+            out = []
+            for (ns, _), pod in self._pods.items():
+                if ns != namespace:
+                    continue
+                if not match_selector(pod["metadata"].get("labels", {}), label_selector):
+                    continue
+                if node_name and pod["spec"].get("nodeName") != node_name:
+                    continue
+                out.append(copy.deepcopy(pod))
+            return out
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if (namespace, name) not in self._pods:
+                raise ApiException(404, f"pod {namespace}/{name} not found")
+            del self._pods[(namespace, name)]
+            self._lock.notify_all()
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if (namespace, name) in self.pdb_blocked:
+                raise ApiException(429, "Cannot evict pod: PodDisruptionBudget")
+            if (namespace, name) not in self._pods:
+                raise ApiException(404, f"pod {namespace}/{name} not found")
+            del self._pods[(namespace, name)]
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------- watch
+    def watch_nodes(
+        self,
+        name: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+    ) -> Iterator[Tuple[str, dict]]:
+        with self._lock:
+            if self.fail_next_watches > 0:
+                self.fail_next_watches -= 1
+                raise ApiException(500, "injected watch failure")
+        deadline = time.monotonic() + timeout_s
+        last_rv = int(resource_version) if resource_version is not None else None
+
+        while True:
+            with self._lock:
+                if last_rv is None:
+                    # no rv: start from "now", like an unversioned k8s watch
+                    last_rv = self._rv
+                else:
+                    oldest_retained = self._events[0][0] if self._events else self._rv + 1
+                    if last_rv + 1 < oldest_retained and last_rv < self._rv:
+                        # requested window fell out of history
+                        raise ApiException(410, "too old resource version")
+                pending = [
+                    (rv, t, obj)
+                    for (rv, t, obj) in self._events
+                    if rv > last_rv
+                    and (name is None or obj["metadata"]["name"] == name)
+                ]
+                if not pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return  # server-side watch timeout: clean stream end
+                    self._lock.wait(timeout=min(remaining, 0.5))
+                    continue
+            for rv, etype, obj in pending:
+                last_rv = max(last_rv, rv)
+                yield etype, copy.deepcopy(obj)

@@ -1,0 +1,271 @@
+"""Observability: structured logging, metrics, /healthz — what the
+reference lacks entirely (SURVEY.md §5.5: "no metrics endpoint, no
+/healthz") and BASELINE measures us on (reconcile-latency histogram).
+
+Prometheus text exposition implemented directly (no client library —
+nothing to vendor), plus a tiny stdlib HTTP server serving:
+
+- ``/healthz`` — liveness: 200 while the agent's watch loop is alive;
+- ``/readyz``  — readiness: 200 once the initial reconcile completed
+  (same condition as the readiness file, reference main.py:67-79);
+- ``/metrics`` — Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+def setup_logging(debug: bool = False) -> None:
+    """Timestamped structured-ish logs (reference main.py:54-59 format,
+    --debug escalation main.py:726-734)."""
+    logging.basicConfig(
+        level=logging.DEBUG if debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+        force=True,
+    )
+
+
+# --------------------------------------------------------------------------
+# metrics primitives
+# --------------------------------------------------------------------------
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+        self.name, self.help = name, help_
+        self.label_names = label_names
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *label_values: str, amount: float = 1.0) -> None:
+        key = tuple(label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, *label_values: str) -> float:
+        return self._values.get(tuple(label_values), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            if not self._values and not self.label_names:
+                out.append(f"{self.name} 0")
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_labels(self.label_names, key)} {_fmt(v)}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+        self.name, self.help = name, help_
+        self.label_names = label_names
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[tuple(label_values)] = value
+
+    def value(self, *label_values: str) -> Optional[float]:
+        return self._values.get(tuple(label_values))
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_labels(self.label_names, key)} {_fmt(v)}")
+        return out
+
+
+class Histogram:
+    """Fixed-bucket histogram; default buckets span label-patch latencies
+    (ms) through full drain+flip reconciles (minutes)."""
+
+    DEFAULT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._samples: List[float] = []  # retained for quantile queries
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            self._samples.append(value)
+            if len(self._samples) > 10000:
+                self._samples = self._samples[-5000:]
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+            idx = min(len(s) - 1, max(0, int(q * len(s))))
+            return s[idx]
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+            cum += self._counts[-1]
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            out.append(f"{self.name}_sum {_fmt(self._sum)}")
+            out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class Metrics:
+    """The agent's metric set (the BASELINE reconcile-latency histogram is
+    ``reconcile_duration_seconds``)."""
+
+    def __init__(self):
+        self.reconciles_total = Counter(
+            "tpu_cc_reconciles_total",
+            "Mode reconciles attempted, by outcome",
+            ("outcome",),
+        )
+        self.reconcile_duration = Histogram(
+            "tpu_cc_reconcile_duration_seconds",
+            "Wall-clock duration of one mode reconcile",
+        )
+        self.watch_errors_total = Counter(
+            "tpu_cc_watch_errors_total", "Node watch stream errors"
+        )
+        self.current_mode = Gauge(
+            "tpu_cc_mode_info", "Current observed CC mode (1 = active)", ("mode",)
+        )
+        self.coalesced_total = Counter(
+            "tpu_cc_coalesced_updates_total",
+            "Label updates absorbed by coalescing without a reconcile",
+        )
+
+    def set_current_mode(self, mode: str) -> None:
+        for m in ("on", "off", "devtools", "ici", "failed", "unknown"):
+            self.current_mode.set(1.0 if m == mode else 0.0, m)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for m in (
+            self.reconciles_total,
+            self.reconcile_duration,
+            self.watch_errors_total,
+            self.current_mode,
+            self.coalesced_total,
+        ):
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# health/metrics HTTP server
+# --------------------------------------------------------------------------
+
+
+class HealthServer:
+    def __init__(self, metrics: Metrics, port: int = 0):
+        self.metrics = metrics
+        self.live = True
+        self.ready = False
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # pragma: no cover
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._respond(200 if outer.live else 503,
+                                  b"ok" if outer.live else b"unhealthy")
+                elif self.path == "/readyz":
+                    self._respond(200 if outer.ready else 503,
+                                  b"ready" if outer.ready else b"not ready")
+                elif self.path == "/metrics":
+                    self._respond(
+                        200,
+                        outer.metrics.render().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                else:
+                    self._respond(404, b"not found")
+
+            def _respond(self, code: int, body: bytes,
+                         ctype: str = "text/plain") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "HealthServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="health-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def create_readiness_file(path: str) -> None:
+    """Touch the readiness file after the initial reconcile (reference
+    main.py:67-79); the validation framework keys off its presence."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(str(time.time()) + "\n")
+
+
+def remove_readiness_file(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

@@ -1,0 +1,225 @@
+"""L3 — node-label watching with coalescing and resume robustness.
+
+Takes the union of the reference's two watcher implementations
+(SURVEY.md §7.2 step 4):
+
+- from the Go agent: the **lossy coalescing** synchronization primitive
+  (reference cmd/main.go:48-76). `SyncableModeConfig.get()` blocks until
+  the value differs from the last one read; N rapid label flips collapse
+  into one reconcile of the latest value. Intermediate modes are
+  *intentionally* skippable — only the newest desired state matters.
+- from the Python agent: the **watch-stream robustness** (reference
+  main.py:605-689): resourceVersion resume, 300 s server-side watch
+  timeout, 5 s reconnect backoff, full re-list + compare on HTTP 410,
+  and a fatal threshold of 10 consecutive errors (beyond which the
+  DaemonSet restart policy is the recovery mechanism).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
+
+log = logging.getLogger("tpu-cc-manager.watch")
+
+#: reference main.py:633
+WATCH_TIMEOUT_S = 300
+#: reference main.py:688-689
+RECONNECT_BACKOFF_S = 5
+#: reference main.py:102,665-673
+MAX_CONSECUTIVE_ERRORS = 10
+
+
+class SyncableModeConfig:
+    """Lossy last-value-wins mailbox (reference cmd/main.go:48-76)."""
+
+    def __init__(self, on_coalesced: Optional[Callable[[], None]] = None):
+        self._cond = threading.Condition()
+        self._current: Optional[str] = None
+        self._last_read: Optional[str] = None
+        self._has_value = False
+        self._closed = False
+        self._on_coalesced = on_coalesced
+
+    def set(self, value: Optional[str]) -> None:
+        """Publish a new desired value; wakes any blocked get()
+        (reference cmd/main.go:61-66 Set + Broadcast)."""
+        with self._cond:
+            if (
+                self._has_value
+                and self._current != self._last_read
+                and value != self._current
+            ):
+                # a pending-but-unread value is being overwritten: that
+                # update is absorbed by coalescing and will never reconcile
+                if self._on_coalesced:
+                    self._on_coalesced()
+            self._current = value
+            self._has_value = True
+            self._cond.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        """Block until the current value differs from the last one read,
+        then consume it (reference cmd/main.go:68-76).
+
+        Returns ``(True, value)`` when a new value was consumed (value may
+        be None — the label was removed), or ``(False, None)`` on
+        timeout/close.
+        """
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._closed
+                or (self._has_value and self._current != self._last_read),
+                timeout=timeout,
+            )
+            if not ok or self._closed:
+                return False, None
+            self._last_read = self._current
+            return True, self._current
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class FatalWatchError(Exception):
+    """Too many consecutive watch failures (reference main.py:665-673)."""
+
+
+class NodeWatcher:
+    """Watches one node's cc.mode label and feeds a SyncableModeConfig."""
+
+    def __init__(
+        self,
+        kube: KubeClient,
+        node_name: str,
+        config: SyncableModeConfig,
+        *,
+        label_key: str = L.CC_MODE_LABEL,
+        watch_timeout_s: int = WATCH_TIMEOUT_S,
+        backoff_s: float = RECONNECT_BACKOFF_S,
+        max_consecutive_errors: int = MAX_CONSECUTIVE_ERRORS,
+        on_fatal: Optional[Callable[[Exception], None]] = None,
+        on_error: Optional[Callable[[], None]] = None,
+    ):
+        self.kube = kube
+        self.node_name = node_name
+        self.config = config
+        self.label_key = label_key
+        self.watch_timeout_s = watch_timeout_s
+        self.backoff_s = backoff_s
+        self.max_consecutive_errors = max_consecutive_errors
+        self.on_fatal = on_fatal
+        self.on_error = on_error
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: last label value pushed downstream (dedup at the watch layer,
+        #: reference main.py:651-661 only reconciles on actual change)
+        self._last_value: Optional[str] = None
+        self.resource_version: Optional[str] = None
+        self.consecutive_errors = 0
+
+    # ------------------------------------------------------------ helpers
+    def read_node_label(self) -> Optional[str]:
+        """Read the label + capture resourceVersion (reference
+        main.py:585-600)."""
+        node = self.kube.get_node(self.node_name)
+        self.resource_version = node["metadata"]["resourceVersion"]
+        return node["metadata"].get("labels", {}).get(self.label_key)
+
+    def _push(self, value: Optional[str]) -> None:
+        if value != self._last_value:
+            log.info(
+                "%s changed on %s: %r -> %r",
+                self.label_key, self.node_name, self._last_value, value,
+            )
+            self._last_value = value
+            self.config.set(value)
+
+    def prime(self) -> Optional[str]:
+        """Initial read; remembers the value so the watch only fires on
+        change. Returns the initial label value."""
+        value = self.read_node_label()
+        self._last_value = value
+        return value
+
+    # ---------------------------------------------------------- main loop
+    def run(self) -> None:
+        """Blocking watch loop; returns only on stop() or fatal error."""
+        while not self._stop.is_set():
+            try:
+                for etype, node in self.kube.watch_nodes(
+                    name=self.node_name,
+                    resource_version=self.resource_version,
+                    timeout_s=self.watch_timeout_s,
+                ):
+                    self.consecutive_errors = 0
+                    rv = node["metadata"].get("resourceVersion")
+                    if rv is not None:
+                        self.resource_version = rv  # main.py:648-649
+                    if etype in ("ADDED", "MODIFIED"):
+                        self._push(
+                            node["metadata"].get("labels", {}).get(self.label_key)
+                        )
+                    elif etype == "DELETED":
+                        log.warning("node %s deleted from the API", self.node_name)
+                    if self._stop.is_set():
+                        return
+                # clean server-side timeout: reconnect immediately with rv
+                self.consecutive_errors = 0
+            except ApiException as e:
+                self.consecutive_errors += 1
+                if self.on_error:
+                    self.on_error()
+                if self.consecutive_errors >= self.max_consecutive_errors:
+                    fatal = FatalWatchError(
+                        f"{self.consecutive_errors} consecutive watch errors; "
+                        f"last: {e}"
+                    )
+                    log.error("%s", fatal)
+                    if self.on_fatal:
+                        self.on_fatal(fatal)
+                        return
+                    raise fatal from e
+                if e.status == 410:
+                    # history expired: full re-read and resync if changed
+                    # (reference main.py:675-687)
+                    log.warning("watch history expired (410); re-listing node")
+                    try:
+                        self._push(self.read_node_label())
+                        continue  # no backoff after successful resync
+                    except ApiException as e2:
+                        log.error("re-list after 410 failed: %s", e2)
+                log.warning(
+                    "watch error (%d consecutive): %s; reconnecting in %.1fs",
+                    self.consecutive_errors, e, self.backoff_s,
+                )
+                self._stop.wait(self.backoff_s)
+            except Exception as e:  # defensive: never kill silently
+                self.consecutive_errors += 1
+                log.exception("unexpected watcher error")
+                if self.consecutive_errors >= self.max_consecutive_errors:
+                    if self.on_fatal:
+                        self.on_fatal(e)
+                        return
+                    raise
+                self._stop.wait(self.backoff_s)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "NodeWatcher":
+        self._thread = threading.Thread(
+            target=self.run, name=f"node-watch-{self.node_name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.config.close()
+        if self._thread:
+            self._thread.join(timeout=5)
