@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Benchmark: pool-wide CC-mode reconcile latency + flip throughput.
+
+Measures the BASELINE.json metric — "node reconcile p50 latency (s);
+CC-mode flips/min on a 32-node TPU pool" — against the target of
+pool-wide reconcile < 60 s on 32 nodes.
+
+Setup: one in-process HTTP API server (the real wire protocol), 32 agent
+instances each with its own HttpKubeClient over real sockets, its own
+fake 4-chip device backend, coalescing watcher, and mode engine. The
+bench PATCHes every node's desired-mode label, then times until every
+node's observed-state label reports the target. Reconcile latency for a
+node = label-patch time -> state-label-commit time, measured inside the
+store (no HTTP overhead added by the measurement itself).
+
+The reference publishes no numbers (BASELINE.md); the comparison base is
+the 60 s pool-wide target, so vs_baseline = 60 / pool_convergence_s
+(>1.0 means faster than target).
+
+Prints exactly ONE JSON line:
+    {"metric": "pool32_reconcile_p50_s", "value": ..., "unit": "s",
+     "vs_baseline": ...,
+     "extras": {"pool_convergence_s": ..., "flips_per_min": ...,
+                "nodes": N, "rounds": R}}
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.agent import CCManagerAgent
+from tpu_cc_manager.config import AgentConfig
+from tpu_cc_manager.device.fake import fake_backend
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+from tpu_cc_manager.k8s.objects import make_node
+
+
+def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
+    server = FakeApiServer().start()
+    store = server.store
+    node_names = [f"tpu-{i:03d}" for i in range(n_nodes)]
+    for name in node_names:
+        store.add_node(
+            make_node(
+                name,
+                labels={
+                    L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+                    L.CC_MODE_LABEL: "off",
+                },
+            )
+        )
+
+    agents = []
+    threads = []
+    for name in node_names:
+        kube = HttpKubeClient(KubeConfig("127.0.0.1", server.port, use_tls=False))
+        cfg = AgentConfig(
+            node_name=name,
+            default_mode="off",
+            readiness_file=f"{readiness_dir}/ready-{name}",
+            health_port=0,
+            drain_strategy="none",
+        )
+        agent = CCManagerAgent(kube, cfg, backend=fake_backend(n_chips=4))
+        agent.watcher.watch_timeout_s = 30
+        agent.watcher.backoff_s = 0.2  # fast retry on transient resets
+        agents.append(agent)
+        t = threading.Thread(target=agent.run, daemon=True)
+        t.start()
+        threads.append(t)
+
+    def state_of(name):
+        return (
+            store.get_node(name)["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL)
+        )
+
+    def wait_all(target, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        pending = set(node_names)
+        completion = {}
+        while pending and time.monotonic() < deadline:
+            done = {n for n in pending if state_of(n) == target}
+            now = time.monotonic()
+            for n in done:
+                completion[n] = now
+            pending -= done
+            if pending:
+                time.sleep(0.01)
+        return completion, pending
+
+    # wait for all initial reconciles (not part of the measurement)
+    _, pending = wait_all("off")
+    if pending:
+        print(f"FATAL: {len(pending)} agents never initialized", file=sys.stderr)
+        sys.exit(1)
+
+    latencies = []
+    round_times = []
+    total_flips = 0
+    t_bench0 = time.monotonic()
+    mode_cycle = ["on", "off", "devtools", "off"]
+    for r in range(rounds):
+        target = mode_cycle[r % len(mode_cycle)]
+        starts = {}
+        t0 = time.monotonic()
+        for name in node_names:
+            starts[name] = time.monotonic()
+            store.set_node_labels(name, {L.CC_MODE_LABEL: target})
+        completion, pending = wait_all(target)
+        t1 = time.monotonic()
+        if pending:
+            print(
+                f"FATAL: round {r}: {len(pending)} nodes never converged to "
+                f"{target}", file=sys.stderr,
+            )
+            sys.exit(1)
+        for name in node_names:
+            latencies.append(completion[name] - starts[name])
+        total_flips += len(node_names)
+        round_times.append(t1 - t0)
+    elapsed = time.monotonic() - t_bench0
+
+    for a in agents:
+        a.shutdown()
+    server.stop()
+
+    p50 = statistics.median(latencies)
+    p95 = sorted(latencies)[int(0.95 * len(latencies))]
+    pool_convergence = statistics.median(round_times)
+    flips_per_min = total_flips / elapsed * 60.0
+    return {
+        "metric": f"pool{n_nodes}_reconcile_p50_s",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(60.0 / pool_convergence, 2),
+        "extras": {
+            "pool_convergence_s": round(pool_convergence, 4),
+            "node_reconcile_p95_s": round(p95, 4),
+            "flips_per_min": round(flips_per_min, 1),
+            "nodes": n_nodes,
+            "rounds": rounds,
+            "baseline_target": "pool-wide reconcile < 60 s on 32 nodes (BASELINE.md)",
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=4)
+    args = ap.parse_args()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        result = run_bench(args.nodes, args.rounds, d)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

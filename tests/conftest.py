@@ -18,6 +18,22 @@ import pytest
 from tpu_cc_manager.device import base as device_base
 
 
+def _force_cpu_jax():
+    """This image's sitecustomize registers the axon TPU PJRT plugin and
+    overrides jax_platforms to 'axon,cpu'; jax.devices() then dials the
+    TPU tunnel (minutes). Tests are CPU-only by contract — force it back.
+    """
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+_force_cpu_jax()
+
+
 @pytest.fixture(autouse=True)
 def _reset_device_backend():
     device_base.set_backend(None)

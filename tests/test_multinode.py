@@ -1,0 +1,153 @@
+"""Cluster-scale simulation: N independent agents against ONE API server,
+coordinating only through node labels — the reference's real distributed
+model (SURVEY.md §2.3 "cluster-wide concurrency"), which it never tested.
+"""
+
+import threading
+import time
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.agent import CCManagerAgent
+from tpu_cc_manager.config import AgentConfig
+from tpu_cc_manager.device.fake import fake_backend
+from tpu_cc_manager.k8s import FakeKube
+from tpu_cc_manager.k8s.objects import make_node, make_pod
+
+
+class SimNode:
+    def __init__(self, kube, name, tmp_path, label=None, n_chips=4):
+        node_labels = {L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice"}
+        if label:
+            node_labels[L.CC_MODE_LABEL] = label
+        kube.add_node(make_node(name, labels=node_labels))
+        self.backend = fake_backend(n_chips=n_chips)
+        cfg = AgentConfig(
+            node_name=name,
+            default_mode="off",
+            readiness_file=str(tmp_path / f"ready-{name}"),
+            health_port=0,
+            drain_strategy="none",
+        )
+        self.agent = CCManagerAgent(kube, cfg, backend=self.backend)
+        self.agent.watcher.watch_timeout_s = 2
+        self.agent.watcher.backoff_s = 0.05
+        self.thread = None
+
+    def start(self):
+        self.thread = threading.Thread(target=self.agent.run, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.agent.shutdown()
+
+
+def _wait(predicate, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_pool_wide_reconcile_32_nodes(tmp_path):
+    """BASELINE config 4 shape (scaled for CI): 32 agents, one label flip
+    each, all converge; then a second concurrent flip back."""
+    kube = FakeKube()
+    nodes = [SimNode(kube, f"tpu-{i:02d}", tmp_path, label="off") for i in range(32)]
+    for n in nodes:
+        n.start()
+    try:
+        # wait for initial reconcile everywhere
+        assert _wait(
+            lambda: all(
+                kube.get_node(f"tpu-{i:02d}")["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL
+                )
+                == "off"
+                for i in range(32)
+            )
+        )
+        # flip the whole pool to on
+        for i in range(32):
+            kube.set_node_labels(f"tpu-{i:02d}", {L.CC_MODE_LABEL: "on"})
+        assert _wait(
+            lambda: all(
+                kube.get_node(f"tpu-{i:02d}")["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL
+                )
+                == "on"
+                for i in range(32)
+            )
+        )
+        assert all(
+            c.query_cc_mode() == "on" for n in nodes for c in n.backend.chips
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_divergent_per_node_policies(tmp_path):
+    kube = FakeKube()
+    modes = ["on", "off", "devtools", "ici"]
+    nodes = [
+        SimNode(kube, f"m-{i}", tmp_path, label=modes[i % 4]) for i in range(8)
+    ]
+    for n in nodes:
+        n.start()
+    try:
+        assert _wait(
+            lambda: all(
+                kube.get_node(f"m-{i}")["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL
+                )
+                == modes[i % 4]
+                for i in range(8)
+            )
+        )
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_reconcile_under_pod_churn(tmp_path):
+    """BASELINE config 4: flips land while unrelated pods churn in the
+    namespace; the agents must converge regardless."""
+    kube = FakeKube()
+    nodes = [SimNode(kube, f"c-{i}", tmp_path, label="off") for i in range(4)]
+    for n in nodes:
+        n.start()
+    stop_churn = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop_churn.is_set():
+            kube.add_pod(make_pod(f"churn-{i}", "default", node_name=f"c-{i % 4}"))
+            if i > 4:
+                try:
+                    kube.delete_pod("default", f"churn-{i - 4}")
+                except Exception:
+                    pass
+            i += 1
+            time.sleep(0.01)
+
+    churn_t = threading.Thread(target=churn, daemon=True)
+    churn_t.start()
+    try:
+        for i in range(4):
+            kube.set_node_labels(f"c-{i}", {L.CC_MODE_LABEL: "on"})
+        assert _wait(
+            lambda: all(
+                kube.get_node(f"c-{i}")["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL
+                )
+                == "on"
+                for i in range(4)
+            )
+        )
+    finally:
+        stop_churn.set()
+        churn_t.join(timeout=2)
+        for n in nodes:
+            n.stop()

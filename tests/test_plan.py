@@ -1,0 +1,113 @@
+"""Fleet-planner tests: encoding, divergence/failure detection, slice
+coherence auditing, and the sharded dry run."""
+
+import numpy as np
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.plan import (
+    MODE_CODES,
+    analyze_fleet,
+    encode_fleet,
+    encode_mode,
+)
+
+
+def _node(name, desired=None, observed=None, slice_id=None):
+    labels = {}
+    if desired:
+        labels[L.CC_MODE_LABEL] = desired
+    if observed:
+        labels[L.CC_MODE_STATE_LABEL] = observed
+    if slice_id:
+        labels[L.TPU_SLICE_LABEL] = slice_id
+    return make_node(name, labels=labels)
+
+
+def test_encode_mode():
+    assert encode_mode("on") == MODE_CODES["on"]
+    assert encode_mode(None) == MODE_CODES["unknown"]
+    assert encode_mode("garbage") == MODE_CODES["unknown"]
+    assert encode_mode("failed") == MODE_CODES["failed"]
+
+
+def test_encode_fleet_dense_slice_ids():
+    nodes = [
+        _node("a", slice_id="s1"),
+        _node("b", slice_id="s2"),
+        _node("c", slice_id="s1"),
+        _node("d"),  # solo node gets its own singleton slice
+    ]
+    desired, observed, slice_ids, names, slice_index = encode_fleet(nodes)
+    assert names == ["a", "b", "c", "d"]
+    assert slice_ids[0] == slice_ids[2] != slice_ids[1]
+    assert len(slice_index) == 3
+
+
+def test_analyze_fleet_divergence_and_failures():
+    nodes = [
+        _node("ok", desired="on", observed="on"),
+        _node("lagging", desired="on", observed="off"),
+        _node("broken", desired="on", observed="failed"),
+        _node("unlabeled"),  # no desired -> never in needs_flip
+    ]
+    report = analyze_fleet(nodes)
+    assert report["nodes"] == 4
+    assert set(report["needs_flip"]) == {"lagging", "broken"}
+    assert report["failed"] == ["broken"]
+    assert report["mode_counts"]["on"] == 1
+    assert report["mode_counts"]["failed"] == 1
+
+
+def test_analyze_fleet_slice_coherence():
+    nodes = [
+        # coherent slice: all at target
+        _node("a0", desired="on", observed="on", slice_id="sa"),
+        _node("a1", desired="on", observed="on", slice_id="sa"),
+        # half-flipped slice: uniform desired, mixed observed
+        _node("b0", desired="on", observed="on", slice_id="sb"),
+        _node("b1", desired="on", observed="off", slice_id="sb"),
+        # divergent desired (operator error): incoherent but not half-flipped
+        _node("c0", desired="on", observed="off", slice_id="sc"),
+        _node("c1", desired="off", observed="off", slice_id="sc"),
+    ]
+    report = analyze_fleet(nodes)
+    assert "sa" not in report["incoherent_slices"]
+    assert set(report["incoherent_slices"]) == {"sb", "sc"}
+    assert report["half_flipped_slices"] == ["sb"]
+
+
+def test_analyze_fleet_empty():
+    assert analyze_fleet([])["nodes"] == 0
+
+
+def test_graft_entry_single_device():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", "__graft_entry__.py"
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    import jax
+
+    fn, args = m.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert int(out["mode_counts"].sum()) == 256
+
+
+def test_graft_entry_multichip_dryrun():
+    import importlib.util
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual cpu devices")
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", "__graft_entry__.py"
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    m.dryrun_multichip(8)  # asserts sharded == unsharded internally

@@ -1,0 +1,239 @@
+"""Slice-coherence protocol tests: atomic multi-host flips, leader
+failover, timeout-refuses-to-flip, and per-slice policy divergence."""
+
+import threading
+import time
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.device.fake import FakeBackend, FakeChip
+from tpu_cc_manager.engine import ModeEngine
+from tpu_cc_manager.k8s import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.slice_coord import (
+    DONE_ANNOTATION,
+    HB_ANNOTATION,
+    SliceAbortError,
+    SliceCoordinator,
+)
+
+
+class SliceMember:
+    """One node's agent-side slice stack: chip + engine + coordinator."""
+
+    def __init__(self, kube, name, slice_id=None, **coord_kw):
+        labels = {L.TPU_SLICE_LABEL: slice_id} if slice_id else {}
+        kube.add_node(make_node(name, labels=labels))
+        self.name = name
+        self.chip = FakeChip(path=f"/dev/{name}")
+        self.states = []
+        # per-member engine bound to this member's own device backend
+        self.engine = ModeEngine(
+            set_state_label=self.states.append,
+            evict_components=False,
+            backend=FakeBackend(chips=[self.chip]),
+        )
+        self.coord = SliceCoordinator(
+            kube, name,
+            poll_s=0.05, commit_timeout_s=coord_kw.pop("commit_timeout_s", 5),
+            hb_ttl_s=coord_kw.pop("hb_ttl_s", 2),
+            **coord_kw,
+        )
+
+    def apply(self, mode):
+        return self.coord.apply_slice_coherent(mode, self.engine)
+
+
+def test_no_slice_label_falls_back_to_local_flip():
+    kube = FakeKube()
+    m = SliceMember(kube, "solo")
+    assert m.apply("on") is True
+    assert m.chip.query_cc_mode() == "on"
+
+
+def test_slice_flip_is_atomic_across_members():
+    kube = FakeKube()
+    members = [SliceMember(kube, f"n{i}", "slice-a") for i in range(4)]
+    results = {}
+
+    def run(m):
+        results[m.name] = m.apply("on")
+
+    threads = [threading.Thread(target=run, args=(m,)) for m in members]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert all(results[m.name] for m in members)
+    assert all(m.chip.query_cc_mode() == "on" for m in members)
+    # leader (lexicographically first) committed with an epoch stamp
+    leader = kube.get_node("n0")
+    commit = leader["metadata"]["annotations"][L.SLICE_COMMIT_ANNOTATION]
+    assert commit.startswith("on:")
+    # every member recorded consuming that epoch
+    for m in members:
+        done = kube.get_node(m.name)["metadata"]["annotations"][DONE_ANNOTATION]
+        assert done == commit
+
+
+def test_missing_member_blocks_the_whole_slice():
+    # 3 members alive+acking, 1 member's agent never shows up but has a
+    # fresh heartbeat (alive, not acked) -> nobody flips; all abort
+    kube = FakeKube()
+    members = [SliceMember(kube, f"n{i}", "slice-a", commit_timeout_s=1.5)
+               for i in range(3)]
+    # the 4th node exists with a fresh heartbeat but never acks
+    kube.add_node(make_node("n3", labels={L.TPU_SLICE_LABEL: "slice-a"}))
+    kube.set_node_annotations("n3", {HB_ANNOTATION: str(time.time() + 1000)})
+
+    results = {}
+
+    def run(m):
+        try:
+            results[m.name] = m.apply("on")
+        except SliceAbortError:
+            results[m.name] = "aborted"
+
+    threads = [threading.Thread(target=run, args=(m,)) for m in members]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert all(results[m.name] == "aborted" for m in members)
+    assert all(m.chip.query_cc_mode() == "off" for m in members)
+    # aborted members retracted their acks (review finding: a lingering
+    # ack must not let a later leader commit on their behalf)
+    for m in members:
+        ann = kube.get_node(m.name)["metadata"]["annotations"]
+        assert L.SLICE_ACK_ANNOTATION not in ann
+
+
+def test_dead_member_staleness_excluded_leader_failover():
+    # "n0" (the would-be leader) is dead: stale heartbeat -> excluded from
+    # liveness, n1 takes leadership and the rest of the slice proceeds
+    kube = FakeKube()
+    kube.add_node(make_node("n0", labels={L.TPU_SLICE_LABEL: "slice-a"}))
+    kube.set_node_annotations("n0", {HB_ANNOTATION: "1.0"})  # ancient
+    members = [SliceMember(kube, f"n{i}", "slice-a") for i in (1, 2)]
+    results = {}
+    threads = [
+        threading.Thread(target=lambda m=m: results.update({m.name: m.apply("on")}))
+        for m in members
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert all(results.values())
+    assert all(m.chip.query_cc_mode() == "on" for m in members)
+    commit = kube.get_node("n1")["metadata"]["annotations"].get(
+        L.SLICE_COMMIT_ANNOTATION
+    )
+    assert commit and commit.startswith("on:")  # n1 became leader
+
+
+def test_per_slice_policy_divergence():
+    # two slices in one pool hold different modes (BASELINE config 5)
+    kube = FakeKube()
+    a = [SliceMember(kube, f"a{i}", "slice-a") for i in range(2)]
+    b = [SliceMember(kube, f"b{i}", "slice-b") for i in range(2)]
+    results = {}
+    threads = [
+        threading.Thread(target=lambda m=m, mode=mode: results.update(
+            {m.name: m.apply(mode)}))
+        for ms, mode in ((a, "on"), (b, "devtools"))
+        for m in ms
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert all(results.values())
+    assert all(m.chip.query_cc_mode() == "on" for m in a)
+    assert all(m.chip.query_cc_mode() == "devtools" for m in b)
+
+
+def test_stale_commit_from_old_round_is_ignored():
+    # review finding: a commit left on a node from an old round (e.g. a
+    # returned ex-leader) must never trigger a flip in a later round
+    kube = FakeKube()
+    members = [SliceMember(kube, f"n{i}", "slice-a") for i in range(2)]
+
+    def both(mode, expect_ok=True):
+        results = {}
+
+        def run(m):
+            try:
+                results[m.name] = m.apply(mode)
+            except SliceAbortError:
+                results[m.name] = "aborted"
+
+        ts = [threading.Thread(target=run, args=(m,)) for m in members]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        return results
+
+    both("on")   # round 1: commit on:<e1> persists on n0
+    both("off")  # round 2: done epochs advance past e1
+    assert all(m.chip.query_cc_mode() == "off" for m in members)
+    # round 3: desired 'on' again. n1 alone (n0's agent "slow"): n1 must
+    # NOT flip off the stale on:<e1> commit — its done epoch e2 > e1.
+    got = {}
+
+    def run_n1():
+        try:
+            got["n1"] = members[1].apply("on")
+        except SliceAbortError:
+            got["n1"] = "aborted"
+
+    members[1].coord.commit_timeout_s = 1.0
+    t = threading.Thread(target=run_n1)
+    t.start()
+    t.join(timeout=10)
+    assert got["n1"] == "aborted"  # waited for a FRESH commit; none came
+    assert members[1].chip.query_cc_mode() == "off"
+
+
+def test_shutdown_interrupts_pending_round():
+    # review finding: agent shutdown must not block for commit_timeout_s
+    kube = FakeKube()
+    m = SliceMember(kube, "n0", "slice-a", commit_timeout_s=60)
+    # a second member that never acks keeps the round pending
+    kube.add_node(make_node("n1", labels={L.TPU_SLICE_LABEL: "slice-a"}))
+    kube.set_node_annotations("n1", {HB_ANNOTATION: str(time.time() + 1000)})
+    result = {}
+
+    def run():
+        t0 = time.monotonic()
+        try:
+            m.apply("on")
+        except SliceAbortError:
+            pass
+        result["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.5)
+    m.coord.stop()
+    t.join(timeout=5)
+    assert result["elapsed"] < 3  # returned promptly, not after 60s
+
+
+def test_heartbeat_thread_updates_annotation():
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.TPU_SLICE_LABEL: "s"}))
+    coord = SliceCoordinator(kube, "n1", hb_period_s=0.05)
+    coord.start()
+    try:
+        time.sleep(0.3)
+        ann = kube.get_node("n1")["metadata"]["annotations"]
+        assert HB_ANNOTATION in ann
+        first = float(ann[HB_ANNOTATION])
+        time.sleep(0.2)
+        second = float(
+            kube.get_node("n1")["metadata"]["annotations"][HB_ANNOTATION]
+        )
+        assert second > first
+    finally:
+        coord.stop()

@@ -27,6 +27,7 @@ from tpu_cc_manager.drain import build_drainer, set_cc_mode_state_label
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
 from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.modes import InvalidModeError
+from tpu_cc_manager.slice_coord import SliceAbortError
 from tpu_cc_manager.obs import HealthServer, Metrics, create_readiness_file
 from tpu_cc_manager.watch import FatalWatchError, NodeWatcher, SyncableModeConfig
 
@@ -50,6 +51,7 @@ class CCManagerAgent:
         *,
         metrics: Optional[Metrics] = None,
         slice_coordinator=None,
+        backend=None,
     ):
         self.kube = kube
         self.cfg = cfg
@@ -70,6 +72,7 @@ class CCManagerAgent:
             set_state_label=self._set_state_label,
             drainer=build_drainer(kube, cfg),
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
+            backend=backend,
         )
         self.health: Optional[HealthServer] = None
         self._fatal: Optional[Exception] = None
@@ -133,6 +136,16 @@ class CCManagerAgent:
                 log.exception("failed to publish failed state")
             outcome = "invalid"
             return False
+        except SliceAbortError as e:
+            # the slice never agreed; local devices untouched — publish the
+            # failure and keep serving (the next label event retries)
+            log.error("slice coordination aborted: %s", e)
+            try:
+                self._set_state_label("failed")
+            except Exception:
+                log.exception("failed to publish failed state")
+            outcome = "slice_abort"
+            return False
         except FatalModeError:
             outcome = "fatal"
             raise
@@ -155,6 +168,8 @@ class CCManagerAgent:
         """Run the agent. Returns a process exit code. ``max_reconciles``
         bounds loop iterations for tests/bench (None = forever)."""
         cfg = self.cfg
+        if self.slice_coordinator is not None:
+            self.slice_coordinator.start()
         if cfg.health_port:  # 0 disables (SURVEY.md §5.6 table)
             try:
                 self.health = HealthServer(self.metrics, port=cfg.health_port).start()
@@ -210,6 +225,8 @@ class CCManagerAgent:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.slice_coordinator is not None:
+            self.slice_coordinator.stop()
         self.watcher.stop()
         if self.health:
             self.health.live = False

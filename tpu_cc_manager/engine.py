@@ -79,11 +79,15 @@ class ModeEngine:
         drainer: Optional[Drainer] = None,
         evict_components: bool = True,
         boot_timeout_s: float = 300.0,
+        backend=None,
     ):
         self._set_state_label = set_state_label
         self._drainer = drainer or NullDrainer()
         self._evict_components = evict_components
         self._boot_timeout_s = boot_timeout_s
+        #: device backend override; None = the process-wide backend. The
+        #: multi-node simulation injects one backend per simulated host.
+        self._backend = backend
 
     # ------------------------------------------------------------- queries
     def get_modes(self) -> dict:
@@ -135,10 +139,11 @@ class ModeEngine:
 
     # ------------------------------------------------------------- planning
     def _all_devices(self) -> List[TpuChip]:
-        chips, err = devlayer.find_tpus()
+        backend = self._backend or devlayer.get_backend()
+        chips, err = backend.find_tpus()
         if err:
             raise DeviceError(f"device enumeration failed: {err}")
-        switches = [c for c in devlayer.find_ici_switches()
+        switches = [c for c in backend.find_ici_switches()
                     if c.path not in {x.path for x in chips}]
         return list(chips) + switches
 

@@ -201,7 +201,12 @@ class FakeApiServer:
     def __init__(self, store: Optional[FakeKube] = None, port: int = 0):
         self.store = store or FakeKube()
         handler = type("BoundHandler", (_Handler,), {"store": self.store})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        # a 32-node pool opening watch streams at once overflows the
+        # default listen(5) backlog -> connection resets
+        server_cls = type(
+            "ApiHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 256}
+        )
+        self.httpd = server_cls(("127.0.0.1", port), handler)
         self.httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

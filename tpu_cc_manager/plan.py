@@ -1,0 +1,245 @@
+"""Fleet planner — vectorized pool-wide reconcile analysis on TPU via JAX.
+
+The reference is control-plane-only and has no compute (SURVEY.md §0), so
+the per-node agent needs none either. This module serves the *operator
+side*: a fleet controller that ingests the labels of an entire TPU fleet
+(thousands of nodes across many slices) and computes, in one fused XLA
+program instead of a Python loop over nodes:
+
+- which nodes diverge from their desired mode (work queue),
+- per-slice coherence analysis: for every slice, whether all members
+  agree on desired and observed mode (half-flipped slice detection — the
+  invariant tpu_cc_manager.slice_coord protects per-flip, audited here
+  fleet-wide),
+- fleet aggregates (node counts per mode, divergence counts, failure
+  counts) for dashboards.
+
+Encoding: modes are small ints (MODE_CODES); nodes are rows of three
+int32 arrays ``desired``, ``observed``, ``slice_ids``. All ops are
+fixed-shape, branch-free gather/scatter/segment reductions — XLA-friendly
+on CPU and TPU, and shardable over a device mesh with ``psum`` combines
+for fleets larger than one device's comfort (see __graft_entry__.py's
+``dryrun_multichip`` for the sharded path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_cc_manager import labels as L
+
+#: Mode → code. UNKNOWN covers absent/invalid label values; FAILED is the
+#: observed-state failure marker.
+MODE_CODES: Dict[str, int] = {
+    "unknown": 0,
+    "off": 1,
+    "on": 2,
+    "devtools": 3,
+    "ici": 4,
+    "failed": 5,
+}
+CODE_MODES = {v: k for k, v in MODE_CODES.items()}
+N_MODES = len(MODE_CODES)
+
+
+def encode_mode(value: Optional[str]) -> int:
+    return MODE_CODES.get(value or "unknown", MODE_CODES["unknown"])
+
+
+def encode_fleet(nodes: List[dict]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[str], Dict[str, int]]:
+    """Turn a list of k8s node objects into planner arrays.
+
+    Returns (desired, observed, slice_ids, node_names, slice_index) where
+    slice_ids[i] is a dense index into slice_index (nodes without a slice
+    label each get their own singleton id).
+    """
+    names, desired, observed, slice_ids = [], [], [], []
+    slice_index: Dict[str, int] = {}
+    for node in nodes:
+        meta = node["metadata"]
+        labels = meta.get("labels", {})
+        names.append(meta["name"])
+        desired.append(encode_mode(labels.get(L.CC_MODE_LABEL)))
+        observed.append(encode_mode(labels.get(L.CC_MODE_STATE_LABEL)))
+        raw_slice = labels.get(L.TPU_SLICE_LABEL)
+        key = raw_slice if raw_slice else f"__solo__/{meta['name']}"
+        slice_ids.append(slice_index.setdefault(key, len(slice_index)))
+    return (
+        np.asarray(desired, dtype=np.int32),
+        np.asarray(observed, dtype=np.int32),
+        np.asarray(slice_ids, dtype=np.int32),
+        names,
+        slice_index,
+    )
+
+
+def fleet_plan(
+    desired: jnp.ndarray,
+    observed: jnp.ndarray,
+    slice_ids: jnp.ndarray,
+    num_slices: int,
+) -> Dict[str, jnp.ndarray]:
+    """The jittable core. All shapes static given (n_nodes, num_slices).
+
+    Returns a dict of arrays:
+      needs_flip      [n]  bool   — desired != observed (and desired known)
+      failed          [n]  bool   — observed == failed
+      mode_counts     [m]  int32  — observed-mode histogram
+      desired_counts  [m]  int32  — desired-mode histogram
+      slice_coherent  [s]  bool   — every member of slice s agrees on
+                                    desired AND observed mode
+      slice_half_flipped [s] bool — slice has BOTH members at desired and
+                                    members not at desired (mid-flip /
+                                    stuck — the dangerous state)
+    """
+    known = desired != MODE_CODES["unknown"]
+    needs_flip = (desired != observed) & known
+    failed = observed == MODE_CODES["failed"]
+
+    mode_counts = jnp.zeros((N_MODES,), jnp.int32).at[observed].add(1)
+    desired_counts = jnp.zeros((N_MODES,), jnp.int32).at[desired].add(1)
+
+    # per-slice agreement via segment min/max: a slice agrees on a value
+    # iff min == max over its members
+    def seg_minmax(x):
+        mn = jnp.full((num_slices,), jnp.iinfo(jnp.int32).max, jnp.int32)
+        mx = jnp.full((num_slices,), jnp.iinfo(jnp.int32).min, jnp.int32)
+        mn = mn.at[slice_ids].min(x)
+        mx = mx.at[slice_ids].max(x)
+        return mn, mx
+
+    d_mn, d_mx = seg_minmax(desired)
+    o_mn, o_mx = seg_minmax(observed)
+    slice_coherent = (d_mn == d_mx) & (o_mn == o_mx)
+
+    # half-flipped: some members observed==desired, others not, within one
+    # slice (only meaningful where desired is uniform)
+    at_target = (observed == desired) & known
+    at_mn = jnp.ones((num_slices,), jnp.int32).at[slice_ids].min(
+        at_target.astype(jnp.int32)
+    )
+    at_mx = jnp.zeros((num_slices,), jnp.int32).at[slice_ids].max(
+        at_target.astype(jnp.int32)
+    )
+    slice_half_flipped = (d_mn == d_mx) & (at_mn == 0) & (at_mx == 1)
+
+    return {
+        "needs_flip": needs_flip,
+        "failed": failed,
+        "mode_counts": mode_counts,
+        "desired_counts": desired_counts,
+        "slice_coherent": slice_coherent,
+        "slice_half_flipped": slice_half_flipped,
+    }
+
+
+#: jitted entry with static slice count (recompiles per distinct fleet
+#: geometry, cached thereafter)
+fleet_plan_jit = jax.jit(fleet_plan, static_argnames=("num_slices",))
+
+
+_backend_pinned = False
+
+
+def _ensure_backend() -> None:
+    """Pin the planner to CPU unless the operator opts into an accelerator
+    via TPU_CC_PLANNER_PLATFORM. The fleet controller must run anywhere —
+    on hosts with a registered-but-unreachable TPU plugin, jax.devices()
+    either raises or (worse) blocks for minutes dialing the device, so
+    'try the default platform first' is not a safe probe. Fleet-analysis
+    arrays are tiny; CPU is always adequate, and TPU users (e.g. the
+    driver's entry() compile check) call fleet_plan / fleet_plan_jit
+    directly without this pin."""
+    global _backend_pinned
+    if _backend_pinned:
+        return
+    platform = os.environ.get("TPU_CC_PLANNER_PLATFORM", "cpu")
+    try:
+        jax.config.update("jax_platforms", platform)
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    _backend_pinned = True
+
+
+def analyze_fleet(nodes: List[dict]) -> dict:
+    """End-to-end host API: node objects in, JSON-ready report out."""
+    _ensure_backend()
+    desired, observed, slice_ids, names, slice_index = encode_fleet(nodes)
+    if len(names) == 0:
+        return {
+            "nodes": 0,
+            "needs_flip": [],
+            "failed": [],
+            "mode_counts": {},
+            "incoherent_slices": [],
+            "half_flipped_slices": [],
+        }
+    out = fleet_plan_jit(
+        jnp.asarray(desired),
+        jnp.asarray(observed),
+        jnp.asarray(slice_ids),
+        num_slices=len(slice_index),
+    )
+    out = jax.device_get(out)
+    slice_names = {v: k for k, v in slice_index.items()}
+    real_slice = {
+        v: not k.startswith("__solo__/") for k, v in slice_index.items()
+    }
+    return {
+        "nodes": len(names),
+        "needs_flip": [n for n, f in zip(names, out["needs_flip"]) if f],
+        "failed": [n for n, f in zip(names, out["failed"]) if f],
+        "mode_counts": {
+            CODE_MODES[i]: int(c)
+            for i, c in enumerate(out["mode_counts"])
+            if c
+        },
+        "incoherent_slices": [
+            slice_names[i]
+            for i in range(len(slice_index))
+            if real_slice[i] and not out["slice_coherent"][i]
+        ],
+        "half_flipped_slices": [
+            slice_names[i]
+            for i in range(len(slice_index))
+            if real_slice[i] and out["slice_half_flipped"][i]
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m tpu_cc_manager.plan`` — fleet report from a live
+    API server (or --from-file for an offline node dump)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="tpu-cc-fleet-plan")
+    ap.add_argument("--kubeconfig", default=None)
+    ap.add_argument("--from-file", default=None,
+                    help="JSON file with a NodeList (offline analysis)")
+    ap.add_argument("--selector", default=L.TPU_ACCELERATOR_LABEL,
+                    help="label selector for TPU nodes")
+    args = ap.parse_args(argv)
+    if args.from_file:
+        with open(args.from_file) as f:
+            nodes = json.load(f).get("items", [])
+    else:
+        from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+
+        kube = HttpKubeClient(KubeConfig.load(args.kubeconfig))
+        nodes = kube.list_nodes(args.selector)
+    print(json.dumps(analyze_fleet(nodes), indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

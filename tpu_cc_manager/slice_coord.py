@@ -1,0 +1,290 @@
+"""Slice-coherent mode flips — the capability the reference never needed.
+
+A multi-host TPU slice (e.g. v5p-128 = 16 hosts) is one ICI/attestation
+domain: flipping CC mode on some member nodes but not others would leave
+the slice half-protected, which is worse than either uniform state. The
+reference's agents are fully node-independent (SURVEY.md §2.3); this
+module adds the per-slice choreography SURVEY.md §7.2 step 7 calls for,
+using only the coordination fabric the architecture already has — the
+Kubernetes API server — so no new communication backend is introduced.
+
+Protocol (all state in node labels/annotations, so it survives agent
+restarts and is kubectl-observable):
+
+- **Membership**: nodes of one slice share ``tpu.google.com/cc.slice``
+  (set by the provisioner / GKE node-pool labels).
+- **Liveness**: each agent heartbeats ``cc.slice.hb=<unix-ts>`` on its own
+  node. A member is *alive* if its heartbeat is fresher than HB_TTL_S.
+- **Leadership**: the alive member with the lexicographically smallest
+  node name is the leader. Deterministic — every member computes the same
+  answer from the same node list; no election messages. If the leader
+  dies its heartbeat stales out and the next member takes over.
+- **Epochs**: rounds are ordered by the cluster's resourceVersion, which
+  is globally monotone (etcd revision). The leader stamps each commit
+  with the highest member rv it observed; members remember the epoch of
+  the last commit they consumed (``cc.slice.done=<mode>:<epoch>``, on
+  their own node, durable across restarts). A commit is actionable only
+  if its epoch is *strictly greater* than the member's done epoch —
+  stale commits left over from old rounds (e.g. on a node that lost and
+  later regained leadership) can never trigger a flip.
+- **Two-phase flip**:
+
+  1. every member publishes ``cc.slice.ack=<mode>`` on its own node
+     ("I see the new desired mode and am ready to flip");
+  2. the leader, once ALL alive members ack the same mode and not all of
+     them have already completed it, publishes
+     ``cc.slice.commit=<mode>:<epoch>`` on its own node;
+  3. members flip locally only after observing a commit whose mode
+     equals the mode they acked and whose epoch is newer than their done
+     epoch; then they record ``cc.slice.done``.
+
+  A member that aborts (timeout, shutdown, API errors) **retracts its
+  ack** so the leader stops counting it. The retraction is best-effort:
+  if the leader read the ack in the same instant, the rest of the slice
+  may proceed while the aborted member reports ``cc.mode.state=failed``
+  — a visibly mixed slice (the fleet planner's ``half_flipped_slices``
+  audit catches exactly this), never a silently mixed one. Full
+  atomicity under arbitrary timing is the two-generals problem; the
+  protocol guarantees no member *flips* without a quorum commit, and
+  every divergence is published.
+
+Divergent per-slice policies (BASELINE config 5) fall out naturally:
+coordination is scoped to one slice id, so two slices of one pool can
+hold different modes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
+
+log = logging.getLogger("tpu-cc-manager.slice")
+
+#: Heartbeat refresh period and liveness TTL.
+HB_PERIOD_S = 10.0
+HB_TTL_S = 45.0
+#: How long a member waits for the slice to agree before giving up.
+COMMIT_TIMEOUT_S = 600.0
+POLL_S = 1.0
+
+HB_ANNOTATION = "tpu.google.com/cc.slice.hb"
+DONE_ANNOTATION = "tpu.google.com/cc.slice.done"
+
+
+class SliceAbortError(Exception):
+    """The slice round did not reach a commit; the local flip was NOT
+    attempted. The agent publishes the failed state and keeps serving."""
+
+
+def _parse_stamp(raw: Optional[str]) -> Tuple[Optional[str], int]:
+    """'mode:epoch' -> (mode, epoch); absent/garbage -> (None, -1)."""
+    if not raw or ":" not in raw:
+        return None, -1
+    mode, _, epoch = raw.rpartition(":")
+    try:
+        return mode, int(epoch)
+    except ValueError:
+        return None, -1
+
+
+class SliceCoordinator:
+    def __init__(
+        self,
+        kube: KubeClient,
+        node_name: str,
+        *,
+        hb_period_s: float = HB_PERIOD_S,
+        hb_ttl_s: float = HB_TTL_S,
+        commit_timeout_s: float = COMMIT_TIMEOUT_S,
+        poll_s: float = POLL_S,
+        clock=time.time,
+    ):
+        self.kube = kube
+        self.node_name = node_name
+        self.hb_period_s = hb_period_s
+        self.hb_ttl_s = hb_ttl_s
+        self.commit_timeout_s = commit_timeout_s
+        self.poll_s = poll_s
+        self.clock = clock
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- membership
+    def slice_id(self) -> Optional[str]:
+        node = self.kube.get_node(self.node_name)
+        return node["metadata"].get("labels", {}).get(L.TPU_SLICE_LABEL)
+
+    def members(self, slice_id: str) -> List[dict]:
+        return sorted(
+            self.kube.list_nodes(f"{L.TPU_SLICE_LABEL}={slice_id}"),
+            key=lambda n: n["metadata"]["name"],
+        )
+
+    def _alive(self, nodes: List[dict]) -> List[dict]:
+        now = self.clock()
+        alive = []
+        for n in nodes:
+            raw = n["metadata"].get("annotations", {}).get(HB_ANNOTATION)
+            try:
+                fresh = raw is not None and now - float(raw) <= self.hb_ttl_s
+            except ValueError:
+                fresh = False
+            # our own row is alive by definition (we're executing)
+            if fresh or n["metadata"]["name"] == self.node_name:
+                alive.append(n)
+        return alive
+
+    # ----------------------------------------------------------- heartbeat
+    def heartbeat_once(self) -> None:
+        self.kube.set_node_annotations(
+            self.node_name, {HB_ANNOTATION: str(self.clock())}
+        )
+
+    def start(self) -> "SliceCoordinator":
+        """Run the background heartbeat (agent lifetime)."""
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.heartbeat_once()
+                except ApiException as e:
+                    log.warning("slice heartbeat failed: %s", e)
+                self._stop.wait(self.hb_period_s)
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="slice-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=5)
+
+    # ------------------------------------------------------------ protocol
+    def _annotate_self(self, key: str, value: Optional[str]) -> None:
+        self.kube.set_node_annotations(self.node_name, {key: value})
+
+    @staticmethod
+    def _ann(node: dict, key: str) -> Optional[str]:
+        return node["metadata"].get("annotations", {}).get(key)
+
+    def _retract_ack(self) -> None:
+        try:
+            self._annotate_self(L.SLICE_ACK_ANNOTATION, None)
+        except ApiException as e:
+            log.warning("could not retract slice ack: %s", e)
+
+    def apply_slice_coherent(self, raw_mode: str, engine) -> bool:
+        """Run the 2-phase protocol around ``engine.set_mode``.
+
+        Falls back to a plain local flip when the node is not part of a
+        multi-host slice. Raises SliceAbortError when the round never
+        reached a commit (the local device state was not touched).
+        """
+        slice_id = self.slice_id()
+        if not slice_id:
+            return engine.set_mode(raw_mode)
+        members = self.members(slice_id)
+        if len(members) <= 1:
+            return engine.set_mode(raw_mode)
+
+        log.info(
+            "slice %s: coordinating flip to %r across %d members",
+            slice_id, raw_mode, len(members),
+        )
+        me = next(
+            n for n in members if n["metadata"]["name"] == self.node_name
+        )
+        _, my_done_epoch = _parse_stamp(self._ann(me, DONE_ANNOTATION))
+
+        try:
+            self.heartbeat_once()
+            self._annotate_self(L.SLICE_ACK_ANNOTATION, raw_mode)
+        except ApiException as e:
+            raise SliceAbortError(f"could not publish slice ack: {e}") from e
+
+        deadline = time.monotonic() + self.commit_timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                self.heartbeat_once()
+                members = self.members(slice_id)
+            except ApiException as e:
+                log.warning("slice %s: membership read failed: %s", slice_id, e)
+                self._stop.wait(self.poll_s)
+                continue
+            alive = self._alive(members)
+            if not alive:
+                break
+            leader = alive[0]["metadata"]["name"]
+
+            if leader == self.node_name:
+                self._maybe_commit(raw_mode, alive)
+
+            leader_node = next(
+                (n for n in members if n["metadata"]["name"] == leader), None
+            )
+            if leader_node is not None:
+                c_mode, c_epoch = _parse_stamp(
+                    self._ann(leader_node, L.SLICE_COMMIT_ANNOTATION)
+                )
+                if c_mode == raw_mode and c_epoch > my_done_epoch:
+                    log.info(
+                        "slice %s: commit epoch %d observed; flipping locally",
+                        slice_id, c_epoch,
+                    )
+                    ok = engine.set_mode(raw_mode)
+                    try:
+                        self._annotate_self(
+                            DONE_ANNOTATION, f"{raw_mode}:{c_epoch}"
+                        )
+                    except ApiException as e:
+                        log.warning("could not record slice done: %s", e)
+                    return ok
+
+            self._stop.wait(self.poll_s)
+
+        self._retract_ack()
+        raise SliceAbortError(
+            f"slice {slice_id}: no commit for mode {raw_mode!r} within "
+            f"{self.commit_timeout_s:.0f}s"
+            + (" (shutting down)" if self._stop.is_set() else "")
+            + "; refusing to flip — the slice must move atomically"
+        )
+
+    def _maybe_commit(self, raw_mode: str, alive: List[dict]) -> None:
+        """Leader side: publish a fresh commit when every alive member has
+        acked this mode and not all of them have already completed it."""
+        acks = [self._ann(n, L.SLICE_ACK_ANNOTATION) for n in alive]
+        if not all(a == raw_mode for a in acks):
+            return
+        stamps = [_parse_stamp(self._ann(n, DONE_ANNOTATION)) for n in alive]
+        laggard_epochs = [e for (m, e) in stamps if m != raw_mode]
+        if not laggard_epochs:
+            return  # round already completed everywhere; nothing to commit
+        # skip if the published commit is already actionable for every
+        # laggard (avoids re-commit churn while members catch up)
+        me = next(
+            n for n in alive if n["metadata"]["name"] == self.node_name
+        )
+        c_mode, c_epoch = _parse_stamp(
+            self._ann(me, L.SLICE_COMMIT_ANNOTATION)
+        )
+        if c_mode == raw_mode and c_epoch > max(laggard_epochs):
+            return
+        # epoch: the highest member rv observed — globally monotone, and
+        # necessarily newer than every done epoch from earlier rounds
+        epoch = max(int(n["metadata"]["resourceVersion"]) for n in alive)
+        log.info(
+            "slice leader %s committing %r at epoch %d (%d acks)",
+            self.node_name, raw_mode, epoch, len(acks),
+        )
+        self._annotate_self(
+            L.SLICE_COMMIT_ANNOTATION, f"{raw_mode}:{epoch}"
+        )
