@@ -1,0 +1,36 @@
+# Top-level build/test entry points (mirrors the reference's Makefile:20-33
+# build/test/check targets, retargeted: Go build -> C++ native build,
+# vacuous `go test ./...` -> a real pytest pyramid).
+
+include versions.mk
+
+PYTHON ?= python3
+
+.PHONY: all build native test test-fast bench lint clean image
+
+all: build
+
+build: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PYTHON) -m pytest tests/ -x -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -x -q -k "not native and not bash"
+
+bench:
+	$(PYTHON) bench.py
+
+lint:
+	$(PYTHON) -m compileall -q tpu_cc_manager bench.py __graft_entry__.py
+	bash -n scripts/tpu-cc-manager.sh
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+
+image:
+	$(MAKE) -f deployments/container/Makefile build-debian

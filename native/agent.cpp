@@ -1,0 +1,479 @@
+/* tpu-cc-manager-agent — native per-node watcher agent (C++17).
+ *
+ * The TPU-native counterpart of the reference's compiled Go agent
+ * (reference cmd/main.go, the repo's only first-party native component,
+ * SURVEY.md §2.2): CLI/env config, a node-label watch with *lossy
+ * coalescing* (reference cmd/main.go:48-76 — N rapid label changes
+ * collapse into one reconcile of the latest value), and exec of the mode
+ * engine per change (reference cmd/main.go:172-182 execs cc-manager.sh;
+ * here the engine command is configurable and defaults to the Python
+ * one-shot CLI).
+ *
+ * Transport: HTTP/1.1 over a POSIX socket to KUBE_API_HOST:KUBE_API_PORT.
+ * In-cluster this is fronted by a `kubectl proxy` localhost sidecar
+ * (which owns TLS + service-account auth); in tests it talks directly to
+ * tpu_cc_manager.k8s.apiserver. A BEARER_TOKEN_FILE env is honored for
+ * direct plain-HTTP API endpoints.
+ *
+ * Watch-stream JSON handling: events for a node-scoped watch are parsed
+ * with a targeted key scanner (type / resourceVersion / the cc.mode
+ * label). Kubernetes label values are constrained to [A-Za-z0-9._-]
+ * (no escapes possible), which is what makes the scanner exact for the
+ * fields it reads.
+ *
+ * Robustness (union of both reference agents, SURVEY.md §7.2 step 4):
+ * 5s reconnect backoff (reference main.py:688), 410 -> full re-read
+ * (reference main.py:675-687), fatal after 10 consecutive errors
+ * (reference main.py:665-673), engine failure -> log and continue
+ * (reference cmd/main.go:164-167).
+ */
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <stdarg.h>
+#include <time.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+const char *kModeLabel = "tpu.google.com/cc.mode";
+
+std::string g_node_name;
+std::string g_default_mode;
+std::string g_api_host = "127.0.0.1";
+int g_api_port = 8001;
+std::string g_engine_cmd =
+    "python3 -m tpu_cc_manager set-cc-mode -m %s";
+std::string g_bearer_token;
+std::atomic<bool> g_stop{false};
+
+void logf(const char *level, const char *fmt, ...) {
+  char msg[1024];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(msg, sizeof(msg), fmt, ap);
+  va_end(ap);
+  time_t now = time(nullptr);
+  char ts[64];
+  strftime(ts, sizeof(ts), "%F %T", localtime(&now));
+  fprintf(stderr, "%s tpu-cc-manager-agent %s %s\n", ts, level, msg);
+}
+
+/* ---------------------------------------------------------------------
+ * Lossy coalescing mailbox — direct port of the Go agent's
+ * SyncableCCModeConfig semantics (reference cmd/main.go:48-76): Set()
+ * overwrites and broadcasts; Get() blocks until current != lastRead.
+ * ------------------------------------------------------------------- */
+class SyncableModeConfig {
+ public:
+  void Set(const std::string &value) {
+    std::lock_guard<std::mutex> lk(mu_);
+    current_ = value;
+    has_value_ = true;
+    cv_.notify_all();
+  }
+  /* blocks; returns false on shutdown. Polls g_stop every 500ms because
+   * the signal handler cannot notify the condition variable. */
+  bool Get(std::string *out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!cv_.wait_for(lk, std::chrono::milliseconds(500), [&] {
+      return g_stop.load() || (has_value_ && current_ != last_read_);
+    })) {
+    }
+    if (g_stop.load()) return false;
+    last_read_ = current_;
+    *out = current_;
+    return true;
+  }
+  void Wake() { cv_.notify_all(); }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::string current_, last_read_ = "\x01unset";
+  bool has_value_ = false;
+};
+
+/* --------------------------------------------------------------- HTTP */
+
+int dial(const std::string &host, int port) {
+  struct addrinfo hints = {}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char port_s[16];
+  snprintf(port_s, sizeof(port_s), "%d", port);
+  if (getaddrinfo(host.c_str(), port_s, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (struct addrinfo *p = res; p; p = p->ai_next) {
+    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool send_all(int fd, const std::string &data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t w = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    off += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+std::string request_head(const std::string &method, const std::string &path) {
+  std::string req = method + " " + path + " HTTP/1.1\r\nHost: " + g_api_host +
+                    "\r\nAccept: application/json\r\n";
+  if (!g_bearer_token.empty())
+    req += "Authorization: Bearer " + g_bearer_token + "\r\n";
+  return req;
+}
+
+/* Simple (non-streaming) GET: returns status, fills body (dechunked). */
+int http_get(const std::string &path, std::string *body) {
+  int fd = dial(g_api_host, g_api_port);
+  if (fd < 0) return -1;
+  std::string req = request_head("GET", path) + "Connection: close\r\n\r\n";
+  if (!send_all(fd, req)) {
+    close(fd);
+    return -1;
+  }
+  std::string raw;
+  char buf[8192];
+  ssize_t r;
+  while ((r = recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, r);
+  close(fd);
+  size_t hdr_end = raw.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) return -1;
+  int status = -1;
+  sscanf(raw.c_str(), "HTTP/1.%*d %d", &status);
+  std::string headers = raw.substr(0, hdr_end);
+  std::string payload = raw.substr(hdr_end + 4);
+  if (headers.find("Transfer-Encoding: chunked") != std::string::npos) {
+    /* dechunk */
+    std::string out;
+    size_t pos = 0;
+    while (pos < payload.size()) {
+      size_t eol = payload.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      long len = strtol(payload.substr(pos, eol - pos).c_str(), nullptr, 16);
+      if (len <= 0) break;
+      out += payload.substr(eol + 2, len);
+      pos = eol + 2 + len + 2;
+    }
+    *body = out;
+  } else {
+    *body = payload;
+  }
+  return status;
+}
+
+/* ------------------------------------------------- targeted JSON scan */
+
+/* Extract the string value of `"key"` (tolerating whitespace around the
+ * colon, as emitted by json.dumps and most serializers). */
+bool scan_string_field(const std::string &json, const std::string &key,
+                       std::string *out, size_t from = 0) {
+  std::string needle = "\"" + key + "\"";
+  size_t pos = json.find(needle, from);
+  if (pos == std::string::npos) return false;
+  pos += needle.size();
+  while (pos < json.size() && (json[pos] == ' ' || json[pos] == '\t')) pos++;
+  if (pos >= json.size() || json[pos] != ':') return false;
+  pos++;
+  while (pos < json.size() && (json[pos] == ' ' || json[pos] == '\t')) pos++;
+  if (pos >= json.size() || json[pos] != '"') return false;
+  pos++;
+  size_t end = json.find('"', pos);
+  if (end == std::string::npos) return false;
+  *out = json.substr(pos, end - pos);
+  return true;
+}
+
+/* The cc.mode label may be absent; distinguish absent from empty. */
+bool scan_mode_label(const std::string &json, std::string *out) {
+  return scan_string_field(json, kModeLabel, out);
+}
+
+/* ------------------------------------------------------------- engine */
+
+int run_engine(const std::string &mode) {
+  char cmd[1024];
+  snprintf(cmd, sizeof(cmd), g_engine_cmd.c_str(), mode.c_str());
+  logf("INFO", "reconciling: exec: %s", cmd);
+  int rc = system(cmd);
+  if (rc == -1) return -1;
+  if (WIFEXITED(rc)) return WEXITSTATUS(rc);
+  return -1;
+}
+
+/* ------------------------------------------------------------- watcher */
+
+struct NodeState {
+  std::string resource_version;
+  std::string mode;      /* label value ("" == absent) */
+  bool ok = false;
+};
+
+NodeState read_node() {
+  NodeState st;
+  std::string body;
+  int status = http_get("/api/v1/nodes/" + g_node_name, &body);
+  if (status != 200) {
+    logf("WARN", "node read failed: http %d", status);
+    return st;
+  }
+  scan_string_field(body, "resourceVersion", &st.resource_version);
+  scan_mode_label(body, &st.mode);
+  st.ok = true;
+  return st;
+}
+
+void watch_loop(SyncableModeConfig *config) {
+  int consecutive_errors = 0;
+  std::string rv;
+  {
+    NodeState st = read_node();
+    if (st.ok) rv = st.resource_version;
+  }
+  std::string last_pushed = "\x01unset";
+  while (!g_stop.load()) {
+    std::string path = "/api/v1/nodes?watch=true&fieldSelector=metadata.name%3D" +
+                       g_node_name + "&timeoutSeconds=300";
+    if (!rv.empty()) path += "&resourceVersion=" + rv;
+    int fd = dial(g_api_host, g_api_port);
+    if (fd < 0) {
+      if (++consecutive_errors >= 10) {
+        logf("ERROR", "10 consecutive watch errors; exiting");
+        exit(1);
+      }
+      logf("WARN", "watch connect failed (%d); retrying in 5s",
+           consecutive_errors);
+      sleep(5);
+      continue;
+    }
+    std::string req = request_head("GET", path) + "\r\n";
+    if (!send_all(fd, req)) {
+      close(fd);
+      continue;
+    }
+    /* bounded recv so the loop notices g_stop within ~1s */
+    struct timeval tv = {1, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    /* stream: read headers, then dechunk NDJSON incrementally */
+    std::string buf;
+    bool headers_done = false;
+    bool error_seen = false;
+    char rbuf[8192];
+    for (;;) {
+      if (g_stop.load()) break;
+      ssize_t r = recv(fd, rbuf, sizeof(rbuf), 0);
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+        continue; /* recv timeout tick: quiet stream, re-check g_stop */
+      if (r <= 0) break; /* server closed (watch timeout) or error */
+      buf.append(rbuf, r);
+      if (!headers_done) {
+        size_t hdr_end = buf.find("\r\n\r\n");
+        if (hdr_end == std::string::npos) continue;
+        int status = -1;
+        sscanf(buf.c_str(), "HTTP/1.%*d %d", &status);
+        if (status != 200) {
+          logf("WARN", "watch http %d", status);
+          error_seen = true;
+          break;
+        }
+        buf.erase(0, hdr_end + 4);
+        headers_done = true;
+      }
+      /* dechunk complete chunks; process complete JSON lines */
+      std::string lines;
+      for (;;) {
+        size_t eol = buf.find("\r\n");
+        if (eol == std::string::npos) break;
+        long len = strtol(buf.substr(0, eol).c_str(), nullptr, 16);
+        if (len < 0) len = 0;
+        if (buf.size() < eol + 2 + static_cast<size_t>(len) + 2) break;
+        lines += buf.substr(eol + 2, len);
+        buf.erase(0, eol + 2 + len + 2);
+        if (len == 0) break;
+      }
+      size_t start = 0, nl;
+      while ((nl = lines.find('\n', start)) != std::string::npos) {
+        std::string event = lines.substr(start, nl - start);
+        start = nl + 1;
+        if (event.empty()) continue;
+        std::string type;
+        scan_string_field(event, "type", &type);
+        if (type == "ERROR") {
+          std::string msg;
+          scan_string_field(event, "message", &msg);
+          if (event.find("\"code\":410") != std::string::npos ||
+              event.find("\"code\": 410") != std::string::npos) {
+            logf("WARN", "watch 410 (%s); re-listing", msg.c_str());
+            NodeState st = read_node();
+            if (st.ok) {
+              rv = st.resource_version;
+              if (st.mode != last_pushed) {
+                last_pushed = st.mode;
+                config->Set(st.mode);
+              }
+            }
+          } else {
+            logf("WARN", "watch error event: %s", msg.c_str());
+            error_seen = true;
+          }
+          continue;
+        }
+        consecutive_errors = 0;
+        std::string evrv;
+        if (scan_string_field(event, "resourceVersion", &evrv)) rv = evrv;
+        if (type == "ADDED" || type == "MODIFIED") {
+          std::string mode; /* absent label -> "" */
+          scan_mode_label(event, &mode);
+          if (mode != last_pushed) {
+            logf("INFO", "%s changed: '%s' -> '%s'", kModeLabel,
+                 last_pushed.c_str(), mode.c_str());
+            last_pushed = mode;
+            config->Set(mode);
+          }
+        }
+      }
+      /* keep any partial line for the next recv */
+      lines.erase(0, start);
+      if (!lines.empty()) buf = lines + buf;
+    }
+    close(fd);
+    if (error_seen) {
+      if (++consecutive_errors >= 10) {
+        logf("ERROR", "10 consecutive watch errors; exiting");
+        exit(1);
+      }
+      sleep(5);
+    }
+    /* clean timeout: reconnect immediately with the saved rv */
+  }
+}
+
+void on_signal(int) { g_stop.store(true); }
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  const char *env;
+  if ((env = getenv("NODE_NAME"))) g_node_name = env;
+  if ((env = getenv("DEFAULT_CC_MODE"))) g_default_mode = env;
+  if ((env = getenv("KUBE_API_HOST"))) g_api_host = env;
+  if ((env = getenv("KUBE_API_PORT"))) g_api_port = atoi(env);
+  if ((env = getenv("TPU_CC_ENGINE_CMD"))) g_engine_cmd = env;
+  if ((env = getenv("BEARER_TOKEN_FILE"))) {
+    FILE *f = fopen(env, "r");
+    if (f) {
+      char tok[4096] = {0};
+      size_t n = fread(tok, 1, sizeof(tok) - 1, f);
+      fclose(f);
+      g_bearer_token.assign(tok, n);
+      while (!g_bearer_token.empty() &&
+             (g_bearer_token.back() == '\n' || g_bearer_token.back() == ' '))
+        g_bearer_token.pop_back();
+    }
+  }
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](const char *flag) -> const char * {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s requires a value\n", flag);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--node-name") g_node_name = next("--node-name");
+    else if (a == "-m" || a == "--default-cc-mode")
+      g_default_mode = next("-m");
+    else if (a == "--api-host") g_api_host = next("--api-host");
+    else if (a == "--api-port") g_api_port = atoi(next("--api-port"));
+    else if (a == "--engine-cmd") g_engine_cmd = next("--engine-cmd");
+    else if (a == "--help" || a == "-h") {
+      printf(
+          "usage: tpu-cc-manager-agent [--node-name N] [-m MODE] "
+          "[--api-host H] [--api-port P] [--engine-cmd CMD]\n"
+          "env: NODE_NAME DEFAULT_CC_MODE KUBE_API_HOST KUBE_API_PORT "
+          "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE\n");
+      return 0;
+    } else {
+      fprintf(stderr, "unknown flag %s\n", a.c_str());
+      return 2;
+    }
+  }
+  /* required-env validation, parity with the Go agent
+   * (reference cmd/main.go:109-115) */
+  if (g_node_name.empty()) {
+    fprintf(stderr, "NODE_NAME env or --node-name flag is required\n");
+    return 1;
+  }
+  if (g_engine_cmd.find("%s") == std::string::npos) {
+    fprintf(stderr, "TPU_CC_ENGINE_CMD must contain %%s for the mode\n");
+    return 1;
+  }
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+
+  /* initial read + default apply (reference cmd/main.go:131-149);
+   * transient API unavailability at startup gets the watch loop's
+   * backoff treatment (10 attempts x 5s, like main.py:664-689) */
+  NodeState st;
+  for (int attempt = 1;; ++attempt) {
+    st = read_node();
+    if (st.ok) break;
+    if (attempt >= 10 || g_stop.load()) {
+      logf("ERROR", "cannot read node %s from API server after %d attempts",
+           g_node_name.c_str(), attempt);
+      return 1;
+    }
+    logf("WARN", "startup node read failed (%d); retrying in 5s", attempt);
+    sleep(5);
+  }
+  if (st.mode.empty() && !g_default_mode.empty()) {
+    if (run_engine(g_default_mode) != 0) {
+      logf("ERROR", "initial default-mode apply failed; exiting");
+      return 1; /* reference cmd/main.go:141-145 */
+    }
+  } else if (!st.mode.empty()) {
+    if (run_engine(st.mode) != 0)
+      logf("ERROR", "initial reconcile failed; continuing");
+  }
+
+  SyncableModeConfig config;
+  std::thread watcher(watch_loop, &config);
+
+  /* hot loop (reference cmd/main.go:155-170) */
+  while (!g_stop.load()) {
+    std::string value;
+    if (!config.Get(&value)) break;
+    std::string mode = value.empty() ? g_default_mode : value;
+    if (mode.empty()) continue;
+    int rc = run_engine(mode);
+    if (rc != 0)
+      logf("ERROR", "engine failed (rc=%d); waiting for next change", rc);
+  }
+  config.Wake();
+  watcher.join();
+  return 0;
+}

@@ -1,0 +1,229 @@
+/* libtpudev implementation. See tpudev.h for the contract and
+ * tpu_cc_manager/device/statefile.py for the shared on-disk layout. */
+
+#include "tpudev.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kGoogleVendorId = 0x1ae0;
+
+std::string read_file_trim(const std::string &path) {
+  FILE *f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  char buf[256] = {0};
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  std::string s(buf, n);
+  while (!s.empty() && (s.back() == '\n' || s.back() == ' ' || s.back() == '\r'))
+    s.pop_back();
+  return s;
+}
+
+long parse_hex(const std::string &s, long fallback) {
+  if (s.empty()) return fallback;
+  errno = 0;
+  char *end = nullptr;
+  long v = strtol(s.c_str(), &end, 16);
+  if (errno != 0 || end == s.c_str()) return fallback;
+  return v;
+}
+
+const char *gen_name(long device_id) {
+  switch (device_id) {
+    case 0x005e: return "tpu-v4";
+    case 0x0062: return "tpu-v5e";
+    case 0x0063: return "tpu-v5p";
+    case 0x006f: return "tpu-v6e";
+    default: return "tpu";
+  }
+}
+
+std::string device_key(const std::string &dev_path) {
+  std::string k = dev_path;
+  std::replace(k.begin(), k.end(), '/', '_');
+  return k;
+}
+
+int ensure_dir(const std::string &path) {
+  /* mkdir -p for a two-level-deep state path */
+  std::string cur;
+  for (size_t i = 0; i < path.size(); ++i) {
+    if (path[i] == '/' && !cur.empty()) {
+      if (mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return -1;
+    }
+    cur.push_back(path[i]);
+  }
+  if (!cur.empty() && mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST)
+    return -1;
+  return 0;
+}
+
+/* RAII flock on <dir>/.lock, matching statefile.py's fcntl.flock. */
+class DevLock {
+ public:
+  explicit DevLock(const std::string &dir) : fd_(-1) {
+    std::string lock_path = dir + "/.lock";
+    fd_ = open(lock_path.c_str(), O_CREAT | O_RDWR, 0644);
+    if (fd_ >= 0) flock(fd_, LOCK_EX);
+  }
+  ~DevLock() {
+    if (fd_ >= 0) {
+      flock(fd_, LOCK_UN);
+      close(fd_);
+    }
+  }
+  bool ok() const { return fd_ >= 0; }
+
+ private:
+  int fd_;
+};
+
+int write_atomic(const std::string &dir, const std::string &name,
+                 const std::string &value) {
+  std::string tmp = dir + "/." + name + ".XXXXXX";
+  std::vector<char> tmpl(tmp.begin(), tmp.end());
+  tmpl.push_back('\0');
+  int fd = mkstemp(tmpl.data());
+  if (fd < 0) return -1;
+  std::string data = value + "\n";
+  ssize_t w = write(fd, data.data(), data.size());
+  fsync(fd);
+  close(fd);
+  if (w != static_cast<ssize_t>(data.size()) ||
+      rename(tmpl.data(), (dir + "/" + name).c_str()) != 0) {
+    unlink(tmpl.data());
+    return -1;
+  }
+  return 0;
+}
+
+std::string read_mode(const std::string &dir, const std::string &name) {
+  std::string v = read_file_trim(dir + "/" + name);
+  return v.empty() ? "off" : v;
+}
+
+int state_dir_for(const char *state_dir, const char *dev_path,
+                  std::string *out) {
+  *out = std::string(state_dir) + "/" + device_key(dev_path);
+  return ensure_dir(*out);
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpudev_enumerate(const char *sysfs_root, const char *dev_root,
+                     const char *allowlist, tpudev_info *out, int max) {
+  std::set<long> allow;
+  bool allow_all = (allowlist == nullptr || *allowlist == '\0');
+  if (!allow_all) {
+    std::string s(allowlist);
+    size_t pos = 0;
+    while (pos != std::string::npos) {
+      size_t comma = s.find(',', pos);
+      std::string tok = s.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      long v = parse_hex(tok, -1);
+      if (v >= 0) allow.insert(v);
+      pos = comma == std::string::npos ? std::string::npos : comma + 1;
+    }
+  }
+
+  DIR *d = opendir(sysfs_root);
+  if (!d) return 0; /* no accel tree: zero devices, not an error */
+  std::vector<std::string> entries;
+  while (struct dirent *e = readdir(d)) {
+    if (e->d_name[0] == '.') continue;
+    entries.emplace_back(e->d_name);
+  }
+  closedir(d);
+  std::sort(entries.begin(), entries.end());
+
+  int n = 0;
+  for (const auto &entry : entries) {
+    if (n >= max) break;
+    std::string sysfs_dir = std::string(sysfs_root) + "/" + entry;
+    std::string devdir = sysfs_dir + "/device";
+    std::string vendor_s = read_file_trim(devdir + "/vendor");
+    long vendor = parse_hex(vendor_s, -1);
+    if (vendor >= 0 && vendor != kGoogleVendorId) continue;
+    long device_id = parse_hex(read_file_trim(devdir + "/device"), -1);
+    bool is_switch = read_file_trim(devdir + "/kind") == "ici-switch";
+    tpudev_info *info = &out[n++];
+    snprintf(info->dev_path, sizeof(info->dev_path), "%s/%s", dev_root,
+             entry.c_str());
+    snprintf(info->sysfs_dir, sizeof(info->sysfs_dir), "%s",
+             sysfs_dir.c_str());
+    snprintf(info->name, sizeof(info->name), "%s",
+             is_switch ? "ici-switch" : gen_name(device_id));
+    info->device_id = static_cast<int>(device_id);
+    info->is_switch = is_switch ? 1 : 0;
+    info->cc_capable =
+        (!is_switch && (allow_all || allow.count(device_id) > 0)) ? 1 : 0;
+  }
+  return n;
+}
+
+int tpudev_stage(const char *state_dir, const char *dev_path,
+                 const char *domain, const char *mode) {
+  std::string dir;
+  if (state_dir_for(state_dir, dev_path, &dir) != 0) return -1;
+  DevLock lock(dir);
+  if (!lock.ok()) return -1;
+  return write_atomic(dir, std::string(domain) + ".staged", mode);
+}
+
+int tpudev_commit(const char *state_dir, const char *dev_path) {
+  std::string dir;
+  if (state_dir_for(state_dir, dev_path, &dir) != 0) return -1;
+  DevLock lock(dir);
+  if (!lock.ok()) return -1;
+  for (const char *domain : {"cc", "ici"}) {
+    std::string staged = read_mode(dir, std::string(domain) + ".staged");
+    if (write_atomic(dir, std::string(domain) + ".effective", staged) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+int tpudev_discard(const char *state_dir, const char *dev_path) {
+  std::string dir;
+  if (state_dir_for(state_dir, dev_path, &dir) != 0) return -1;
+  DevLock lock(dir);
+  if (!lock.ok()) return -1;
+  for (const char *domain : {"cc", "ici"}) {
+    std::string effective = read_mode(dir, std::string(domain) + ".effective");
+    if (write_atomic(dir, std::string(domain) + ".staged", effective) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+int tpudev_read(const char *state_dir, const char *dev_path,
+                const char *domain, int staged, char *buf, size_t buflen) {
+  std::string dir;
+  if (state_dir_for(state_dir, dev_path, &dir) != 0) return -1;
+  DevLock lock(dir);
+  if (!lock.ok()) return -1;
+  std::string v =
+      read_mode(dir, std::string(domain) + (staged ? ".staged" : ".effective"));
+  snprintf(buf, buflen, "%s", v.c_str());
+  return 0;
+}
+
+}  /* extern "C" */

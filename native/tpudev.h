@@ -1,0 +1,56 @@
+/* libtpudev — native TPU device enumeration + attestation-mode state.
+ *
+ * C ABI so it is loadable from the Python agent (ctypes), the C++ agent,
+ * and the tpudevctl CLI used by the bash engine. This is the native
+ * portion of the L0 device layer: where the reference's device access
+ * went through the external gpu-admin-tools Python package
+ * (reference main.py:38-41) plus raw sysfs pokes in bash
+ * (reference scripts/cc-manager.sh:40-76), the TPU build keeps one
+ * native implementation with three consumers.
+ *
+ * The on-disk mode-state layout is shared byte-for-byte with
+ * tpu_cc_manager/device/statefile.py:
+ *
+ *     <state_dir>/<device-key>/{cc,ici}.{staged,effective}
+ *     <state_dir>/<device-key>/.lock      (flock'd during any access)
+ *
+ * where <device-key> is the device path with '/' -> '_'.
+ */
+#ifndef TPUDEV_H
+#define TPUDEV_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+  char dev_path[256];  /* /dev/accelN                        */
+  char sysfs_dir[256]; /* /sys/class/accel/accelN            */
+  char name[32];       /* tpu-v5p / ici-switch / tpu         */
+  int device_id;       /* PCI device id, -1 if unreadable    */
+  int is_switch;       /* 1 for ICI switch parts             */
+  int cc_capable;      /* passes the CC_CAPABLE_DEVICE_IDS allowlist */
+} tpudev_info;
+
+/* Scan sysfs_root for Google (vendor 0x1ae0) accel devices. allowlist is
+ * the comma-separated hex device-id list ("" or NULL = all capable).
+ * Returns the number of devices written to out (<= max), or -1 on error. */
+int tpudev_enumerate(const char *sysfs_root, const char *dev_root,
+                     const char *allowlist, tpudev_info *out, int max);
+
+/* Mode state store. domain is "cc" or "ici"; mode is a short token.
+ * All return 0 on success, -1 on error. Reads default to "off". */
+int tpudev_stage(const char *state_dir, const char *dev_path,
+                 const char *domain, const char *mode);
+int tpudev_commit(const char *state_dir, const char *dev_path);
+int tpudev_discard(const char *state_dir, const char *dev_path);
+int tpudev_read(const char *state_dir, const char *dev_path,
+                const char *domain, int staged, char *buf, size_t buflen);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUDEV_H */

@@ -1,0 +1,74 @@
+/* tpudevctl — CLI over libtpudev for the bash engine (and humans).
+ *
+ * The bash mode engine shells out to this binary the way the reference's
+ * shell engine shelled out to nvidia_gpu_tools.py
+ * (reference scripts/cc-manager.sh:152,389,437). Subcommands:
+ *
+ *   tpudevctl list                          # one line per device:
+ *                                           #   <dev_path> <name> <id> <switch> <capable>
+ *   tpudevctl query   <dev> <cc|ici>        # print effective mode
+ *   tpudevctl staged  <dev> <cc|ici>        # print staged mode
+ *   tpudevctl stage   <dev> <cc|ici> <mode> # stage a mode
+ *   tpudevctl commit  <dev>                 # apply staged (reset-time)
+ *   tpudevctl discard <dev>                 # staged := effective
+ *
+ * Env: TPU_SYSFS_ROOT, TPU_DEV_ROOT, TPU_CC_STATE_DIR,
+ *      CC_CAPABLE_DEVICE_IDS — same contract as the Python device layer.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tpudev.h"
+
+static const char *envor(const char *name, const char *fallback) {
+  const char *v = getenv(name);
+  return (v && *v) ? v : fallback;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: tpudevctl list | query <dev> <dom> | staged <dev> <dom> | "
+            "stage <dev> <dom> <mode> | commit <dev> | discard <dev>\n");
+    return 2;
+  }
+  const char *sysfs = envor("TPU_SYSFS_ROOT", "/sys/class/accel");
+  const char *devroot = envor("TPU_DEV_ROOT", "/dev");
+  const char *state = envor("TPU_CC_STATE_DIR", "/var/lib/tpu-cc-manager");
+  const char *allow = envor("CC_CAPABLE_DEVICE_IDS", "");
+
+  const char *cmd = argv[1];
+  if (strcmp(cmd, "list") == 0) {
+    tpudev_info devs[64];
+    int n = tpudev_enumerate(sysfs, devroot, allow, devs, 64);
+    if (n < 0) {
+      fprintf(stderr, "enumeration failed\n");
+      return 1;
+    }
+    for (int i = 0; i < n; ++i)
+      printf("%s %s 0x%04x %d %d\n", devs[i].dev_path, devs[i].name,
+             devs[i].device_id < 0 ? 0 : devs[i].device_id, devs[i].is_switch,
+             devs[i].cc_capable);
+    return 0;
+  }
+  if ((strcmp(cmd, "query") == 0 || strcmp(cmd, "staged") == 0) && argc == 4) {
+    char buf[64];
+    if (tpudev_read(state, argv[2], argv[3], strcmp(cmd, "staged") == 0, buf,
+                    sizeof(buf)) != 0) {
+      fprintf(stderr, "read failed\n");
+      return 1;
+    }
+    printf("%s\n", buf);
+    return 0;
+  }
+  if (strcmp(cmd, "stage") == 0 && argc == 5)
+    return tpudev_stage(state, argv[2], argv[3], argv[4]) == 0 ? 0 : 1;
+  if (strcmp(cmd, "commit") == 0 && argc == 3)
+    return tpudev_commit(state, argv[2]) == 0 ? 0 : 1;
+  if (strcmp(cmd, "discard") == 0 && argc == 3)
+    return tpudev_discard(state, argv[2]) == 0 ? 0 : 1;
+  fprintf(stderr, "bad arguments\n");
+  return 2;
+}

@@ -1,0 +1,308 @@
+#!/bin/bash
+# tpu-cc-manager.sh — shell mode engine (TPU-native rebuild of the
+# reference's scripts/cc-manager.sh). The native agent can exec this as
+# its engine command (the reference Go agent execs cc-manager.sh,
+# cmd/main.go:172-182); it is also a standalone operator CLI.
+#
+#   tpu-cc-manager.sh set-cc-mode [-a | -d <dev>] -m <on|off|devtools|ici>
+#   tpu-cc-manager.sh get-cc-mode [-a | -d <dev>]
+#   tpu-cc-manager.sh help
+#
+# Device access goes through the native `tpudevctl` binary (the way the
+# reference shells to nvidia_gpu_tools.py, scripts/cc-manager.sh:152),
+# honoring TPU_SYSFS_ROOT / TPU_DEV_ROOT / TPU_CC_STATE_DIR /
+# CC_CAPABLE_DEVICE_IDS. Kubernetes access goes through curl against
+# KUBE_API_HOST:KUBE_API_PORT (kubectl-proxy pattern — the reference used
+# kubectl directly, scripts/cc-manager.sh:219).
+#
+# Env (required like the reference, scripts/cc-manager.sh:5-6):
+#   NODE_NAME            — this node
+# Optional:
+#   KUBE_API_HOST/PORT   — default 127.0.0.1:8001
+#   OPERATOR_NAMESPACE   — default tpu-system
+#   EVICT_OPERATOR_COMPONENTS — default true
+#   TPUDEVCTL            — path to tpudevctl (default: alongside script or PATH)
+#   CC_READINESS_FILE    — touched after successful set (reference :536)
+set -eo pipefail
+[ -n "$TPU_CC_DEBUG" ] && set -x   # reference runs with set -x (:3)
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+TPUDEVCTL="${TPUDEVCTL:-}"
+if [ -z "$TPUDEVCTL" ]; then
+  if [ -x "$SCRIPT_DIR/../native/build/tpudevctl" ]; then
+    TPUDEVCTL="$SCRIPT_DIR/../native/build/tpudevctl"
+  else
+    TPUDEVCTL="tpudevctl"
+  fi
+fi
+KUBE_API_HOST="${KUBE_API_HOST:-127.0.0.1}"
+KUBE_API_PORT="${KUBE_API_PORT:-8001}"
+API="http://${KUBE_API_HOST}:${KUBE_API_PORT}"
+OPERATOR_NAMESPACE="${OPERATOR_NAMESPACE:-tpu-system}"
+EVICT_OPERATOR_COMPONENTS="${EVICT_OPERATOR_COMPONENTS:-true}"
+
+MODE_LABEL_STATE="tpu.google.com/cc.mode.state"
+PAUSED_STR="paused-for-cc-flip"
+COMPONENT_LABELS=(
+  "tpu.google.com/pool.deploy.device-plugin"
+  "tpu.google.com/pool.deploy.metrics-exporter"
+  "tpu.google.com/pool.deploy.dra-driver"
+  "tpu.google.com/pool.deploy.workload-validator"
+  "tpu.google.com/pool.deploy.node-problem-detector"
+)
+
+log() { echo "$(date '+%F %T') tpu-cc-manager.sh $*" >&2; }
+
+_require_node_name() {
+  if [ -z "$NODE_NAME" ]; then
+    log "ERROR: NODE_NAME env is required"
+    exit 1
+  fi
+}
+
+# ------------------------------------------------------------- k8s (curl)
+_patch_node_labels() {
+  # $1 = JSON object of labels, e.g. {"k":"v","k2":null}
+  curl -sf -X PATCH \
+    -H "Content-Type: application/merge-patch+json" \
+    -d "{\"metadata\":{\"labels\":$1}}" \
+    "$API/api/v1/nodes/$NODE_NAME" > /dev/null
+}
+
+_fetch_node_json() {
+  curl -sf "$API/api/v1/nodes/$NODE_NAME"
+}
+
+_label_from_json() {
+  # $1 = node JSON, $2 = label key. k8s label values are [A-Za-z0-9._-],
+  # so a regex extraction is exact (no escapes possible). Absent label
+  # prints nothing and still returns 0 (set -e safe).
+  { printf '%s' "$1" \
+    | grep -o "\"$2\"[[:space:]]*:[[:space:]]*\"[^\"]*\"" \
+    | head -1 | sed 's/.*:[[:space:]]*"\(.*\)"/\1/'; } || true
+}
+
+_set_state_label() {
+  _patch_node_labels "{\"$MODE_LABEL_STATE\":\"$1\"}" \
+    || log "WARN: could not set state label"
+}
+
+# -------------------------------------------------- eviction (pause labels)
+# reference scripts/cc-manager.sh:173-334
+_evict_components() {
+  [ "$EVICT_OPERATOR_COMPONENTS" = "true" ] || return 0
+  local node_json patch="{" first=1 key val
+  node_json="$(_fetch_node_json)"
+  for key in "${COMPONENT_LABELS[@]}"; do
+    val="$(_label_from_json "$node_json" "$key")"
+    if [ -n "$val" ] && [ "$val" != "false" ] && [[ "$val" != ${PAUSED_STR}* ]]; then
+      [ $first -eq 0 ] && patch+=","
+      patch+="\"$key\":\"${PAUSED_STR}_${val}\""
+      first=0
+    fi
+  done
+  patch+="}"
+  if [ "$patch" != "{}" ]; then
+    log "pausing components: $patch"
+    _patch_node_labels "$patch"
+    _wait_components_gone
+  fi
+}
+
+_wait_components_gone() {
+  # poll until no component pods remain on this node (timeout 300s like
+  # kubectl wait --timeout=5m, reference :275; warn-and-continue)
+  local deadline=$((SECONDS + ${EVICTION_TIMEOUT_S:-300}))
+  local apps="tpu-device-plugin tpu-metrics-exporter tpu-dra-driver tpu-workload-validator tpu-node-problem-detector"
+  while [ $SECONDS -lt $deadline ]; do
+    local remaining=0 app
+    for app in $apps; do
+      local n
+      n=$( { curl -sf "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME" \
+          | grep -c '"kind":[[:space:]]*"Pod"'; } || true )
+      remaining=$((remaining + ${n:-0}))
+    done
+    [ "$remaining" -eq 0 ] && return 0
+    sleep "${EVICTION_POLL_S:-2}"
+  done
+  log "WARN: timed out waiting for component pods to leave; continuing"
+}
+
+_reschedule_components() {
+  [ "$EVICT_OPERATOR_COMPONENTS" = "true" ] || return 0
+  local node_json patch="{" first=1 key val
+  node_json="$(_fetch_node_json)"
+  for key in "${COMPONENT_LABELS[@]}"; do
+    val="$(_label_from_json "$node_json" "$key")"
+    if [[ "$val" == ${PAUSED_STR}_* ]]; then
+      [ $first -eq 0 ] && patch+=","
+      patch+="\"$key\":\"${val#${PAUSED_STR}_}\""
+      first=0
+    fi
+  done
+  patch+="}"
+  if [ "$patch" != "{}" ]; then
+    log "restoring components: $patch"
+    _patch_node_labels "$patch"
+  fi
+}
+
+# always restore on failure (reference _exit_failed, :210-215)
+_exit_failed() {
+  _set_state_label "failed"
+  _reschedule_components
+  exit 1
+}
+
+# ----------------------------------------------------------------- devices
+_all_devices() {
+  # prints "<dev_path> <is_switch> <capable>" per device
+  "$TPUDEVCTL" list | awk '{print $1, $4, $5}'
+}
+
+_unbind_device_from_driver() {
+  # sysfs driver unbind before the flip (reference :40-50); best-effort —
+  # TPU VMs typically have no unbind attribute
+  local dev_name sysfs_dev
+  dev_name="$(basename "$1")"
+  sysfs_dev="${TPU_SYSFS_ROOT:-/sys/class/accel}/$dev_name/device"
+  if [ -w "$sysfs_dev/driver/unbind" ] 2>/dev/null; then
+    echo "$dev_name" > "$sysfs_dev/driver/unbind" || true
+  fi
+}
+
+_set_device_mode() {
+  # $1 dev, $2 mode: discard stale intent, stage the right domains, commit
+  # (=reset), verify (reference set_gpu_cc_mode, :384-405)
+  local dev="$1" mode="$2" cc_target ici_target
+  case "$mode" in
+    ici) cc_target="off"; ici_target="on" ;;
+    on|devtools) cc_target="$mode"; ici_target="off" ;;
+    off) cc_target="off"; ici_target="off" ;;
+  esac
+  "$TPUDEVCTL" discard "$dev" || return 1
+  "$TPUDEVCTL" stage "$dev" cc "$cc_target" || return 1
+  "$TPUDEVCTL" stage "$dev" ici "$ici_target" || return 1
+  _unbind_device_from_driver "$dev"
+  "$TPUDEVCTL" commit "$dev" || return 1
+  local got_cc got_ici
+  got_cc="$("$TPUDEVCTL" query "$dev" cc)"
+  got_ici="$("$TPUDEVCTL" query "$dev" ici)"
+  if [ "$got_cc" != "$cc_target" ] || [ "$got_ici" != "$ici_target" ]; then
+    log "ERROR: $dev verify mismatch: cc=$got_cc (want $cc_target) ici=$got_ici (want $ici_target)"
+    return 1
+  fi
+  return 0
+}
+
+_device_at_mode() {
+  local dev="$1" mode="$2" cc ici
+  cc="$("$TPUDEVCTL" query "$dev" cc)"
+  ici="$("$TPUDEVCTL" query "$dev" ici)"
+  case "$mode" in
+    ici)  [ "$cc" = "off" ] && [ "$ici" = "on" ] ;;
+    off)  [ "$cc" = "off" ] && [ "$ici" = "off" ] ;;
+    *)    [ "$cc" = "$mode" ] && [ "$ici" = "off" ] ;;
+  esac
+}
+
+# ---------------------------------------------------------------- commands
+_parse_mode() {
+  # reference _parse_mode (:125-134): reject unknown values loudly
+  case "$1" in
+    on|off|devtools|ici) return 0 ;;
+    *) log "ERROR: invalid mode '$1' (must be on|off|devtools|ici)"; exit 1 ;;
+  esac
+}
+
+set_cc_mode() {
+  local mode="$1" target_dev="$2"
+  _require_node_name
+  local devices=()
+  while read -r dev is_switch capable; do
+    [ -n "$target_dev" ] && [ "$dev" != "$target_dev" ] && continue
+    # mixed-capability bailout (reference main.py:214-217 semantics)
+    if [ "$capable" = "0" ] && [ "$is_switch" = "0" ] && [ "$mode" != "off" ]; then
+      log "ERROR: $dev is not CC-capable; refusing mode '$mode' on a mixed node"
+      exit 1
+    fi
+    devices+=("$dev")
+  done < <(_all_devices)
+
+  if [ ${#devices[@]} -eq 0 ]; then
+    log "no TPU devices found; nothing to do"   # reference :338-340
+    return 0
+  fi
+
+  # idempotent fast path (reference :342-346)
+  local all_set=1 dev
+  for dev in "${devices[@]}"; do
+    _device_at_mode "$dev" "$mode" || { all_set=0; break; }
+  done
+  if [ $all_set -eq 1 ]; then
+    log "all ${#devices[@]} device(s) already in mode '$mode'"
+    _set_state_label "$mode"
+    return 0
+  fi
+
+  _evict_components
+  for dev in "${devices[@]}"; do
+    if ! _set_device_mode "$dev" "$mode"; then
+      log "ERROR: failed to set mode on $dev"
+      _exit_failed
+    fi
+  done
+  _set_state_label "$mode"
+  _reschedule_components
+  if [ -n "$CC_READINESS_FILE" ]; then
+    mkdir -p "$(dirname "$CC_READINESS_FILE")" && touch "$CC_READINESS_FILE"
+  fi
+  log "mode '$mode' applied to ${#devices[@]} device(s)"
+}
+
+get_cc_mode() {
+  local target_dev="$1"
+  while read -r dev is_switch capable; do
+    [ -n "$target_dev" ] && [ "$dev" != "$target_dev" ] && continue
+    local cc="-" ici="-"
+    if [ "$is_switch" = "0" ]; then cc="$("$TPUDEVCTL" query "$dev" cc)"; fi
+    ici="$("$TPUDEVCTL" query "$dev" ici)"
+    echo "$dev cc=$cc ici=$ici"
+  done < <(_all_devices)
+}
+
+usage() {
+  sed -n '2,12p' "$0" | sed 's/^# \{0,1\}//'
+}
+
+# ------------------------------------------------------- arg parsing
+# (reference scripts/cc-manager.sh:472-533)
+cmd="$1"; shift || true
+MODE="" DEV="" ALL=0
+while getopts ":am:d:" opt 2>/dev/null; do
+  case "$opt" in
+    a) ALL=1 ;;
+    m) MODE="$OPTARG" ;;
+    d) DEV="$OPTARG" ;;
+    *) ;;
+  esac
+done
+
+case "$cmd" in
+  set-cc-mode)
+    [ -z "$MODE" ] && { log "ERROR: -m <mode> is required"; exit 1; }
+    _parse_mode "$MODE"
+    set_cc_mode "$MODE" "$DEV"
+    ;;
+  get-cc-mode)
+    get_cc_mode "$DEV"
+    ;;
+  help|--help|-h|"")
+    usage
+    ;;
+  *)
+    log "ERROR: unknown command '$cmd'"
+    usage
+    exit 1
+    ;;
+esac

@@ -1,0 +1,139 @@
+"""Bash mode-engine tests: drive scripts/tpu-cc-manager.sh end-to-end
+against the HTTP fake API server and a synthetic sysfs tree, with device
+access through the real tpudevctl binary."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.device.statefile import ModeStateStore
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.objects import make_node
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "tpu-cc-manager.sh")
+DP = "tpu.google.com/pool.deploy.device-plugin"
+
+
+@pytest.fixture(scope="module")
+def tpudevctl():
+    if shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return os.path.join(REPO, "native", "build", "tpudevctl")
+
+
+@pytest.fixture()
+def env(tmp_path, tpudevctl):
+    sysfs = tmp_path / "sysfs"
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(2):
+        d = sysfs / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text("0x1ae0\n")
+        (d / "device").write_text("0x0063\n")
+        (dev / f"accel{i}").write_text("")
+    server = FakeApiServer().start()
+    server.store.add_node(make_node("bash-node", labels={DP: "true"}))
+    e = dict(os.environ)
+    e.update(
+        NODE_NAME="bash-node",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(server.port),
+        TPU_SYSFS_ROOT=str(sysfs),
+        TPU_DEV_ROOT=str(dev),
+        TPU_CC_STATE_DIR=str(tmp_path / "state"),
+        TPUDEVCTL=tpudevctl,
+        EVICTION_TIMEOUT_S="2",
+        EVICTION_POLL_S="0.2",
+        CC_READINESS_FILE=str(tmp_path / "run" / ".ready"),
+    )
+    e.pop("CC_CAPABLE_DEVICE_IDS", None)
+    yield e, server, tmp_path
+    server.stop()
+
+
+def run_sh(env, *args):
+    return subprocess.run(["bash", SCRIPT, *args], capture_output=True,
+                          text=True, env=env, timeout=60)
+
+
+def test_set_and_get_cc_mode(env):
+    e, server, tmp_path = env
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 0, r.stderr
+    labels = server.store.get_node("bash-node")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "on"
+    assert labels[DP] == "true"  # paused then restored
+    store = ModeStateStore(str(tmp_path / "state"))
+    for i in range(2):
+        assert store.effective(str(tmp_path / "dev" / f"accel{i}"), "cc") == "on"
+    assert (tmp_path / "run" / ".ready").exists()
+
+    r = run_sh(e, "get-cc-mode")
+    assert r.returncode == 0
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 2
+    assert all("cc=on" in ln and "ici=off" in ln for ln in lines)
+
+
+def test_idempotent_fast_path(env):
+    e, server, _ = env
+    assert run_sh(e, "set-cc-mode", "-a", "-m", "devtools").returncode == 0
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "devtools")
+    assert r.returncode == 0
+    assert "already in mode" in r.stderr
+
+
+def test_invalid_mode_rejected(env):
+    e, _, _ = env
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "bogus")
+    assert r.returncode == 1
+    assert "invalid mode" in r.stderr
+
+
+def test_ici_mode_and_off(env):
+    e, server, tmp_path = env
+    assert run_sh(e, "set-cc-mode", "-a", "-m", "ici").returncode == 0
+    store = ModeStateStore(str(tmp_path / "state"))
+    dev0 = str(tmp_path / "dev" / "accel0")
+    assert store.effective(dev0, "ici") == "on"
+    assert store.effective(dev0, "cc") == "off"
+    assert run_sh(e, "set-cc-mode", "-a", "-m", "off").returncode == 0
+    assert store.effective(dev0, "ici") == "off"
+    labels = server.store.get_node("bash-node")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "off"
+
+
+def test_single_device_scope(env):
+    e, _, tmp_path = env
+    dev1 = str(tmp_path / "dev" / "accel1")
+    r = run_sh(e, "set-cc-mode", "-d", dev1, "-m", "on")
+    assert r.returncode == 0, r.stderr
+    store = ModeStateStore(str(tmp_path / "state"))
+    assert store.effective(dev1, "cc") == "on"
+    assert store.effective(str(tmp_path / "dev" / "accel0"), "cc") == "off"
+
+
+def test_mixed_capability_bailout(env):
+    e, _, _ = env
+    e2 = dict(e)
+    e2["CC_CAPABLE_DEVICE_IDS"] = "0x005e"  # nothing matches 0x0063
+    r = run_sh(e2, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 1
+    assert "not CC-capable" in r.stderr
+
+
+def test_missing_node_name(env):
+    e, _, _ = env
+    e2 = dict(e)
+    del e2["NODE_NAME"]
+    r = run_sh(e2, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 1
+    assert "NODE_NAME" in r.stderr

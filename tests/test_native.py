@@ -1,0 +1,264 @@
+"""Native component tests: build via make, then exercise tpudevctl's
+state-store interop with the Python device layer, and the C++ agent
+end-to-end against the HTTP fake API server."""
+
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.device.statefile import ModeStateStore
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.objects import make_node
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BUILD = os.path.join(NATIVE, "build")
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("native toolchain unavailable")
+    r = subprocess.run(
+        ["make", "-C", NATIVE], capture_output=True, text=True
+    )
+    assert r.returncode == 0, r.stderr
+    return BUILD
+
+
+def make_accel_tree(root, n=2):
+    sysfs = root / "sysfs"
+    dev = root / "dev"
+    dev.mkdir(exist_ok=True)
+    for i in range(n):
+        d = sysfs / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text("0x1ae0\n")
+        (d / "device").write_text("0x0063\n")
+        (dev / f"accel{i}").write_text("")
+    return str(sysfs), str(dev)
+
+
+def ctl_env(tmp_path, sysfs, dev):
+    env = dict(os.environ)
+    env.update(
+        TPU_SYSFS_ROOT=sysfs,
+        TPU_DEV_ROOT=dev,
+        TPU_CC_STATE_DIR=str(tmp_path / "state"),
+    )
+    env.pop("CC_CAPABLE_DEVICE_IDS", None)
+    return env
+
+
+def ctl(native_build, env, *args):
+    return subprocess.run(
+        [os.path.join(native_build, "tpudevctl"), *args],
+        capture_output=True, text=True, env=env,
+    )
+
+
+def test_tpudevctl_list(native_build, tmp_path):
+    sysfs, dev = make_accel_tree(tmp_path)
+    r = ctl(native_build, ctl_env(tmp_path, sysfs, dev), "list")
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 2
+    path, name, devid, is_switch, capable = lines[0].split()
+    assert path.endswith("/accel0") and name == "tpu-v5p"
+    assert devid == "0x0063" and is_switch == "0" and capable == "1"
+
+
+def test_tpudevctl_allowlist(native_build, tmp_path):
+    sysfs, dev = make_accel_tree(tmp_path)
+    env = ctl_env(tmp_path, sysfs, dev)
+    env["CC_CAPABLE_DEVICE_IDS"] = "0x005e"
+    r = ctl(native_build, env, "list")
+    assert all(line.split()[-1] == "0" for line in r.stdout.strip().splitlines())
+
+
+def test_tpudevctl_state_interop_with_python(native_build, tmp_path):
+    """C++ writes, Python reads (and vice versa) — same on-disk layout."""
+    sysfs, dev = make_accel_tree(tmp_path, n=1)
+    env = ctl_env(tmp_path, sysfs, dev)
+    devpath = dev + "/accel0"
+    state_dir = env["TPU_CC_STATE_DIR"]
+
+    # C++ stage + commit -> Python sees effective
+    assert ctl(native_build, env, "stage", devpath, "cc", "on").returncode == 0
+    store = ModeStateStore(state_dir)
+    assert store.staged(devpath, "cc") == "on"
+    assert store.effective(devpath, "cc") == "off"
+    assert ctl(native_build, env, "commit", devpath).returncode == 0
+    assert store.effective(devpath, "cc") == "on"
+
+    # Python stage -> C++ query staged; C++ discard -> staged reverts
+    store.stage(devpath, "ici", "on")
+    r = ctl(native_build, env, "staged", devpath, "ici")
+    assert r.stdout.strip() == "on"
+    assert ctl(native_build, env, "discard", devpath).returncode == 0
+    assert store.staged(devpath, "ici") == "off"
+    r = ctl(native_build, env, "query", devpath, "cc")
+    assert r.stdout.strip() == "on"
+
+
+@pytest.fixture()
+def apiserver():
+    with FakeApiServer() as s:
+        yield s
+
+
+def test_cpp_agent_reconciles_label_changes(native_build, apiserver, tmp_path):
+    """The native agent watches the node and execs the engine command per
+    change (coalesced). The engine command here is a stub that appends the
+    mode to a file."""
+    out_file = tmp_path / "engine-calls.txt"
+    apiserver.store.add_node(
+        make_node("cnode", labels={L.CC_MODE_LABEL: "off"})
+    )
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="cnode",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if out_file.exists() and "off" in out_file.read_text():
+                break
+            time.sleep(0.05)
+        assert out_file.exists() and out_file.read_text().split() == ["off"]
+
+        apiserver.store.set_node_labels("cnode", {L.CC_MODE_LABEL: "on"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if out_file.read_text().split() == ["off", "on"]:
+                break
+            time.sleep(0.05)
+        assert out_file.read_text().split() == ["off", "on"]
+
+        # label removal -> nothing (no default set); unrelated label -> no call
+        apiserver.store.set_node_labels("cnode", {"unrelated": "x"})
+        time.sleep(1)
+        assert out_file.read_text().split() == ["off", "on"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_cpp_agent_applies_default_when_label_absent(
+    native_build, apiserver, tmp_path
+):
+    out_file = tmp_path / "calls.txt"
+    apiserver.store.add_node(make_node("dnode"))
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="dnode",
+        DEFAULT_CC_MODE="devtools",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if out_file.exists() and out_file.read_text().strip():
+                break
+            time.sleep(0.05)
+        assert out_file.read_text().split() == ["devtools"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_cpp_agent_requires_node_name(native_build):
+    env = dict(os.environ)
+    env.pop("NODE_NAME", None)
+    r = subprocess.run(
+        [os.path.join(BUILD, "tpu-cc-manager-agent")],
+        capture_output=True, text=True, env=env, timeout=10,
+    )
+    assert r.returncode == 1
+    assert "NODE_NAME" in r.stderr
+
+
+def test_cpp_agent_coalesces_burst(native_build, apiserver, tmp_path):
+    """A burst of label flips while the engine is busy collapses to the
+    latest value (reference cmd/main.go:48-76 semantics)."""
+    out_file = tmp_path / "calls.txt"
+    apiserver.store.add_node(make_node("bnode", labels={L.CC_MODE_LABEL: "off"}))
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="bnode",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        # engine takes 1s: the burst lands while it runs
+        TPU_CC_ENGINE_CMD=f"sh -c 'sleep 1; echo %s >> {out_file}'",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if out_file.exists() and "off" in out_file.read_text():
+                break
+            time.sleep(0.05)
+        for m in ("on", "devtools", "ici", "on"):
+            apiserver.store.set_node_labels("bnode", {L.CC_MODE_LABEL: m})
+        time.sleep(4)
+        calls = out_file.read_text().split()
+        assert calls[0] == "off"
+        assert calls[-1] == "on"
+        # the burst must NOT have produced one call per flip
+        assert len(calls) <= 3
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_python_ctypes_binding_interop(native_build, tmp_path, monkeypatch):
+    """SysfsTpuBackend routes through libtpudev.so when TPU_CC_NATIVE_LIB
+    is set; state written natively is identical to the pure-Python layout."""
+    from tpu_cc_manager.device.native import load_native_store
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+    lib = os.path.join(native_build, "libtpudev.so")
+    monkeypatch.setenv("TPU_CC_NATIVE_LIB", lib)
+    state_dir = str(tmp_path / "state")
+    native = load_native_store(state_dir)
+    assert native is not None
+
+    sysfs, dev = make_accel_tree(tmp_path, n=1)
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=state_dir)
+    assert type(be.store).__name__ == "NativeModeStateStore"
+    (chip,), _ = be.find_tpus()
+    chip.set_cc_mode("on")
+    chip.reset()
+    assert chip.query_cc_mode() == "on"
+    # pure-Python store reads the same bytes
+    py_store = ModeStateStore(state_dir)
+    assert py_store.effective(chip.path, "cc") == "on"

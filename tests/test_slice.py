@@ -237,3 +237,51 @@ def test_heartbeat_thread_updates_annotation():
         assert second > first
     finally:
         coord.stop()
+
+
+def test_agent_restart_reapplies_completed_mode_without_quorum():
+    # review finding: a routine agent restart re-reconciling the unchanged
+    # label must NOT wait for a new slice round (which would never come)
+    kube = FakeKube()
+    members = [SliceMember(kube, f"n{i}", "slice-a") for i in range(2)]
+    results = {}
+
+    def run(m, mode):
+        try:
+            results[m.name] = m.apply(mode)
+        except SliceAbortError:
+            results[m.name] = "aborted"
+
+    ts = [threading.Thread(target=run, args=(m, "on")) for m in members]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    assert all(results[m.name] is True for m in members)
+
+    # "restart": same member re-applies the already-done mode alone
+    t0 = time.monotonic()
+    assert members[1].apply("on") is True  # immediate, no coordination
+    assert time.monotonic() - t0 < 1.0
+    assert members[1].states[-1] == "on"
+
+
+def test_shutdown_abort_is_flagged():
+    kube = FakeKube()
+    m = SliceMember(kube, "n0", "slice-a", commit_timeout_s=60)
+    kube.add_node(make_node("n1", labels={L.TPU_SLICE_LABEL: "slice-a"}))
+    kube.set_node_annotations("n1", {HB_ANNOTATION: str(time.time() + 1000)})
+    caught = {}
+
+    def run():
+        try:
+            m.apply("on")
+        except SliceAbortError as e:
+            caught["shutting_down"] = e.shutting_down
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.5)
+    m.coord.stop()
+    t.join(timeout=5)
+    assert caught.get("shutting_down") is True

@@ -137,9 +137,13 @@ class CCManagerAgent:
             outcome = "invalid"
             return False
         except SliceAbortError as e:
-            # the slice never agreed; local devices untouched — publish the
-            # failure and keep serving (the next label event retries)
+            # the slice never agreed; local devices untouched
             log.error("slice coordination aborted: %s", e)
+            if e.shutting_down:
+                # termination artifact, not a real failure: leave the
+                # durable state label alone
+                outcome = "shutdown"
+                return False
             try:
                 self._set_state_label("failed")
             except Exception:

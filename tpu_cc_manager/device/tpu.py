@@ -191,9 +191,16 @@ class SysfsTpuBackend(Backend):
             "TPU_SYSFS_ROOT", "/sys/class/accel"
         )
         self.dev_root = dev_root or os.environ.get("TPU_DEV_ROOT", "/dev")
-        self.store = ModeStateStore(
-            state_dir
-            or os.environ.get("TPU_CC_STATE_DIR", "/var/lib/tpu-cc-manager")
+        resolved_state_dir = state_dir or os.environ.get(
+            "TPU_CC_STATE_DIR", "/var/lib/tpu-cc-manager"
+        )
+        # prefer the native store when available (one implementation shared
+        # with the C++ agent and tpudevctl); identical on-disk layout
+        from tpu_cc_manager.device.native import load_native_store
+
+        self.store = (
+            load_native_store(resolved_state_dir)
+            or ModeStateStore(resolved_state_dir)
         )
 
     def _scan(self) -> List[SysfsTpuChip]:

@@ -34,16 +34,15 @@ import numpy as np
 
 from tpu_cc_manager import labels as L
 
-#: Mode → code. UNKNOWN covers absent/invalid label values; FAILED is the
-#: observed-state failure marker.
-MODE_CODES: Dict[str, int] = {
-    "unknown": 0,
-    "off": 1,
-    "on": 2,
-    "devtools": 3,
-    "ici": 4,
-    "failed": 5,
-}
+#: Mode → code, derived from the canonical vocabulary in modes.py so the
+#: planner cannot drift when modes are added. UNKNOWN covers absent or
+#: invalid label values; FAILED is the observed-state failure marker.
+from tpu_cc_manager.modes import STATE_FAILED, VALID_MODES
+
+MODE_CODES: Dict[str, int] = {"unknown": 0}
+for _m in VALID_MODES:
+    MODE_CODES[_m] = len(MODE_CODES)
+MODE_CODES[STATE_FAILED] = len(MODE_CODES)
 CODE_MODES = {v: k for k, v in MODE_CODES.items()}
 N_MODES = len(MODE_CODES)
 

@@ -78,7 +78,13 @@ DONE_ANNOTATION = "tpu.google.com/cc.slice.done"
 
 class SliceAbortError(Exception):
     """The slice round did not reach a commit; the local flip was NOT
-    attempted. The agent publishes the failed state and keeps serving."""
+    attempted. The agent publishes the failed state and keeps serving —
+    except when ``shutting_down`` is set, in which case the abort is an
+    artifact of agent termination and no failure is published."""
+
+    def __init__(self, msg: str, *, shutting_down: bool = False):
+        super().__init__(msg)
+        self.shutting_down = shutting_down
 
 
 def _parse_stamp(raw: Optional[str]) -> Tuple[Optional[str], int]:
@@ -202,7 +208,20 @@ class SliceCoordinator:
         me = next(
             n for n in members if n["metadata"]["name"] == self.node_name
         )
-        _, my_done_epoch = _parse_stamp(self._ann(me, DONE_ANNOTATION))
+        my_done_mode, my_done_epoch = _parse_stamp(
+            self._ann(me, DONE_ANNOTATION)
+        )
+        if my_done_mode == raw_mode:
+            # this member already completed a round for exactly this mode
+            # (routine agent restart re-reconciling the unchanged label):
+            # no quorum needed — the local engine call is idempotent and
+            # republishes the state label (engine fast path).
+            log.info(
+                "slice %s: mode %r already completed (epoch %d); "
+                "re-applying locally without coordination",
+                slice_id, raw_mode, my_done_epoch,
+            )
+            return engine.set_mode(raw_mode)
 
         try:
             self.heartbeat_once()
@@ -211,9 +230,15 @@ class SliceCoordinator:
             raise SliceAbortError(f"could not publish slice ack: {e}") from e
 
         deadline = time.monotonic() + self.commit_timeout_s
+        last_hb = self.clock()
+        # refresh the heartbeat well inside the TTL even when start()'s
+        # background thread isn't running, without PATCHing every poll
+        hb_refresh_s = min(self.hb_period_s, self.hb_ttl_s / 3.0)
         while time.monotonic() < deadline and not self._stop.is_set():
             try:
-                self.heartbeat_once()
+                if self.clock() - last_hb >= hb_refresh_s:
+                    self.heartbeat_once()
+                    last_hb = self.clock()
                 members = self.members(slice_id)
             except ApiException as e:
                 log.warning("slice %s: membership read failed: %s", slice_id, e)
@@ -251,11 +276,13 @@ class SliceCoordinator:
             self._stop.wait(self.poll_s)
 
         self._retract_ack()
+        shutting_down = self._stop.is_set()
         raise SliceAbortError(
             f"slice {slice_id}: no commit for mode {raw_mode!r} within "
             f"{self.commit_timeout_s:.0f}s"
-            + (" (shutting down)" if self._stop.is_set() else "")
-            + "; refusing to flip — the slice must move atomically"
+            + (" (shutting down)" if shutting_down else "")
+            + "; refusing to flip — the slice must move atomically",
+            shutting_down=shutting_down,
         )
 
     def _maybe_commit(self, raw_mode: str, alive: List[dict]) -> None:

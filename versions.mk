@@ -1,0 +1,16 @@
+# Central version pins, threaded through docker build args and CI
+# (mirrors the reference's versions.mk:15-23).
+
+# this component
+VERSION ?= v0.1.0
+
+# container bases
+PYTHON_VERSION ?= 3.12
+DEBIAN_VERSION ?= bookworm
+DISTROLESS_TAG ?= gcr.io/distroless/python3-debian12:nonroot
+
+# toolchain
+GXX_STD ?= c++17
+
+# registry
+REGISTRY ?= ghcr.io/example/tpu-cc-manager
