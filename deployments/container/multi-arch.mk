@@ -1,0 +1,27 @@
+# Multi-arch buildx targets (the reference's
+# deployments/container/multi-arch.mk analog): one builder building for
+# every platform in PLATFORMS. `build-%` validates the build without
+# pushing; `push-%` rebuilds from cache and pushes the manifest list —
+# buildx cannot `--load` a multi-platform image into the local daemon, so
+# push happens straight from the builder (same constraint the reference
+# works around).
+
+PLATFORMS ?= linux/amd64,linux/arm64
+
+build-%: deployments/container/Dockerfile.%
+	$(DOCKER) buildx build --platform=$(PLATFORMS) $(BUILD_ARGS) \
+	  -f deployments/container/Dockerfile.$* \
+	  -t $(IMAGE_TAG) \
+	  --output type=image,push=false .
+
+push-%: deployments/container/Dockerfile.%
+	$(DOCKER) buildx build --platform=$(PLATFORMS) $(BUILD_ARGS) \
+	  -f deployments/container/Dockerfile.$* \
+	  -t $(IMAGE_TAG) \
+	  --push .
+
+# Short tag via imagetools: a plain pull+tag+push would collapse the
+# multi-arch manifest list to the runner's architecture.
+push-short:
+	$(DOCKER) buildx imagetools create \
+	  -t $(IMAGE):$(VERSION) $(IMAGE):$(VERSION)-$(DEFAULT_PUSH_TARGET)

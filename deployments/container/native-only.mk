@@ -1,0 +1,16 @@
+# Single-arch docker build/push targets (the reference's
+# deployments/container/native-only.mk analog): plain `docker build` for
+# the host platform, used for local development and non-multi-arch CI.
+
+build-%: deployments/container/Dockerfile.%
+	$(DOCKER) build $(BUILD_ARGS) \
+	  -f deployments/container/Dockerfile.$* \
+	  -t $(IMAGE_TAG) .
+
+push-%:
+	$(DOCKER) push $(IMAGE_TAG)
+
+# Push the default dist under the short (dist-less) tag.
+push-short:
+	$(DOCKER) tag $(IMAGE):$(VERSION)-$(DEFAULT_PUSH_TARGET) $(IMAGE):$(VERSION)
+	$(DOCKER) push $(IMAGE):$(VERSION)

@@ -28,6 +28,10 @@
  * (reference cmd/main.go:164-167).
  */
 
+#ifndef TPU_CC_VERSION
+#define TPU_CC_VERSION "dev" /* overridden by the Makefile from versions.mk */
+#endif
+
 #include <arpa/inet.h>
 #include <errno.h>
 #include <netdb.h>
@@ -59,6 +63,12 @@ int g_api_port = 8001;
 std::string g_engine_cmd =
     "python3 -m tpu_cc_manager set-cc-mode -m %s";
 std::string g_bearer_token;
+/* label value main() SUCCESSFULLY reconciled at startup; seeds the
+ * watcher's change detection so the list-state push skips the no-change
+ * case instead of double-reconciling. Stays at the never-matching
+ * sentinel when the startup reconcile failed, so the first watch event
+ * (even for the same label value) retries the engine. */
+std::string g_initial_label = "\x01unset";
 std::atomic<bool> g_stop{false};
 
 void logf(const char *level, const char *fmt, ...) {
@@ -250,11 +260,21 @@ NodeState read_node() {
 void watch_loop(SyncableModeConfig *config) {
   int consecutive_errors = 0;
   std::string rv;
+  std::string last_pushed = g_initial_label;
+  /* List-then-watch: push the list-time state too, like the reference
+   * informer's Add handler (cmd/main.go:192-206) — a label change landing
+   * between main's startup reconcile and this read would otherwise be
+   * applied only after the *next* event. */
   {
     NodeState st = read_node();
-    if (st.ok) rv = st.resource_version;
+    if (st.ok) {
+      rv = st.resource_version;
+      if (st.mode != last_pushed) {
+        last_pushed = st.mode;
+        config->Set(st.mode);
+      }
+    }
   }
-  std::string last_pushed = "\x01unset";
   while (!g_stop.load()) {
     std::string path = "/api/v1/nodes?watch=true&fieldSelector=metadata.name%3D" +
                        g_node_name + "&timeoutSeconds=300";
@@ -410,10 +430,17 @@ int main(int argc, char **argv) {
     else if (a == "--api-host") g_api_host = next("--api-host");
     else if (a == "--api-port") g_api_port = atoi(next("--api-port"));
     else if (a == "--engine-cmd") g_engine_cmd = next("--engine-cmd");
+    else if (a == "--version" || a == "-v") {
+      /* version banner, parity with the Go agent's urfave/cli -v
+       * (reference cmd/main.go:78-107); also the image smoke test's
+       * entrypoint (deployments/container/Makefile test-%) */
+      printf("tpu-cc-manager-agent %s\n", TPU_CC_VERSION);
+      return 0;
+    }
     else if (a == "--help" || a == "-h") {
       printf(
           "usage: tpu-cc-manager-agent [--node-name N] [-m MODE] "
-          "[--api-host H] [--api-port P] [--engine-cmd CMD]\n"
+          "[--api-host H] [--api-port P] [--engine-cmd CMD] [--version]\n"
           "env: NODE_NAME DEFAULT_CC_MODE KUBE_API_HOST KUBE_API_PORT "
           "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE\n");
       return 0;
@@ -450,15 +477,19 @@ int main(int argc, char **argv) {
     logf("WARN", "startup node read failed (%d); retrying in 5s", attempt);
     sleep(5);
   }
+  bool initial_applied = true;
   if (st.mode.empty() && !g_default_mode.empty()) {
     if (run_engine(g_default_mode) != 0) {
       logf("ERROR", "initial default-mode apply failed; exiting");
       return 1; /* reference cmd/main.go:141-145 */
     }
   } else if (!st.mode.empty()) {
-    if (run_engine(st.mode) != 0)
+    if (run_engine(st.mode) != 0) {
       logf("ERROR", "initial reconcile failed; continuing");
+      initial_applied = false; /* leave the sentinel: first event retries */
+    }
   }
+  if (initial_applied) g_initial_label = st.mode;
 
   SyncableModeConfig config;
   std::thread watcher(watch_loop, &config);

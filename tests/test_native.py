@@ -226,8 +226,20 @@ def test_cpp_agent_coalesces_burst(native_build, apiserver, tmp_path):
             time.sleep(0.05)
         for m in ("on", "devtools", "ici", "on"):
             apiserver.store.set_node_labels("bnode", {L.CC_MODE_LABEL: m})
-        time.sleep(4)
-        calls = out_file.read_text().split()
+        # poll for convergence + quiescence (1-core sandbox: fixed sleeps
+        # are flaky; breaking on the first trailing "on" could sample
+        # mid-burst and miss extra per-flip engine runs)
+        deadline = time.monotonic() + 20
+        calls: list = []
+        stable_since = time.monotonic()
+        while time.monotonic() < deadline:
+            new = out_file.read_text().split()
+            if new != calls:
+                calls, stable_since = new, time.monotonic()
+            elif calls and calls[-1] == "on" and \
+                    time.monotonic() - stable_since > 2.5:
+                break
+            time.sleep(0.2)
         assert calls[0] == "off"
         assert calls[-1] == "on"
         # the burst must NOT have produced one call per flip
