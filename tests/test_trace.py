@@ -1,0 +1,224 @@
+"""Tracing subsystem: span trees, sinks, per-phase metrics, /debug/traces.
+
+The reference's only tracing is ``set -x`` in its bash engine
+(reference scripts/cc-manager.sh:3); these tests cover the structured
+replacement (SURVEY.md §5.1 / §7.2 step 5).
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tpu_cc_manager.device.fake import fake_backend
+from tpu_cc_manager.engine import ModeEngine, NullDrainer
+from tpu_cc_manager.obs import HealthServer, Metrics
+from tpu_cc_manager.trace import JsonlSink, Tracer
+
+
+def test_span_nesting_and_ids():
+    tr = Tracer()
+    with tr.span("reconcile", mode="on") as root:
+        with tr.span("evict") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    spans = tr.recent()
+    # children complete (and are recorded) before their parent
+    assert [s["name"] for s in spans] == ["evict", "reconcile"]
+    assert spans[0]["trace"] == spans[1]["trace"]
+    assert spans[1]["attrs"] == {"mode": "on"}
+    assert all(s["status"] == "ok" for s in spans)
+    assert all(s["dur_s"] >= 0 for s in spans)
+
+
+def test_span_error_status_propagates_exception():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("flip", device="/dev/accel0"):
+            raise ValueError("boom")
+    (span,) = tr.recent()
+    assert span["status"] == "error"
+    assert "ValueError: boom" in span["error"]
+
+
+def test_sibling_traces_get_distinct_ids():
+    tr = Tracer()
+    with tr.span("reconcile"):
+        pass
+    with tr.span("reconcile"):
+        pass
+    a, b = tr.recent()
+    assert a["trace"] != b["trace"]
+    assert len(tr.traces()) == 2
+
+
+def test_ring_buffer_bounded():
+    tr = Tracer(ring_size=8)
+    for _ in range(50):
+        with tr.span("plan"):
+            pass
+    assert len(tr.recent(limit=100)) == 8
+
+
+def test_threads_keep_separate_span_stacks():
+    tr = Tracer()
+    errs = []
+
+    def worker(i):
+        try:
+            with tr.span("reconcile", worker=i) as root:
+                with tr.span("flip", worker=i) as child:
+                    assert child.parent_id == root.span_id
+                    assert child.trace_id == root.trace_id
+        except BaseException as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    spans = tr.recent()
+    assert len(spans) == 16
+    # every flip's parent is the reconcile of the same worker
+    roots = {s["span"]: s for s in spans if s["name"] == "reconcile"}
+    for s in spans:
+        if s["name"] == "flip":
+            parent = roots[s["parent"]]
+            assert parent["attrs"]["worker"] == s["attrs"]["worker"]
+
+
+def test_jsonl_sink(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    tr = Tracer()
+    tr.add_sink(JsonlSink(str(path)))
+    with tr.span("reconcile", mode="on"):
+        with tr.span("evict"):
+            pass
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["name"] for l in lines] == ["evict", "reconcile"]
+
+
+def test_broken_sink_does_not_break_spans():
+    tr = Tracer()
+    tr.add_sink(lambda s: (_ for _ in ()).throw(RuntimeError("sink down")))
+    with tr.span("reconcile"):
+        pass
+    assert tr.recent()[0]["status"] == "ok"
+
+
+def test_engine_emits_phase_spans():
+    tr = Tracer()
+    backend = fake_backend(n_chips=2)
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        drainer=NullDrainer(),
+        evict_components=True,
+        backend=backend,
+        tracer=tr,
+    )
+    assert engine.set_mode("on")
+    names = [s["name"] for s in tr.recent()]
+    assert names == [
+        "enumerate", "plan", "evict", "flip", "flip", "reschedule",
+        "state_label",
+    ]
+    plan_span = next(s for s in tr.recent() if s["name"] == "plan")
+    assert plan_span["attrs"] == {"mode": "on", "devices": 2, "divergent": 2}
+    flips = [s for s in tr.recent() if s["name"] == "flip"]
+    assert {f["attrs"]["device"] for f in flips} == {"/dev/accel0", "/dev/accel1"}
+    assert all(f["attrs"]["changes"] == {"cc": "on"} for f in flips)
+
+
+def test_engine_flip_span_error_on_device_failure():
+    tr = Tracer()
+    backend = fake_backend(n_chips=1)
+    backend.chips[0].fail_reset = True
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        drainer=NullDrainer(),
+        evict_components=False,
+        backend=backend,
+        tracer=tr,
+    )
+    assert engine.set_mode("on") is False
+    flip = next(s for s in tr.recent() if s["name"] == "flip")
+    assert flip["status"] == "error"
+    assert "reset failed" in flip["error"]
+
+
+def test_engine_flip_span_error_on_verify_mismatch():
+    tr = Tracer()
+    backend = fake_backend(n_chips=1)
+    backend.chips[0].drop_staged_mode = True
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        drainer=NullDrainer(),
+        evict_components=False,
+        backend=backend,
+        tracer=tr,
+    )
+    assert engine.set_mode("on") is False
+    flip = next(s for s in tr.recent() if s["name"] == "flip")
+    assert flip["status"] == "error"
+    assert "verify mismatch" in flip["error"]
+
+
+def test_metrics_phase_histogram_sink():
+    tr = Tracer()
+    m = Metrics()
+    tr.add_sink(m.observe_span)
+    with tr.span("reconcile"):
+        with tr.span("flip"):
+            pass
+    assert m.phase_duration.labels("reconcile").count == 1
+    assert m.phase_duration.labels("flip").count == 1
+    text = m.render()
+    assert 'tpu_cc_phase_duration_seconds_count{phase="flip"} 1' in text
+    assert 'tpu_cc_phase_duration_seconds_bucket{phase="reconcile",le="+Inf"} 1' in text
+
+
+def test_debug_traces_endpoint():
+    tr = Tracer()
+    with tr.span("reconcile", mode="on"):
+        pass
+    srv = HealthServer(Metrics(), port=0, tracer=tr).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces"
+        ) as resp:
+            body = json.load(resp)
+        assert body and body[-1]["name"] == "reconcile"
+        assert body[-1]["attrs"] == {"mode": "on"}
+    finally:
+        srv.stop()
+
+
+def test_agent_wires_reconcile_spans():
+    """End-to-end: agent reconcile produces a rooted span tree and the
+    per-phase histogram via its own tracer/metrics."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.k8s.objects import make_node
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    cfg = AgentConfig(
+        node_name="n1", drain_strategy="none", health_port=0,
+        readiness_file="/tmp/.test-trace-ready",
+    )
+    backend = fake_backend(n_chips=1)
+    agent = CCManagerAgent(kube, cfg, backend=backend)
+    assert agent.reconcile("on")
+    spans = agent.tracer.recent()
+    root = next(s for s in spans if s["name"] == "reconcile")
+    assert root["attrs"]["outcome"] == "success"
+    assert root.get("parent") is None
+    for s in spans:
+        if s["name"] != "reconcile":
+            assert s["trace"] == root["trace"]
+    assert agent.metrics.phase_duration.labels("reconcile").count == 1
+    assert agent.metrics.phase_duration.labels("flip").count == 1

@@ -29,6 +29,7 @@ from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.modes import InvalidModeError
 from tpu_cc_manager.slice_coord import SliceAbortError
 from tpu_cc_manager.obs import HealthServer, Metrics, create_readiness_file
+from tpu_cc_manager.trace import JsonlSink, Tracer, get_tracer
 from tpu_cc_manager.watch import FatalWatchError, NodeWatcher, SyncableModeConfig
 
 log = logging.getLogger("tpu-cc-manager.agent")
@@ -52,10 +53,20 @@ class CCManagerAgent:
         metrics: Optional[Metrics] = None,
         slice_coordinator=None,
         backend=None,
+        tracer: Optional[Tracer] = None,
     ):
         self.kube = kube
         self.cfg = cfg
         self.metrics = metrics or Metrics()
+        # per-agent tracer (not the process-wide one): the multi-node
+        # simulation runs many agents in one process, and each agent's
+        # spans must land only in its own metrics/sinks. An injected
+        # tracer must be dedicated to this agent — sinks are added to it,
+        # so sharing one across agents double-counts every span.
+        self.tracer = tracer or Tracer()
+        self.tracer.add_sink(self.metrics.observe_span)
+        if cfg.trace_file:
+            self.tracer.add_sink(JsonlSink(cfg.trace_file))
         self.config_mailbox = SyncableModeConfig(
             on_coalesced=lambda: self.metrics.coalesced_total.inc()
         )
@@ -67,12 +78,21 @@ class CCManagerAgent:
             on_error=lambda: self.metrics.watch_errors_total.inc(),
         )
         self.slice_coordinator = slice_coordinator
+        if (
+            slice_coordinator is not None
+            and slice_coordinator.tracer is get_tracer()
+        ):
+            # coordinator was built without an explicit tracer: adopt it so
+            # slice_wait spans land in this agent's trace tree (a tracer
+            # injected into the coordinator is left alone)
+            slice_coordinator.tracer = self.tracer
 
         self.engine = ModeEngine(
             set_state_label=self._set_state_label,
             drainer=build_drainer(kube, cfg),
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
             backend=backend,
+            tracer=self.tracer,
         )
         self.health: Optional[HealthServer] = None
         self._fatal: Optional[Exception] = None
@@ -118,54 +138,57 @@ class CCManagerAgent:
         FatalModeError."""
         start = time.monotonic()
         outcome = "error"
-        try:
-            if self.slice_coordinator is not None:
-                ok = self.slice_coordinator.apply_slice_coherent(
-                    raw_mode, self.engine
-                )
-            else:
-                ok = self.engine.set_mode(raw_mode)
-            outcome = "success" if ok else "failure"
-            return ok
-        except InvalidModeError as e:
-            # bad label value: report, keep serving (the operator may fix it)
-            log.error("rejecting desired mode: %s", e)
+        with self.tracer.span("reconcile", mode=raw_mode) as root_span:
             try:
-                self._set_state_label("failed")
-            except Exception:
-                log.exception("failed to publish failed state")
-            outcome = "invalid"
-            return False
-        except SliceAbortError as e:
-            # the slice never agreed; local devices untouched
-            log.error("slice coordination aborted: %s", e)
-            if e.shutting_down:
-                # termination artifact, not a real failure: leave the
-                # durable state label alone
-                outcome = "shutdown"
+                if self.slice_coordinator is not None:
+                    ok = self.slice_coordinator.apply_slice_coherent(
+                        raw_mode, self.engine
+                    )
+                else:
+                    ok = self.engine.set_mode(raw_mode)
+                outcome = "success" if ok else "failure"
+                return ok
+            except InvalidModeError as e:
+                # bad label value: report, keep serving (the operator may
+                # fix it)
+                log.error("rejecting desired mode: %s", e)
+                try:
+                    self._set_state_label("failed")
+                except Exception:
+                    log.exception("failed to publish failed state")
+                outcome = "invalid"
                 return False
-            try:
-                self._set_state_label("failed")
+            except SliceAbortError as e:
+                # the slice never agreed; local devices untouched
+                log.error("slice coordination aborted: %s", e)
+                if e.shutting_down:
+                    # termination artifact, not a real failure: leave the
+                    # durable state label alone
+                    outcome = "shutdown"
+                    return False
+                try:
+                    self._set_state_label("failed")
+                except Exception:
+                    log.exception("failed to publish failed state")
+                outcome = "slice_abort"
+                return False
+            except FatalModeError:
+                outcome = "fatal"
+                raise
             except Exception:
-                log.exception("failed to publish failed state")
-            outcome = "slice_abort"
-            return False
-        except FatalModeError:
-            outcome = "fatal"
-            raise
-        except Exception:
-            log.exception("reconcile crashed")
-            try:
-                self._set_state_label("failed")
-            except Exception:
-                log.exception("failed to publish failed state")
-            return False
-        finally:
-            dur = time.monotonic() - start
-            self.metrics.reconcile_duration.observe(dur)
-            self.metrics.reconciles_total.inc(outcome)
-            self.reconcile_count += 1
-            log.info("reconcile finished: %s in %.3fs", outcome, dur)
+                log.exception("reconcile crashed")
+                try:
+                    self._set_state_label("failed")
+                except Exception:
+                    log.exception("failed to publish failed state")
+                return False
+            finally:
+                dur = time.monotonic() - start
+                root_span.attrs["outcome"] = outcome
+                self.metrics.reconcile_duration.observe(dur)
+                self.metrics.reconciles_total.inc(outcome)
+                self.reconcile_count += 1
+                log.info("reconcile finished: %s in %.3fs", outcome, dur)
 
     # ---------------------------------------------------------------- run
     def run(self, max_reconciles: Optional[int] = None) -> int:
@@ -176,7 +199,9 @@ class CCManagerAgent:
             self.slice_coordinator.start()
         if cfg.health_port:  # 0 disables (SURVEY.md §5.6 table)
             try:
-                self.health = HealthServer(self.metrics, port=cfg.health_port).start()
+                self.health = HealthServer(
+                    self.metrics, port=cfg.health_port, tracer=self.tracer
+                ).start()
             except OSError as e:
                 log.warning("health server disabled: %s", e)
 

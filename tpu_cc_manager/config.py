@@ -18,6 +18,7 @@ flag                      env                            default
 (none)                    CC_CAPABLE_DEVICE_IDS          "" (all Google chips capable)
 --health-port             HEALTH_PORT                    8089 (0 disables)
 (none)                    SLICE_COORDINATION             "false"
+(none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
 ========================  =============================  =======================
 """
 
@@ -52,6 +53,7 @@ class AgentConfig:
     readiness_file: str = DEFAULT_READINESS_FILE
     health_port: int = 8089
     slice_coordination: bool = False
+    trace_file: Optional[str] = None
 
     def __post_init__(self):
         if self.drain_strategy not in ("components", "node", "none"):
@@ -126,5 +128,6 @@ def parse_config(argv: Optional[List[str]] = None):
         readiness_file=os.environ.get("CC_READINESS_FILE", DEFAULT_READINESS_FILE),
         health_port=args.health_port,
         slice_coordination=_env_bool("SLICE_COORDINATION", False),
+        trace_file=os.environ.get("CC_TRACE_FILE") or None,
     )
     return cfg, args

@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from tpu_cc_manager import device as devlayer
 from tpu_cc_manager.device.base import DeviceError, TpuChip
 from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
+from tpu_cc_manager.trace import Tracer, get_tracer
 
 log = logging.getLogger("tpu-cc-manager.engine")
 
@@ -80,6 +81,7 @@ class ModeEngine:
         evict_components: bool = True,
         boot_timeout_s: float = 300.0,
         backend=None,
+        tracer: Optional[Tracer] = None,
     ):
         self._set_state_label = set_state_label
         self._drainer = drainer or NullDrainer()
@@ -88,6 +90,7 @@ class ModeEngine:
         #: device backend override; None = the process-wide backend. The
         #: multi-node simulation injects one backend per simulated host.
         self._backend = backend
+        self._tracer = tracer or get_tracer()
 
     # ------------------------------------------------------------- queries
     def get_modes(self) -> dict:
@@ -116,10 +119,14 @@ class ModeEngine:
         desired_cc = mode.value if mode in CC_MODES else "off"
         desired_ici = "on" if mode is Mode.ICI else "off"
 
-        devices = self._all_devices()
+        with self._tracer.span("enumerate"):
+            devices = self._all_devices()
         self._check_capability(devices, mode)
 
-        plan = self._plan(devices, desired_cc, desired_ici)
+        with self._tracer.span("plan", mode=mode.value) as plan_span:
+            plan = self._plan(devices, desired_cc, desired_ici)
+            plan_span.attrs["devices"] = len(devices)
+            plan_span.attrs["divergent"] = len(plan)
         if not plan:
             n = len(devices)
             if n:
@@ -187,7 +194,8 @@ class ModeEngine:
         ok = False
         try:
             if self._evict_components:
-                self._drainer.evict()
+                with self._tracer.span("evict"):
+                    self._drainer.evict()
             ok = apply()
         except DeviceError as e:
             log.error("mode flip failed: %s", e)
@@ -195,10 +203,12 @@ class ModeEngine:
         finally:
             if self._evict_components:
                 try:
-                    self._drainer.reschedule()
+                    with self._tracer.span("reschedule"):
+                        self._drainer.reschedule()
                 except Exception:
                     log.exception("failed to reschedule drained components")
-        self._set_state_label(state_on_success if ok else STATE_FAILED)
+        with self._tracer.span("state_label"):
+            self._set_state_label(state_on_success if ok else STATE_FAILED)
         return ok
 
     def _apply_plan(self, plan: Sequence[PlanItem]) -> bool:
@@ -207,25 +217,33 @@ class ModeEngine:
         every staged domain. Any failure aborts the whole node flip."""
         for dev, changes in plan:
             try:
-                dev.discard_staged()
-                for domain, target in changes.items():
-                    if domain == "cc":
-                        dev.set_cc_mode(target)
-                    else:
-                        dev.set_ici_mode(target)
-                dev.reset()
-                dev.wait_ready(timeout_s=self._boot_timeout_s)
-                for domain, target in changes.items():
-                    achieved = (
-                        dev.query_cc_mode() if domain == "cc"
-                        else dev.query_ici_mode()
-                    )
-                    if achieved != target:
-                        log.error(
-                            "%s: %s mode verify mismatch: wanted %r got %r",
-                            dev.path, domain, target, achieved,
+                with self._tracer.span(
+                    "flip", device=dev.path, changes=dict(changes)
+                ) as flip_span:
+                    dev.discard_staged()
+                    for domain, target in changes.items():
+                        if domain == "cc":
+                            dev.set_cc_mode(target)
+                        else:
+                            dev.set_ici_mode(target)
+                    dev.reset()
+                    dev.wait_ready(timeout_s=self._boot_timeout_s)
+                    for domain, target in changes.items():
+                        achieved = (
+                            dev.query_cc_mode() if domain == "cc"
+                            else dev.query_ici_mode()
                         )
-                        return False
+                        if achieved != target:
+                            log.error(
+                                "%s: %s mode verify mismatch: wanted %r got %r",
+                                dev.path, domain, target, achieved,
+                            )
+                            flip_span.status = "error"
+                            flip_span.error = (
+                                f"verify mismatch: {domain} wanted "
+                                f"{target!r} got {achieved!r}"
+                            )
+                            return False
             except DeviceError as e:
                 log.error("%s: mode flip failed: %s", dev.path, e)
                 return False

@@ -13,6 +13,7 @@ nothing to vendor), plus a tiny stdlib HTTP server serving:
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -125,18 +126,30 @@ class Histogram:
     def count(self) -> int:
         return self._total
 
-    def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def render_series(self, name: str, label_prefix: str = "") -> List[str]:
+        """Exposition series lines only (no HELP/TYPE). ``label_prefix`` is
+        a ``key="value",``-style fragment prepended inside every brace set
+        (used by HistogramVec for its family label)."""
+        suffix = "{" + label_prefix.rstrip(",") + "}" if label_prefix else ""
+        out = []
         with self._lock:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
-                out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+                out.append(
+                    f'{name}_bucket{{{label_prefix}le="{_fmt(b)}"}} {cum}'
+                )
             cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            out.append(f"{self.name}_sum {_fmt(self._sum)}")
-            out.append(f"{self.name}_count {self._total}")
+            out.append(f'{name}_bucket{{{label_prefix}le="+Inf"}} {cum}')
+            out.append(f"{name}_sum{suffix} {_fmt(self._sum)}")
+            out.append(f"{name}_count{suffix} {self._total}")
         return out
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ] + self.render_series(self.name)
 
 
 def _fmt(v: float) -> str:
@@ -148,6 +161,43 @@ def _labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
         return ""
     pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
     return "{" + pairs + "}"
+
+
+class HistogramVec:
+    """A histogram family keyed by one label (bounded cardinality: callers
+    must pass values from a closed vocabulary, e.g. trace.PHASES)."""
+
+    def __init__(self, name: str, help_: str, label_name: str,
+                 buckets=Histogram.DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.label_name = label_name
+        self.buckets = buckets
+        self._children: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value: str) -> Histogram:
+        with self._lock:
+            h = self._children.get(value)
+            if h is None:
+                # bare family name: the vec's render attaches the label
+                h = self._children[value] = Histogram(
+                    self.name, self.help, self.buckets
+                )
+            return h
+
+    def observe(self, label_value: str, value: float) -> None:
+        self.labels(label_value).observe(value)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for label_value, h in children:
+            out.extend(
+                h.render_series(self.name, f'{self.label_name}="{label_value}",')
+            )
+        return out
 
 
 class Metrics:
@@ -174,6 +224,15 @@ class Metrics:
             "tpu_cc_coalesced_updates_total",
             "Label updates absorbed by coalescing without a reconcile",
         )
+        self.phase_duration = HistogramVec(
+            "tpu_cc_phase_duration_seconds",
+            "Wall-clock duration of one reconcile phase (trace span)",
+            "phase",
+        )
+
+    def observe_span(self, span) -> None:
+        """Trace sink: fold completed spans into the per-phase histogram."""
+        self.phase_duration.observe(span.name, span.dur_s)
 
     def set_current_mode(self, mode: str) -> None:
         for m in ("on", "off", "devtools", "ici", "failed", "unknown"):
@@ -187,6 +246,7 @@ class Metrics:
             self.watch_errors_total,
             self.current_mode,
             self.coalesced_total,
+            self.phase_duration,
         ):
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
@@ -198,8 +258,9 @@ class Metrics:
 
 
 class HealthServer:
-    def __init__(self, metrics: Metrics, port: int = 0):
+    def __init__(self, metrics: Metrics, port: int = 0, tracer=None):
         self.metrics = metrics
+        self.tracer = tracer
         self.live = True
         self.ready = False
         outer = self
@@ -223,6 +284,14 @@ class HealthServer:
                         outer.metrics.render().encode(),
                         "text/plain; version=0.0.4",
                     )
+                elif self.path == "/debug/traces":
+                    if outer.tracer is None:
+                        self._respond(404, b"tracing not wired")
+                    else:
+                        body = json.dumps(
+                            outer.tracer.recent(), indent=1
+                        ).encode()
+                        self._respond(200, body, "application/json")
                 else:
                     self._respond(404, b"not found")
 

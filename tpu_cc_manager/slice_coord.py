@@ -62,6 +62,7 @@ from typing import List, Optional, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.trace import Tracer, get_tracer
 
 log = logging.getLogger("tpu-cc-manager.slice")
 
@@ -109,9 +110,11 @@ class SliceCoordinator:
         commit_timeout_s: float = COMMIT_TIMEOUT_S,
         poll_s: float = POLL_S,
         clock=time.time,
+        tracer: Optional[Tracer] = None,
     ):
         self.kube = kube
         self.node_name = node_name
+        self.tracer = tracer or get_tracer()
         self.hb_period_s = hb_period_s
         self.hb_ttl_s = hb_ttl_s
         self.commit_timeout_s = commit_timeout_s
@@ -234,46 +237,68 @@ class SliceCoordinator:
         # refresh the heartbeat well inside the TTL even when start()'s
         # background thread isn't running, without PATCHing every poll
         hb_refresh_s = min(self.hb_period_s, self.hb_ttl_s / 3.0)
-        while time.monotonic() < deadline and not self._stop.is_set():
-            try:
-                if self.clock() - last_hb >= hb_refresh_s:
-                    self.heartbeat_once()
-                    last_hb = self.clock()
-                members = self.members(slice_id)
-            except ApiException as e:
-                log.warning("slice %s: membership read failed: %s", slice_id, e)
-                self._stop.wait(self.poll_s)
-                continue
-            alive = self._alive(members)
-            if not alive:
-                break
-            leader = alive[0]["metadata"]["name"]
-
-            if leader == self.node_name:
-                self._maybe_commit(raw_mode, alive)
-
-            leader_node = next(
-                (n for n in members if n["metadata"]["name"] == leader), None
-            )
-            if leader_node is not None:
-                c_mode, c_epoch = _parse_stamp(
-                    self._ann(leader_node, L.SLICE_COMMIT_ANNOTATION)
-                )
-                if c_mode == raw_mode and c_epoch > my_done_epoch:
-                    log.info(
-                        "slice %s: commit epoch %d observed; flipping locally",
-                        slice_id, c_epoch,
+        commit_epoch: Optional[int] = None
+        with self.tracer.span(
+            "slice_wait", slice=slice_id, mode=raw_mode
+        ) as wait_span:
+            while time.monotonic() < deadline and not self._stop.is_set():
+                try:
+                    if self.clock() - last_hb >= hb_refresh_s:
+                        self.heartbeat_once()
+                        last_hb = self.clock()
+                    members = self.members(slice_id)
+                except ApiException as e:
+                    log.warning(
+                        "slice %s: membership read failed: %s", slice_id, e
                     )
-                    ok = engine.set_mode(raw_mode)
-                    try:
-                        self._annotate_self(
-                            DONE_ANNOTATION, f"{raw_mode}:{c_epoch}"
-                        )
-                    except ApiException as e:
-                        log.warning("could not record slice done: %s", e)
-                    return ok
+                    self._stop.wait(self.poll_s)
+                    continue
+                alive = self._alive(members)
+                if not alive:
+                    break
+                leader = alive[0]["metadata"]["name"]
 
-            self._stop.wait(self.poll_s)
+                if leader == self.node_name:
+                    try:
+                        self._maybe_commit(raw_mode, alive)
+                    except ApiException as e:
+                        # transient commit-PATCH failure: keep polling (the
+                        # ack must stay published, so no retract here)
+                        log.warning(
+                            "slice %s: commit publish failed: %s",
+                            slice_id, e,
+                        )
+                        self._stop.wait(self.poll_s)
+                        continue
+
+                leader_node = next(
+                    (n for n in members if n["metadata"]["name"] == leader),
+                    None,
+                )
+                if leader_node is not None:
+                    c_mode, c_epoch = _parse_stamp(
+                        self._ann(leader_node, L.SLICE_COMMIT_ANNOTATION)
+                    )
+                    if c_mode == raw_mode and c_epoch > my_done_epoch:
+                        commit_epoch = c_epoch
+                        break
+
+                self._stop.wait(self.poll_s)
+            wait_span.attrs["committed"] = commit_epoch is not None
+
+        if commit_epoch is not None:
+            log.info(
+                "slice %s: commit epoch %d observed; flipping locally",
+                slice_id, commit_epoch,
+            )
+            ok = engine.set_mode(raw_mode)
+            try:
+                self._annotate_self(
+                    DONE_ANNOTATION, f"{raw_mode}:{commit_epoch}"
+                )
+            except ApiException as e:
+                log.warning("could not record slice done: %s", e)
+            return ok
 
         self._retract_ack()
         shutting_down = self._stop.is_set()

@@ -1,0 +1,194 @@
+"""Reconcile tracing — per-phase spans with durable export.
+
+The reference's only "tracing" is ``set -x`` on its bash engine
+(SURVEY.md §5.1: every command echoed to the pod log, nothing structured,
+nothing timed). This module is the deliberate improvement SURVEY.md §7.2
+step 5 calls for: every reconcile becomes a tree of timed spans
+(enumerate → plan → evict → per-device flip → reschedule), so the
+wall-clock dominators the reference can only be guessed at from logs —
+eviction pod-waits and device reset/boot (SURVEY.md §3.5) — are measured
+per phase, per device.
+
+Design:
+
+- :class:`Tracer` keeps a thread-local span stack (nesting without
+  explicit parent plumbing) and a bounded ring of completed spans.
+- Sinks observe every completed span: :class:`JsonlSink` appends one JSON
+  line per span to ``CC_TRACE_FILE`` (the structured replacement for
+  ``set -x``); the agent adds a metrics sink so ``/metrics`` exports a
+  per-phase duration histogram; ``/debug/traces`` on the health server
+  serves the ring for live inspection.
+- Tracing is always on (it is microseconds of overhead per reconcile);
+  sinks are what you opt into.
+
+The span vocabulary (``PHASES``) is intentionally closed: the per-phase
+histogram's label cardinality stays bounded no matter what attrs
+individual spans carry.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("tpu-cc-manager.trace")
+
+#: Closed span-name vocabulary (metrics label values).
+PHASES = (
+    "reconcile",    # root: one desired-mode application end to end
+    "enumerate",    # device discovery
+    "plan",         # divergence computation
+    "slice_wait",   # slice-coordination wait for quorum commit
+    "evict",        # L2 drain
+    "flip",         # one device: stage + reset + wait + verify
+    "reschedule",   # L2 restore
+    "state_label",  # observed-state label publish
+)
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id",
+        "start_ts", "dur_s", "status", "error", "attrs",
+    )
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], attrs: Dict[str, object]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ts = time.time()
+        self.dur_s: float = 0.0
+        self.status = "ok"
+        self.error: Optional[str] = None
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "start_ts": round(self.start_ts, 6),
+            "dur_s": round(self.dur_s, 6),
+            "status": self.status,
+        }
+        if self.parent_id is not None:
+            d["parent"] = self.parent_id
+        if self.error is not None:
+            d["error"] = self.error
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class Tracer:
+    """Thread-safe span recorder. One process-wide instance is enough; the
+    thread-local stack keeps concurrent threads' span trees separate."""
+
+    def __init__(self, ring_size: int = 2048):
+        self._ring: deque = deque(maxlen=ring_size)
+        self._sinks: List[Callable[[Span], None]] = []
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- plumbing
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    def _next_id(self) -> str:
+        with self._lock:
+            return format(next(self._ids), "x")
+
+    def add_sink(self, sink: Callable[[Span], None]) -> "Tracer":
+        self._sinks.append(sink)
+        return self
+
+    # --------------------------------------------------------------- spans
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Time a phase. Exceptions mark the span failed and propagate."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        sid = self._next_id()
+        s = Span(
+            name,
+            trace_id=parent.trace_id if parent else sid,
+            span_id=sid,
+            parent_id=parent.span_id if parent else None,
+            attrs=attrs,
+        )
+        t0 = time.monotonic()
+        stack.append(s)
+        try:
+            yield s
+        except BaseException as e:
+            s.status = "error"
+            s.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            s.dur_s = time.monotonic() - t0
+            stack.pop()
+            self._record(s)
+
+    def _record(self, s: Span) -> None:
+        with self._lock:
+            self._ring.append(s)
+        for sink in self._sinks:
+            try:
+                sink(s)
+            except Exception:  # a broken sink must never break a reconcile
+                log.exception("trace sink failed")
+
+    # ------------------------------------------------------------- reading
+    def recent(self, limit: int = 256) -> List[dict]:
+        """Most recent completed spans, oldest first."""
+        with self._lock:  # snapshot: reconcile threads append concurrently
+            items = list(self._ring)
+        return [s.to_dict() for s in items[-limit:]]
+
+    def traces(self, limit: int = 16) -> List[List[dict]]:
+        """Recent spans grouped by trace id, oldest trace first."""
+        with self._lock:
+            items = list(self._ring)
+        by_trace: Dict[str, List[dict]] = {}
+        for s in items:
+            by_trace.setdefault(s.trace_id, []).append(s.to_dict())
+        return list(by_trace.values())[-limit:]
+
+
+class JsonlSink:
+    """Append one JSON line per completed span to a file — the structured
+    successor of the bash engine's ``set -x`` log. Enable with
+    ``CC_TRACE_FILE=/var/log/tpu-cc-trace.jsonl``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def __call__(self, span: Span) -> None:
+        line = json.dumps(span.to_dict(), sort_keys=True)
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+
+
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    """Swap the process-wide tracer (tests use this for isolation)."""
+    global _default
+    _default = tracer or Tracer()
