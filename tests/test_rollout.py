@@ -1,0 +1,366 @@
+"""Operator-side rolling mode changes (tpu_cc_manager.rollout).
+
+The reference has no pool-level orchestration (admins label nodes by
+hand, reference README_PYTHON.md:77-102); these tests cover the rollout
+tool built for BASELINE config 3 ("rolling CC enable").
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.modes import InvalidModeError
+from tpu_cc_manager.rollout import GroupResult, Rollout, RolloutError
+
+
+def _node(name, desired=None, state=None, slice_id=None):
+    labels = {L.TPU_ACCELERATOR_LABEL: "tpu-v5e-slice"}
+    if desired:
+        labels[L.CC_MODE_LABEL] = desired
+    if state:
+        labels[L.CC_MODE_STATE_LABEL] = state
+    if slice_id:
+        labels[L.TPU_SLICE_LABEL] = slice_id
+    return make_node(name, labels=labels)
+
+
+def _pool(kube, *nodes):
+    for n in nodes:
+        kube.add_node(n)
+
+
+def test_plan_groups_slices_and_singletons():
+    groups = Rollout.plan_groups([
+        _node("b1", slice_id="s-beta"),
+        _node("a2", slice_id="s-alpha"),
+        _node("a1", slice_id="s-alpha"),
+        _node("z-solo"),
+        _node("a-solo"),
+    ])
+    assert groups == [
+        ("slice/s-alpha", ["a1", "a2"]),
+        ("slice/s-beta", ["b1"]),
+        ("node/a-solo", ["a-solo"]),
+        ("node/z-solo", ["z-solo"]),
+    ]
+
+
+def test_invalid_mode_rejected_before_any_patch():
+    with pytest.raises(InvalidModeError):
+        Rollout(FakeKube(), "bogus")
+
+
+def test_empty_selector_refused():
+    with pytest.raises(RolloutError, match="no nodes"):
+        Rollout(FakeKube(), "on").run()
+
+
+def test_dry_run_plans_without_patching():
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("n1", desired="off", state="off"),
+        _node("n2", desired="on", state="on"),
+    )
+    report = Rollout(kube, "on", dry_run=True).run()
+    by_name = {g.name: g for g in report.groups}
+    assert by_name["node/n1"].outcome == "planned"
+    assert by_name["node/n2"].outcome == "skipped"
+    # nothing patched
+    assert (
+        kube.get_node("n1")["metadata"]["labels"][L.CC_MODE_LABEL] == "off"
+    )
+    assert report.ok
+
+
+def test_preflight_refuses_broken_fleet():
+    kube = FakeKube()
+    _pool(kube, _node("n1", desired="off", state="failed"))
+    with pytest.raises(RolloutError, match="failed nodes"):
+        Rollout(kube, "on").run()
+    # force overrides; group converges once the 'agent' recovers
+    done = threading.Event()
+
+    def fake_agent():
+        while not done.is_set():
+            labels = kube.get_node("n1")["metadata"]["labels"]
+            if labels.get(L.CC_MODE_LABEL) == "on":
+                kube.set_node_labels("n1", {L.CC_MODE_STATE_LABEL: "on"})
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=fake_agent, daemon=True)
+    t.start()
+    try:
+        report = Rollout(kube, "on", force=True, poll_s=0.02,
+                         group_timeout_s=10).run()
+    finally:
+        done.set()
+        t.join(timeout=2)
+    assert report.ok and report.succeeded == ["node/n1"]
+
+
+class _ReactiveAgents(threading.Thread):
+    """Simulated per-node agents: when a node's desired label changes,
+    publish the observed state after a small delay (or 'failed' for nodes
+    in fail_nodes). Records the order in which groups converged."""
+
+    def __init__(self, kube, node_names, fail_nodes=(), delay_s=0.05):
+        super().__init__(daemon=True)
+        self.kube = kube
+        self.node_names = list(node_names)
+        self.fail_nodes = set(fail_nodes)
+        self.delay_s = delay_s
+        self.stop = threading.Event()
+        self.converge_times = {}
+
+    def run(self):
+        while not self.stop.is_set():
+            for name in self.node_names:
+                labels = self.kube.get_node(name)["metadata"]["labels"]
+                desired = labels.get(L.CC_MODE_LABEL)
+                state = labels.get(L.CC_MODE_STATE_LABEL)
+                if desired and state != desired and state != "failed":
+                    time.sleep(self.delay_s)
+                    value = (
+                        "failed" if name in self.fail_nodes else desired
+                    )
+                    self.kube.set_node_labels(
+                        name, {L.CC_MODE_STATE_LABEL: value}
+                    )
+                    self.converge_times[name] = time.monotonic()
+            time.sleep(0.01)
+
+
+def test_rolling_window_serializes_groups():
+    """max_unavailable=1: the second slice's desired label must not be
+    patched until the first slice fully converged."""
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("a1", desired="off", state="off", slice_id="s-a"),
+        _node("a2", desired="off", state="off", slice_id="s-a"),
+        _node("b1", desired="off", state="off", slice_id="s-b"),
+        _node("b2", desired="off", state="off", slice_id="s-b"),
+    )
+    patch_times = {}
+    orig = kube.set_node_labels
+
+    def recording_set(name, labels):
+        if L.CC_MODE_LABEL in labels:
+            patch_times[name] = time.monotonic()
+        return orig(name, labels)
+
+    kube.set_node_labels = recording_set
+    agents = _ReactiveAgents(kube, ["a1", "a2", "b1", "b2"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", max_unavailable=1, poll_s=0.02,
+                         group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.ok
+    assert set(report.succeeded) == {"slice/s-a", "slice/s-b"}
+    # both members of s-a converged before either member of s-b was patched
+    s_a_done = max(agents.converge_times["a1"], agents.converge_times["a2"])
+    s_b_start = min(patch_times["b1"], patch_times["b2"])
+    assert s_a_done <= s_b_start
+
+
+def test_window_2_runs_groups_concurrently():
+    kube = FakeKube()
+    _pool(
+        kube,
+        *[_node(f"n{i}", desired="off", state="off") for i in range(4)],
+    )
+    patch_times = {}
+    orig = kube.set_node_labels
+
+    def recording_set(name, labels):
+        if L.CC_MODE_LABEL in labels:
+            patch_times[name] = time.monotonic()
+        return orig(name, labels)
+
+    kube.set_node_labels = recording_set
+    agents = _ReactiveAgents(kube, [f"n{i}" for i in range(4)], delay_s=0.2)
+    agents.start()
+    try:
+        report = Rollout(kube, "on", max_unavailable=2, poll_s=0.02,
+                         group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.ok
+    # first two launches happen together, before any node converged
+    t0, t1 = sorted(patch_times.values())[:2]
+    first_converge = min(agents.converge_times.values())
+    assert t1 <= first_converge
+
+
+def test_failure_budget_aborts_rollout():
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("f1", desired="off", state="off"),
+        _node("g1", desired="off", state="off"),
+        _node("h1", desired="off", state="off"),
+    )
+    agents = _ReactiveAgents(kube, ["f1", "g1", "h1"], fail_nodes={"f1"})
+    agents.start()
+    try:
+        report = Rollout(kube, "on", max_unavailable=1, poll_s=0.02,
+                         group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.aborted and not report.ok
+    by_name = {g.name: g for g in report.groups}
+    assert by_name["node/f1"].outcome == "failed"
+    # groups after the failure were never attempted
+    untouched = [
+        g for g in report.groups if g.outcome == "not_attempted"
+    ]
+    assert len(untouched) == 2
+    for g in untouched:
+        labels = kube.get_node(g.nodes[0])["metadata"]["labels"]
+        assert labels.get(L.CC_MODE_LABEL) == "off"
+
+
+def test_failure_budget_allows_continuing():
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("f1", desired="off", state="off"),
+        _node("g1", desired="off", state="off"),
+    )
+    agents = _ReactiveAgents(kube, ["f1", "g1"], fail_nodes={"f1"})
+    agents.start()
+    try:
+        report = Rollout(kube, "on", failure_budget=1, poll_s=0.02,
+                         group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert not report.aborted
+    assert report.failed == ["node/f1"]
+    assert report.succeeded == ["node/g1"]
+    assert not report.ok  # failures still fail the rollout exit code
+
+
+def test_partial_launch_rolls_back_slice():
+    """If patching a slice member fails mid-launch, already-patched
+    members are reverted — a slice never gets incoherent desired labels."""
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("s1", desired="off", state="off", slice_id="s-x"),
+        _node("s2", desired="off", state="off", slice_id="s-x"),
+    )
+    from tpu_cc_manager.k8s.client import ApiException
+
+    orig = kube.set_node_labels
+
+    def failing_set(name, labels):
+        if name == "s2" and labels.get(L.CC_MODE_LABEL) == "on":
+            raise ApiException(500, "injected patch failure")
+        return orig(name, labels)
+
+    kube.set_node_labels = failing_set
+    report = Rollout(kube, "on", poll_s=0.02, group_timeout_s=5).run()
+    assert report.failed == ["slice/s-x"]
+    # s1 was patched first, then rolled back to 'off'
+    assert (
+        kube.get_node("s1")["metadata"]["labels"][L.CC_MODE_LABEL] == "off"
+    )
+
+
+def test_dry_run_allowed_on_broken_fleet():
+    kube = FakeKube()
+    _pool(kube, _node("n1", desired="off", state="failed"))
+    report = Rollout(kube, "on", dry_run=True).run()
+    assert report.preflight["failed"] == ["n1"]
+    assert {g.outcome for g in report.groups} == {"planned"}
+
+
+def test_group_timeout():
+    kube = FakeKube()
+    _pool(kube, _node("slow", desired="off", state="off"))
+    # no agent running: nobody ever publishes the state label
+    report = Rollout(kube, "on", poll_s=0.02, group_timeout_s=0.2).run()
+    assert report.failed == ["node/slow"]
+    by_name = {g.name: g for g in report.groups}
+    assert by_name["node/slow"].outcome == "timeout"
+
+
+def test_cli_rollout_dry_run(capsys):
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    import tpu_cc_manager.__main__ as cli
+
+    with FakeApiServer() as srv:
+        srv.store.add_node(_node("n1", desired="off", state="off"))
+        kubeconfig = None
+        # point the CLI at the fake server via a kubeconfig file
+        import tempfile, textwrap, os
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".yaml", delete=False
+        ) as f:
+            f.write(textwrap.dedent(f"""\
+                apiVersion: v1
+                kind: Config
+                current-context: t
+                contexts: [{{name: t, context: {{cluster: c, user: u}}}}]
+                clusters: [{{name: c, cluster: {{server: "{srv.url}"}}}}]
+                users: [{{name: u, user: {{}}}}]
+            """))
+            kubeconfig = f.name
+        try:
+            rc = cli.main([
+                "--kubeconfig", kubeconfig, "rollout", "-m", "on",
+                "--dry-run",
+            ])
+        finally:
+            os.unlink(kubeconfig)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"outcome": "planned"' in out
+    assert '"mode": "on"' in out
+
+
+def test_real_agents_rolling_enable(tmp_path):
+    """End-to-end BASELINE config 3 shape: real agents on 4 nodes, rolling
+    CC enable with window 1 — uses the same agent harness as the
+    multi-node simulation."""
+    from tests.test_multinode import SimNode, _wait
+
+    kube = FakeKube()
+    sims = [SimNode(kube, f"r-{i}", tmp_path, label="off") for i in range(4)]
+    for s in sims:
+        s.start()
+    try:
+        assert _wait(
+            lambda: all(
+                kube.get_node(f"r-{i}")["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL
+                ) == "off"
+                for i in range(4)
+            )
+        )
+        report = Rollout(
+            kube, "on",
+            selector=L.TPU_ACCELERATOR_LABEL,
+            max_unavailable=1, poll_s=0.05, group_timeout_s=30,
+        ).run()
+        assert report.ok
+        assert len(report.succeeded) == 4
+        assert all(
+            c.query_cc_mode() == "on" for s in sims for c in s.backend.chips
+        )
+    finally:
+        for s in sims:
+            s.stop()

@@ -7,7 +7,9 @@ Modes of operation (parity with both reference CLIs):
 - ``set-cc-mode -m <mode>``: one-shot engine invocation, the bash-engine
   CLI surface (reference scripts/cc-manager.sh:472-533) — this is also
   what the native C++ agent execs per reconcile;
-- ``get-cc-mode``: print per-device modes as JSON.
+- ``get-cc-mode``: print per-device modes as JSON;
+- ``rollout -m <mode>``: operator-side rolling mode change across the
+  pool (new vs the reference — see tpu_cc_manager.rollout).
 """
 
 from __future__ import annotations
@@ -39,6 +41,28 @@ def main(argv=None) -> int:
                             evict_components=False)
         print(json.dumps(engine.get_modes(), indent=2, sort_keys=True))
         return 0
+
+    if args.command == "rollout":
+        from tpu_cc_manager.modes import InvalidModeError
+        from tpu_cc_manager.rollout import Rollout, RolloutError
+
+        try:
+            rollout = Rollout(
+                _kube_client(cfg),
+                args.mode,
+                selector=args.selector,
+                max_unavailable=args.max_unavailable,
+                failure_budget=args.failure_budget,
+                group_timeout_s=args.group_timeout,
+                force=args.force,
+                dry_run=args.dry_run,
+            )
+            report = rollout.run()
+        except (InvalidModeError, RolloutError) as e:
+            log.error("rollout refused: %s", e)
+            return 1
+        print(report.to_json())
+        return 0 if report.ok else 1
 
     if args.command == "set-cc-mode":
         kube = _kube_client(cfg)

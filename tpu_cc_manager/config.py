@@ -29,6 +29,8 @@ import dataclasses
 import os
 from typing import List, Optional
 
+from tpu_cc_manager import labels as L
+
 #: Readiness file signalling "initial reconcile done" to the validation
 #: framework (reference main.py:64: /run/nvidia/validations/...).
 DEFAULT_READINESS_FILE = "/run/tpu/validations/.cc-manager-ctr-ready"
@@ -106,6 +108,37 @@ def build_parser() -> argparse.ArgumentParser:
         help="operate on all devices (the only supported scope)",
     )
     sub.add_parser("get-cc-mode", help="print per-device modes and exit")
+    roll = sub.add_parser(
+        "rollout",
+        help="roll a mode change across the pool, bounded by a "
+             "disruption window (operator-side; no NODE_NAME needed)",
+    )
+    roll.add_argument("-m", "--mode", required=True)
+    roll.add_argument(
+        "--selector",
+        default=L.TPU_ACCELERATOR_LABEL,
+        help="label selector scoping the pool",
+    )
+    roll.add_argument(
+        "--max-unavailable", type=int, default=1,
+        help="slice groups in flight at once (default 1)",
+    )
+    roll.add_argument(
+        "--failure-budget", type=int, default=0,
+        help="failed groups tolerated before aborting (default 0)",
+    )
+    roll.add_argument(
+        "--group-timeout", type=float, default=600.0,
+        help="seconds to wait for one group to converge (default 600)",
+    )
+    roll.add_argument(
+        "--force", action="store_true",
+        help="proceed despite failed nodes / half-flipped slices",
+    )
+    roll.add_argument(
+        "--dry-run", action="store_true",
+        help="print the group plan without patching anything",
+    )
     return p
 
 
@@ -113,7 +146,7 @@ def parse_config(argv: Optional[List[str]] = None):
     """-> (AgentConfig, parsed_args). Validates NODE_NAME presence like the
     reference (cmd/main.go:109-115, main.py:737-739)."""
     args = build_parser().parse_args(argv)
-    if not args.node_name and args.command != "get-cc-mode":
+    if not args.node_name and args.command not in ("get-cc-mode", "rollout"):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"
         )

@@ -1,0 +1,361 @@
+"""Rolling pool-wide mode changes — the operator-side orchestrator.
+
+The reference has no pool-level tooling at all: an admin labels nodes by
+hand (reference README_PYTHON.md:77-102) and every agent flips the moment
+it sees its label, so a pool-wide change takes the whole pool's TPU
+workloads down at once. This module adds the controlled rollout BASELINE
+config 3 describes ("4-node v5e GKE pool: rolling CC enable with pod
+eviction"): patch desired-state labels group by group, bounded by a
+disruption window, watching the observed-state labels the agents publish.
+
+Semantics:
+
+- **Unit of rollout = slice group.** All member nodes of a multi-host
+  slice receive the desired label in the same step — a slice flips
+  coherently (tpu_cc_manager.slice_coord), so staggering its members
+  would just park the early ones in ``slice_wait``. Nodes without a
+  slice label are singleton groups.
+- **Window.** Up to ``max_unavailable`` groups are in flight at once. A
+  group completes when every member's ``cc.mode.state`` label reaches
+  the target mode; it fails when any member publishes ``failed`` or the
+  group times out.
+- **Failure budget.** Each failed group consumes budget; when exhausted,
+  no further groups launch (in-flight groups drain), remaining groups
+  are reported ``not_attempted``, and the rollout is ``aborted``.
+- **Preflight.** The JAX fleet planner (tpu_cc_manager.plan) audits the
+  pool first; failed nodes or half-flipped slices fail fast unless
+  ``force`` — rolling a new mode over a broken fleet only hides the
+  breakage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.modes import parse_mode
+from tpu_cc_manager.plan import analyze_fleet
+
+log = logging.getLogger("tpu-cc-manager.rollout")
+
+
+class RolloutError(Exception):
+    """Preflight or configuration problem; nothing was patched."""
+
+
+@dataclasses.dataclass
+class GroupResult:
+    name: str
+    nodes: List[str]
+    #: skipped | planned | succeeded | failed | timeout | not_attempted
+    outcome: str
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "nodes": self.nodes, "outcome": self.outcome}
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+
+@dataclasses.dataclass
+class RolloutReport:
+    mode: str
+    groups: List[GroupResult]
+    aborted: bool
+    preflight: dict
+
+    @property
+    def failed(self) -> List[str]:
+        return [g.name for g in self.groups if g.outcome in ("failed", "timeout")]
+
+    @property
+    def succeeded(self) -> List[str]:
+        return [g.name for g in self.groups if g.outcome == "succeeded"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.aborted and not self.failed
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode,
+            "ok": self.ok,
+            "aborted": self.aborted,
+            "groups": [g.to_dict() for g in self.groups],
+            "preflight": self.preflight,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+class Rollout:
+    def __init__(
+        self,
+        kube: KubeClient,
+        mode: str,
+        *,
+        selector: str = L.TPU_ACCELERATOR_LABEL,
+        max_unavailable: int = 1,
+        failure_budget: int = 0,
+        group_timeout_s: float = 600.0,
+        poll_s: float = 0.5,
+        force: bool = False,
+        dry_run: bool = False,
+    ):
+        self.kube = kube
+        self.mode = parse_mode(mode).value  # reject bad input before any patch
+        self.selector = selector
+        if max_unavailable < 1:
+            raise RolloutError("max_unavailable must be >= 1")
+        self.max_unavailable = max_unavailable
+        self.failure_budget = failure_budget
+        self.group_timeout_s = group_timeout_s
+        self.poll_s = poll_s
+        self.force = force
+        self.dry_run = dry_run
+
+    # ------------------------------------------------------------ planning
+    def discover(self) -> List[dict]:
+        nodes = self.kube.list_nodes(self.selector)
+        if not nodes:
+            raise RolloutError(
+                f"no nodes match selector {self.selector!r}; nothing to roll"
+            )
+        return nodes
+
+    @staticmethod
+    def plan_groups(nodes: Sequence[dict]) -> List[Tuple[str, List[str]]]:
+        """Slice-aware grouping: one group per slice, singletons for
+        unsliced nodes; deterministic order (slices first, by name)."""
+        slices: Dict[str, List[str]] = {}
+        solo: List[str] = []
+        for node in nodes:
+            meta = node["metadata"]
+            slice_id = meta.get("labels", {}).get(L.TPU_SLICE_LABEL)
+            if slice_id:
+                slices.setdefault(slice_id, []).append(meta["name"])
+            else:
+                solo.append(meta["name"])
+        groups = [
+            (f"slice/{s}", sorted(members))
+            for s, members in sorted(slices.items())
+        ]
+        groups += [(f"node/{n}", [n]) for n in sorted(solo)]
+        return groups
+
+    def _converged(self, node: dict) -> bool:
+        labels = node["metadata"].get("labels", {})
+        return (
+            labels.get(L.CC_MODE_LABEL) == self.mode
+            and labels.get(L.CC_MODE_STATE_LABEL) == self.mode
+        )
+
+    # ------------------------------------------------------------- running
+    def run(self) -> RolloutReport:
+        nodes = self.discover()
+        preflight = analyze_fleet(nodes)
+        blockers = []
+        if preflight["failed"]:
+            blockers.append(f"failed nodes: {preflight['failed']}")
+        if preflight["half_flipped_slices"]:
+            blockers.append(
+                f"half-flipped slices: {preflight['half_flipped_slices']}"
+            )
+        if blockers and not self.force and not self.dry_run:
+            # dry-run is read-only: always allowed to show the plan (the
+            # blockers are visible in the report's preflight section)
+            raise RolloutError(
+                "preflight found a broken fleet (" + "; ".join(blockers) +
+                "); fix it or pass --force"
+            )
+
+        by_name = {n["metadata"]["name"]: n for n in nodes}
+        results: List[GroupResult] = []
+        pending = deque()
+        for gname, members in self.plan_groups(nodes):
+            if all(self._converged(by_name[m]) for m in members):
+                results.append(
+                    GroupResult(gname, members, "skipped",
+                                f"already at {self.mode}")
+                )
+            elif self.dry_run:
+                results.append(GroupResult(gname, members, "planned"))
+            else:
+                pending.append((gname, members))
+
+        report = RolloutReport(self.mode, results, aborted=False,
+                               preflight=preflight)
+        if self.dry_run or not pending:
+            report.groups.sort(key=lambda g: g.name)
+            return report
+
+        log.info(
+            "rolling %d group(s) to %r, window %d, budget %d",
+            len(pending), self.mode, self.max_unavailable,
+            self.failure_budget,
+        )
+        budget = self.failure_budget
+        in_flight: Dict[str, Tuple[List[str], float, set]] = {}
+        while pending or in_flight:
+            while (
+                pending
+                and budget >= 0
+                and not report.aborted
+                and len(in_flight) < self.max_unavailable
+            ):
+                gname, members = pending.popleft()
+                # a node already showing 'failed' at launch (--force over a
+                # broken fleet) can't fail fast: the agent re-publishing
+                # the same value is invisible, so for those members only
+                # convergence or the group timeout decides
+                stale_failed = {
+                    m for m in members
+                    if by_name[m]["metadata"].get("labels", {}).get(
+                        L.CC_MODE_STATE_LABEL
+                    ) == "failed"
+                }
+                if self._launch(gname, members, by_name):
+                    in_flight[gname] = (
+                        members,
+                        time.monotonic() + self.group_timeout_s,
+                        stale_failed,
+                    )
+                else:
+                    results.append(
+                        GroupResult(gname, members, "failed",
+                                    "desired-label patch failed")
+                    )
+                    budget -= 1
+
+            if in_flight:
+                # ONE list per tick serves every in-flight group (and
+                # refreshes the snapshot used for launch bookkeeping)
+                try:
+                    by_name = {
+                        n["metadata"]["name"]: n
+                        for n in self.kube.list_nodes(self.selector)
+                    }
+                    fresh = True
+                except ApiException as e:
+                    log.warning("pool poll failed: %s", e)
+                    fresh = False
+                for gname in list(in_flight):
+                    members, deadline, stale_failed = in_flight[gname]
+                    outcome = self._judge_group(
+                        gname, members, deadline, stale_failed,
+                        by_name if fresh else None,
+                    )
+                    if outcome is None:
+                        continue
+                    del in_flight[gname]
+                    results.append(outcome)
+                    if outcome.outcome in ("failed", "timeout"):
+                        budget -= 1
+
+            if budget < 0 and not report.aborted:
+                report.aborted = True
+                log.error(
+                    "failure budget exhausted; draining %d in-flight "
+                    "group(s), %d pending group(s) not attempted",
+                    len(in_flight), len(pending),
+                )
+            if report.aborted and pending:
+                for gname, members in pending:
+                    results.append(
+                        GroupResult(gname, members, "not_attempted",
+                                    "rollout aborted")
+                    )
+                pending.clear()
+            if in_flight:
+                time.sleep(self.poll_s)
+
+        report.groups.sort(key=lambda g: g.name)
+        return report
+
+    def _launch(
+        self, gname: str, members: List[str], by_name: Dict[str, dict]
+    ) -> bool:
+        """Patch the desired-state label on every member of one group.
+
+        All-or-nothing per group: on a partial failure the already-patched
+        members are rolled back to their previous desired label —
+        otherwise a multi-host slice would be left with incoherent desired
+        state (agents parked in slice_wait) and the disruption would
+        exceed the window, the exact states the preflight exists to block.
+        """
+        log.info("launching group %s (%s) -> %r", gname, members, self.mode)
+        patched: List[str] = []
+        for m in members:
+            try:
+                self.kube.set_node_labels(m, {L.CC_MODE_LABEL: self.mode})
+                patched.append(m)
+            except ApiException as e:
+                log.error("could not label %s: %s", m, e)
+                for p in patched:
+                    prev = by_name[p]["metadata"].get("labels", {}).get(
+                        L.CC_MODE_LABEL
+                    )
+                    try:
+                        self.kube.set_node_labels(
+                            p, {L.CC_MODE_LABEL: prev}
+                        )
+                    except ApiException as e2:  # best effort; keep going
+                        log.error(
+                            "rollback of %s to %r failed: %s", p, prev, e2
+                        )
+                return False
+        return True
+
+    def _judge_group(
+        self,
+        gname: str,
+        members: List[str],
+        deadline: float,
+        stale_failed: frozenset = frozenset(),
+        by_name: Optional[Dict[str, dict]] = None,
+    ) -> Optional[GroupResult]:
+        """None = still in flight; otherwise the terminal GroupResult.
+        ``by_name`` is this tick's pool snapshot (None = the poll failed;
+        only the deadline is checked)."""
+        if by_name is None:
+            if time.monotonic() >= deadline:
+                return GroupResult(
+                    gname, members, "timeout",
+                    f"no convergence within {self.group_timeout_s:.0f}s "
+                    "(pool poll failing)",
+                )
+            return None  # transient: retry next tick
+        states = {
+            m: by_name.get(m, {}).get("metadata", {}).get("labels", {}).get(
+                L.CC_MODE_STATE_LABEL
+            )
+            for m in members
+        }
+        bad = [
+            m for m, s in states.items()
+            if s == "failed" and m not in stale_failed
+        ]
+        if bad:
+            return GroupResult(
+                gname, members, "failed",
+                f"agent(s) reported failed state: {sorted(bad)}",
+            )
+        if all(s == self.mode for s in states.values()):
+            log.info("group %s converged to %r", gname, self.mode)
+            return GroupResult(gname, members, "succeeded")
+        if time.monotonic() >= deadline:
+            lag = sorted(m for m, s in states.items() if s != self.mode)
+            return GroupResult(
+                gname, members, "timeout",
+                f"no convergence within {self.group_timeout_s:.0f}s; "
+                f"lagging: {lag}",
+            )
+        return None
