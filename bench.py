@@ -125,6 +125,23 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         round_times.append(t1 - t0)
     elapsed = time.monotonic() - t_bench0
 
+    # rolling-update scenario (BASELINE config 3 shape at pool scale):
+    # roll the whole pool back to "on" with a bounded disruption window
+    from tpu_cc_manager.rollout import Rollout
+
+    roll_kube = HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False)
+    )
+    t_roll0 = time.monotonic()
+    roll_report = Rollout(
+        roll_kube, "on",
+        max_unavailable=8, poll_s=0.02, group_timeout_s=60,
+    ).run()
+    rollout_s = time.monotonic() - t_roll0
+    if not roll_report.ok:
+        print("FATAL: rollout scenario failed", file=sys.stderr)
+        sys.exit(1)
+
     for a in agents:
         a.shutdown()
     server.stop()
@@ -142,6 +159,7 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
             "pool_convergence_s": round(pool_convergence, 4),
             "node_reconcile_p95_s": round(p95, 4),
             "flips_per_min": round(flips_per_min, 1),
+            "rollout_window8_s": round(rollout_s, 4),
             "nodes": n_nodes,
             "rounds": rounds,
             "baseline_target": "pool-wide reconcile < 60 s on 32 nodes (BASELINE.md)",
