@@ -15,10 +15,13 @@ from tpu_cc_manager.k8s.objects import make_node, make_pod
 
 
 class SimNode:
-    def __init__(self, kube, name, tmp_path, label=None, n_chips=4):
+    def __init__(self, kube, name, tmp_path, label=None, n_chips=4,
+                 slice_id=None, coordinate=False):
         node_labels = {L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice"}
         if label:
             node_labels[L.CC_MODE_LABEL] = label
+        if slice_id:
+            node_labels[L.TPU_SLICE_LABEL] = slice_id
         kube.add_node(make_node(name, labels=node_labels))
         self.backend = fake_backend(n_chips=n_chips)
         cfg = AgentConfig(
@@ -28,7 +31,16 @@ class SimNode:
             health_port=0,
             drain_strategy="none",
         )
-        self.agent = CCManagerAgent(kube, cfg, backend=self.backend)
+        coordinator = None
+        if coordinate:
+            from tpu_cc_manager.slice_coord import SliceCoordinator
+
+            coordinator = SliceCoordinator(
+                kube, name, poll_s=0.05, commit_timeout_s=30, hb_ttl_s=3
+            )
+        self.agent = CCManagerAgent(
+            kube, cfg, backend=self.backend, slice_coordinator=coordinator
+        )
         self.agent.watcher.watch_timeout_s = 2
         self.agent.watcher.backoff_s = 0.05
         self.thread = None

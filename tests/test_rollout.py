@@ -332,6 +332,55 @@ def test_cli_rollout_dry_run(capsys):
     assert '"mode": "on"' in out
 
 
+def test_rollout_divergent_slice_policies_full_stack(tmp_path):
+    """BASELINE config 5, full stack: two 2-node slices with slice
+    coordination enabled, driven to DIVERGENT modes by two rollouts. Each
+    slice flips coherently (two-phase protocol) while holding a different
+    policy than its neighbor."""
+    from tests.test_multinode import SimNode, _wait
+
+    kube = FakeKube()
+    sims = [
+        SimNode(kube, n, tmp_path, label="off", slice_id=s, coordinate=True)
+        for n, s in [
+            ("a0", "s-a"), ("a1", "s-a"), ("b0", "s-b"), ("b1", "s-b"),
+        ]
+    ]
+    for s in sims:
+        s.start()
+    try:
+        assert _wait(
+            lambda: all(
+                kube.get_node(n)["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL
+                ) == "off"
+                for n in ("a0", "a1", "b0", "b1")
+            )
+        )
+        rep_a = Rollout(
+            kube, "on", selector=f"{L.TPU_SLICE_LABEL}=s-a",
+            poll_s=0.05, group_timeout_s=30,
+        ).run()
+        rep_b = Rollout(
+            kube, "devtools", selector=f"{L.TPU_SLICE_LABEL}=s-b",
+            poll_s=0.05, group_timeout_s=30,
+        ).run()
+        assert rep_a.ok and rep_a.succeeded == ["slice/s-a"]
+        assert rep_b.ok and rep_b.succeeded == ["slice/s-b"]
+        by = {s.agent.cfg.node_name: s for s in sims}
+        assert all(
+            c.query_cc_mode() == "on"
+            for n in ("a0", "a1") for c in by[n].backend.chips
+        )
+        assert all(
+            c.query_cc_mode() == "devtools"
+            for n in ("b0", "b1") for c in by[n].backend.chips
+        )
+    finally:
+        for s in sims:
+            s.stop()
+
+
 def test_real_agents_rolling_enable(tmp_path):
     """End-to-end BASELINE config 3 shape: real agents on 4 nodes, rolling
     CC enable with window 1 — uses the same agent harness as the
