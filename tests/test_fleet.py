@@ -1,0 +1,139 @@
+"""Fleet controller: periodic pool audit served as metrics + report."""
+
+import json
+import threading
+import time
+import urllib.request
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.fleet import FleetController
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+
+
+def _node(name, desired=None, state=None, slice_id=None):
+    labels = {L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice"}
+    if desired:
+        labels[L.CC_MODE_LABEL] = desired
+    if state:
+        labels[L.CC_MODE_STATE_LABEL] = state
+    if slice_id:
+        labels[L.TPU_SLICE_LABEL] = slice_id
+    return make_node(name, labels=labels)
+
+
+def _mixed_fleet():
+    kube = FakeKube()
+    # 2 converged, 1 divergent, 1 failed, one half-flipped 2-node slice
+    kube.add_node(_node("ok-1", desired="on", state="on"))
+    kube.add_node(_node("ok-2", desired="off", state="off"))
+    kube.add_node(_node("lag-1", desired="on", state="off"))
+    kube.add_node(_node("bad-1", desired="on", state="failed"))
+    kube.add_node(_node("s1-a", desired="on", state="on", slice_id="s1"))
+    kube.add_node(_node("s1-b", desired="on", state="off", slice_id="s1"))
+    return kube
+
+
+def test_scan_once_updates_metrics_and_report():
+    ctrl = FleetController(_mixed_fleet())
+    report = ctrl.scan_once()
+    assert report["nodes"] == 6
+    # divergent: lag-1, bad-1 (failed != on), s1-b
+    assert set(report["needs_flip"]) == {"lag-1", "bad-1", "s1-b"}
+    assert report["failed"] == ["bad-1"]
+    assert report["half_flipped_slices"] == ["s1"]
+    m = ctrl.metrics
+    assert m.nodes.value() == 6
+    assert m.needs_flip.value() == 3
+    assert m.failed.value() == 1
+    assert m.half_flipped_slices.value() == 1
+    assert m.nodes_by_mode.value("on") == 2  # ok-1, s1-a
+    assert m.scans_total.value("success") == 1
+
+
+def test_metrics_zero_out_vanished_modes():
+    kube = FakeKube()
+    kube.add_node(_node("n", desired="on", state="on"))
+    ctrl = FleetController(kube)
+    ctrl.scan_once()
+    assert ctrl.metrics.nodes_by_mode.value("on") == 1
+    kube.set_node_labels("n", {L.CC_MODE_STATE_LABEL: "off"})
+    ctrl.scan_once()
+    assert ctrl.metrics.nodes_by_mode.value("on") == 0
+    assert ctrl.metrics.nodes_by_mode.value("off") == 1
+
+
+def test_http_endpoints_and_run_loop():
+    ctrl = FleetController(_mixed_fleet(), interval_s=0.05, port=0)
+    t = threading.Thread(target=ctrl.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while ctrl.last_report is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ctrl.last_report is not None
+        base = f"http://127.0.0.1:{ctrl.port}"
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(f"{base}/report") as r:
+            report = json.load(r)
+        assert report["nodes"] == 6
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            text = r.read().decode()
+        assert "tpu_cc_fleet_nodes 6" in text
+        assert 'tpu_cc_fleet_nodes_by_mode{mode="failed"} 1' in text
+        assert "tpu_cc_fleet_half_flipped_slices 1" in text
+        try:
+            urllib.request.urlopen(f"{base}/metrics/bogus")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        ctrl.stop()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+
+def test_persistent_api_failure_exits_unhealthy():
+    from tpu_cc_manager.k8s.client import ApiException
+
+    kube = FakeKube()
+    kube.add_node(_node("n"))
+
+    calls = {"n": 0}
+    orig = kube.list_nodes
+
+    def flaky(selector=None):
+        calls["n"] += 1
+        raise ApiException(500, "injected outage")
+
+    kube.list_nodes = flaky
+    ctrl = FleetController(
+        kube, interval_s=0.01, port=0, max_consecutive_errors=3
+    )
+    rc = ctrl.run()
+    assert rc == 1
+    assert calls["n"] == 3
+    assert not ctrl.healthy
+
+
+def test_rejects_nonpositive_interval():
+    import pytest
+
+    with pytest.raises(ValueError, match="interval"):
+        FleetController(FakeKube(), interval_s=0)
+
+
+def test_report_503_before_first_scan():
+    ctrl = FleetController(FakeKube(), port=0)
+    ctrl._server.start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ctrl.port}/report"
+            )
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        ctrl.stop()

@@ -9,7 +9,10 @@ Modes of operation (parity with both reference CLIs):
   what the native C++ agent execs per reconcile;
 - ``get-cc-mode``: print per-device modes as JSON;
 - ``rollout -m <mode>``: operator-side rolling mode change across the
-  pool (new vs the reference — see tpu_cc_manager.rollout).
+  pool (new vs the reference — see tpu_cc_manager.rollout);
+- ``fleet-controller``: long-running read-only fleet audit service
+  (JAX fleet scans served as /metrics + /report — see
+  tpu_cc_manager.fleet).
 """
 
 from __future__ import annotations
@@ -63,6 +66,21 @@ def main(argv=None) -> int:
             return 1
         print(report.to_json())
         return 0 if report.ok else 1
+
+    if args.command == "fleet-controller":
+        from tpu_cc_manager.fleet import FleetController
+
+        try:
+            controller = FleetController(
+                _kube_client(cfg),
+                selector=args.selector,
+                interval_s=args.interval,
+                port=args.port,
+            )
+        except ValueError as e:
+            log.error("fleet-controller refused: %s", e)
+            return 1
+        return controller.run()
 
     if args.command == "set-cc-mode":
         kube = _kube_client(cfg)

@@ -19,6 +19,8 @@ flag                      env                            default
 --health-port             HEALTH_PORT                    8089 (0 disables)
 (none)                    SLICE_COORDINATION             "false"
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
+--interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
+--port (fleet)            FLEET_PORT                     8090
 ========================  =============================  =======================
 """
 
@@ -139,6 +141,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--dry-run", action="store_true",
         help="print the group plan without patching anything",
     )
+    fleet = sub.add_parser(
+        "fleet-controller",
+        help="run the read-only fleet audit service: periodic JAX fleet "
+             "scans served as /metrics + /report (operator-side; no "
+             "NODE_NAME needed)",
+    )
+    fleet.add_argument(
+        "--selector",
+        default=L.TPU_ACCELERATOR_LABEL,
+        help="label selector scoping the fleet",
+    )
+    fleet.add_argument(
+        "--interval", type=float,
+        default=float(os.environ.get("FLEET_SCAN_INTERVAL", "30")),
+        help="seconds between fleet scans (default 30)",
+    )
+    fleet.add_argument(
+        "--port", type=int,
+        default=int(os.environ.get("FLEET_PORT", "8090")),
+        help="HTTP port for /metrics, /report, /healthz (default 8090)",
+    )
     return p
 
 
@@ -146,7 +169,9 @@ def parse_config(argv: Optional[List[str]] = None):
     """-> (AgentConfig, parsed_args). Validates NODE_NAME presence like the
     reference (cmd/main.go:109-115, main.py:737-739)."""
     args = build_parser().parse_args(argv)
-    if not args.node_name and args.command not in ("get-cc-mode", "rollout"):
+    if not args.node_name and args.command not in (
+        "get-cc-mode", "rollout", "fleet-controller"
+    ):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"
         )

@@ -1,0 +1,191 @@
+"""Fleet controller — the long-running operator-side audit service.
+
+The per-node agents make each node converge; the rollout tool changes a
+pool on purpose; this controller answers "what state is the fleet in
+RIGHT NOW" continuously. It periodically lists the pool, runs the JAX
+fleet planner (tpu_cc_manager.plan — one fused XLA computation over the
+whole fleet), and serves:
+
+- ``GET /metrics`` — pool-level Prometheus gauges: nodes per observed
+  mode, divergence count, failed count, incoherent / half-flipped slice
+  counts, scan duration;
+- ``GET /report``  — the latest full fleet report as JSON (the same
+  shape as ``python -m tpu_cc_manager.plan``);
+- ``GET /healthz`` — liveness (scan loop alive and not persistently
+  failing).
+
+Deliberately read-only: remediation stays with the agents (per node)
+and the rollout tool (operator-driven). The reference has no fleet-level
+view at all — its operators grep node labels by hand (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.obs import (
+    OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
+)
+from tpu_cc_manager.plan import analyze_fleet
+
+log = logging.getLogger("tpu-cc-manager.fleet")
+
+
+class FleetMetrics:
+    def __init__(self):
+        self.nodes = Gauge("tpu_cc_fleet_nodes", "Nodes in the fleet")
+        self.nodes_by_mode = Gauge(
+            "tpu_cc_fleet_nodes_by_mode",
+            "Nodes per observed mode", ("mode",),
+        )
+        self.needs_flip = Gauge(
+            "tpu_cc_fleet_needs_flip",
+            "Nodes whose observed mode diverges from desired",
+        )
+        self.failed = Gauge(
+            "tpu_cc_fleet_failed_nodes", "Nodes reporting failed state"
+        )
+        self.incoherent_slices = Gauge(
+            "tpu_cc_fleet_incoherent_slices",
+            "Multi-host slices whose members disagree on desired/observed mode",
+        )
+        self.half_flipped_slices = Gauge(
+            "tpu_cc_fleet_half_flipped_slices",
+            "Multi-host slices stuck mid-flip (some members at target)",
+        )
+        self.scans_total = Counter(
+            "tpu_cc_fleet_scans_total", "Fleet scans, by outcome", ("outcome",)
+        )
+        self.scan_duration = Histogram(
+            "tpu_cc_fleet_scan_duration_seconds",
+            "Wall-clock duration of one fleet scan",
+        )
+
+    def update(self, report: dict) -> None:
+        self.nodes.set(report["nodes"])
+        counts = report["mode_counts"]
+        # the canonical vocabulary, so modes that vanished from the fleet
+        # zero out instead of going stale
+        for mode in OBSERVED_MODE_VALUES:
+            self.nodes_by_mode.set(counts.get(mode, 0), mode)
+        self.needs_flip.set(len(report["needs_flip"]))
+        self.failed.set(len(report["failed"]))
+        self.incoherent_slices.set(len(report["incoherent_slices"]))
+        self.half_flipped_slices.set(len(report["half_flipped_slices"]))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for m in (
+            self.nodes, self.nodes_by_mode, self.needs_flip, self.failed,
+            self.incoherent_slices, self.half_flipped_slices,
+            self.scans_total, self.scan_duration,
+        ):
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class FleetController:
+    def __init__(
+        self,
+        kube: KubeClient,
+        *,
+        selector: str = L.TPU_ACCELERATOR_LABEL,
+        interval_s: float = 30.0,
+        port: int = 8090,
+        max_consecutive_errors: int = 10,
+    ):
+        self.kube = kube
+        self.selector = selector
+        if interval_s <= 0:
+            raise ValueError(
+                f"scan interval must be > 0, got {interval_s!r} "
+                "(a zero interval busy-loops against the API server)"
+            )
+        self.interval_s = interval_s
+        self.max_consecutive_errors = max_consecutive_errors
+        self.metrics = FleetMetrics()
+        self.last_report: Optional[dict] = None
+        self.consecutive_errors = 0
+        self._stop = threading.Event()
+        self._server = RouteServer(port, name="fleet-http")
+        self._server.add_route("/healthz", self._healthz)
+        self._server.add_route("/metrics", self._metrics_route)
+        self._server.add_route("/report", self._report_route)
+
+    # -------------------------------------------------------------- scans
+    def scan_once(self) -> dict:
+        t0 = time.monotonic()
+        try:
+            nodes = self.kube.list_nodes(self.selector)
+            report = analyze_fleet(nodes)
+        except ApiException:
+            self.metrics.scans_total.inc("error")
+            self.consecutive_errors += 1
+            raise
+        self.consecutive_errors = 0
+        self.metrics.scans_total.inc("success")
+        self.metrics.scan_duration.observe(time.monotonic() - t0)
+        self.metrics.update(report)
+        self.last_report = report
+        return report
+
+    @property
+    def healthy(self) -> bool:
+        return self.consecutive_errors < self.max_consecutive_errors
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    # -------------------------------------------------------------- routes
+    def _healthz(self):
+        return ((200, b"ok", "text/plain") if self.healthy
+                else (503, b"unhealthy", "text/plain"))
+
+    def _metrics_route(self):
+        return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
+
+    def _report_route(self):
+        if self.last_report is None:
+            return 503, b"no scan completed yet", "text/plain"
+        body = json.dumps(self.last_report, indent=2, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> int:
+        self._server.start()
+        log.info(
+            "fleet controller serving on :%d (selector %r, every %.0fs)",
+            self.port, self.selector, self.interval_s,
+        )
+        try:
+            while not self._stop.is_set():
+                try:
+                    report = self.scan_once()
+                    log.info(
+                        "fleet scan: %d nodes, %d divergent, %d failed",
+                        report["nodes"], len(report["needs_flip"]),
+                        len(report["failed"]),
+                    )
+                except ApiException as e:
+                    log.warning("fleet scan failed: %s", e)
+                    if not self.healthy:
+                        log.error(
+                            "%d consecutive scan failures; exiting",
+                            self.consecutive_errors,
+                        )
+                        return 1
+                self._stop.wait(self.interval_s)
+            return 0
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()

@@ -22,6 +22,12 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from tpu_cc_manager.modes import STATE_FAILED, VALID_MODES
+
+#: Every value the observed-mode gauge can take — derived from the
+#: canonical vocabulary so new modes can't drift out of the metrics.
+OBSERVED_MODE_VALUES = VALID_MODES + (STATE_FAILED, "unknown")
+
 
 def setup_logging(debug: bool = False) -> None:
     """Timestamped structured-ish logs (reference main.py:54-59 format,
@@ -235,7 +241,7 @@ class Metrics:
         self.phase_duration.observe(span.name, span.dur_s)
 
     def set_current_mode(self, mode: str) -> None:
-        for m in ("on", "off", "devtools", "ici", "failed", "unknown"):
+        for m in OBSERVED_MODE_VALUES:
             self.current_mode.set(1.0 if m == mode else 0.0, m)
 
     def render(self) -> str:
@@ -257,12 +263,34 @@ class Metrics:
 # --------------------------------------------------------------------------
 
 
-class HealthServer:
-    def __init__(self, metrics: Metrics, port: int = 0, tracer=None):
-        self.metrics = metrics
-        self.tracer = tracer
-        self.live = True
-        self.ready = False
+#: A route handler: () -> (status_code, body_bytes, content_type).
+RouteHandler = "Callable[[], Tuple[int, bytes, str]]"
+
+
+class RouteServer:
+    """Minimal threaded HTTP GET server over a route table — the one
+    serving scaffold shared by the agent's HealthServer and the fleet
+    controller (exact-path match, HTTP/1.1 + Content-Length, silent
+    access log, idempotent stop)."""
+
+    def __init__(self, port: int = 0, name: str = "http-server"):
+        self._routes: Dict[str, object] = {}
+        self._name = name
+        self._port = port
+        self.httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_lock = threading.Lock()  # stop() may race from 2 threads
+
+    def add_route(self, path: str, fn) -> None:
+        self._routes[path] = fn
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1] if self.httpd else self._port
+
+    def start(self):
+        """Bind and serve. Binding is deferred to here so constructing a
+        server object never takes the port (raises OSError if taken)."""
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -272,57 +300,64 @@ class HealthServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    self._respond(200 if outer.live else 503,
-                                  b"ok" if outer.live else b"unhealthy")
-                elif self.path == "/readyz":
-                    self._respond(200 if outer.ready else 503,
-                                  b"ready" if outer.ready else b"not ready")
-                elif self.path == "/metrics":
-                    self._respond(
-                        200,
-                        outer.metrics.render().encode(),
-                        "text/plain; version=0.0.4",
-                    )
-                elif self.path == "/debug/traces":
-                    if outer.tracer is None:
-                        self._respond(404, b"tracing not wired")
-                    else:
-                        body = json.dumps(
-                            outer.tracer.recent(), indent=1
-                        ).encode()
-                        self._respond(200, body, "application/json")
+                fn = outer._routes.get(self.path)
+                if fn is None:
+                    code, body, ctype = 404, b"not found", "text/plain"
                 else:
-                    self._respond(404, b"not found")
-
-            def _respond(self, code: int, body: bytes,
-                         ctype: str = "text/plain") -> None:
+                    code, body, ctype = fn()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
-        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", self._port), Handler)
         self.httpd.daemon_threads = True
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self.httpd.server_address[1]
-
-    def start(self) -> "HealthServer":
         self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="health-server", daemon=True
+            target=self.httpd.serve_forever, name=self._name, daemon=True
         )
         self._thread.start()
         return self
 
     def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        with self._stop_lock:
+            httpd, self.httpd = self.httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+
+class HealthServer(RouteServer):
+    def __init__(self, metrics: Metrics, port: int = 0, tracer=None):
+        super().__init__(port, name="health-server")
+        self.metrics = metrics
+        self.tracer = tracer
+        self.live = True
+        self.ready = False
+        self.add_route("/healthz", self._healthz)
+        self.add_route("/readyz", self._readyz)
+        self.add_route("/metrics", self._metrics)
+        self.add_route("/debug/traces", self._traces)
+
+    def _healthz(self):
+        return ((200, b"ok", "text/plain") if self.live
+                else (503, b"unhealthy", "text/plain"))
+
+    def _readyz(self):
+        return ((200, b"ready", "text/plain") if self.ready
+                else (503, b"not ready", "text/plain"))
+
+    def _metrics(self):
+        return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
+
+    def _traces(self):
+        if self.tracer is None:
+            return 404, b"tracing not wired", "text/plain"
+        body = json.dumps(self.tracer.recent(), indent=1).encode()
+        return 200, body, "application/json"
 
 
 def create_readiness_file(path: str) -> None:
