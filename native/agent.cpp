@@ -225,7 +225,25 @@ bool scan_mode_label(const std::string &json, std::string *out) {
 
 /* ------------------------------------------------------------- engine */
 
+/* The engine's mode vocabulary (tpu_cc_manager/modes.py VALID_MODES;
+ * reference scripts/cc-manager.sh:111-123). run_engine validates against
+ * it BEFORE interpolating into the shell command: k8s label-value charset
+ * already forbids shell metacharacters, but the allowlist removes the
+ * whole injection class instead of leaning on that invariant. */
+static const char *kValidModes[] = {"on", "off", "devtools", "ici"};
+
+bool is_valid_mode(const std::string &mode) {
+  for (const char *m : kValidModes)
+    if (mode == m) return true;
+  return false;
+}
+
 int run_engine(const std::string &mode) {
+  if (!is_valid_mode(mode)) {
+    logf("ERROR", "refusing to exec engine for invalid mode '%s'",
+         mode.c_str());
+    return -1;
+  }
   char cmd[1024];
   snprintf(cmd, sizeof(cmd), g_engine_cmd.c_str(), mode.c_str());
   logf("INFO", "reconciling: exec: %s", cmd);

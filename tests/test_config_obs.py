@@ -132,3 +132,41 @@ def test_readiness_file(tmp_path):
     import os
 
     assert os.path.exists(path)
+
+
+def test_histogram_quantile_exact_sliding_window():
+    from tpu_cc_manager.obs import Histogram
+
+    h = Histogram("h", "t")
+    # overflow the window with small values, then fill it with large ones:
+    # the quantile must answer over exactly the last WINDOW observations
+    for _ in range(Histogram.WINDOW):
+        h.observe(0.001)
+    for _ in range(Histogram.WINDOW):
+        h.observe(100.0)
+    assert h.quantile(0.5) == 100.0
+    assert h.quantile(0.0) == 100.0  # no pre-window samples leak in
+    assert h.count == 2 * Histogram.WINDOW  # cumulative count unaffected
+
+
+def test_route_server_handler_exception_returns_500():
+    import urllib.request
+
+    from tpu_cc_manager.obs import RouteServer
+
+    srv = RouteServer(0, name="t-500").start()
+    try:
+        srv.add_route("/boom", lambda: 1 / 0)
+        srv.add_route("/ok", lambda: (200, b"fine", "text/plain"))
+        url = f"http://127.0.0.1:{srv.port}"
+        try:
+            urllib.request.urlopen(f"{url}/boom")
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert b"internal error" in e.read()
+        # server still serves other routes afterwards
+        with urllib.request.urlopen(f"{url}/ok") as r:
+            assert r.read() == b"fine"
+    finally:
+        srv.stop()

@@ -137,3 +137,24 @@ def test_report_503_before_first_scan():
             assert e.code == 503
     finally:
         ctrl.stop()
+
+
+def test_scan_loop_survives_non_api_exceptions():
+    # A malformed node object (analyze_fleet KeyError) must count as a
+    # failed scan and degrade /healthz, not crash the controller process.
+    from tpu_cc_manager.fleet import FleetController
+
+    class BrokenKube:
+        def list_nodes(self, selector=None):
+            return [{"spec": {}}]  # no metadata -> KeyError in analyze
+
+    ctrl = FleetController(BrokenKube(), interval_s=30.0, port=0,
+                           max_consecutive_errors=2)
+    for _ in range(2):
+        try:
+            ctrl.scan_once()
+        except Exception:
+            pass
+    assert ctrl.consecutive_errors == 2
+    assert not ctrl.healthy
+    assert ctrl.metrics.scans_total.value("error") == 2

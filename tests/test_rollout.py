@@ -413,3 +413,84 @@ def test_real_agents_rolling_enable(tmp_path):
     finally:
         for s in sims:
             s.stop()
+
+
+def test_vanished_node_fails_group_fast():
+    # GKE node repair deletes a node mid-rollout: the group must fail
+    # immediately with a distinct detail, not burn the group timeout.
+    kube = FakeKube()
+    _pool(kube, _node("doomed", desired="off", state="off"))
+
+    class VanishingKube:
+        """Delegates to FakeKube but drops 'doomed' from polls after the
+        desired label lands (simulating node deletion)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.patched = False
+
+        def list_nodes(self, selector=None):
+            nodes = self._inner.list_nodes(selector)
+            if self.patched:
+                nodes = [
+                    n for n in nodes if n["metadata"]["name"] != "doomed"
+                ]
+            return nodes
+
+        def set_node_labels(self, name, labels):
+            self._inner.set_node_labels(name, labels)
+            self.patched = True
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    t0 = time.monotonic()
+    report = Rollout(
+        VanishingKube(kube), "on", poll_s=0.02, group_timeout_s=30.0
+    ).run()
+    assert time.monotonic() - t0 < 5.0  # far under the group timeout
+    by_name = {g.name: g for g in report.groups}
+    assert by_name["node/doomed"].outcome == "failed"
+    assert "disappeared" in by_name["node/doomed"].detail
+
+
+def test_vanished_node_in_pending_group_fails_at_launch():
+    # A member of a not-yet-launched group deleted mid-rollout must fail
+    # that group at launch time (from the refreshed snapshot), not crash
+    # the rollout with a KeyError.
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("a", desired="off", state="off"),
+        _node("b", desired="off", state="off"),
+    )
+
+    class VanishingKube:
+        """Drops node 'b' from every list after the first patch lands
+        (while group node/a is still in flight)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.patched = False
+
+        def list_nodes(self, selector=None):
+            nodes = self._inner.list_nodes(selector)
+            if self.patched:
+                nodes = [n for n in nodes if n["metadata"]["name"] != "b"]
+            return nodes
+
+        def set_node_labels(self, name, labels):
+            self._inner.set_node_labels(name, labels)
+            self.patched = True
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    report = Rollout(
+        VanishingKube(kube), "on", max_unavailable=1, failure_budget=3,
+        poll_s=0.02, group_timeout_s=0.2,
+    ).run()
+    by_name = {g.name: g for g in report.groups}
+    assert by_name["node/a"].outcome == "timeout"  # nobody converges it
+    assert by_name["node/b"].outcome == "failed"
+    assert "before launch" in by_name["node/b"].detail

@@ -96,6 +96,19 @@ def main(argv=None) -> int:
         except FatalModeError as e:
             log.error("fatal: %s", e)
             return 1
+        except Exception:
+            # Never exit without publishing failure: the state label is the
+            # cluster's only machine-readable outcome for a one-shot run
+            # (reference main.py:300-307). Best-effort — the label write
+            # itself may be what failed.
+            log.exception("set-cc-mode failed unexpectedly")
+            try:
+                set_cc_mode_state_label(kube, cfg.node_name, "failed")
+            except Exception as pub_err:
+                log.error(
+                    "could not publish cc.mode.state=failed: %s", pub_err
+                )
+            return 1
 
     # long-lived agent
     kube = _kube_client(cfg)

@@ -14,6 +14,8 @@ import ctypes
 import os
 from typing import Optional
 
+from tpu_cc_manager.device.base import DeviceError
+
 
 class NativeModeStateStore:
     """Drop-in for ModeStateStore backed by libtpudev.so."""
@@ -40,7 +42,9 @@ class NativeModeStateStore:
             1 if staged else 0, buf, len(buf),
         )
         if rc != 0:
-            raise OSError(f"tpudev_read failed for {path}/{domain}")
+            # DeviceError (not OSError) so the engine's failure path still
+            # publishes cc.mode.state=failed (reference main.py:300-307)
+            raise DeviceError(f"tpudev_read failed for {path}/{domain}")
         return buf.value.decode()
 
     def effective(self, path: str, domain: str) -> str:
@@ -53,15 +57,15 @@ class NativeModeStateStore:
         if self._lib.tpudev_stage(
             self.state_dir, path.encode(), domain.encode(), mode.encode()
         ) != 0:
-            raise OSError(f"tpudev_stage failed for {path}")
+            raise DeviceError(f"tpudev_stage failed for {path}")
 
     def commit(self, path: str) -> None:
         if self._lib.tpudev_commit(self.state_dir, path.encode()) != 0:
-            raise OSError(f"tpudev_commit failed for {path}")
+            raise DeviceError(f"tpudev_commit failed for {path}")
 
     def discard(self, path: str) -> None:
         if self._lib.tpudev_discard(self.state_dir, path.encode()) != 0:
-            raise OSError(f"tpudev_discard failed for {path}")
+            raise DeviceError(f"tpudev_discard failed for {path}")
 
 
 def load_native_store(state_dir: str) -> Optional[NativeModeStateStore]:

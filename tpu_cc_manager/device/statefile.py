@@ -32,6 +32,8 @@ import os
 import tempfile
 from contextlib import contextmanager
 
+from tpu_cc_manager.device.base import DeviceError
+
 
 def device_key(path: str) -> str:
     return path.replace("/", "_")
@@ -43,14 +45,21 @@ class ModeStateStore:
 
     def _dev_dir(self, path: str) -> str:
         d = os.path.join(self.state_dir, device_key(path))
-        os.makedirs(d, exist_ok=True)
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            raise DeviceError(f"{path}: cannot create state dir {d}: {e}") from e
         return d
 
     @contextmanager
     def _locked(self, path: str):
         d = self._dev_dir(path)
         lock_path = os.path.join(d, ".lock")
-        with open(lock_path, "a+") as lock:
+        try:
+            lock = open(lock_path, "a+")
+        except OSError as e:
+            raise DeviceError(f"{path}: cannot open lock {lock_path}: {e}") from e
+        with lock:
             fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
             try:
                 yield d
@@ -67,18 +76,28 @@ class ModeStateStore:
 
     @staticmethod
     def _write_atomic(d: str, name: str, value: str) -> None:
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.")
+        # Store failures (disk full, read-only fs, permissions) must surface
+        # as DeviceError: the engine's failure path catches DeviceError and
+        # publishes cc.mode.state=failed (the reference's failure-visibility
+        # contract, reference main.py:300-307) — a bare OSError would skip
+        # the state label entirely.
+        try:
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=f".{name}.")
+        except OSError as e:
+            raise DeviceError(f"cannot stage {name} in {d}: {e}") from e
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(value + "\n")
                 f.flush()
                 os.fsync(f.fileno())
             os.rename(tmp, os.path.join(d, name))
-        except BaseException:
+        except BaseException as e:
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
+            if isinstance(e, OSError):
+                raise DeviceError(f"cannot write {name} in {d}: {e}") from e
             raise
 
     def effective(self, path: str, domain: str) -> str:

@@ -200,6 +200,13 @@ class ModeEngine:
         except DeviceError as e:
             log.error("mode flip failed: %s", e)
             ok = False
+        except Exception:
+            # Unexpected (non-device) failure mid-flip: still publish
+            # cc.mode.state=failed below — the reference labels failed on
+            # every failure path (main.py:300-307); without this a one-shot
+            # set-cc-mode could exit leaving the stale previous state label.
+            log.exception("mode flip failed unexpectedly")
+            ok = False
         finally:
             if self._evict_components:
                 try:

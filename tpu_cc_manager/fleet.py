@@ -28,7 +28,7 @@ import time
 from typing import List, Optional
 
 from tpu_cc_manager import labels as L
-from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.obs import (
     OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
 )
@@ -124,7 +124,10 @@ class FleetController:
         try:
             nodes = self.kube.list_nodes(self.selector)
             report = analyze_fleet(nodes)
-        except ApiException:
+        except Exception:
+            # Count EVERY scan failure (malformed node objects, JAX runtime
+            # errors, ...), not just ApiException — an uncounted failure
+            # class would crash run() instead of degrading /healthz.
             self.metrics.scans_total.inc("error")
             self.consecutive_errors += 1
             raise
@@ -173,7 +176,7 @@ class FleetController:
                         report["nodes"], len(report["needs_flip"]),
                         len(report["failed"]),
                     )
-                except ApiException as e:
+                except Exception as e:
                     log.warning("fleet scan failed: %s", e)
                     if not self.healthy:
                         log.error(

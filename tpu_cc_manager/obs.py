@@ -19,6 +19,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -94,9 +95,17 @@ class Gauge:
 
 class Histogram:
     """Fixed-bucket histogram; default buckets span label-patch latencies
-    (ms) through full drain+flip reconciles (minutes)."""
+    (ms) through full drain+flip reconciles (minutes).
+
+    Bucket counts/sum/count are cumulative for the process lifetime (the
+    Prometheus contract). ``quantile()`` is answered from an exact sliding
+    window of the most recent ``WINDOW`` observations — on a long-running
+    agent it is "the pXX over the last 10k reconciles", never a mix of
+    arbitrary retention epochs.
+    """
 
     DEFAULT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+    WINDOW = 10000
 
     def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
         self.name, self.help = name, help_
@@ -105,15 +114,14 @@ class Histogram:
         self._sum = 0.0
         self._total = 0
         self._lock = threading.Lock()
-        self._samples: List[float] = []  # retained for quantile queries
+        # exact sliding window for quantile queries (deque drops oldest)
+        self._samples = deque(maxlen=self.WINDOW)
 
     def observe(self, value: float) -> None:
         with self._lock:
             self._sum += value
             self._total += 1
             self._samples.append(value)
-            if len(self._samples) > 10000:
-                self._samples = self._samples[-5000:]
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._counts[i] += 1
@@ -121,6 +129,7 @@ class Histogram:
             self._counts[-1] += 1
 
     def quantile(self, q: float) -> Optional[float]:
+        """q-quantile over the last ``WINDOW`` observations (exact)."""
         with self._lock:
             if not self._samples:
                 return None
@@ -304,7 +313,15 @@ class RouteServer:
                 if fn is None:
                     code, body, ctype = 404, b"not found", "text/plain"
                 else:
-                    code, body, ctype = fn()
+                    try:
+                        code, body, ctype = fn()
+                    except Exception:  # degrade to 500, not a dropped socket
+                        logging.getLogger(outer._name).exception(
+                            "route handler %s failed", self.path
+                        )
+                        # generic body: the server is unauthenticated on
+                        # 0.0.0.0 — exception detail stays in the log
+                        code, body, ctype = 500, b"internal error", "text/plain"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

@@ -212,6 +212,18 @@ class Rollout:
                 and len(in_flight) < self.max_unavailable
             ):
                 gname, members = pending.popleft()
+                # a member that vanished from the pool while the group sat
+                # in the queue (GKE node repair/deletion) fails the group
+                # at launch, mirroring _judge_group's in-flight check
+                gone = sorted(m for m in members if m not in by_name)
+                if gone:
+                    results.append(GroupResult(
+                        gname, members, "failed",
+                        f"node(s) disappeared from the pool before "
+                        f"launch: {gone}",
+                    ))
+                    budget -= 1
+                    continue
                 # a node already showing 'failed' at launch (--force over a
                 # broken fleet) can't fail fast: the agent re-publishing
                 # the same value is invisible, so for those members only
@@ -333,6 +345,15 @@ class Rollout:
                     "(pool poll failing)",
                 )
             return None  # transient: retry next tick
+        # A member absent from a fresh pool snapshot is gone (GKE node
+        # repair/deletion mid-rollout): fail the group immediately instead
+        # of burning the whole group timeout treating it as "lagging".
+        vanished = sorted(m for m in members if m not in by_name)
+        if vanished:
+            return GroupResult(
+                gname, members, "failed",
+                f"node(s) disappeared from the pool mid-rollout: {vanished}",
+            )
         states = {
             m: by_name.get(m, {}).get("metadata", {}).get("labels", {}).get(
                 L.CC_MODE_STATE_LABEL

@@ -12,5 +12,8 @@ DISTROLESS_TAG ?= gcr.io/distroless/python3-debian12:nonroot
 # toolchain
 GXX_STD ?= c++17
 
+# operator-side / dev Python dep pins live in requirements-dev.txt
+# (single source of truth; nothing at runtime depends on them)
+
 # registry
 REGISTRY ?= ghcr.io/example/tpu-cc-manager
