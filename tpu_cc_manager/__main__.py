@@ -39,6 +39,28 @@ def main(argv=None) -> int:
     cfg, args = parse_config(argv)
     setup_logging(cfg.debug)
 
+    if args.command == "probe-devices":
+        # Device inventory; --backend jax (default) asks the live TPU
+        # runtime — the hardware-truth surface (reference main.py:258-296
+        # queries hardware the same way). Never tracebacks: failures come
+        # back as JSON with rc 1.
+        import os as _os
+
+        from tpu_cc_manager.device import describe_backend
+        from tpu_cc_manager.device.base import _default_backend
+
+        _os.environ["TPU_CC_DEVICE_BACKEND"] = args.backend
+        try:
+            out = describe_backend(_default_backend(), name=args.backend)
+        except Exception as e:
+            print(json.dumps(
+                {"backend": args.backend, "error": str(e), "devices": []},
+                indent=2, sort_keys=True,
+            ))
+            return 1
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+
     if args.command == "get-cc-mode":
         engine = ModeEngine(set_state_label=lambda v: None, drainer=NullDrainer(),
                             evict_components=False)

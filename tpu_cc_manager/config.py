@@ -110,6 +110,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="operate on all devices (the only supported scope)",
     )
     sub.add_parser("get-cc-mode", help="print per-device modes and exit")
+    probe = sub.add_parser(
+        "probe-devices",
+        help="print the device inventory as JSON (no NODE_NAME needed). "
+             "Default backend: jax — the live TPU runtime, i.e. hardware "
+             "truth; pass --backend sysfs to inspect the surface a "
+             "sysfs-backend agent actually manages.",
+    )
+    probe.add_argument(
+        "--backend",
+        choices=("jax", "sysfs", "fake"),
+        default=os.environ.get("TPU_CC_DEVICE_BACKEND", "jax"),
+        help="device backend to probe (env TPU_CC_DEVICE_BACKEND; "
+             "default jax)",
+    )
     roll = sub.add_parser(
         "rollout",
         help="roll a mode change across the pool, bounded by a "
@@ -170,7 +184,7 @@ def parse_config(argv: Optional[List[str]] = None):
     reference (cmd/main.go:109-115, main.py:737-739)."""
     args = build_parser().parse_args(argv)
     if not args.node_name and args.command not in (
-        "get-cc-mode", "rollout", "fleet-controller"
+        "get-cc-mode", "probe-devices", "rollout", "fleet-controller"
     ):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"

@@ -31,6 +31,11 @@ Implementations:
   enumeration of TPU chips from ``/dev/accel*`` + ``/sys/class/accel``
   (vfio-style) with attestation-mode state managed through the native
   ``libtpudev`` shim (C++) or a pure-Python fallback.
+- :class:`tpu_cc_manager.device.jaxdev.JaxTpuBackend` — live enumeration
+  through the TPU runtime itself (PJRT/libtpu): on-chip health probes and
+  a real runtime-restart reset. The hardware-truth path; selected with
+  ``TPU_CC_DEVICE_BACKEND=jax`` (see REALDEV_r02.json for a real v5e
+  chip driven through a full flip cycle).
 
 There is deliberately no NVML, no ``nvidia-smi``, and no vendor tooling
 anywhere behind this interface — the BASELINE acceptance grep holds by
@@ -55,6 +60,7 @@ __all__ = [
     "set_backend",
     "find_tpus",
     "find_ici_switches",
+    "describe_backend",
 ]
 
 
@@ -71,3 +77,40 @@ def find_tpus():
 def find_ici_switches():
     """Enumerate ICI switches (NVSwitch analog, reference main.py:185)."""
     return get_backend().find_ici_switches()
+
+
+def describe_backend(backend=None, name: str = "") -> dict:
+    """Machine-readable device inventory for ANY backend (the
+    ``probe-devices`` CLI and the bench's real-host extra serialize this).
+    Per-device failures are reported in that device's ``error`` field —
+    an inventory query never raises for one bad part."""
+    backend = backend or get_backend()
+    chips, err = backend.find_tpus()
+    switches = backend.find_ici_switches()
+    devices = []
+    for c in list(chips) + [s for s in switches if s not in chips]:
+        entry = {
+            "path": c.path,
+            "device_kind": c.name,
+            "is_ici_switch": c.is_ici_switch(),
+            "cc_capable": c.is_cc_query_supported,
+            "ici_capable": c.is_ici_query_supported,
+        }
+        for attr in ("platform", "device_id", "process_index", "coords"):
+            if hasattr(c, attr):
+                entry[attr] = getattr(c, attr)
+        try:
+            entry["cc_mode"] = (
+                c.query_cc_mode() if c.is_cc_query_supported else None
+            )
+            entry["ici_mode"] = (
+                c.query_ici_mode() if c.is_ici_query_supported else None
+            )
+        except DeviceError as e:
+            entry["error"] = str(e)
+        devices.append(entry)
+    return {
+        "backend": name or type(backend).__name__,
+        "error": err,
+        "devices": devices,
+    }

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import abc
+import os
 import threading
 from typing import List, Optional, Tuple
 
@@ -92,12 +93,39 @@ def set_backend(backend: Optional[Backend]) -> None:
         _backend = backend
 
 
+def _default_backend() -> Backend:
+    """Build the backend named by TPU_CC_DEVICE_BACKEND:
+
+    - ``sysfs`` (default) — host accel sysfs tree scan (device.tpu);
+    - ``jax``             — live PJRT/libtpu enumeration (device.jaxdev),
+      the path that touches the real chip;
+    - ``fake``            — in-memory fake (device.fake), for kind-style
+      dry runs where the DaemonSet has no device plumbing at all.
+    """
+    name = os.environ.get("TPU_CC_DEVICE_BACKEND", "sysfs").strip().lower()
+    if name == "jax":
+        from tpu_cc_manager.device.jaxdev import JaxTpuBackend
+
+        return JaxTpuBackend()
+    if name == "fake":
+        from tpu_cc_manager.device.fake import fake_backend
+
+        return fake_backend()
+    if name != "sysfs":
+        raise DeviceError(
+            f"unknown TPU_CC_DEVICE_BACKEND {name!r}: "
+            "expected sysfs | jax | fake"
+        )
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+    return SysfsTpuBackend()
+
+
 def get_backend() -> Backend:
-    """Return the installed backend, defaulting to the sysfs TPU backend."""
+    """Return the installed backend, defaulting per TPU_CC_DEVICE_BACKEND
+    (sysfs unless overridden)."""
     global _backend
     with _lock:
         if _backend is None:
-            from tpu_cc_manager.device.tpu import SysfsTpuBackend
-
-            _backend = SysfsTpuBackend()
+            _backend = _default_backend()
         return _backend

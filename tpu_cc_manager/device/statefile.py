@@ -31,6 +31,7 @@ import fcntl
 import os
 import tempfile
 from contextlib import contextmanager
+from typing import Optional
 
 from tpu_cc_manager.device.base import DeviceError
 
@@ -100,11 +101,22 @@ class ModeStateStore:
                 raise DeviceError(f"cannot write {name} in {d}: {e}") from e
             raise
 
+    def _read_only_dir(self, path: str) -> Optional[str]:
+        """Device dir for pure reads: None when absent — readers report
+        'off' without creating dirs/locks as a side effect (an inventory
+        query must not scribble on /var/lib)."""
+        d = os.path.join(self.state_dir, device_key(path))
+        return d if os.path.isdir(d) else None
+
     def effective(self, path: str, domain: str) -> str:
+        if self._read_only_dir(path) is None:
+            return "off"
         with self._locked(path) as d:
             return self._read(d, f"{domain}.effective")
 
     def staged(self, path: str, domain: str) -> str:
+        if self._read_only_dir(path) is None:
+            return "off"
         with self._locked(path) as d:
             return self._read(d, f"{domain}.staged")
 

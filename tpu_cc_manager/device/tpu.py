@@ -30,6 +30,19 @@ Environment:
 - ``TPU_CC_STATE_DIR`` (default ``/var/lib/tpu-cc-manager``)
 - ``CC_CAPABLE_DEVICE_IDS`` — comma-separated hex device ids
 - ``TPU_CC_NATIVE_LIB`` — path to libtpudev.so (else bundled, else fallback)
+- ``TPU_SYSFS_RESET_ATTR`` / ``TPU_SYSFS_HEALTH_ATTR`` — per-device sysfs
+  attribute names poked by ``reset()`` / polled by ``wait_ready()``
+  (defaults ``reset`` / ``health``). Accel-class attribute names vary by
+  driver generation; these knobs let the DaemonSet match the node image
+  without a code change.
+
+Hardware-truth note: in environments where the chip is reachable only
+through the TPU runtime (no accel sysfs tree at all — e.g. this project's
+bench host, where the chip sits behind a PJRT tunnel), use
+:class:`tpu_cc_manager.device.jaxdev.JaxTpuBackend`
+(``TPU_CC_DEVICE_BACKEND=jax``): it enumerates, probes, and resets the
+REAL chip via the runtime itself and shares this module's statefile
+contract, so the two backends are interchangeable per host.
 """
 
 from __future__ import annotations
@@ -155,7 +168,9 @@ class SysfsTpuChip(TpuChip):
         observable contract (mode changes only after reset) holds on hosts
         with and without a resettable accel tree.
         """
-        reset_attr = os.path.join(self.sysfs_dir, "reset")
+        reset_attr = os.path.join(
+            self.sysfs_dir, os.environ.get("TPU_SYSFS_RESET_ATTR", "reset")
+        )
         if os.path.exists(reset_attr):
             try:
                 with open(reset_attr, "w") as f:
@@ -168,7 +183,9 @@ class SysfsTpuChip(TpuChip):
         """Poll device-node presence + optional sysfs health until ready
         (wait_for_boot analog, reference main.py:289)."""
         deadline = time.monotonic() + timeout_s
-        health_attr = os.path.join(self.sysfs_dir, "health")
+        health_attr = os.path.join(
+            self.sysfs_dir, os.environ.get("TPU_SYSFS_HEALTH_ATTR", "health")
+        )
         while True:
             node_ok = os.path.exists(self.path) or not self.path.startswith("/dev/")
             health = _read(health_attr)

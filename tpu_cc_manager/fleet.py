@@ -122,20 +122,22 @@ class FleetController:
     def scan_once(self) -> dict:
         t0 = time.monotonic()
         try:
+            # Everything through metric publication is inside the counted
+            # block: any failure (malformed node objects, JAX runtime
+            # errors, metric-shape bugs) increments consecutive_errors and
+            # degrades /healthz instead of crashing run() or — worse —
+            # retrying forever with the error counter stuck at 0.
             nodes = self.kube.list_nodes(self.selector)
             report = analyze_fleet(nodes)
+            self.metrics.scan_duration.observe(time.monotonic() - t0)
+            self.metrics.update(report)
+            self.last_report = report
         except Exception:
-            # Count EVERY scan failure (malformed node objects, JAX runtime
-            # errors, ...), not just ApiException — an uncounted failure
-            # class would crash run() instead of degrading /healthz.
             self.metrics.scans_total.inc("error")
             self.consecutive_errors += 1
             raise
         self.consecutive_errors = 0
         self.metrics.scans_total.inc("success")
-        self.metrics.scan_duration.observe(time.monotonic() - t0)
-        self.metrics.update(report)
-        self.last_report = report
         return report
 
     @property
