@@ -36,10 +36,22 @@ from tpu_cc_manager.k8s.fake import FakeKube
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     store: FakeKube  # set by server factory
+    required_token: Optional[str] = None  # when set, reject non-bearers 401
 
     # silence default stderr access logging
     def log_message(self, fmt, *args):  # pragma: no cover
         pass
+
+    def _authorized(self) -> bool:
+        """Bearer-token gate, enabled by FakeApiServer(required_token=...).
+        Lets tests prove the exec-credential/kubeconfig auth path
+        end-to-end over the wire."""
+        if self.required_token is None:
+            return True
+        if self.headers.get("Authorization") == f"Bearer {self.required_token}":
+            return True
+        self._send_error_status(ApiException(401, "Unauthorized"))
+        return False
 
     # ---------------------------------------------------------- plumbing
     def _send_json(self, code: int, obj: dict) -> None:
@@ -76,6 +88,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- verbs
     def do_GET(self):
+        if not self._authorized():
+            return
         parts, q = self._parts()
         try:
             if parts[:3] == ["api", "v1", "nodes"]:
@@ -105,6 +119,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_status(e)
 
     def do_PATCH(self):
+        if not self._authorized():
+            return
         parts, _ = self._parts()
         try:
             if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
@@ -116,6 +132,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_status(e)
 
     def do_PUT(self):
+        if not self._authorized():
+            return
         parts, _ = self._parts()
         try:
             if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
@@ -127,6 +145,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_status(e)
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         parts, _ = self._parts()
         try:
             if (
@@ -141,6 +161,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_status(e)
 
     def do_POST(self):
+        if not self._authorized():
+            return
         parts, _ = self._parts()
         try:
             if (
@@ -198,9 +220,18 @@ class _Handler(BaseHTTPRequestHandler):
 class FakeApiServer:
     """Owns a ThreadingHTTPServer bound to 127.0.0.1:<port> over a FakeKube."""
 
-    def __init__(self, store: Optional[FakeKube] = None, port: int = 0):
+    def __init__(
+        self,
+        store: Optional[FakeKube] = None,
+        port: int = 0,
+        required_token: Optional[str] = None,
+    ):
         self.store = store or FakeKube()
-        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"store": self.store, "required_token": required_token},
+        )
         # a 32-node pool opening watch streams at once overflows the
         # default listen(5) backlog -> connection resets
         server_cls = type(

@@ -10,11 +10,14 @@ from __future__ import annotations
 
 import abc
 import base64
+import datetime
 import json
 import os
 import socket
 import ssl
+import subprocess
 import tempfile
+import threading
 import urllib.parse
 from http.client import HTTPConnection, HTTPSConnection
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -95,6 +98,180 @@ class KubeClient(abc.ABC):
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+class ExecCredentialError(Exception):
+    """A kubeconfig users[].exec credential plugin failed or returned an
+    unusable ExecCredential."""
+
+
+class ExecCredentialPlugin:
+    """Runs a kubeconfig ``users[].exec`` credential plugin and caches the
+    resulting bearer token until its ``expirationTimestamp``.
+
+    This is GKE's actual auth path: real GKE kubeconfigs carry no static
+    token — they name ``gke-gcloud-auth-plugin``, which prints an
+    ExecCredential JSON on stdout. The reference gets this for free from
+    client-go (reference cmd/main.go:120, clientcmd.BuildConfigFromFlags)
+    and the kubernetes Python client (reference main.py:105-114); this is
+    the stdlib equivalent for the operator-side tools (rollout,
+    fleet-controller, plan) running from a workstation.
+
+    Implements the client-go contract:
+    - spawn ``command args...`` with ``env`` entries merged over os.environ;
+    - when ``provideClusterInfo`` is set, pass the target cluster through
+      the ``KUBERNETES_EXEC_INFO`` env var;
+    - parse the ExecCredential status: ``token`` (primary; GKE) and the
+      ``clientCertificateData``/``clientKeyData`` pair (some plugins);
+    - cache until ``expirationTimestamp`` minus a refresh skew; a
+      credential with no expiry is cached for the process lifetime.
+    """
+
+    REFRESH_SKEW_S = 60
+
+    def __init__(self, spec: dict, cluster: Optional[dict] = None):
+        self.command = spec["command"]
+        self.args = list(spec.get("args") or [])
+        self.env = list(spec.get("env") or [])  # [{"name":..., "value":...}]
+        self.api_version = spec.get(
+            "apiVersion", "client.authentication.k8s.io/v1beta1"
+        )
+        self.provide_cluster_info = bool(spec.get("provideClusterInfo"))
+        self.cluster = cluster or {}
+        self.timeout_s = 60.0
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._cert_files: Optional[Tuple[str, str]] = None
+        self._expiry: Optional[datetime.datetime] = None
+        self._fetched = False
+
+    # -- cache ----------------------------------------------------------
+    def _fresh(self, now: datetime.datetime) -> bool:
+        if not self._fetched:
+            return False
+        if self._expiry is None:
+            return True  # no expiry: valid for process lifetime (client-go)
+        return now < self._expiry - datetime.timedelta(seconds=self.REFRESH_SKEW_S)
+
+    def token(self) -> Optional[str]:
+        with self._lock:
+            self._ensure(datetime.datetime.now(datetime.timezone.utc))
+            return self._token
+
+    def client_cert_pair(self) -> Optional[Tuple[str, str]]:
+        """(cert_file, key_file) when the plugin returned TLS credentials."""
+        with self._lock:
+            self._ensure(datetime.datetime.now(datetime.timezone.utc))
+            return self._cert_files
+
+    def invalidate(self) -> None:
+        """Drop the cached credential (e.g. after a 401) so the next
+        request re-runs the plugin."""
+        with self._lock:
+            self._fetched = False
+
+    # -- plugin invocation ----------------------------------------------
+    def _ensure(self, now: datetime.datetime) -> None:
+        if self._fresh(now):
+            return
+        status = self._invoke()
+        self._token = status.get("token")
+        self._expiry = _parse_rfc3339(status.get("expirationTimestamp"))
+        cert, key = status.get("clientCertificateData"), status.get("clientKeyData")
+        if cert and key:
+            # reuse the same two files across refreshes: a short-expiry
+            # plugin in a long-running controller must not grow /tmp (and
+            # must not leave a trail of stale private keys)
+            if self._cert_files is None:
+                self._cert_files = (_write_temp(b""), _write_temp(b""))
+            for path, data in zip(self._cert_files, (cert, key)):
+                with open(path, "wb") as f:
+                    f.write(data.encode())
+        elif self._cert_files is not None:
+            for path in self._cert_files:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._cert_files = None
+        if not self._token and not self._cert_files:
+            raise ExecCredentialError(
+                f"{self.command}: ExecCredential carries neither token nor "
+                "client certificate"
+            )
+        self._fetched = True
+
+    def _invoke(self) -> dict:
+        env = dict(os.environ)
+        for e in self.env:
+            env[e["name"]] = e["value"]
+        if self.provide_cluster_info:
+            # client-go ExecCredential input contract (KUBERNETES_EXEC_INFO)
+            env["KUBERNETES_EXEC_INFO"] = json.dumps({
+                "apiVersion": self.api_version,
+                "kind": "ExecCredential",
+                "spec": {
+                    "interactive": False,
+                    "cluster": {
+                        "server": self.cluster.get("server", ""),
+                        "certificate-authority-data":
+                            self.cluster.get("certificate-authority-data", ""),
+                    },
+                },
+            })
+        try:
+            proc = subprocess.run(
+                [self.command, *self.args],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=self.timeout_s,
+            )
+        except FileNotFoundError:
+            raise ExecCredentialError(
+                f"credential plugin not found: {self.command}"
+            ) from None
+        except subprocess.TimeoutExpired:
+            raise ExecCredentialError(
+                f"credential plugin timed out after {self.timeout_s}s: "
+                f"{self.command}"
+            ) from None
+        if proc.returncode != 0:
+            raise ExecCredentialError(
+                f"credential plugin failed (rc={proc.returncode}): "
+                f"{self.command}: {proc.stderr.strip()[:200]}"
+            )
+        try:
+            cred = json.loads(proc.stdout)
+        except json.JSONDecodeError as e:
+            raise ExecCredentialError(
+                f"credential plugin printed invalid JSON: {self.command}: {e}"
+            ) from None
+        if cred.get("kind") not in (None, "ExecCredential"):
+            raise ExecCredentialError(
+                f"credential plugin returned kind={cred.get('kind')!r}, "
+                "expected ExecCredential"
+            )
+        return cred.get("status") or {}
+
+
+def _parse_rfc3339(ts: Optional[str]) -> Optional[datetime.datetime]:
+    if not ts:
+        return None
+    try:
+        dt = datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except ValueError:
+        return None  # unparseable expiry: treat as non-expiring
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt
+
+
+def _write_temp(data: bytes, prefix: str = "kubecfg-") -> str:
+    fd, p = tempfile.mkstemp(prefix=prefix)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    return p
+
+
 class KubeConfig:
     def __init__(
         self,
@@ -107,6 +284,7 @@ class KubeConfig:
         client_cert: Optional[str] = None,
         client_key: Optional[str] = None,
         insecure_skip_verify: bool = False,
+        exec_plugin: Optional[ExecCredentialPlugin] = None,
     ):
         self.host = host
         self.port = port
@@ -116,6 +294,24 @@ class KubeConfig:
         self.client_cert = client_cert
         self.client_key = client_key
         self.insecure_skip_verify = insecure_skip_verify
+        self.exec_plugin = exec_plugin
+
+    def bearer_token(self) -> Optional[str]:
+        """The token for the next request: static when the kubeconfig
+        carries one, otherwise freshly resolved (and cached) through the
+        exec credential plugin."""
+        if self.token:
+            return self.token
+        if self.exec_plugin:
+            return self.exec_plugin.token()
+        return None
+
+    def client_cert_pair(self) -> Optional[Tuple[str, str]]:
+        if self.client_cert:
+            return (self.client_cert, self.client_key)
+        if self.exec_plugin:
+            return self.exec_plugin.client_cert_pair()
+        return None
 
     @classmethod
     def in_cluster(cls) -> "KubeConfig":
@@ -131,19 +327,31 @@ class KubeConfig:
                    ca_file=ca_path if os.path.exists(ca_path) else None)
 
     @classmethod
-    def from_kubeconfig(cls, path: str) -> "KubeConfig":
+    def from_kubeconfig(cls, path: str, context: Optional[str] = None) -> "KubeConfig":
         """Parse a kubeconfig file (reference main.py:111-114 falls back to
-        load_kube_config when not in-cluster)."""
+        load_kube_config when not in-cluster). Supports static tokens,
+        inline/file client certificates, and ``users[].exec`` credential
+        plugins — the gke-gcloud-auth-plugin path every real GKE
+        kubeconfig uses."""
         import yaml
 
         with open(path) as f:
             cfg = yaml.safe_load(f)
-        ctx_name = cfg.get("current-context")
-        ctx = next(c for c in cfg["contexts"] if c["name"] == ctx_name)["context"]
-        cluster = next(
-            c for c in cfg["clusters"] if c["name"] == ctx["cluster"]
-        )["cluster"]
-        user = next(u for u in cfg["users"] if u["name"] == ctx["user"])["user"]
+        ctx_name = context or cfg.get("current-context")
+        try:
+            ctx = next(c for c in cfg["contexts"] if c["name"] == ctx_name)["context"]
+        except StopIteration:
+            raise ValueError(f"kubeconfig {path}: context {ctx_name!r} not found") from None
+        try:
+            cluster = next(
+                c for c in cfg["clusters"] if c["name"] == ctx["cluster"]
+            )["cluster"]
+        except StopIteration:
+            raise ValueError(f"kubeconfig {path}: cluster {ctx['cluster']!r} not found") from None
+        try:
+            user = next(u for u in cfg["users"] if u["name"] == ctx["user"])["user"]
+        except StopIteration:
+            raise ValueError(f"kubeconfig {path}: user {ctx['user']!r} not found") from None
 
         url = urllib.parse.urlparse(cluster["server"])
         use_tls = url.scheme == "https"
@@ -153,11 +361,12 @@ class KubeConfig:
             if blob.get(file_key):
                 return blob[file_key]
             if blob.get(data_key):
-                fd, p = tempfile.mkstemp(prefix="kubecfg-")
-                with os.fdopen(fd, "wb") as f:
-                    f.write(base64.b64decode(blob[data_key]))
-                return p
+                return _write_temp(base64.b64decode(blob[data_key]))
             return None
+
+        exec_plugin = None
+        if user.get("exec"):
+            exec_plugin = ExecCredentialPlugin(user["exec"], cluster=cluster)
 
         return cls(
             url.hostname or "localhost",
@@ -168,6 +377,7 @@ class KubeConfig:
             client_cert=_inline("client-certificate-data", "client-certificate", user),
             client_key=_inline("client-key-data", "client-key", user),
             insecure_skip_verify=bool(cluster.get("insecure-skip-tls-verify")),
+            exec_plugin=exec_plugin,
         )
 
     @classmethod
@@ -201,15 +411,17 @@ class HttpKubeClient(KubeClient):
             if c.insecure_skip_verify:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
-            if c.client_cert:
-                ctx.load_cert_chain(c.client_cert, c.client_key)
+            pair = c.client_cert_pair()
+            if pair:
+                ctx.load_cert_chain(pair[0], pair[1])
             return HTTPSConnection(c.host, c.port, context=ctx, timeout=read_timeout)
         return HTTPConnection(c.host, c.port, timeout=read_timeout)
 
     def _headers(self, content_type: Optional[str] = None) -> dict:
         h = {"Accept": "application/json"}
-        if self.config.token:
-            h["Authorization"] = f"Bearer {self.config.token}"
+        token = self.config.bearer_token()
+        if token:
+            h["Authorization"] = f"Bearer {token}"
         if content_type:
             h["Content-Type"] = content_type
         return h
@@ -221,8 +433,16 @@ class HttpKubeClient(KubeClient):
         body: Optional[dict] = None,
         content_type: str = "application/json",
         read_timeout: Optional[float] = 30.0,
+        _auth_retry: bool = True,
     ) -> dict:
-        conn = self._connect(read_timeout)
+        try:
+            conn = self._connect(read_timeout)
+        except ExecCredentialError as e:
+            # surface credential-plugin failures through the module's error
+            # contract so callers' except-ApiException retry/rollback paths
+            # (rollout, agent watch loop) handle them like any transport
+            # failure instead of crashing on a foreign exception type
+            raise ApiException(0, f"exec credential failure: {e}") from e
         try:
             try:
                 conn.request(
@@ -233,11 +453,21 @@ class HttpKubeClient(KubeClient):
                 )
                 resp = conn.getresponse()
                 data = resp.read()
+            except ExecCredentialError as e:
+                raise ApiException(0, f"exec credential failure: {e}") from e
             except OSError as e:
                 # transport failure (refused/reset/timeout): surface as an
                 # API error (status 0) so callers' retry/backoff paths —
                 # not a raw traceback — handle it
                 raise ApiException(0, f"transport error: {e}") from e
+            if resp.status == 401 and _auth_retry and self.config.exec_plugin:
+                # cached exec credential revoked server-side: refresh once
+                # (client-go invalidate-and-retry contract)
+                self.config.exec_plugin.invalidate()
+                return self._request(
+                    method, path, body=body, content_type=content_type,
+                    read_timeout=read_timeout, _auth_retry=False,
+                )
             if resp.status >= 400:
                 if resp.status == 409:
                     raise ConflictError(data.decode("utf-8", "replace")[:200])
@@ -304,6 +534,7 @@ class HttpKubeClient(KubeClient):
         name: Optional[str] = None,
         resource_version: Optional[str] = None,
         timeout_s: int = 300,
+        _auth_retry: bool = True,
     ) -> Iterator[Tuple[str, dict]]:
         params = {"watch": "true", "timeoutSeconds": str(timeout_s)}
         if name:
@@ -314,13 +545,31 @@ class HttpKubeClient(KubeClient):
             params["resourceVersion"] = str(resource_version)
         path = "/api/v1/nodes?" + urllib.parse.urlencode(params)
 
-        conn = self._connect(read_timeout=timeout_s + 30)
+        try:
+            conn = self._connect(read_timeout=timeout_s + 30)
+        except ExecCredentialError as e:
+            raise ApiException(0, f"exec credential failure: {e}") from e
         try:
             try:
                 conn.request("GET", path, headers=self._headers())
                 resp = conn.getresponse()
+            except ExecCredentialError as e:
+                raise ApiException(0, f"exec credential failure: {e}") from e
             except OSError as e:
                 raise ApiException(0, f"transport error: {e}") from e
+            if resp.status == 401 and _auth_retry and self.config.exec_plugin:
+                # same invalidate-and-retry as _request: a revoked cached
+                # exec credential must not burn the watcher's consecutive-
+                # error budget when one plugin re-run fixes it
+                self.config.exec_plugin.invalidate()
+                resp.read()
+                yield from self.watch_nodes(
+                    name=name,
+                    resource_version=resource_version,
+                    timeout_s=timeout_s,
+                    _auth_retry=False,
+                )
+                return
             if resp.status >= 400:
                 raise ApiException(resp.status, resp.read().decode("utf-8", "replace")[:200])
             # newline-delimited JSON event stream
