@@ -33,6 +33,13 @@ from tpu_cc_manager.k8s.client import ApiException
 from tpu_cc_manager.k8s.fake import FakeKube
 
 
+def _list_obj(kind: str, items: list, cont: Optional[str]) -> dict:
+    out = {"kind": kind, "apiVersion": "v1", "items": items, "metadata": {}}
+    if cont:
+        out["metadata"]["continue"] = cont
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     store: FakeKube  # set by server factory
@@ -97,10 +104,12 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send_json(200, self.store.get_node(parts[3]))
                 if q.get("watch") == "true":
                     return self._stream_watch(q)
-                items = self.store.list_nodes(q.get("labelSelector"))
-                return self._send_json(
-                    200, {"kind": "NodeList", "apiVersion": "v1", "items": items}
+                items, cont = self.store.list_nodes_page(
+                    q.get("labelSelector"),
+                    limit=int(q["limit"]) if q.get("limit") else None,
+                    cont=q.get("continue"),
                 )
+                return self._send_json(200, _list_obj("NodeList", items, cont))
             if (
                 len(parts) >= 5
                 and parts[:3] == ["api", "v1", "namespaces"]
@@ -108,12 +117,14 @@ class _Handler(BaseHTTPRequestHandler):
             ):
                 ns = parts[3]
                 if len(parts) == 5:
-                    items = self.store.list_pods(
-                        ns, q.get("labelSelector"), q.get("fieldSelector")
+                    items, cont = self.store.list_pods_page(
+                        ns,
+                        q.get("labelSelector"),
+                        q.get("fieldSelector"),
+                        limit=int(q["limit"]) if q.get("limit") else None,
+                        cont=q.get("continue"),
                     )
-                    return self._send_json(
-                        200, {"kind": "PodList", "apiVersion": "v1", "items": items}
-                    )
+                    return self._send_json(200, _list_obj("PodList", items, cont))
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:
             return self._send_error_status(e)
@@ -184,7 +195,7 @@ class _Handler(BaseHTTPRequestHandler):
         fs = q.get("fieldSelector", "")
         if fs.startswith("metadata.name="):
             name = fs.split("=", 1)[1]
-        timeout_s = int(q.get("timeoutSeconds", "300"))
+        timeout_s = float(q.get("timeoutSeconds", "300"))
         rv = q.get("resourceVersion")
 
         self.send_response(200)
@@ -198,7 +209,10 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             for etype, obj in self.store.watch_nodes(
-                name=name, resource_version=rv, timeout_s=timeout_s
+                name=name,
+                resource_version=rv,
+                timeout_s=timeout_s,
+                allow_bookmarks=q.get("allowWatchBookmarks") == "true",
             ):
                 _chunk(json.dumps({"type": etype, "object": obj}).encode() + b"\n")
         except ApiException as e:

@@ -30,6 +30,23 @@ from tpu_cc_manager.k8s.client import ApiException, ConflictError, KubeClient
 from tpu_cc_manager.k8s.objects import match_selector, merge_patch
 
 
+def _paginate(
+    items: List[dict], limit: Optional[int], cont: Optional[str]
+) -> Tuple[List[dict], Optional[str]]:
+    """Name-ordered chunking with an offset continue token (the real API
+    server's chunked-LIST contract, close enough for client testing)."""
+    items.sort(key=lambda o: o["metadata"]["name"])
+    try:
+        start = int(cont) if cont else 0
+    except ValueError:
+        raise ApiException(410, f"invalid continue token {cont!r}") from None
+    if limit is None or limit <= 0:
+        return items[start:], None
+    page = items[start:start + limit]
+    nxt = start + limit
+    return page, (str(nxt) if nxt < len(items) else None)
+
+
 class FakeKube(KubeClient):
     def __init__(self, watch_history_limit: int = 1000):
         self._lock = threading.Condition()
@@ -43,6 +60,11 @@ class FakeKube(KubeClient):
         self.pdb_blocked: set = set()  # {(ns, name)} -> evict raises 429
         self.fail_next_watches = 0  # next N watch_nodes calls raise 500
         self.patch_delay_s = 0.0  # simulated API latency
+        #: when set, idle watches emit BOOKMARK events at this cadence
+        #: (like a real API server with allowWatchBookmarks), letting
+        #: clients keep their resourceVersion current through
+        #: other-object churn
+        self.bookmark_every_s: Optional[float] = None
 
     # ------------------------------------------------------------ helpers
     def _bump(self, obj: dict) -> None:
@@ -94,6 +116,16 @@ class FakeKube(KubeClient):
                 for n in self._nodes.values()
                 if match_selector(n["metadata"].get("labels", {}), label_selector)
             ]
+
+    def list_nodes_page(
+        self,
+        label_selector: Optional[str] = None,
+        limit: Optional[int] = None,
+        cont: Optional[str] = None,
+    ) -> Tuple[List[dict], Optional[str]]:
+        """Chunked LIST: (items, continue_token). Name-ordered like the
+        real API server; the token encodes the resume position."""
+        return _paginate(self.list_nodes(label_selector), limit, cont)
 
     def patch_node(self, name: str, patch: dict) -> dict:
         if self.patch_delay_s:
@@ -150,6 +182,18 @@ class FakeKube(KubeClient):
                 out.append(copy.deepcopy(pod))
             return out
 
+    def list_pods_page(
+        self,
+        namespace: str,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+        limit: Optional[int] = None,
+        cont: Optional[str] = None,
+    ) -> Tuple[List[dict], Optional[str]]:
+        return _paginate(
+            self.list_pods(namespace, label_selector, field_selector), limit, cont
+        )
+
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
             if (namespace, name) not in self._pods:
@@ -172,6 +216,7 @@ class FakeKube(KubeClient):
         name: Optional[str] = None,
         resource_version: Optional[str] = None,
         timeout_s: int = 300,
+        allow_bookmarks: bool = True,
     ) -> Iterator[Tuple[str, dict]]:
         with self._lock:
             if self.fail_next_watches > 0:
@@ -179,29 +224,63 @@ class FakeKube(KubeClient):
                 raise ApiException(500, "injected watch failure")
         deadline = time.monotonic() + timeout_s
         last_rv = int(resource_version) if resource_version is not None else None
+        last_bookmark = time.monotonic()
+        establishing = True
 
         while True:
+            bookmark = None
             with self._lock:
                 if last_rv is None:
                     # no rv: start from "now", like an unversioned k8s watch
                     last_rv = self._rv
-                else:
+                elif establishing:
+                    # staleness is judged at watch establishment only: once
+                    # streaming, this generator examines every event (even
+                    # ones the name filter drops), so later compaction of
+                    # already-examined history must not kill a live stream
                     oldest_retained = self._events[0][0] if self._events else self._rv + 1
                     if last_rv + 1 < oldest_retained and last_rv < self._rv:
                         # requested window fell out of history
                         raise ApiException(410, "too old resource version")
+                establishing = False
                 pending = [
                     (rv, t, obj)
                     for (rv, t, obj) in self._events
                     if rv > last_rv
                     and (name is None or obj["metadata"]["name"] == name)
                 ]
+                if self._events:
+                    # everything currently retained has now been examined
+                    last_rv = max(last_rv, self._events[-1][0])
                 if not pending:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return  # server-side watch timeout: clean stream end
-                    self._lock.wait(timeout=min(remaining, 0.5))
-                    continue
+                    if (
+                        allow_bookmarks
+                        and self.bookmark_every_s is not None
+                        and time.monotonic() - last_bookmark
+                        >= self.bookmark_every_s
+                    ):
+                        # fast-forward the client past churn it filtered
+                        # out (other nodes, pods) so a reconnect from this
+                        # rv stays inside retained history
+                        last_bookmark = time.monotonic()
+                        last_rv = self._rv
+                        bookmark = {
+                            "kind": "Node",
+                            "apiVersion": "v1",
+                            "metadata": {
+                                "name": name or "",
+                                "resourceVersion": str(self._rv),
+                            },
+                        }
+                    else:
+                        self._lock.wait(timeout=min(remaining, 0.5))
+                        continue
+            if bookmark is not None:
+                yield "BOOKMARK", bookmark
+                continue
             for rv, etype, obj in pending:
                 last_rv = max(last_rv, rv)
                 yield etype, copy.deepcopy(obj)
