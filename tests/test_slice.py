@@ -125,10 +125,13 @@ def test_dead_member_staleness_excluded_leader_failover():
         t.join(timeout=20)
     assert all(results.values())
     assert all(m.chip.query_cc_mode() == "on" for m in members)
-    commit = kube.get_node("n1")["metadata"]["annotations"].get(
-        L.SLICE_COMMIT_ANNOTATION
-    )
-    assert commit and commit.startswith("on:")  # n1 became leader
+    # the commit is fenced on the ANCHOR node (n0 — smallest member, even
+    # though its agent is dead: the node object still exists), written by
+    # the failover leader n1
+    ann = kube.get_node("n0")["metadata"]["annotations"]
+    commit = ann.get(L.SLICE_COMMIT_ANNOTATION)
+    assert commit and commit.startswith("on:")
+    assert ann[L.SLICE_LEADER_ANNOTATION] == "n1"  # n1 became leader
 
 
 def test_per_slice_policy_divergence():
@@ -285,3 +288,164 @@ def test_shutdown_abort_is_flagged():
     m.coord.stop()
     t.join(timeout=5)
     assert caught.get("shutting_down") is True
+
+
+def test_half_flipped_slice_heals_on_retry():
+    # VERDICT r1 item 8: a member whose local flip fails AFTER the quorum
+    # commit leaves the slice half-flipped; a plain retry (what the
+    # agent's self-repair loop does) must converge it with no operator
+    # relabeling and no new quorum round.
+    kube = FakeKube()
+    members = [SliceMember(kube, f"n{i}", "slice-a") for i in range(3)]
+    members[2].chip.fail_set = True  # device fault on one member
+    results = {}
+
+    def run(m):
+        try:
+            results[m.name] = m.apply("on")
+        except SliceAbortError:
+            results[m.name] = "aborted"
+
+    threads = [threading.Thread(target=run, args=(m,)) for m in members]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    # quorum committed; healthy members flipped, the faulty one failed
+    assert results["n0"] is True and results["n1"] is True
+    assert results["n2"] is False
+    assert members[2].chip.query_cc_mode() == "off"  # half-flipped
+    assert members[2].states[-1] == "failed"
+    # done was NOT recorded for the laggard, so the commit stays actionable
+    ann2 = kube.get_node("n2")["metadata"]["annotations"]
+    assert DONE_ANNOTATION not in ann2
+
+    # heal: fault clears, the laggard retries alone — it observes the
+    # still-actionable commit on the anchor and converges immediately
+    members[2].chip.fail_set = False
+    t0 = time.monotonic()
+    assert members[2].apply("on") is True
+    assert time.monotonic() - t0 < 3
+    assert members[2].chip.query_cc_mode() == "on"
+    done = kube.get_node("n2")["metadata"]["annotations"][DONE_ANNOTATION]
+    commit = kube.get_node("n0")["metadata"]["annotations"][
+        L.SLICE_COMMIT_ANNOTATION
+    ]
+    assert done == commit
+
+
+class _StaleAnchorKube:
+    """Delegating kube whose get_node serves a frozen pre-commit snapshot
+    of the anchor — models a dual leader acting on a stale read."""
+
+    def __init__(self, real, stale_anchor):
+        self._real = real
+        self._stale = stale_anchor
+
+    def get_node(self, name):
+        import copy as _copy
+        if name == self._stale["metadata"]["name"]:
+            return _copy.deepcopy(self._stale)
+        return self._real.get_node(name)
+
+    def __getattr__(self, attr):
+        return getattr(self._real, attr)
+
+
+def test_commit_cas_exactly_one_writer_per_epoch():
+    # VERDICT r1 item 7: during a heartbeat-staleness window two members
+    # can both believe they are leader. The CAS fence on the anchor must
+    # let exactly one commit through per epoch.
+    import copy
+
+    kube = FakeKube()
+    now = time.time()
+    for i in range(3):
+        kube.add_node(make_node(f"n{i}", labels={L.TPU_SLICE_LABEL: "s"}))
+        kube.set_node_annotations(
+            f"n{i}",
+            {HB_ANNOTATION: str(now + 1000), L.SLICE_ACK_ANNOTATION: "on"},
+        )
+
+    replaces = []
+    real_replace = kube.replace_node
+
+    def counting_replace(name, node):
+        out = real_replace(name, node)
+        replaces.append(name)
+        return out
+
+    kube.replace_node = counting_replace
+
+    c0 = SliceCoordinator(kube, "n0")
+    members = c0.members("s")
+    stale_anchor = copy.deepcopy(kube.get_node("n0"))
+    stale_members = copy.deepcopy(members)
+
+    # leader n0 commits from a fresh view
+    c0._maybe_commit("on", members, members)
+    ann = kube.get_node("n0")["metadata"]["annotations"]
+    commit1 = ann[L.SLICE_COMMIT_ANNOTATION]
+    assert commit1.startswith("on:")
+    assert ann[L.SLICE_LEADER_ANNOTATION] == "n0"
+    assert len(replaces) == 1
+
+    # dual leader n1 acts on the PRE-COMMIT snapshot: its CAS must lose
+    # (409) and leave the winner's commit untouched
+    c1 = SliceCoordinator(_StaleAnchorKube(kube, stale_anchor), "n1")
+    c1._maybe_commit("on", stale_members, stale_members)
+    ann = kube.get_node("n0")["metadata"]["annotations"]
+    assert ann[L.SLICE_COMMIT_ANNOTATION] == commit1  # winner intact
+    assert ann[L.SLICE_LEADER_ANNOTATION] == "n0"
+    assert len(replaces) == 1  # no second successful write
+
+    # a FRESH-view leader with the round already actionable writes nothing
+    c2 = SliceCoordinator(kube, "n1")
+    c2._maybe_commit("on", c2.members("s"), c2.members("s"))
+    assert len(replaces) == 1
+
+
+def test_commit_cas_churn_many_concurrent_leaders():
+    # heartbeat-churn stress: many would-be leaders race one round; the
+    # anchor must end with exactly one commit epoch and one leader, and
+    # every successful write must be CAS-serialized (no lost updates).
+    kube = FakeKube()
+    now = time.time()
+    n = 6
+    for i in range(n):
+        kube.add_node(make_node(f"n{i}", labels={L.TPU_SLICE_LABEL: "s"}))
+        kube.set_node_annotations(
+            f"n{i}",
+            {HB_ANNOTATION: str(now + 1000), L.SLICE_ACK_ANNOTATION: "on"},
+        )
+    wrote = []
+    real_replace = kube.replace_node
+
+    def counting_replace(name, node):
+        out = real_replace(name, node)
+        wrote.append(node["metadata"]["annotations"][L.SLICE_COMMIT_ANNOTATION])
+        return out
+
+    kube.replace_node = counting_replace
+
+    coords = [SliceCoordinator(kube, f"n{i}") for i in range(n)]
+
+    def race(c):
+        members = c.members("s")
+        c._maybe_commit("on", members, members)
+
+    threads = [threading.Thread(target=race, args=(c,)) for c in coords]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    # exactly one commit epoch survives on the anchor...
+    ann = kube.get_node("n0")["metadata"]["annotations"]
+    final = ann[L.SLICE_COMMIT_ANNOTATION]
+    assert final.startswith("on:")
+    # ...and every write that succeeded carried the SAME mode; successful
+    # writers were serialized by CAS, each with a strictly newer epoch
+    assert all(w.startswith("on:") for w in wrote)
+    epochs = [int(w.rpartition(":")[2]) for w in wrote]
+    assert epochs == sorted(set(epochs))
+    assert final == wrote[-1]

@@ -98,6 +98,12 @@ class CCManagerAgent:
         self._fatal: Optional[Exception] = None
         self._stop = threading.Event()
         self.reconcile_count = 0
+        self.last_outcome = "none"
+        # self-repair state: the last desired mode whose reconcile failed,
+        # and the earliest monotonic time a retry may run (VERDICT r1
+        # item 8 — heal half-flipped slices without operator relabeling)
+        self._repair_mode: Optional[str] = None
+        self._repair_due: float = 0.0
 
     # ------------------------------------------------------------ plumbing
     def _set_state_label(self, value: str) -> None:
@@ -138,6 +144,7 @@ class CCManagerAgent:
         FatalModeError."""
         start = time.monotonic()
         outcome = "error"
+        self.last_outcome = "error"
         with self.tracer.span("reconcile", mode=raw_mode) as root_span:
             try:
                 if self.slice_coordinator is not None:
@@ -184,11 +191,51 @@ class CCManagerAgent:
                 return False
             finally:
                 dur = time.monotonic() - start
+                self.last_outcome = outcome
                 root_span.attrs["outcome"] = outcome
                 self.metrics.reconcile_duration.observe(dur)
                 self.metrics.reconciles_total.inc(outcome)
                 self.reconcile_count += 1
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
+
+    # -------------------------------------------------------------- repair
+    def _note_outcome(self, mode: str, ok: bool) -> None:
+        """Arm (or disarm) the self-repair retry after a reconcile.
+
+        Only *retryable* failures arm it: an invalid label value fails
+        deterministically until the operator fixes the label, and that
+        label change triggers its own reconcile — retrying would just
+        churn the API server."""
+        if (
+            ok
+            or not self.cfg.repair_interval_s
+            or self._stop.is_set()
+            or self.last_outcome not in ("failure", "slice_abort", "error")
+        ):
+            self._repair_mode = None
+            return
+        self._repair_mode = mode
+        self._repair_due = time.monotonic() + self.cfg.repair_interval_s
+
+    def _maybe_repair(self) -> None:
+        """Idle-tick self-repair: retry the last failed reconcile.
+
+        The reference retries only on the next label *event*
+        (cmd/main.go:164-167) — but a half-flipped slice never produces
+        one: the desired label is already correct, only this node's
+        device state (and ``cc.mode.state=failed``) lag. Retrying here
+        re-enters the slice protocol, observes the still-actionable
+        quorum commit on the anchor, and converges the laggard without
+        any operator relabeling (VERDICT r1 item 8). Plain (non-slice)
+        device faults heal the same way.
+        """
+        if self._repair_mode is None or time.monotonic() < self._repair_due:
+            return
+        mode = self._repair_mode
+        log.info("self-repair: retrying failed reconcile to %r", mode)
+        self.metrics.repairs_total.inc()
+        ok = self.reconcile(mode)
+        self._note_outcome(mode, ok)
 
     # ---------------------------------------------------------------- run
     def run(self, max_reconciles: Optional[int] = None) -> int:
@@ -213,6 +260,7 @@ class CCManagerAgent:
             mode = with_default(initial, cfg.default_mode)
             if mode is not None:
                 ok = self.reconcile(mode)
+                self._note_outcome(mode, ok)
                 if not ok and initial is None:
                     # startup default-apply failure is fatal in the Go agent
                     # (cmd/main.go:141-145)
@@ -230,13 +278,18 @@ class CCManagerAgent:
                 if not got:
                     if max_reconciles is not None and self.reconcile_count >= max_reconciles:
                         break
+                    self._maybe_repair()
                     continue
                 if self._stop.is_set():
                     break
                 mode = with_default(value, cfg.default_mode)
                 if mode is None:
+                    # desired mode withdrawn (label removed, no default):
+                    # a pending repair must not re-apply the stale mode
+                    self._repair_mode = None
                     continue
-                self.reconcile(mode)  # failure: log + continue (go :164-167)
+                ok = self.reconcile(mode)  # failure: log + continue (go :164-167)
+                self._note_outcome(mode, ok)
                 if max_reconciles is not None and self.reconcile_count >= max_reconciles:
                     break
             if self._fatal is not None:

@@ -18,6 +18,7 @@ flag                      env                            default
 (none)                    CC_CAPABLE_DEVICE_IDS          "" (all Google chips capable)
 --health-port             HEALTH_PORT                    8089 (0 disables)
 (none)                    SLICE_COORDINATION             "false"
+(none)                    REPAIR_INTERVAL_S              30 (0 disables self-repair)
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
@@ -57,6 +58,12 @@ class AgentConfig:
     readiness_file: str = DEFAULT_READINESS_FILE
     health_port: int = 8089
     slice_coordination: bool = False
+    #: Seconds between self-repair retries of a failed reconcile (device
+    #: fault or slice abort). The reference only retries on the *next
+    #: label event* (cmd/main.go:164-167) — which for a half-flipped
+    #: slice never comes, because the desired label is already correct.
+    #: 0 disables.
+    repair_interval_s: float = 30.0
     trace_file: Optional[str] = None
 
     def __post_init__(self):
@@ -64,6 +71,11 @@ class AgentConfig:
             raise ValueError(
                 f"invalid DRAIN_STRATEGY {self.drain_strategy!r}: "
                 "must be components|node|none"
+            )
+        if self.repair_interval_s < 0:
+            raise ValueError(
+                f"invalid REPAIR_INTERVAL_S {self.repair_interval_s!r}: "
+                "must be >= 0 (0 disables self-repair)"
             )
 
 
@@ -200,6 +212,7 @@ def parse_config(argv: Optional[List[str]] = None):
         readiness_file=os.environ.get("CC_READINESS_FILE", DEFAULT_READINESS_FILE),
         health_port=args.health_port,
         slice_coordination=_env_bool("SLICE_COORDINATION", False),
+        repair_interval_s=float(os.environ.get("REPAIR_INTERVAL_S", "30")),
         trace_file=os.environ.get("CC_TRACE_FILE") or None,
     )
     return cfg, args

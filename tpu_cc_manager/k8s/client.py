@@ -19,7 +19,12 @@ import subprocess
 import tempfile
 import threading
 import urllib.parse
-from http.client import HTTPConnection, HTTPException, HTTPSConnection
+from http.client import (
+    BadStatusLine,
+    HTTPConnection,
+    HTTPException,
+    HTTPSConnection,
+)
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
@@ -508,20 +513,27 @@ class HttpKubeClient(KubeClient):
             except ExecCredentialError as e:
                 raise ApiException(0, f"exec credential failure: {e}") from e
             except (OSError, HTTPException) as e:
-                # the server closes idle keep-alive connections; a request
-                # racing that close dies before any bytes of response
-                # (RemoteDisconnected/BadStatusLine/reset) — safe to replay
-                # once on a fresh connection. Failures on a fresh
-                # connection are real transport errors: surface as an API
-                # error (status 0) so callers' retry/backoff paths — not a
-                # raw traceback — handle it
+                # Replay ONLY the stale keep-alive race: a reused
+                # connection the server closed before sending any response
+                # bytes (RemoteDisconnected/BadStatusLine — Go's net/http
+                # retries exactly this on reused connections). Anything
+                # else — a timeout or reset mid-response, any failure on a
+                # fresh connection — may have already executed server-side,
+                # so replaying a non-idempotent PATCH/DELETE would double-
+                # apply it; surface as an API error (status 0) and let the
+                # caller's retry/backoff own the decision.
                 self._drop_pooled()
-                if fresh or attempt == 1:
+                replayable = isinstance(e, BadStatusLine) and not fresh
+                if not replayable or attempt == 1:
                     raise ApiException(0, f"transport error: {e}") from e
         if resp.status == 401 and _auth_retry and self.config.exec_plugin:
             # cached exec credential revoked server-side: refresh once
-            # (client-go invalidate-and-retry contract)
+            # (client-go invalidate-and-retry contract). Drop the pooled
+            # connection too — a refreshed exec client *certificate* only
+            # takes effect on a new TLS handshake, so retrying over the
+            # old session would 401 forever.
             self.config.exec_plugin.invalidate()
+            self._drop_pooled()
             return self._request(
                 method, path, body=body, content_type=content_type,
                 read_timeout=read_timeout, _auth_retry=False,

@@ -239,6 +239,11 @@ class Metrics:
             "tpu_cc_coalesced_updates_total",
             "Label updates absorbed by coalescing without a reconcile",
         )
+        self.repairs_total = Counter(
+            "tpu_cc_repairs_total",
+            "Self-repair retries of a failed reconcile (half-flipped-slice "
+            "healing included)",
+        )
         self.phase_duration = HistogramVec(
             "tpu_cc_phase_duration_seconds",
             "Wall-clock duration of one reconcile phase (trace span)",
@@ -261,6 +266,7 @@ class Metrics:
             self.watch_errors_total,
             self.current_mode,
             self.coalesced_total,
+            self.repairs_total,
             self.phase_duration,
         ):
             lines.extend(m.render())

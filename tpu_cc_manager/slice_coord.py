@@ -19,6 +19,19 @@ restarts and is kubectl-observable):
   node name is the leader. Deterministic — every member computes the same
   answer from the same node list; no election messages. If the leader
   dies its heartbeat stales out and the next member takes over.
+- **Commit fencing (CAS)**: commits live on the *anchor* node — the
+  lexicographically smallest member of the slice, alive or not (the node
+  *object* always exists even when its agent is down). The leader writes
+  the commit with ``replace_node`` preconditioned on the anchor's
+  resourceVersion (Kubernetes optimistic concurrency, the mechanism
+  client-go's leader-election leases use). Two members that both believe
+  they are leader during a heartbeat-staleness window therefore race a
+  compare-and-swap on one object: exactly one write per epoch wins, the
+  loser gets 409 Conflict, re-reads, and finds the round already
+  committed. Members always *read* commits from the anchor, so divergent
+  leaders can never produce divergent observed commits. The winning
+  leader also records ``cc.slice.leader=<name>`` and
+  ``cc.slice.epoch=<epoch>`` on the anchor for auditability.
 - **Epochs**: rounds are ordered by the cluster's resourceVersion, which
   is globally monotone (etcd revision). The leader stamps each commit
   with the highest member rv it observed; members remember the epoch of
@@ -33,7 +46,7 @@ restarts and is kubectl-observable):
      ("I see the new desired mode and am ready to flip");
   2. the leader, once ALL alive members ack the same mode and not all of
      them have already completed it, publishes
-     ``cc.slice.commit=<mode>:<epoch>`` on its own node;
+     ``cc.slice.commit=<mode>:<epoch>`` on the anchor node via CAS;
   3. members flip locally only after observing a commit whose mode
      equals the mode they acked and whose epoch is newer than their done
      epoch; then they record ``cc.slice.done``.
@@ -46,7 +59,11 @@ restarts and is kubectl-observable):
   audit catches exactly this), never a silently mixed one. Full
   atomicity under arbitrary timing is the two-generals problem; the
   protocol guarantees no member *flips* without a quorum commit, and
-  every divergence is published.
+  every divergence is published. Divergences also *heal*: the agent's
+  self-repair loop (CCManagerAgent._maybe_repair) retries the failed
+  reconcile, and because the quorum commit on the anchor stays
+  actionable until the laggard records ``done``, the retry converges the
+  slice without a new quorum round or any operator relabeling.
 
 Divergent per-slice policies (BASELINE config 5) fall out naturally:
 coordination is scoped to one slice id, so two slices of one pool can
@@ -61,7 +78,7 @@ import time
 from typing import List, Optional, Tuple
 
 from tpu_cc_manager import labels as L
-from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.k8s.client import ApiException, ConflictError, KubeClient
 from tpu_cc_manager.trace import Tracer, get_tracer
 
 log = logging.getLogger("tpu-cc-manager.slice")
@@ -260,9 +277,9 @@ class SliceCoordinator:
 
                 if leader == self.node_name:
                     try:
-                        self._maybe_commit(raw_mode, alive)
+                        self._maybe_commit(raw_mode, alive, members)
                     except ApiException as e:
-                        # transient commit-PATCH failure: keep polling (the
+                        # transient commit-write failure: keep polling (the
                         # ack must stay published, so no retract here)
                         log.warning(
                             "slice %s: commit publish failed: %s",
@@ -271,17 +288,15 @@ class SliceCoordinator:
                         self._stop.wait(self.poll_s)
                         continue
 
-                leader_node = next(
-                    (n for n in members if n["metadata"]["name"] == leader),
-                    None,
+                # commits are read from the anchor (smallest member), the
+                # single fenced location — NOT from whichever node this
+                # member currently computes as leader
+                c_mode, c_epoch = _parse_stamp(
+                    self._ann(members[0], L.SLICE_COMMIT_ANNOTATION)
                 )
-                if leader_node is not None:
-                    c_mode, c_epoch = _parse_stamp(
-                        self._ann(leader_node, L.SLICE_COMMIT_ANNOTATION)
-                    )
-                    if c_mode == raw_mode and c_epoch > my_done_epoch:
-                        commit_epoch = c_epoch
-                        break
+                if c_mode == raw_mode and c_epoch > my_done_epoch:
+                    commit_epoch = c_epoch
+                    break
 
                 self._stop.wait(self.poll_s)
             wait_span.attrs["committed"] = commit_epoch is not None
@@ -292,12 +307,24 @@ class SliceCoordinator:
                 slice_id, commit_epoch,
             )
             ok = engine.set_mode(raw_mode)
-            try:
-                self._annotate_self(
-                    DONE_ANNOTATION, f"{raw_mode}:{commit_epoch}"
+            if ok:
+                try:
+                    self._annotate_self(
+                        DONE_ANNOTATION, f"{raw_mode}:{commit_epoch}"
+                    )
+                except ApiException as e:
+                    log.warning("could not record slice done: %s", e)
+            else:
+                # local flip failed AFTER the quorum commit: the slice is
+                # now visibly half-flipped (cc.mode.state=failed here).
+                # Leaving `done` unrecorded keeps the commit actionable,
+                # so the agent's repair loop re-converges this laggard
+                # without a new quorum round (VERDICT r1 item 8).
+                log.error(
+                    "slice %s: local flip to %r failed after commit epoch "
+                    "%d — slice is half-flipped until repaired",
+                    slice_id, raw_mode, commit_epoch,
                 )
-            except ApiException as e:
-                log.warning("could not record slice done: %s", e)
             return ok
 
         self._retract_ack()
@@ -310,9 +337,17 @@ class SliceCoordinator:
             shutting_down=shutting_down,
         )
 
-    def _maybe_commit(self, raw_mode: str, alive: List[dict]) -> None:
+    def _maybe_commit(
+        self, raw_mode: str, alive: List[dict], members: List[dict]
+    ) -> None:
         """Leader side: publish a fresh commit when every alive member has
-        acked this mode and not all of them have already completed it."""
+        acked this mode and not all of them have already completed it.
+
+        The write is a compare-and-swap on the anchor node (``members[0]``)
+        preconditioned on its resourceVersion, so concurrent would-be
+        leaders (heartbeat-staleness dual-leader window) produce exactly
+        one commit per epoch — the loser's PUT fails with 409 and the next
+        poll observes the winner's commit instead."""
         acks = [self._ann(n, L.SLICE_ACK_ANNOTATION) for n in alive]
         if not all(a == raw_mode for a in acks):
             return
@@ -320,23 +355,42 @@ class SliceCoordinator:
         laggard_epochs = [e for (m, e) in stamps if m != raw_mode]
         if not laggard_epochs:
             return  # round already completed everywhere; nothing to commit
-        # skip if the published commit is already actionable for every
-        # laggard (avoids re-commit churn while members catch up)
-        me = next(
-            n for n in alive if n["metadata"]["name"] == self.node_name
-        )
-        c_mode, c_epoch = _parse_stamp(
-            self._ann(me, L.SLICE_COMMIT_ANNOTATION)
-        )
+        # fresh read of the anchor: both the CAS precondition and the
+        # re-commit-churn check must see the latest committed state
+        anchor_name = members[0]["metadata"]["name"]
+        anchor = self.kube.get_node(anchor_name)
+        ann = anchor["metadata"].setdefault("annotations", {})
+        c_mode, c_epoch = _parse_stamp(ann.get(L.SLICE_COMMIT_ANNOTATION))
         if c_mode == raw_mode and c_epoch > max(laggard_epochs):
-            return
-        # epoch: the highest member rv observed — globally monotone, and
-        # necessarily newer than every done epoch from earlier rounds
-        epoch = max(int(n["metadata"]["resourceVersion"]) for n in alive)
-        log.info(
-            "slice leader %s committing %r at epoch %d (%d acks)",
-            self.node_name, raw_mode, epoch, len(acks),
+            return  # published commit already actionable for every laggard
+        # epoch: the highest member rv observed — globally monotone (etcd
+        # revision), and necessarily newer than every done epoch from
+        # earlier rounds
+        epoch = max(
+            int(n["metadata"]["resourceVersion"]) for n in alive + [anchor]
         )
-        self._annotate_self(
-            L.SLICE_COMMIT_ANNOTATION, f"{raw_mode}:{epoch}"
+        try:
+            prev_epoch = int(ann.get(L.SLICE_EPOCH_ANNOTATION, -1))
+        except ValueError:
+            prev_epoch = -1
+        if epoch <= prev_epoch:
+            return  # stale view of the slice; re-poll before writing
+        ann[L.SLICE_COMMIT_ANNOTATION] = f"{raw_mode}:{epoch}"
+        ann[L.SLICE_LEADER_ANNOTATION] = self.node_name
+        ann[L.SLICE_EPOCH_ANNOTATION] = str(epoch)
+        try:
+            self.kube.replace_node(anchor_name, anchor)
+        except ConflictError:
+            # a concurrent leader won the CAS; their commit (visible on
+            # the next poll) fences this epoch — do not retry blindly
+            log.info(
+                "slice commit CAS lost by %s for %r (epoch %d); deferring "
+                "to the concurrent writer",
+                self.node_name, raw_mode, epoch,
+            )
+            return
+        log.info(
+            "slice leader %s committed %r at epoch %d on anchor %s "
+            "(%d acks)",
+            self.node_name, raw_mode, epoch, anchor_name, len(acks),
         )
