@@ -196,6 +196,33 @@ int http_get(const std::string &path, std::string *body) {
   return status;
 }
 
+/* Merge-patch the node's observed-state label. Best-effort: the engine
+ * normally publishes cc.mode.state itself; the agent only writes it when
+ * it refuses to exec the engine at all (invalid desired mode), so the
+ * failure is still visible cluster-wide (reference main.py:300-307). */
+bool patch_state_label(const std::string &value) {
+  int fd = dial(g_api_host, g_api_port);
+  if (fd < 0) return false;
+  std::string body =
+      "{\"metadata\":{\"labels\":{\"tpu.google.com/cc.mode.state\":\"" +
+      value + "\"}}}";
+  char len[32];
+  snprintf(len, sizeof(len), "%zu", body.size());
+  std::string req = request_head("PATCH", "/api/v1/nodes/" + g_node_name) +
+                    "Content-Type: application/merge-patch+json\r\n"
+                    "Content-Length: " + len + "\r\nConnection: close\r\n\r\n" +
+                    body;
+  bool ok = send_all(fd, req);
+  std::string raw;
+  char buf[4096];
+  ssize_t r;
+  while (ok && (r = recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, r);
+  close(fd);
+  int status = -1;
+  if (ok) sscanf(raw.c_str(), "HTTP/1.%*d %d", &status);
+  return status >= 200 && status < 300;
+}
+
 /* ------------------------------------------------- targeted JSON scan */
 
 /* Extract the string value of `"key"` (tolerating whitespace around the
@@ -242,6 +269,8 @@ int run_engine(const std::string &mode) {
   if (!is_valid_mode(mode)) {
     logf("ERROR", "refusing to exec engine for invalid mode '%s'",
          mode.c_str());
+    if (!patch_state_label("failed"))
+      logf("WARN", "could not publish cc.mode.state=failed");
     return -1;
   }
   char cmd[1024];

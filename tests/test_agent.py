@@ -200,6 +200,38 @@ def test_agent_self_repair_heals_failed_reconcile(tmp_path):
     assert not t.is_alive()
 
 
+def test_agent_repair_backoff_is_exponential(tmp_path):
+    # A persistently failing reconcile must not retry at a fixed cadence:
+    # consecutive failures for the same mode double the repair delay
+    # (capped), so a wedged slice member cannot starve the event loop or
+    # hammer the API server.
+    backend = fake_backend(n_chips=1)
+    backend.chips[0].fail_set = True
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path, repair_interval_s=30.0)
+
+    agent.reconcile("on")
+    assert agent._repair_mode == "on" and agent._repair_failures == 1
+    first_due = agent._repair_due
+    agent.reconcile("on")
+    assert agent._repair_failures == 2
+    assert agent._repair_due - first_due >= 25.0  # ~2x base, not 1x
+    for _ in range(10):
+        agent.reconcile("on")
+    # capped at 32x the base interval
+    import time as _t
+    assert agent._repair_due - _t.monotonic() <= 32 * 30.0 + 1.0
+    # a different mode resets the ladder
+    agent.reconcile("devtools")
+    assert agent._repair_failures == 1
+    # success disarms and resets
+    backend.chips[0].fail_set = False
+    agent.reconcile("devtools")
+    assert agent._repair_mode is None and agent._repair_failures == 0
+
+
 def test_agent_repair_disabled_means_no_retry(tmp_path):
     backend = fake_backend(n_chips=1)
     backend.chips[0].fail_set = True

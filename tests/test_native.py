@@ -274,3 +274,197 @@ def test_python_ctypes_binding_interop(native_build, tmp_path, monkeypatch):
     # pure-Python store reads the same bytes
     py_store = ModeStateStore(state_dir)
     assert py_store.effective(chip.path, "cc") == "on"
+
+
+# ---------------------------------------------------------------------
+# Proxy-sidecar topology (deployments/manifests/daemonset-native.yaml)
+# ---------------------------------------------------------------------
+
+class LoopbackProxy:
+    """Pod-local loopback relay standing in for the `kubectl proxy`
+    sidecar the native DaemonSet manifest declares: the agent and the
+    bash engine dial 127.0.0.1:<port>, the relay forwards the byte
+    stream (including the chunked watch long-poll) to the API server.
+    kubectl proxy additionally owns TLS + SA auth; the fake API server
+    speaks plain HTTP, so a transparent relay reproduces the exact
+    in-pod network topology."""
+
+    def __init__(self, upstream_port):
+        import socket
+
+        self.upstream_port = upstream_port
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self.connections = 0
+        import threading
+
+        self._t = threading.Thread(target=self._accept_loop, daemon=True)
+        self._t.start()
+
+    def _accept_loop(self):
+        import socket
+        import threading
+
+        while not self._stop:
+            try:
+                client, _ = self._srv.accept()
+            except OSError:
+                return
+            self.connections += 1
+            try:
+                upstream = socket.create_connection(
+                    ("127.0.0.1", self.upstream_port)
+                )
+            except OSError:
+                client.close()
+                continue
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(
+                    target=self._pump, args=(a, b), daemon=True
+                ).start()
+
+    @staticmethod
+    def _pump(src, dst):
+        import socket
+
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def test_cpp_agent_full_native_path_through_proxy_sidecar(
+    native_build, apiserver, tmp_path
+):
+    """The exact wiring daemonset-native.yaml schedules: C++ agent →
+    loopback proxy hop → API server for the watch, and per reconcile the
+    agent execs the bash engine, which drives devices through tpudevctl
+    and publishes the state label through the same proxy hop."""
+    sysfs, dev = make_accel_tree(tmp_path, n=2)
+    apiserver.store.add_node(
+        make_node("native-node", labels={L.CC_MODE_LABEL: "off"})
+    )
+    proxy = LoopbackProxy(apiserver.port)
+    script = os.path.join(REPO, "scripts", "tpu-cc-manager.sh")
+    env = dict(os.environ)
+    env.pop("CC_CAPABLE_DEVICE_IDS", None)
+    env.update(
+        NODE_NAME="native-node",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(proxy.port),
+        TPU_CC_ENGINE_CMD=f"bash {script} set-cc-mode -a -m %s",
+        TPU_SYSFS_ROOT=sysfs,
+        TPU_DEV_ROOT=dev,
+        TPU_CC_STATE_DIR=str(tmp_path / "state"),
+        TPUDEVCTL=os.path.join(native_build, "tpudevctl"),
+        EVICT_OPERATOR_COMPONENTS="false",
+        CC_READINESS_FILE=str(tmp_path / "run" / ".ready"),
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+
+    def state_label():
+        node = apiserver.store.get_node("native-node")
+        return node["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL)
+
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and state_label() != "off":
+            time.sleep(0.1)
+        assert state_label() == "off", "initial reconcile never completed"
+
+        apiserver.store.set_node_labels(
+            "native-node", {L.CC_MODE_LABEL: "on"}
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and state_label() != "on":
+            time.sleep(0.1)
+        assert state_label() == "on", "flip through proxy never completed"
+
+        # the device store really flipped (bash engine → tpudevctl)
+        store = ModeStateStore(str(tmp_path / "state"))
+        for i in range(2):
+            assert store.effective(f"{dev}/accel{i}", "cc") == "on"
+        # every byte travelled the sidecar hop
+        assert proxy.connections > 0
+        # the engine touched the readiness file (reference :536 parity)
+        assert os.path.exists(env["CC_READINESS_FILE"])
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        proxy.stop()
+
+
+def test_cpp_agent_publishes_failed_on_invalid_mode(
+    native_build, apiserver, tmp_path
+):
+    """An invalid desired mode is refused before exec (shell-injection
+    guard), but the refusal must still be visible cluster-wide as
+    cc.mode.state=failed (reference main.py:300-307 contract)."""
+    out_file = tmp_path / "calls.txt"
+    apiserver.store.add_node(
+        make_node("inode", labels={L.CC_MODE_LABEL: "off"})
+    )
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="inode",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+
+    def state_label():
+        node = apiserver.store.get_node("inode")
+        return node["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL)
+
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if out_file.exists() and "off" in out_file.read_text():
+                break
+            time.sleep(0.05)
+        assert out_file.exists()
+
+        apiserver.store.set_node_labels("inode", {L.CC_MODE_LABEL: "rm -rf"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and state_label() != "failed":
+            time.sleep(0.05)
+        assert state_label() == "failed"
+        # the invalid value never reached a shell
+        assert out_file.read_text().split() == ["off"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -104,6 +104,7 @@ class CCManagerAgent:
         # item 8 — heal half-flipped slices without operator relabeling)
         self._repair_mode: Optional[str] = None
         self._repair_due: float = 0.0
+        self._repair_failures = 0  # consecutive failures for one mode
 
     # ------------------------------------------------------------ plumbing
     def _set_state_label(self, value: str) -> None:
@@ -144,7 +145,6 @@ class CCManagerAgent:
         FatalModeError."""
         start = time.monotonic()
         outcome = "error"
-        self.last_outcome = "error"
         with self.tracer.span("reconcile", mode=raw_mode) as root_span:
             try:
                 if self.slice_coordinator is not None:
@@ -192,6 +192,7 @@ class CCManagerAgent:
             finally:
                 dur = time.monotonic() - start
                 self.last_outcome = outcome
+                self._arm_repair(raw_mode, outcome)
                 root_span.attrs["outcome"] = outcome
                 self.metrics.reconcile_duration.observe(dur)
                 self.metrics.reconciles_total.inc(outcome)
@@ -199,23 +200,35 @@ class CCManagerAgent:
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
 
     # -------------------------------------------------------------- repair
-    def _note_outcome(self, mode: str, ok: bool) -> None:
-        """Arm (or disarm) the self-repair retry after a reconcile.
+    def _arm_repair(self, mode: str, outcome: str) -> None:
+        """Arm (or disarm) the self-repair retry; runs at the end of
+        every reconcile.
 
         Only *retryable* failures arm it: an invalid label value fails
         deterministically until the operator fixes the label, and that
         label change triggers its own reconcile — retrying would just
-        churn the API server."""
+        churn the API server. Consecutive failures for the same mode
+        back off exponentially (capped at 32x the base interval): a
+        persistently stuck slice member would otherwise cost a full
+        commit-timeout wait every repair_interval_s, starving the event
+        loop and hammering the API server with the slice wait's 1 Hz
+        node lists."""
         if (
-            ok
-            or not self.cfg.repair_interval_s
+            not self.cfg.repair_interval_s
             or self._stop.is_set()
-            or self.last_outcome not in ("failure", "slice_abort", "error")
+            or outcome not in ("failure", "slice_abort", "error")
         ):
             self._repair_mode = None
+            self._repair_failures = 0
             return
+        if mode != self._repair_mode:
+            self._repair_failures = 0
         self._repair_mode = mode
-        self._repair_due = time.monotonic() + self.cfg.repair_interval_s
+        self._repair_failures += 1
+        delay = self.cfg.repair_interval_s * min(
+            2 ** (self._repair_failures - 1), 32
+        )
+        self._repair_due = time.monotonic() + delay
 
     def _maybe_repair(self) -> None:
         """Idle-tick self-repair: retry the last failed reconcile.
@@ -234,8 +247,7 @@ class CCManagerAgent:
         mode = self._repair_mode
         log.info("self-repair: retrying failed reconcile to %r", mode)
         self.metrics.repairs_total.inc()
-        ok = self.reconcile(mode)
-        self._note_outcome(mode, ok)
+        self.reconcile(mode)  # re-arms (with backoff) or disarms itself
 
     # ---------------------------------------------------------------- run
     def run(self, max_reconciles: Optional[int] = None) -> int:
@@ -260,7 +272,6 @@ class CCManagerAgent:
             mode = with_default(initial, cfg.default_mode)
             if mode is not None:
                 ok = self.reconcile(mode)
-                self._note_outcome(mode, ok)
                 if not ok and initial is None:
                     # startup default-apply failure is fatal in the Go agent
                     # (cmd/main.go:141-145)
@@ -287,9 +298,9 @@ class CCManagerAgent:
                     # desired mode withdrawn (label removed, no default):
                     # a pending repair must not re-apply the stale mode
                     self._repair_mode = None
+                    self._repair_failures = 0
                     continue
-                ok = self.reconcile(mode)  # failure: log + continue (go :164-167)
-                self._note_outcome(mode, ok)
+                self.reconcile(mode)  # failure: log + continue (go :164-167)
                 if max_reconciles is not None and self.reconcile_count >= max_reconciles:
                     break
             if self._fatal is not None:

@@ -12,6 +12,12 @@ DISTROLESS_TAG ?= gcr.io/distroless/python3-debian12:nonroot
 # toolchain
 GXX_STD ?= c++17
 
+# kubectl in the debian image fronts the native agent as a kubectl-proxy
+# sidecar (the reference downloads kubectl into its ubi8 image the same
+# way, Dockerfile.ubi8:33-34); pinned to match the reference's client-go
+# line (go.mod: k8s.io/client-go v0.29.3)
+KUBECTL_VERSION ?= v1.29.3
+
 # operator-side / dev Python dep pins live in requirements-dev.txt
 # (single source of truth; nothing at runtime depends on them)
 
