@@ -203,9 +203,9 @@ int http_get(const std::string &path, std::string *body) {
 bool patch_state_label(const std::string &value) {
   int fd = dial(g_api_host, g_api_port);
   if (fd < 0) return false;
-  std::string body =
-      "{\"metadata\":{\"labels\":{\"tpu.google.com/cc.mode.state\":\"" +
-      value + "\"}}}";
+  std::string body = "{\"metadata\":{\"labels\":{\"" +
+                     std::string(kModeLabel) + ".state\":\"" + value +
+                     "\"}}}";
   char len[32];
   snprintf(len, sizeof(len), "%zu", body.size());
   std::string req = request_head("PATCH", "/api/v1/nodes/" + g_node_name) +

@@ -200,6 +200,10 @@ class CCManagerAgent:
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
 
     # -------------------------------------------------------------- repair
+    def _disarm_repair(self) -> None:
+        self._repair_mode = None
+        self._repair_failures = 0
+
     def _arm_repair(self, mode: str, outcome: str) -> None:
         """Arm (or disarm) the self-repair retry; runs at the end of
         every reconcile.
@@ -218,8 +222,7 @@ class CCManagerAgent:
             or self._stop.is_set()
             or outcome not in ("failure", "slice_abort", "error")
         ):
-            self._repair_mode = None
-            self._repair_failures = 0
+            self._disarm_repair()
             return
         if mode != self._repair_mode:
             self._repair_failures = 0
@@ -297,8 +300,7 @@ class CCManagerAgent:
                 if mode is None:
                     # desired mode withdrawn (label removed, no default):
                     # a pending repair must not re-apply the stale mode
-                    self._repair_mode = None
-                    self._repair_failures = 0
+                    self._disarm_repair()
                     continue
                 self.reconcile(mode)  # failure: log + continue (go :164-167)
                 if max_reconciles is not None and self.reconcile_count >= max_reconciles:
