@@ -157,11 +157,21 @@ std::string request_head(const std::string &method, const std::string &path) {
   return req;
 }
 
-/* Simple (non-streaming) GET: returns status, fills body (dechunked). */
-int http_get(const std::string &path, std::string *body) {
+/* One-shot (non-streaming) request: send, read to EOF, parse status,
+ * dechunk the payload. Shared by GET and PATCH so header construction,
+ * the recv loop, and status parsing have a single home. */
+int http_request(const std::string &method, const std::string &path,
+                 const std::string &extra_headers, const std::string &req_body,
+                 std::string *resp_body) {
   int fd = dial(g_api_host, g_api_port);
   if (fd < 0) return -1;
-  std::string req = request_head("GET", path) + "Connection: close\r\n\r\n";
+  std::string req = request_head(method, path) + extra_headers;
+  if (!req_body.empty()) {
+    char len[32];
+    snprintf(len, sizeof(len), "%zu", req_body.size());
+    req += "Content-Length: " + std::string(len) + "\r\n";
+  }
+  req += "Connection: close\r\n\r\n" + req_body;
   if (!send_all(fd, req)) {
     close(fd);
     return -1;
@@ -175,25 +185,31 @@ int http_get(const std::string &path, std::string *body) {
   if (hdr_end == std::string::npos) return -1;
   int status = -1;
   sscanf(raw.c_str(), "HTTP/1.%*d %d", &status);
-  std::string headers = raw.substr(0, hdr_end);
-  std::string payload = raw.substr(hdr_end + 4);
-  if (headers.find("Transfer-Encoding: chunked") != std::string::npos) {
-    /* dechunk */
-    std::string out;
-    size_t pos = 0;
-    while (pos < payload.size()) {
-      size_t eol = payload.find("\r\n", pos);
-      if (eol == std::string::npos) break;
-      long len = strtol(payload.substr(pos, eol - pos).c_str(), nullptr, 16);
-      if (len <= 0) break;
-      out += payload.substr(eol + 2, len);
-      pos = eol + 2 + len + 2;
+  if (resp_body != nullptr) {
+    std::string headers = raw.substr(0, hdr_end);
+    std::string payload = raw.substr(hdr_end + 4);
+    if (headers.find("Transfer-Encoding: chunked") != std::string::npos) {
+      /* dechunk */
+      std::string out;
+      size_t pos = 0;
+      while (pos < payload.size()) {
+        size_t eol = payload.find("\r\n", pos);
+        if (eol == std::string::npos) break;
+        long len = strtol(payload.substr(pos, eol - pos).c_str(), nullptr, 16);
+        if (len <= 0) break;
+        out += payload.substr(eol + 2, len);
+        pos = eol + 2 + len + 2;
+      }
+      *resp_body = out;
+    } else {
+      *resp_body = payload;
     }
-    *body = out;
-  } else {
-    *body = payload;
   }
   return status;
+}
+
+int http_get(const std::string &path, std::string *body) {
+  return http_request("GET", path, "", "", body);
 }
 
 /* Merge-patch the node's observed-state label. Best-effort: the engine
@@ -201,25 +217,12 @@ int http_get(const std::string &path, std::string *body) {
  * it refuses to exec the engine at all (invalid desired mode), so the
  * failure is still visible cluster-wide (reference main.py:300-307). */
 bool patch_state_label(const std::string &value) {
-  int fd = dial(g_api_host, g_api_port);
-  if (fd < 0) return false;
   std::string body = "{\"metadata\":{\"labels\":{\"" +
                      std::string(kModeLabel) + ".state\":\"" + value +
                      "\"}}}";
-  char len[32];
-  snprintf(len, sizeof(len), "%zu", body.size());
-  std::string req = request_head("PATCH", "/api/v1/nodes/" + g_node_name) +
-                    "Content-Type: application/merge-patch+json\r\n"
-                    "Content-Length: " + len + "\r\nConnection: close\r\n\r\n" +
-                    body;
-  bool ok = send_all(fd, req);
-  std::string raw;
-  char buf[4096];
-  ssize_t r;
-  while (ok && (r = recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, r);
-  close(fd);
-  int status = -1;
-  if (ok) sscanf(raw.c_str(), "HTTP/1.%*d %d", &status);
+  int status = http_request(
+      "PATCH", "/api/v1/nodes/" + g_node_name,
+      "Content-Type: application/merge-patch+json\r\n", body, nullptr);
   return status >= 200 && status < 300;
 }
 

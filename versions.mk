@@ -19,7 +19,9 @@ GXX_STD ?= c++17
 KUBECTL_VERSION ?= v1.29.3
 
 # operator-side / dev Python dep pins live in requirements-dev.txt
-# (single source of truth; nothing at runtime depends on them)
+# (single source of truth; the per-node agent needs none of them, but
+# Dockerfile.operator installs its jax/numpy lines into the operator
+# image — keep those pins image-safe)
 
 # registry
 REGISTRY ?= ghcr.io/example/tpu-cc-manager
