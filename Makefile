@@ -6,7 +6,7 @@ include versions.mk
 
 PYTHON ?= python3
 
-.PHONY: all build native test test-fast bench lint clean image
+.PHONY: all build native test test-fast bench lint clean image kind-smoke
 
 all: build
 
@@ -24,9 +24,15 @@ test-fast:
 bench:
 	$(PYTHON) bench.py
 
+# BASELINE config 1 executed: label->state round trip on a kind cluster
+# (or the manifest-faithful process smoke when docker is unavailable —
+# docs/kind-smoke.md has a captured run and the why)
+kind-smoke:
+	bash scripts/kind-smoke.sh
+
 lint:
-	$(PYTHON) -m compileall -q tpu_cc_manager bench.py __graft_entry__.py
-	bash -n scripts/tpu-cc-manager.sh
+	$(PYTHON) -m compileall -q tpu_cc_manager bench.py __graft_entry__.py scripts
+	bash -n scripts/tpu-cc-manager.sh scripts/kind-smoke.sh
 
 clean:
 	$(MAKE) -C native clean

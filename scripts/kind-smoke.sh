@@ -1,0 +1,59 @@
+#!/bin/bash
+# kind-smoke — BASELINE config 1 executed for real: label a node, watch
+# the agent reconcile a (synthetic) device and publish the state label.
+#
+# Two paths:
+#   1. kind + docker available: create a throwaway kind cluster, build +
+#      load the distroless image, apply the SHIPPED daemonset.yaml
+#      (patched only to point the device layer at a synthetic sysfs tree
+#      on the kind node — scripts/kind_smoke_patch.py), then drive the
+#      label->state round trip with kubectl.
+#   2. otherwise (this repo's sandbox has no docker daemon): the
+#      manifest-faithful process smoke scripts/kind_smoke_local.py — the
+#      same agent entrypoint, env block extracted from the same
+#      manifest, real HTTP API server. docs/kind-smoke.md records a
+#      captured run.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v kind >/dev/null && command -v kubectl >/dev/null \
+   && command -v docker >/dev/null && docker info >/dev/null 2>&1; then
+  CLUSTER=tpu-cc-smoke
+  IMAGE=tpu-cc-manager:kind-smoke
+  echo "[kind-smoke] kind path: creating cluster $CLUSTER"
+  kind create cluster --name "$CLUSTER" --wait 180s
+  trap 'kind delete cluster --name "$CLUSTER"' EXIT
+  docker build -f deployments/container/Dockerfile.distroless -t "$IMAGE" .
+  kind load docker-image "$IMAGE" --name "$CLUSTER"
+  NODE=$(kubectl get nodes -o name | head -1 | cut -d/ -f2)
+  # synthetic accel tree on the kind node (its /sys has no TPUs)
+  docker exec "$CLUSTER-control-plane" sh -c '
+    mkdir -p /var/tpu-smoke/sysfs/accel0/device /var/tpu-smoke/dev \
+             /var/tpu-smoke/state &&
+    printf "0x1ae0\n" > /var/tpu-smoke/sysfs/accel0/device/vendor &&
+    printf "0x0063\n" > /var/tpu-smoke/sysfs/accel0/device/device &&
+    touch /var/tpu-smoke/dev/accel0'
+  # make the DaemonSet's nodeAffinity match the kind node
+  kubectl label node "$NODE" cloud.google.com/gke-tpu-accelerator=tpu-v5p-slice
+  python3 scripts/kind_smoke_patch.py deployments/manifests/daemonset.yaml \
+    "$IMAGE" | kubectl apply -f -
+  kubectl -n tpu-system rollout status ds/tpu-cc-manager --timeout=180s
+  echo "[kind-smoke] label -> state round trip"
+  kubectl label node "$NODE" tpu.google.com/cc.mode=devtools --overwrite
+  for _ in $(seq 60); do
+    STATE=$(kubectl get node "$NODE" \
+      -o jsonpath='{.metadata.labels.tpu\.google\.com/cc\.mode\.state}')
+    [ "$STATE" = devtools ] && break
+    sleep 2
+  done
+  [ "$STATE" = devtools ] || {
+    echo "[kind-smoke] FAILED: state=$STATE"
+    kubectl -n tpu-system logs ds/tpu-cc-manager --tail=100
+    exit 1
+  }
+  echo "[kind-smoke] ALL PASS: cc.mode=devtools -> cc.mode.state=devtools"
+else
+  echo "[kind-smoke] kind/docker unavailable; running the" \
+       "manifest-faithful local smoke (see docs/kind-smoke.md)"
+  exec python3 scripts/kind_smoke_local.py
+fi

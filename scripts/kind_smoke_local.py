@@ -1,0 +1,224 @@
+#!/usr/bin/env python3
+"""Manifest-faithful local smoke — the no-docker fallback of
+`make kind-smoke` (BASELINE config 1: "main.py dry-run reconcile on kind
+cluster, mocked device list"; reference README_PYTHON.md:77-102 is the
+manual flow this scripts).
+
+Where the kind path schedules the shipped DaemonSet on a kind node, this
+fallback reproduces the same wiring as host processes:
+
+- the agent's environment is EXTRACTED FROM deployments/manifests/
+  daemonset.yaml (the literal env block the DaemonSet injects), so the
+  manifest's configuration is what gets smoke-tested;
+- the Kubernetes API server is the real-wire FakeApiServer (HTTP);
+- the device layer scans a synthetic accel sysfs tree (the manifest's
+  /sys hostPath has no TPUs on a workstation either — kind would use the
+  same TPU_SYSFS_ROOT override, scripts/kind_smoke_patch.py);
+- the agent is the real entrypoint (`python -m tpu_cc_manager`) run as a
+  subprocess.
+
+Substitutions a kind cluster would otherwise provide, each logged in the
+transcript: NODE_NAME (fieldRef spec.nodeName -> smoke node name),
+in-cluster service-account auth (-> kubeconfig file), hostPath volumes
+(-> scratch dirs). Exit code 0 = the label->state round trip converged.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_cc_manager import labels as L  # noqa: E402
+from tpu_cc_manager.k8s.apiserver import FakeApiServer  # noqa: E402
+from tpu_cc_manager.k8s.objects import make_node  # noqa: E402
+
+NODE = "kind-smoke-node"
+
+
+def log(msg):
+    print(f"[kind-smoke-local] {msg}", flush=True)
+
+
+def manifest_env():
+    """The agent container's env block, exactly as the DaemonSet ships it."""
+    path = os.path.join(REPO, "deployments", "manifests", "daemonset.yaml")
+    for doc in yaml.safe_load_all(open(path)):
+        if doc and doc.get("kind") == "DaemonSet":
+            ctr = doc["spec"]["template"]["spec"]["containers"][0]
+            env = {}
+            for e in ctr.get("env", []):
+                if "value" in e:
+                    env[e["name"]] = e["value"]
+                elif e.get("valueFrom", {}).get("fieldRef", {}).get(
+                    "fieldPath"
+                ) == "spec.nodeName":
+                    env[e["name"]] = NODE  # kubelet downward API analog
+            return env
+    raise SystemExit("no DaemonSet in manifest")
+
+
+def accel_tree(root):
+    sysfs = os.path.join(root, "sysfs")
+    dev = os.path.join(root, "dev")
+    os.makedirs(dev, exist_ok=True)
+    for i in range(2):
+        d = os.path.join(sysfs, f"accel{i}", "device")
+        os.makedirs(d)
+        open(os.path.join(d, "vendor"), "w").write("0x1ae0\n")
+        open(os.path.join(d, "device"), "w").write("0x0063\n")
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+    return sysfs, dev
+
+
+def state_label(store):
+    return store.get_node(NODE)["metadata"]["labels"].get(
+        L.CC_MODE_STATE_LABEL
+    )
+
+
+def wait_state(store, target, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if state_label(store) == target:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def main():
+    env_from_manifest = manifest_env()
+    log(f"env from daemonset.yaml: {json.dumps(env_from_manifest)}")
+
+    with tempfile.TemporaryDirectory(prefix="kind-smoke-") as scratch:
+        sysfs, dev = accel_tree(scratch)
+        server = FakeApiServer().start()
+        store = server.store
+        # the node the DaemonSet's affinity would match (accelerator
+        # label present, any value) with the manifest's component label
+        # so the "components" drain strategy has something to pause
+        store.add_node(
+            make_node(
+                NODE,
+                labels={
+                    L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+                    L.COMPONENT_LABELS[0]: "true",
+                },
+            )
+        )
+        kubeconfig = os.path.join(scratch, "kubeconfig.yaml")
+        with open(kubeconfig, "w") as f:
+            yaml.safe_dump(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Config",
+                    "current-context": "kind-smoke",
+                    "contexts": [
+                        {
+                            "name": "kind-smoke",
+                            "context": {"cluster": "local", "user": "dev"},
+                        }
+                    ],
+                    "clusters": [
+                        {
+                            "name": "local",
+                            "cluster": {
+                                "server": f"http://127.0.0.1:{server.port}"
+                            },
+                        }
+                    ],
+                    "users": [{"name": "dev", "user": {}}],
+                },
+                f,
+            )
+
+        env = dict(os.environ)
+        env.update(env_from_manifest)
+        readiness = os.path.join(
+            scratch, env_from_manifest["CC_READINESS_FILE"].lstrip("/")
+        )
+        env.update(
+            KUBECONFIG=kubeconfig,  # kind: in-cluster SA
+            PYTHONPATH=REPO,
+            TPU_SYSFS_ROOT=sysfs,  # kind: /var/tpu-smoke hostPath
+            TPU_DEV_ROOT=dev,
+            TPU_CC_STATE_DIR=os.path.join(scratch, "state"),
+            CC_READINESS_FILE=readiness,  # kind: validations hostPath
+        )
+        log("starting agent: python -m tpu_cc_manager "
+            f"(NODE_NAME={NODE}, DRAIN_STRATEGY="
+            f"{env_from_manifest.get('DRAIN_STRATEGY')})")
+        agent_log = open(os.path.join(scratch, "agent.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_cc_manager"],
+            env=env, stdout=agent_log, stderr=subprocess.STDOUT, cwd=REPO,
+        )
+        failures = []
+        try:
+            # 1. no cc.mode label -> DEFAULT_CC_MODE from the manifest
+            default = env_from_manifest.get("DEFAULT_CC_MODE", "on")
+            if wait_state(store, default):
+                log(f"PASS initial reconcile: cc.mode.state={default} "
+                    "(manifest DEFAULT_CC_MODE, label absent)")
+            else:
+                failures.append("initial default reconcile")
+            if os.path.exists(readiness):
+                log(f"PASS readiness file created: {readiness}")
+            else:
+                failures.append("readiness file")
+
+            # 2. health endpoints on the manifest's HEALTH_PORT
+            port = env_from_manifest.get("HEALTH_PORT")
+            if port:
+                for ep in ("healthz", "readyz"):
+                    code = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/{ep}", timeout=5
+                    ).status
+                    log(f"PASS /{ep} -> {code} (manifest probe path)")
+
+            # 3. label -> state round trip (the core of config 1)
+            for mode in ("devtools", "ici", "off"):
+                store.set_node_labels(NODE, {L.CC_MODE_LABEL: mode})
+                if wait_state(store, mode):
+                    log(f"PASS round trip: cc.mode={mode} -> "
+                        f"cc.mode.state={mode}")
+                else:
+                    failures.append(f"round trip {mode}")
+
+            # 4. invalid mode -> visible failure, agent stays up
+            store.set_node_labels(NODE, {L.CC_MODE_LABEL: "bogus"})
+            if wait_state(store, "failed"):
+                log("PASS invalid mode: cc.mode.state=failed")
+            else:
+                failures.append("invalid mode visibility")
+            if proc.poll() is None:
+                log("PASS agent still running after invalid mode")
+            else:
+                failures.append("agent exited")
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            agent_log.close()
+            server.stop()
+
+        if failures:
+            log(f"FAILED: {failures}")
+            print(open(os.path.join(scratch, "agent.log")).read()[-4000:])
+            return 1
+        log("ALL PASS — label->state round trip verified against the "
+            "manifest's env, device layer on synthetic sysfs tree")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Patch deployments/manifests/daemonset.yaml for a kind smoke run.
+
+kind nodes have no TPU accel devices, so the smoke points the device
+layer at a synthetic sysfs tree created on the kind node
+(/var/tpu-smoke, see scripts/kind-smoke.sh) — exactly the "fake device
+env" clause of BASELINE config 1. Everything else (RBAC, probes, env,
+readiness file, DaemonSet scheduling) runs as shipped.
+
+Usage: kind_smoke_patch.py <manifest> <image> | kubectl apply -f -
+"""
+
+import sys
+
+import yaml
+
+
+def patch(docs, image):
+    for doc in docs:
+        if not doc or doc.get("kind") != "DaemonSet":
+            continue
+        spec = doc["spec"]["template"]["spec"]
+        ctr = spec["containers"][0]
+        ctr["image"] = image
+        ctr["imagePullPolicy"] = "Never"  # kind-loaded image
+        env = ctr.setdefault("env", [])
+        env.extend(
+            [
+                {"name": "TPU_SYSFS_ROOT", "value": "/var/tpu-smoke/sysfs"},
+                {"name": "TPU_DEV_ROOT", "value": "/var/tpu-smoke/dev"},
+                {"name": "TPU_CC_STATE_DIR", "value": "/var/tpu-smoke/state"},
+            ]
+        )
+        ctr.setdefault("volumeMounts", []).append(
+            {"name": "tpu-smoke", "mountPath": "/var/tpu-smoke"}
+        )
+        spec.setdefault("volumes", []).append(
+            {
+                "name": "tpu-smoke",
+                "hostPath": {
+                    "path": "/var/tpu-smoke",
+                    "type": "DirectoryOrCreate",
+                },
+            }
+        )
+    return docs
+
+
+def main():
+    manifest, image = sys.argv[1], sys.argv[2]
+    with open(manifest) as f:
+        docs = list(yaml.safe_load_all(f))
+    yaml.safe_dump_all(patch(docs, image), sys.stdout)
+
+
+if __name__ == "__main__":
+    main()
