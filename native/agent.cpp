@@ -62,6 +62,7 @@ std::string g_api_host = "127.0.0.1";
 int g_api_port = 8001;
 std::string g_engine_cmd =
     "python3 -m tpu_cc_manager set-cc-mode -m %s";
+int g_watch_timeout_s = 300; /* TPU_CC_WATCH_TIMEOUT_S; tests shrink it */
 std::string g_bearer_token;
 /* label value main() SUCCESSFULLY reconciled at startup; seeds the
  * watcher's change detection so the list-state push skips the no-change
@@ -326,8 +327,16 @@ void watch_loop(SyncableModeConfig *config) {
     }
   }
   while (!g_stop.load()) {
+    /* allowWatchBookmarks: the server periodically reports the latest
+     * resourceVersion even when this node is quiet, so resuming after a
+     * disconnect doesn't 410 into a full re-list at cluster scale
+     * (client-go informer behavior; generic rv tracking below advances
+     * on BOOKMARK events like on any other). */
+    char timeout_q[32];
+    snprintf(timeout_q, sizeof(timeout_q), "%d", g_watch_timeout_s);
     std::string path = "/api/v1/nodes?watch=true&fieldSelector=metadata.name%3D" +
-                       g_node_name + "&timeoutSeconds=300";
+                       g_node_name + "&timeoutSeconds=" + timeout_q +
+                       "&allowWatchBookmarks=true";
     if (!rv.empty()) path += "&resourceVersion=" + rv;
     int fd = dial(g_api_host, g_api_port);
     if (fd < 0) {
@@ -350,8 +359,10 @@ void watch_loop(SyncableModeConfig *config) {
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     /* stream: read headers, then dechunk NDJSON incrementally */
     std::string buf;
+    std::string lines; /* dechunked payload; may end mid-JSON-line */
     bool headers_done = false;
     bool error_seen = false;
+    bool stream_end = false; /* terminal 0-length chunk seen */
     char rbuf[8192];
     for (;;) {
       if (g_stop.load()) break;
@@ -374,7 +385,6 @@ void watch_loop(SyncableModeConfig *config) {
         headers_done = true;
       }
       /* dechunk complete chunks; process complete JSON lines */
-      std::string lines;
       for (;;) {
         size_t eol = buf.find("\r\n");
         if (eol == std::string::npos) break;
@@ -383,7 +393,14 @@ void watch_loop(SyncableModeConfig *config) {
         if (buf.size() < eol + 2 + static_cast<size_t>(len) + 2) break;
         lines += buf.substr(eol + 2, len);
         buf.erase(0, eol + 2 + len + 2);
-        if (len == 0) break;
+        if (len == 0) {
+          /* terminal chunk: the server ended the watch (its
+           * timeoutSeconds elapsed) but an HTTP/1.1 keep-alive
+           * connection stays open — waiting for TCP close here would
+           * hang the watch forever after the first server-side timeout */
+          stream_end = true;
+          break;
+        }
       }
       size_t start = 0, nl;
       while ((nl = lines.find('\n', start)) != std::string::npos) {
@@ -426,9 +443,17 @@ void watch_loop(SyncableModeConfig *config) {
           }
         }
       }
-      /* keep any partial line for the next recv */
+      /* keep the partial trailing line in `lines` for the next recv —
+       * it is DECHUNKED data and must never be mixed back into the
+       * chunk-encoded `buf` */
       lines.erase(0, start);
-      if (!lines.empty()) buf = lines + buf;
+      if (stream_end) {
+        /* a clean server-side timeout is a healthy cycle, not an error:
+         * without this reset, sporadic failures spread over days would
+         * still accumulate to the fatal-10 threshold on idle nodes */
+        consecutive_errors = 0;
+        break; /* close and re-establish */
+      }
     }
     close(fd);
     if (error_seen) {
@@ -453,6 +478,16 @@ int main(int argc, char **argv) {
   if ((env = getenv("KUBE_API_HOST"))) g_api_host = env;
   if ((env = getenv("KUBE_API_PORT"))) g_api_port = atoi(env);
   if ((env = getenv("TPU_CC_ENGINE_CMD"))) g_engine_cmd = env;
+  if ((env = getenv("TPU_CC_WATCH_TIMEOUT_S"))) {
+    int v = atoi(env);
+    if (v > 0) {
+      g_watch_timeout_s = v;
+    } else {
+      /* zero/negative/garbage would mean timeoutSeconds=0 -> the server
+       * ends every stream immediately -> busy reconnect loop */
+      fprintf(stderr, "ignoring invalid TPU_CC_WATCH_TIMEOUT_S '%s'\n", env);
+    }
+  }
   if ((env = getenv("BEARER_TOKEN_FILE"))) {
     FILE *f = fopen(env, "r");
     if (f) {
@@ -492,7 +527,7 @@ int main(int argc, char **argv) {
           "usage: tpu-cc-manager-agent [--node-name N] [-m MODE] "
           "[--api-host H] [--api-port P] [--engine-cmd CMD] [--version]\n"
           "env: NODE_NAME DEFAULT_CC_MODE KUBE_API_HOST KUBE_API_PORT "
-          "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE\n");
+          "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE TPU_CC_WATCH_TIMEOUT_S\n");
       return 0;
     } else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());

@@ -468,3 +468,60 @@ def test_cpp_agent_publishes_failed_on_invalid_mode(
             proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_cpp_agent_bookmarks_prevent_410_relists(
+    native_build, apiserver, tmp_path
+):
+    """With allowWatchBookmarks the agent's resume rv stays current
+    through idle periods, so short watch reconnects never hit 410 even
+    after the server compacts its event history (client-go informer
+    parity; Python twin behavior in watch.py)."""
+    out_file = tmp_path / "calls.txt"
+    err_file = open(tmp_path / "agent-stderr.log", "w")
+    apiserver.store.bookmark_every_s = 0.2
+    apiserver.store.add_node(
+        make_node("bmnode", labels={L.CC_MODE_LABEL: "off"})
+    )
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="bmnode",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+        TPU_CC_WATCH_TIMEOUT_S="1",  # force frequent resumes
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=err_file, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if out_file.exists() and "off" in out_file.read_text():
+                break
+            time.sleep(0.05)
+        assert out_file.exists()
+
+        # several reconnect cycles, each with the history compacted so a
+        # stale-rv resume would 410 into a re-list
+        for _ in range(3):
+            time.sleep(1.3)
+            apiserver.store.compact_watch_history()
+
+        apiserver.store.set_node_labels("bmnode", {L.CC_MODE_LABEL: "on"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "on" in out_file.read_text():
+                break
+            time.sleep(0.05)
+        assert out_file.read_text().split()[-1] == "on"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        err_file.close()
+    stderr = (tmp_path / "agent-stderr.log").read_text()
+    assert "watch 410" not in stderr, stderr

@@ -225,10 +225,17 @@ class _Handler(BaseHTTPRequestHandler):
                     "message": e.reason,
                 },
             }
-            _chunk(json.dumps(err).encode() + b"\n")
+            try:
+                _chunk(json.dumps(err).encode() + b"\n")
+            except (BrokenPipeError, ConnectionResetError):
+                return
         except (BrokenPipeError, ConnectionResetError):  # client went away
             return
-        _chunk(b"")  # terminating chunk
+        try:
+            _chunk(b"")  # terminating chunk
+        except (BrokenPipeError, ConnectionResetError):
+            # client disconnected between the last event and stream end
+            return
 
 
 class FakeApiServer:
