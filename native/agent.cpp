@@ -422,6 +422,10 @@ void watch_loop(SyncableModeConfig *config) {
                 last_pushed = st.mode;
                 config->Set(st.mode);
               }
+            } else {
+              /* rv is still stale: without backoff the next connect
+               * would 410 again instantly — a tight dial/410 loop */
+              error_seen = true;
             }
           } else {
             logf("WARN", "watch error event: %s", msg.c_str());
@@ -450,8 +454,10 @@ void watch_loop(SyncableModeConfig *config) {
       if (stream_end) {
         /* a clean server-side timeout is a healthy cycle, not an error:
          * without this reset, sporadic failures spread over days would
-         * still accumulate to the fatal-10 threshold on idle nodes */
-        consecutive_errors = 0;
+         * still accumulate to the fatal-10 threshold on idle nodes.
+         * An ERROR event on the same stream still counts — resetting
+         * unconditionally would make the fatal-10 exit unreachable. */
+        if (!error_seen) consecutive_errors = 0;
         break; /* close and re-establish */
       }
     }

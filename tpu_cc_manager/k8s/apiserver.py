@@ -208,33 +208,31 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         try:
-            for etype, obj in self.store.watch_nodes(
-                name=name,
-                resource_version=rv,
-                timeout_s=timeout_s,
-                allow_bookmarks=q.get("allowWatchBookmarks") == "true",
-            ):
-                _chunk(json.dumps({"type": etype, "object": obj}).encode() + b"\n")
-        except ApiException as e:
-            err = {
-                "type": "ERROR",
-                "object": {
-                    "kind": "Status",
-                    "code": e.status,
-                    "reason": "Expired" if e.status == 410 else "InternalError",
-                    "message": e.reason,
-                },
-            }
             try:
+                for etype, obj in self.store.watch_nodes(
+                    name=name,
+                    resource_version=rv,
+                    timeout_s=timeout_s,
+                    allow_bookmarks=q.get("allowWatchBookmarks") == "true",
+                ):
+                    _chunk(
+                        json.dumps({"type": etype, "object": obj}).encode()
+                        + b"\n"
+                    )
+            except ApiException as e:
+                err = {
+                    "type": "ERROR",
+                    "object": {
+                        "kind": "Status",
+                        "code": e.status,
+                        "reason": "Expired" if e.status == 410
+                        else "InternalError",
+                        "message": e.reason,
+                    },
+                }
                 _chunk(json.dumps(err).encode() + b"\n")
-            except (BrokenPipeError, ConnectionResetError):
-                return
-        except (BrokenPipeError, ConnectionResetError):  # client went away
-            return
-        try:
             _chunk(b"")  # terminating chunk
-        except (BrokenPipeError, ConnectionResetError):
-            # client disconnected between the last event and stream end
+        except (BrokenPipeError, ConnectionResetError):  # client went away
             return
 
 
