@@ -252,3 +252,57 @@ def test_agent_repair_disabled_means_no_retry(tmp_path):
     finally:
         agent.shutdown()
         t.join(timeout=10)
+
+
+def test_agent_emits_reconcile_events(tmp_path):
+    """Reconcile outcomes surface as core/v1 Events on the node, so
+    `kubectl describe node` carries the mode-flip history (capability the
+    reference lacks — it records outcomes only in a label + pod logs)."""
+    set_backend(fake_backend(n_chips=1))
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path)
+
+    assert agent.reconcile("on") is True
+    assert agent.reconcile("bogus") is False
+
+    events = kube.cluster_events
+    assert len(events) == 2
+    ok, bad = events
+    assert ok["reason"] == "CCModeApplied" and ok["type"] == "Normal"
+    # cluster-scoped involvedObject -> "default" ns (real apiserver rule)
+    assert ok["metadata"]["namespace"] == "default"
+    assert ok["involvedObject"] == {
+        "kind": "Node", "apiVersion": "v1", "name": "n1",
+    }
+    assert "'on': success" in ok["message"]
+    assert bad["reason"] == "CCModeInvalid" and bad["type"] == "Warning"
+    # unique names (k8s rejects duplicate event names in a namespace)
+    assert ok["metadata"]["name"] != bad["metadata"]["name"]
+
+
+def test_agent_event_emission_is_best_effort(tmp_path):
+    """A clientset without Events support (base-class 501) must never
+    affect the reconcile result."""
+    set_backend(fake_backend(n_chips=1))
+
+    class NoEventsKube(FakeKube):
+        def create_event(self, namespace, event):
+            from tpu_cc_manager.k8s.client import ApiException
+            raise ApiException(501, "nope")
+
+    kube = NoEventsKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path)
+    assert agent.reconcile("on") is True
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "on"
+
+
+def test_agent_events_disabled_by_config(tmp_path):
+    set_backend(fake_backend(n_chips=1))
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path, emit_events=False)
+    assert agent.reconcile("on") is True
+    assert kube.cluster_events == []

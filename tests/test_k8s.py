@@ -351,3 +351,22 @@ def test_bookmark_rv_survives_foreign_churn(server, client):
                                               resource_version=rv,
                                               timeout_s=1)]
     assert "MODIFIED" in etypes
+
+
+def test_http_client_creates_events_over_the_wire():
+    with FakeApiServer() as srv:
+        kube = HttpKubeClient(KubeConfig("127.0.0.1", srv.port, use_tls=False))
+        out = kube.create_event(
+            "tpu-system",
+            {
+                "kind": "Event", "apiVersion": "v1",
+                "metadata": {"name": "n1.cc-reconcile.1",
+                             "namespace": "tpu-system"},
+                "involvedObject": {"kind": "Node", "apiVersion": "v1",
+                                   "name": "n1"},
+                "reason": "CCModeApplied", "message": "m", "type": "Normal",
+            },
+        )
+        assert out["metadata"]["resourceVersion"]
+        assert srv.store.cluster_events[0]["reason"] == "CCModeApplied"
+        assert srv.store.cluster_events[0]["metadata"]["namespace"] == "tpu-system"

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import uuid
 import time
 from typing import Optional
 
@@ -105,6 +106,12 @@ class CCManagerAgent:
         self._repair_mode: Optional[str] = None
         self._repair_due: float = 0.0
         self._repair_failures = 0  # consecutive failures for one mode
+        # Event-name uniqueness: per-process counter + a startup-unique
+        # token, so a restarted agent never collides with the previous
+        # process's still-live events (409 AlreadyExists would silently
+        # drop them)
+        self._event_seq = 0
+        self._event_token = uuid.uuid4().hex[:8]
 
     # ------------------------------------------------------------ plumbing
     def _set_state_label(self, value: str) -> None:
@@ -193,11 +200,73 @@ class CCManagerAgent:
                 dur = time.monotonic() - start
                 self.last_outcome = outcome
                 self._arm_repair(raw_mode, outcome)
+                self._emit_reconcile_event(raw_mode, outcome, dur)
                 root_span.attrs["outcome"] = outcome
                 self.metrics.reconcile_duration.observe(dur)
                 self.metrics.reconciles_total.inc(outcome)
                 self.reconcile_count += 1
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
+
+    #: reconcile outcome -> (Event reason, Event type); shutdown is a
+    #: termination artifact, not an outcome worth recording
+    _EVENT_FOR_OUTCOME = {
+        "success": ("CCModeApplied", "Normal"),
+        "failure": ("CCModeFailed", "Warning"),
+        "error": ("CCModeFailed", "Warning"),
+        "invalid": ("CCModeInvalid", "Warning"),
+        "slice_abort": ("CCSliceAborted", "Warning"),
+        "fatal": ("CCModeFailed", "Warning"),
+    }
+
+    def _emit_reconcile_event(self, mode: str, outcome: str, dur: float) -> None:
+        """Best-effort core/v1 Event so `kubectl describe node` carries
+        the mode-flip history (the reference records outcomes only in a
+        label + pod logs). Never interferes with the reconcile result."""
+        if not self.cfg.emit_events:
+            return
+        hit = self._EVENT_FOR_OUTCOME.get(outcome)
+        if hit is None:
+            return
+        reason, etype = hit
+        self._event_seq += 1
+        node = self.cfg.node_name
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        # Nodes are cluster-scoped: a real apiserver only accepts their
+        # events in the "default" namespace (event.namespace must match
+        # involvedObject.namespace, which is empty)
+        ns = "default"
+        try:
+            self.kube.create_event(
+                ns,
+                {
+                    "kind": "Event",
+                    "apiVersion": "v1",
+                    "metadata": {
+                        "name": (
+                            f"{node}.cc-reconcile."
+                            f"{self._event_token}.{self._event_seq}"
+                        ),
+                        "namespace": ns,
+                    },
+                    "involvedObject": {
+                        "kind": "Node", "apiVersion": "v1", "name": node,
+                    },
+                    "reason": reason,
+                    "message": (
+                        f"cc mode reconcile to '{mode}': {outcome} "
+                        f"in {dur:.2f}s"
+                    ),
+                    "type": etype,
+                    "source": {"component": "tpu-cc-manager", "host": node},
+                    "firstTimestamp": now,
+                    "lastTimestamp": now,
+                    "count": 1,
+                },
+            )
+        except Exception as e:
+            # a clientset without Events support (501) or a transient API
+            # error must never affect the reconcile itself
+            log.debug("event emission skipped: %s", e)
 
     # -------------------------------------------------------------- repair
     def _disarm_repair(self) -> None:

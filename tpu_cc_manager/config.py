@@ -20,6 +20,7 @@ flag                      env                            default
 (none)                    SLICE_COORDINATION             "false"
 (none)                    REPAIR_INTERVAL_S              30 (0 disables self-repair)
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
+(none)                    EMIT_EVENTS                    true (reconcile Events)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
 ========================  =============================  =======================
@@ -65,6 +66,10 @@ class AgentConfig:
     #: 0 disables.
     repair_interval_s: float = 30.0
     trace_file: Optional[str] = None
+    #: Emit core/v1 Events on reconcile outcomes so `kubectl describe
+    #: node` shows the mode-flip history (the reference surfaces outcomes
+    #: only in labels + pod logs). Best-effort; EMIT_EVENTS=false disables.
+    emit_events: bool = True
 
     def __post_init__(self):
         if self.drain_strategy not in ("components", "node", "none"):
@@ -214,5 +219,6 @@ def parse_config(argv: Optional[List[str]] = None):
         slice_coordination=_env_bool("SLICE_COORDINATION", False),
         repair_interval_s=float(os.environ.get("REPAIR_INTERVAL_S", "30")),
         trace_file=os.environ.get("CC_TRACE_FILE") or None,
+        emit_events=_env_bool("EMIT_EVENTS", True),
     )
     return cfg, args

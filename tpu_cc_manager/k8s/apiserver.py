@@ -15,6 +15,7 @@ Endpoints:
 - ``GET    /api/v1/namespaces/{ns}/pods``
 - ``DELETE /api/v1/namespaces/{ns}/pods/{name}``
 - ``POST   /api/v1/namespaces/{ns}/pods/{name}/eviction``
+- ``POST   /api/v1/namespaces/{ns}/events``
 
 Watch responses are newline-delimited JSON event streams, ending when the
 ``timeoutSeconds`` window elapses (clean EOF), or a single ERROR event for
@@ -185,6 +186,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._read_body()
                 self.store.evict_pod(parts[3], parts[5])
                 return self._send_json(201, {"kind": "Status", "status": "Success"})
+            if (
+                len(parts) == 5
+                and parts[:3] == ["api", "v1", "namespaces"]
+                and parts[4] == "events"
+            ):
+                return self._send_json(
+                    201, self.store.create_event(parts[3], self._read_body())
+                )
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:
             return self._send_error_status(e)

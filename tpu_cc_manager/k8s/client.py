@@ -88,6 +88,13 @@ class KubeClient(abc.ABC):
         ApiException(410) when resource_version fell out of history —
         callers re-list and resume (reference main.py:675-687)."""
 
+    def create_event(self, namespace: str, event: dict) -> dict:
+        """Create a core/v1 Event (observability only — reconcile
+        outcomes surface in ``kubectl describe node``). Non-abstract so
+        minimal clientsets/test doubles keep working; callers treat
+        emission as best-effort."""
+        raise ApiException(501, "events not supported by this client")
+
     # convenience built on the primitives -------------------------------
     def set_node_labels(self, name: str, labels: Dict[str, Optional[str]]) -> dict:
         return self.patch_node(name, {"metadata": {"labels": labels}})
@@ -608,6 +615,11 @@ class HttpKubeClient(KubeClient):
                 "kind": "Eviction",
                 "metadata": {"name": name, "namespace": namespace},
             },
+        )
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        return self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/events", body=event
         )
 
     # -- watch ----------------------------------------------------------

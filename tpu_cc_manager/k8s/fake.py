@@ -65,6 +65,8 @@ class FakeKube(KubeClient):
         #: clients keep their resourceVersion current through
         #: other-object churn
         self.bookmark_every_s: Optional[float] = None
+        #: core/v1 Events recorded via create_event, keyed by namespace
+        self.cluster_events: List[dict] = []
 
     # ------------------------------------------------------------ helpers
     def _bump(self, obj: dict) -> None:
@@ -209,6 +211,15 @@ class FakeKube(KubeClient):
                 raise ApiException(404, f"pod {namespace}/{name} not found")
             del self._pods[(namespace, name)]
             self._lock.notify_all()
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        with self._lock:
+            stored = copy.deepcopy(event)
+            stored.setdefault("metadata", {})["namespace"] = namespace
+            self._rv += 1
+            stored["metadata"]["resourceVersion"] = str(self._rv)
+            self.cluster_events.append(stored)
+            return copy.deepcopy(stored)
 
     # ------------------------------------------------------------- watch
     def watch_nodes(
