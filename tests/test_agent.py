@@ -306,3 +306,46 @@ def test_agent_events_disabled_by_config(tmp_path):
     agent = _agent(kube, tmp_path, emit_events=False)
     assert agent.reconcile("on") is True
     assert kube.cluster_events == []
+
+
+def test_agent_node_drain_with_pdb_blocked_pod(tmp_path):
+    """GKE-native drain end-to-end through the real agent: cordon, PDB
+    429 retries while blocked, eviction once released, flip, uncordon
+    (the path the reference lacks entirely, SURVEY.md §7.1)."""
+    from tpu_cc_manager.k8s.objects import make_pod
+
+    backend = fake_backend(n_chips=2)
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("nd", labels={L.CC_MODE_LABEL: "on"}))
+    kube.add_pod(
+        make_pod("tpu-job", "default", labels={"tpu-workload": "y"},
+                 node_name="nd")
+    )
+    kube.pdb_blocked.add(("default", "tpu-job"))
+    agent = _agent(kube, tmp_path, node="nd", drain_strategy="node")
+    agent.engine._drainer.timeout_s = 10
+    agent.engine._drainer.poll_s = 0.1
+
+    done = {}
+
+    def run():
+        done["ok"] = agent.reconcile("on")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # while the PDB blocks, the node must already be cordoned
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if kube.get_node("nd").get("spec", {}).get("unschedulable"):
+            break
+        time.sleep(0.05)
+    assert kube.get_node("nd")["spec"].get("unschedulable") is True
+    kube.pdb_blocked.clear()
+    t.join(timeout=20)
+    assert done.get("ok") is True
+    assert kube.get_node("nd")["metadata"]["labels"][L.CC_MODE_STATE_LABEL] == "on"
+    # uncordoned and pod gone
+    assert not kube.get_node("nd")["spec"].get("unschedulable")
+    assert kube.list_pods("default", label_selector="tpu-workload=y") == []
+    assert all(c.query_cc_mode() == "on" for c in backend.chips)

@@ -112,6 +112,7 @@ class CCManagerAgent:
         # drop them)
         self._event_seq = 0
         self._event_token = uuid.uuid4().hex[:8]
+        self._event_warned = False
 
     # ------------------------------------------------------------ plumbing
     def _set_state_label(self, value: str) -> None:
@@ -264,9 +265,18 @@ class CCManagerAgent:
                 },
             )
         except Exception as e:
-            # a clientset without Events support (501) or a transient API
-            # error must never affect the reconcile itself
-            log.debug("event emission skipped: %s", e)
+            # must never affect the reconcile itself. A clientset without
+            # Events support (501) stays at debug; anything else (403 RBAC
+            # missing, 400 validation) warns once so a misconfigured
+            # deployment doesn't silently lose the whole feature.
+            if getattr(e, "status", None) == 501:
+                log.debug("event emission skipped: %s", e)
+            elif not self._event_warned:
+                self._event_warned = True
+                log.warning(
+                    "event emission failing (suppressing further "
+                    "warnings): %s", e,
+                )
 
     # -------------------------------------------------------------- repair
     def _disarm_repair(self) -> None:

@@ -65,7 +65,8 @@ class FakeKube(KubeClient):
         #: clients keep their resourceVersion current through
         #: other-object churn
         self.bookmark_every_s: Optional[float] = None
-        #: core/v1 Events recorded via create_event, keyed by namespace
+        #: core/v1 Events recorded via create_event: an append-ordered
+        #: flat list (each event carries metadata.namespace)
         self.cluster_events: List[dict] = []
 
     # ------------------------------------------------------------ helpers
@@ -215,6 +216,15 @@ class FakeKube(KubeClient):
     def create_event(self, namespace: str, event: dict) -> dict:
         with self._lock:
             stored = copy.deepcopy(event)
+            body_ns = stored.get("metadata", {}).get("namespace")
+            if body_ns is not None and body_ns != namespace:
+                # real apiserver rule: event.namespace must match the
+                # request path's namespace
+                raise ApiException(
+                    400,
+                    f"the namespace of the object ({body_ns}) does not "
+                    f"match the namespace on the request ({namespace})",
+                )
             stored.setdefault("metadata", {})["namespace"] = namespace
             self._rv += 1
             stored["metadata"]["resourceVersion"] = str(self._rv)
