@@ -202,6 +202,21 @@ def main():
                 log("PASS agent still running after invalid mode")
             else:
                 failures.append("agent exited")
+
+            # 5. reconcile Events recorded (kubectl-describe-node
+            # analog). Poll: the agent POSTs the event after the state
+            # label lands, so a single snapshot would race.
+            deadline = time.monotonic() + 10
+            reasons = []
+            while time.monotonic() < deadline:
+                reasons = [e["reason"] for e in store.list_events("default")]
+                if "CCModeApplied" in reasons and "CCModeInvalid" in reasons:
+                    break
+                time.sleep(0.2)
+            if "CCModeApplied" in reasons and "CCModeInvalid" in reasons:
+                log(f"PASS events recorded: {reasons}")
+            else:
+                failures.append(f"events missing: {reasons}")
         finally:
             proc.terminate()
             try:

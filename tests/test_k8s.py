@@ -370,3 +370,16 @@ def test_http_client_creates_events_over_the_wire():
         assert out["metadata"]["resourceVersion"]
         assert srv.store.cluster_events[0]["reason"] == "CCModeApplied"
         assert srv.store.cluster_events[0]["metadata"]["namespace"] == "tpu-system"
+
+
+def test_http_apiserver_lists_events_by_namespace():
+    with FakeApiServer() as srv:
+        kube = HttpKubeClient(KubeConfig("127.0.0.1", srv.port, use_tls=False))
+        for ns, name in (("default", "e1"), ("default", "e2"), ("other", "e3")):
+            kube.create_event(ns, {
+                "kind": "Event", "apiVersion": "v1",
+                "metadata": {"name": name},
+                "involvedObject": {"kind": "Node", "name": "n"},
+                "reason": "CCModeApplied", "message": "m", "type": "Normal"})
+        items = kube.list_events("default")
+        assert [e["metadata"]["name"] for e in items] == ["e1", "e2"]

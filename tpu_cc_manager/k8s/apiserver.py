@@ -16,6 +16,7 @@ Endpoints:
 - ``DELETE /api/v1/namespaces/{ns}/pods/{name}``
 - ``POST   /api/v1/namespaces/{ns}/pods/{name}/eviction``
 - ``POST   /api/v1/namespaces/{ns}/events``
+- ``GET    /api/v1/namespaces/{ns}/events``
 
 Watch responses are newline-delimited JSON event streams, ending when the
 ``timeoutSeconds`` window elapses (clean EOF), or a single ERROR event for
@@ -126,6 +127,16 @@ class _Handler(BaseHTTPRequestHandler):
                         cont=q.get("continue"),
                     )
                     return self._send_json(200, _list_obj("PodList", items, cont))
+            if (
+                len(parts) == 5
+                and parts[:3] == ["api", "v1", "namespaces"]
+                and parts[4] == "events"
+            ):
+                return self._send_json(
+                    200,
+                    _list_obj("EventList",
+                              self.store.list_events(parts[3]), None),
+                )
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:
             return self._send_error_status(e)

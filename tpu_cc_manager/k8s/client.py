@@ -95,6 +95,11 @@ class KubeClient(abc.ABC):
         emission as best-effort."""
         raise ApiException(501, "events not supported by this client")
 
+    def list_events(self, namespace: str) -> List[dict]:
+        """List the namespace's core/v1 Events (kubectl-describe analog
+        for smokes/tests)."""
+        raise ApiException(501, "events not supported by this client")
+
     # convenience built on the primitives -------------------------------
     def set_node_labels(self, name: str, labels: Dict[str, Optional[str]]) -> dict:
         return self.patch_node(name, {"metadata": {"labels": labels}})
@@ -621,6 +626,12 @@ class HttpKubeClient(KubeClient):
         return self._request(
             "POST", f"/api/v1/namespaces/{namespace}/events", body=event
         )
+
+    def list_events(self, namespace: str) -> List[dict]:
+        resp = self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/events"
+        )
+        return resp.get("items", [])
 
     # -- watch ----------------------------------------------------------
     def watch_nodes(

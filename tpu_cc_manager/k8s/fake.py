@@ -231,6 +231,13 @@ class FakeKube(KubeClient):
             self.cluster_events.append(stored)
             return copy.deepcopy(stored)
 
+    def list_events(self, namespace: str) -> List[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(e) for e in self.cluster_events
+                if e["metadata"]["namespace"] == namespace
+            ]
+
     # ------------------------------------------------------------- watch
     def watch_nodes(
         self,
