@@ -265,6 +265,7 @@ def test_agent_emits_reconcile_events(tmp_path):
 
     assert agent.reconcile("on") is True
     assert agent.reconcile("bogus") is False
+    assert agent.flush_events()
 
     events = kube.cluster_events
     assert len(events) == 2
@@ -295,6 +296,7 @@ def test_agent_event_emission_is_best_effort(tmp_path):
     kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
     agent = _agent(kube, tmp_path)
     assert agent.reconcile("on") is True
+    assert agent.flush_events()
     labels = kube.get_node("n1")["metadata"]["labels"]
     assert labels[L.CC_MODE_STATE_LABEL] == "on"
 
@@ -305,6 +307,7 @@ def test_agent_events_disabled_by_config(tmp_path):
     kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
     agent = _agent(kube, tmp_path, emit_events=False)
     assert agent.reconcile("on") is True
+    assert agent.flush_events()
     assert kube.cluster_events == []
 
 

@@ -18,6 +18,7 @@ Error philosophy (reference cmd/main.go:164-167 + main.py:300-307):
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import uuid
 import time
@@ -34,6 +35,9 @@ from tpu_cc_manager.trace import JsonlSink, Tracer, get_tracer
 from tpu_cc_manager.watch import FatalWatchError, NodeWatcher, SyncableModeConfig
 
 log = logging.getLogger("tpu-cc-manager.agent")
+
+#: sentinel telling the event-recorder worker to exit
+_EVENT_STOP: dict = {}
 
 
 def with_default(value: Optional[str], default: Optional[str]) -> Optional[str]:
@@ -113,6 +117,14 @@ class CCManagerAgent:
         self._event_seq = 0
         self._event_token = uuid.uuid4().hex[:8]
         self._event_warned = False
+        # Async event delivery (client-go EventRecorder parity): the
+        # reconcile loop enqueues, a daemon worker POSTs — an API-server
+        # hiccup or slow event write must never stretch reconcile
+        # latency. Bounded: overflow drops the event (observability,
+        # not correctness).
+        self._event_queue: "queue.Queue[dict]" = queue.Queue(maxsize=64)
+        self._event_worker: Optional[threading.Thread] = None
+        self._events_closed = False  # set by shutdown; no enqueues after
 
     # ------------------------------------------------------------ plumbing
     def _set_state_label(self, value: str) -> None:
@@ -236,47 +248,78 @@ class CCManagerAgent:
         # events in the "default" namespace (event.namespace must match
         # involvedObject.namespace, which is empty)
         ns = "default"
-        try:
-            self.kube.create_event(
-                ns,
-                {
-                    "kind": "Event",
-                    "apiVersion": "v1",
-                    "metadata": {
-                        "name": (
-                            f"{node}.cc-reconcile."
-                            f"{self._event_token}.{self._event_seq}"
-                        ),
-                        "namespace": ns,
-                    },
-                    "involvedObject": {
-                        "kind": "Node", "apiVersion": "v1", "name": node,
-                    },
-                    "reason": reason,
-                    "message": (
-                        f"cc mode reconcile to '{mode}': {outcome} "
-                        f"in {dur:.2f}s"
-                    ),
-                    "type": etype,
-                    "source": {"component": "tpu-cc-manager", "host": node},
-                    "firstTimestamp": now,
-                    "lastTimestamp": now,
-                    "count": 1,
-                },
+        event = {
+            "kind": "Event",
+            "apiVersion": "v1",
+            "metadata": {
+                "name": (
+                    f"{node}.cc-reconcile."
+                    f"{self._event_token}.{self._event_seq}"
+                ),
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "kind": "Node", "apiVersion": "v1", "name": node,
+            },
+            "reason": reason,
+            "message": (
+                f"cc mode reconcile to '{mode}': {outcome} in {dur:.2f}s"
+            ),
+            "type": etype,
+            "source": {"component": "tpu-cc-manager", "host": node},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        if self._events_closed:
+            return  # shutting down: a post-STOP enqueue would be stranded
+        if self._event_worker is None or not self._event_worker.is_alive():
+            self._event_worker = threading.Thread(
+                target=self._event_loop, daemon=True,
+                name="cc-event-recorder",
             )
-        except Exception as e:
-            # must never affect the reconcile itself. A clientset without
-            # Events support (501) stays at debug; anything else (403 RBAC
-            # missing, 400 validation) warns once so a misconfigured
-            # deployment doesn't silently lose the whole feature.
-            if getattr(e, "status", None) == 501:
-                log.debug("event emission skipped: %s", e)
-            elif not self._event_warned:
-                self._event_warned = True
-                log.warning(
-                    "event emission failing (suppressing further "
-                    "warnings): %s", e,
+            self._event_worker.start()
+        try:
+            self._event_queue.put_nowait(event)
+        except queue.Full:
+            log.debug("event queue full; dropping %s", reason)
+
+    def _event_loop(self) -> None:
+        """Daemon worker draining the event queue. One failed POST must
+        never affect a reconcile. A clientset without Events support
+        (501) stays at debug; anything else (403 RBAC missing, 400
+        validation) warns once so a misconfigured deployment doesn't
+        silently lose the whole feature."""
+        while True:
+            event = self._event_queue.get()
+            try:
+                if event is _EVENT_STOP:
+                    return
+                self.kube.create_event(
+                    event["metadata"]["namespace"], event
                 )
+            except Exception as e:
+                if getattr(e, "status", None) == 501:
+                    log.debug("event emission skipped: %s", e)
+                elif not self._event_warned:
+                    self._event_warned = True
+                    log.warning(
+                        "event emission failing (suppressing further "
+                        "warnings): %s", e,
+                    )
+            finally:
+                self._event_queue.task_done()
+
+    def flush_events(self, timeout: float = 5.0) -> bool:
+        """Block until queued events are delivered (tests + shutdown)."""
+        if self._event_worker is None or not self._event_worker.is_alive():
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._event_queue.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return False
 
     # -------------------------------------------------------------- repair
     def _disarm_repair(self) -> None:
@@ -399,6 +442,16 @@ class CCManagerAgent:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # close the recorder first (a reconcile finishing concurrently
+        # must not enqueue behind STOP and strand its event), then
+        # deliver what's queued and stop the worker
+        self._events_closed = True
+        self.flush_events(timeout=2.0)
+        if self._event_worker is not None and self._event_worker.is_alive():
+            try:
+                self._event_queue.put_nowait(_EVENT_STOP)
+            except queue.Full:
+                pass
         if self.slice_coordinator is not None:
             self.slice_coordinator.stop()
         self.watcher.stop()
