@@ -23,6 +23,7 @@
 #   EVICT_OPERATOR_COMPONENTS — default true
 #   TPUDEVCTL            — path to tpudevctl (default: alongside script or PATH)
 #   CC_READINESS_FILE    — touched after successful set (reference :536)
+#   EMIT_EVENTS          — default true; post core/v1 Events per outcome
 set -eo pipefail
 [ -n "$TPU_CC_DEBUG" ] && set -x   # reference runs with set -x (:3)
 
@@ -85,6 +86,27 @@ _label_from_json() {
 _set_state_label() {
   _patch_node_labels "{\"$MODE_LABEL_STATE\":\"$1\"}" \
     || log "WARN: could not set state label"
+}
+
+_post_event() {
+  # $1 = reason, $2 = type (Normal|Warning), $3 = message. Best-effort
+  # core/v1 Event against the node, matching the Python agent's emission
+  # (agent.py _emit_reconcile_event): namespace "default" because Nodes
+  # are cluster-scoped; unique name from PID + epoch + a per-run counter.
+  [ "${EMIT_EVENTS:-true}" = "true" ] || return 0
+  _EVENT_SEQ=$(( ${_EVENT_SEQ:-0} + 1 ))
+  local ts name
+  ts="$(date -u '+%Y-%m-%dT%H:%M:%SZ')"
+  name="$NODE_NAME.cc-engine.$$.$(date +%s).$_EVENT_SEQ"
+  curl -sf --max-time 10 -X POST -H "Content-Type: application/json" \
+    -d "{\"kind\":\"Event\",\"apiVersion\":\"v1\",\
+\"metadata\":{\"name\":\"$name\",\"namespace\":\"default\"},\
+\"involvedObject\":{\"kind\":\"Node\",\"apiVersion\":\"v1\",\"name\":\"$NODE_NAME\"},\
+\"reason\":\"$1\",\"message\":\"$3\",\"type\":\"$2\",\
+\"source\":{\"component\":\"tpu-cc-manager.sh\",\"host\":\"$NODE_NAME\"},\
+\"firstTimestamp\":\"$ts\",\"lastTimestamp\":\"$ts\",\"count\":1}" \
+    "$API/api/v1/namespaces/default/events" > /dev/null \
+    || log "WARN: could not post event $1"
 }
 
 # -------------------------------------------------- eviction (pause labels)
@@ -150,6 +172,7 @@ _reschedule_components() {
 # always restore on failure (reference _exit_failed, :210-215)
 _exit_failed() {
   _set_state_label "failed"
+  _post_event "CCModeFailed" "Warning" "cc mode flip failed on $NODE_NAME"
   _reschedule_components
   exit 1
 }
@@ -221,9 +244,13 @@ set_cc_mode() {
   local devices=()
   while read -r dev is_switch capable; do
     [ -n "$target_dev" ] && [ "$dev" != "$target_dev" ] && continue
-    # mixed-capability bailout (reference main.py:214-217 semantics)
+    # mixed-capability bailout (reference main.py:214-217 semantics);
+    # fatal, but still visible cluster-wide (Python agent parity: the
+    # "fatal" outcome emits CCModeFailed too)
     if [ "$capable" = "0" ] && [ "$is_switch" = "0" ] && [ "$mode" != "off" ]; then
       log "ERROR: $dev is not CC-capable; refusing mode '$mode' on a mixed node"
+      _post_event "CCModeFailed" "Warning" \
+        "refusing mode '$mode': non-capable device on a mixed node"
       exit 1
     fi
     devices+=("$dev")
@@ -242,6 +269,8 @@ set_cc_mode() {
   if [ $all_set -eq 1 ]; then
     log "all ${#devices[@]} device(s) already in mode '$mode'"
     _set_state_label "$mode"
+    _post_event "CCModeApplied" "Normal" \
+      "cc mode '$mode' already set on ${#devices[@]} device(s) (no-op)"
     return 0
   fi
 
@@ -253,6 +282,8 @@ set_cc_mode() {
     fi
   done
   _set_state_label "$mode"
+  _post_event "CCModeApplied" "Normal" \
+    "cc mode '$mode' applied to ${#devices[@]} device(s)"
   _reschedule_components
   if [ -n "$CC_READINESS_FILE" ]; then
     mkdir -p "$(dirname "$CC_READINESS_FILE")" && touch "$CC_READINESS_FILE"

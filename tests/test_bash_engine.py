@@ -137,3 +137,29 @@ def test_missing_node_name(env):
     r = run_sh(e2, "set-cc-mode", "-a", "-m", "on")
     assert r.returncode == 1
     assert "NODE_NAME" in r.stderr
+
+
+def test_bash_engine_posts_events(env):
+    e, server, tmp_path = env
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 0, r.stderr
+    evs = server.store.list_events("default")
+    assert [x["reason"] for x in evs] == ["CCModeApplied"]
+    assert evs[0]["type"] == "Normal"
+    assert evs[0]["involvedObject"]["name"] == "bash-node"
+    assert evs[0]["source"]["component"] == "tpu-cc-manager.sh"
+
+    # failure path: mixed-capability bailout posts a Warning
+    e3 = dict(e)
+    e3["CC_CAPABLE_DEVICE_IDS"] = "0xdead"
+    r = run_sh(e3, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode != 0
+    reasons = [x["reason"] for x in server.store.list_events("default")]
+    assert reasons == ["CCModeApplied", "CCModeFailed"]
+
+    # disabled via env (same knob as the Python agent)
+    e2 = dict(e)
+    e2["EMIT_EVENTS"] = "false"
+    r = run_sh(e2, "set-cc-mode", "-a", "-m", "off")
+    assert r.returncode == 0, r.stderr
+    assert len(server.store.list_events("default")) == 2

@@ -124,6 +124,10 @@ class CCManagerAgent:
         # not correctness).
         self._event_queue: "queue.Queue[dict]" = queue.Queue(maxsize=64)
         self._event_worker: Optional[threading.Thread] = None
+        # _event_lock makes close+enqueue atomic: without it a reconcile
+        # thread could pass the closed check, lose the CPU, and enqueue
+        # behind the stop sentinel into a dead queue
+        self._event_lock = threading.Lock()
         self._events_closed = False  # set by shutdown; no enqueues after
 
     # ------------------------------------------------------------ plumbing
@@ -271,18 +275,19 @@ class CCManagerAgent:
             "lastTimestamp": now,
             "count": 1,
         }
-        if self._events_closed:
-            return  # shutting down: a post-STOP enqueue would be stranded
-        if self._event_worker is None or not self._event_worker.is_alive():
-            self._event_worker = threading.Thread(
-                target=self._event_loop, daemon=True,
-                name="cc-event-recorder",
-            )
-            self._event_worker.start()
-        try:
-            self._event_queue.put_nowait(event)
-        except queue.Full:
-            log.debug("event queue full; dropping %s", reason)
+        with self._event_lock:
+            if self._events_closed:
+                return  # shutting down: would strand behind the sentinel
+            if self._event_worker is None or not self._event_worker.is_alive():
+                self._event_worker = threading.Thread(
+                    target=self._event_loop, daemon=True,
+                    name="cc-event-recorder",
+                )
+                self._event_worker.start()
+            try:
+                self._event_queue.put_nowait(event)
+            except queue.Full:
+                log.debug("event queue full; dropping %s", reason)
 
     def _event_loop(self) -> None:
         """Daemon worker draining the event queue. One failed POST must
@@ -442,10 +447,12 @@ class CCManagerAgent:
 
     def shutdown(self) -> None:
         self._stop.set()
-        # close the recorder first (a reconcile finishing concurrently
-        # must not enqueue behind STOP and strand its event), then
+        # close the recorder first (under the lock, so a reconcile
+        # finishing concurrently either enqueued before the close or
+        # skips emission entirely — nothing can land behind STOP), then
         # deliver what's queued and stop the worker
-        self._events_closed = True
+        with self._event_lock:
+            self._events_closed = True
         self.flush_events(timeout=2.0)
         if self._event_worker is not None and self._event_worker.is_alive():
             try:
