@@ -25,3 +25,54 @@ def test_parse_invalid_modes_loud(bad):
 def test_cc_modes_exclude_ici():
     assert Mode.ICI not in CC_MODES
     assert set(CC_MODES) == {Mode.ON, Mode.OFF, Mode.DEVTOOLS}
+
+
+def test_oneshot_cli_posts_reconcile_event(tmp_path, monkeypatch):
+    """The one-shot set-cc-mode CLI has the same Event visibility as the
+    agent and the bash engine."""
+    import os
+    import subprocess
+    import sys
+
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    from tpu_cc_manager.k8s.objects import make_node
+    import yaml
+
+    sysfs = tmp_path / "sysfs" / "accel0" / "device"
+    sysfs.mkdir(parents=True)
+    (sysfs / "vendor").write_text("0x1ae0\n")
+    (sysfs / "device").write_text("0x0063\n")
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    (dev / "accel0").write_text("")
+
+    with FakeApiServer() as srv:
+        srv.store.add_node(make_node("cli-node"))
+        kc = tmp_path / "kubeconfig.yaml"
+        kc.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Config", "current-context": "c",
+            "contexts": [{"name": "c",
+                          "context": {"cluster": "l", "user": "u"}}],
+            "clusters": [{"name": "l", "cluster": {
+                "server": f"http://127.0.0.1:{srv.port}"}}],
+            "users": [{"name": "u", "user": {}}],
+        }))
+        env = dict(os.environ)
+        env.update(
+            NODE_NAME="cli-node", KUBECONFIG=str(kc),
+            PYTHONPATH=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            TPU_SYSFS_ROOT=str(tmp_path / "sysfs"),
+            TPU_DEV_ROOT=str(dev),
+            TPU_CC_STATE_DIR=str(tmp_path / "state"),
+            DRAIN_STRATEGY="none",
+        )
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_cc_manager", "set-cc-mode",
+             "-m", "devtools"],
+            capture_output=True, text=True, env=env, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        evs = srv.store.list_events("default")
+        assert [e["reason"] for e in evs] == ["CCModeApplied"]
+        assert ".cc-oneshot." in evs[0]["metadata"]["name"]

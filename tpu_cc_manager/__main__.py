@@ -49,6 +49,10 @@ def main(argv=None) -> int:
         from tpu_cc_manager.device import describe_backend
         from tpu_cc_manager.device.base import _default_backend
 
+        # scope the backend override to this call — main() also runs
+        # in-process (tests, embedders), where a permanent os.environ
+        # mutation would silently re-route every later backend default
+        prev = _os.environ.get("TPU_CC_DEVICE_BACKEND")
         _os.environ["TPU_CC_DEVICE_BACKEND"] = args.backend
         try:
             out = describe_backend(_default_backend(), name=args.backend)
@@ -58,6 +62,11 @@ def main(argv=None) -> int:
                 indent=2, sort_keys=True,
             ))
             return 1
+        finally:
+            if prev is None:
+                _os.environ.pop("TPU_CC_DEVICE_BACKEND", None)
+            else:
+                _os.environ["TPU_CC_DEVICE_BACKEND"] = prev
         print(json.dumps(out, indent=2, sort_keys=True))
         return 0
 
@@ -105,6 +114,12 @@ def main(argv=None) -> int:
         return controller.run()
 
     if args.command == "set-cc-mode":
+        import time as _time
+        import uuid as _uuid
+
+        from tpu_cc_manager.drain import build_reconcile_event
+        from tpu_cc_manager.modes import InvalidModeError
+
         kube = _kube_client(cfg)
         engine = ModeEngine(
             set_state_label=lambda v: set_cc_mode_state_label(
@@ -113,10 +128,52 @@ def main(argv=None) -> int:
             drainer=build_drainer(kube, cfg),
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
         )
+
+        def _post_event(outcome: str, dur: float) -> None:
+            # same best-effort visibility as the agent / bash engine
+            if not cfg.emit_events:
+                return
+            event = build_reconcile_event(
+                cfg.node_name, args.mode, outcome, dur,
+                name=(
+                    f"{cfg.node_name}.cc-oneshot."
+                    f"{_uuid.uuid4().hex[:8]}"
+                ),
+            )
+            if event is None:
+                return
+            try:
+                kube.create_event(event["metadata"]["namespace"], event)
+            except Exception as e:
+                # agent-path parity: a clientset without Events support
+                # (501) is routine; anything else (403 RBAC, 400
+                # validation) deserves a visible warning
+                if getattr(e, "status", None) == 501:
+                    log.debug("event emission skipped: %s", e)
+                else:
+                    log.warning("event emission failed: %s", e)
+
+        t0 = _time.monotonic()
         try:
-            return 0 if engine.set_mode(args.mode) else 1
+            ok = engine.set_mode(args.mode)
+            _post_event("success" if ok else "failure",
+                        _time.monotonic() - t0)
+            return 0 if ok else 1
+        except InvalidModeError as e:
+            # agent-path parity (agent.py reconcile): a typo'd mode is a
+            # clean rejection (CCModeInvalid), not a flip failure
+            log.error("rejecting desired mode: %s", e)
+            try:
+                set_cc_mode_state_label(kube, cfg.node_name, "failed")
+            except Exception as pub_err:
+                log.error(
+                    "could not publish cc.mode.state=failed: %s", pub_err
+                )
+            _post_event("invalid", _time.monotonic() - t0)
+            return 1
         except FatalModeError as e:
             log.error("fatal: %s", e)
+            _post_event("fatal", _time.monotonic() - t0)
             return 1
         except Exception:
             # Never exit without publishing failure: the state label is the
@@ -130,6 +187,7 @@ def main(argv=None) -> int:
                 log.error(
                     "could not publish cc.mode.state=failed: %s", pub_err
                 )
+            _post_event("error", _time.monotonic() - t0)
             return 1
 
     # long-lived agent

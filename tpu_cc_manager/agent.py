@@ -25,7 +25,9 @@ import time
 from typing import Optional
 
 from tpu_cc_manager.config import AgentConfig
-from tpu_cc_manager.drain import build_drainer, set_cc_mode_state_label
+from tpu_cc_manager.drain import (
+    build_drainer, build_reconcile_event, set_cc_mode_state_label,
+)
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
 from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.modes import InvalidModeError
@@ -224,57 +226,22 @@ class CCManagerAgent:
                 self.reconcile_count += 1
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
 
-    #: reconcile outcome -> (Event reason, Event type); shutdown is a
-    #: termination artifact, not an outcome worth recording
-    _EVENT_FOR_OUTCOME = {
-        "success": ("CCModeApplied", "Normal"),
-        "failure": ("CCModeFailed", "Warning"),
-        "error": ("CCModeFailed", "Warning"),
-        "invalid": ("CCModeInvalid", "Warning"),
-        "slice_abort": ("CCSliceAborted", "Warning"),
-        "fatal": ("CCModeFailed", "Warning"),
-    }
-
     def _emit_reconcile_event(self, mode: str, outcome: str, dur: float) -> None:
         """Best-effort core/v1 Event so `kubectl describe node` carries
         the mode-flip history (the reference records outcomes only in a
         label + pod logs). Never interferes with the reconcile result."""
         if not self.cfg.emit_events:
             return
-        hit = self._EVENT_FOR_OUTCOME.get(outcome)
-        if hit is None:
-            return
-        reason, etype = hit
         self._event_seq += 1
-        node = self.cfg.node_name
-        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        # Nodes are cluster-scoped: a real apiserver only accepts their
-        # events in the "default" namespace (event.namespace must match
-        # involvedObject.namespace, which is empty)
-        ns = "default"
-        event = {
-            "kind": "Event",
-            "apiVersion": "v1",
-            "metadata": {
-                "name": (
-                    f"{node}.cc-reconcile."
-                    f"{self._event_token}.{self._event_seq}"
-                ),
-                "namespace": ns,
-            },
-            "involvedObject": {
-                "kind": "Node", "apiVersion": "v1", "name": node,
-            },
-            "reason": reason,
-            "message": (
-                f"cc mode reconcile to '{mode}': {outcome} in {dur:.2f}s"
+        event = build_reconcile_event(
+            self.cfg.node_name, mode, outcome, dur,
+            name=(
+                f"{self.cfg.node_name}.cc-reconcile."
+                f"{self._event_token}.{self._event_seq}"
             ),
-            "type": etype,
-            "source": {"component": "tpu-cc-manager", "host": node},
-            "firstTimestamp": now,
-            "lastTimestamp": now,
-            "count": 1,
-        }
+        )
+        if event is None:
+            return
         with self._event_lock:
             if self._events_closed:
                 return  # shutting down: would strand behind the sentinel
@@ -287,7 +254,8 @@ class CCManagerAgent:
             try:
                 self._event_queue.put_nowait(event)
             except queue.Full:
-                log.debug("event queue full; dropping %s", reason)
+                self.metrics.events_dropped_total.inc()
+                log.debug("event queue full; dropping %s", event["reason"])
 
     def _event_loop(self) -> None:
         """Daemon worker draining the event queue. One failed POST must
@@ -303,6 +271,7 @@ class CCManagerAgent:
                 self.kube.create_event(
                     event["metadata"]["namespace"], event
                 )
+                self.metrics.events_emitted_total.inc()
             except Exception as e:
                 if getattr(e, "status", None) == 501:
                     log.debug("event emission skipped: %s", e)

@@ -54,6 +54,51 @@ def set_cc_mode_state_label(kube: KubeClient, node_name: str, value: str) -> Non
     kube.set_node_labels(node_name, {L.CC_MODE_STATE_LABEL: value})
 
 
+#: reconcile outcome -> (core/v1 Event reason, Event type); "shutdown"
+#: is a termination artifact, not an outcome worth recording
+EVENT_FOR_OUTCOME = {
+    "success": ("CCModeApplied", "Normal"),
+    "failure": ("CCModeFailed", "Warning"),
+    "error": ("CCModeFailed", "Warning"),
+    "invalid": ("CCModeInvalid", "Warning"),
+    "slice_abort": ("CCSliceAborted", "Warning"),
+    "fatal": ("CCModeFailed", "Warning"),
+}
+
+
+def build_reconcile_event(
+    node_name: str, mode: str, outcome: str, duration_s: float, name: str
+) -> Optional[dict]:
+    """Core/v1 Event for one reconcile outcome, shared by the agent's
+    async recorder and the one-shot CLI (the bash engine builds the same
+    shape in _post_event). None for outcomes that don't record. Events
+    for cluster-scoped Nodes must live in the "default" namespace —
+    a real apiserver rejects event.namespace != involvedObject.namespace
+    (which is empty for Nodes)."""
+    hit = EVENT_FOR_OUTCOME.get(outcome)
+    if hit is None:
+        return None
+    reason, etype = hit
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    return {
+        "kind": "Event",
+        "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": "default"},
+        "involvedObject": {
+            "kind": "Node", "apiVersion": "v1", "name": node_name,
+        },
+        "reason": reason,
+        "message": (
+            f"cc mode reconcile to '{mode}': {outcome} in {duration_s:.2f}s"
+        ),
+        "type": etype,
+        "source": {"component": "tpu-cc-manager", "host": node_name},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+
+
 def paused_value(original: str) -> str:
     """Encode the pause marker, preserving the original for restore
     (reference gpu_operator_eviction.py:43-70 '<PAUSED_STR>_<original>')."""

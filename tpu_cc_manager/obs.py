@@ -239,6 +239,14 @@ class Metrics:
             "tpu_cc_coalesced_updates_total",
             "Label updates absorbed by coalescing without a reconcile",
         )
+        self.events_emitted_total = Counter(
+            "tpu_cc_events_emitted_total",
+            "Reconcile-outcome Events delivered to the API server",
+        )
+        self.events_dropped_total = Counter(
+            "tpu_cc_events_dropped_total",
+            "Reconcile-outcome Events dropped on recorder-queue overflow",
+        )
         self.repairs_total = Counter(
             "tpu_cc_repairs_total",
             "Self-repair retries of a failed reconcile (half-flipped-slice "
