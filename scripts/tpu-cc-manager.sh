@@ -64,14 +64,14 @@ _require_node_name() {
 # ------------------------------------------------------------- k8s (curl)
 _patch_node_labels() {
   # $1 = JSON object of labels, e.g. {"k":"v","k2":null}
-  curl -sf -X PATCH \
+  curl -sf --max-time 30 -X PATCH \
     -H "Content-Type: application/merge-patch+json" \
     -d "{\"metadata\":{\"labels\":$1}}" \
     "$API/api/v1/nodes/$NODE_NAME" > /dev/null
 }
 
 _fetch_node_json() {
-  curl -sf "$API/api/v1/nodes/$NODE_NAME"
+  curl -sf --max-time 30 "$API/api/v1/nodes/$NODE_NAME"
 }
 
 _label_from_json() {
@@ -137,14 +137,19 @@ _wait_components_gone() {
   local deadline=$((SECONDS + ${EVICTION_TIMEOUT_S:-300}))
   local apps="tpu-device-plugin tpu-metrics-exporter tpu-dra-driver tpu-workload-validator tpu-node-problem-detector"
   while [ $SECONDS -lt $deadline ]; do
-    local remaining=0 app
+    local remaining=0 app listed_all=1
     for app in $apps; do
-      local n
-      n=$( { curl -sf "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME" \
-          | grep -c '"kind":[[:space:]]*"Pod"'; } || true )
-      remaining=$((remaining + ${n:-0}))
+      # a failed/timed-out list means UNKNOWN, not zero: flipping with
+      # workloads possibly still on the node is the one wrong answer
+      local body n
+      if body=$(curl -sf --max-time 30 "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME"); then
+        n=$(printf '%s' "$body" | grep -c '"kind":[[:space:]]*"Pod"' || true)
+        remaining=$((remaining + ${n:-0}))
+      else
+        listed_all=0
+      fi
     done
-    [ "$remaining" -eq 0 ] && return 0
+    [ "$remaining" -eq 0 ] && [ "$listed_all" -eq 1 ] && return 0
     sleep "${EVICTION_POLL_S:-2}"
   done
   log "WARN: timed out waiting for component pods to leave; continuing"

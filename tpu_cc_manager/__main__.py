@@ -117,7 +117,9 @@ def main(argv=None) -> int:
         import time as _time
         import uuid as _uuid
 
-        from tpu_cc_manager.drain import build_reconcile_event
+        from tpu_cc_manager.drain import (
+            build_reconcile_event, post_event_best_effort,
+        )
         from tpu_cc_manager.modes import InvalidModeError
 
         kube = _kube_client(cfg)
@@ -142,16 +144,7 @@ def main(argv=None) -> int:
             )
             if event is None:
                 return
-            try:
-                kube.create_event(event["metadata"]["namespace"], event)
-            except Exception as e:
-                # agent-path parity: a clientset without Events support
-                # (501) is routine; anything else (403 RBAC, 400
-                # validation) deserves a visible warning
-                if getattr(e, "status", None) == 501:
-                    log.debug("event emission skipped: %s", e)
-                else:
-                    log.warning("event emission failed: %s", e)
+            post_event_best_effort(kube, event)
 
         t0 = _time.monotonic()
         try:

@@ -26,7 +26,8 @@ from typing import Optional
 
 from tpu_cc_manager.config import AgentConfig
 from tpu_cc_manager.drain import (
-    build_drainer, build_reconcile_event, set_cc_mode_state_label,
+    build_drainer, build_reconcile_event, post_event_best_effort,
+    set_cc_mode_state_label,
 )
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
 from tpu_cc_manager.k8s.client import KubeClient
@@ -268,19 +269,13 @@ class CCManagerAgent:
             try:
                 if event is _EVENT_STOP:
                     return
-                self.kube.create_event(
-                    event["metadata"]["namespace"], event
+                delivered, warned = post_event_best_effort(
+                    self.kube, event, warned_before=self._event_warned
                 )
-                self.metrics.events_emitted_total.inc()
-            except Exception as e:
-                if getattr(e, "status", None) == 501:
-                    log.debug("event emission skipped: %s", e)
-                elif not self._event_warned:
+                if delivered:
+                    self.metrics.events_emitted_total.inc()
+                if warned:
                     self._event_warned = True
-                    log.warning(
-                        "event emission failing (suppressing further "
-                        "warnings): %s", e,
-                    )
             finally:
                 self._event_queue.task_done()
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.engine import Drainer, NullDrainer
@@ -97,6 +97,29 @@ def build_reconcile_event(
         "lastTimestamp": now,
         "count": 1,
     }
+
+
+def post_event_best_effort(kube: KubeClient, event: dict,
+                           warned_before: bool = False) -> Tuple[bool, bool]:
+    """Deliver one Event, never raising. Returns (delivered, warned):
+    a clientset without Events support (501) is routine and stays at
+    debug, anything else (403 RBAC missing, 400 validation) warns — once
+    per caller, tracked via ``warned_before`` — because it means the
+    deployment is silently losing the feature."""
+    try:
+        kube.create_event(event["metadata"]["namespace"], event)
+        return True, False
+    except Exception as e:
+        if getattr(e, "status", None) == 501:
+            log.debug("event emission skipped: %s", e)
+            return False, False
+        if not warned_before:
+            log.warning(
+                "event emission failing (suppressing further warnings): %s",
+                e,
+            )
+            return False, True
+        return False, False
 
 
 def paused_value(original: str) -> str:
