@@ -114,7 +114,12 @@ _post_event() {
 _evict_components() {
   [ "$EVICT_OPERATOR_COMPONENTS" = "true" ] || return 0
   local node_json patch="{" first=1 key val
-  node_json="$(_fetch_node_json)"
+  # an unreadable node is NOT "no components deployed": proceeding would
+  # flip over possibly-running workloads
+  node_json="$(_fetch_node_json)" || {
+    log "ERROR: cannot read node $NODE_NAME for component eviction"
+    return 1
+  }
   for key in "${COMPONENT_LABELS[@]}"; do
     val="$(_label_from_json "$node_json" "$key")"
     if [ -n "$val" ] && [ "$val" != "false" ] && [[ "$val" != ${PAUSED_STR}* ]]; then
@@ -133,14 +138,18 @@ _evict_components() {
 
 _wait_components_gone() {
   # poll until no component pods remain on this node (timeout 300s like
-  # kubectl wait --timeout=5m, reference :275; warn-and-continue)
+  # kubectl wait --timeout=5m, reference :275). Timeout with pods KNOWN
+  # present is warn-and-continue (reference gpu_operator_eviction.py:
+  # 205-207 parity); timeout with the pod list NEVER obtained is a
+  # failure — flipping with workloads possibly still bound to the TPU is
+  # the one wrong answer.
   local deadline=$((SECONDS + ${EVICTION_TIMEOUT_S:-300}))
   local apps="tpu-device-plugin tpu-metrics-exporter tpu-dra-driver tpu-workload-validator tpu-node-problem-detector"
+  local ever_listed_all=0
   while [ $SECONDS -lt $deadline ]; do
     local remaining=0 app listed_all=1
     for app in $apps; do
-      # a failed/timed-out list means UNKNOWN, not zero: flipping with
-      # workloads possibly still on the node is the one wrong answer
+      # a failed/timed-out list means UNKNOWN, not zero
       local body n
       if body=$(curl -sf --max-time 30 "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME"); then
         n=$(printf '%s' "$body" | grep -c '"kind":[[:space:]]*"Pod"' || true)
@@ -149,9 +158,14 @@ _wait_components_gone() {
         listed_all=0
       fi
     done
+    [ "$listed_all" -eq 1 ] && ever_listed_all=1
     [ "$remaining" -eq 0 ] && [ "$listed_all" -eq 1 ] && return 0
     sleep "${EVICTION_POLL_S:-2}"
   done
+  if [ "$ever_listed_all" -eq 0 ]; then
+    log "ERROR: could not list component pods before the eviction deadline"
+    return 1
+  fi
   log "WARN: timed out waiting for component pods to leave; continuing"
 }
 
@@ -279,7 +293,7 @@ set_cc_mode() {
     return 0
   fi
 
-  _evict_components
+  _evict_components || _exit_failed
   for dev in "${devices[@]}"; do
     if ! _set_device_mode "$dev" "$mode"; then
       log "ERROR: failed to set mode on $dev"

@@ -352,3 +352,39 @@ def test_agent_node_drain_with_pdb_blocked_pod(tmp_path):
     assert not kube.get_node("nd")["spec"].get("unschedulable")
     assert kube.list_pods("default", label_selector="tpu-workload=y") == []
     assert all(c.query_cc_mode() == "on" for c in backend.chips)
+
+
+def test_agent_emits_slice_abort_event(tmp_path):
+    """A slice round that never reaches quorum surfaces as a
+    CCSliceAborted Warning event, not just a log line."""
+    set_backend(fake_backend(n_chips=1))
+    kube = FakeKube()
+    kube.add_node(make_node(
+        "n1", labels={L.CC_MODE_LABEL: "on", L.TPU_SLICE_LABEL: "s0"}
+    ))
+    # a second, permanently silent member keeps the quorum incomplete
+    kube.add_node(make_node(
+        "n2", labels={L.CC_MODE_LABEL: "on", L.TPU_SLICE_LABEL: "s0"}
+    ))
+    from tpu_cc_manager.slice_coord import SliceCoordinator
+
+    coord = SliceCoordinator(
+        kube, "n1", poll_s=0.05, commit_timeout_s=0.5, hb_ttl_s=60,
+    )
+    # make n2 look alive so the leader keeps waiting for its ack
+    import time as _t
+    kube.set_node_annotations(
+        "n2", {"tpu.google.com/cc.slice.hb": str(int(_t.time()))}
+    )
+    cfg = AgentConfig(
+        node_name="n1", default_mode="on",
+        readiness_file=str(tmp_path / "ready"), health_port=0,
+        drain_strategy="none",
+    )
+    agent = CCManagerAgent(kube, cfg, backend=fake_backend(n_chips=1),
+                           slice_coordinator=coord)
+    assert agent.reconcile("on") is False
+    assert agent.flush_events()
+    reasons = [e["reason"] for e in kube.cluster_events]
+    assert reasons == ["CCSliceAborted"]
+    assert kube.cluster_events[0]["type"] == "Warning"

@@ -163,3 +163,23 @@ def test_bash_engine_posts_events(env):
     r = run_sh(e2, "set-cc-mode", "-a", "-m", "off")
     assert r.returncode == 0, r.stderr
     assert len(server.store.list_events("default")) == 2
+
+
+def test_drain_wait_fails_when_pods_never_listable(env):
+    """Eviction deadline reached without ever obtaining a pod list ->
+    the flip must FAIL (state label + event), not proceed over possibly
+    still-running workloads."""
+    e, server, tmp_path = env
+    # point k8s at a dead port AFTER device discovery needs nothing from
+    # it; the engine's label writes will also fail (best-effort), so the
+    # outcome is the nonzero exit
+    e2 = dict(e)
+    e2["KUBE_API_PORT"] = "1"  # nothing listens
+    e2["EVICTION_TIMEOUT_S"] = "1"
+    e2["EVICTION_POLL_S"] = "0.2"
+    e2["EVICT_OPERATOR_COMPONENTS"] = "true"
+    r = run_sh(e2, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode != 0
+    # devices untouched: the flip never ran
+    q = run_sh(e2, "get-cc-mode", "-a")
+    assert "cc=off" in q.stdout

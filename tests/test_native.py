@@ -525,3 +525,60 @@ def test_cpp_agent_bookmarks_prevent_410_relists(
         err_file.close()
     stderr = (tmp_path / "agent-stderr.log").read_text()
     assert "watch 410" not in stderr, stderr
+
+
+def test_cpp_agent_bearer_token_auth(native_build, tmp_path):
+    """BEARER_TOKEN_FILE path: the agent authenticates every request
+    (list, watch, state-label PATCH via the engine stub's curl-free
+    echo) against a token-gated API server — the direct plain-HTTP
+    deployment shape the agent header documents."""
+    out_file = tmp_path / "calls.txt"
+    token_file = tmp_path / "token"
+    token_file.write_text("s3cret-token\n")  # trailing newline is stripped
+    with FakeApiServer(required_token="s3cret-token") as srv:
+        srv.store.add_node(
+            make_node("authnode", labels={L.CC_MODE_LABEL: "off"})
+        )
+        env = dict(os.environ)
+        env.update(
+            NODE_NAME="authnode",
+            KUBE_API_HOST="127.0.0.1",
+            KUBE_API_PORT=str(srv.port),
+            BEARER_TOKEN_FILE=str(token_file),
+            TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+        )
+        proc = subprocess.Popen(
+            [os.path.join(native_build, "tpu-cc-manager-agent")],
+            env=env, stderr=subprocess.DEVNULL,
+        )
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if out_file.exists() and "off" in out_file.read_text():
+                    break
+                time.sleep(0.05)
+            assert out_file.exists() and "off" in out_file.read_text()
+            srv.store.set_node_labels("authnode", {L.CC_MODE_LABEL: "on"})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if "on" in out_file.read_text():
+                    break
+                time.sleep(0.05)
+            assert out_file.read_text().split() == ["off", "on"]
+
+            # the agent's own state PATCH (invalid-mode path) also carries
+            # the token: it must succeed against the gated server
+            srv.store.set_node_labels("authnode", {L.CC_MODE_LABEL: "nope"})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                labels = srv.store.get_node("authnode")["metadata"]["labels"]
+                if labels.get(L.CC_MODE_STATE_LABEL) == "failed":
+                    break
+                time.sleep(0.05)
+            assert labels.get(L.CC_MODE_STATE_LABEL) == "failed"
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
