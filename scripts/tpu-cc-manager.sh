@@ -59,6 +59,14 @@ _require_node_name() {
     log "ERROR: NODE_NAME env is required"
     exit 1
   fi
+  # the drain wait parses PodList JSON with python3; without it every
+  # poll would fail silently and the flip would burn the full eviction
+  # timeout before dying with a misleading apiserver error — fail fast
+  # with the real cause instead
+  if [ "$EVICT_OPERATOR_COMPONENTS" = "true" ] && ! command -v python3 >/dev/null; then
+    log "ERROR: python3 is required to wait for evicted component pods"
+    exit 1
+  fi
 }
 
 # ------------------------------------------------------------- k8s (curl)
@@ -149,11 +157,15 @@ _wait_components_gone() {
   while [ $SECONDS -lt $deadline ]; do
     local remaining=0 app listed_all=1
     for app in $apps; do
-      # a failed/timed-out list means UNKNOWN, not zero
+      # a failed/timed-out list means UNKNOWN, not zero. Count list items
+      # by parsing the PodList JSON: a real apiserver omits TypeMeta
+      # (kind/apiVersion) on list items, so grepping for '"kind":"Pod"'
+      # would always count 0 against a real cluster and let the flip
+      # proceed over still-terminating pods.
       local body n
-      if body=$(curl -sf --max-time 30 "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME"); then
-        n=$(printf '%s' "$body" | grep -c '"kind":[[:space:]]*"Pod"' || true)
-        remaining=$((remaining + ${n:-0}))
+      if body=$(curl -sf --max-time 30 "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME") \
+         && n=$(printf '%s' "$body" | python3 -c 'import json,sys; print(len(json.load(sys.stdin).get("items") or []))' 2>/dev/null); then
+        remaining=$((remaining + n))
       else
         listed_all=0
       fi

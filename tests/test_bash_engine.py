@@ -165,6 +165,28 @@ def test_bash_engine_posts_events(env):
     assert len(server.store.list_events("default")) == 2
 
 
+def test_drain_wait_counts_typemeta_less_pod_items(env):
+    """A still-present component pod must be seen by the drain wait even
+    though the apiserver (like a real one) omits kind/apiVersion from
+    list items — a grep for '"kind":"Pod"' would count 0 and skip the
+    wait entirely. Present pod -> the wait runs to its deadline and
+    warns (reference gpu_operator_eviction.py:205-207 parity); pod gone
+    -> no warn."""
+    e, server, tmp_path = env
+    from tpu_cc_manager.k8s.objects import make_pod
+    server.store.add_pod(make_pod(
+        "dp-1", "tpu-system", labels={"app": "tpu-device-plugin"},
+        node_name="bash-node"))
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 0, r.stderr
+    assert "timed out waiting" in r.stderr
+
+    server.store.delete_pod("tpu-system", "dp-1")
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "off")
+    assert r.returncode == 0, r.stderr
+    assert "timed out waiting" not in r.stderr
+
+
 def test_drain_wait_fails_when_pods_never_listable(env):
     """Eviction deadline reached without ever obtaining a pod list ->
     the flip must FAIL (state label + event), not proceed over possibly

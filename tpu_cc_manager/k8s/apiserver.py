@@ -36,6 +36,13 @@ from tpu_cc_manager.k8s.fake import FakeKube
 
 
 def _list_obj(kind: str, items: list, cont: Optional[str]) -> dict:
+    # A real apiserver omits TypeMeta (kind/apiVersion) from list items —
+    # only the List object itself carries it. Serve the same shape so
+    # clients that grep or parse items are tested against real wire
+    # format (a grep for '"kind":"Pod"' must count 0 here, as it would
+    # in production).
+    items = [{k: v for k, v in it.items() if k not in ("kind", "apiVersion")}
+             for it in items]
     out = {"kind": kind, "apiVersion": "v1", "items": items, "metadata": {}}
     if cont:
         out["metadata"]["continue"] = cont
