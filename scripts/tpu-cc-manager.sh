@@ -225,15 +225,61 @@ _unbind_device_from_driver() {
   fi
 }
 
+_gating_enabled() {
+  # same value semantics as device/gate.py: unknown values are a loud
+  # config error, never a silent gating-off
+  case "${TPU_CC_DEVICE_GATING:-chmod}" in
+    chmod|"") return 0 ;;
+    none|off|false|0) return 1 ;;
+    *) log "ERROR: unknown TPU_CC_DEVICE_GATING '${TPU_CC_DEVICE_GATING}' (chmod|none)"; exit 1 ;;
+  esac
+}
+
+_gate_lock() {
+  # workload-visible gating (parity with device/gate.py): lock the node
+  # for the duration of the flip — a workload that could open the chip
+  # before the flip observably cannot mid-flip. Fail-SECURE both ways:
+  # a chmod failure on an existing node aborts the flip (refusing to
+  # flip an ungated device), and a failed flip leaves the node locked.
+  _gating_enabled || return 0
+  if [ -e "$1" ]; then
+    chmod 000 "$1" || { log "ERROR: cannot gate $1; refusing to flip"; return 1; }
+  fi
+}
+
+_gate_apply() {
+  # $1 dev, $2 effective cc mode: encode the verified mode in the node's
+  # permission bits (on=0600 off=0666 devtools=0660)
+  _gating_enabled || return 0
+  [ -e "$1" ] || return 0
+  local perms
+  case "$2" in
+    off) perms=666 ;;
+    devtools) perms=660 ;;
+    *) perms=600 ;;
+  esac
+  chmod "$perms" "$1" || true
+}
+
+_gate_cc_target() {
+  # effective cc domain value for a node-level mode
+  case "$1" in
+    ici|off) echo off ;;
+    *) echo "$1" ;;
+  esac
+}
+
 _set_device_mode() {
-  # $1 dev, $2 mode: discard stale intent, stage the right domains, commit
-  # (=reset), verify (reference set_gpu_cc_mode, :384-405)
+  # $1 dev, $2 mode: gate + discard stale intent, stage the right
+  # domains, commit (=reset), verify, regate
+  # (reference set_gpu_cc_mode, :384-405)
   local dev="$1" mode="$2" cc_target ici_target
   case "$mode" in
     ici) cc_target="off"; ici_target="on" ;;
     on|devtools) cc_target="$mode"; ici_target="off" ;;
     off) cc_target="off"; ici_target="off" ;;
   esac
+  _gate_lock "$dev" || return 1
   "$TPUDEVCTL" discard "$dev" || return 1
   "$TPUDEVCTL" stage "$dev" cc "$cc_target" || return 1
   "$TPUDEVCTL" stage "$dev" ici "$ici_target" || return 1
@@ -246,6 +292,7 @@ _set_device_mode() {
     log "ERROR: $dev verify mismatch: cc=$got_cc (want $cc_target) ici=$got_ici (want $ici_target)"
     return 1
   fi
+  _gate_apply "$dev" "$cc_target"
   return 0
 }
 
@@ -299,6 +346,11 @@ set_cc_mode() {
   done
   if [ $all_set -eq 1 ]; then
     log "all ${#devices[@]} device(s) already in mode '$mode'"
+    # re-assert gate perms even on the no-op path (Python engine parity):
+    # bookkeeping being converged doesn't mean /dev perms still are
+    for dev in "${devices[@]}"; do
+      _gate_apply "$dev" "$(_gate_cc_target "$mode")"
+    done
     _set_state_label "$mode"
     _post_event "CCModeApplied" "Normal" \
       "cc mode '$mode' already set on ${#devices[@]} device(s) (no-op)"

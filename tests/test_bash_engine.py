@@ -165,6 +165,25 @@ def test_bash_engine_posts_events(env):
     assert len(server.store.list_events("default")) == 2
 
 
+def test_device_gating_perms(env):
+    """Parity with device/gate.py: after a verified flip the device
+    node's permission bits encode the effective CC mode (on=0600,
+    off=0666) — the workload-visible consequence of the mode."""
+    import stat as st
+    e, server, tmp_path = env
+    assert run_sh(e, "set-cc-mode", "-a", "-m", "on").returncode == 0
+    assert st.S_IMODE(os.stat(tmp_path / "dev" / "accel0").st_mode) == 0o600
+    assert run_sh(e, "set-cc-mode", "-a", "-m", "off").returncode == 0
+    assert st.S_IMODE(os.stat(tmp_path / "dev" / "accel0").st_mode) == 0o666
+
+    # TPU_CC_DEVICE_GATING=none leaves the node alone
+    e2 = dict(e)
+    e2["TPU_CC_DEVICE_GATING"] = "none"
+    os.chmod(tmp_path / "dev" / "accel0", 0o644)
+    assert run_sh(e2, "set-cc-mode", "-a", "-m", "on").returncode == 0
+    assert st.S_IMODE(os.stat(tmp_path / "dev" / "accel0").st_mode) == 0o644
+
+
 def test_drain_wait_counts_typemeta_less_pod_items(env):
     """A still-present component pod must be seen by the drain wait even
     though the apiserver (like a real one) omits kind/apiVersion from

@@ -1,0 +1,257 @@
+"""Workload-visible mode enforcement (VERDICT r2 item 1).
+
+The flip must have a node-local consequence a workload can observe:
+
+- mid-flip, a process that could open the device node beforehand cannot
+  (access-revocation analog of the reference's driver unbind,
+  reference scripts/cc-manager.sh:40-50);
+- after a verified commit, the node's permission bits encode the mode —
+  cc=on is detectably different from cc=off to an unprivileged opener;
+- a failed flip leaves the node locked (fail-secure), never half-open;
+- the node carries the flip taint for exactly the duration of the cycle.
+
+Privilege note: these tests run as root (the sandbox default), so the
+"can a workload open it?" probes run in a subprocess that drops to
+uid/gid 65534 (nobody) first — root bypasses permission bits.
+"""
+
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.device.fake import FakeBackend, FakeChip
+from tpu_cc_manager.device.gate import DeviceGate, FLIP_LOCK_PERMS, MODE_PERMS
+from tpu_cc_manager.engine import ModeEngine
+from tpu_cc_manager.drain import NodeFlipTaint
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+
+
+def _can_open_as_nobody(path: str) -> bool:
+    """Try to open `path` read-only as uid/gid 65534 in a subprocess."""
+    code = (
+        "import os,sys\n"
+        "os.setgid(65534); os.setuid(65534)\n"
+        f"os.close(os.open({path!r}, os.O_RDONLY))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True)
+    return r.returncode == 0
+
+
+needs_root = pytest.mark.skipif(
+    os.geteuid() != 0, reason="needs root to drop privileges for the probe"
+)
+
+
+class PermProbeChip(FakeChip):
+    """FakeChip whose device path is a real file; records the file's
+    permission bits at reset time (i.e. mid-flip)."""
+
+    def __init__(self, path, **kw):
+        super().__init__(path=path, **kw)
+        self.perms_at_reset = None
+
+    def reset(self):
+        self.perms_at_reset = stat.S_IMODE(os.stat(self.path).st_mode)
+        super().reset()
+
+
+def _dev_file(tmp_path, name="accel0", perms=0o666):
+    # pytest tmp dirs are 0700; open the directory chain so the
+    # dropped-privilege probe can traverse to the "device node"
+    d = tmp_path
+    while str(d).startswith("/tmp/") and str(d) != "/tmp":
+        os.chmod(d, 0o711)
+        d = d.parent
+    p = tmp_path / name
+    p.write_text("")
+    os.chmod(p, perms)
+    return str(p)
+
+
+def _engine(backend, states=None, **kw):
+    states = states if states is not None else []
+    kw.setdefault("evict_components", False)
+    kw.setdefault("gate", DeviceGate(enabled=True))
+    return ModeEngine(set_state_label=states.append, backend=backend, **kw)
+
+
+@needs_root
+def test_workload_loses_access_mid_flip_and_mode_is_detectable(tmp_path):
+    dev = _dev_file(tmp_path)
+    chip = PermProbeChip(dev)
+    engine = _engine(FakeBackend(chips=[chip]))
+
+    assert _can_open_as_nobody(dev)  # before: open
+    assert engine.set_mode("on") is True
+    # mid-flip (at reset time) the node was fully locked
+    assert chip.perms_at_reset == FLIP_LOCK_PERMS
+    # after the verified commit: cc=on means unprivileged open FAILS —
+    # the mode-on/mode-off difference a workload can detect
+    assert stat.S_IMODE(os.stat(dev).st_mode) == MODE_PERMS["on"]
+    assert not _can_open_as_nobody(dev)
+
+    assert engine.set_mode("off") is True
+    assert stat.S_IMODE(os.stat(dev).st_mode) == MODE_PERMS["off"]
+    assert _can_open_as_nobody(dev)
+
+
+def test_failed_flip_leaves_device_locked(tmp_path):
+    dev = _dev_file(tmp_path)
+    chip = FakeChip(path=dev)
+    chip.fail_reset = True
+    states = []
+    engine = _engine(FakeBackend(chips=[chip]), states)
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+    # fail-secure: the half-flipped device is NOT handed back to workloads
+    assert stat.S_IMODE(os.stat(dev).st_mode) == FLIP_LOCK_PERMS
+
+
+def test_verify_mismatch_leaves_device_locked(tmp_path):
+    dev = _dev_file(tmp_path)
+    chip = FakeChip(path=dev)
+    chip.drop_staged_mode = True
+    engine = _engine(FakeBackend(chips=[chip]))
+    assert engine.set_mode("on") is False
+    assert stat.S_IMODE(os.stat(dev).st_mode) == FLIP_LOCK_PERMS
+
+
+def test_fast_path_reasserts_gate_perms(tmp_path):
+    dev = _dev_file(tmp_path)
+    chip = FakeChip(path=dev, cc_mode="on")
+    engine = _engine(FakeBackend(chips=[chip]))
+    # chip already in mode 'on' but someone re-opened the node perms
+    os.chmod(dev, 0o666)
+    assert engine.set_mode("on") is True  # idempotent fast path
+    assert stat.S_IMODE(os.stat(dev).st_mode) == MODE_PERMS["on"]
+
+
+def test_gating_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_CC_DEVICE_GATING", "none")
+    dev = _dev_file(tmp_path)
+    chip = FakeChip(path=dev)
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        backend=FakeBackend(chips=[chip]),
+        evict_components=False,
+        gate=None,  # engine builds one from env
+    )
+    assert engine.set_mode("on") is True
+    assert stat.S_IMODE(os.stat(dev).st_mode) == 0o666  # untouched
+
+
+def test_missing_device_node_is_skipped(tmp_path):
+    # fake/jax identities (e.g. "tpu:0") have no devfs entry: gating is
+    # silently skipped, the flip still succeeds
+    chip = FakeChip(path=str(tmp_path / "does-not-exist"))
+    engine = _engine(FakeBackend(chips=[chip]))
+    assert engine.set_mode("on") is True
+
+
+class TaintCheckingDrainer:
+    """Asserts the flip taint is present while the drain runs (taint must
+    precede eviction so the scheduler stops backfilling the node)."""
+
+    def __init__(self, kube, node_name):
+        self.kube = kube
+        self.node_name = node_name
+        self.taint_seen_at_evict = None
+
+    def _has_taint(self):
+        taints = self.kube.get_node(self.node_name).get("spec", {}).get(
+            "taints") or []
+        return any(t.get("key") == L.FLIP_TAINT_KEY for t in taints)
+
+    def evict(self):
+        self.taint_seen_at_evict = self._has_taint()
+
+    def reschedule(self):
+        pass
+
+
+def test_flip_taint_held_for_exactly_the_flip_cycle(tmp_path):
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    drainer = TaintCheckingDrainer(kube, "n1")
+    chip = FakeChip(path=_dev_file(tmp_path))
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        backend=FakeBackend(chips=[chip]),
+        drainer=drainer,
+        evict_components=True,
+        gate=DeviceGate(enabled=True),
+        flip_taint=NodeFlipTaint(kube, "n1"),
+    )
+    assert engine.set_mode("on") is True
+    assert drainer.taint_seen_at_evict is True
+    taints = kube.get_node("n1").get("spec", {}).get("taints") or []
+    assert not any(t.get("key") == L.FLIP_TAINT_KEY for t in taints)
+
+
+def test_flip_taint_cleared_even_on_failure(tmp_path):
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    chip = FakeChip(path=_dev_file(tmp_path))
+    chip.fail_reset = True
+    states = []
+    engine = ModeEngine(
+        set_state_label=states.append,
+        backend=FakeBackend(chips=[chip]),
+        evict_components=False,
+        gate=DeviceGate(enabled=True),
+        flip_taint=NodeFlipTaint(kube, "n1"),
+    )
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+    taints = kube.get_node("n1").get("spec", {}).get("taints") or []
+    assert not any(t.get("key") == L.FLIP_TAINT_KEY for t in taints)
+
+
+def test_flip_taint_survives_concurrent_taint_writer():
+    """spec.taints is a list: a blind merge patch would wipe taints other
+    controllers add concurrently. The taint uses read-edit-replace with
+    409 retry; a not-ready taint added between the read and the write
+    must survive."""
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    t = NodeFlipTaint(kube, "n1")
+
+    real_replace = kube.replace_node
+    raced = {"done": False}
+
+    def racing_replace(name, node):
+        if not raced["done"]:
+            raced["done"] = True
+            # node-lifecycle controller wins the race
+            kube.patch_node(name, {"spec": {"taints": [
+                {"key": "node.kubernetes.io/not-ready", "value": "",
+                 "effect": "NoExecute"},
+            ]}})
+        return real_replace(name, node)  # first call: 409
+
+    kube.replace_node = racing_replace
+    t.set()
+    keys = {x["key"] for x in kube.get_node("n1")["spec"]["taints"]}
+    assert keys == {"node.kubernetes.io/not-ready", L.FLIP_TAINT_KEY}
+
+
+def test_flip_taint_preserves_foreign_taints():
+    kube = FakeKube()
+    kube.add_node(make_node("n1"))
+    kube.patch_node("n1", {"spec": {"taints": [
+        {"key": "example.com/other", "value": "x", "effect": "NoExecute"},
+    ]}})
+    t = NodeFlipTaint(kube, "n1")
+    t.set()
+    t.set()  # idempotent
+    taints = kube.get_node("n1")["spec"]["taints"]
+    assert len(taints) == 2
+    t.clear()
+    t.clear()  # idempotent
+    taints = kube.get_node("n1")["spec"]["taints"]
+    assert [x["key"] for x in taints] == ["example.com/other"]

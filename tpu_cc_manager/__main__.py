@@ -123,12 +123,14 @@ def main(argv=None) -> int:
         from tpu_cc_manager.modes import InvalidModeError
 
         kube = _kube_client(cfg)
+        from tpu_cc_manager.drain import NodeFlipTaint
         engine = ModeEngine(
             set_state_label=lambda v: set_cc_mode_state_label(
                 kube, cfg.node_name, v
             ),
             drainer=build_drainer(kube, cfg),
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
+            flip_taint=NodeFlipTaint(kube, cfg.node_name),
         )
 
         def _post_event(outcome: str, dur: float) -> None:

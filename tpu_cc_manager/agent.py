@@ -26,8 +26,8 @@ from typing import Optional
 
 from tpu_cc_manager.config import AgentConfig
 from tpu_cc_manager.drain import (
-    build_drainer, build_reconcile_event, post_event_best_effort,
-    set_cc_mode_state_label,
+    NodeFlipTaint, build_drainer, build_reconcile_event,
+    post_event_best_effort, set_cc_mode_state_label,
 )
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
 from tpu_cc_manager.k8s.client import KubeClient
@@ -101,6 +101,7 @@ class CCManagerAgent:
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
             backend=backend,
             tracer=self.tracer,
+            flip_taint=NodeFlipTaint(kube, cfg.node_name),
         )
         self.health: Optional[HealthServer] = None
         self._fatal: Optional[Exception] = None

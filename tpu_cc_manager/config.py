@@ -21,6 +21,7 @@ flag                      env                            default
 (none)                    REPAIR_INTERVAL_S              30 (0 disables self-repair)
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
 (none)                    EMIT_EVENTS                    true (reconcile Events)
+(none)                    TPU_CC_DEVICE_GATING           "chmod" | "none" (device-node gating)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
 ========================  =============================  =======================

@@ -32,7 +32,7 @@ import time
 from typing import Dict, Optional, Sequence, Tuple
 
 from tpu_cc_manager import labels as L
-from tpu_cc_manager.engine import Drainer, NullDrainer
+from tpu_cc_manager.engine import Drainer, FlipTaint, NullDrainer
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
 
 log = logging.getLogger("tpu-cc-manager.drain")
@@ -120,6 +120,68 @@ def post_event_best_effort(kube: KubeClient, event: dict,
             )
             return False, True
         return False, False
+
+
+class NodeFlipTaint(FlipTaint):
+    """Real k8s flip taint: ``tpu.google.com/cc.mode=flipping:NoSchedule``
+    held on the node while the engine flips its devices, so the scheduler
+    stops placing new TPU pods on a node whose devices are gated. The
+    pause labels only speak to a cooperating operator; the taint speaks to
+    kube-scheduler itself (SURVEY.md §7.1's GKE-native drain direction).
+
+    ``spec.taints`` is a list, so a merge patch would replace it
+    wholesale and wipe taints other controllers (node-lifecycle's
+    not-ready/unreachable) add concurrently. Both operations therefore
+    use optimistic-concurrency replace: read the node, edit the taint
+    list, ``replace_node`` with the read resourceVersion, and retry on
+    409 conflict. Both are idempotent."""
+
+    #: bounded retries: losing every race for this long means the node
+    #: object is churning so hard the taint is the least of its problems
+    MAX_CAS_ATTEMPTS = 8
+
+    def __init__(self, kube: KubeClient, node_name: str):
+        self.kube = kube
+        self.node_name = node_name
+
+    def _edit_taints(self, edit) -> None:
+        from tpu_cc_manager.k8s.client import ConflictError
+
+        for _ in range(self.MAX_CAS_ATTEMPTS):
+            node = self.kube.get_node(self.node_name)
+            taints = list(node.get("spec", {}).get("taints") or [])
+            new = edit(taints)
+            if new is None:  # already in the desired state
+                return
+            node.setdefault("spec", {})["taints"] = new
+            try:
+                self.kube.replace_node(self.node_name, node)
+                return
+            except ConflictError:
+                continue
+        raise ApiException(409, "taint update kept conflicting")
+
+    def set(self) -> None:
+        def add(taints):
+            if any(t.get("key") == L.FLIP_TAINT_KEY for t in taints):
+                return None
+            return taints + [{
+                "key": L.FLIP_TAINT_KEY,
+                "value": L.FLIP_TAINT_VALUE,
+                "effect": L.FLIP_TAINT_EFFECT,
+            }]
+
+        log.info("tainting %s %s=%s:%s for the flip", self.node_name,
+                 L.FLIP_TAINT_KEY, L.FLIP_TAINT_VALUE, L.FLIP_TAINT_EFFECT)
+        self._edit_taints(add)
+
+    def clear(self) -> None:
+        def remove(taints):
+            kept = [t for t in taints if t.get("key") != L.FLIP_TAINT_KEY]
+            return None if len(kept) == len(taints) else kept
+
+        log.info("removing flip taint from %s", self.node_name)
+        self._edit_taints(remove)
 
 
 def paused_value(original: str) -> str:

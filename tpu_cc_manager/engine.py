@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tpu_cc_manager import device as devlayer
 from tpu_cc_manager.device.base import DeviceError, TpuChip
+from tpu_cc_manager.device.gate import DeviceGate
 from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
 from tpu_cc_manager.trace import Tracer, get_tracer
 
@@ -67,6 +68,21 @@ class NullDrainer(Drainer):
         pass
 
 
+class FlipTaint:
+    """Collaborator interface: mark the node unschedulable-for-new-work
+    for the duration of a flip (``tpu.google.com/cc.mode=flipping:
+    NoSchedule``), so the *scheduler* — not just the pause labels — knows
+    a flip is in progress. See tpu_cc_manager.drain.NodeFlipTaint for the
+    real k8s implementation; this default is a no-op (one-shot CLIs
+    without cluster access, unit tests)."""
+
+    def set(self) -> None:
+        pass
+
+    def clear(self) -> None:
+        pass
+
+
 #: One unit of planned device work: the device and the per-domain targets
 #: it diverges on ({"cc": "on"} / {"ici": "off"} / both).
 PlanItem = Tuple[TpuChip, Dict[str, str]]
@@ -82,6 +98,8 @@ class ModeEngine:
         boot_timeout_s: float = 300.0,
         backend=None,
         tracer: Optional[Tracer] = None,
+        gate: Optional[DeviceGate] = None,
+        flip_taint: Optional[FlipTaint] = None,
     ):
         self._set_state_label = set_state_label
         self._drainer = drainer or NullDrainer()
@@ -91,6 +109,9 @@ class ModeEngine:
         #: multi-node simulation injects one backend per simulated host.
         self._backend = backend
         self._tracer = tracer or get_tracer()
+        #: workload-visible device-node gating (TPU_CC_DEVICE_GATING)
+        self._gate = gate or DeviceGate()
+        self._flip_taint = flip_taint or FlipTaint()
 
     # ------------------------------------------------------------- queries
     def get_modes(self) -> dict:
@@ -127,6 +148,17 @@ class ModeEngine:
             plan = self._plan(devices, desired_cc, desired_ici)
             plan_span.attrs["devices"] = len(devices)
             plan_span.attrs["divergent"] = len(plan)
+        # re-assert the workload-visible gate on every device that is
+        # ALREADY in its desired mode (the whole node on the idempotent
+        # fast path, the converged subset on a partial flip): an agent
+        # restart after someone reset /dev perms must reconverge the
+        # node-local consequence, not just the bookkeeping. In-plan
+        # devices are gated inside _apply_plan.
+        in_plan = {dev.path for dev, _ in plan}
+        for dev in devices:
+            if dev.path not in in_plan and dev.is_cc_query_supported:
+                self._gate.apply_mode(dev.path, dev.query_cc_mode())
+
         if not plan:
             n = len(devices)
             if n:
@@ -192,6 +224,13 @@ class ModeEngine:
         """Evict around the flip; ALWAYS reschedule, even when evict or the
         flip itself failed (reference scripts/cc-manager.sh:210-215)."""
         ok = False
+        # taint first: new TPU pods must stop landing on a node whose
+        # devices are about to be gated. Best-effort — a node that can't
+        # be tainted (RBAC gap) still gets the drain + gate protections.
+        try:
+            self._flip_taint.set()
+        except Exception:
+            log.warning("failed to set flip taint; continuing", exc_info=True)
         try:
             if self._evict_components:
                 with self._tracer.span("evict"):
@@ -214,19 +253,31 @@ class ModeEngine:
                         self._drainer.reschedule()
                 except Exception:
                     log.exception("failed to reschedule drained components")
+            try:
+                self._flip_taint.clear()
+            except Exception:
+                log.warning("failed to clear flip taint", exc_info=True)
         with self._tracer.span("state_label"):
             self._set_state_label(state_on_success if ok else STATE_FAILED)
         return ok
 
     def _apply_plan(self, plan: Sequence[PlanItem]) -> bool:
-        """Per-device hot loop (reference main.py:258-311): discard stale
-        staged state, stage every divergent domain, ONE reset, wait, verify
-        every staged domain. Any failure aborts the whole node flip."""
+        """Per-device hot loop (reference main.py:258-311): lock the device
+        node, discard stale staged state, stage every divergent domain, ONE
+        reset, wait, verify every staged domain, then re-open the node with
+        the verified mode's permissions. Any failure aborts the whole node
+        flip — leaving already-locked devices locked (fail-secure; see
+        device.gate)."""
         for dev, changes in plan:
             try:
                 with self._tracer.span(
                     "flip", device=dev.path, changes=dict(changes)
                 ) as flip_span:
+                    # access-revocation analog of the reference's driver
+                    # unbind (scripts/cc-manager.sh:40-50): mid-flip, a
+                    # workload that could open the node observably cannot
+                    if not dev.is_ici_switch():
+                        self._gate.lock_for_flip(dev.path)
                     dev.discard_staged()
                     for domain, target in changes.items():
                         if domain == "cc":
@@ -251,6 +302,13 @@ class ModeEngine:
                                 f"{target!r} got {achieved!r}"
                             )
                             return False
+                    if not dev.is_ici_switch():
+                        final_cc = changes.get(
+                            "cc",
+                            dev.query_cc_mode()
+                            if dev.is_cc_query_supported else "off",
+                        )
+                        self._gate.apply_mode(dev.path, final_cc)
             except DeviceError as e:
                 log.error("%s: mode flip failed: %s", dev.path, e)
                 return False

@@ -62,3 +62,11 @@ SLICE_LEADER_ANNOTATION = "tpu.google.com/cc.slice.leader"
 SLICE_EPOCH_ANNOTATION = "tpu.google.com/cc.slice.epoch"
 SLICE_ACK_ANNOTATION = "tpu.google.com/cc.slice.ack"
 SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"
+
+#: Node taint held for the duration of a mode flip so the *scheduler* —
+#: not just the component pause labels — keeps new TPU work off a node
+#: whose devices are gated mid-flip. Cleared when the flip cycle ends
+#: (success or failure; the cc.mode.state label carries the outcome).
+FLIP_TAINT_KEY = "tpu.google.com/cc.mode"
+FLIP_TAINT_VALUE = "flipping"
+FLIP_TAINT_EFFECT = "NoSchedule"
