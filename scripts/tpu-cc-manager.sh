@@ -67,6 +67,18 @@ _require_node_name() {
     log "ERROR: python3 is required to wait for evicted component pods"
     exit 1
   fi
+  # validate gating/holder knobs BEFORE any eviction or device gating, so
+  # a typo'd value fails the run cleanly instead of dying mid-flip with
+  # components drained and the device locked (Python parity: DeviceError
+  # at engine construction)
+  case "${TPU_CC_DEVICE_GATING:-chmod}" in
+    chmod|""|none|off|false|0) ;;
+    *) log "ERROR: unknown TPU_CC_DEVICE_GATING '${TPU_CC_DEVICE_GATING}' (chmod|none)"; exit 1 ;;
+  esac
+  case "${TPU_CC_HOLDER_CHECK:-proc}" in
+    proc|""|none|off|false|0) ;;
+    *) log "ERROR: unknown TPU_CC_HOLDER_CHECK '${TPU_CC_HOLDER_CHECK}' (proc|none)"; exit 1 ;;
+  esac
 }
 
 # ------------------------------------------------------------- k8s (curl)
@@ -226,12 +238,11 @@ _unbind_device_from_driver() {
 }
 
 _gating_enabled() {
-  # same value semantics as device/gate.py: unknown values are a loud
-  # config error, never a silent gating-off
+  # value already validated in _require_node_name; unknown values were a
+  # loud config error before any drain/gating side effects
   case "${TPU_CC_DEVICE_GATING:-chmod}" in
-    chmod|"") return 0 ;;
     none|off|false|0) return 1 ;;
-    *) log "ERROR: unknown TPU_CC_DEVICE_GATING '${TPU_CC_DEVICE_GATING}' (chmod|none)"; exit 1 ;;
+    *) return 0 ;;
   esac
 }
 
@@ -269,6 +280,61 @@ _gate_cc_target() {
   esac
 }
 
+_device_holders() {
+  # pids (with comm) holding an open fd on $1 — the host-side ground
+  # truth of "who has the chip". Excludes this engine process. ONE find
+  # exec scans every fd table (-lname matches the symlink target), not
+  # one readlink per fd — the poll loop below runs this every 0.5s.
+  local real link pid last=""
+  real="$(readlink -f "$1" 2>/dev/null)" || return 0
+  [ -e "$real" ] || return 0
+  find /proc/[0-9]*/fd -lname "$real" 2>/dev/null | while IFS= read -r link; do
+    pid="${link#/proc/}"; pid="${pid%%/*}"
+    [ "$pid" = "$$" ] && continue
+    [ "$pid" = "$last" ] && continue   # fd entries are per-pid contiguous
+    last="$pid"
+    echo "$(cat "/proc/$pid/comm" 2>/dev/null || echo '?')[$pid]"
+  done
+}
+
+_hold_wait_s_int() {
+  # TPU_CC_HOLD_WAIT_S is shared with the Python engine, which accepts
+  # fractions; bash arithmetic doesn't — round up
+  local w="${TPU_CC_HOLD_WAIT_S:-30}"
+  case "$w" in
+    *.*) w="${w%%.*}"; [ -z "$w" ] && w=0; w=$((w + 1)) ;;
+  esac
+  echo "$w"
+}
+
+_ensure_device_free() {
+  # exclusive-hold guarantee (parity with device/holders.py): never
+  # commit a staged mode while a foreign process holds the device. If
+  # TPU_CC_RUNTIME_RESTART_CMD is set it is run once (bounded by the
+  # wait window — a hung hook must not hang the flip) to make the
+  # external runtime let go, then we poll for TPU_CC_HOLD_WAIT_S.
+  case "${TPU_CC_HOLDER_CHECK:-proc}" in
+    none|off|false|0) return 0 ;;
+  esac
+  local dev="$1" holders wait_s
+  wait_s="$(_hold_wait_s_int)"
+  holders="$(_device_holders "$dev")"
+  [ -z "$holders" ] && return 0
+  if [ -n "${TPU_CC_RUNTIME_RESTART_CMD:-}" ]; then
+    log "WARN: $dev held by: $holders; running runtime restart hook"
+    timeout "$wait_s" bash -c "$TPU_CC_RUNTIME_RESTART_CMD" \
+      || { log "ERROR: runtime restart hook failed or timed out"; return 1; }
+  fi
+  local deadline=$((SECONDS + wait_s))
+  while [ $SECONDS -lt $deadline ]; do
+    holders="$(_device_holders "$dev")"
+    [ -z "$holders" ] && return 0
+    sleep 0.5
+  done
+  log "ERROR: $dev still held by: $holders; refusing to flip under a live holder"
+  return 1
+}
+
 _set_device_mode() {
   # $1 dev, $2 mode: gate + discard stale intent, stage the right
   # domains, commit (=reset), verify, regate
@@ -284,6 +350,7 @@ _set_device_mode() {
   "$TPUDEVCTL" stage "$dev" cc "$cc_target" || return 1
   "$TPUDEVCTL" stage "$dev" ici "$ici_target" || return 1
   _unbind_device_from_driver "$dev"
+  _ensure_device_free "$dev" || return 1
   "$TPUDEVCTL" commit "$dev" || return 1
   local got_cc got_ici
   got_cc="$("$TPUDEVCTL" query "$dev" cc)"

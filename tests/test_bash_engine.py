@@ -184,6 +184,37 @@ def test_device_gating_perms(env):
     assert st.S_IMODE(os.stat(tmp_path / "dev" / "accel0").st_mode) == 0o644
 
 
+def test_exclusive_hold_check(env):
+    """Parity with device/holders.py: the bash engine refuses to commit
+    while a foreign process holds the device node, and the configured
+    runtime restart hook evicts the holder so the flip can proceed."""
+    import subprocess as sp
+    import sys
+    e, server, tmp_path = env
+    dev = str(tmp_path / "dev" / "accel0")
+    holder = sp.Popen(
+        [sys.executable, "-c",
+         f"import time\nf=open({dev!r})\nprint('held',flush=True)\n"
+         "time.sleep(120)"],
+        stdout=sp.PIPE, text=True)
+    assert holder.stdout.readline().strip() == "held"
+    try:
+        e2 = dict(e)
+        e2["TPU_CC_HOLD_WAIT_S"] = "1"
+        r = run_sh(e2, "set-cc-mode", "-a", "-m", "on")
+        assert r.returncode != 0
+        assert "held by" in r.stderr
+
+        # with a restart hook that kills the holder, the flip proceeds
+        e3 = dict(e)
+        e3["TPU_CC_RUNTIME_RESTART_CMD"] = f"kill {holder.pid}"
+        r = run_sh(e3, "set-cc-mode", "-a", "-m", "on")
+        assert r.returncode == 0, r.stderr
+    finally:
+        holder.poll() is not None or holder.kill()
+        holder.wait()
+
+
 def test_drain_wait_counts_typemeta_less_pod_items(env):
     """A still-present component pod must be seen by the drain wait even
     though the apiserver (like a real one) omits kind/apiVersion from

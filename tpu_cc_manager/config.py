@@ -22,6 +22,9 @@ flag                      env                            default
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
 (none)                    EMIT_EVENTS                    true (reconcile Events)
 (none)                    TPU_CC_DEVICE_GATING           "chmod" | "none" (device-node gating)
+(none)                    TPU_CC_HOLDER_CHECK            "proc" | "none" (exclusive-hold scan)
+(none)                    TPU_CC_RUNTIME_RESTART_CMD     "" (hook to evict an external holder)
+(none)                    TPU_CC_HOLD_WAIT_S             30 (grace period for holders to leave)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
 ========================  =============================  =======================

@@ -56,6 +56,17 @@ class TpuChip(abc.ABC):
         along into this reset. Default: no-op for backends without durable
         staging."""
 
+    def verify_independent(self, domain: str) -> Optional[str]:
+        """Re-read the effective mode of ``domain`` through a path that
+        shares as little as possible with the flip that just committed —
+        a different binary (tpudevctl) or a different store
+        implementation against the same on-disk state. The engine
+        requires this reading to agree with the target before declaring
+        the flip verified (non-tautological verify, reference
+        main.py:291-296). Default: None — no independent path exists
+        (in-memory fakes), plain verify stands alone."""
+        return None
+
     @abc.abstractmethod
     def reset(self) -> None:
         """Restart the TPU runtime / reset the chip so a staged mode takes

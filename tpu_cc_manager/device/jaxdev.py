@@ -45,7 +45,7 @@ import time
 from typing import List, Optional, Tuple
 
 from tpu_cc_manager.device.base import Backend, DeviceError, TpuChip
-from tpu_cc_manager.device.statefile import ModeStateStore
+from tpu_cc_manager.device.statefile import ModeStateStore, independent_read
 
 log = logging.getLogger("tpu-cc-manager.jaxdev")
 
@@ -114,6 +114,13 @@ class JaxTpuChip(TpuChip):
 
     def discard_staged(self) -> None:
         self._backend.store.discard(self.path)
+
+    def verify_independent(self, domain: str) -> Optional[str]:
+        """Cross-read through the other store implementation (fresh
+        handle, shared bytes + lock only). The device-health half of the
+        verified claim comes from wait_ready's on-chip probe, which the
+        engine always runs before verify."""
+        return independent_read(self._backend.store, self.path, domain)
 
     # ------------------------------------------------------------- reset
     def reset(self) -> None:

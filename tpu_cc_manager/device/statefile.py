@@ -140,3 +140,24 @@ class ModeStateStore:
             for domain in ("cc", "ici"):
                 effective = self._read(d, f"{domain}.effective")
                 self._write_atomic(d, f"{domain}.staged", effective)
+
+
+def independent_read(store, path: str, domain: str) -> str:
+    """Cross-read the effective mode through an INDEPENDENT store handle,
+    preferring the *other* implementation (native libtpudev when the
+    caller uses the Python store, and vice versa). This is the engine's
+    non-tautological verify path (reference main.py:291-296 re-queries
+    hardware that could genuinely disagree): a commit that only "took"
+    inside the flipping handle's state — or a statefile tampered after
+    commit — is caught by a reader that shares nothing with the writer
+    but the bytes on disk and the fcntl lock."""
+    from tpu_cc_manager.device.native import load_native_store
+
+    state_dir = store.state_dir
+    if isinstance(state_dir, bytes):
+        state_dir = state_dir.decode()
+    if isinstance(store, ModeStateStore):
+        alt = load_native_store(state_dir) or ModeStateStore(state_dir)
+    else:
+        alt = ModeStateStore(state_dir)
+    return alt.effective(path, domain)

@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from tpu_cc_manager import device as devlayer
 from tpu_cc_manager.device.base import DeviceError, TpuChip
 from tpu_cc_manager.device.gate import DeviceGate
+from tpu_cc_manager.device.holders import HolderCheck
 from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
 from tpu_cc_manager.trace import Tracer, get_tracer
 
@@ -100,6 +101,7 @@ class ModeEngine:
         tracer: Optional[Tracer] = None,
         gate: Optional[DeviceGate] = None,
         flip_taint: Optional[FlipTaint] = None,
+        holder_check: Optional[HolderCheck] = None,
     ):
         self._set_state_label = set_state_label
         self._drainer = drainer or NullDrainer()
@@ -112,6 +114,8 @@ class ModeEngine:
         #: workload-visible device-node gating (TPU_CC_DEVICE_GATING)
         self._gate = gate or DeviceGate()
         self._flip_taint = flip_taint or FlipTaint()
+        #: exclusive-hold guarantee before commit (TPU_CC_HOLDER_CHECK)
+        self._holder_check = holder_check or HolderCheck()
 
     # ------------------------------------------------------------- queries
     def get_modes(self) -> dict:
@@ -284,6 +288,14 @@ class ModeEngine:
                             dev.set_cc_mode(target)
                         else:
                             dev.set_ici_mode(target)
+                    # exclusive-hold guarantee (the reference's driver
+                    # unbind makes this impossible by construction,
+                    # scripts/cc-manager.sh:40-50): the gate above stops
+                    # NEW opens, this stops committing under fds that
+                    # were already open — running the configured runtime
+                    # restart hook if needed
+                    with self._tracer.span("holder_check", device=dev.path):
+                        self._holder_check.ensure_free(dev.path)
                     dev.reset()
                     dev.wait_ready(timeout_s=self._boot_timeout_s)
                     for domain, target in changes.items():
@@ -300,6 +312,25 @@ class ModeEngine:
                             flip_span.error = (
                                 f"verify mismatch: {domain} wanted "
                                 f"{target!r} got {achieved!r}"
+                            )
+                            return False
+                        # non-tautological verify: a reader that shares
+                        # nothing with the flip path but the bytes on
+                        # disk must agree too (reference main.py:291-296
+                        # re-queries hardware that can genuinely
+                        # disagree; our statefile-backed chips would
+                        # otherwise only re-read their own bookkeeping)
+                        independent = dev.verify_independent(domain)
+                        if independent is not None and independent != target:
+                            log.error(
+                                "%s: independent %s verify disagrees: "
+                                "wanted %r, independent reader saw %r",
+                                dev.path, domain, target, independent,
+                            )
+                            flip_span.status = "error"
+                            flip_span.error = (
+                                f"independent verify mismatch: {domain} "
+                                f"wanted {target!r} got {independent!r}"
                             )
                             return False
                     if not dev.is_ici_switch():
