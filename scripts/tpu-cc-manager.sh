@@ -272,6 +272,23 @@ _gate_apply() {
   chmod "$perms" "$1" || true
 }
 
+_publish_evidence() {
+  # per-flip attestation evidence (parity with the Python engines):
+  # build the document in python (shared wire format, see
+  # tpu_cc_manager/evidence.py), publish through this engine's own curl
+  # path. Best-effort — evidence never fails a flip.
+  [ "${TPU_CC_EVIDENCE:-true}" = "true" ] || return 0
+  local patch
+  if ! patch="$(python3 -m tpu_cc_manager.evidence 2>/dev/null)"; then
+    log "WARN: evidence build failed; skipping evidence annotation"
+    return 0
+  fi
+  curl -sf --max-time 30 -X PATCH \
+    -H "Content-Type: application/merge-patch+json" \
+    -d "$patch" "$API/api/v1/nodes/$NODE_NAME" > /dev/null \
+    || log "WARN: evidence annotation publish failed"
+}
+
 _gate_cc_target() {
   # effective cc domain value for a node-level mode
   case "$1" in
@@ -285,10 +302,13 @@ _device_holders() {
   # truth of "who has the chip". Excludes this engine process. ONE find
   # exec scans every fd table (-lname matches the symlink target), not
   # one readlink per fd — the poll loop below runs this every 0.5s.
-  local real link pid last=""
+  local real esc link pid last=""
   real="$(readlink -f "$1" 2>/dev/null)" || return 0
   [ -e "$real" ] || return 0
-  find /proc/[0-9]*/fd -lname "$real" 2>/dev/null | while IFS= read -r link; do
+  # -lname fnmatches: escape glob metacharacters or a path containing
+  # [ ] * ? silently matches nothing and the hold check fails OPEN
+  esc="$(printf '%s' "$real" | sed 's/[][*?\\]/\\&/g')"
+  find /proc/[0-9]*/fd -lname "$esc" 2>/dev/null | while IFS= read -r link; do
     pid="${link#/proc/}"; pid="${pid%%/*}"
     [ "$pid" = "$$" ] && continue
     [ "$pid" = "$last" ] && continue   # fd entries are per-pid contiguous
@@ -299,11 +319,14 @@ _device_holders() {
 
 _hold_wait_s_int() {
   # TPU_CC_HOLD_WAIT_S is shared with the Python engine, which accepts
-  # fractions; bash arithmetic doesn't — round up
+  # fractions; bash arithmetic doesn't — round up, and clamp to >=1
+  # because `timeout 0` means UNBOUNDED to GNU timeout (a hung restart
+  # hook must never hang the flip)
   local w="${TPU_CC_HOLD_WAIT_S:-30}"
   case "$w" in
     *.*) w="${w%%.*}"; [ -z "$w" ] && w=0; w=$((w + 1)) ;;
   esac
+  [ "$w" -ge 1 ] 2>/dev/null || w=1
   echo "$w"
 }
 
@@ -419,6 +442,7 @@ set_cc_mode() {
       _gate_apply "$dev" "$(_gate_cc_target "$mode")"
     done
     _set_state_label "$mode"
+    _publish_evidence
     _post_event "CCModeApplied" "Normal" \
       "cc mode '$mode' already set on ${#devices[@]} device(s) (no-op)"
     return 0
@@ -432,6 +456,7 @@ set_cc_mode() {
     fi
   done
   _set_state_label "$mode"
+  _publish_evidence
   _post_event "CCModeApplied" "Normal" \
     "cc mode '$mode' applied to ${#devices[@]} device(s)"
   _reschedule_components

@@ -165,6 +165,21 @@ def test_bash_engine_posts_events(env):
     assert len(server.store.list_events("default")) == 2
 
 
+def test_bash_engine_publishes_evidence(env):
+    """Parity with the Python engines: a successful bash flip publishes
+    the evidence annotation (same wire format, built by
+    `python -m tpu_cc_manager.evidence`), and it verifies."""
+    import json
+    from tpu_cc_manager.evidence import evidence_mode, verify_evidence
+    e, server, tmp_path = env
+    assert run_sh(e, "set-cc-mode", "-a", "-m", "on").returncode == 0
+    ann = server.store.get_node("bash-node")["metadata"]["annotations"]
+    doc = json.loads(ann[L.EVIDENCE_ANNOTATION])
+    assert verify_evidence(doc, key=None)[0] is True
+    assert doc["node"] == "bash-node"
+    assert evidence_mode(doc) == "on"
+
+
 def test_device_gating_perms(env):
     """Parity with device/gate.py: after a verified flip the device
     node's permission bits encode the effective CC mode (on=0600,

@@ -410,7 +410,13 @@ def test_cpp_agent_full_native_path_through_proxy_sidecar(
             assert store.effective(f"{dev}/accel{i}", "cc") == "on"
         # every byte travelled the sidecar hop
         assert proxy.connections > 0
-        # the engine touched the readiness file (reference :536 parity)
+        # the engine touches the readiness file after the state label
+        # (with evidence publication in between — poll, don't race)
+        deadline = time.monotonic() + 15
+        while (time.monotonic() < deadline
+               and not os.path.exists(env["CC_READINESS_FILE"])):
+            time.sleep(0.1)
+        # reference :536 parity
         assert os.path.exists(env["CC_READINESS_FILE"])
     finally:
         proc.terminate()

@@ -151,6 +151,11 @@ def main(argv=None) -> int:
         t0 = _time.monotonic()
         try:
             ok = engine.set_mode(args.mode)
+            if ok and cfg.emit_evidence:
+                # same per-flip evidence the long-lived agent publishes
+                from tpu_cc_manager.evidence import publish_evidence
+
+                publish_evidence(kube, cfg.node_name)
             _post_event("success" if ok else "failure",
                         _time.monotonic() - t0)
             return 0 if ok else 1

@@ -95,6 +95,7 @@ class CCManagerAgent:
             # injected into the coordinator is left alone)
             slice_coordinator.tracer = self.tracer
 
+        self._backend = backend
         self.engine = ModeEngine(
             set_state_label=self._set_state_label,
             drainer=build_drainer(kube, cfg),
@@ -138,6 +139,17 @@ class CCManagerAgent:
     def _set_state_label(self, value: str) -> None:
         set_cc_mode_state_label(self.kube, self.cfg.node_name, value)
         self.metrics.set_current_mode(value)
+
+    def _publish_evidence(self) -> None:
+        """Best-effort per-flip attestation evidence annotation (see
+        tpu_cc_manager.evidence): published after every successful
+        reconcile so the fleet controller can audit evidence-vs-label
+        consistency. Never fails the reconcile."""
+        if not self.cfg.emit_evidence:
+            return
+        from tpu_cc_manager.evidence import publish_evidence
+
+        publish_evidence(self.kube, self.cfg.node_name, self._backend)
 
     def _on_fatal_watch(self, exc: Exception) -> None:
         self._fatal = exc
@@ -220,6 +232,8 @@ class CCManagerAgent:
             finally:
                 dur = time.monotonic() - start
                 self.last_outcome = outcome
+                if outcome == "success":
+                    self._publish_evidence()
                 self._arm_repair(raw_mode, outcome)
                 self._emit_reconcile_event(raw_mode, outcome, dur)
                 root_span.attrs["outcome"] = outcome

@@ -25,6 +25,8 @@ flag                      env                            default
 (none)                    TPU_CC_HOLDER_CHECK            "proc" | "none" (exclusive-hold scan)
 (none)                    TPU_CC_RUNTIME_RESTART_CMD     "" (hook to evict an external holder)
 (none)                    TPU_CC_HOLD_WAIT_S             30 (grace period for holders to leave)
+(none)                    TPU_CC_EVIDENCE                true (per-flip evidence annotation)
+(none)                    TPU_CC_EVIDENCE_KEY[_FILE]     "" (HMAC key; unset = plain sha256)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
 ========================  =============================  =======================
@@ -74,6 +76,10 @@ class AgentConfig:
     #: node` shows the mode-flip history (the reference surfaces outcomes
     #: only in labels + pod logs). Best-effort; EMIT_EVENTS=false disables.
     emit_events: bool = True
+    #: Publish the per-flip attestation evidence annotation
+    #: (tpu_cc_manager.evidence). Best-effort; TPU_CC_EVIDENCE=false
+    #: disables.
+    emit_evidence: bool = True
 
     def __post_init__(self):
         if self.drain_strategy not in ("components", "node", "none"):
@@ -224,5 +230,6 @@ def parse_config(argv: Optional[List[str]] = None):
         repair_interval_s=float(os.environ.get("REPAIR_INTERVAL_S", "30")),
         trace_file=os.environ.get("CC_TRACE_FILE") or None,
         emit_events=_env_bool("EMIT_EVENTS", True),
+        emit_evidence=_env_bool("TPU_CC_EVIDENCE", True),
     )
     return cfg, args

@@ -1,0 +1,303 @@
+"""Attestation evidence per flip (VERDICT r2 item 2).
+
+The reference's flip changes hardware state, so the hardware itself is
+the evidence (reference main.py:291-296 re-queries it). On TPU the
+attestation mode is host-side durable state, so the framework must
+*produce* evidence: at every successful reconcile the agent emits a
+signed-or-hashed evidence document binding together
+
+- the node identity and timestamp,
+- every device's identity as enumerated (path, chip model, and — on the
+  PJRT backend — the live device id / process index / topology coords),
+- every device's effective modes as read back through the INDEPENDENT
+  verify path (device/statefile.independent_read — the same
+  cross-implementation reader the engine's verify uses),
+- a digest over the on-disk statefiles themselves,
+
+and publishes it as the ``tpu.google.com/cc.evidence`` node annotation.
+The fleet controller audits evidence-vs-label consistency fleet-wide
+(tpu_cc_manager.fleet), and :func:`verify_evidence` re-checks a document
+against the local statefiles — a tampered statefile is detected because
+its recomputed digest no longer matches the evidence.
+
+Integrity: the document digest is HMAC-SHA256 when a node key is
+configured (``TPU_CC_EVIDENCE_KEY`` inline or
+``TPU_CC_EVIDENCE_KEY_FILE``; give each pool a key via a Secret to make
+evidence unforgeable by anything that can't read the key), else plain
+SHA-256 (tamper-*evident* against accidental corruption and label-only
+actors, not against an adversary with annotation write access — exactly
+the honesty the reference's unauthenticated state label also lives
+with).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import json
+import logging
+import os
+import time
+from typing import List, Optional, Tuple
+
+from tpu_cc_manager.device.statefile import independent_read
+
+log = logging.getLogger("tpu-cc-manager.evidence")
+
+EVIDENCE_VERSION = 1
+
+
+def evidence_key() -> Optional[bytes]:
+    """Node evidence key: TPU_CC_EVIDENCE_KEY (inline) or
+    TPU_CC_EVIDENCE_KEY_FILE (path, e.g. a mounted Secret)."""
+    inline = os.environ.get("TPU_CC_EVIDENCE_KEY", "")
+    if inline:
+        return inline.encode()
+    path = os.environ.get("TPU_CC_EVIDENCE_KEY_FILE", "")
+    if path:
+        try:
+            with open(path, "rb") as f:
+                return f.read().strip() or None
+        except OSError as e:
+            log.warning("cannot read evidence key file %s: %s", path, e)
+            return None
+    return None
+
+
+def _canonical(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _digest(payload: bytes, key: Optional[bytes]) -> str:
+    if key:
+        return "hmac-sha256:" + hmac_mod.new(
+            key, payload, hashlib.sha256
+        ).hexdigest()
+    return "sha256:" + hashlib.sha256(payload).hexdigest()
+
+
+def statefile_digest(store, device_paths: List[str]) -> Optional[str]:
+    """SHA-256 over every device's effective per-domain statefile values,
+    read through the independent cross-implementation reader. None when
+    the backend has no durable store (in-memory fakes)."""
+    if store is None:
+        return None
+    h = hashlib.sha256()
+    for path in sorted(device_paths):
+        for domain in ("cc", "ici"):
+            value = independent_read(store, path, domain)
+            h.update(f"{path}\x00{domain}\x00{value}\n".encode())
+    return "sha256:" + h.hexdigest()
+
+
+def _device_entry(chip, store) -> dict:
+    entry = {"path": chip.path, "name": chip.name}
+    # live-enumeration identity, where the backend provides it
+    for attr in ("device_id", "process_index", "coords", "platform"):
+        v = getattr(chip, attr, None)
+        if v is not None:
+            entry[attr] = list(v) if isinstance(v, tuple) else v
+    # capability-gated even when a store exists: an ICI switch has no cc
+    # domain, and attesting the store default 'off' for it would make
+    # every switch-bearing node read as 'mixed'
+    if chip.is_cc_query_supported:
+        entry["cc"] = (
+            independent_read(store, chip.path, "cc") if store is not None
+            else chip.query_cc_mode()
+        )
+    else:
+        entry["cc"] = None
+    if chip.is_ici_query_supported:
+        entry["ici"] = (
+            independent_read(store, chip.path, "ici") if store is not None
+            else chip.query_ici_mode()
+        )
+    else:
+        entry["ici"] = None
+    return entry
+
+
+def build_evidence(node_name: str, backend,
+                   key: Optional[bytes] = None) -> dict:
+    """Evidence document for the node's current device state. ``key``
+    defaults to :func:`evidence_key`."""
+    if key is None:
+        key = evidence_key()
+    store = getattr(backend, "store", None)
+    chips, err = backend.find_tpus()
+    if err:
+        raise RuntimeError(f"cannot build evidence: enumeration failed: {err}")
+    switches = [
+        c for c in backend.find_ici_switches()
+        if c.path not in {x.path for x in chips}
+    ]
+    devices = [_device_entry(c, store) for c in list(chips) + switches]
+    doc = {
+        "version": EVIDENCE_VERSION,
+        "node": node_name,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "devices": devices,
+        "statefile_digest": statefile_digest(
+            store, [d["path"] for d in devices]
+        ),
+    }
+    doc["digest"] = _digest(_canonical(doc), key)
+    return doc
+
+
+def evidence_mode(doc: dict) -> Optional[str]:
+    """Node-level mode this evidence attests to: 'ici' only when EVERY
+    ici-capable device has protected ICI on (a half-flipped ici node is
+    'mixed', not protected); else the devices' common cc mode; 'mixed'
+    when devices disagree; None when the node has no devices."""
+    devices = doc.get("devices") or []
+    cc_modes = {d.get("cc") for d in devices if d.get("cc") is not None}
+    ici_modes = {d.get("ici") for d in devices if d.get("ici") is not None}
+    if "on" in ici_modes:
+        return "ici" if ici_modes == {"on"} else "mixed"
+    if not cc_modes:
+        return None
+    if len(cc_modes) > 1:
+        return "mixed"
+    return cc_modes.pop()
+
+
+def verify_evidence(doc: dict, *, key: Optional[bytes] = None,
+                    backend=None) -> Tuple[bool, str]:
+    """Check a document's integrity, and — when ``backend`` is given —
+    re-derive the statefile digest from disk so post-hoc statefile
+    tampering is detected. Returns (ok, reason)."""
+    if key is None:
+        key = evidence_key()
+    if (not isinstance(doc, dict) or
+            not isinstance(doc.get("digest"), str)):
+        return False, "malformed"
+    body = {k: v for k, v in doc.items() if k != "digest"}
+    claimed = doc["digest"]
+    if claimed.startswith("hmac-sha256:") and key is None:
+        return False, "no_key"
+    if key is not None and not claimed.startswith("hmac-sha256:"):
+        # no downgrade: a keyed verifier rejects unsigned documents —
+        # otherwise a forger without the key could bypass the HMAC by
+        # publishing a plain-sha256 doc
+        return False, "unsigned"
+    recomputed = _digest(
+        _canonical(body),
+        key if claimed.startswith("hmac-sha256:") else None,
+    )
+    if not hmac_mod.compare_digest(recomputed, claimed):
+        return False, "digest_mismatch"
+    if backend is not None:
+        store = getattr(backend, "store", None)
+        paths = [d["path"] for d in (doc.get("devices") or [])]
+        actual = statefile_digest(store, paths)
+        if actual != doc.get("statefile_digest"):
+            return False, "statefile_mismatch"
+    return True, "ok"
+
+
+def publish_evidence(kube, node_name: str, backend=None) -> bool:
+    """Build this node's evidence and publish it as the evidence
+    annotation. Best-effort: returns False (after logging) on any
+    failure — evidence must never fail a reconcile. Shared by the
+    long-lived agent, the one-shot CLI, and the bash engine (which execs
+    it via ``python -m tpu_cc_manager.evidence``)."""
+    try:
+        if backend is None:
+            from tpu_cc_manager import device as devlayer
+
+            backend = devlayer.get_backend()
+        from tpu_cc_manager import labels as L
+
+        doc = build_evidence(node_name, backend)
+        kube.set_node_annotations(node_name, {
+            L.EVIDENCE_ANNOTATION: json.dumps(
+                doc, sort_keys=True, separators=(",", ":")
+            ),
+        })
+        return True
+    except Exception:
+        log.warning("evidence publication failed", exc_info=True)
+        return False
+
+
+def audit_evidence(nodes: List[dict],
+                   key: Optional[bytes] = None) -> dict:
+    """Fleet-wide evidence-vs-label audit (run by the fleet controller):
+    every node whose ``cc.mode.state`` label claims a successfully
+    applied mode must carry evidence that (a) passes integrity
+    verification and (b) attests the SAME mode the label claims. The
+    label is writable by anything with node-patch rights; the evidence
+    binds the claim to independently-read device state — this is the
+    'label vs device truth' cross-check the per-node agents cannot do
+    for each other (VERDICT r2 item 7)."""
+    from tpu_cc_manager import labels as L
+
+    if key is None:
+        key = evidence_key()
+    missing: List[str] = []
+    invalid: List[str] = []
+    mismatch: List[str] = []
+    for node in nodes:
+        meta = node.get("metadata", {})
+        name = meta.get("name", "?")
+        state = (meta.get("labels") or {}).get(L.CC_MODE_STATE_LABEL)
+        if state in (None, "failed"):
+            continue  # no successful mode claim to audit
+        raw = (meta.get("annotations") or {}).get(L.EVIDENCE_ANNOTATION)
+        if not raw:
+            missing.append(name)
+            continue
+        # the annotation is exactly the hostile input this audit exists
+        # for — one malformed document must count as invalid, never
+        # crash the fleet scan loop
+        try:
+            doc = json.loads(raw)
+            ok, _reason = verify_evidence(doc, key=key)
+            if not ok or doc.get("node") != name:
+                invalid.append(name)
+                continue
+            attested = evidence_mode(doc)
+        except Exception:
+            invalid.append(name)
+            continue
+        if attested is not None and attested != state:
+            mismatch.append(name)
+    return {
+        "missing": sorted(missing),
+        "invalid": sorted(invalid),
+        "label_device_mismatch": sorted(mismatch),
+    }
+
+
+def main(argv=None) -> int:
+    """CLI (``python -m tpu_cc_manager.evidence``): print the node
+    merge-patch carrying this host's evidence annotation. The bash
+    engine builds evidence here and publishes through its own curl path,
+    so all three engines emit the same wire format."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(prog="tpu-cc-evidence")
+    ap.add_argument("--node-name", default=os.environ.get("NODE_NAME"))
+    args = ap.parse_args(argv)
+    if not args.node_name:
+        print("NODE_NAME required", file=sys.stderr)
+        return 1
+    from tpu_cc_manager import device as devlayer
+    from tpu_cc_manager import labels as L
+
+    doc = build_evidence(args.node_name, devlayer.get_backend())
+    patch = {"metadata": {"annotations": {
+        L.EVIDENCE_ANNOTATION: json.dumps(
+            doc, sort_keys=True, separators=(",", ":")
+        ),
+    }}}
+    print(json.dumps(patch))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via bash engine
+    import sys
+
+    sys.exit(main())

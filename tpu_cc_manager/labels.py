@@ -63,6 +63,13 @@ SLICE_EPOCH_ANNOTATION = "tpu.google.com/cc.slice.epoch"
 SLICE_ACK_ANNOTATION = "tpu.google.com/cc.slice.ack"
 SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"
 
+#: Per-flip attestation evidence annotation (tpu_cc_manager.evidence):
+#: a hashed/HMAC'd document binding node, live device identities,
+#: independently-read effective modes, and a statefile digest. Written by
+#: the agent at every successful reconcile; audited fleet-wide by the
+#: fleet controller.
+EVIDENCE_ANNOTATION = "tpu.google.com/cc.evidence"
+
 #: Node taint held for the duration of a mode flip so the *scheduler* —
 #: not just the component pause labels — keeps new TPU work off a node
 #: whose devices are gated mid-flip. Cleared when the flip cycle ends
