@@ -352,6 +352,219 @@ class _StaleAnchorKube:
         return getattr(self._real, attr)
 
 
+def test_superseded_round_aborts_fast_via_label(monkeypatch):
+    """VERDICT r2 item 4: the operator changes the desired label while a
+    round is stuck waiting for quorum. The member must abort as
+    superseded within a few poll periods — no commit-timeout stall, no
+    spurious failure — and retract its ack."""
+    kube = FakeKube()
+    m1 = SliceMember(kube, "p1", "slice-s", commit_timeout_s=30)
+    # alive (fresh heartbeat) but never acks: quorum can't form
+    SliceMember(kube, "p2", "slice-s")
+    kube.set_node_annotations("p2", {HB_ANNOTATION: str(time.time() + 1000)})
+
+    errs = {}
+
+    def run():
+        try:
+            m1.apply("on")
+        except SliceAbortError as e:
+            errs["e"] = e
+
+    t = threading.Thread(target=run)
+    t0 = time.monotonic()
+    t.start()
+    # let the round publish its ack and start waiting
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        ann = kube.get_node("p1")["metadata"].get("annotations", {})
+        if ann.get(L.SLICE_ACK_ANNOTATION) == "on":
+            break
+        time.sleep(0.02)
+    # operator changes the desired mode mid-round
+    kube.set_node_labels("p1", {L.CC_MODE_LABEL: "devtools"})
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 10  # nowhere near commit_timeout_s
+    e = errs["e"]
+    assert e.superseded is True
+    assert "superseded" in str(e)
+    # devices untouched, no state label published, ack retracted
+    assert m1.chip.query_cc_mode() == "off"
+    assert m1.states == []
+    ann = kube.get_node("p1")["metadata"].get("annotations", {})
+    assert ann.get(L.SLICE_ACK_ANNOTATION) is None
+
+
+def test_superseded_round_aborts_via_should_abort_callback():
+    """The agent wires should_abort to its mailbox: the coordinator must
+    poll it and abort without touching devices."""
+    kube = FakeKube()
+    flagged = threading.Event()
+    m1 = SliceMember(kube, "q1", "slice-t", commit_timeout_s=30,
+                     should_abort=lambda mode: flagged.is_set())
+    SliceMember(kube, "q2", "slice-t")  # alive but never acks
+    kube.set_node_annotations("q2", {HB_ANNOTATION: str(time.time() + 1000)})
+
+    errs = {}
+
+    def run():
+        try:
+            m1.apply("on")
+        except SliceAbortError as e:
+            errs["e"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)
+    flagged.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert errs["e"].superseded is True
+    assert m1.chip.query_cc_mode() == "off"
+
+
+def test_agent_superseded_round_reconciles_new_mode_without_failed():
+    """Full agent path: label flips mid-round; the agent must never
+    publish cc.mode.state=failed, and must converge to the NEW mode
+    within a few poll periods once the slice acks it."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    kube = FakeKube()
+    m2 = SliceMember(kube, "r2", "slice-u")  # peer, acks later
+    # alive from the start, so the "on" round cannot trivially commit
+    kube.set_node_annotations("r2", {HB_ANNOTATION: str(time.time() + 1000)})
+    # the agent under test runs on r1 (anchor + leader by name order)
+    labels = {L.TPU_SLICE_LABEL: "slice-u",
+              L.CC_MODE_LABEL: "on"}
+    kube.add_node(make_node("r1", labels=labels))
+    chip = FakeChip(path="/dev/r1")
+    coord = SliceCoordinator(kube, "r1", poll_s=0.05, commit_timeout_s=30,
+                             hb_ttl_s=2)
+    cfg = AgentConfig(node_name="r1", drain_strategy="none", health_port=0,
+                      emit_events=False, emit_evidence=False,
+                      repair_interval_s=0)
+    agent = CCManagerAgent(kube, cfg, slice_coordinator=coord,
+                           backend=FakeBackend(chips=[chip]))
+    assert coord.should_abort is not None  # wired to the mailbox
+
+    results = []
+
+    def run():
+        # the agent consumed "on" from its mailbox; mid-round the
+        # operator flips to devtools
+        agent.config_mailbox.set("on")
+        agent.config_mailbox.get(timeout=1)
+        results.append(("on", agent.reconcile("on")))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)
+    # supersede mid-round: in production the watch feeds both the node
+    # label and the mailbox, so update both here
+    kube.set_node_labels("r1", {L.CC_MODE_LABEL: "devtools"})
+    agent.config_mailbox.set("devtools")
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert results == [("on", False)]
+    assert agent.last_outcome == "superseded"
+    # the spurious-failed bug: state label must never read failed
+    node_labels = kube.get_node("r1")["metadata"]["labels"]
+    assert node_labels.get(L.CC_MODE_STATE_LABEL) != "failed"
+
+    # the new mode then converges normally once the peer acks it
+    got, value = agent.config_mailbox.get(timeout=1)
+    assert (got, value) == (True, "devtools")
+
+    def peer():
+        try:
+            m2.apply("devtools")
+        except SliceAbortError:
+            pass
+
+    pt = threading.Thread(target=peer)
+    pt.start()
+    assert agent.reconcile("devtools") is True
+    pt.join(timeout=10)
+    assert chip.query_cc_mode() == "devtools"
+    assert kube.get_node("r1")["metadata"]["labels"][
+        L.CC_MODE_STATE_LABEL] == "devtools"
+
+
+def test_empty_label_value_does_not_supersede():
+    """cc.mode='' resolves to the agent default; it must NOT abort the
+    in-flight round for that default as superseded (the round should run
+    to its normal outcome — here, a quorum timeout)."""
+    kube = FakeKube()
+    m1 = SliceMember(kube, "e1", "slice-e", commit_timeout_s=1.0)
+    SliceMember(kube, "e2", "slice-e")  # alive but never acks
+    kube.set_node_annotations("e2", {HB_ANNOTATION: str(time.time() + 1000)})
+    kube.set_node_labels("e1", {L.CC_MODE_LABEL: ""})
+
+    try:
+        m1.apply("on")
+        assert False, "expected timeout abort"
+    except SliceAbortError as e:
+        assert e.superseded is False  # a timeout, not a supersession
+
+
+def test_label_flap_back_to_same_mode_reruns_round(monkeypatch):
+    """X->Y->X flap observed mid-round: the agent must abort the round
+    (ack was retracted) and immediately RE-RUN mode X — not block on the
+    mailbox with X unapplied forever."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    kube = FakeKube()
+    kube.add_node(make_node("f1"))
+    cfg = AgentConfig(node_name="f1", drain_strategy="none", health_port=0,
+                      emit_events=False, emit_evidence=False,
+                      repair_interval_s=0)
+    agent = CCManagerAgent(kube, cfg, backend=FakeBackend(chips=[]))
+
+    calls = []
+    outcomes = iter(["superseded", "success"])
+
+    def fake_reconcile(mode):
+        calls.append(mode)
+        agent.last_outcome = next(outcomes)
+        return agent.last_outcome == "success"
+
+    monkeypatch.setattr(agent, "reconcile", fake_reconcile)
+    # the flap already coalesced away: mailbox has nothing pending
+    assert agent._reconcile_current("on") is True
+    assert calls == ["on", "on"]  # re-ran the SAME mode after the abort
+
+    # and with a pending different mode, the retry uses the new mode
+    calls.clear()
+    outcomes = iter(["superseded", "success"])
+    agent.config_mailbox.set("devtools")
+    assert agent._reconcile_current("on") is True
+    assert calls == ["on", "devtools"]
+
+
+def test_pending_peek_is_mode_resolved():
+    """A pending label REMOVAL that resolves back to the in-flight mode
+    (default) is not a supersession — no churny abort."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    kube = FakeKube()
+    kube.add_node(make_node("g1"))
+    cfg = AgentConfig(node_name="g1", default_mode="on",
+                      drain_strategy="none", health_port=0,
+                      emit_events=False, emit_evidence=False)
+    agent = CCManagerAgent(kube, cfg, backend=FakeBackend(chips=[]))
+    agent.config_mailbox.set("on")
+    agent.config_mailbox.get(timeout=1)  # in-flight round consumed "on"
+
+    agent.config_mailbox.set(None)  # label removed -> default "on"
+    assert agent._superseded_by_pending("on") is False
+    agent.config_mailbox.set("devtools")
+    assert agent._superseded_by_pending("on") is True
+
+
 def test_commit_cas_exactly_one_writer_per_epoch():
     # VERDICT r1 item 7: during a heartbeat-staleness window two members
     # can both believe they are leader. The CAS fence on the anchor must

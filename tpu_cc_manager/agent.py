@@ -94,6 +94,14 @@ class CCManagerAgent:
             # slice_wait spans land in this agent's trace tree (a tracer
             # injected into the coordinator is left alone)
             slice_coordinator.tracer = self.tracer
+        if (
+            slice_coordinator is not None
+            and slice_coordinator.should_abort is None
+        ):
+            # an in-flight slice round is superseded the moment a newer
+            # desired mode lands in the mailbox — don't stall the round
+            # out to its timeout
+            slice_coordinator.should_abort = self._superseded_by_pending
 
         self._backend = backend
         self.engine = ModeEngine(
@@ -139,6 +147,53 @@ class CCManagerAgent:
     def _set_state_label(self, value: str) -> None:
         set_cc_mode_state_label(self.kube, self.cfg.node_name, value)
         self.metrics.set_current_mode(value)
+
+    def _superseded_by_pending(self, in_flight_mode: str) -> bool:
+        """True when the mailbox holds a pending desired value that
+        RESOLVES (with_default) to a different mode than the in-flight
+        round — a label flap or removal that coalesces back to the same
+        effective mode is not a supersession, just churn."""
+        has, value = self.config_mailbox.peek_pending()
+        if not has:
+            return False
+        return with_default(value, self.cfg.default_mode) != in_flight_mode
+
+    def _reconcile_current(self, mode: str) -> bool:
+        """Reconcile, following supersessions: a superseded round
+        immediately re-reconciles the NEWEST desired mode — consuming
+        the pending mailbox value if one is still there, or re-running
+        the same mode if a flap coalesced back to it (the aborted
+        round's ack was retracted, so it must re-run either way). Without
+        this, an X->Y->X flap observed mid-round would abort the X round
+        and then block on the mailbox forever with X unapplied."""
+        while True:
+            ok = self.reconcile(mode)
+            if self.last_outcome != "superseded" or self._stop.is_set():
+                return ok
+            got, value = self.config_mailbox.get(timeout=0)
+            if not got:
+                # nothing pending — either a flap coalesced back to this
+                # mode, or the watcher isn't feeding the mailbox yet (the
+                # STARTUP reconcile runs before watcher.start()). Re-read
+                # the label directly: re-running the old mode against a
+                # changed label would supersede-abort forever.
+                from tpu_cc_manager import labels as L
+
+                try:
+                    node = self.kube.get_node(self.cfg.node_name)
+                    value = (node["metadata"].get("labels") or {}).get(
+                        L.CC_MODE_LABEL)
+                except Exception:
+                    log.warning("desired-label re-read failed; retrying "
+                                "the superseded mode", exc_info=True)
+                    continue
+            new_mode = with_default(value, self.cfg.default_mode)
+            if new_mode is None:
+                # desired mode withdrawn entirely (label removed, no
+                # default): the superseded round stays unapplied by design
+                self._disarm_repair()
+                return ok
+            mode = new_mode
 
     def _publish_evidence(self) -> None:
         """Best-effort per-flip attestation evidence annotation (see
@@ -212,6 +267,13 @@ class CCManagerAgent:
                     # termination artifact, not a real failure: leave the
                     # durable state label alone
                     outcome = "shutdown"
+                    return False
+                if e.superseded:
+                    # the operator changed the desired mode mid-round: not
+                    # a failure — the mailbox already holds the new mode
+                    # and the main loop reconciles it immediately. No
+                    # failed label, no Warning event, no repair arming.
+                    outcome = "superseded"
                     return False
                 try:
                     self._set_state_label("failed")
@@ -380,10 +442,13 @@ class CCManagerAgent:
             initial = self._prime_with_retry()
             mode = with_default(initial, cfg.default_mode)
             if mode is not None:
-                ok = self.reconcile(mode)
-                if not ok and initial is None:
+                ok = self._reconcile_current(mode)
+                if (not ok and initial is None
+                        and self.last_outcome not in ("superseded",
+                                                      "shutdown")):
                     # startup default-apply failure is fatal in the Go agent
-                    # (cmd/main.go:141-145)
+                    # (cmd/main.go:141-145); a superseded or shutting-down
+                    # startup round is not a failure
                     log.error("initial default-mode apply failed; exiting")
                     return 1
             # signal readiness only after the initial reconcile
@@ -408,7 +473,9 @@ class CCManagerAgent:
                     # a pending repair must not re-apply the stale mode
                     self._disarm_repair()
                     continue
-                self.reconcile(mode)  # failure: log + continue (go :164-167)
+                # failure: log + continue (go :164-167); supersession:
+                # retried inside with the newest mode
+                self._reconcile_current(mode)
                 if max_reconciles is not None and self.reconcile_count >= max_reconciles:
                     break
             if self._fatal is not None:

@@ -97,12 +97,16 @@ DONE_ANNOTATION = "tpu.google.com/cc.slice.done"
 class SliceAbortError(Exception):
     """The slice round did not reach a commit; the local flip was NOT
     attempted. The agent publishes the failed state and keeps serving —
-    except when ``shutting_down`` is set, in which case the abort is an
-    artifact of agent termination and no failure is published."""
+    except when ``shutting_down`` is set (an artifact of agent
+    termination) or ``superseded`` is set (the operator changed the
+    desired mode mid-round; the NEW mode is about to reconcile), in
+    which cases no failure is published."""
 
-    def __init__(self, msg: str, *, shutting_down: bool = False):
+    def __init__(self, msg: str, *, shutting_down: bool = False,
+                 superseded: bool = False):
         super().__init__(msg)
         self.shutting_down = shutting_down
+        self.superseded = superseded
 
 
 def _parse_stamp(raw: Optional[str]) -> Tuple[Optional[str], int]:
@@ -128,6 +132,7 @@ class SliceCoordinator:
         poll_s: float = POLL_S,
         clock=time.time,
         tracer: Optional[Tracer] = None,
+        should_abort=None,
     ):
         self.kube = kube
         self.node_name = node_name
@@ -137,6 +142,13 @@ class SliceCoordinator:
         self.commit_timeout_s = commit_timeout_s
         self.poll_s = poll_s
         self.clock = clock
+        #: Optional callable polled during the commit wait with the
+        #: in-flight mode: True means a newer desired mode has arrived
+        #: that RESOLVES to a different mode, so this round is superseded
+        #: (the agent wires it to a with_default-aware mailbox peek —
+        #: a label flap that coalesces back to the same effective mode
+        #: must not abort the round).
+        self.should_abort = should_abort
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
 
@@ -270,6 +282,28 @@ class SliceCoordinator:
                     )
                     self._stop.wait(self.poll_s)
                     continue
+                # superseded? (VERDICT r2 item 4: an in-flight round must
+                # not stall out the full timeout and publish a spurious
+                # `failed` when the operator changes the desired mode
+                # mid-round). Two signals, either suffices: the agent's
+                # mailbox (should_abort), and this node's own desired
+                # label re-read from the member list we just fetched.
+                if (self.should_abort is not None
+                        and self.should_abort(raw_mode)):
+                    self._superseded_abort(slice_id, raw_mode)
+                me_row = next(
+                    (n for n in members
+                     if n["metadata"]["name"] == self.node_name), None,
+                )
+                if me_row is not None:
+                    desired_now = (me_row["metadata"].get("labels") or {}
+                                   ).get(L.CC_MODE_LABEL)
+                    # a REMOVED or empty label maps to the agent's
+                    # default mode, which this coordinator doesn't know —
+                    # only a present-and-different value is proof of
+                    # supersession
+                    if desired_now and desired_now != raw_mode:
+                        self._superseded_abort(slice_id, raw_mode)
                 alive = self._alive(members)
                 if not alive:
                     break
@@ -335,6 +369,18 @@ class SliceCoordinator:
             + (" (shutting down)" if shutting_down else "")
             + "; refusing to flip — the slice must move atomically",
             shutting_down=shutting_down,
+        )
+
+    def _superseded_abort(self, slice_id: str, raw_mode: str) -> None:
+        """Abort the round cleanly: retract the ack (the leader must stop
+        counting us toward the OLD mode's quorum) and raise with
+        superseded set, so the agent skips the failed label and proceeds
+        straight to the new mode."""
+        self._retract_ack()
+        raise SliceAbortError(
+            f"slice {slice_id}: round for mode {raw_mode!r} superseded by "
+            f"a newer desired mode; aborting without failure",
+            superseded=True,
         )
 
     def _maybe_commit(

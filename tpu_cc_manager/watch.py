@@ -81,6 +81,24 @@ class SyncableModeConfig:
             self._last_read = self._current
             return True, self._current
 
+    def peek_pending(self):
+        """Non-consuming peek: ``(True, value)`` when a newer value is
+        waiting that differs from the last one consumed, else
+        ``(False, None)``. Lets a long in-flight reconcile (the
+        slice-coordination wait) notice it may have been superseded
+        without disturbing the mailbox's coalescing contract — the
+        caller decides whether the pending value actually *changes* the
+        effective mode (label-removal can coalesce back to the same
+        default)."""
+        with self._cond:
+            if (
+                not self._closed
+                and self._has_value
+                and self._current != self._last_read
+            ):
+                return True, self._current
+            return False, None
+
     def close(self) -> None:
         with self._cond:
             self._closed = True
