@@ -494,3 +494,245 @@ def test_vanished_node_in_pending_group_fails_at_launch():
     assert by_name["node/a"].outcome == "timeout"  # nobody converges it
     assert by_name["node/b"].outcome == "failed"
     assert "before launch" in by_name["node/b"].detail
+
+
+# ----------------------------------------------------- durable record/resume
+class SimulatedCrash(Exception):
+    pass
+
+
+def _crash_rollout_at(kube, monkeypatch, rollout, record_ready):
+    """Run `rollout` in a thread and kill it (SimulatedCrash raised from
+    its own poll-sleep) once `record_ready(record)` is true. Returns the
+    record at crash time."""
+    import tpu_cc_manager.rollout as rollout_mod
+    from tpu_cc_manager.rollout import load_rollout_record
+
+    crash = threading.Event()
+    died = threading.Event()
+    orig_sleep = time.sleep
+    box = {}
+
+    def target():
+        try:
+            rollout.run()
+        except SimulatedCrash:
+            died.set()
+
+    t = threading.Thread(target=target, daemon=True)
+
+    def crashing_sleep(s):
+        if crash.is_set() and threading.current_thread() is t:
+            raise SimulatedCrash()
+        orig_sleep(s)
+
+    monkeypatch.setattr(rollout_mod.time, "sleep", crashing_sleep)
+    t.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        rec, _ = load_rollout_record(kube, kube.list_nodes(None))
+        if rec is not None and record_ready(rec):
+            break
+        orig_sleep(0.02)
+    else:
+        raise AssertionError("crash precondition never reached")
+    crash.set()
+    assert died.wait(10), "rollout thread did not crash"
+    monkeypatch.setattr(rollout_mod.time, "sleep", orig_sleep)
+    rec, _ = load_rollout_record(kube, kube.list_nodes(None))
+    return rec
+
+
+def test_resume_after_crash_one_coherent_report(monkeypatch):
+    """VERDICT r2 item 6: kill the rollout mid-window, resume, and get
+    one coherent final report with no group double-counted."""
+    kube = FakeKube()
+    names = [f"n{i}" for i in range(4)]
+    _pool(kube, *[_node(n, desired="off", state="off") for n in names])
+    # n0 converges; n1 stalls (agent not simulated yet) -> stays in_flight
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    roll = Rollout(kube, "on", max_unavailable=1, group_timeout_s=60,
+                   poll_s=0.05)
+
+    def ready(rec):
+        g = rec.get("groups", {})
+        return (g.get("node/n0", {}).get("outcome") == "succeeded"
+                and g.get("node/n1", {}).get("outcome") == "in_flight")
+
+    rec = _crash_rollout_at(kube, monkeypatch, roll, ready)
+    agents.stop.set()
+    assert rec["complete"] is False
+    assert rec["groups"]["node/n2"]["outcome"] == "pending"
+
+    # a fresh rollout is refused while the record is unfinished
+    with pytest.raises(RolloutError, match="--resume"):
+        Rollout(kube, "on").run()
+
+    # resume: all agents now converge
+    agents2 = _ReactiveAgents(kube, names)
+    agents2.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.05, group_timeout_s=60).run()
+    finally:
+        agents2.stop.set()
+    assert report.ok
+    assert [g.name for g in report.groups] == sorted(
+        f"node/{n}" for n in names)          # every group exactly once
+    outcomes = {g.name: g.outcome for g in report.groups}
+    assert outcomes == {f"node/{n}": "succeeded" for n in names}
+    # the durable record is now complete; a fresh rollout is allowed again
+    from tpu_cc_manager.rollout import load_rollout_record
+    rec, _ = load_rollout_record(kube, kube.list_nodes(None))
+    assert rec["complete"] is True
+
+
+def test_resume_preserves_spent_failure_budget(monkeypatch):
+    """Budget spent before the crash carries over: one more failure
+    after resume exhausts it and aborts, with the remainder
+    not_attempted."""
+    kube = FakeKube()
+    names = [f"m{i}" for i in range(4)]
+    _pool(kube, *[_node(n, desired="off", state="off") for n in names])
+    agents = _ReactiveAgents(kube, ["m0", "m1"], fail_nodes={"m1"})
+    agents.start()
+    roll = Rollout(kube, "on", max_unavailable=1, failure_budget=1,
+                   group_timeout_s=60, poll_s=0.05)
+
+    def ready(rec):
+        g = rec.get("groups", {})
+        return (g.get("node/m1", {}).get("outcome") == "failed"
+                and g.get("node/m2", {}).get("outcome") == "in_flight")
+
+    _crash_rollout_at(kube, monkeypatch, roll, ready)
+    agents.stop.set()
+
+    agents2 = _ReactiveAgents(kube, names, fail_nodes={"m1", "m2"})
+    agents2.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.05, group_timeout_s=60).run()
+    finally:
+        agents2.stop.set()
+    outcomes = {g.name: g.outcome for g in report.groups}
+    assert outcomes["node/m0"] == "succeeded"
+    assert outcomes["node/m1"] == "failed"        # judged pre-crash
+    assert outcomes["node/m2"] == "failed"        # budget now exhausted
+    assert outcomes["node/m3"] == "not_attempted"
+    assert report.aborted
+    assert len(report.groups) == 4
+
+
+def test_resume_with_nothing_to_resume():
+    kube = FakeKube()
+    _pool(kube, _node("x1", desired="on", state="on"))
+    with pytest.raises(RolloutError, match="no unfinished rollout"):
+        Rollout.resume(kube)
+    # a COMPLETED record is also not resumable
+    report = Rollout(kube, "on", poll_s=0.05).run()
+    assert report.ok
+    with pytest.raises(RolloutError, match="no unfinished rollout"):
+        Rollout.resume(kube)
+
+
+def _write_record(kube, node, record):
+    import json as _json
+    kube.set_node_annotations(node, {
+        L.ROLLOUT_ANNOTATION: _json.dumps(record)})
+
+
+def test_resume_of_aborted_rollout_drains_in_flight():
+    """Groups in flight when an already-aborted rollout crashed have
+    patched labels and flipping nodes: resume must JUDGE them, not
+    report them not_attempted."""
+    kube = FakeKube()
+    _pool(kube,
+          _node("d0", desired="on", state="on"),      # succeeded pre-crash
+          _node("d1", desired="on", state="off"),     # in flight at crash
+          _node("d2", desired="off", state="off"))    # pending at crash
+    _write_record(kube, "d0", {
+        "id": "abc", "started": 1.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": True,
+        "groups": {
+            "node/d0": {"nodes": ["d0"], "outcome": "succeeded"},
+            "node/dX": {"nodes": ["dX"], "outcome": "failed",
+                        "detail": "budget burner"},
+            "node/d1": {"nodes": ["d1"], "outcome": "in_flight"},
+            "node/d2": {"nodes": ["d2"], "outcome": "pending"},
+        },
+    })
+    agents = _ReactiveAgents(kube, ["d1"])
+    agents.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.05, group_timeout_s=30).run()
+    finally:
+        agents.stop.set()
+    outcomes = {g.name: g.outcome for g in report.groups}
+    assert outcomes["node/d1"] == "succeeded"       # drained, not dropped
+    assert outcomes["node/d2"] == "not_attempted"   # launches stay blocked
+    assert report.aborted
+    # d2's desired label was never patched
+    assert kube.get_node("d2")["metadata"]["labels"][L.CC_MODE_LABEL] == "off"
+
+
+def test_resume_uses_recorded_selector_and_guard_sees_foreign_records():
+    """The record persists its selector: resume scopes the SAME node
+    set even when invoked with the default selector, and a new rollout
+    with a different selector is refused while any unfinished record
+    exists anywhere in the cluster."""
+    kube = FakeKube()
+    # pool under a custom selector; nodes lack the default accel label
+    kube.add_node(make_node("c0", labels={
+        "pool": "custom", L.CC_MODE_LABEL: "on",
+        L.CC_MODE_STATE_LABEL: "off"}))
+    kube.add_node(make_node("c1", labels={
+        "pool": "custom", L.CC_MODE_LABEL: "off",
+        L.CC_MODE_STATE_LABEL: "off"}))
+    _write_record(kube, "c0", {
+        "id": "sel1", "started": 2.0, "mode": "on",
+        "selector": "pool=custom",
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": False,
+        "groups": {
+            "node/c0": {"nodes": ["c0"], "outcome": "in_flight"},
+            "node/c1": {"nodes": ["c1"], "outcome": "pending"},
+        },
+    })
+    # a new rollout over a DIFFERENT selector is refused
+    kube.add_node(_node("other1", desired="off", state="off"))
+    with pytest.raises(RolloutError, match="--resume"):
+        Rollout(kube, "on").run()
+    # resume with the DEFAULT selector still finds + scopes the record
+    agents = _ReactiveAgents(kube, ["c0", "c1"])
+    agents.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.05, group_timeout_s=30).run()
+    finally:
+        agents.stop.set()
+    assert report.ok
+    assert {g.name for g in report.groups} == {"node/c0", "node/c1"}
+
+
+def test_resume_dry_run_previews_without_patching():
+    kube = FakeKube()
+    _pool(kube, _node("p0", desired="off", state="off"),
+          _node("p1", desired="off", state="off"))
+    _write_record(kube, "p0", {
+        "id": "dr1", "started": 3.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": False,
+        "groups": {
+            "node/p0": {"nodes": ["p0"], "outcome": "in_flight"},
+            "node/p1": {"nodes": ["p1"], "outcome": "pending"},
+        },
+    })
+    report = Rollout.resume(kube, dry_run=True).run()
+    outcomes = {g.name: g.outcome for g in report.groups}
+    assert outcomes == {"node/p0": "planned", "node/p1": "planned"}
+    # nothing patched, record still unfinished (resumable for real)
+    assert kube.get_node("p0")["metadata"]["labels"][L.CC_MODE_LABEL] == "off"
+    from tpu_cc_manager.rollout import load_rollout_record
+    rec, _ = load_rollout_record(kube, kube.list_nodes(None))
+    assert rec["complete"] is False

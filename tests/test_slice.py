@@ -492,6 +492,24 @@ def test_agent_superseded_round_reconciles_new_mode_without_failed():
         L.CC_MODE_STATE_LABEL] == "devtools"
 
 
+def test_already_won_commit_beats_supersession():
+    """A commit the slice already won is honored BEFORE any supersession
+    abort: peers may flip on that commit in the same poll, so aborting
+    would leave the slice mixed. The member must flip to the committed
+    mode even though its desired label already shows a newer one."""
+    kube = FakeKube()
+    m1 = SliceMember(kube, "w1", "slice-w", commit_timeout_s=10)
+    SliceMember(kube, "w2", "slice-w")
+    # an actionable commit for 'on' is already on the anchor (w1)...
+    kube.set_node_annotations("w1", {L.SLICE_COMMIT_ANNOTATION: "on:5"})
+    # ...while the desired label has ALREADY moved on to devtools
+    kube.set_node_labels("w1", {L.CC_MODE_LABEL: "devtools"})
+    assert m1.apply("on") is True  # flips, no superseded abort
+    assert m1.chip.query_cc_mode() == "on"
+    ann = kube.get_node("w1")["metadata"]["annotations"]
+    assert ann[DONE_ANNOTATION] == "on:5"
+
+
 def test_empty_label_value_does_not_supersede():
     """cc.mode='' resolves to the agent default; it must NOT abort the
     in-flight round for that default as superseded (the round should run

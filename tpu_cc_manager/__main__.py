@@ -81,16 +81,36 @@ def main(argv=None) -> int:
         from tpu_cc_manager.rollout import Rollout, RolloutError
 
         try:
-            rollout = Rollout(
-                _kube_client(cfg),
-                args.mode,
-                selector=args.selector,
-                max_unavailable=args.max_unavailable,
-                failure_budget=args.failure_budget,
-                group_timeout_s=args.group_timeout,
-                force=args.force,
-                dry_run=args.dry_run,
-            )
+            if args.resume:
+                if args.mode:
+                    log.error("--resume takes the mode from the durable "
+                              "record; do not pass --mode")
+                    return 1
+                if args.max_unavailable != 1 or args.failure_budget != 0:
+                    log.error("--resume takes the window and budget from "
+                              "the durable record; do not pass "
+                              "--max-unavailable/--failure-budget")
+                    return 1
+                rollout = Rollout.resume(
+                    _kube_client(cfg),
+                    selector=args.selector,
+                    group_timeout_s=args.group_timeout,
+                    dry_run=args.dry_run,
+                )
+            else:
+                if not args.mode:
+                    log.error("rollout requires -m/--mode (or --resume)")
+                    return 1
+                rollout = Rollout(
+                    _kube_client(cfg),
+                    args.mode,
+                    selector=args.selector,
+                    max_unavailable=args.max_unavailable,
+                    failure_budget=args.failure_budget,
+                    group_timeout_s=args.group_timeout,
+                    force=args.force,
+                    dry_run=args.dry_run,
+                )
             report = rollout.run()
         except (InvalidModeError, RolloutError) as e:
             log.error("rollout refused: %s", e)

@@ -156,7 +156,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="roll a mode change across the pool, bounded by a "
              "disruption window (operator-side; no NODE_NAME needed)",
     )
-    roll.add_argument("-m", "--mode", required=True)
+    roll.add_argument("-m", "--mode", default=None,
+                      help="target mode (not needed with --resume)")
+    roll.add_argument(
+        "--resume", action="store_true",
+        help="resume the pool's unfinished rollout from its durable "
+             "record (anchor-node annotation) after an operator-side "
+             "crash; mode/window/budget come from the record",
+    )
     roll.add_argument(
         "--selector",
         default=L.TPU_ACCELERATOR_LABEL,

@@ -70,6 +70,13 @@ SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"
 #: fleet controller.
 EVIDENCE_ANNOTATION = "tpu.google.com/cc.evidence"
 
+#: Durable rollout record (tpu_cc_manager.rollout): the group plan,
+#: per-group outcomes, and budget of the pool's current/last rollout,
+#: stored as an annotation on the pool's anchor node so an operator-side
+#: crash mid-rollout can be resumed (`rollout --resume`) from cluster
+#: state alone.
+ROLLOUT_ANNOTATION = "tpu.google.com/cc.rollout"
+
 #: Node taint held for the duration of a mode flip so the *scheduler* —
 #: not just the component pause labels — keeps new TPU work off a node
 #: whose devices are gated mid-flip. Cleared when the flip cycle ends

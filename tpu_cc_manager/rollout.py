@@ -26,6 +26,18 @@ Semantics:
   pool first; failed nodes or half-flipped slices fail fast unless
   ``force`` — rolling a new mode over a broken fleet only hides the
   breakage.
+- **Durable record + resume.** The rollout's identity, parameters, group
+  plan, and per-group outcomes are persisted to the
+  ``tpu.google.com/cc.rollout`` annotation on the pool's anchor node
+  (the lexicographically smallest member — the same durable-location
+  convention slice commits use) at every state transition, with group
+  *intent* written before the labels are patched. An operator-side crash
+  therefore loses nothing that matters: ``rollout --resume``
+  reconstructs the window, the remaining budget, and the not-yet-judged
+  groups from cluster state, relaunches the groups that were in flight
+  (label patches are idempotent), and produces ONE coherent final
+  report with every group counted exactly once. A second concurrent
+  rollout is refused while an unfinished record exists.
 """
 
 from __future__ import annotations
@@ -47,6 +59,35 @@ log = logging.getLogger("tpu-cc-manager.rollout")
 
 class RolloutError(Exception):
     """Preflight or configuration problem; nothing was patched."""
+
+
+#: Group outcomes that consume failure budget.
+_BUDGET_CONSUMING = ("failed", "timeout")
+#: Group outcomes that are final (never re-attempted on resume).
+_TERMINAL = ("skipped", "succeeded", "failed", "timeout", "not_attempted")
+
+
+def load_rollout_record(kube: KubeClient, nodes: Sequence[dict]
+                        ) -> Tuple[Optional[dict], Optional[str]]:
+    """Newest rollout record found on any pool node -> (record, node).
+    Scanning the whole pool (not just the current anchor) tolerates the
+    anchor node changing between rollouts."""
+    best: Optional[dict] = None
+    best_node: Optional[str] = None
+    for n in nodes:
+        raw = (n["metadata"].get("annotations") or {}).get(
+            L.ROLLOUT_ANNOTATION)
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if best is None or rec.get("started", 0) > best.get("started", 0):
+            best, best_node = rec, n["metadata"]["name"]
+    return best, best_node
 
 
 @dataclasses.dataclass
@@ -121,6 +162,76 @@ class Rollout:
         self.poll_s = poll_s
         self.force = force
         self.dry_run = dry_run
+        #: durable-record state (anchor-node annotation); set by run()
+        self._record: Optional[dict] = None
+        self._record_node: Optional[str] = None
+        self._resume_from: Optional[Tuple[dict, str]] = None
+
+    @classmethod
+    def resume(
+        cls,
+        kube: KubeClient,
+        *,
+        selector: str = L.TPU_ACCELERATOR_LABEL,
+        group_timeout_s: float = 600.0,
+        poll_s: float = 0.5,
+        dry_run: bool = False,
+    ) -> "Rollout":
+        """Rebuild a Rollout from the pool's unfinished durable record.
+        Mode, window, budget, AND selector come from the record (the
+        record persists the selector precisely so the resumed run scopes
+        the same node set); ``force`` is implied (a mid-rollout pool
+        legitimately contains half-flipped slices — that's what is being
+        resumed). ``dry_run`` previews the resume without patching."""
+        nodes = kube.list_nodes(selector)
+        record, record_node = load_rollout_record(kube, nodes)
+        if record is None:
+            # the record's anchor may sit outside the caller's selector
+            # (original rollout used a different one): scan the cluster
+            record, record_node = load_rollout_record(
+                kube, kube.list_nodes(None)
+            )
+        if record is None or record.get("complete"):
+            raise RolloutError("no unfinished rollout to resume on this pool")
+        r = cls(
+            kube, record["mode"],
+            selector=record.get("selector", selector),
+            max_unavailable=int(record.get("max_unavailable", 1)),
+            failure_budget=int(record.get("failure_budget", 0)),
+            group_timeout_s=group_timeout_s, poll_s=poll_s, force=True,
+            dry_run=dry_run,
+        )
+        r._resume_from = (record, record_node)
+        return r
+
+    # ---------------------------------------------------------- durability
+    def _persist(self) -> None:
+        """Write the record annotation; best-effort (a persist failure
+        degrades resume fidelity, it must not fail the live rollout)."""
+        if self._record is None or self._record_node is None:
+            return
+        try:
+            payload = json.dumps(
+                self._record, sort_keys=True, separators=(",", ":")
+            )
+            self.kube.set_node_annotations(
+                self._record_node, {L.ROLLOUT_ANNOTATION: payload}
+            )
+        except ApiException as e:
+            log.warning(
+                "rollout record persist failed (resume fidelity "
+                "degraded): %s", e,
+            )
+
+    def _record_group(self, gname: str, nodes: List[str], outcome: str,
+                      detail: str = "") -> None:
+        if self._record is None:
+            return
+        g = self._record["groups"].setdefault(gname, {"nodes": list(nodes)})
+        g["outcome"] = outcome
+        if detail:
+            g["detail"] = detail
+        self._persist()
 
     # ------------------------------------------------------------ planning
     def discover(self) -> List[dict]:
@@ -180,20 +291,115 @@ class Rollout:
         by_name = {n["metadata"]["name"]: n for n in nodes}
         results: List[GroupResult] = []
         pending = deque()
-        for gname, members in self.plan_groups(nodes):
-            if all(self._converged(by_name[m]) for m in members):
-                results.append(
-                    GroupResult(gname, members, "skipped",
-                                f"already at {self.mode}")
-                )
-            elif self.dry_run:
-                results.append(GroupResult(gname, members, "planned"))
-            else:
-                pending.append((gname, members))
+        budget = self.failure_budget
+        aborted = False
 
-        report = RolloutReport(self.mode, results, aborted=False,
+        in_flight_seed: List[Tuple[str, List[str]]] = []
+        if self._resume_from is not None:
+            # -------- resume: the record, not re-planning, is the truth
+            self._record, self._record_node = self._resume_from
+            groups_rec = self._record.get("groups", {})
+            relaunch = deque()
+            for gname in sorted(groups_rec):
+                g = groups_rec[gname]
+                members = list(g.get("nodes", []))
+                oc = g.get("outcome", "pending")
+                if oc in _TERMINAL:
+                    # judged before the crash: counted exactly once,
+                    # never re-attempted
+                    results.append(GroupResult(
+                        gname, members, oc, g.get("detail", "")))
+                elif oc == "in_flight":
+                    relaunch.append((gname, members))
+                else:
+                    pending.append((gname, members))
+            budget -= sum(
+                1 for g in groups_rec.values()
+                if g.get("outcome") in _BUDGET_CONSUMING
+            )
+            aborted = bool(self._record.get("aborted"))
+            if self.dry_run:
+                # preview only: report the record's state, patch nothing
+                for gname, members in relaunch:
+                    results.append(GroupResult(
+                        gname, members, "planned",
+                        "would relaunch (was in flight at crash)",
+                    ))
+                for gname, members in pending:
+                    results.append(GroupResult(gname, members, "planned"))
+                pending = deque()
+                relaunch = deque()
+                self._record = None  # no persistence from a preview
+            elif aborted:
+                # the rollout had already aborted: its in-flight groups'
+                # labels are patched and the nodes are flipping — DRAIN
+                # them (judge to a terminal outcome) rather than falsely
+                # reporting them not_attempted; pending stays blocked
+                in_flight_seed = list(relaunch)
+            else:
+                # intent was persisted before the patch: relaunching is
+                # an idempotent re-patch + fresh judge window
+                pending = deque(list(relaunch) + list(pending))
+            log.info(
+                "resuming rollout %s to %r: %d judged, %d to relaunch/"
+                "drain, %d pending, remaining budget %d",
+                self._record.get("id") if self._record else "(dry-run)",
+                self.mode, len(results), len(relaunch) + len(in_flight_seed),
+                len(pending), budget,
+            )
+        else:
+            # the guard must see records on ANY node, not just this
+            # selector's pool — a second rollout with a disjoint selector
+            # could otherwise run concurrently over the same nodes
+            existing, _ = load_rollout_record(
+                self.kube, self.kube.list_nodes(None)
+            )
+            if existing and not existing.get("complete") and not self.dry_run:
+                raise RolloutError(
+                    f"an unfinished rollout (id {existing.get('id')}, mode "
+                    f"{existing.get('mode')!r}) already exists on this "
+                    f"pool; finish it with --resume"
+                )
+            for gname, members in self.plan_groups(nodes):
+                if all(self._converged(by_name[m]) for m in members):
+                    results.append(
+                        GroupResult(gname, members, "skipped",
+                                    f"already at {self.mode}")
+                    )
+                elif self.dry_run:
+                    results.append(GroupResult(gname, members, "planned"))
+                else:
+                    pending.append((gname, members))
+            if not self.dry_run:
+                import uuid as _uuid
+
+                self._record_node = sorted(by_name)[0]  # pool anchor
+                self._record = {
+                    "id": _uuid.uuid4().hex[:8],
+                    "started": time.time(),
+                    "mode": self.mode,
+                    "selector": self.selector,
+                    "max_unavailable": self.max_unavailable,
+                    "failure_budget": self.failure_budget,
+                    "complete": False,
+                    "aborted": False,
+                    "groups": {},
+                }
+                for r in results:
+                    self._record["groups"][r.name] = {
+                        "nodes": list(r.nodes), "outcome": r.outcome,
+                        "detail": r.detail,
+                    }
+                for gname, members in pending:
+                    self._record["groups"][gname] = {
+                        "nodes": list(members), "outcome": "pending",
+                    }
+                self._persist()
+
+        report = RolloutReport(self.mode, results, aborted=aborted,
                                preflight=preflight)
-        if self.dry_run or not pending:
+        if self.dry_run or (not pending and not in_flight_seed):
+            self._finish_record(report)
             report.groups.sort(key=lambda g: g.name)
             return report
 
@@ -202,8 +408,19 @@ class Rollout:
             len(pending), self.mode, self.max_unavailable,
             self.failure_budget,
         )
-        budget = self.failure_budget
         in_flight: Dict[str, Tuple[List[str], float, set]] = {}
+        for gname, members in in_flight_seed:
+            # resumed drain of an aborted rollout's in-flight groups:
+            # already patched pre-crash; judge only, with a fresh window
+            stale_failed = {
+                m for m in members
+                if by_name.get(m, {}).get("metadata", {}).get(
+                    "labels", {}).get(L.CC_MODE_STATE_LABEL) == "failed"
+            }
+            in_flight[gname] = (
+                members, time.monotonic() + self.group_timeout_s,
+                stale_failed,
+            )
         while pending or in_flight:
             while (
                 pending
@@ -217,11 +434,11 @@ class Rollout:
                 # at launch, mirroring _judge_group's in-flight check
                 gone = sorted(m for m in members if m not in by_name)
                 if gone:
-                    results.append(GroupResult(
-                        gname, members, "failed",
-                        f"node(s) disappeared from the pool before "
-                        f"launch: {gone}",
-                    ))
+                    detail = (f"node(s) disappeared from the pool before "
+                              f"launch: {gone}")
+                    results.append(GroupResult(gname, members, "failed",
+                                               detail))
+                    self._record_group(gname, members, "failed", detail)
                     budget -= 1
                     continue
                 # a node already showing 'failed' at launch (--force over a
@@ -234,6 +451,10 @@ class Rollout:
                         L.CC_MODE_STATE_LABEL
                     ) == "failed"
                 }
+                # persist INTENT before patching: a crash between the
+                # two leaves the group marked in_flight, and resume
+                # relaunches it (idempotent patch) instead of losing it
+                self._record_group(gname, members, "in_flight")
                 if self._launch(gname, members, by_name):
                     in_flight[gname] = (
                         members,
@@ -241,10 +462,11 @@ class Rollout:
                         stale_failed,
                     )
                 else:
+                    detail = "desired-label patch failed"
                     results.append(
-                        GroupResult(gname, members, "failed",
-                                    "desired-label patch failed")
+                        GroupResult(gname, members, "failed", detail)
                     )
+                    self._record_group(gname, members, "failed", detail)
                     budget -= 1
 
             if in_flight:
@@ -269,11 +491,18 @@ class Rollout:
                         continue
                     del in_flight[gname]
                     results.append(outcome)
-                    if outcome.outcome in ("failed", "timeout"):
+                    self._record_group(
+                        gname, outcome.nodes, outcome.outcome,
+                        outcome.detail,
+                    )
+                    if outcome.outcome in _BUDGET_CONSUMING:
                         budget -= 1
 
             if budget < 0 and not report.aborted:
                 report.aborted = True
+                if self._record is not None:
+                    self._record["aborted"] = True
+                    self._persist()
                 log.error(
                     "failure budget exhausted; draining %d in-flight "
                     "group(s), %d pending group(s) not attempted",
@@ -285,12 +514,24 @@ class Rollout:
                         GroupResult(gname, members, "not_attempted",
                                     "rollout aborted")
                     )
+                    self._record_group(gname, members, "not_attempted",
+                                       "rollout aborted")
                 pending.clear()
             if in_flight:
                 time.sleep(self.poll_s)
 
+        self._finish_record(report)
         report.groups.sort(key=lambda g: g.name)
         return report
+
+    def _finish_record(self, report: RolloutReport) -> None:
+        """Mark the durable record complete (kept for audit; the next
+        rollout overwrites it)."""
+        if self._record is None:
+            return
+        self._record["complete"] = True
+        self._record["aborted"] = report.aborted
+        self._persist()
 
     def _launch(
         self, gname: str, members: List[str], by_name: Dict[str, dict]
