@@ -9,11 +9,17 @@
  * here the engine command is configurable and defaults to the Python
  * one-shot CLI).
  *
- * Transport: HTTP/1.1 over a POSIX socket to KUBE_API_HOST:KUBE_API_PORT.
- * In-cluster this is fronted by a `kubectl proxy` localhost sidecar
- * (which owns TLS + service-account auth); in tests it talks directly to
- * tpu_cc_manager.k8s.apiserver. A BEARER_TOKEN_FILE env is honored for
- * direct plain-HTTP API endpoints.
+ * Transport: HTTP/1.1 over a POSIX socket to KUBE_API_HOST:KUBE_API_PORT,
+ * or — with KUBE_API_TLS=true — over TLS spoken by an `openssl s_client`
+ * child process per connection (-verify_return_error -CAfile <cluster
+ * CA> plus hostname/IP verification; fail-closed: a handshake or
+ * verification failure reads as EOF and the request fails). The
+ * subprocess transport is what makes direct in-cluster HTTPS possible
+ * without linking a TLS library into the binary; the `kubectl proxy`
+ * localhost-sidecar topology (daemonset-native.yaml) remains supported
+ * for proxied deployments. BEARER_TOKEN_FILE supplies the
+ * service-account token either way; in tests the agent talks directly
+ * to tpu_cc_manager.k8s.apiserver.
  *
  * Watch-stream JSON handling: events for a node-scoped watch are parsed
  * with a targeted key scanner (type / resourceVersion / the cc.mode
@@ -35,6 +41,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <netdb.h>
+#include <poll.h>
 #include <stdarg.h>
 #include <time.h>
 #include <signal.h>
@@ -42,6 +49,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -51,6 +59,8 @@
 #include <string>
 #include <thread>
 #include <vector>
+
+extern char **environ;
 
 namespace {
 
@@ -63,7 +73,12 @@ int g_api_port = 8001;
 std::string g_engine_cmd =
     "python3 -m tpu_cc_manager set-cc-mode -m %s";
 int g_watch_timeout_s = 300; /* TPU_CC_WATCH_TIMEOUT_S; tests shrink it */
-std::string g_bearer_token;
+std::string g_token_file; /* BEARER_TOKEN_FILE; re-read per request —
+                           * bound SA tokens rotate on disk (~1h) and a
+                           * cached copy would 401 a long-lived daemon */
+bool g_tls = false;           /* KUBE_API_TLS: direct HTTPS (no sidecar) */
+std::string g_ca_file;        /* KUBE_CA_FILE: cluster CA to verify */
+std::string g_openssl = "openssl"; /* TPU_CC_OPENSSL: s_client binary */
 /* label value main() SUCCESSFULLY reconciled at startup; seeds the
  * watcher's change detection so the list-state push skips the no-change
  * case instead of double-reconciling. Stays at the never-matching
@@ -140,21 +155,130 @@ int dial(const std::string &host, int port) {
   return fd;
 }
 
-bool send_all(int fd, const std::string &data) {
+/* One API-server connection: a plain socket, or a pipe pair into an
+ * `openssl s_client` child that owns the TLS session. Both ends are
+ * driven through the same read/write helpers below, so the HTTP layer
+ * never knows which transport it is on. */
+struct Conn {
+  int rfd = -1;   /* read end (socket, or child's stdout) */
+  int wfd = -1;   /* write end (same socket, or child's stdin) */
+  pid_t pid = -1; /* s_client child; -1 for plain TCP */
+  bool ok() const { return rfd >= 0; }
+};
+
+bool looks_like_ip(const std::string &h) {
+  /* IPv4 dotted quad or IPv6 (contains ':'): choose -verify_ip */
+  if (h.find(':') != std::string::npos) return true;
+  bool digit_seen = false;
+  for (char c : h) {
+    if (c >= '0' && c <= '9') { digit_seen = true; continue; }
+    if (c == '.') continue;
+    return false;
+  }
+  return digit_seen;
+}
+
+Conn conn_dial() {
+  Conn c;
+  if (!g_tls) {
+    int fd = dial(g_api_host, g_api_port);
+    if (fd >= 0) { c.rfd = c.wfd = fd; }
+    return c;
+  }
+  /* TLS: delegate the session to openssl s_client with full chain +
+   * endpoint verification. -quiet keeps stdout pure payload (and
+   * disables the interactive Q/R commands); -verify_return_error makes
+   * a failed verification abort the connection (fail-closed). */
+  int to_child[2], from_child[2];
+  if (pipe(to_child) != 0) return c;
+  if (pipe(from_child) != 0) {
+    close(to_child[0]); close(to_child[1]);
+    return c;
+  }
+  char hostport[512];
+  snprintf(hostport, sizeof(hostport), "%s:%d", g_api_host.c_str(),
+           g_api_port);
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(to_child[0]); close(to_child[1]);
+    close(from_child[0]); close(from_child[1]);
+    return c;
+  }
+  if (pid == 0) {
+    dup2(to_child[0], 0);
+    dup2(from_child[1], 1);
+    close(to_child[0]); close(to_child[1]);
+    close(from_child[0]); close(from_child[1]);
+    const char *verify_flag =
+        looks_like_ip(g_api_host) ? "-verify_ip" : "-verify_hostname";
+    /* child stderr stays on the agent's stderr: handshake failures are
+     * the one place the operator needs the real OpenSSL error text */
+    execlp(g_openssl.c_str(), g_openssl.c_str(), "s_client", "-quiet",
+           "-connect", hostport, "-servername", g_api_host.c_str(),
+           "-verify_return_error", "-CAfile", g_ca_file.c_str(),
+           verify_flag, g_api_host.c_str(), (char *)nullptr);
+    _exit(127);
+  }
+  close(to_child[0]);
+  close(from_child[1]);
+  c.wfd = to_child[1];
+  c.rfd = from_child[0];
+  c.pid = pid;
+  return c;
+}
+
+void conn_close(Conn &c) {
+  if (c.wfd >= 0 && c.wfd != c.rfd) close(c.wfd);
+  if (c.rfd >= 0) close(c.rfd);
+  if (c.pid > 0) {
+    kill(c.pid, SIGTERM);
+    waitpid(c.pid, nullptr, 0);
+  }
+  c = Conn{};
+}
+
+bool conn_write_all(Conn &c, const std::string &data) {
   size_t off = 0;
   while (off < data.size()) {
-    ssize_t w = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
-    if (w <= 0) return false;
+    ssize_t w = write(c.wfd, data.data() + off, data.size() - off);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
     off += static_cast<size_t>(w);
   }
   return true;
 }
 
+/* Read with a poll() timeout (SO_RCVTIMEO does not apply to pipes).
+ * Returns >0 bytes, 0 on EOF/close, -1 on error, -2 on timeout. */
+ssize_t conn_read(Conn &c, char *buf, size_t n, int timeout_ms) {
+  struct pollfd pfd = {c.rfd, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_ms);
+  if (pr == 0) return -2;
+  if (pr < 0) return (errno == EINTR) ? -2 : -1;
+  ssize_t r = read(c.rfd, buf, n);
+  if (r < 0 && errno == EINTR) return -2;
+  return r;
+}
+
+std::string read_bearer_token() {
+  if (g_token_file.empty()) return "";
+  FILE *f = fopen(g_token_file.c_str(), "r");
+  if (!f) return "";
+  char tok[8192] = {0};
+  size_t n = fread(tok, 1, sizeof(tok) - 1, f);
+  fclose(f);
+  std::string t(tok, n);
+  while (!t.empty() && (t.back() == '\n' || t.back() == ' ')) t.pop_back();
+  return t;
+}
+
 std::string request_head(const std::string &method, const std::string &path) {
   std::string req = method + " " + path + " HTTP/1.1\r\nHost: " + g_api_host +
                     "\r\nAccept: application/json\r\n";
-  if (!g_bearer_token.empty())
-    req += "Authorization: Bearer " + g_bearer_token + "\r\n";
+  std::string token = read_bearer_token();
+  if (!token.empty()) req += "Authorization: Bearer " + token + "\r\n";
   return req;
 }
 
@@ -164,8 +288,8 @@ std::string request_head(const std::string &method, const std::string &path) {
 int http_request(const std::string &method, const std::string &path,
                  const std::string &extra_headers, const std::string &req_body,
                  std::string *resp_body) {
-  int fd = dial(g_api_host, g_api_port);
-  if (fd < 0) return -1;
+  Conn conn = conn_dial();
+  if (!conn.ok()) return -1;
   std::string req = request_head(method, path) + extra_headers;
   if (!req_body.empty()) {
     char len[32];
@@ -173,15 +297,24 @@ int http_request(const std::string &method, const std::string &path,
     req += "Content-Length: " + std::string(len) + "\r\n";
   }
   req += "Connection: close\r\n\r\n" + req_body;
-  if (!send_all(fd, req)) {
-    close(fd);
+  if (!conn_write_all(conn, req)) {
+    conn_close(conn);
     return -1;
   }
   std::string raw;
   char buf[8192];
-  ssize_t r;
-  while ((r = recv(fd, buf, sizeof(buf), 0)) > 0) raw.append(buf, r);
-  close(fd);
+  bool timed_out = false;
+  for (;;) {
+    ssize_t r = conn_read(conn, buf, sizeof(buf), 30000);
+    if (r == -2) { timed_out = true; break; }
+    if (r <= 0) break;
+    raw.append(buf, r);
+  }
+  conn_close(conn);
+  /* 30s of mid-response silence is an ERROR, not end-of-response:
+   * parsing a truncated body could misread "label absent" and apply the
+   * default mode over the node's real desired state */
+  if (timed_out) return -1;
   size_t hdr_end = raw.find("\r\n\r\n");
   if (hdr_end == std::string::npos) return -1;
   int status = -1;
@@ -277,12 +410,50 @@ int run_engine(const std::string &mode) {
       logf("WARN", "could not publish cc.mode.state=failed");
     return -1;
   }
-  char cmd[1024];
-  snprintf(cmd, sizeof(cmd), g_engine_cmd.c_str(), mode.c_str());
-  logf("INFO", "reconciling: exec: %s", cmd);
-  int rc = system(cmd);
-  if (rc == -1) return -1;
-  if (WIFEXITED(rc)) return WEXITSTATUS(rc);
+  /* Structural injection safety (on top of the allowlist above): the
+   * mode is NEVER interpolated into the command text. Every %s in the
+   * template becomes "${TPU_CC_MODE}", and the mode rides in as an
+   * exported environment variable — the shell expands it as data, not
+   * syntax, no matter what it contains, and (unlike a positional
+   * parameter) the expansion survives nested `sh -c '...'` templates
+   * because child shells inherit the environment. */
+  std::string cmd;
+  for (size_t i = 0; i < g_engine_cmd.size(); ++i) {
+    if (g_engine_cmd[i] == '%' && i + 1 < g_engine_cmd.size() &&
+        g_engine_cmd[i + 1] == 's') {
+      cmd += "\"${TPU_CC_MODE}\"";
+      ++i;
+    } else {
+      cmd += g_engine_cmd[i];
+    }
+  }
+  logf("INFO", "reconciling: exec: %s  (TPU_CC_MODE='%s')", cmd.c_str(),
+       mode.c_str());
+  /* Build argv + envp BEFORE forking: this process is multithreaded
+   * (watcher thread), so the child may only use async-signal-safe calls
+   * between fork and exec — setenv/malloc there can deadlock on a lock
+   * a watcher thread held at fork time. */
+  std::vector<std::string> env_store;
+  for (char **e = environ; *e != nullptr; ++e) {
+    if (strncmp(*e, "TPU_CC_MODE=", 12) != 0) env_store.emplace_back(*e);
+  }
+  env_store.push_back("TPU_CC_MODE=" + mode);
+  std::vector<char *> envp;
+  envp.reserve(env_store.size() + 1);
+  for (auto &s : env_store) envp.push_back(const_cast<char *>(s.c_str()));
+  envp.push_back(nullptr);
+  const char *child_argv[] = {"sh", "-c", cmd.c_str(), nullptr};
+  pid_t pid = fork();
+  if (pid < 0) return -1;
+  if (pid == 0) {
+    execve("/bin/sh", const_cast<char *const *>(child_argv), envp.data());
+    _exit(127);
+  }
+  int status = 0;
+  while (waitpid(pid, &status, 0) < 0) {
+    if (errno != EINTR) return -1;
+  }
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
   return -1;
 }
 
@@ -338,8 +509,8 @@ void watch_loop(SyncableModeConfig *config) {
                        g_node_name + "&timeoutSeconds=" + timeout_q +
                        "&allowWatchBookmarks=true";
     if (!rv.empty()) path += "&resourceVersion=" + rv;
-    int fd = dial(g_api_host, g_api_port);
-    if (fd < 0) {
+    Conn conn = conn_dial();
+    if (!conn.ok()) {
       if (++consecutive_errors >= 10) {
         logf("ERROR", "10 consecutive watch errors; exiting");
         exit(1);
@@ -350,13 +521,10 @@ void watch_loop(SyncableModeConfig *config) {
       continue;
     }
     std::string req = request_head("GET", path) + "\r\n";
-    if (!send_all(fd, req)) {
-      close(fd);
+    if (!conn_write_all(conn, req)) {
+      conn_close(conn);
       continue;
     }
-    /* bounded recv so the loop notices g_stop within ~1s */
-    struct timeval tv = {1, 0};
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     /* stream: read headers, then dechunk NDJSON incrementally */
     std::string buf;
     std::string lines; /* dechunked payload; may end mid-JSON-line */
@@ -366,9 +534,9 @@ void watch_loop(SyncableModeConfig *config) {
     char rbuf[8192];
     for (;;) {
       if (g_stop.load()) break;
-      ssize_t r = recv(fd, rbuf, sizeof(rbuf), 0);
-      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
-        continue; /* recv timeout tick: quiet stream, re-check g_stop */
+      /* bounded read so the loop notices g_stop within ~1s */
+      ssize_t r = conn_read(conn, rbuf, sizeof(rbuf), 1000);
+      if (r == -2) continue; /* timeout tick: quiet stream, re-check stop */
       if (r <= 0) break; /* server closed (watch timeout) or error */
       buf.append(rbuf, r);
       if (!headers_done) {
@@ -461,7 +629,7 @@ void watch_loop(SyncableModeConfig *config) {
         break; /* close and re-establish */
       }
     }
-    close(fd);
+    conn_close(conn);
     if (error_seen) {
       if (++consecutive_errors >= 10) {
         logf("ERROR", "10 consecutive watch errors; exiting");
@@ -484,6 +652,10 @@ int main(int argc, char **argv) {
   if ((env = getenv("KUBE_API_HOST"))) g_api_host = env;
   if ((env = getenv("KUBE_API_PORT"))) g_api_port = atoi(env);
   if ((env = getenv("TPU_CC_ENGINE_CMD"))) g_engine_cmd = env;
+  if ((env = getenv("KUBE_API_TLS")))
+    g_tls = (strcmp(env, "true") == 0 || strcmp(env, "1") == 0);
+  if ((env = getenv("KUBE_CA_FILE"))) g_ca_file = env;
+  if ((env = getenv("TPU_CC_OPENSSL"))) g_openssl = env;
   if ((env = getenv("TPU_CC_WATCH_TIMEOUT_S"))) {
     int v = atoi(env);
     if (v > 0) {
@@ -494,18 +666,7 @@ int main(int argc, char **argv) {
       fprintf(stderr, "ignoring invalid TPU_CC_WATCH_TIMEOUT_S '%s'\n", env);
     }
   }
-  if ((env = getenv("BEARER_TOKEN_FILE"))) {
-    FILE *f = fopen(env, "r");
-    if (f) {
-      char tok[4096] = {0};
-      size_t n = fread(tok, 1, sizeof(tok) - 1, f);
-      fclose(f);
-      g_bearer_token.assign(tok, n);
-      while (!g_bearer_token.empty() &&
-             (g_bearer_token.back() == '\n' || g_bearer_token.back() == ' '))
-        g_bearer_token.pop_back();
-    }
-  }
+  if ((env = getenv("BEARER_TOKEN_FILE"))) g_token_file = env;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&](const char *flag) -> const char * {
@@ -533,7 +694,8 @@ int main(int argc, char **argv) {
           "usage: tpu-cc-manager-agent [--node-name N] [-m MODE] "
           "[--api-host H] [--api-port P] [--engine-cmd CMD] [--version]\n"
           "env: NODE_NAME DEFAULT_CC_MODE KUBE_API_HOST KUBE_API_PORT "
-          "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE TPU_CC_WATCH_TIMEOUT_S\n");
+          "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE TPU_CC_WATCH_TIMEOUT_S "
+          "KUBE_API_TLS KUBE_CA_FILE TPU_CC_OPENSSL\n");
       return 0;
     } else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
@@ -550,8 +712,23 @@ int main(int argc, char **argv) {
     fprintf(stderr, "TPU_CC_ENGINE_CMD must contain %%s for the mode\n");
     return 1;
   }
+  if (g_tls) {
+    /* fail-closed config: direct HTTPS without a CA to verify against
+     * would be a silent trust-anything client */
+    if (g_ca_file.empty())
+      g_ca_file = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt";
+    FILE *ca = fopen(g_ca_file.c_str(), "r");
+    if (!ca) {
+      fprintf(stderr,
+              "KUBE_API_TLS=true but CA file '%s' is unreadable "
+              "(set KUBE_CA_FILE)\n", g_ca_file.c_str());
+      return 1;
+    }
+    fclose(ca);
+  }
   signal(SIGINT, on_signal);
   signal(SIGTERM, on_signal);
+  signal(SIGPIPE, SIG_IGN); /* a dying s_client child must not kill us */
 
   /* initial read + default apply (reference cmd/main.go:131-149);
    * transient API unavailability at startup gets the watch loop's

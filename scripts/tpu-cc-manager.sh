@@ -38,7 +38,24 @@ if [ -z "$TPUDEVCTL" ]; then
 fi
 KUBE_API_HOST="${KUBE_API_HOST:-127.0.0.1}"
 KUBE_API_PORT="${KUBE_API_PORT:-8001}"
-API="http://${KUBE_API_HOST}:${KUBE_API_PORT}"
+# KUBE_API_TLS=true: speak HTTPS directly (no kubectl-proxy), verifying
+# the cluster CA and sending the service-account token — the same
+# direct-TLS posture as the native agent (daemonset-native-tls.yaml).
+CURL_OPTS=()
+if [ "${KUBE_API_TLS:-false}" = "true" ]; then
+  API="https://${KUBE_API_HOST}:${KUBE_API_PORT}"
+  KUBE_CA_FILE="${KUBE_CA_FILE:-/var/run/secrets/kubernetes.io/serviceaccount/ca.crt}"
+  BEARER_TOKEN_FILE="${BEARER_TOKEN_FILE:-/var/run/secrets/kubernetes.io/serviceaccount/token}"
+  CURL_OPTS+=(--cacert "$KUBE_CA_FILE")
+  [ -r "$BEARER_TOKEN_FILE" ] \
+    && CURL_OPTS+=(-H "Authorization: Bearer $(cat "$BEARER_TOKEN_FILE")")
+else
+  API="http://${KUBE_API_HOST}:${KUBE_API_PORT}"
+  [ -n "${BEARER_TOKEN_FILE:-}" ] && [ -r "${BEARER_TOKEN_FILE:-}" ] \
+    && CURL_OPTS+=(-H "Authorization: Bearer $(cat "$BEARER_TOKEN_FILE")")
+fi
+
+kcurl() { curl "${CURL_OPTS[@]}" "$@"; }
 OPERATOR_NAMESPACE="${OPERATOR_NAMESPACE:-tpu-system}"
 EVICT_OPERATOR_COMPONENTS="${EVICT_OPERATOR_COMPONENTS:-true}"
 
@@ -84,14 +101,14 @@ _require_node_name() {
 # ------------------------------------------------------------- k8s (curl)
 _patch_node_labels() {
   # $1 = JSON object of labels, e.g. {"k":"v","k2":null}
-  curl -sf --max-time 30 -X PATCH \
+  kcurl -sf --max-time 30 -X PATCH \
     -H "Content-Type: application/merge-patch+json" \
     -d "{\"metadata\":{\"labels\":$1}}" \
     "$API/api/v1/nodes/$NODE_NAME" > /dev/null
 }
 
 _fetch_node_json() {
-  curl -sf --max-time 30 "$API/api/v1/nodes/$NODE_NAME"
+  kcurl -sf --max-time 30 "$API/api/v1/nodes/$NODE_NAME"
 }
 
 _label_from_json() {
@@ -118,7 +135,7 @@ _post_event() {
   local ts name
   ts="$(date -u '+%Y-%m-%dT%H:%M:%SZ')"
   name="$NODE_NAME.cc-engine.$$.$(date +%s).$_EVENT_SEQ"
-  curl -sf --max-time 10 -X POST -H "Content-Type: application/json" \
+  kcurl -sf --max-time 10 -X POST -H "Content-Type: application/json" \
     -d "{\"kind\":\"Event\",\"apiVersion\":\"v1\",\
 \"metadata\":{\"name\":\"$name\",\"namespace\":\"default\"},\
 \"involvedObject\":{\"kind\":\"Node\",\"apiVersion\":\"v1\",\"name\":\"$NODE_NAME\"},\
@@ -175,7 +192,7 @@ _wait_components_gone() {
       # would always count 0 against a real cluster and let the flip
       # proceed over still-terminating pods.
       local body n
-      if body=$(curl -sf --max-time 30 "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME") \
+      if body=$(kcurl -sf --max-time 30 "$API/api/v1/namespaces/$OPERATOR_NAMESPACE/pods?labelSelector=app%3D$app&fieldSelector=spec.nodeName%3D$NODE_NAME") \
          && n=$(printf '%s' "$body" | python3 -c 'import json,sys; print(len(json.load(sys.stdin).get("items") or []))' 2>/dev/null); then
         remaining=$((remaining + n))
       else
@@ -283,7 +300,7 @@ _publish_evidence() {
     log "WARN: evidence build failed; skipping evidence annotation"
     return 0
   fi
-  curl -sf --max-time 30 -X PATCH \
+  kcurl -sf --max-time 30 -X PATCH \
     -H "Content-Type: application/merge-patch+json" \
     -d "$patch" "$API/api/v1/nodes/$NODE_NAME" > /dev/null \
     || log "WARN: evidence annotation publish failed"

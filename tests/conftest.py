@@ -39,3 +39,27 @@ def _reset_device_backend():
     device_base.set_backend(None)
     yield
     device_base.set_backend(None)
+
+
+@pytest.fixture(scope="session")
+def tls_pki(tmp_path_factory):
+    """Self-signed server cert/key for 127.0.0.1 (SAN IP), generated
+    with the openssl CLI — shared by the native agent's direct-TLS tests
+    and the bash engine's KUBE_API_TLS test. Returns (cert, key) paths;
+    the cert doubles as the client's CA file."""
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl binary unavailable")
+    d = tmp_path_factory.mktemp("pki")
+    cert, key = d / "cert.pem", d / "key.pem"
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"openssl req unavailable: {r.stderr}")
+    return str(cert), str(key)

@@ -230,6 +230,33 @@ def test_exclusive_hold_check(env):
         holder.wait()
 
 
+def test_bash_engine_direct_tls(env, tls_pki, tmp_path):
+    """KUBE_API_TLS=true: the bash engine's curl path verifies the
+    cluster CA and sends the bearer token — parity with the native
+    agent's direct-TLS transport (daemonset-native-tls.yaml)."""
+    e, _, _tmp = env
+    cert, key = tls_pki
+    token = tmp_path / "token"
+    token.write_text("tls-engine-token\n")
+    tls_server = FakeApiServer(required_token="tls-engine-token",
+                               tls_cert=str(cert), tls_key=str(key)).start()
+    try:
+        tls_server.store.add_node(make_node("bash-node", labels={DP: "true"}))
+        e2 = dict(e)
+        e2.update(
+            KUBE_API_PORT=str(tls_server.port),
+            KUBE_API_TLS="true",
+            KUBE_CA_FILE=str(cert),
+            BEARER_TOKEN_FILE=str(token),
+        )
+        r = run_sh(e2, "set-cc-mode", "-a", "-m", "on")
+        assert r.returncode == 0, r.stderr
+        labels = tls_server.store.get_node("bash-node")["metadata"]["labels"]
+        assert labels[L.CC_MODE_STATE_LABEL] == "on"
+    finally:
+        tls_server.stop()
+
+
 def test_drain_wait_counts_typemeta_less_pod_items(env):
     """A still-present component pod must be seen by the drain wait even
     though the apiserver (like a real one) omits kind/apiVersion from

@@ -588,3 +588,115 @@ def test_cpp_agent_bearer_token_auth(native_build, tmp_path):
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+# ------------------------------------------------------------ direct TLS
+# (self-signed PKI comes from the shared tls_pki session fixture in
+# conftest.py — also used by the bash engine's KUBE_API_TLS test)
+
+
+def test_cpp_agent_direct_https(native_build, tmp_path, tls_pki):
+    """VERDICT r2 item 8: the native agent speaks HTTPS directly — no
+    kubectl-proxy sidecar — verifying the cluster CA and sending the
+    service-account bearer token. Transport is an `openssl s_client`
+    child per connection (no TLS library is linked); a full label->state
+    watch round trip must work over it."""
+    cert, key = tls_pki
+    token_file = tmp_path / "token"
+    token_file.write_text("sa-secret-token\n")
+    out_file = tmp_path / "calls.txt"
+    with FakeApiServer(required_token="sa-secret-token",
+                       tls_cert=cert, tls_key=key) as srv:
+        srv.store.add_node(make_node("tls-node",
+                                     labels={L.CC_MODE_LABEL: "off"}))
+        env = dict(os.environ)
+        env.update(
+            NODE_NAME="tls-node",
+            KUBE_API_HOST="127.0.0.1",
+            KUBE_API_PORT=str(srv.port),
+            KUBE_API_TLS="true",
+            KUBE_CA_FILE=cert,
+            BEARER_TOKEN_FILE=str(token_file),
+            TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+            TPU_CC_WATCH_TIMEOUT_S="5",
+        )
+        proc = subprocess.Popen(
+            [os.path.join(native_build, "tpu-cc-manager-agent")],
+            env=env, stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if out_file.exists() and "off" in out_file.read_text():
+                    break
+                time.sleep(0.05)
+            assert out_file.exists(), "initial reconcile never ran over TLS"
+
+            srv.store.set_node_labels("tls-node", {L.CC_MODE_LABEL: "on"})
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if out_file.read_text().split() == ["off", "on"]:
+                    break
+                time.sleep(0.05)
+            assert out_file.read_text().split() == ["off", "on"]
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def test_cpp_agent_tls_requires_readable_ca(native_build, tmp_path):
+    """Fail-closed config: KUBE_API_TLS without a readable CA file must
+    exit immediately, never run a trust-anything client."""
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="x",
+        KUBE_API_TLS="true",
+        KUBE_CA_FILE=str(tmp_path / "missing-ca.pem"),
+    )
+    r = subprocess.run(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, capture_output=True, text=True, timeout=10)
+    assert r.returncode == 1
+    assert "unreadable" in r.stderr
+
+
+def test_cpp_agent_wrong_ca_rejected(native_build, tmp_path, tls_pki):
+    """A server whose cert doesn't chain to the configured CA must be
+    rejected (s_client -verify_return_error): no request succeeds."""
+    cert, key = tls_pki
+    # a DIFFERENT self-signed CA the server's cert does not chain to
+    other = tmp_path / "other.pem"
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(tmp_path / "other-key.pem"), "-out", str(other),
+         "-days", "1", "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out_file = tmp_path / "calls.txt"
+    with FakeApiServer(tls_cert=cert, tls_key=key) as srv:
+        srv.store.add_node(make_node("bad-ca-node",
+                                     labels={L.CC_MODE_LABEL: "on"}))
+        env = dict(os.environ)
+        env.update(
+            NODE_NAME="bad-ca-node",
+            KUBE_API_HOST="127.0.0.1",
+            KUBE_API_PORT=str(srv.port),
+            KUBE_API_TLS="true",
+            KUBE_CA_FILE=str(other),
+            TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",
+        )
+        proc = subprocess.Popen(
+            [os.path.join(native_build, "tpu-cc-manager-agent")],
+            env=env, stderr=subprocess.DEVNULL)
+        try:
+            time.sleep(4)  # several startup read attempts
+            assert not out_file.exists()  # nothing EVER reconciled
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()

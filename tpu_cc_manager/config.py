@@ -27,6 +27,10 @@ flag                      env                            default
 (none)                    TPU_CC_HOLD_WAIT_S             30 (grace period for holders to leave)
 (none)                    TPU_CC_EVIDENCE                true (per-flip evidence annotation)
 (none)                    TPU_CC_EVIDENCE_KEY[_FILE]     "" (HMAC key; unset = plain sha256)
+(none)                    KUBE_API_TLS                   false (native agent + bash engine:
+                                                        direct HTTPS, no proxy sidecar)
+(none)                    KUBE_CA_FILE                   serviceaccount ca.crt (with TLS)
+(none)                    BEARER_TOKEN_FILE              "" (SA token for direct API auth)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
 ========================  =============================  =======================

@@ -271,6 +271,8 @@ class FakeApiServer:
         store: Optional[FakeKube] = None,
         port: int = 0,
         required_token: Optional[str] = None,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
     ):
         self.store = store or FakeKube()
         handler = type(
@@ -284,6 +286,17 @@ class FakeApiServer:
             "ApiHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 256}
         )
         self.httpd = server_cls(("127.0.0.1", port), handler)
+        self.tls = bool(tls_cert)
+        if tls_cert:
+            # serve real HTTPS (the native agent's direct-TLS path is
+            # integration-tested against this)
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key or tls_cert)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
         self.httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
@@ -293,7 +306,8 @@ class FakeApiServer:
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def start(self) -> "FakeApiServer":
         self._thread = threading.Thread(

@@ -69,9 +69,13 @@ _TERMINAL = ("skipped", "succeeded", "failed", "timeout", "not_attempted")
 
 def load_rollout_record(kube: KubeClient, nodes: Sequence[dict]
                         ) -> Tuple[Optional[dict], Optional[str]]:
-    """Newest rollout record found on any pool node -> (record, node).
-    Scanning the whole pool (not just the current anchor) tolerates the
-    anchor node changing between rollouts."""
+    """The rollout record that MATTERS on these nodes -> (record, node):
+    an unfinished record always wins over a newer complete one (in a
+    multi-pool cluster, pool B finishing a rollout must not mask pool
+    A's crashed-and-resumable record, for either --resume or the
+    concurrent-rollout guard); among several of the same completeness,
+    newest started wins. Scanning every node (not just the current
+    anchor) tolerates the anchor changing between rollouts."""
     best: Optional[dict] = None
     best_node: Optional[str] = None
     for n in nodes:
@@ -85,7 +89,13 @@ def load_rollout_record(kube: KubeClient, nodes: Sequence[dict]
             continue
         if not isinstance(rec, dict):
             continue
-        if best is None or rec.get("started", 0) > best.get("started", 0):
+        better = (
+            best is None
+            or (best.get("complete") and not rec.get("complete"))
+            or (bool(best.get("complete")) == bool(rec.get("complete"))
+                and rec.get("started", 0) > best.get("started", 0))
+        )
+        if better:
             best, best_node = rec, n["metadata"]["name"]
     return best, best_node
 

@@ -282,6 +282,8 @@ class SliceCoordinator:
                     )
                     self._stop.wait(self.poll_s)
                     continue
+                if not members:
+                    break  # slice dissolved (labels removed) mid-round
                 # a round the slice has already WON must be honored
                 # BEFORE any supersession abort: peers may observe the
                 # same commit this poll and flip — aborting now would
