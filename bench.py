@@ -184,21 +184,30 @@ def _wait_pool(store, names, target, timeout=240.0):
     return None if pending else time.monotonic() - t0
 
 
-def run_drained_bench(n_nodes, readiness_dir, dwell_s=0.5):
-    """Drained scenario (VERDICT r1 item 5a): every node deploys a
-    device-plugin component whose pod takes ``dwell_s`` to terminate
-    after its pause label flips, so the ComponentDrainer's pod-wait — the
-    reference's wall-clock dominator (gpu_operator_eviction.py:174-208,
-    300 s timeout) — is actually on the measured path. A simulated
-    operator (the gpu-operator analog) deletes paused components' pods
-    after the dwell and recreates them on unpause."""
+def _run_pool_convergence(names, readiness_dir, prefix, *,
+                          slice_of=None, drained=False, dwell_s=0.5):
+    """Shared convergence harness for the dominator scenarios: build a
+    pool, run one real agent per node, flip every desired label to "on",
+    and time convergence.
+
+    - ``drained``: every node deploys a device-plugin component whose
+      pod takes ``dwell_s`` to terminate after its pause label flips, so
+      the ComponentDrainer's pod-wait — the reference's wall-clock
+      dominator (gpu_operator_eviction.py:174-208, 300 s timeout) — is
+      on the measured path. A simulated operator (the gpu-operator
+      analog) deletes paused components' pods after the dwell and
+      recreates them on unpause.
+    - ``slice_of``: name -> slice id; members flip only after the
+      two-phase ack/commit (slice_coord.py), putting the quorum wait on
+      the measured path.
+    """
     from tpu_cc_manager.k8s.objects import make_pod
+    from tpu_cc_manager.slice_coord import SliceCoordinator
 
     server = FakeApiServer().start()
     store = server.store
     dp_label = L.COMPONENT_LABELS[0]
     app = L.COMPONENT_APP_LABELS[dp_label]
-    names = [f"dr-{i:03d}" for i in range(n_nodes)]
 
     def component_pod(name):
         return make_pod(
@@ -206,17 +215,17 @@ def run_drained_bench(n_nodes, readiness_dir, dwell_s=0.5):
         )
 
     for name in names:
-        store.add_node(
-            make_node(
-                name,
-                labels={
-                    L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
-                    L.CC_MODE_LABEL: "off",
-                    dp_label: "true",
-                },
-            )
-        )
-        store.add_pod(component_pod(name))
+        labels = {
+            L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+            L.CC_MODE_LABEL: "off",
+        }
+        if slice_of is not None:
+            labels[L.TPU_SLICE_LABEL] = slice_of(name)
+        if drained:
+            labels[dp_label] = "true"
+        store.add_node(make_node(name, labels=labels))
+        if drained:
+            store.add_pod(component_pod(name))
 
     stop = threading.Event()
     pause_seen = {}
@@ -248,8 +257,10 @@ def run_drained_bench(n_nodes, readiness_dir, dwell_s=0.5):
                     pass  # racing a concurrent delete is fine
             time.sleep(0.05)
 
-    op_thread = threading.Thread(target=operator_sim, daemon=True)
-    op_thread.start()
+    op_thread = None
+    if drained:
+        op_thread = threading.Thread(target=operator_sim, daemon=True)
+        op_thread.start()
 
     agents = []
     for name in names:
@@ -257,104 +268,90 @@ def run_drained_bench(n_nodes, readiness_dir, dwell_s=0.5):
         cfg = AgentConfig(
             node_name=name,
             default_mode="off",
-            readiness_file=f"{readiness_dir}/dr-ready-{name}",
+            readiness_file=f"{readiness_dir}/{prefix}-ready-{name}",
             health_port=0,
-            drain_strategy="components",
+            drain_strategy="components" if drained else "none",
             operator_namespace="tpu-system",
         )
-        agent = CCManagerAgent(kube, cfg, backend=fake_backend(n_chips=4))
-        agent.watcher.watch_timeout_s = 30
-        agent.watcher.backoff_s = 0.2
-        # scale the reference's 2 s/300 s waits down to bench scale
-        agent.engine._drainer.poll_s = 0.1
-        agent.engine._drainer.timeout_s = 60
-        agents.append(agent)
-        threading.Thread(target=agent.run, daemon=True).start()
-
-    try:
-        if _wait_pool(store, names, "off") is None:
-            print("FATAL: drained bench never initialized", file=sys.stderr)
-            sys.exit(1)
-        # the flip that pays the drain: pause -> pod-wait (>= dwell_s) ->
-        # stage/reset/verify -> restore
-        for name in names:
-            store.set_node_labels(name, {L.CC_MODE_LABEL: "on"})
-        convergence = _wait_pool(store, names, "on")
-        if convergence is None:
-            print("FATAL: drained pool never converged", file=sys.stderr)
-            sys.exit(1)
-        return round(convergence, 4)
-    finally:
-        for a in agents:
-            a.shutdown()
-        stop.set()
-        op_thread.join(timeout=5)
-        server.stop()
-
-
-def run_sliced_bench(n_slices, hosts_per_slice, readiness_dir):
-    """Sliced scenario (VERDICT r1 item 5b): an n_slices x hosts_per_slice
-    pool where every slice flips coherently — the two-phase ack/commit
-    wait (slice_coord.py) is on the measured path for all nodes."""
-    from tpu_cc_manager.slice_coord import SliceCoordinator
-
-    server = FakeApiServer().start()
-    store = server.store
-    names = [
-        f"sl-{s}-{h:02d}"
-        for s in range(n_slices)
-        for h in range(hosts_per_slice)
-    ]
-    for name in names:
-        store.add_node(
-            make_node(
-                name,
-                labels={
-                    L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
-                    L.CC_MODE_LABEL: "off",
-                    L.TPU_SLICE_LABEL: name.rsplit("-", 1)[0],
-                },
+        coord = None
+        if slice_of is not None:
+            coord = SliceCoordinator(
+                kube, name, poll_s=0.25, commit_timeout_s=120,
+                hb_period_s=2.0, hb_ttl_s=10.0,
             )
-        )
-
-    agents = []
-    for name in names:
-        kube = HttpKubeClient(KubeConfig("127.0.0.1", server.port, use_tls=False))
-        cfg = AgentConfig(
-            node_name=name,
-            default_mode="off",
-            readiness_file=f"{readiness_dir}/sl-ready-{name}",
-            health_port=0,
-            drain_strategy="none",
-        )
-        coord = SliceCoordinator(
-            kube, name, poll_s=0.25, commit_timeout_s=120,
-            hb_period_s=2.0, hb_ttl_s=10.0,
-        )
         agent = CCManagerAgent(
             kube, cfg, backend=fake_backend(n_chips=4),
             slice_coordinator=coord,
         )
         agent.watcher.watch_timeout_s = 30
         agent.watcher.backoff_s = 0.2
+        if drained:
+            # scale the reference's 2 s/300 s waits down to bench scale
+            agent.engine._drainer.poll_s = 0.1
+            agent.engine._drainer.timeout_s = 60
         agents.append(agent)
         threading.Thread(target=agent.run, daemon=True).start()
 
     try:
         if _wait_pool(store, names, "off") is None:
-            print("FATAL: sliced bench never initialized", file=sys.stderr)
+            print(f"FATAL: {prefix} bench never initialized", file=sys.stderr)
             sys.exit(1)
         for name in names:
             store.set_node_labels(name, {L.CC_MODE_LABEL: "on"})
         convergence = _wait_pool(store, names, "on")
         if convergence is None:
-            print("FATAL: sliced pool never converged", file=sys.stderr)
+            print(f"FATAL: {prefix} pool never converged", file=sys.stderr)
             sys.exit(1)
         return round(convergence, 4)
     finally:
         for a in agents:
             a.shutdown()
+        stop.set()
+        if op_thread is not None:
+            op_thread.join(timeout=5)
         server.stop()
+
+
+def run_drained_bench(n_nodes, readiness_dir, dwell_s=0.5):
+    """Drained scenario (VERDICT r1 item 5a): the component drain with
+    slow-leaving pods on the measured path."""
+    names = [f"dr-{i:03d}" for i in range(n_nodes)]
+    return _run_pool_convergence(
+        names, readiness_dir, "dr", drained=True, dwell_s=dwell_s
+    )
+
+
+def run_sliced_bench(n_slices, hosts_per_slice, readiness_dir):
+    """Sliced scenario (VERDICT r1 item 5b): an n_slices x
+    hosts_per_slice pool where every slice flips coherently."""
+    names = [
+        f"sl-{s}-{h:02d}"
+        for s in range(n_slices)
+        for h in range(hosts_per_slice)
+    ]
+    return _run_pool_convergence(
+        names, readiness_dir, "sl",
+        slice_of=lambda n: n.rsplit("-", 1)[0],
+    )
+
+
+def run_sliced_drained_bench(n_slices, hosts_per_slice, readiness_dir,
+                             dwell_s=0.5):
+    """Stacked-dominator scenario (VERDICT r2 item 9): slice-coherent
+    flips AND a real ComponentDrainer with slow-leaving pods on the SAME
+    pool — SURVEY §3.5's two wall-clock dominators (eviction pod-wait +
+    reset wait) measured together, not extrapolated from separate
+    runs."""
+    names = [
+        f"sd-{s}-{h:02d}"
+        for s in range(n_slices)
+        for h in range(hosts_per_slice)
+    ]
+    return _run_pool_convergence(
+        names, readiness_dir, "sd",
+        slice_of=lambda n: n.rsplit("-", 1)[0],
+        drained=True, dwell_s=dwell_s,
+    )
 
 
 def bench_real_chip(state_dir: str):
@@ -426,6 +423,11 @@ def main():
         )
         result["extras"]["sliced_pool_convergence_s"] = run_sliced_bench(
             args.slices, args.hosts_per_slice, d
+        )
+        # the two dominators STACKED (VERDICT r2 item 9): slice commit
+        # wait + component drain with slow-leaving pods on one pool
+        result["extras"]["sliced_drained_pool_convergence_s"] = (
+            run_sliced_drained_bench(args.slices, args.hosts_per_slice, d)
         )
         result["extras"]["sliced_topology"] = (
             f"{args.slices}x{args.hosts_per_slice}"

@@ -40,6 +40,7 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <poll.h>
 #include <stdarg.h>
@@ -145,7 +146,10 @@ int dial(const std::string &host, int port) {
   if (getaddrinfo(host.c_str(), port_s, &hints, &res) != 0) return -1;
   int fd = -1;
   for (struct addrinfo *p = res; p; p = p->ai_next) {
-    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    /* CLOEXEC: exec'd engine children must never inherit the agent's
+     * API connection */
+    fd = socket(p->ai_family, p->ai_socktype | SOCK_CLOEXEC,
+                p->ai_protocol);
     if (fd < 0) continue;
     if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
     close(fd);
@@ -189,9 +193,15 @@ Conn conn_dial() {
    * endpoint verification. -quiet keeps stdout pure payload (and
    * disables the interactive Q/R commands); -verify_return_error makes
    * a failed verification abort the connection (fail-closed). */
+  /* O_CLOEXEC on BOTH pipe pairs: without it, every exec'd child (the
+   * engine's `sh` tree, concurrent s_client children) would inherit the
+   * parent's ends of this SA-authenticated TLS channel — a process that
+   * writes to the inherited fd could pipeline its own API requests over
+   * the agent's credentials. The s_client child's dup2() below clears
+   * CLOEXEC on exactly the two ends it needs as stdin/stdout. */
   int to_child[2], from_child[2];
-  if (pipe(to_child) != 0) return c;
-  if (pipe(from_child) != 0) {
+  if (pipe2(to_child, O_CLOEXEC) != 0) return c;
+  if (pipe2(from_child, O_CLOEXEC) != 0) {
     close(to_child[0]); close(to_child[1]);
     return c;
   }

@@ -42,18 +42,28 @@ KUBE_API_PORT="${KUBE_API_PORT:-8001}"
 # the cluster CA and sending the service-account token — the same
 # direct-TLS posture as the native agent (daemonset-native-tls.yaml).
 CURL_OPTS=()
+_AUTH_HEADER_FILE=""
+_setup_auth_header() {
+  # the token must NEVER ride in argv (visible to the whole host via
+  # /proc/<pid>/cmdline while any curl runs): write the header to a
+  # 0600 temp file and pass it by reference (-H @file)
+  [ -n "${BEARER_TOKEN_FILE:-}" ] && [ -r "${BEARER_TOKEN_FILE:-}" ] || return 0
+  _AUTH_HEADER_FILE="$(mktemp)" || return 0
+  chmod 600 "$_AUTH_HEADER_FILE"
+  printf 'Authorization: Bearer %s' "$(cat "$BEARER_TOKEN_FILE")" \
+    > "$_AUTH_HEADER_FILE"
+  CURL_OPTS+=(-H "@$_AUTH_HEADER_FILE")
+  trap '[ -n "$_AUTH_HEADER_FILE" ] && rm -f "$_AUTH_HEADER_FILE"' EXIT
+}
 if [ "${KUBE_API_TLS:-false}" = "true" ]; then
   API="https://${KUBE_API_HOST}:${KUBE_API_PORT}"
   KUBE_CA_FILE="${KUBE_CA_FILE:-/var/run/secrets/kubernetes.io/serviceaccount/ca.crt}"
   BEARER_TOKEN_FILE="${BEARER_TOKEN_FILE:-/var/run/secrets/kubernetes.io/serviceaccount/token}"
   CURL_OPTS+=(--cacert "$KUBE_CA_FILE")
-  [ -r "$BEARER_TOKEN_FILE" ] \
-    && CURL_OPTS+=(-H "Authorization: Bearer $(cat "$BEARER_TOKEN_FILE")")
 else
   API="http://${KUBE_API_HOST}:${KUBE_API_PORT}"
-  [ -n "${BEARER_TOKEN_FILE:-}" ] && [ -r "${BEARER_TOKEN_FILE:-}" ] \
-    && CURL_OPTS+=(-H "Authorization: Bearer $(cat "$BEARER_TOKEN_FILE")")
 fi
+_setup_auth_header
 
 kcurl() { curl "${CURL_OPTS[@]}" "$@"; }
 OPERATOR_NAMESPACE="${OPERATOR_NAMESPACE:-tpu-system}"
