@@ -53,7 +53,13 @@ _setup_auth_header() {
   printf 'Authorization: Bearer %s' "$(cat "$BEARER_TOKEN_FILE")" \
     > "$_AUTH_HEADER_FILE"
   CURL_OPTS+=(-H "@$_AUTH_HEADER_FILE")
+  # EXIT alone doesn't fire on fatal signals — a SIGTERM'd run must not
+  # leave the token at rest in /tmp. The signal traps exit, which runs
+  # the EXIT trap, which removes the file.
   trap '[ -n "$_AUTH_HEADER_FILE" ] && rm -f "$_AUTH_HEADER_FILE"' EXIT
+  trap 'exit 129' HUP
+  trap 'exit 130' INT
+  trap 'exit 143' TERM
 }
 if [ "${KUBE_API_TLS:-false}" = "true" ]; then
   API="https://${KUBE_API_HOST}:${KUBE_API_PORT}"

@@ -171,6 +171,8 @@ def test_agent_publishes_evidence_through_apiserver(tmp_path, monkeypatch):
                           health_port=0, emit_events=False)
         agent = CCManagerAgent(kube, cfg, backend=be)
         assert agent.reconcile("on") is True
+        # evidence rides the async recorder worker (like Events)
+        assert agent.flush_events(timeout=10)
         node = server.store.get_node("ev-node")
         raw = node["metadata"]["annotations"][L.EVIDENCE_ANNOTATION]
         doc = json.loads(raw)

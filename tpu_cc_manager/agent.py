@@ -199,12 +199,45 @@ class CCManagerAgent:
         """Best-effort per-flip attestation evidence annotation (see
         tpu_cc_manager.evidence): published after every successful
         reconcile so the fleet controller can audit evidence-vs-label
-        consistency. Never fails the reconcile."""
+        consistency. Delivered ASYNCHRONOUSLY through the recorder
+        worker, like Events — an API-server hiccup or slow annotation
+        write must never stretch reconcile latency. A dropped publish
+        (bounded queue under API outage) is republished by the next
+        successful reconcile; staleness in between is visible, not
+        silent — the fleet audit flags it."""
         if not self.cfg.emit_evidence:
             return
-        from tpu_cc_manager.evidence import publish_evidence
+        import json as _json
 
-        publish_evidence(self.kube, self.cfg.node_name, self._backend)
+        from tpu_cc_manager import device as devlayer
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.evidence import build_evidence
+
+        # build the document SYNCHRONOUSLY (cheap local reads): a
+        # drain-time build could race the next flip and attest a torn
+        # mid-transition state under the old reconcile's banner. Only
+        # the API write is deferred.
+        try:
+            backend = self._backend or devlayer.get_backend()
+            payload = _json.dumps(
+                build_evidence(self.cfg.node_name, backend),
+                sort_keys=True, separators=(",", ":"),
+            )
+        except Exception:
+            log.warning("evidence build failed", exc_info=True)
+            return
+
+        def task():
+            try:
+                self.kube.set_node_annotations(self.cfg.node_name, {
+                    L.EVIDENCE_ANNOTATION: payload,
+                })
+            except Exception:
+                log.warning("evidence publish failed", exc_info=True)
+
+        if self._enqueue_recorder_item(task) == "full":
+            log.warning("evidence publish dropped (recorder queue full); "
+                        "the next successful reconcile republishes")
 
     def _on_fatal_watch(self, exc: Exception) -> None:
         self._fatal = exc
@@ -320,9 +353,18 @@ class CCManagerAgent:
         )
         if event is None:
             return
+        if self._enqueue_recorder_item(event) == "full":
+            self.metrics.events_dropped_total.inc()
+            log.debug("event queue full; dropping %s", event["reason"])
+
+    def _enqueue_recorder_item(self, item) -> str:
+        """Hand an Event dict or a callable task to the async recorder
+        worker. Returns "ok", "closed" (shutting down — a routine
+        non-delivery, not a drop), or "full" (bounded-queue overflow —
+        the caller accounts for the drop)."""
         with self._event_lock:
             if self._events_closed:
-                return  # shutting down: would strand behind the sentinel
+                return "closed"  # would strand behind the STOP sentinel
             if self._event_worker is None or not self._event_worker.is_alive():
                 self._event_worker = threading.Thread(
                     target=self._event_loop, daemon=True,
@@ -330,14 +372,15 @@ class CCManagerAgent:
                 )
                 self._event_worker.start()
             try:
-                self._event_queue.put_nowait(event)
+                self._event_queue.put_nowait(item)
+                return "ok"
             except queue.Full:
-                self.metrics.events_dropped_total.inc()
-                log.debug("event queue full; dropping %s", event["reason"])
+                return "full"
 
     def _event_loop(self) -> None:
-        """Daemon worker draining the event queue. One failed POST must
-        never affect a reconcile. A clientset without Events support
+        """Daemon worker draining the recorder queue (Event dicts and
+        callable tasks such as evidence publication). One failed POST
+        must never affect a reconcile. A clientset without Events support
         (501) stays at debug; anything else (403 RBAC missing, 400
         validation) warns once so a misconfigured deployment doesn't
         silently lose the whole feature."""
@@ -346,6 +389,12 @@ class CCManagerAgent:
             try:
                 if event is _EVENT_STOP:
                     return
+                if callable(event):
+                    try:
+                        event()
+                    except Exception:
+                        log.exception("async recorder task failed")
+                    continue
                 delivered, warned = post_event_best_effort(
                     self.kube, event, warned_before=self._event_warned
                 )
