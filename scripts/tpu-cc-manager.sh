@@ -43,6 +43,25 @@ KUBE_API_PORT="${KUBE_API_PORT:-8001}"
 # direct-TLS posture as the native agent (daemonset-native-tls.yaml).
 CURL_OPTS=()
 _AUTH_HEADER_FILE=""
+_TAINT_ACTIVE=0
+_on_exit() {
+  # runs on EVERY termination (the signal traps exit, which fires this):
+  # - the 0600 token header file must never stay at rest in /tmp;
+  # - a set flip taint must never outlive the run (a set -e abort
+  #   between _set_flip_taint and _clear_flip_taint would otherwise
+  #   leave the node NoSchedule forever — the Python engine's
+  #   finally-block parity)
+  [ -n "$_AUTH_HEADER_FILE" ] && rm -f "$_AUTH_HEADER_FILE"
+  if [ "$_TAINT_ACTIVE" = "1" ]; then
+    _TAINT_ACTIVE=0
+    _taint_edit remove || true
+  fi
+}
+trap _on_exit EXIT
+trap 'exit 129' HUP
+trap 'exit 130' INT
+trap 'exit 143' TERM
+
 _setup_auth_header() {
   # the token must NEVER ride in argv (visible to the whole host via
   # /proc/<pid>/cmdline while any curl runs): write the header to a
@@ -53,13 +72,6 @@ _setup_auth_header() {
   printf 'Authorization: Bearer %s' "$(cat "$BEARER_TOKEN_FILE")" \
     > "$_AUTH_HEADER_FILE"
   CURL_OPTS+=(-H "@$_AUTH_HEADER_FILE")
-  # EXIT alone doesn't fire on fatal signals — a SIGTERM'd run must not
-  # leave the token at rest in /tmp. The signal traps exit, which runs
-  # the EXIT trap, which removes the file.
-  trap '[ -n "$_AUTH_HEADER_FILE" ] && rm -f "$_AUTH_HEADER_FILE"' EXIT
-  trap 'exit 129' HUP
-  trap 'exit 130' INT
-  trap 'exit 143' TERM
 }
 if [ "${KUBE_API_TLS:-false}" = "true" ]; then
   API="https://${KUBE_API_HOST}:${KUBE_API_PORT}"
@@ -245,11 +257,62 @@ _reschedule_components() {
   fi
 }
 
+_taint_edit() {
+  # $1 = add|remove the flip taint (parity with drain.NodeFlipTaint):
+  # spec.taints is a list, so this is read-edit-REPLACE with the read
+  # resourceVersion (PUT; 409 retried) — a merge patch would wipe
+  # taints other controllers add concurrently.
+  local action="$1" attempt node_json new_json rc code
+  for attempt in 1 2 3 4 5 6 7 8; do
+    node_json="$(_fetch_node_json)" || return 1
+    rc=0
+    new_json="$(printf '%s' "$node_json" | python3 -c "
+import json, sys
+node = json.load(sys.stdin)
+key = 'tpu.google.com/cc.mode'
+taints = node.setdefault('spec', {}).get('taints') or []
+has = any(t.get('key') == key for t in taints)
+action = sys.argv[1]
+if action == 'add':
+    if has: sys.exit(3)
+    taints = taints + [
+        {'key': key, 'value': 'flipping', 'effect': 'NoSchedule'}]
+else:
+    if not has: sys.exit(3)
+    taints = [t for t in taints if t.get('key') != key]
+node['spec']['taints'] = taints
+print(json.dumps(node))
+" "$action")" || rc=$?
+    [ "$rc" -eq 3 ] && return 0   # already in the desired state
+    [ "$rc" -ne 0 ] && return 1
+    code="$(kcurl -s -o /dev/null -w '%{http_code}' --max-time 30 \
+      -X PUT -H 'Content-Type: application/json' \
+      -d "$new_json" "$API/api/v1/nodes/$NODE_NAME")" || return 1
+    [ "$code" = "200" ] && return 0
+    [ "$code" = "409" ] || return 1   # lost the CAS: re-read and retry
+  done
+  return 1
+}
+
+_set_flip_taint() {
+  # best-effort (Python engine parity): an untaintable node still gets
+  # the drain + gate protections
+  if _taint_edit add; then _TAINT_ACTIVE=1; else
+    log "WARN: could not set flip taint"
+  fi
+}
+
+_clear_flip_taint() {
+  _TAINT_ACTIVE=0
+  _taint_edit remove || log "WARN: could not clear flip taint"
+}
+
 # always restore on failure (reference _exit_failed, :210-215)
 _exit_failed() {
   _set_state_label "failed"
   _post_event "CCModeFailed" "Warning" "cc mode flip failed on $NODE_NAME"
   _reschedule_components
+  _clear_flip_taint
   exit 1
 }
 
@@ -474,6 +537,9 @@ set_cc_mode() {
     for dev in "${devices[@]}"; do
       _gate_apply "$dev" "$(_gate_cc_target "$mode")"
     done
+    # a leftover flip taint from a crashed earlier run must not survive
+    # a converged reconcile — this is the self-heal for the leak class
+    _clear_flip_taint
     _set_state_label "$mode"
     _publish_evidence
     _post_event "CCModeApplied" "Normal" \
@@ -481,6 +547,9 @@ set_cc_mode() {
     return 0
   fi
 
+  # taint first (Python engine parity): new TPU pods must stop landing
+  # on a node whose devices are about to be gated
+  _set_flip_taint
   _evict_components || _exit_failed
   for dev in "${devices[@]}"; do
     if ! _set_device_mode "$dev" "$mode"; then
@@ -493,6 +562,7 @@ set_cc_mode() {
   _post_event "CCModeApplied" "Normal" \
     "cc mode '$mode' applied to ${#devices[@]} device(s)"
   _reschedule_components
+  _clear_flip_taint
   if [ -n "$CC_READINESS_FILE" ]; then
     mkdir -p "$(dirname "$CC_READINESS_FILE")" && touch "$CC_READINESS_FILE"
   fi

@@ -230,6 +230,41 @@ def test_exclusive_hold_check(env):
         holder.wait()
 
 
+def test_bash_engine_flip_taint(env):
+    """Parity with the Python engine's NodeFlipTaint: the flip taint is
+    cleared by the end of the cycle (success AND failure paths), and
+    foreign taints survive the read-edit-replace."""
+    e, server, tmp_path = env
+    server.store.patch_node("bash-node", {"spec": {"taints": [
+        {"key": "example.com/other", "value": "x", "effect": "NoExecute"},
+    ]}})
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 0, r.stderr
+    taints = server.store.get_node("bash-node")["spec"]["taints"]
+    assert [t["key"] for t in taints] == ["example.com/other"]
+
+    # failure path (holder blocks the flip): taint still cleared
+    import subprocess as sp
+    import sys as _sys
+    dev = str(tmp_path / "dev" / "accel0")
+    holder = sp.Popen(
+        [_sys.executable, "-c",
+         f"import time\nf=open({dev!r})\nprint('held',flush=True)\n"
+         "time.sleep(120)"],
+        stdout=sp.PIPE, text=True)
+    assert holder.stdout.readline().strip() == "held"
+    try:
+        e2 = dict(e)
+        e2["TPU_CC_HOLD_WAIT_S"] = "1"
+        r = run_sh(e2, "set-cc-mode", "-a", "-m", "off")
+        assert r.returncode != 0
+        taints = server.store.get_node("bash-node")["spec"]["taints"]
+        assert [t["key"] for t in taints] == ["example.com/other"]
+    finally:
+        holder.kill()
+        holder.wait()
+
+
 def test_bash_engine_direct_tls(env, tls_pki, tmp_path):
     """KUBE_API_TLS=true: the bash engine's curl path verifies the
     cluster CA and sends the bearer token — parity with the native

@@ -736,3 +736,98 @@ def test_resume_dry_run_previews_without_patching():
     from tpu_cc_manager.rollout import load_rollout_record
     rec, _ = load_rollout_record(kube, kube.list_nodes(None))
     assert rec["complete"] is False
+
+
+def test_rollout_distrusts_lying_convergence_labels(tmp_path, monkeypatch):
+    """A member whose state label claims the target while its evidence
+    attests another mode must NOT count as converged: the group resolves
+    as timeout with the evidence contradiction in the detail. Members
+    with no evidence at all (pre-evidence agents) still pass."""
+    import json as _json
+
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+    from tpu_cc_manager.evidence import build_evidence
+
+    # real statefile-backed evidence attesting cc=off
+    sysfs = tmp_path / "sysfs"
+    devd = sysfs / "accel0" / "device"
+    devd.mkdir(parents=True)
+    (devd / "vendor").write_text("0x1ae0\n")
+    (devd / "device").write_text("0x0063\n")
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev" / "accel0").write_text("")
+    be = SysfsTpuBackend(sysfs_root=str(sysfs),
+                         dev_root=str(tmp_path / "dev"),
+                         state_dir=str(tmp_path / "state"))
+    off_evidence = _json.dumps(build_evidence("liar", be, key=None))
+
+    kube = FakeKube()
+    kube.add_node(make_node("liar", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: off_evidence}))
+    kube.add_node(_node("honest", desired="off", state="off"))
+
+    # agents set only the label — the liar's evidence stays at "off"
+    agents = _ReactiveAgents(kube, ["liar", "honest"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", max_unavailable=2, failure_budget=2,
+                         group_timeout_s=2, poll_s=0.05).run()
+    finally:
+        agents.stop.set()
+    outcomes = {g.name: g for g in report.groups}
+    assert outcomes["node/honest"].outcome == "succeeded"  # no evidence: ok
+    liar = outcomes["node/liar"]
+    assert liar.outcome == "timeout"
+    assert "evidence" in liar.detail
+
+
+def test_preconverged_liar_and_replayed_evidence_not_skipped(tmp_path):
+    """Two label-forgery variants the evidence cross-check must catch:
+    a node already AT the target labels before the rollout starts (would
+    previously be 'skipped' unchecked), and a node carrying another
+    node's valid evidence (replay — the node binding is part of the
+    claim)."""
+    import json as _json
+
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+    from tpu_cc_manager.evidence import build_evidence
+
+    sysfs = tmp_path / "sysfs"
+    devd = sysfs / "accel0" / "device"
+    devd.mkdir(parents=True)
+    (devd / "vendor").write_text("0x1ae0\n")
+    (devd / "device").write_text("0x0063\n")
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev" / "accel0").write_text("")
+    be = SysfsTpuBackend(sysfs_root=str(sysfs),
+                         dev_root=str(tmp_path / "dev"),
+                         state_dir=str(tmp_path / "state"))
+    chips, _ = be.find_tpus()
+    be.store.stage(chips[0].path, "cc", "on")
+    be.store.commit(chips[0].path)
+    on_evidence_for_other = _json.dumps(build_evidence("real-node", be))
+    be.store.stage(chips[0].path, "cc", "off")
+    be.store.commit(chips[0].path)
+    off_evidence_forged = _json.dumps(build_evidence("forged", be))
+
+    kube = FakeKube()
+    # labels forged to on/on BEFORE the rollout; evidence attests off
+    kube.add_node(make_node("forged", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: off_evidence_forged}))
+    # labels forged on/on with VALID evidence replayed from real-node
+    kube.add_node(make_node("copycat", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: on_evidence_for_other}))
+
+    report = Rollout(kube, "on", max_unavailable=2, failure_budget=2,
+                     group_timeout_s=1.5, poll_s=0.05).run()
+    outcomes = {g.name: g for g in report.groups}
+    assert outcomes["node/forged"].outcome == "timeout"
+    assert "evidence" in outcomes["node/forged"].detail
+    assert outcomes["node/copycat"].outcome == "timeout"
+    assert "evidence" in outcomes["node/copycat"].detail

@@ -110,6 +110,7 @@ def main(argv=None) -> int:
                     group_timeout_s=args.group_timeout,
                     force=args.force,
                     dry_run=args.dry_run,
+                    verify_evidence=not args.no_verify_evidence,
                 )
             report = rollout.run()
         except (InvalidModeError, RolloutError) as e:

@@ -193,6 +193,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--dry-run", action="store_true",
         help="print the group plan without patching anything",
     )
+    roll.add_argument(
+        "--no-verify-evidence", action="store_true",
+        help="trust cc.mode.state labels without cross-checking the "
+             "per-node attestation evidence",
+    )
     fleet = sub.add_parser(
         "fleet-controller",
         help="run the read-only fleet audit service: periodic JAX fleet "

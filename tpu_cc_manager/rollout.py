@@ -160,6 +160,7 @@ class Rollout:
         poll_s: float = 0.5,
         force: bool = False,
         dry_run: bool = False,
+        verify_evidence: bool = True,
     ):
         self.kube = kube
         self.mode = parse_mode(mode).value  # reject bad input before any patch
@@ -172,6 +173,21 @@ class Rollout:
         self.poll_s = poll_s
         self.force = force
         self.dry_run = dry_run
+        #: Cross-check converged groups against their attestation
+        #: evidence: a member whose label claims the target while its
+        #: PRESENT evidence is invalid or attests another mode does not
+        #: count as converged (it resolves via the group timeout, with
+        #: the evidence problem in the detail). Missing evidence is
+        #: accepted — agents predating the evidence feature must not
+        #: brick a rollout.
+        self.verify_evidence = verify_evidence
+        if verify_evidence:
+            from tpu_cc_manager.evidence import evidence_key
+
+            #: resolved once: the key is static for the process, and the
+            #: judge tick must not re-read the key file every poll
+            self._evidence_key = evidence_key()
+            self._warned_no_key = False
         #: durable-record state (anchor-node annotation); set by run()
         self._record: Optional[dict] = None
         self._record_node: Optional[str] = None
@@ -371,7 +387,17 @@ class Rollout:
                     f"pool; finish it with --resume"
                 )
             for gname, members in self.plan_groups(nodes):
-                if all(self._converged(by_name[m]) for m in members):
+                converged = all(
+                    self._converged(by_name[m]) for m in members
+                )
+                if converged and self.verify_evidence and not self.dry_run:
+                    # a node lying BEFORE the rollout starts must not
+                    # slip through as 'skipped': route it through the
+                    # judged path, where the contradiction surfaces
+                    converged = not self._evidence_suspects(
+                        members, by_name
+                    )
+                if converged:
                     results.append(
                         GroupResult(gname, members, "skipped",
                                     f"already at {self.mode}")
@@ -621,8 +647,24 @@ class Rollout:
                 f"agent(s) reported failed state: {sorted(bad)}",
             )
         if all(s == self.mode for s in states.values()):
-            log.info("group %s converged to %r", gname, self.mode)
-            return GroupResult(gname, members, "succeeded")
+            suspect = (
+                self._evidence_suspects(members, by_name)
+                if self.verify_evidence else []
+            )
+            if not suspect:
+                log.info("group %s converged to %r", gname, self.mode)
+                return GroupResult(gname, members, "succeeded")
+            # label text claims convergence but the device-truth channel
+            # disagrees (or is tampered): don't trust it. Evidence is
+            # published asynchronously after the label, so keep waiting
+            # — a persistent contradiction resolves via the timeout.
+            if time.monotonic() >= deadline:
+                return GroupResult(
+                    gname, members, "timeout",
+                    f"labels reached {self.mode!r} but evidence "
+                    f"disagrees or fails verification on: {suspect}",
+                )
+            return None
         if time.monotonic() >= deadline:
             lag = sorted(m for m, s in states.items() if s != self.mode)
             return GroupResult(
@@ -631,3 +673,46 @@ class Rollout:
                 f"lagging: {lag}",
             )
         return None
+
+    def _evidence_suspects(self, members: List[str],
+                           by_name: Dict[str, dict]) -> List[str]:
+        """Members whose PRESENT evidence annotation is invalid, belongs
+        to a DIFFERENT node (replayed from elsewhere), or attests a
+        different mode than this rollout's target. Two tolerated cases,
+        so misconfiguration never bricks a rollout: missing evidence
+        (pre-evidence agents) and a keyed document this operator cannot
+        check (no local TPU_CC_EVIDENCE_KEY — warned once)."""
+        from tpu_cc_manager.evidence import evidence_mode, verify_evidence
+
+        out: List[str] = []
+        for m in members:
+            meta = by_name.get(m, {}).get("metadata", {})
+            raw = (meta.get("annotations") or {}).get(L.EVIDENCE_ANNOTATION)
+            if not raw:
+                continue
+            try:
+                doc = json.loads(raw)
+                ok, reason = verify_evidence(doc, key=self._evidence_key)
+                if not ok and reason == "no_key":
+                    # the evidence is signed but this operator has no
+                    # key to judge it: not a contradiction, just a blind
+                    # spot — the fleet controller (which holds the key)
+                    # still audits it
+                    if not self._warned_no_key:
+                        self._warned_no_key = True
+                        log.warning(
+                            "evidence is HMAC-signed but no "
+                            "TPU_CC_EVIDENCE_KEY is configured here; "
+                            "skipping evidence verification"
+                        )
+                    continue
+                # evidence for another node pasted here verifies fine —
+                # the binding to THIS node is part of the claim
+                if ok and doc.get("node") != m:
+                    ok = False
+                attested = evidence_mode(doc) if ok else None
+            except Exception:
+                ok, attested = False, None
+            if not ok or (attested is not None and attested != self.mode):
+                out.append(m)
+        return sorted(out)
