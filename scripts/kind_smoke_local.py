@@ -169,6 +169,13 @@ def main():
                     "(manifest DEFAULT_CC_MODE, label absent)")
             else:
                 failures.append("initial default reconcile")
+            # the readiness touch happens after the reconcile returns
+            # (evidence build sits between the state label and it) —
+            # poll instead of racing a snapshot
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and not os.path.exists(readiness)):
+                time.sleep(0.1)
             if os.path.exists(readiness):
                 log(f"PASS readiness file created: {readiness}")
             else:
@@ -217,6 +224,46 @@ def main():
                 log(f"PASS events recorded: {reasons}")
             else:
                 failures.append(f"events missing: {reasons}")
+
+            # 6. round-3 enforcement surface: a good reconcile leaves a
+            # verifiable evidence annotation, no leftover flip taint,
+            # and mode-encoding device-node permissions
+            store.set_node_labels(NODE, {L.CC_MODE_LABEL: "on"})
+            if not wait_state(store, "on"):
+                failures.append("final reconcile to on")
+            import stat as _stat
+
+            from tpu_cc_manager.evidence import (
+                evidence_mode, verify_evidence,
+            )
+
+            deadline = time.monotonic() + 10
+            doc = None
+            while time.monotonic() < deadline:
+                node = store.get_node(NODE)
+                raw = node["metadata"].get("annotations", {}).get(
+                    L.EVIDENCE_ANNOTATION)
+                if raw:
+                    doc = json.loads(raw)
+                    if evidence_mode(doc) == "on":
+                        break
+                time.sleep(0.2)  # evidence rides the async recorder
+            if doc and verify_evidence(doc, key=None) == (True, "ok") \
+                    and evidence_mode(doc) == "on":
+                log("PASS evidence annotation verifies and attests 'on'")
+            else:
+                failures.append(f"evidence: {doc}")
+            taints = store.get_node(NODE).get("spec", {}).get("taints") or []
+            if not any(t.get("key") == L.FLIP_TAINT_KEY for t in taints):
+                log("PASS no leftover flip taint after the cycle")
+            else:
+                failures.append(f"leftover flip taint: {taints}")
+            dev0 = os.path.join(dev, "accel0")
+            perms = _stat.S_IMODE(os.stat(dev0).st_mode)
+            if perms == 0o600:
+                log("PASS device node gated 0600 for cc=on")
+            else:
+                failures.append(f"device perms {oct(perms)} != 0o600")
         finally:
             proc.terminate()
             try:

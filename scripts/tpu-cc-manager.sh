@@ -88,6 +88,7 @@ OPERATOR_NAMESPACE="${OPERATOR_NAMESPACE:-tpu-system}"
 EVICT_OPERATOR_COMPONENTS="${EVICT_OPERATOR_COMPONENTS:-true}"
 
 MODE_LABEL_STATE="tpu.google.com/cc.mode.state"
+FLIP_TAINT_KEY="tpu.google.com/cc.mode"   # labels.FLIP_TAINT_KEY parity
 PAUSED_STR="paused-for-cc-flip"
 COMPONENT_LABELS=(
   "tpu.google.com/pool.deploy.device-plugin"
@@ -269,10 +270,9 @@ _taint_edit() {
     new_json="$(printf '%s' "$node_json" | python3 -c "
 import json, sys
 node = json.load(sys.stdin)
-key = 'tpu.google.com/cc.mode'
+action, key = sys.argv[1], sys.argv[2]
 taints = node.setdefault('spec', {}).get('taints') or []
 has = any(t.get('key') == key for t in taints)
-action = sys.argv[1]
 if action == 'add':
     if has: sys.exit(3)
     taints = taints + [
@@ -282,7 +282,7 @@ else:
     taints = [t for t in taints if t.get('key') != key]
 node['spec']['taints'] = taints
 print(json.dumps(node))
-" "$action")" || rc=$?
+" "$action" "$FLIP_TAINT_KEY")" || rc=$?
     [ "$rc" -eq 3 ] && return 0   # already in the desired state
     [ "$rc" -ne 0 ] && return 1
     code="$(kcurl -s -o /dev/null -w '%{http_code}' --max-time 30 \
@@ -303,8 +303,13 @@ _set_flip_taint() {
 }
 
 _clear_flip_taint() {
-  _TAINT_ACTIVE=0
-  _taint_edit remove || log "WARN: could not clear flip taint"
+  # flag drops only on SUCCESSFUL removal: a failed clear here must
+  # leave the _on_exit safety net armed to retry
+  if _taint_edit remove; then
+    _TAINT_ACTIVE=0
+  else
+    log "WARN: could not clear flip taint"
+  fi
 }
 
 # always restore on failure (reference _exit_failed, :210-215)

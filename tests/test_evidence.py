@@ -267,3 +267,39 @@ def test_fleet_controller_report_carries_audit(tmp_path, monkeypatch):
                 '{issue="label_device_mismatch"} 1') in metrics
     finally:
         ctrl.stop()
+
+
+def test_dropped_evidence_publish_retried_from_idle_tick(tmp_path,
+                                                         monkeypatch):
+    """A failed async evidence write must not leave stale evidence on
+    the cluster until the next label change (which may never come): the
+    idle tick republishes."""
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    kube = FakeKube()
+    kube.add_node(make_node("rt-node"))
+    cfg = AgentConfig(node_name="rt-node", drain_strategy="none",
+                      health_port=0, emit_events=False)
+    agent = CCManagerAgent(kube, cfg, backend=be)
+
+    real_set = kube.set_node_annotations
+    fail = {"on": True}
+
+    def flaky_set(name, ann):
+        if fail["on"] and L.EVIDENCE_ANNOTATION in ann:
+            raise RuntimeError("annotation write blip")
+        return real_set(name, ann)
+
+    kube.set_node_annotations = flaky_set
+    assert agent.reconcile("on") is True
+    assert agent.flush_events(timeout=10)
+    ann = kube.get_node("rt-node")["metadata"].get("annotations", {})
+    assert L.EVIDENCE_ANNOTATION not in ann  # the write failed
+    assert agent._evidence_retry is True
+
+    fail["on"] = False
+    agent._maybe_repair()  # idle tick
+    assert agent.flush_events(timeout=10)
+    ann = kube.get_node("rt-node")["metadata"]["annotations"]
+    doc = json.loads(ann[L.EVIDENCE_ANNOTATION])
+    assert verify_evidence(doc, key=None) == (True, "ok")
+    assert evidence_mode(doc) == "on"

@@ -96,6 +96,7 @@ def main(argv=None) -> int:
                     selector=args.selector,
                     group_timeout_s=args.group_timeout,
                     dry_run=args.dry_run,
+                    verify_evidence=not args.no_verify_evidence,
                 )
             else:
                 if not args.mode:

@@ -202,6 +202,7 @@ class Rollout:
         group_timeout_s: float = 600.0,
         poll_s: float = 0.5,
         dry_run: bool = False,
+        verify_evidence: bool = True,
     ) -> "Rollout":
         """Rebuild a Rollout from the pool's unfinished durable record.
         Mode, window, budget, AND selector come from the record (the
@@ -225,7 +226,7 @@ class Rollout:
             max_unavailable=int(record.get("max_unavailable", 1)),
             failure_budget=int(record.get("failure_budget", 0)),
             group_timeout_s=group_timeout_s, poll_s=poll_s, force=True,
-            dry_run=dry_run,
+            dry_run=dry_run, verify_evidence=verify_evidence,
         )
         r._resume_from = (record, record_node)
         return r
@@ -390,10 +391,12 @@ class Rollout:
                 converged = all(
                     self._converged(by_name[m]) for m in members
                 )
-                if converged and self.verify_evidence and not self.dry_run:
+                if converged and self.verify_evidence:
                     # a node lying BEFORE the rollout starts must not
                     # slip through as 'skipped': route it through the
-                    # judged path, where the contradiction surfaces
+                    # judged path, where the contradiction surfaces.
+                    # Read-only, so dry-run uses it too — the preview
+                    # must classify groups the way the real run would
                     converged = not self._evidence_suspects(
                         members, by_name
                     )
