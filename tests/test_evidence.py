@@ -294,7 +294,7 @@ def test_dropped_evidence_publish_retried_from_idle_tick(tmp_path,
     assert agent.flush_events(timeout=10)
     ann = kube.get_node("rt-node")["metadata"].get("annotations", {})
     assert L.EVIDENCE_ANNOTATION not in ann  # the write failed
-    assert agent._evidence_retry is True
+    assert agent._evidence_published_gen < agent._evidence_wanted_gen
 
     fail["on"] = False
     agent._maybe_repair()  # idle tick
@@ -303,3 +303,8 @@ def test_dropped_evidence_publish_retried_from_idle_tick(tmp_path,
     doc = json.loads(ann[L.EVIDENCE_ANNOTATION])
     assert verify_evidence(doc, key=None) == (True, "ok")
     assert evidence_mode(doc) == "on"
+    assert agent._evidence_published_gen == agent._evidence_wanted_gen
+    # retry is throttled: the next tick doesn't republish
+    due = agent._evidence_retry_due
+    agent._maybe_repair()
+    assert agent._evidence_retry_due == due

@@ -153,6 +153,60 @@ def test_missing_device_node_is_skipped(tmp_path):
     assert engine.set_mode("on") is True
 
 
+def test_idle_tick_heals_perms_drift(tmp_path):
+    """Gate perms drift while the agent is idle (no label event) must
+    heal on the idle tick, not wait for the next flip."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    dev = _dev_file(tmp_path)
+    chip = FakeChip(path=dev, cc_mode="on")
+    kube = FakeKube()
+    kube.add_node(make_node("gd-node"))
+    cfg = AgentConfig(node_name="gd-node", drain_strategy="none",
+                      health_port=0, emit_events=False,
+                      emit_evidence=False, repair_interval_s=5)
+    agent = CCManagerAgent(kube, cfg, backend=FakeBackend(chips=[chip]))
+    # engine built from env: force gating on for this agent's gate
+    agent.engine._gate = DeviceGate(enabled=True)
+    os.chmod(dev, 0o666)  # drift
+    agent._maybe_repair()  # idle tick
+    assert stat.S_IMODE(os.stat(dev).st_mode) == MODE_PERMS["on"]
+    # throttled: a second tick inside the interval doesn't re-scan
+    os.chmod(dev, 0o666)
+    agent._maybe_repair()
+    assert stat.S_IMODE(os.stat(dev).st_mode) == 0o666
+    # after the interval it heals again
+    agent._gate_reassert_due = 0.0
+    agent._maybe_repair()
+    assert stat.S_IMODE(os.stat(dev).st_mode) == MODE_PERMS["on"]
+
+
+def test_idle_tick_never_reopens_fail_secure_lock(tmp_path):
+    """A device left at the flip-lock perms by a FAILED flip must stay
+    locked: the drift-heal may only reopen devices whose flip verified.
+    (Without this guard the idle tick would chmod a half-flipped chip
+    back to its queried mode's perms.)"""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    dev = _dev_file(tmp_path)
+    chip = FakeChip(path=dev)
+    chip.fail_reset = True
+    kube = FakeKube()
+    kube.add_node(make_node("fs-node"))
+    cfg = AgentConfig(node_name="fs-node", drain_strategy="none",
+                      health_port=0, emit_events=False,
+                      emit_evidence=False, repair_interval_s=5)
+    agent = CCManagerAgent(kube, cfg, backend=FakeBackend(chips=[chip]))
+    agent.engine._gate = DeviceGate(enabled=True)
+    assert agent.reconcile("on") is False  # flip fails -> locked
+    assert stat.S_IMODE(os.stat(dev).st_mode) == FLIP_LOCK_PERMS
+    agent._gate_reassert_due = 0.0
+    agent._maybe_repair()  # repair backoff hasn't elapsed; only drift-heal
+    assert stat.S_IMODE(os.stat(dev).st_mode) == FLIP_LOCK_PERMS
+
+
 class TaintCheckingDrainer:
     """Asserts the flip taint is present while the drain runs (taint must
     precede eviction so the scheduler stops backfilling the node)."""

@@ -123,6 +123,16 @@ class CCManagerAgent:
         self._repair_mode: Optional[str] = None
         self._repair_due: float = 0.0
         self._repair_failures = 0  # consecutive failures for one mode
+        # evidence delivery generations: wanted > published means the
+        # newest evidence hasn't landed on the cluster (failed/dropped
+        # write) and the idle tick should republish. A stale queued
+        # task's success can never mask a newer miss — each task only
+        # advances published to ITS OWN generation.
+        self._evidence_wanted_gen = 0
+        self._evidence_published_gen = 0
+        self._evidence_retry_due = 0.0
+        # idle-tick gate drift-heal throttle
+        self._gate_reassert_due = 0.0
         # Event-name uniqueness: per-process counter + a startup-unique
         # token, so a restarted agent never collides with the previous
         # process's still-live events (409 AlreadyExists would silently
@@ -213,6 +223,14 @@ class CCManagerAgent:
         from tpu_cc_manager import labels as L
         from tpu_cc_manager.evidence import build_evidence
 
+        # this publication's generation: anything that keeps it from
+        # landing (build failure, queue overflow, write failure) leaves
+        # published < wanted, and the idle tick republishes — stale
+        # on-cluster evidence reads as a label-vs-device contradiction
+        # to auditors, and the next label change may never come
+        self._evidence_wanted_gen += 1
+        gen = self._evidence_wanted_gen
+
         # build the document SYNCHRONOUSLY (cheap local reads): a
         # drain-time build could race the next flip and attest a torn
         # mid-transition state under the old reconcile's banner. Only
@@ -224,7 +242,7 @@ class CCManagerAgent:
                 sort_keys=True, separators=(",", ":"),
             )
         except Exception:
-            log.warning("evidence build failed", exc_info=True)
+            log.warning("evidence build failed; will retry", exc_info=True)
             return
 
         def task():
@@ -232,18 +250,16 @@ class CCManagerAgent:
                 self.kube.set_node_annotations(self.cfg.node_name, {
                     L.EVIDENCE_ANNOTATION: payload,
                 })
-                self._evidence_retry = False
+                # advance published only to THIS task's generation — a
+                # stale queued task's success must not mask a newer miss
+                self._evidence_published_gen = max(
+                    self._evidence_published_gen, gen
+                )
             except Exception:
-                # stale on-cluster evidence reads as a label-vs-device
-                # contradiction to auditors, so a failed publish is
-                # retried from the idle tick — not just "eventually, on
-                # the next label change" (which may never come)
-                self._evidence_retry = True
                 log.warning("evidence publish failed; will retry",
                             exc_info=True)
 
         if self._enqueue_recorder_item(task) == "full":
-            self._evidence_retry = True
             log.warning("evidence publish dropped (recorder queue full); "
                         "retrying from the idle tick")
 
@@ -470,11 +486,22 @@ class CCManagerAgent:
         any operator relabeling (VERDICT r1 item 8). Plain (non-slice)
         device faults heal the same way.
         """
-        if getattr(self, "_evidence_retry", False):
+        now = time.monotonic()
+        if (self.cfg.emit_evidence
+                and self._evidence_published_gen < self._evidence_wanted_gen
+                and now >= self._evidence_retry_due):
             # a dropped/failed evidence publish left stale on-cluster
-            # evidence; republish from current device state
-            self._evidence_retry = False
+            # evidence; republish from current device state (throttled —
+            # a persistently failing API must not be hammered every tick)
+            self._evidence_retry_due = now + (
+                self.cfg.repair_interval_s or 30.0
+            )
             self._publish_evidence()
+        # heal gate-perms drift on idle nodes (same cadence as repair;
+        # local chmods only, no cluster traffic)
+        if self.cfg.repair_interval_s and now >= self._gate_reassert_due:
+            self._gate_reassert_due = now + self.cfg.repair_interval_s
+            self.engine.reassert_gate()
         if self._repair_mode is None or time.monotonic() < self._repair_due:
             return
         mode = self._repair_mode

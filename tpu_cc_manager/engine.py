@@ -131,6 +131,33 @@ class ModeEngine:
             out[dev.path] = entry
         return out
 
+    def reassert_gate(self) -> None:
+        """Re-apply the workload-visible gate for every device's CURRENT
+        effective mode. Reconciles only run on label events and repairs;
+        this lets the agent's idle tick heal perms drift (someone chmods
+        /dev/accel* back open) without waiting for the next flip.
+        Best-effort and local-only — never touches cluster state.
+
+        Devices sitting at the flip-lock perms are SKIPPED: a failed
+        flip leaves its device locked on purpose (fail-secure,
+        device/gate.py) and only a successful reconcile may reopen it —
+        drift toward locked is the safe direction either way."""
+        from tpu_cc_manager.device.gate import FLIP_LOCK_PERMS
+
+        try:
+            devices = self._all_devices()
+        except DeviceError:
+            return
+        for dev in devices:
+            if not dev.is_cc_query_supported or dev.is_ici_switch():
+                continue
+            if self._gate.current_perms(dev.path) == FLIP_LOCK_PERMS:
+                continue  # fail-secure lock: never reopened by drift-heal
+            try:
+                self._gate.apply_mode(dev.path, dev.query_cc_mode())
+            except DeviceError:
+                pass
+
     # ------------------------------------------------------------ top level
     def set_mode(self, raw_mode: str) -> bool:
         """Validate, plan, apply. Returns True on success. Raises
