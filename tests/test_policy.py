@@ -1,0 +1,577 @@
+"""TPUCCPolicy controller (tpu_cc_manager.policy).
+
+The reference has no declarative surface at all (admins patch node
+labels by hand, reference README_PYTHON.md:77-102); these tests cover
+the custom-resource plumbing (FakeKube store, FakeApiServer wire
+protocol, HttpKubeClient) and the level-triggered controller built on
+top of the rollout layer.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.client import (
+    ApiException, HttpKubeClient, KubeConfig,
+)
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.policy import (
+    PolicyController, PolicySpecError, parse_policy_spec,
+)
+
+G, V, P = L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL
+
+
+def make_policy(name, mode="on", selector=L.TPU_ACCELERATOR_LABEL,
+                paused=False, strategy=None):
+    spec = {"mode": mode, "nodeSelector": selector}
+    if paused:
+        spec["paused"] = True
+    if strategy:
+        spec["strategy"] = strategy
+    return {
+        "apiVersion": f"{G}/{V}",
+        "kind": L.POLICY_KIND,
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+def _node(name, desired=None, state=None, slice_id=None, extra=None):
+    labels = {L.TPU_ACCELERATOR_LABEL: "tpu-v5e-slice"}
+    if desired:
+        labels[L.CC_MODE_LABEL] = desired
+    if state:
+        labels[L.CC_MODE_STATE_LABEL] = state
+    if slice_id:
+        labels[L.TPU_SLICE_LABEL] = slice_id
+    labels.update(extra or {})
+    return make_node(name, labels=labels)
+
+
+class _ReactiveAgents(threading.Thread):
+    """Simulated per-node agents: when a node's desired label changes,
+    publish the observed state after a small delay ('failed' for nodes
+    in fail_nodes)."""
+
+    def __init__(self, kube, node_names, fail_nodes=(), delay_s=0.03):
+        super().__init__(daemon=True)
+        self.kube = kube
+        self.node_names = list(node_names)
+        self.fail_nodes = set(fail_nodes)
+        self.delay_s = delay_s
+        self.stop = threading.Event()
+
+    def run(self):
+        while not self.stop.is_set():
+            for name in self.node_names:
+                try:
+                    labels = self.kube.get_node(name)["metadata"]["labels"]
+                except ApiException:
+                    continue
+                desired = labels.get(L.CC_MODE_LABEL)
+                state = labels.get(L.CC_MODE_STATE_LABEL)
+                if desired and state != desired and state != "failed":
+                    time.sleep(self.delay_s)
+                    value = "failed" if name in self.fail_nodes else desired
+                    self.kube.set_node_labels(
+                        name, {L.CC_MODE_STATE_LABEL: value}
+                    )
+            time.sleep(0.01)
+
+
+def controller(kube, **kw):
+    kw.setdefault("poll_s", 0.02)
+    return PolicyController(kube, **kw)
+
+
+# ---------------------------------------------------------------------------
+# spec validation
+# ---------------------------------------------------------------------------
+
+def test_parse_policy_spec_defaults():
+    spec = parse_policy_spec(make_policy("p", mode="on"))
+    assert spec["mode"] == "on"
+    assert spec["max_unavailable"] == 1
+    assert spec["failure_budget"] == 0
+    assert spec["group_timeout_s"] == 600.0
+    assert not spec["paused"]
+
+
+@pytest.mark.parametrize("mutate, match", [
+    (lambda p: p.pop("spec"), "spec missing"),
+    (lambda p: p["spec"].update(mode="bogus"), "invalid CC mode"),
+    (lambda p: p["spec"].update(nodeSelector=""), "nodeSelector"),
+    (lambda p: p["spec"].update(strategy={"maxUnavailable": 0}),
+     "maxUnavailable"),
+    (lambda p: p["spec"].update(strategy={"failureBudget": -1}),
+     "failureBudget"),
+    (lambda p: p["spec"].update(strategy={"groupTimeoutSeconds": 0}),
+     "groupTimeoutSeconds"),
+    (lambda p: p["spec"].update(strategy="nope"), "must be an object"),
+])
+def test_parse_policy_spec_rejects(mutate, match):
+    pol = make_policy("p")
+    mutate(pol)
+    with pytest.raises(PolicySpecError, match=match):
+        parse_policy_spec(pol)
+
+
+# ---------------------------------------------------------------------------
+# custom-resource plumbing: FakeKube semantics
+# ---------------------------------------------------------------------------
+
+def test_fake_custom_resource_generation_semantics():
+    kube = FakeKube()
+    kube.add_custom(G, P, make_policy("p1"))
+    got = kube.get_cluster_custom(G, V, P, "p1")
+    assert got["metadata"]["generation"] == 1
+
+    # spec patch bumps generation
+    kube.patch_cluster_custom(G, V, P, "p1", {"spec": {"mode": "off"}})
+    got = kube.get_cluster_custom(G, V, P, "p1")
+    assert got["metadata"]["generation"] == 2
+    assert got["spec"]["mode"] == "off"
+
+    # status subresource patch does NOT bump generation and does not
+    # touch spec
+    kube.patch_cluster_custom(
+        G, V, P, "p1",
+        {"status": {"phase": "Converged"}, "spec": {"mode": "on"}},
+        subresource="status",
+    )
+    got = kube.get_cluster_custom(G, V, P, "p1")
+    assert got["metadata"]["generation"] == 2
+    assert got["spec"]["mode"] == "off"
+    assert got["status"]["phase"] == "Converged"
+
+    # main-resource patch ignores status (it has a subresource)
+    kube.patch_cluster_custom(
+        G, V, P, "p1", {"status": {"phase": "Bogus"}}
+    )
+    assert kube.get_cluster_custom(
+        G, V, P, "p1"
+    )["status"]["phase"] == "Converged"
+
+
+def test_fake_custom_resource_404s():
+    kube = FakeKube()
+    with pytest.raises(ApiException) as ei:
+        kube.get_cluster_custom(G, V, P, "absent")
+    assert ei.value.status == 404
+    with pytest.raises(ApiException) as ei:
+        kube.patch_cluster_custom(G, V, P, "absent", {})
+    assert ei.value.status == 404
+
+
+def test_list_cluster_custom_sorted_and_scoped():
+    kube = FakeKube()
+    kube.add_custom(G, P, make_policy("zeta"))
+    kube.add_custom(G, P, make_policy("alpha"))
+    kube.add_custom(G, "othercollection", make_policy("other"))
+    names = [o["metadata"]["name"] for o in kube.list_cluster_custom(G, V, P)]
+    assert names == ["alpha", "zeta"]
+
+
+# ---------------------------------------------------------------------------
+# custom-resource plumbing: real wire protocol
+# ---------------------------------------------------------------------------
+
+def test_custom_resources_over_the_wire():
+    store = FakeKube()
+    store.add_custom(G, P, make_policy("wire-pol"))
+    with FakeApiServer(store) as srv:
+        client = HttpKubeClient(
+            KubeConfig("127.0.0.1", srv.port, use_tls=False)
+        )
+        objs = client.list_cluster_custom(G, V, P)
+        assert [o["metadata"]["name"] for o in objs] == ["wire-pol"]
+
+        got = client.get_cluster_custom(G, V, P, "wire-pol")
+        assert got["spec"]["mode"] == "on"
+
+        client.patch_cluster_custom(
+            G, V, P, "wire-pol", {"status": {"phase": "Pending"}},
+            subresource="status",
+        )
+        assert store.get_cluster_custom(
+            G, V, P, "wire-pol"
+        )["status"]["phase"] == "Pending"
+
+        with pytest.raises(ApiException) as ei:
+            client.get_cluster_custom(G, V, P, "absent")
+        assert ei.value.status == 404
+
+
+# ---------------------------------------------------------------------------
+# controller: phase derivation (no rollout needed)
+# ---------------------------------------------------------------------------
+
+def test_converged_policy_reports_converged():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("p"))
+    report = controller(kube).scan_once()
+    st = report["policies"]["p"]
+    assert st["phase"] == "Converged"
+    assert (st["nodes"], st["converged"], st["divergent"]) == (1, 1, 0)
+    # status published to the CR
+    assert kube.get_cluster_custom(G, V, P, "p")["status"]["phase"] == \
+        "Converged"
+
+
+def test_invalid_policy_is_reported_not_crashed():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("bad", mode="bogus"))
+    kube.add_custom(G, P, make_policy("good"))
+    report = controller(kube).scan_once()
+    assert report["policies"]["bad"]["phase"] == "Invalid"
+    assert "invalid CC mode" in report["policies"]["bad"]["message"]
+    # the good policy still reconciled
+    assert report["policies"]["good"]["phase"] == "Converged"
+
+
+def test_paused_policy_patches_nothing():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy("p", paused=True))
+    st = controller(kube).scan_once()["policies"]["p"]
+    assert st["phase"] == "Paused"
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_LABEL] == "off"  # untouched
+
+
+def test_empty_selector_is_pending_not_degraded():
+    kube = FakeKube()
+    kube.add_custom(G, P, make_policy("p", selector="no-such-label"))
+    st = controller(kube).scan_once()["policies"]["p"]
+    assert st["phase"] == "Pending"
+    assert "no nodes match" in st["message"]
+
+
+def test_failed_node_reports_degraded():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="failed"))
+    kube.add_custom(G, P, make_policy("p"))
+    st = controller(kube).scan_once()["policies"]["p"]
+    assert st["phase"] == "Degraded"
+    assert st["failed"] == 1
+
+
+def test_overlapping_policies_conflict_name_order():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    # both select the same node; 'alpha' wins by name order
+    kube.add_custom(G, P, make_policy("beta", mode="off"))
+    kube.add_custom(G, P, make_policy("alpha", mode="on"))
+    report = controller(kube).scan_once()
+    assert report["policies"]["alpha"]["phase"] == "Converged"
+    st = report["policies"]["beta"]
+    assert st["phase"] == "Conflicted"
+    assert "n1" in st["message"]
+    # the conflicted policy patched nothing
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_LABEL] == "on"
+
+
+def test_observed_generation_tracks_spec_changes():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("p"))
+    c = controller(kube)
+    c.scan_once()
+    assert kube.get_cluster_custom(
+        G, V, P, "p"
+    )["status"]["observedGeneration"] == 1
+    kube.patch_cluster_custom(G, V, P, "p", {"spec": {"paused": True}})
+    c.scan_once()
+    got = kube.get_cluster_custom(G, V, P, "p")
+    assert got["metadata"]["generation"] == 2
+    assert got["status"]["observedGeneration"] == 2
+    assert got["status"]["phase"] == "Paused"
+
+
+# ---------------------------------------------------------------------------
+# controller: driving rollouts
+# ---------------------------------------------------------------------------
+
+def test_divergent_pool_converges_via_rollout():
+    kube = FakeKube()
+    for i in range(3):
+        kube.add_node(_node(f"n{i}", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy(
+        "p", strategy={"maxUnavailable": 2, "groupTimeoutSeconds": 10},
+    ))
+    agents = _ReactiveAgents(kube, [f"n{i}" for i in range(3)])
+    agents.start()
+    try:
+        st = controller(kube).scan_once()["policies"]["p"]
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert st["phase"] == "Converged"
+    assert st["lastRollout"]["ok"] is True
+    assert len(st["lastRollout"]["succeeded"]) == 3
+    for i in range(3):
+        labels = kube.get_node(f"n{i}")["metadata"]["labels"]
+        assert labels[L.CC_MODE_LABEL] == "on"
+        assert labels[L.CC_MODE_STATE_LABEL] == "on"
+    # published status matches
+    assert kube.get_cluster_custom(
+        G, V, P, "p"
+    )["status"]["phase"] == "Converged"
+
+
+def test_rollout_failure_degrades_policy_and_is_retried_next_tick():
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    kube.add_node(_node("n1", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy(
+        "p", strategy={"groupTimeoutSeconds": 5},
+    ))
+    agents = _ReactiveAgents(kube, ["n0", "n1"], fail_nodes={"n1"})
+    agents.start()
+    c = controller(kube)
+    try:
+        st = c.scan_once()["policies"]["p"]
+        assert st["phase"] == "Degraded"
+        assert st["lastRollout"]["ok"] is False
+        # level-triggered: the next tick sees the failed node and the
+        # preflight refusal, stays Degraded, crashes nothing
+        st2 = c.scan_once()["policies"]["p"]
+        assert st2["phase"] == "Degraded"
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+
+
+def test_one_rollout_per_tick_deterministic_order():
+    kube = FakeKube()
+    kube.add_node(_node("a1", desired="off", state="off",
+                        extra={"pool": "a"}))
+    kube.add_node(_node("b1", desired="off", state="off",
+                        extra={"pool": "b"}))
+    kube.add_custom(G, P, make_policy(
+        "pol-a", selector="pool=a",
+        strategy={"groupTimeoutSeconds": 10},
+    ))
+    kube.add_custom(G, P, make_policy(
+        "pol-b", selector="pool=b",
+        strategy={"groupTimeoutSeconds": 10},
+    ))
+    agents = _ReactiveAgents(kube, ["a1", "b1"])
+    agents.start()
+    c = controller(kube)
+    try:
+        report = c.scan_once()
+        # name order: pol-a rolled this tick, pol-b queued
+        assert report["policies"]["pol-a"]["phase"] == "Converged"
+        assert report["policies"]["pol-b"]["phase"] == "Pending"
+        assert "queued" in report["policies"]["pol-b"]["message"]
+        report2 = c.scan_once()
+        assert report2["policies"]["pol-b"]["phase"] == "Converged"
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+
+
+def test_controller_adopts_unfinished_rollout_record():
+    """Crash-safety: an unfinished rollout record on the pool (a crashed
+    controller or operator run) is resumed before anything new starts."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    kube.add_node(_node("n1", desired="on", state="off"))
+    # a crashed rollout: n1's label was already patched (in_flight),
+    # n0 still pending
+    record = {
+        "id": "deadbeef", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "groups": {
+            "node/n1": {"nodes": ["n1"], "outcome": "in_flight"},
+            "node/n0": {"nodes": ["n0"], "outcome": "pending"},
+        },
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    kube.add_custom(G, P, make_policy(
+        "p", strategy={"groupTimeoutSeconds": 10},
+    ))
+    agents = _ReactiveAgents(kube, ["n0", "n1"])
+    agents.start()
+    c = controller(kube)
+    try:
+        c.scan_once()  # tick 1: adopts + finishes the crashed rollout
+        rec = json.loads(
+            kube.get_node("n0")["metadata"]["annotations"][
+                L.ROLLOUT_ANNOTATION
+            ]
+        )
+        assert rec["complete"] is True
+        assert rec["groups"]["node/n1"]["outcome"] == "succeeded"
+        assert rec["groups"]["node/n0"]["outcome"] == "succeeded"
+        st = c.scan_once()["policies"]["p"]  # tick 2: level-triggered
+        assert st["phase"] == "Converged"
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+
+
+def test_paused_policy_holds_adoption_of_unfinished_rollout():
+    """spec.paused is an emergency brake: it must freeze even the
+    crash-recovery resume path for the policy's nodes."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    record = {
+        "id": "cafe01", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "groups": {"node/n0": {"nodes": ["n0"], "outcome": "in_flight"}},
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    kube.add_custom(G, P, make_policy("p", paused=True))
+    c = controller(kube)
+    st = c.scan_once()["policies"]["p"]
+    assert st["phase"] == "Paused"
+    assert "held by pause" in st["message"]
+    # nothing resumed: the record is still incomplete, desired untouched
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is False
+    assert kube.get_node("n0")["metadata"]["labels"][L.CC_MODE_LABEL] == "off"
+
+    # unpausing releases the brake: adoption resumes the record
+    kube.patch_cluster_custom(G, V, P, "p", {"spec": {"paused": False}})
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    try:
+        c.scan_once()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is True
+    assert rec["groups"]["node/n0"]["outcome"] == "succeeded"
+
+
+def test_list_failure_of_earlier_policy_holds_later_rollouts():
+    """A transient node-list failure for a name-ordered-earlier policy
+    must not hand its nodes to a later overlapping policy for the tick —
+    that would flip-flop the pool on every API blip."""
+    fail = {"on": True}
+
+    class FlakyKube(FakeKube):
+        def list_nodes(self, selector=None):
+            # only 'alpha's selector fails; 'beta' (the overlap) lists fine
+            if fail["on"] and selector == "pool=shared":
+                raise ApiException(500, "transient")
+            return super().list_nodes(selector)
+
+    kube = FlakyKube()
+    kube.add_node(_node("n1", desired="on", state="on",
+                        extra={"pool": "shared"}))
+    kube.add_custom(G, P, make_policy("alpha", mode="on",
+                                      selector="pool=shared"))
+    kube.add_custom(G, P, make_policy(
+        "beta", mode="off", selector=L.TPU_ACCELERATOR_LABEL,
+        strategy={"groupTimeoutSeconds": 5},
+    ))
+    c = controller(kube)
+    report = c.scan_once()
+    assert report["policies"]["alpha"]["phase"] == "Degraded"
+    assert report["policies"]["beta"]["phase"] == "Pending"
+    assert "holding" in report["policies"]["beta"]["message"]
+    # beta patched nothing: n1 still at alpha's mode
+    assert kube.get_node("n1")["metadata"]["labels"][L.CC_MODE_LABEL] == "on"
+
+    # once alpha lists again, the overlap is visible as a plain conflict
+    fail["on"] = False
+    report = c.scan_once()
+    assert report["policies"]["alpha"]["phase"] == "Converged"
+    assert report["policies"]["beta"]["phase"] == "Conflicted"
+
+
+def test_steady_state_emits_no_status_patches():
+    """A converged fleet must not generate a status PATCH per policy per
+    tick forever (etcd write + watch churn for zero information)."""
+    patches = []
+
+    class CountingKube(FakeKube):
+        def patch_cluster_custom(self, *a, **k):
+            if k.get("subresource") == "status":
+                patches.append(a[3])
+            return super().patch_cluster_custom(*a, **k)
+
+    kube = CountingKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("p"))
+    c = controller(kube)
+    c.scan_once()
+    assert patches == ["p"]  # first publication
+    c.scan_once()
+    c.scan_once()
+    assert patches == ["p"]  # steady state: no further writes
+    # a real change writes again
+    kube.patch_cluster_custom(G, V, P, "p", {"spec": {"paused": True}})
+    c.scan_once()
+    assert patches == ["p", "p"]
+
+
+# ---------------------------------------------------------------------------
+# controller: service surface
+# ---------------------------------------------------------------------------
+
+def test_http_surface_and_metrics():
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("p"))
+    c = controller(kube, port=0)
+    c._server.start()
+    try:
+        base = f"http://127.0.0.1:{c.port}"
+        # before any scan: /report 503, /healthz ok
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/report")
+        assert ei.value.code == 503
+        assert urllib.request.urlopen(f"{base}/healthz").status == 200
+
+        c.scan_once()
+        body = json.loads(urllib.request.urlopen(f"{base}/report").read())
+        assert body["policies"]["p"]["phase"] == "Converged"
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics"
+        ).read().decode()
+        assert 'tpu_cc_policy_phase{phase="Converged"} 1' in metrics
+        assert "tpu_cc_policy_count 1" in metrics
+    finally:
+        c.stop()
+
+
+def test_scan_failure_degrades_healthz():
+    class BrokenKube(FakeKube):
+        def list_cluster_custom(self, *a, **k):
+            raise ApiException(500, "boom")
+
+    c = controller(BrokenKube(), max_consecutive_errors=2)
+    for _ in range(2):
+        with pytest.raises(ApiException):
+            c.scan_once()
+    assert not c.healthy
+
+
+def test_interval_validation():
+    with pytest.raises(ValueError, match="interval"):
+        PolicyController(FakeKube(), interval_s=0)

@@ -12,7 +12,10 @@ Modes of operation (parity with both reference CLIs):
   pool (new vs the reference — see tpu_cc_manager.rollout);
 - ``fleet-controller``: long-running read-only fleet audit service
   (JAX fleet scans served as /metrics + /report — see
-  tpu_cc_manager.fleet).
+  tpu_cc_manager.fleet);
+- ``policy-controller``: declarative TPUCCPolicy reconciler — drives
+  bounded rollouts toward the modes the cluster's policy objects
+  declare (see tpu_cc_manager.policy).
 """
 
 from __future__ import annotations
@@ -132,6 +135,21 @@ def main(argv=None) -> int:
             )
         except ValueError as e:
             log.error("fleet-controller refused: %s", e)
+            return 1
+        return controller.run()
+
+    if args.command == "policy-controller":
+        from tpu_cc_manager.policy import PolicyController
+
+        try:
+            controller = PolicyController(
+                _kube_client(cfg),
+                interval_s=args.interval,
+                port=args.port,
+                verify_evidence=not args.no_verify_evidence,
+            )
+        except ValueError as e:
+            log.error("policy-controller refused: %s", e)
             return 1
         return controller.run()
 

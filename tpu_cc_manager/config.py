@@ -219,6 +219,28 @@ def build_parser() -> argparse.ArgumentParser:
         default=int(os.environ.get("FLEET_PORT", "8090")),
         help="HTTP port for /metrics, /report, /healthz (default 8090)",
     )
+    pol = sub.add_parser(
+        "policy-controller",
+        help="run the declarative TPUCCPolicy controller: continuously "
+             "reconcile the fleet to the modes the cluster's TPUCCPolicy "
+             "objects declare, driving bounded rollouts and publishing "
+             "status (operator-side; no NODE_NAME needed)",
+    )
+    pol.add_argument(
+        "--interval", type=float,
+        default=float(os.environ.get("POLICY_SCAN_INTERVAL", "30")),
+        help="seconds between policy scans (default 30)",
+    )
+    pol.add_argument(
+        "--port", type=int,
+        default=int(os.environ.get("POLICY_PORT", "8091")),
+        help="HTTP port for /metrics, /report, /healthz (default 8091)",
+    )
+    pol.add_argument(
+        "--no-verify-evidence", action="store_true",
+        help="trust cc.mode.state labels without cross-checking the "
+             "per-node attestation evidence",
+    )
     return p
 
 
@@ -227,7 +249,8 @@ def parse_config(argv: Optional[List[str]] = None):
     reference (cmd/main.go:109-115, main.py:737-739)."""
     args = build_parser().parse_args(argv)
     if not args.node_name and args.command not in (
-        "get-cc-mode", "probe-devices", "rollout", "fleet-controller"
+        "get-cc-mode", "probe-devices", "rollout", "fleet-controller",
+        "policy-controller",
     ):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"

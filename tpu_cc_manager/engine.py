@@ -144,6 +144,10 @@ class ModeEngine:
         drift toward locked is the safe direction either way."""
         from tpu_cc_manager.device.gate import FLIP_LOCK_PERMS
 
+        if not self._gate.enabled:
+            # nothing to heal, and the per-chip mode queries below would
+            # be pure wasted device I/O on every idle tick
+            return
         try:
             devices = self._all_devices()
         except DeviceError:

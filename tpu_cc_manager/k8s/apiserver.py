@@ -17,6 +17,9 @@ Endpoints:
 - ``POST   /api/v1/namespaces/{ns}/pods/{name}/eviction``
 - ``POST   /api/v1/namespaces/{ns}/events``
 - ``GET    /api/v1/namespaces/{ns}/events``
+- ``GET    /apis/{group}/{ver}/{plural}``          (cluster-scoped CRs)
+- ``GET    /apis/{group}/{ver}/{plural}/{name}``
+- ``PATCH  /apis/{group}/{ver}/{plural}/{name}[/status]``
 
 Watch responses are newline-delimited JSON event streams, ending when the
 ``timeoutSeconds`` window elapses (clean EOF), or a single ERROR event for
@@ -144,6 +147,17 @@ class _Handler(BaseHTTPRequestHandler):
                     _list_obj("EventList",
                               self.store.list_events(parts[3]), None),
                 )
+            if parts[0] == "apis" and len(parts) == 4:
+                group, ver, plural = parts[1], parts[2], parts[3]
+                items = self.store.list_cluster_custom(group, ver, plural)
+                return self._send_json(200, _list_obj("List", items, None))
+            if parts[0] == "apis" and len(parts) == 5:
+                return self._send_json(
+                    200,
+                    self.store.get_cluster_custom(
+                        parts[1], parts[2], parts[3], parts[4]
+                    ),
+                )
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:
             return self._send_error_status(e)
@@ -156,6 +170,15 @@ class _Handler(BaseHTTPRequestHandler):
             if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
                 return self._send_json(
                     200, self.store.patch_node(parts[3], self._read_body())
+                )
+            if parts[0] == "apis" and len(parts) in (5, 6):
+                sub = parts[5] if len(parts) == 6 else None
+                return self._send_json(
+                    200,
+                    self.store.patch_cluster_custom(
+                        parts[1], parts[2], parts[3], parts[4],
+                        self._read_body(), subresource=sub,
+                    ),
                 )
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:

@@ -100,6 +100,36 @@ class KubeClient(abc.ABC):
         for smokes/tests)."""
         raise ApiException(501, "events not supported by this client")
 
+    # -- cluster-scoped custom resources (CRDs) -------------------------
+    # Non-abstract with a 501 default, like the events surface: only the
+    # policy controller needs CRs, and minimal clientsets/test doubles
+    # must keep working unchanged.
+    def list_cluster_custom(
+        self, group: str, version: str, plural: str
+    ) -> List[dict]:
+        """List a cluster-scoped custom resource collection
+        (``GET /apis/{group}/{version}/{plural}``)."""
+        raise ApiException(501, "custom resources not supported by this client")
+
+    def get_cluster_custom(
+        self, group: str, version: str, plural: str, name: str
+    ) -> dict:
+        raise ApiException(501, "custom resources not supported by this client")
+
+    def patch_cluster_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        name: str,
+        patch: dict,
+        subresource: Optional[str] = None,
+    ) -> dict:
+        """JSON merge patch on a cluster-scoped custom resource;
+        ``subresource="status"`` patches the status subresource (which,
+        like the real API server, never bumps ``metadata.generation``)."""
+        raise ApiException(501, "custom resources not supported by this client")
+
     # convenience built on the primitives -------------------------------
     def set_node_labels(self, name: str, labels: Dict[str, Optional[str]]) -> dict:
         return self.patch_node(name, {"metadata": {"labels": labels}})
@@ -625,6 +655,34 @@ class HttpKubeClient(KubeClient):
     def create_event(self, namespace: str, event: dict) -> dict:
         return self._request(
             "POST", f"/api/v1/namespaces/{namespace}/events", body=event
+        )
+
+    # -- custom resources ------------------------------------------------
+    def list_cluster_custom(
+        self, group: str, version: str, plural: str
+    ) -> List[dict]:
+        return self._paged_list(f"/apis/{group}/{version}/{plural}", {})
+
+    def get_cluster_custom(
+        self, group: str, version: str, plural: str, name: str
+    ) -> dict:
+        return self._request("GET", f"/apis/{group}/{version}/{plural}/{name}")
+
+    def patch_cluster_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        name: str,
+        patch: dict,
+        subresource: Optional[str] = None,
+    ) -> dict:
+        path = f"/apis/{group}/{version}/{plural}/{name}"
+        if subresource:
+            path += f"/{subresource}"
+        return self._request(
+            "PATCH", path, body=patch,
+            content_type="application/merge-patch+json",
         )
 
     def list_events(self, namespace: str) -> List[dict]:

@@ -68,6 +68,10 @@ class FakeKube(KubeClient):
         #: core/v1 Events recorded via create_event: an append-ordered
         #: flat list (each event carries metadata.namespace)
         self.cluster_events: List[dict] = []
+        #: cluster-scoped custom resources, keyed (group, plural, name).
+        #: version is deliberately not part of the key: the fake serves
+        #: one storage version, like a real API server does
+        self._customs: Dict[Tuple[str, str, str], dict] = {}
 
     # ------------------------------------------------------------ helpers
     def _bump(self, obj: dict) -> None:
@@ -237,6 +241,81 @@ class FakeKube(KubeClient):
                 copy.deepcopy(e) for e in self.cluster_events
                 if e["metadata"]["namespace"] == namespace
             ]
+
+    # ------------------------------------------------- custom resources
+    def add_custom(self, group: str, plural: str, obj: dict) -> dict:
+        """Create a cluster-scoped custom resource (test surface, the
+        ``kubectl apply`` analog)."""
+        with self._lock:
+            stored = copy.deepcopy(obj)
+            stored.setdefault("metadata", {}).setdefault("generation", 1)
+            self._bump(stored)
+            self._customs[(group, plural, stored["metadata"]["name"])] = stored
+            return copy.deepcopy(stored)
+
+    def list_cluster_custom(
+        self, group: str, version: str, plural: str
+    ) -> List[dict]:
+        with self._lock:
+            return sorted(
+                (
+                    copy.deepcopy(o)
+                    for (g, p, _), o in self._customs.items()
+                    if g == group and p == plural
+                ),
+                key=lambda o: o["metadata"]["name"],
+            )
+
+    def get_cluster_custom(
+        self, group: str, version: str, plural: str, name: str
+    ) -> dict:
+        with self._lock:
+            obj = self._customs.get((group, plural, name))
+            if obj is None:
+                raise ApiException(
+                    404, f"{plural}.{group} {name!r} not found"
+                )
+            return copy.deepcopy(obj)
+
+    def patch_cluster_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        name: str,
+        patch: dict,
+        subresource: Optional[str] = None,
+    ) -> dict:
+        with self._lock:
+            cur = self._customs.get((group, plural, name))
+            if cur is None:
+                raise ApiException(
+                    404, f"{plural}.{group} {name!r} not found"
+                )
+            if subresource == "status":
+                # status subresource: only .status moves; spec/metadata in
+                # the patch body are ignored and generation never bumps
+                # (the real API server's subresource contract)
+                merged = merge_patch(
+                    cur, {"status": patch.get("status", {})}
+                )
+            elif subresource:
+                raise ApiException(
+                    404, f"subresource {subresource!r} not served"
+                )
+            else:
+                # main resource: status in the patch is ignored (it has a
+                # subresource), and a spec change bumps the generation —
+                # observedGeneration bookkeeping depends on this
+                body = {k: v for k, v in patch.items() if k != "status"}
+                merged = merge_patch(cur, body)
+                if merged.get("spec") != cur.get("spec"):
+                    gen = merged["metadata"].get("generation", 1)
+                    merged["metadata"]["generation"] = gen + 1
+            merged["metadata"]["name"] = name
+            self._customs[(group, plural, name)] = merged
+            self._bump(merged)
+            return copy.deepcopy(merged)
 
     # ------------------------------------------------------------- watch
     def watch_nodes(

@@ -84,3 +84,13 @@ ROLLOUT_ANNOTATION = "tpu.google.com/cc.rollout"
 FLIP_TAINT_KEY = "tpu.google.com/cc.mode"
 FLIP_TAINT_VALUE = "flipping"
 FLIP_TAINT_EFFECT = "NoSchedule"
+
+#: TPUCCPolicy custom resource (tpu_cc_manager.policy): the declarative,
+#: level-triggered replacement for hand-run rollouts. Cluster-scoped —
+#: a policy selects node pools by label selector, so namespacing it
+#: would be a lie. The reference has no declarative surface at all
+#: (admins patch labels by hand, reference README_PYTHON.md:77-102).
+POLICY_GROUP = "tpu.google.com"
+POLICY_VERSION = "v1alpha1"
+POLICY_PLURAL = "tpuccpolicies"
+POLICY_KIND = "TPUCCPolicy"

@@ -1,0 +1,561 @@
+"""TPUCCPolicy controller — declarative, level-triggered pool policy.
+
+The reference's only interface for changing a fleet's CC mode is
+imperative: an admin patches node labels by hand (reference
+README_PYTHON.md:77-102) or — in this build — runs ``rollout`` once.
+This module closes the loop the Kubernetes way: a cluster-scoped
+``TPUCCPolicy`` custom resource declares the desired mode for a set of
+node pools, and a controller continuously reconciles the fleet toward
+it, driving the existing rollout machinery (tpu_cc_manager.rollout —
+disruption window, failure budget, durable record, evidence
+verification) and reporting progress in the resource's status
+subresource:
+
+.. code-block:: yaml
+
+    apiVersion: tpu.google.com/v1alpha1
+    kind: TPUCCPolicy
+    metadata:
+      name: prod-v5p-confidential
+    spec:
+      mode: "on"
+      nodeSelector: "cloud.google.com/gke-tpu-accelerator"
+      paused: false
+      strategy:
+        maxUnavailable: 1
+        failureBudget: 0
+        groupTimeoutSeconds: 600
+
+Semantics:
+
+- **Level-triggered.** Every scan tick re-derives each policy's state
+  from node labels; nodes added to the pool later (autoscaling, repair)
+  converge on the next tick with no operator action. A failed rollout is
+  retried next tick — the scan interval is the retry backoff.
+- **One rollout at a time, deterministic order.** Policies are
+  processed in name order and at most one rollout runs per tick
+  (the rollout layer's cluster-wide durable-record guard refuses
+  concurrency anyway); a policy whose turn hasn't come reports
+  ``Pending``.
+- **Crash-safe by adoption.** Before launching anything, the controller
+  resumes any unfinished rollout record found on the pool (its own
+  crashed rollout or an operator's) via the same ``--resume`` machinery,
+  so a controller restart mid-rollout loses nothing.
+- **Conflicts are refused, loudly.** When two policies select
+  overlapping nodes, the name-ordered first policy owns them; the later
+  policy reports ``Conflicted`` and patches nothing — the safe failure
+  mode for a fat-fingered selector.
+- **Status is honest.** ``observedGeneration`` tracks spec changes; the
+  phase vocabulary is Invalid | Conflicted | Paused | Pending |
+  Rolling | Degraded | Converged; counts come from live node labels,
+  and rollout outcomes (including evidence mismatches the rollout
+  layer detects) land in ``status.lastRollout``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.modes import InvalidModeError, parse_mode
+from tpu_cc_manager.obs import Counter, Gauge, Histogram, RouteServer
+from tpu_cc_manager.rollout import Rollout, RolloutError, load_rollout_record
+
+log = logging.getLogger("tpu-cc-manager.policy")
+
+#: Status phase vocabulary (also the metrics label set, so vanished
+#: phases zero out instead of going stale).
+PHASES = (
+    "Invalid", "Conflicted", "Paused", "Pending", "Rolling", "Degraded",
+    "Converged",
+)
+
+_STRATEGY_DEFAULTS = {
+    "maxUnavailable": 1,
+    "failureBudget": 0,
+    "groupTimeoutSeconds": 600,
+}
+
+
+class PolicySpecError(ValueError):
+    """The policy's spec cannot be acted on (bad mode, bad strategy)."""
+
+
+def parse_policy_spec(policy: dict) -> dict:
+    """Validated spec with strategy defaults filled in. Raises
+    PolicySpecError — the controller turns it into phase=Invalid rather
+    than crashing the scan loop (one bad policy must not take down
+    reconciliation of the others)."""
+    spec = policy.get("spec")
+    if not isinstance(spec, dict):
+        raise PolicySpecError("spec missing")
+    try:
+        mode = parse_mode(str(spec.get("mode", ""))).value
+    except InvalidModeError as e:
+        raise PolicySpecError(str(e)) from None
+    selector = spec.get("nodeSelector")
+    if not selector or not isinstance(selector, str):
+        raise PolicySpecError("spec.nodeSelector (label selector string) "
+                              "is required")
+    strategy = dict(_STRATEGY_DEFAULTS)
+    raw_strategy = spec.get("strategy") or {}
+    if not isinstance(raw_strategy, dict):
+        raise PolicySpecError("spec.strategy must be an object")
+    strategy.update(raw_strategy)
+    try:
+        max_unavailable = int(strategy["maxUnavailable"])
+        failure_budget = int(strategy["failureBudget"])
+        group_timeout = float(strategy["groupTimeoutSeconds"])
+    except (TypeError, ValueError) as e:
+        raise PolicySpecError(f"spec.strategy: {e}") from None
+    if max_unavailable < 1:
+        raise PolicySpecError("spec.strategy.maxUnavailable must be >= 1")
+    if failure_budget < 0:
+        raise PolicySpecError("spec.strategy.failureBudget must be >= 0")
+    if group_timeout <= 0:
+        raise PolicySpecError(
+            "spec.strategy.groupTimeoutSeconds must be > 0"
+        )
+    return {
+        "mode": mode,
+        "selector": selector,
+        "paused": bool(spec.get("paused", False)),
+        "max_unavailable": max_unavailable,
+        "failure_budget": failure_budget,
+        "group_timeout_s": group_timeout,
+    }
+
+
+class PolicyMetrics:
+    def __init__(self):
+        self.policies = Gauge(
+            "tpu_cc_policy_count", "TPUCCPolicy objects observed"
+        )
+        self.by_phase = Gauge(
+            "tpu_cc_policy_phase", "Policies per status phase", ("phase",)
+        )
+        self.rollouts = Counter(
+            "tpu_cc_policy_rollouts_total",
+            "Rollouts driven by the policy controller, by outcome",
+            ("outcome",),
+        )
+        self.scans = Counter(
+            "tpu_cc_policy_scans_total", "Policy scans, by outcome",
+            ("outcome",),
+        )
+        self.scan_duration = Histogram(
+            "tpu_cc_policy_scan_duration_seconds",
+            "Wall-clock duration of one policy scan",
+        )
+
+    def update(self, statuses: Dict[str, dict]) -> None:
+        self.policies.set(len(statuses))
+        counts = {p: 0 for p in PHASES}
+        for st in statuses.values():
+            counts[st["phase"]] = counts.get(st["phase"], 0) + 1
+        for phase in PHASES:
+            self.by_phase.set(counts.get(phase, 0), phase)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for m in (self.policies, self.by_phase, self.rollouts, self.scans,
+                  self.scan_duration):
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class PolicyController:
+    """Reconciles every TPUCCPolicy each ``interval_s``; serves
+    /healthz, /metrics, and /report (the latest per-policy statuses)."""
+
+    def __init__(
+        self,
+        kube: KubeClient,
+        *,
+        interval_s: float = 30.0,
+        port: int = 8091,
+        poll_s: float = 0.5,
+        max_consecutive_errors: int = 10,
+        verify_evidence: bool = True,
+    ):
+        if interval_s <= 0:
+            raise ValueError(
+                f"scan interval must be > 0, got {interval_s!r} "
+                "(a zero interval busy-loops against the API server)"
+            )
+        self.kube = kube
+        self.interval_s = interval_s
+        self.poll_s = poll_s
+        self.max_consecutive_errors = max_consecutive_errors
+        self.verify_evidence = verify_evidence
+        self.metrics = PolicyMetrics()
+        self.last_report: Optional[dict] = None
+        self.consecutive_errors = 0
+        #: last status published per policy (lastScanTime excluded): a
+        #: converged steady-state fleet must not generate a status PATCH
+        #: (etcd write + watch churn) per policy per tick forever
+        self._published: Dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._server = RouteServer(port, name="policy-http")
+        self._server.add_route("/healthz", self._healthz)
+        self._server.add_route("/metrics", self._metrics_route)
+        self._server.add_route("/report", self._report_route)
+
+    # ------------------------------------------------------------- scans
+    def scan_once(self) -> dict:
+        """One full reconcile pass over every policy. Returns the report
+        also served at /report."""
+        t0 = time.monotonic()
+        try:
+            report = self._scan()
+            self.metrics.scan_duration.observe(time.monotonic() - t0)
+            self.metrics.update(report["policies"])
+            self.last_report = report
+        except Exception:
+            self.metrics.scans.inc("error")
+            self.consecutive_errors += 1
+            raise
+        self.consecutive_errors = 0
+        self.metrics.scans.inc("success")
+        return report
+
+    def _scan(self) -> dict:
+        policies = self.kube.list_cluster_custom(
+            L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL
+        )
+        policies.sort(key=lambda p: p["metadata"]["name"])
+        statuses: Dict[str, dict] = {}
+        claims: Dict[str, str] = {}  # node -> owning policy (name order)
+        paused_claims: Dict[str, str] = {}  # node -> paused owning policy
+        seen_nodes: Dict[str, dict] = {}  # union of all listed nodes
+        actionable: List[Tuple[dict, dict]] = []  # (policy, parsed spec)
+        claims_incomplete = False
+
+        # ---- pass 1: validate, claim nodes, derive label-truth counts
+        for pol in policies:
+            name = pol["metadata"]["name"]
+            try:
+                spec = parse_policy_spec(pol)
+            except PolicySpecError as e:
+                statuses[name] = self._status(pol, "Invalid", str(e))
+                continue
+            try:
+                nodes = self.kube.list_nodes(spec["selector"])
+            except ApiException as e:
+                statuses[name] = self._status(
+                    pol, "Degraded", f"node list failed: {e}"
+                )
+                # this policy's claims are unknown this tick; a later
+                # overlapping policy must NOT inherit its nodes and roll
+                # them the other way (selector overlap is only detectable
+                # through the claims this list would have registered)
+                claims_incomplete = True
+                continue
+            conflicted = sorted(
+                n["metadata"]["name"] for n in nodes
+                if claims.get(n["metadata"]["name"], name) != name
+            )
+            own = [
+                n for n in nodes
+                if n["metadata"]["name"] not in conflicted
+            ]
+            for n in own:
+                claims[n["metadata"]["name"]] = name
+                if spec["paused"]:
+                    paused_claims[n["metadata"]["name"]] = name
+            for n in nodes:
+                seen_nodes[n["metadata"]["name"]] = n
+            st = self._derive_status(pol, spec, own, conflicted)
+            statuses[name] = st
+            # an empty pool is Pending but not actionable: there is
+            # nothing to roll until nodes appear
+            if st["phase"] == "Pending" and own:
+                actionable.append((pol, spec))
+
+        # ---- pass 2: adopt any unfinished rollout left on the pool
+        # (this controller's crashed run, or an operator's) before
+        # launching anything new — resume IS the crash-safety story
+        adopted = self._adopt_unfinished(
+            list(seen_nodes.values()), paused_claims, statuses
+        )
+
+        # ---- pass 3: drive at most one rollout this tick
+        if claims_incomplete and actionable:
+            # hold everything: with one policy's node list unknown, a
+            # later policy acting on an overlap would flip-flop the pool
+            for pol, _ in actionable:
+                lname = pol["metadata"]["name"]
+                statuses[lname]["message"] += (
+                    "; holding — an earlier policy's node list failed "
+                    "this tick, so selector overlap cannot be ruled out"
+                )
+            actionable = []
+        if not adopted and actionable:
+            pol, spec = actionable[0]
+            name = pol["metadata"]["name"]
+            statuses[name]["phase"] = "Rolling"
+            statuses[name]["message"] = (
+                f"rolling {spec['mode']!r} across "
+                f"{statuses[name]['divergent']} divergent node(s)"
+            )
+            self._patch_status(pol, statuses[name])  # visible mid-roll
+            outcome = self._drive_rollout(pol, spec, statuses[name])
+            self.metrics.rollouts.inc(outcome)
+            for later, _ in actionable[1:]:
+                lname = later["metadata"]["name"]
+                statuses[lname]["message"] = (
+                    statuses[lname]["message"] + "; queued behind "
+                    f"policy {name!r}"
+                ).lstrip("; ")
+
+        # ---- pass 4: publish statuses
+        for pol in policies:
+            self._patch_status(pol, statuses[pol["metadata"]["name"]])
+        return {
+            "policies": statuses,
+            "claimed_nodes": len(claims),
+            "scanned": len(policies),
+        }
+
+    # --------------------------------------------------------- derivation
+    def _derive_status(self, pol: dict, spec: dict, own: List[dict],
+                       conflicted: List[str]) -> dict:
+        converged = failed = 0
+        for n in own:
+            labels = n["metadata"].get("labels", {})
+            state = labels.get(L.CC_MODE_STATE_LABEL)
+            if state == "failed":
+                failed += 1
+            elif (labels.get(L.CC_MODE_LABEL) == spec["mode"]
+                  and state == spec["mode"]):
+                converged += 1
+        divergent = len(own) - converged
+        st = self._status(pol, "Converged", "")
+        st.update({
+            "nodes": len(own), "converged": converged, "failed": failed,
+            "divergent": divergent, "conflicted": len(conflicted),
+        })
+        if conflicted:
+            st["phase"] = "Conflicted"
+            st["message"] = (
+                f"node(s) {conflicted[:5]} already claimed by an earlier "
+                "policy; refusing to act on an overlapping selector"
+            )
+        elif spec["paused"]:
+            st["phase"] = "Paused"
+            st["message"] = f"{divergent} divergent node(s) held by pause"
+        elif not own:
+            st["phase"] = "Pending"
+            st["message"] = (
+                f"no nodes match selector {spec['selector']!r}"
+            )
+        elif failed:
+            st["phase"] = "Degraded"
+            st["message"] = f"{failed} node(s) report cc.mode.state=failed"
+        elif divergent:
+            st["phase"] = "Pending"
+            st["message"] = f"{divergent} node(s) diverge from {spec['mode']!r}"
+        else:
+            st["message"] = f"all {len(own)} node(s) at {spec['mode']!r}"
+        return st
+
+    @staticmethod
+    def _status(pol: dict, phase: str, message: str) -> dict:
+        return {
+            "observedGeneration": pol["metadata"].get("generation", 1),
+            "phase": phase,
+            "message": message,
+            "nodes": 0, "converged": 0, "failed": 0, "divergent": 0,
+            "conflicted": 0,
+            "lastScanTime": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+
+    # ----------------------------------------------------------- rollouts
+    def _adopt_unfinished(
+        self,
+        nodes: List[dict],
+        paused_claims: Dict[str, str],
+        statuses: Dict[str, dict],
+    ) -> bool:
+        """Resume a crashed rollout if one exists on the policies' own
+        nodes. True when the tick's rollout slot is consumed (a resume
+        ran, or an unfinished record is being held by a paused policy —
+        launching anything new would just trip the rollout layer's
+        concurrent-record guard).
+
+        Scope is deliberately the union of the policies' node lists, not
+        a full-cluster scan: records the controller itself wrote always
+        live there, and an operator's rollout on pools no policy owns is
+        the operator's to resume, not ours."""
+        record, _ = load_rollout_record(self.kube, nodes)
+        if record is None or record.get("complete"):
+            return False
+        held_by = sorted({
+            paused_claims[m]
+            for g in (record.get("groups") or {}).values()
+            for m in g.get("nodes", [])
+            if m in paused_claims
+        })
+        if held_by:
+            # the emergency brake: a paused policy freezes even the
+            # crash-recovery path for its nodes — visible in status, and
+            # released the moment the operator unpauses
+            for pname in held_by:
+                if pname in statuses:
+                    statuses[pname]["message"] = (
+                        f"unfinished rollout {record.get('id')!r} held "
+                        "by pause; unpause to let it resume"
+                    )
+            log.info(
+                "unfinished rollout %s held by paused polic%s %s",
+                record.get("id"),
+                "y" if len(held_by) == 1 else "ies", held_by,
+            )
+            return True
+        log.info(
+            "adopting unfinished rollout %s (mode %r)",
+            record.get("id"), record.get("mode"),
+        )
+        try:
+            report = Rollout.resume(
+                self.kube, poll_s=self.poll_s,
+                verify_evidence=self.verify_evidence,
+            ).run()
+            self.metrics.rollouts.inc(
+                "resumed_ok" if report.ok else "resumed_failed"
+            )
+        except (RolloutError, ApiException) as e:
+            log.warning("rollout adoption failed: %s", e)
+            self.metrics.rollouts.inc("resume_error")
+        return True
+
+    def _drive_rollout(self, pol: dict, spec: dict, st: dict) -> str:
+        """Run one bounded rollout for this policy; mutate its status
+        with the outcome. Returns the metrics outcome label."""
+        name = pol["metadata"]["name"]
+        try:
+            rollout = Rollout(
+                self.kube, spec["mode"],
+                selector=spec["selector"],
+                max_unavailable=spec["max_unavailable"],
+                failure_budget=spec["failure_budget"],
+                group_timeout_s=spec["group_timeout_s"],
+                poll_s=self.poll_s,
+                verify_evidence=self.verify_evidence,
+            )
+            report = rollout.run()
+        except (RolloutError, ApiException) as e:
+            # preflight refusal (broken fleet) or transport failure: the
+            # controller is level-triggered, so next tick retries; the
+            # status says why nothing is moving in the meantime
+            st["phase"] = "Degraded"
+            st["message"] = f"rollout refused: {e}"
+            log.warning("policy %s: rollout refused: %s", name, e)
+            return "refused"
+        st["lastRollout"] = {
+            "mode": report.mode,
+            "ok": report.ok,
+            "aborted": report.aborted,
+            "succeeded": report.succeeded,
+            "failed": report.failed,
+            "finishedAt": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        if report.ok:
+            st["phase"] = "Converged"
+            st["message"] = (
+                f"rollout converged {len(report.succeeded)} group(s) "
+                f"to {spec['mode']!r}"
+            )
+            st["converged"] += st["divergent"]
+            st["divergent"] = 0
+            return "ok"
+        st["phase"] = "Degraded"
+        st["message"] = (
+            f"rollout {'aborted' if report.aborted else 'failed'}: "
+            f"groups {report.failed}"
+        )
+        log.warning("policy %s: %s", name, st["message"])
+        return "aborted" if report.aborted else "failed"
+
+    # ------------------------------------------------------------- status
+    def _patch_status(self, pol: dict, status: dict) -> None:
+        """Best-effort status publication — a status write failure must
+        not stop reconciliation of the remaining policies. No-op patches
+        (nothing changed but lastScanTime) are skipped; /report and the
+        metrics carry scan liveness instead."""
+        name = pol["metadata"]["name"]
+        meaningful = {k: v for k, v in status.items() if k != "lastScanTime"}
+        if self._published.get(name) == meaningful:
+            return
+        try:
+            self.kube.patch_cluster_custom(
+                L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL,
+                name, {"status": status},
+                subresource="status",
+            )
+            self._published[name] = json.loads(json.dumps(meaningful))
+        except ApiException as e:
+            log.warning("status patch for policy %s failed: %s", name, e)
+
+    # -------------------------------------------------------------- http
+    @property
+    def healthy(self) -> bool:
+        return self.consecutive_errors < self.max_consecutive_errors
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def _healthz(self):
+        return ((200, b"ok", "text/plain") if self.healthy
+                else (503, b"unhealthy", "text/plain"))
+
+    def _metrics_route(self):
+        return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
+
+    def _report_route(self):
+        if self.last_report is None:
+            return 503, b"no scan completed yet", "text/plain"
+        body = json.dumps(self.last_report, indent=2, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> int:
+        self._server.start()
+        log.info(
+            "policy controller serving on :%d (every %.0fs)",
+            self.port, self.interval_s,
+        )
+        try:
+            while not self._stop.is_set():
+                try:
+                    report = self.scan_once()
+                    log.info(
+                        "policy scan: %d policies, %d nodes claimed",
+                        report["scanned"], report["claimed_nodes"],
+                    )
+                except Exception as e:
+                    log.warning("policy scan failed: %s", e)
+                    if not self.healthy:
+                        log.error(
+                            "%d consecutive scan failures; exiting",
+                            self.consecutive_errors,
+                        )
+                        return 1
+                self._stop.wait(self.interval_s)
+            return 0
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
