@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Generate the admission webhook's serving certificate, create the
+# tpu-cc-webhook-tls Secret, and substitute the CA bundle into
+# deployments/manifests/webhook.yaml on stdout:
+#
+#   scripts/gen-webhook-certs.sh | kubectl apply -f -
+#
+# Self-contained alternative to cert-manager for clusters without it.
+# The cert is a one-node CA signing a serving cert for the webhook
+# Service DNS name; rotate by re-running (the Secret is replaced and
+# the caBundle re-substituted).
+set -euo pipefail
+
+NAMESPACE="${NAMESPACE:-tpu-system}"
+SERVICE="${SERVICE:-tpu-cc-webhook}"
+DAYS="${DAYS:-365}"
+MANIFEST="$(dirname "$0")/../deployments/manifests/webhook.yaml"
+
+workdir="$(mktemp -d)"
+trap 'rm -rf "$workdir"' EXIT
+
+# CA
+openssl req -x509 -newkey rsa:2048 -nodes -days "$DAYS" \
+  -keyout "$workdir/ca.key" -out "$workdir/ca.crt" \
+  -subj "/CN=${SERVICE}-ca" >/dev/null 2>&1
+
+# serving cert for the Service DNS names
+cat > "$workdir/san.cnf" <<EOF
+[req]
+distinguished_name = dn
+req_extensions = ext
+[dn]
+[ext]
+subjectAltName = DNS:${SERVICE}.${NAMESPACE}.svc,DNS:${SERVICE}.${NAMESPACE}.svc.cluster.local
+EOF
+openssl req -newkey rsa:2048 -nodes \
+  -keyout "$workdir/tls.key" -out "$workdir/tls.csr" \
+  -subj "/CN=${SERVICE}.${NAMESPACE}.svc" \
+  -config "$workdir/san.cnf" >/dev/null 2>&1
+openssl x509 -req -in "$workdir/tls.csr" -days "$DAYS" \
+  -CA "$workdir/ca.crt" -CAkey "$workdir/ca.key" -CAcreateserial \
+  -extensions ext -extfile "$workdir/san.cnf" \
+  -out "$workdir/tls.crt" >/dev/null 2>&1
+
+CA_BUNDLE="$(base64 < "$workdir/ca.crt" | tr -d '\n')"
+
+# the Secret (kubectl create emits it; --dry-run keeps this script
+# cluster-free so the output can be reviewed/applied atomically)
+kubectl create secret tls tpu-cc-webhook-tls \
+  --namespace "$NAMESPACE" \
+  --cert "$workdir/tls.crt" --key "$workdir/tls.key" \
+  --dry-run=client -o yaml
+echo "---"
+sed "s|\${CA_BUNDLE}|${CA_BUNDLE}|g" "$MANIFEST"

@@ -530,6 +530,85 @@ def test_steady_state_emits_no_status_patches():
     assert patches == ["p", "p"]
 
 
+def test_claims_incomplete_holds_adoption_too():
+    """When a policy's node list fails, pause coverage is unknown —
+    adoption of an unfinished rollout must hold along with new rollouts,
+    or the paused policy's brake could be bypassed for the tick."""
+    fail = {"on": True}
+
+    class FlakyKube(FakeKube):
+        def list_nodes(self, selector=None):
+            if fail["on"] and selector == "pool=paused":
+                raise ApiException(500, "transient")
+            return super().list_nodes(selector)
+
+    kube = FlakyKube()
+    kube.add_node(_node("n0", desired="off", state="off",
+                        extra={"pool": "paused"}))
+    record = {
+        "id": "feed02", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "groups": {"node/n0": {"nodes": ["n0"], "outcome": "in_flight"}},
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    # 'aaa' (paused, owns n0 via pool=paused) lists first but fails;
+    # 'zzz' (broad selector) still sees n0
+    kube.add_custom(G, P, make_policy("aaa", paused=True,
+                                      selector="pool=paused"))
+    kube.add_custom(G, P, make_policy("zzz"))
+    c = controller(kube)
+    c.scan_once()
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is False  # nothing resumed blind
+    # once the list recovers, the pause brake itself holds the record
+    fail["on"] = False
+    c.scan_once()
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is False
+
+
+def test_recreated_policy_gets_status_written_again():
+    """The no-op-patch suppression must baseline on the LIVE object's
+    status: a deleted-and-recreated policy arrives status-less and needs
+    its first write even if the derived status is identical."""
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("p"))
+    c = controller(kube)
+    c.scan_once()
+    assert kube.get_cluster_custom(G, V, P, "p")["status"]["phase"] == \
+        "Converged"
+    # delete + recreate (same name/spec, no status)
+    with kube._lock:
+        del kube._customs[(G, P, "p")]
+    kube.add_custom(G, P, make_policy("p"))
+    c.scan_once()
+    assert kube.get_cluster_custom(G, V, P, "p")["status"]["phase"] == \
+        "Converged"
+
+
+def test_busy_port_raises_oserror_not_hang():
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+    try:
+        c = PolicyController(FakeKube(), port=port)
+        with pytest.raises(OSError):
+            c.run()
+    finally:
+        sock.close()
+
+
 # ---------------------------------------------------------------------------
 # controller: service surface
 # ---------------------------------------------------------------------------

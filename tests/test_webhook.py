@@ -1,0 +1,272 @@
+"""Admission webhook (tpu_cc_manager.webhook).
+
+Scheduler-level CC enforcement the reference lacks entirely: mutating
+(inject a nodeSelector on the OBSERVED state label) and validating
+(reject contradictory specs) admission for pods carrying the
+requires-cc label, over the admission.k8s.io/v1 AdmissionReview wire
+protocol on real HTTPS.
+"""
+
+import base64
+import json
+import ssl
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.webhook import (
+    AdmissionServer, mutate_pod, required_mode, review_response,
+    validate_pod,
+)
+
+
+def make_pod(requires=None, node_selector=None, tolerations=None):
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "workload", "labels": {}},
+        "spec": {"containers": [{"name": "c", "image": "busybox"}]},
+    }
+    if requires is not None:
+        pod["metadata"]["labels"][L.REQUIRES_CC_LABEL] = requires
+    if node_selector is not None:
+        pod["spec"]["nodeSelector"] = node_selector
+    if tolerations is not None:
+        pod["spec"]["tolerations"] = tolerations
+    return pod
+
+
+def apply_json_patch(doc, ops):
+    """Minimal RFC 6902 'add' applier — enough to prove the emitted
+    patch produces the pod the scheduler must see."""
+    doc = json.loads(json.dumps(doc))
+    for op in ops:
+        assert op["op"] == "add"
+        tokens = [
+            t.replace("~1", "/").replace("~0", "~")
+            for t in op["path"].lstrip("/").split("/")
+        ]
+        target = doc
+        for t in tokens[:-1]:
+            target = target[t]
+        target[tokens[-1]] = op["value"]
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# pure logic
+# ---------------------------------------------------------------------------
+
+def test_required_mode_parsing():
+    assert required_mode(make_pod()) is None
+    assert required_mode(make_pod(requires="on")) == "on"
+    assert required_mode(make_pod(requires="ici")) == "ici"
+    with pytest.raises(ValueError, match="must be one of"):
+        required_mode(make_pod(requires="bogus"))
+
+
+def test_mutate_injects_observed_state_selector():
+    ops = mutate_pod(make_pod(requires="on"))
+    patched = apply_json_patch(make_pod(requires="on"), ops)
+    assert patched["spec"]["nodeSelector"] == {L.CC_MODE_STATE_LABEL: "on"}
+
+
+def test_mutate_preserves_existing_selector_keys():
+    pod = make_pod(requires="devtools",
+                   node_selector={"pool": "prod"})
+    patched = apply_json_patch(pod, mutate_pod(pod))
+    assert patched["spec"]["nodeSelector"] == {
+        "pool": "prod", L.CC_MODE_STATE_LABEL: "devtools",
+    }
+
+
+def test_mutate_noop_when_not_opted_in_or_already_right():
+    assert mutate_pod(make_pod()) == []
+    assert mutate_pod(make_pod(
+        requires="on", node_selector={L.CC_MODE_STATE_LABEL: "on"}
+    )) == []
+
+
+def test_mutate_leaves_contradictory_pin_for_validation_to_reject():
+    """Mutating webhooks run BEFORE validating ones: rewriting a
+    contradictory explicit pin would silently admit the spec the
+    validating webhook is documented to reject. Mutate must leave it
+    alone so validation still fires."""
+    pod = make_pod(requires="on",
+                   node_selector={L.CC_MODE_STATE_LABEL: "off"})
+    assert mutate_pod(pod) == []
+    ok, reason = validate_pod(pod)
+    assert not ok and "pins" in reason
+
+
+def test_validate_allows_clean_and_unopted_pods():
+    assert validate_pod(make_pod()) == (True, "")
+    assert validate_pod(make_pod(requires="on")) == (True, "")
+
+
+def test_validate_rejects_contradictory_selector():
+    ok, reason = validate_pod(make_pod(
+        requires="on", node_selector={L.CC_MODE_STATE_LABEL: "off"}
+    ))
+    assert not ok and "pins" in reason
+
+
+@pytest.mark.parametrize("tol", [
+    # exact key+value match
+    {"key": L.FLIP_TAINT_KEY, "operator": "Equal",
+     "value": L.FLIP_TAINT_VALUE, "effect": "NoSchedule"},
+    # key Exists
+    {"key": L.FLIP_TAINT_KEY, "operator": "Exists"},
+    # tolerate-everything wildcard
+    {"operator": "Exists"},
+    # effect unset tolerates all effects
+    {"key": L.FLIP_TAINT_KEY, "operator": "Equal",
+     "value": L.FLIP_TAINT_VALUE},
+])
+def test_validate_rejects_flip_taint_toleration(tol):
+    ok, reason = validate_pod(make_pod(requires="on", tolerations=[tol]))
+    assert not ok and "flip" in reason
+
+
+@pytest.mark.parametrize("tol", [
+    # different key
+    {"key": "node.kubernetes.io/not-ready", "operator": "Exists"},
+    # right key, wrong value
+    {"key": L.FLIP_TAINT_KEY, "operator": "Equal", "value": "other"},
+    # right key but scoped to a different effect
+    {"key": L.FLIP_TAINT_KEY, "operator": "Exists",
+     "effect": "NoExecute"},
+])
+def test_validate_allows_unrelated_tolerations(tol):
+    assert validate_pod(make_pod(requires="on", tolerations=[tol]))[0]
+
+
+def test_unopted_pod_with_wildcard_toleration_is_allowed():
+    # the webhook only polices pods that ASK for confidential placement
+    assert validate_pod(
+        make_pod(tolerations=[{"operator": "Exists"}])
+    )[0]
+
+
+# ---------------------------------------------------------------------------
+# AdmissionReview protocol
+# ---------------------------------------------------------------------------
+
+def make_review(pod, uid="uid-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "object": pod},
+    }
+
+
+def test_review_response_mutate_carries_base64_patch():
+    out = review_response(make_review(make_pod(requires="on")), "mutate")
+    resp = out["response"]
+    assert resp["uid"] == "uid-1" and resp["allowed"]
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    assert resp["patchType"] == "JSONPatch"
+    patched = apply_json_patch(make_pod(requires="on"), ops)
+    assert patched["spec"]["nodeSelector"][L.CC_MODE_STATE_LABEL] == "on"
+
+
+def test_review_response_validate_denies_with_status():
+    out = review_response(
+        make_review(make_pod(requires="on", tolerations=[
+            {"operator": "Exists"},
+        ])),
+        "validate",
+    )
+    resp = out["response"]
+    assert resp["allowed"] is False
+    assert resp["status"]["code"] == 403
+
+
+def test_review_response_invalid_mode_denied_on_both_endpoints():
+    for kind in ("mutate", "validate"):
+        resp = review_response(
+            make_review(make_pod(requires="bogus")), kind
+        )["response"]
+        assert resp["allowed"] is False
+        assert resp["status"]["code"] == 400
+
+
+def test_review_response_malformed_raises():
+    with pytest.raises(ValueError, match="uid"):
+        review_response({"request": {}}, "mutate")
+    with pytest.raises(ValueError):
+        review_response({"bogus": True}, "validate")
+
+
+# ---------------------------------------------------------------------------
+# the HTTPS server (real wire)
+# ---------------------------------------------------------------------------
+
+def _post(url, body, ctx=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, context=ctx) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_admission_server_over_https(tls_pki):
+    cert, key = tls_pki
+    ctx = ssl.create_default_context(cafile=cert)
+    with AdmissionServer(0, cert_file=cert, key_file=key) as srv:
+        base = f"https://127.0.0.1:{srv.port}"
+        status, out = _post(
+            f"{base}/mutate", make_review(make_pod(requires="on")), ctx
+        )
+        assert status == 200
+        assert out["response"]["patchType"] == "JSONPatch"
+
+        status, out = _post(
+            f"{base}/validate",
+            make_review(make_pod(
+                requires="on",
+                node_selector={L.CC_MODE_STATE_LABEL: "off"},
+            ), uid="uid-2"),
+            ctx,
+        )
+        assert out["response"] == {
+            "uid": "uid-2", "allowed": False,
+            "status": {"message": out["response"]["status"]["message"],
+                       "code": 403},
+        }
+
+        # health + counters
+        health = urllib.request.urlopen(f"{base}/healthz", context=ctx)
+        assert health.status == 200
+        assert srv.reviews == 2
+
+        # malformed review -> 400, counted
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/mutate", {"not": "a review"}, ctx)
+        assert ei.value.code == 400
+        assert srv.rejected_malformed == 1
+
+        # unknown route -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/other", {}, ctx)
+        assert ei.value.code == 404
+
+
+def test_tls_required_unless_explicitly_disabled():
+    with pytest.raises(ValueError, match="TLS"):
+        AdmissionServer(0)
+    # tests may opt out
+    with AdmissionServer(0, tls=False) as srv:
+        status, out = _post(
+            f"http://127.0.0.1:{srv.port}/validate",
+            make_review(make_pod()),
+        )
+        assert status == 200 and out["response"]["allowed"]
+
+
+def test_cli_webhook_requires_cert(capsys):
+    from tpu_cc_manager.__main__ import main
+
+    assert main(["webhook", "--port", "0"]) == 1

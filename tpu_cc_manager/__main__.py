@@ -15,7 +15,10 @@ Modes of operation (parity with both reference CLIs):
   tpu_cc_manager.fleet);
 - ``policy-controller``: declarative TPUCCPolicy reconciler — drives
   bounded rollouts toward the modes the cluster's policy objects
-  declare (see tpu_cc_manager.policy).
+  declare (see tpu_cc_manager.policy);
+- ``webhook``: admission webhook steering requires-cc pods onto
+  verified nodes and rejecting contradictory specs (see
+  tpu_cc_manager.webhook).
 """
 
 from __future__ import annotations
@@ -133,10 +136,12 @@ def main(argv=None) -> int:
                 interval_s=args.interval,
                 port=args.port,
             )
-        except ValueError as e:
+            # OSError belongs inside the guard too: RouteServer binds
+            # lazily in run(), so a busy --port surfaces here
+            return controller.run()
+        except (ValueError, OSError) as e:
             log.error("fleet-controller refused: %s", e)
             return 1
-        return controller.run()
 
     if args.command == "policy-controller":
         from tpu_cc_manager.policy import PolicyController
@@ -148,10 +153,22 @@ def main(argv=None) -> int:
                 port=args.port,
                 verify_evidence=not args.no_verify_evidence,
             )
-        except ValueError as e:
+            return controller.run()
+        except (ValueError, OSError) as e:
             log.error("policy-controller refused: %s", e)
             return 1
-        return controller.run()
+
+    if args.command == "webhook":
+        from tpu_cc_manager.webhook import AdmissionServer
+
+        try:
+            server = AdmissionServer(
+                args.port, cert_file=args.cert, key_file=args.key,
+            )
+        except (ValueError, OSError) as e:
+            log.error("webhook refused: %s", e)
+            return 1
+        return server.serve_forever()
 
     if args.command == "set-cc-mode":
         import time as _time

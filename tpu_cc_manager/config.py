@@ -241,6 +241,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="trust cc.mode.state labels without cross-checking the "
              "per-node attestation evidence",
     )
+    wh = sub.add_parser(
+        "webhook",
+        help="run the admission webhook: steer pods labeled "
+             f"{L.REQUIRES_CC_LABEL} onto nodes whose observed mode "
+             "matches, and reject contradictory specs (operator-side; "
+             "no NODE_NAME needed)",
+    )
+    wh.add_argument(
+        "--port", type=int,
+        default=int(os.environ.get("WEBHOOK_PORT", "8443")),
+        help="HTTPS port for /mutate, /validate, /healthz (default 8443)",
+    )
+    wh.add_argument(
+        "--cert", default=os.environ.get("WEBHOOK_CERT"),
+        help="TLS server certificate (env WEBHOOK_CERT; required)",
+    )
+    wh.add_argument(
+        "--key", default=os.environ.get("WEBHOOK_KEY"),
+        help="TLS server key (env WEBHOOK_KEY; defaults to --cert)",
+    )
     return p
 
 
@@ -250,7 +270,7 @@ def parse_config(argv: Optional[List[str]] = None):
     args = build_parser().parse_args(argv)
     if not args.node_name and args.command not in (
         "get-cc-mode", "probe-devices", "rollout", "fleet-controller",
-        "policy-controller",
+        "policy-controller", "webhook",
     ):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"

@@ -85,6 +85,15 @@ FLIP_TAINT_KEY = "tpu.google.com/cc.mode"
 FLIP_TAINT_VALUE = "flipping"
 FLIP_TAINT_EFFECT = "NoSchedule"
 
+#: Pod-side request for a confidential-compute guarantee
+#: (tpu_cc_manager.webhook): a pod carrying this label asks to run only
+#: on nodes whose OBSERVED mode (cc.mode.state — the agent-published
+#: truth, not the desired label) equals the value. The mutating webhook
+#: injects the matching nodeSelector; the validating webhook rejects
+#: specs that contradict it (wrong explicit selector, or tolerating the
+#: flip taint, which would let the pod land mid-flip).
+REQUIRES_CC_LABEL = "tpu.google.com/requires-cc-mode"
+
 #: TPUCCPolicy custom resource (tpu_cc_manager.policy): the declarative,
 #: level-triggered replacement for hand-run rollouts. Cluster-scoped —
 #: a policy selects node pools by label selector, so namespacing it

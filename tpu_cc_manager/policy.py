@@ -196,10 +196,6 @@ class PolicyController:
         self.metrics = PolicyMetrics()
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
-        #: last status published per policy (lastScanTime excluded): a
-        #: converged steady-state fleet must not generate a status PATCH
-        #: (etcd write + watch churn) per policy per tick forever
-        self._published: Dict[str, dict] = {}
         self._stop = threading.Event()
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
@@ -281,7 +277,8 @@ class PolicyController:
         # (this controller's crashed run, or an operator's) before
         # launching anything new — resume IS the crash-safety story
         adopted = self._adopt_unfinished(
-            list(seen_nodes.values()), paused_claims, statuses
+            list(seen_nodes.values()), paused_claims, statuses,
+            claims_incomplete=claims_incomplete,
         )
 
         # ---- pass 3: drive at most one rollout this tick
@@ -383,6 +380,7 @@ class PolicyController:
         nodes: List[dict],
         paused_claims: Dict[str, str],
         statuses: Dict[str, dict],
+        claims_incomplete: bool = False,
     ) -> bool:
         """Resume a crashed rollout if one exists on the policies' own
         nodes. True when the tick's rollout slot is consumed (a resume
@@ -397,6 +395,17 @@ class PolicyController:
         record, _ = load_rollout_record(self.kube, nodes)
         if record is None or record.get("complete"):
             return False
+        if claims_incomplete:
+            # a policy's node list failed this tick, so paused_claims may
+            # be missing exactly the paused policy whose brake should
+            # hold this record — resuming now could bypass it. Hold the
+            # slot; next tick retries with complete claims.
+            log.info(
+                "unfinished rollout %s held: a policy's node list "
+                "failed this tick, pause coverage unknown",
+                record.get("id"),
+            )
+            return True
         held_by = sorted({
             paused_claims[m]
             for g in (record.get("groups") or {}).values()
@@ -491,10 +500,20 @@ class PolicyController:
         """Best-effort status publication — a status write failure must
         not stop reconciliation of the remaining policies. No-op patches
         (nothing changed but lastScanTime) are skipped; /report and the
-        metrics carry scan liveness instead."""
+        metrics carry scan liveness instead. The comparison baseline is
+        the LIVE object's status from this tick's list (not an in-memory
+        cache): a deleted-and-recreated policy arrives status-less and
+        gets its first write immediately, and nothing accumulates for
+        policies that no longer exist."""
         name = pol["metadata"]["name"]
-        meaningful = {k: v for k, v in status.items() if k != "lastScanTime"}
-        if self._published.get(name) == meaningful:
+        live = {
+            k: v for k, v in (pol.get("status") or {}).items()
+            if k != "lastScanTime"
+        }
+        meaningful = json.loads(json.dumps(
+            {k: v for k, v in status.items() if k != "lastScanTime"}
+        ))
+        if live == meaningful:
             return
         try:
             self.kube.patch_cluster_custom(
@@ -502,7 +521,11 @@ class PolicyController:
                 name, {"status": status},
                 subresource="status",
             )
-            self._published[name] = json.loads(json.dumps(meaningful))
+            # keep the in-hand object current so the final pass-4 write
+            # after a mid-roll 'Rolling' publication diffs correctly
+            pol["status"] = dict(meaningful, lastScanTime=status.get(
+                "lastScanTime"
+            ))
         except ApiException as e:
             log.warning("status patch for policy %s failed: %s", name, e)
 

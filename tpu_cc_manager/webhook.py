@@ -1,0 +1,285 @@
+"""Admission webhook — scheduler-level CC-mode enforcement.
+
+The node-side enforcement chain (device-node gating, flip taint, pause
+labels) keeps workloads off a node *while it flips*. This webhook closes
+the remaining scheduling gap: nothing so far guarantees that a workload
+which NEEDS confidential compute only lands on nodes whose mode is
+verifiedly ``on``. A pod opts in with the
+``tpu.google.com/requires-cc-mode`` label and the webhook enforces it at
+admission time:
+
+- **Mutating** (``POST /mutate``): inject
+  ``spec.nodeSelector["tpu.google.com/cc.mode.state"] = <required mode>``
+  — keyed on the OBSERVED state label the agents publish (and back with
+  attestation evidence), not the desired label an operator may have just
+  patched. The scheduler then simply cannot place the pod on an
+  unconverged node.
+- **Validating** (``POST /validate``): reject specs that contradict the
+  requirement — an explicit nodeSelector pinning a DIFFERENT mode, a
+  toleration of the flip taint (which would let the pod land mid-flip,
+  exactly when the device gate is locked), or a nonsense required mode.
+
+Both endpoints speak the ``admission.k8s.io/v1`` AdmissionReview wire
+protocol over HTTPS (the API server refuses plaintext webhooks);
+``deployments/manifests/webhook.yaml`` scopes them with an
+``objectSelector`` on the requires-cc label so the webhook can never
+stall pods that don't opt in, and sets ``failurePolicy: Fail`` —
+confidential placement fails closed.
+
+The reference has no admission-time story at all: its CC mode only
+matters to workloads via out-of-band convention (SURVEY.md §2.3 — the
+pause-label choreography assumes a cooperating operator).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.modes import VALID_MODES
+
+log = logging.getLogger("tpu-cc-manager.webhook")
+
+
+def _escape(ptr: str) -> str:
+    """RFC 6901 JSON-pointer token escaping (label keys contain '/')."""
+    return ptr.replace("~", "~0").replace("/", "~1")
+
+
+def required_mode(pod: dict) -> Optional[str]:
+    """The mode the pod's requires-cc label asks for; None when the pod
+    doesn't opt in. Raises ValueError on an invalid value — admission
+    must reject it loudly, not guess."""
+    value = (pod.get("metadata", {}).get("labels") or {}).get(
+        L.REQUIRES_CC_LABEL
+    )
+    if value is None:
+        return None
+    if value not in VALID_MODES:
+        raise ValueError(
+            f"label {L.REQUIRES_CC_LABEL}={value!r}: must be one of "
+            f"{', '.join(VALID_MODES)}"
+        )
+    return value
+
+
+def mutate_pod(pod: dict) -> List[dict]:
+    """JSON-patch ops steering an opted-in pod onto nodes whose observed
+    mode matches. Empty list = no change (not opted in, the selector is
+    already right, or the selector CONTRADICTS the requirement — the
+    mutating phase runs before validation, so rewriting a contradictory
+    pin here would silently admit a spec the validating webhook is
+    documented to reject; leave it for validate_pod to deny)."""
+    mode = required_mode(pod)  # ValueError propagates; caller denies
+    if mode is None:
+        return []
+    selector = (pod.get("spec") or {}).get("nodeSelector")
+    if selector is not None and L.CC_MODE_STATE_LABEL in selector:
+        return []
+    ops: List[dict] = []
+    if selector is None:
+        ops.append({
+            "op": "add", "path": "/spec/nodeSelector", "value": {},
+        })
+    ops.append({
+        "op": "add",
+        "path": f"/spec/nodeSelector/{_escape(L.CC_MODE_STATE_LABEL)}",
+        "value": mode,
+    })
+    return ops
+
+
+def _tolerates_flip_taint(pod: dict) -> bool:
+    """Does any toleration match the flip taint (key-wildcard Exists,
+    key match with Exists, or key+value Equal)? Mirrors the scheduler's
+    toleration-matching rules for the fields the flip taint uses."""
+    for tol in (pod.get("spec") or {}).get("tolerations") or []:
+        effect = tol.get("effect") or ""
+        if effect and effect != L.FLIP_TAINT_EFFECT:
+            continue
+        key = tol.get("key") or ""
+        op = tol.get("operator") or ("Exists" if not key else "Equal")
+        if not key:
+            # empty key with Exists tolerates everything
+            if op == "Exists":
+                return True
+            continue
+        if key != L.FLIP_TAINT_KEY:
+            continue
+        if op == "Exists":
+            return True
+        if tol.get("value") == L.FLIP_TAINT_VALUE:
+            return True
+    return False
+
+
+def validate_pod(pod: dict) -> Tuple[bool, str]:
+    """(allowed, reason). Only opted-in pods are ever denied."""
+    try:
+        mode = required_mode(pod)
+    except ValueError as e:
+        return False, str(e)
+    if mode is None:
+        return True, ""
+    selector = (pod.get("spec") or {}).get("nodeSelector") or {}
+    pinned = selector.get(L.CC_MODE_STATE_LABEL)
+    if pinned is not None and pinned != mode:
+        return False, (
+            f"pod requires cc mode {mode!r} but its nodeSelector pins "
+            f"{L.CC_MODE_STATE_LABEL}={pinned!r}"
+        )
+    if _tolerates_flip_taint(pod):
+        return False, (
+            f"pod requires cc mode {mode!r} but tolerates the flip "
+            f"taint {L.FLIP_TAINT_KEY}={L.FLIP_TAINT_VALUE}:"
+            f"{L.FLIP_TAINT_EFFECT}; it could be scheduled onto a node "
+            "mid-flip, when the device is gated"
+        )
+    return True, ""
+
+
+def review_response(review: dict, kind: str) -> dict:
+    """Process one AdmissionReview request dict; returns the response
+    AdmissionReview. ``kind`` is 'mutate' or 'validate'. Malformed
+    reviews raise ValueError (the server answers 400)."""
+    req = review.get("request")
+    if not isinstance(req, dict) or "uid" not in req:
+        raise ValueError("not an AdmissionReview: request.uid missing")
+    pod = req.get("object") or {}
+    resp = {"uid": req["uid"], "allowed": True}
+    try:
+        required_mode(pod)
+    except ValueError as e:
+        # invalid requires-cc value: deny on BOTH endpoints with the
+        # same 400 (a mutate that silently ignored it would admit a pod
+        # whose confidential requirement is unenforceable)
+        resp["allowed"] = False
+        resp["status"] = {"message": str(e), "code": 400}
+    else:
+        if kind == "mutate":
+            ops = mutate_pod(pod)
+            if ops:
+                resp["patchType"] = "JSONPatch"
+                resp["patch"] = base64.b64encode(
+                    json.dumps(ops).encode()
+                ).decode()
+        else:
+            allowed, reason = validate_pod(pod)
+            resp["allowed"] = allowed
+            if not allowed:
+                resp["status"] = {"message": reason, "code": 403}
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
+
+
+class AdmissionServer:
+    """HTTPS server for the two admission endpoints + /healthz.
+    TLS is mandatory in production (the API server refuses plaintext
+    webhooks); tests may pass ``tls=False`` to probe the handler."""
+
+    def __init__(
+        self,
+        port: int = 8443,
+        *,
+        cert_file: Optional[str] = None,
+        key_file: Optional[str] = None,
+        tls: bool = True,
+    ):
+        if tls and not cert_file:
+            raise ValueError(
+                "TLS requires --cert/--key (the Kubernetes API server "
+                "refuses plaintext webhooks)"
+            )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # pragma: no cover
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, b"ok", "text/plain")
+                return self._send(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                kind = self.path.strip("/")
+                if kind not in ("mutate", "validate"):
+                    return self._send(404, b"not found", "text/plain")
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    review = json.loads(self.rfile.read(length))
+                    out = review_response(review, kind)
+                except (ValueError, json.JSONDecodeError) as e:
+                    outer.rejected_malformed += 1
+                    return self._send(
+                        400, json.dumps({"error": str(e)}).encode()
+                    )
+                outer.reviews += 1
+                return self._send(200, json.dumps(out).encode())
+
+        server_cls = type(
+            "WebhookHTTPServer", (ThreadingHTTPServer,),
+            {"request_queue_size": 64},
+        )
+        self.httpd = server_cls(("0.0.0.0", port), Handler)
+        if tls:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file or cert_file)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
+        self.httpd.daemon_threads = True
+        self.reviews = 0
+        self.rejected_malformed = 0
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "AdmissionServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="webhook-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> int:
+        log.info("admission webhook serving on :%d", self.port)
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:  # pragma: no cover - operator stop
+            pass
+        return 0
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "AdmissionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
