@@ -185,10 +185,12 @@ def _wait_pool(store, names, target, timeout=240.0):
 
 
 def _run_pool_convergence(names, readiness_dir, prefix, *,
-                          slice_of=None, drained=False, dwell_s=0.5):
+                          slice_of=None, drained=False, dwell_s=0.5,
+                          flip=None):
     """Shared convergence harness for the dominator scenarios: build a
-    pool, run one real agent per node, flip every desired label to "on",
-    and time convergence.
+    pool, run one real agent per node, flip every desired label to "on"
+    (or let ``flip(store, server, names)`` initiate the change — the
+    policy scenario drives it declaratively), and time convergence.
 
     - ``drained``: every node deploys a device-plugin component whose
       pod takes ``dwell_s`` to terminate after its pause label flips, so
@@ -296,8 +298,11 @@ def _run_pool_convergence(names, readiness_dir, prefix, *,
         if _wait_pool(store, names, "off") is None:
             print(f"FATAL: {prefix} bench never initialized", file=sys.stderr)
             sys.exit(1)
-        for name in names:
-            store.set_node_labels(name, {L.CC_MODE_LABEL: "on"})
+        if flip is not None:
+            flip(store, server, names)
+        else:
+            for name in names:
+                store.set_node_labels(name, {L.CC_MODE_LABEL: "on"})
         convergence = _wait_pool(store, names, "on")
         if convergence is None:
             print(f"FATAL: {prefix} pool never converged", file=sys.stderr)
@@ -352,6 +357,40 @@ def run_sliced_drained_bench(n_slices, hosts_per_slice, readiness_dir,
         slice_of=lambda n: n.rsplit("-", 1)[0],
         drained=True, dwell_s=dwell_s,
     )
+
+
+def run_policy_bench(n_nodes, readiness_dir):
+    """Declarative-path scenario (round 3): a TPUCCPolicy object is the
+    ONLY input — the policy controller notices it, drives a rollout
+    (evidence verification on), and the agents converge. Times the whole
+    chain: CR -> controller scan -> rollout window -> agent reconcile ->
+    evidence-verified convergence."""
+    from tpu_cc_manager.policy import PolicyController
+
+    names = [f"po-{i:03d}" for i in range(n_nodes)]
+
+    def flip(store, server, names):
+        store.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+            "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+            "kind": L.POLICY_KIND,
+            "metadata": {"name": "bench-policy"},
+            "spec": {
+                "mode": "on",
+                "nodeSelector": L.TPU_ACCELERATOR_LABEL,
+                # window as wide as the pool: the headline number flips
+                # everything at once, so the declarative path gets the
+                # same parallelism — the delta IS the machinery cost
+                "strategy": {"maxUnavailable": len(names),
+                             "groupTimeoutSeconds": 120},
+            },
+        })
+        kube = HttpKubeClient(
+            KubeConfig("127.0.0.1", server.port, use_tls=False)
+        )
+        ctrl = PolicyController(kube, poll_s=0.05)
+        threading.Thread(target=ctrl.scan_once, daemon=True).start()
+
+    return _run_pool_convergence(names, readiness_dir, "po", flip=flip)
 
 
 def bench_real_chip(state_dir: str):
@@ -431,6 +470,11 @@ def main():
         )
         result["extras"]["sliced_topology"] = (
             f"{args.slices}x{args.hosts_per_slice}"
+        )
+        # the declarative chain end to end (round 3): TPUCCPolicy ->
+        # controller -> rollout -> agents -> evidence-backed convergence
+        result["extras"]["policy_pool_convergence_s"] = run_policy_bench(
+            args.nodes, d
         )
     print(json.dumps(result))
 
