@@ -241,6 +241,10 @@ def test_admission_server_over_https(tls_pki):
         health = urllib.request.urlopen(f"{base}/healthz", context=ctx)
         assert health.status == 200
         assert srv.reviews == 2
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", context=ctx
+        ).read().decode()
+        assert "tpu_cc_webhook_reviews_total 2" in metrics
 
         # malformed review -> 400, counted
         with pytest.raises(urllib.error.HTTPError) as ei:

@@ -218,6 +218,21 @@ class AdmissionServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     return self._send(200, b"ok", "text/plain")
+                if self.path == "/metrics":
+                    body = (
+                        "# HELP tpu_cc_webhook_reviews_total Admission "
+                        "reviews served\n"
+                        "# TYPE tpu_cc_webhook_reviews_total counter\n"
+                        f"tpu_cc_webhook_reviews_total {outer.reviews}\n"
+                        "# HELP tpu_cc_webhook_malformed_total Malformed "
+                        "review bodies rejected with 400\n"
+                        "# TYPE tpu_cc_webhook_malformed_total counter\n"
+                        f"tpu_cc_webhook_malformed_total "
+                        f"{outer.rejected_malformed}\n"
+                    ).encode()
+                    return self._send(
+                        200, body, "text/plain; version=0.0.4"
+                    )
                 return self._send(404, b"not found", "text/plain")
 
             def do_POST(self):
