@@ -264,6 +264,94 @@ def main():
                 log("PASS device node gated 0600 for cc=on")
             else:
                 failures.append(f"device perms {oct(perms)} != 0o600")
+
+            # 7. declarative path: a TPUCCPolicy object is the ONLY
+            # input; the real policy-controller subprocess (the
+            # policy-controller.yaml deployment unit) must notice it,
+            # drive a rollout, and the agent converges
+            store.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+                "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+                "kind": L.POLICY_KIND,
+                "metadata": {"name": "smoke-policy"},
+                "spec": {
+                    "mode": "devtools",
+                    "nodeSelector": L.TPU_ACCELERATOR_LABEL,
+                    "strategy": {"groupTimeoutSeconds": 60},
+                },
+            })
+            pc_log = open(os.path.join(scratch, "policy.log"), "w")
+            pc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_cc_manager",
+                 "policy-controller", "--interval", "1", "--port", "0"],
+                env=env, stdout=pc_log, stderr=subprocess.STDOUT,
+                cwd=REPO,
+            )
+            try:
+                if wait_state(store, "devtools"):
+                    log("PASS policy-controller: TPUCCPolicy mode="
+                        "devtools -> node converged declaratively")
+                else:
+                    failures.append("policy-driven convergence")
+                deadline = time.monotonic() + 20
+                phase = None
+                while time.monotonic() < deadline:
+                    phase = store.get_cluster_custom(
+                        L.POLICY_GROUP, L.POLICY_VERSION,
+                        L.POLICY_PLURAL, "smoke-policy",
+                    ).get("status", {}).get("phase")
+                    if phase == "Converged":
+                        break
+                    time.sleep(0.2)
+                if phase == "Converged":
+                    log("PASS TPUCCPolicy status published: "
+                        "phase=Converged")
+                else:
+                    failures.append(f"policy status phase={phase}")
+            finally:
+                pc.terminate()
+                try:
+                    pc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pc.kill()
+                pc_log.close()
+
+            # 8. admission webhook on the wire: a confidential pod is
+            # steered onto the observed mode the pool just converged to
+            import base64 as _b64
+
+            from tpu_cc_manager.webhook import AdmissionServer
+
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": "smoke-1", "object": {
+                    "metadata": {"name": "train", "labels": {
+                        L.REQUIRES_CC_LABEL: "devtools"}},
+                    "spec": {},
+                }},
+            }
+            with AdmissionServer(0, tls=False) as wh:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{wh.port}/mutate",
+                    data=json.dumps(review).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                resp = json.loads(urllib.request.urlopen(req, timeout=5).read())
+                ops = json.loads(_b64.b64decode(resp["response"]["patch"]))
+                injected = {
+                    op["path"].split("/spec/nodeSelector/", 1)[1]
+                    .replace("~1", "/").replace("~0", "~"): op["value"]
+                    for op in ops if op["path"] != "/spec/nodeSelector"
+                }
+            node_state = state_label(store)
+            if injected.get(L.CC_MODE_STATE_LABEL) == node_state == "devtools":
+                log("PASS webhook steers requires-cc pod onto "
+                    f"{L.CC_MODE_STATE_LABEL}={node_state}")
+            else:
+                failures.append(
+                    f"webhook selector {injected} vs node {node_state}"
+                )
         finally:
             proc.terminate()
             try:

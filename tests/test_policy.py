@@ -707,6 +707,35 @@ def test_full_stack_policy_to_scheduler(tmp_path):
             s.stop()
 
 
+def test_missing_crd_is_a_deployment_race_not_a_crash():
+    """The controller Deployment may win the apply race against the
+    CRD: a 404 on the policy list must keep the controller healthy and
+    retrying, not crash-loop it; once the CRD (and a policy) appear,
+    reconciliation starts."""
+    crd = {"installed": False}
+
+    class RacingKube(FakeKube):
+        def list_cluster_custom(self, *a, **k):
+            if not crd["installed"]:
+                raise ApiException(404, "the server could not find the "
+                                        "requested resource")
+            return super().list_cluster_custom(*a, **k)
+
+    kube = RacingKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    c = controller(kube)
+    for _ in range(3):
+        report = c.scan_once()
+        assert report == {
+            "policies": {}, "claimed_nodes": 0, "scanned": 0,
+            "crd_missing": True,
+        }
+    assert c.healthy and c.consecutive_errors == 0
+    crd["installed"] = True
+    kube.add_custom(G, P, make_policy("p"))
+    assert c.scan_once()["policies"]["p"]["phase"] == "Converged"
+
+
 def test_scan_failure_degrades_healthz():
     class BrokenKube(FakeKube):
         def list_cluster_custom(self, *a, **k):

@@ -196,6 +196,7 @@ class PolicyController:
         self.metrics = PolicyMetrics()
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
+        self._warned_no_crd = False
         self._stop = threading.Event()
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
@@ -221,9 +222,29 @@ class PolicyController:
         return report
 
     def _scan(self) -> dict:
-        policies = self.kube.list_cluster_custom(
-            L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL
-        )
+        try:
+            policies = self.kube.list_cluster_custom(
+                L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL
+            )
+            self._warned_no_crd = False
+        except ApiException as e:
+            if e.status == 404:
+                # CRD not installed (yet): a normal deployment race —
+                # the controller Deployment may win the apply race
+                # against the CRD. Not an error: stay healthy, report
+                # empty, retry next tick (crash-looping here would just
+                # thrash the Deployment until the CRD lands).
+                if not self._warned_no_crd:
+                    self._warned_no_crd = True
+                    log.warning(
+                        "TPUCCPolicy CRD not found (%s); will keep "
+                        "retrying every %.0fs", e, self.interval_s,
+                    )
+                return {
+                    "policies": {}, "claimed_nodes": 0, "scanned": 0,
+                    "crd_missing": True,
+                }
+            raise
         policies.sort(key=lambda p: p["metadata"]["name"])
         statuses: Dict[str, dict] = {}
         claims: Dict[str, str] = {}  # node -> owning policy (name order)
