@@ -407,9 +407,10 @@ def test_controller_adopts_unfinished_rollout_record():
     ))
     agents = _ReactiveAgents(kube, ["n0", "n1"])
     agents.start()
-    c = controller(kube)
+    c = controller(kube, adopt_after_s=0)
     try:
-        c.scan_once()  # tick 1: adopts + finishes the crashed rollout
+        c.scan_once()  # tick 1: observes the (static) heartbeat
+        c.scan_once()  # tick 2: adopts + finishes the crashed rollout
         rec = json.loads(
             kube.get_node("n0")["metadata"]["annotations"][
                 L.ROLLOUT_ANNOTATION
@@ -440,8 +441,9 @@ def test_paused_policy_holds_adoption_of_unfinished_rollout():
         "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
     )
     kube.add_custom(G, P, make_policy("p", paused=True))
-    c = controller(kube)
-    st = c.scan_once()["policies"]["p"]
+    c = controller(kube, adopt_after_s=0)
+    c.scan_once()  # tick 1 only observes the heartbeat
+    st = c.scan_once()["policies"]["p"]  # tick 2: staleness ripened
     assert st["phase"] == "Paused"
     assert "held by pause" in st["message"]
     # nothing resumed: the record is still incomplete, desired untouched
@@ -530,6 +532,172 @@ def test_steady_state_emits_no_status_patches():
     assert patches == ["p", "p"]
 
 
+def test_moving_heartbeat_is_never_adopted_static_one_is():
+    """Liveness is judged by OBSERVATION on the controller's own clock
+    (a wall-clock comparison would break under cross-host clock skew):
+    a record whose heartbeat keeps changing is someone else's live
+    rollout and must be left alone; once the heartbeat stops moving for
+    the observation window, the record is abandoned and gets adopted."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    record = {
+        "id": "live01", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        # deliberately ANCIENT wall-clock stamp: a skewed writer's clock
+        # must not matter — only whether the value moves
+        "heartbeat": time.time() - 7200,
+        "groups": {"node/n0": {"nodes": ["n0"], "outcome": "in_flight"}},
+    }
+
+    def write(rec):
+        kube.set_node_annotations(
+            "n0", {L.ROLLOUT_ANNOTATION: json.dumps(rec)}
+        )
+
+    write(record)
+    kube.add_custom(G, P, make_policy("p"))
+    c = controller(kube, adopt_after_s=0.2)
+    st = c.scan_once()["policies"]["p"]  # first sighting: observe only
+    assert st["phase"] == "Pending"  # not Degraded: nothing went wrong
+    # the (skewed-clock) owner stamps again: value moved -> still live
+    record["heartbeat"] += 5
+    write(record)
+    time.sleep(0.25)
+    c.scan_once()
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is False  # untouched: owner still driving
+    # owner dies: value sits still past the window -> adopted
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    try:
+        c.scan_once()          # re-observes the now-static value
+        time.sleep(0.25)
+        c.scan_once()          # ripened: adopts and finishes
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is True
+
+
+def test_rollout_run_stamps_heartbeat_and_owner():
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", poll_s=0.02,
+                         group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.ok
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert isinstance(rec["heartbeat"], float)
+    assert rec["owner"]
+
+
+def test_persist_fences_foreign_owner():
+    """Fencing: once another process claims the record, this writer's
+    very next persist must raise instead of clobbering the adopter's
+    state — the revived-original-owner half of the takeover story."""
+    from tpu_cc_manager.rollout import OwnershipLostError, Rollout
+
+    kube = FakeKube()
+    kube.add_node(_node("n0"))
+    taken = {
+        "id": "q1", "complete": False, "owner": "adopter-b",
+        "groups": {},
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(taken)}
+    )
+    r = Rollout(kube, "on")
+    r._record = {"id": "q1", "complete": False, "groups": {}}
+    r._record_node = "n0"
+    with pytest.raises(OwnershipLostError, match="taken over"):
+        r._persist()
+    # the adopter's record was NOT overwritten
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["owner"] == "adopter-b"
+
+
+def test_revived_owner_stops_after_adoption():
+    """End-to-end takeover: an adopter resumes a stale record (seizing
+    ownership); when the original owner's process comes back and tries
+    to persist, it stops with OwnershipLostError rather than judging
+    groups alongside the adopter."""
+    from tpu_cc_manager.rollout import OwnershipLostError, Rollout
+
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    crashed = {
+        "id": "q2", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "owner": "original-a",
+        "groups": {"node/n0": {"nodes": ["n0"], "outcome": "in_flight"}},
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(crashed)}
+    )
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    try:
+        assert Rollout.resume(kube, poll_s=0.02,
+                              group_timeout_s=10).run().ok
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    # the original owner revives with its private (stale) copy
+    orig = Rollout(kube, "on")
+    orig._owner = "original-a"
+    orig._record = dict(crashed)
+    orig._record_node = "n0"
+    with pytest.raises(OwnershipLostError):
+        orig._persist()
+
+
+def test_manual_resume_outranks_heartbeat():
+    """`rollout --resume` is a human asserting the old run is dead —
+    it must work even against a fresh heartbeat (e.g. a wedged process
+    still stamping), unlike automatic adoption."""
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    record = {
+        "id": "wedge1", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "heartbeat": time.time(),
+        "groups": {"node/n0": {"nodes": ["n0"], "outcome": "in_flight"}},
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.02,
+                                group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.ok
+
+
 def test_claims_incomplete_holds_adoption_too():
     """When a policy's node list fails, pause coverage is unknown —
     adoption of an unfinished rollout must hold along with new rollouts,
@@ -559,14 +727,16 @@ def test_claims_incomplete_holds_adoption_too():
     kube.add_custom(G, P, make_policy("aaa", paused=True,
                                       selector="pool=paused"))
     kube.add_custom(G, P, make_policy("zzz"))
-    c = controller(kube)
-    c.scan_once()
+    c = controller(kube, adopt_after_s=0)
+    c.scan_once()  # observe heartbeat
+    c.scan_once()  # ripened: the claims_incomplete hold is now the gate
     rec = json.loads(
         kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
     )
     assert rec["complete"] is False  # nothing resumed blind
     # once the list recovers, the pause brake itself holds the record
     fail["on"] = False
+    c.scan_once()
     c.scan_once()
     rec = json.loads(
         kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]

@@ -64,7 +64,9 @@ from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.modes import InvalidModeError, parse_mode
 from tpu_cc_manager.obs import Counter, Gauge, Histogram, RouteServer
-from tpu_cc_manager.rollout import Rollout, RolloutError, load_rollout_record
+from tpu_cc_manager.rollout import (
+    HEARTBEAT_STALE_S, Rollout, RolloutError, load_rollout_record,
+)
 
 log = logging.getLogger("tpu-cc-manager.policy")
 
@@ -182,6 +184,7 @@ class PolicyController:
         poll_s: float = 0.5,
         max_consecutive_errors: int = 10,
         verify_evidence: bool = True,
+        adopt_after_s: float = HEARTBEAT_STALE_S,
     ):
         if interval_s <= 0:
             raise ValueError(
@@ -197,6 +200,13 @@ class PolicyController:
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
         self._warned_no_crd = False
+        self.adopt_after_s = adopt_after_s
+        #: heartbeat observation per record id: (last value seen,
+        #: monotonic time it was FIRST seen unchanged). Staleness is
+        #: judged on this controller's own clock by watching whether the
+        #: value moves — never by comparing the stamp (another host's
+        #: wall clock) against local time.
+        self._hb_seen: Dict[str, Tuple[object, float]] = {}
         self._stop = threading.Event()
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
@@ -415,7 +425,20 @@ class PolicyController:
         the operator's to resume, not ours."""
         record, _ = load_rollout_record(self.kube, nodes)
         if record is None or record.get("complete"):
+            self._hb_seen.clear()  # no unfinished record: reset watch
             return False
+        if not self._record_observed_stale(record):
+            # the heartbeat is still moving (or we haven't watched it
+            # long enough): a rollout process — a human-run `rollout`,
+            # or another controller replica — may still be driving it.
+            # Adopting now would mean two writers judging the same
+            # groups. Hold the slot; once the heartbeat stops moving for
+            # adopt_after_s on OUR clock, the next tick adopts for real.
+            log.info(
+                "unfinished rollout %s: heartbeat still under "
+                "observation; waiting for its owner", record.get("id"),
+            )
+            return True
         if claims_incomplete:
             # a policy's node list failed this tick, so paused_claims may
             # be missing exactly the paused policy whose brake should
@@ -453,6 +476,7 @@ class PolicyController:
             "adopting unfinished rollout %s (mode %r)",
             record.get("id"), record.get("mode"),
         )
+        self._hb_seen.clear()  # adopting: the old observation is moot
         try:
             report = Rollout.resume(
                 self.kube, poll_s=self.poll_s,
@@ -465,6 +489,23 @@ class PolicyController:
             log.warning("rollout adoption failed: %s", e)
             self.metrics.rollouts.inc("resume_error")
         return True
+
+    def _record_observed_stale(self, record: dict) -> bool:
+        """Has this record's heartbeat sat UNCHANGED for adopt_after_s
+        of this controller's own monotonic time? First sighting starts
+        the watch (returns False); a moving heartbeat resets it. Records
+        without a heartbeat (a crash before the first stamp, or a
+        pre-heartbeat writer) follow the same path: their value is a
+        constant None, so they ripen after one full observation
+        window."""
+        rid = str(record.get("id"))
+        hb = record.get("heartbeat")
+        now = time.monotonic()
+        prev = self._hb_seen.get(rid)
+        if prev is None or prev[0] != hb:
+            self._hb_seen[rid] = (hb, now)
+            return False
+        return now - prev[1] >= self.adopt_after_s
 
     def _drive_rollout(self, pol: dict, spec: dict, st: dict) -> str:
         """Run one bounded rollout for this policy; mutate its status

@@ -38,6 +38,17 @@ Semantics:
   (label patches are idempotent), and produces ONE coherent final
   report with every group counted exactly once. A second concurrent
   rollout is refused while an unfinished record exists.
+- **Liveness heartbeat + ownership fencing.** A running rollout stamps
+  the record every few seconds; automatic adopters (the policy
+  controller) only resume records whose heartbeat they have OBSERVED
+  sitting unchanged for a full window on their own clock (wall-clock
+  comparison would break under cross-host skew) — a live human-run
+  rollout is never hijacked. Adoption seizes the record's ``owner``
+  field, and every subsequent persist by any writer fences against it:
+  a revived original owner stops with :class:`OwnershipLostError` at
+  its next persist instead of clobbering the adopter. Manual
+  ``--resume`` deliberately ignores liveness: the human asserting the
+  old run is dead outranks it.
 """
 
 from __future__ import annotations
@@ -65,6 +76,28 @@ class RolloutError(Exception):
 _BUDGET_CONSUMING = ("failed", "timeout")
 #: Group outcomes that are final (never re-attempted on resume).
 _TERMINAL = ("skipped", "succeeded", "failed", "timeout", "not_attempted")
+
+#: How often a LIVE rollout stamps record["heartbeat"]. An unfinished
+#: record whose heartbeat keeps CHANGING belongs to a running
+#: operator/controller; automatic adoption (policy controller) must
+#: leave it alone. Staleness is judged by OBSERVATION — the adopter
+#: watches whether the value changes across its own scans on its own
+#: monotonic clock — never by comparing the stamp against local
+#: wall-clock time (the stamping process may run on an operator
+#: workstation whose clock is skewed vs the controller pod). Manual
+#: ``rollout --resume`` ignores liveness entirely: the human asserting
+#: the old run is dead outranks it.
+HEARTBEAT_PERIOD_S = 5.0
+#: How long an adopter must observe an UNCHANGED heartbeat before the
+#: record counts as abandoned.
+HEARTBEAT_STALE_S = 30.0
+
+
+class OwnershipLostError(RolloutError):
+    """Another process took over this rollout's durable record (the
+    fencing check in ``_persist`` saw a foreign owner). This process
+    must stop driving immediately — patching labels or judging groups
+    past this point would mean two writers on the same rollout."""
 
 
 def load_rollout_record(kube: KubeClient, nodes: Sequence[dict]
@@ -192,6 +225,15 @@ class Rollout:
         self._record: Optional[dict] = None
         self._record_node: Optional[str] = None
         self._resume_from: Optional[Tuple[dict, str]] = None
+        self._last_heartbeat = 0.0
+        import uuid as _uuid
+
+        #: fencing identity: stamped into the record; _persist refuses
+        #: to overwrite a record another owner has claimed
+        self._owner = _uuid.uuid4().hex[:12]
+        #: set by resume(): the first persist claims the record from its
+        #: previous (presumed-dead) owner instead of fencing against it
+        self._force_claim = False
 
     @classmethod
     def resume(
@@ -229,14 +271,34 @@ class Rollout:
             dry_run=dry_run, verify_evidence=verify_evidence,
         )
         r._resume_from = (record, record_node)
+        r._force_claim = True
         return r
 
     # ---------------------------------------------------------- durability
     def _persist(self) -> None:
-        """Write the record annotation; best-effort (a persist failure
-        degrades resume fidelity, it must not fail the live rollout)."""
+        """Write the record annotation; best-effort against transport
+        failures (a persist failure degrades resume fidelity, it must
+        not fail the live rollout). Every persist stamps the liveness
+        heartbeat, and FENCES first: the on-cluster record is re-read
+        and, if another owner has claimed it (an adopter took over a
+        rollout whose heartbeat looked stale — e.g. this process was
+        stopped for a while), raises OwnershipLostError instead of
+        clobbering the adopter's state. The read-check-write is not
+        atomic, but it shrinks the two-writer window from 'forever'
+        (blind overwrite) to one API round trip, and the loser stops at
+        its very next persist."""
         if self._record is None or self._record_node is None:
             return
+        if self._force_claim:
+            # resume: deliberately seize the record from its previous
+            # (presumed-dead) owner; every LATER persist fences normally,
+            # protecting this adopter from the next one
+            self._force_claim = False
+        else:
+            self._fence()
+        self._record["owner"] = self._owner
+        self._record["heartbeat"] = time.time()
+        self._last_heartbeat = time.monotonic()
         try:
             payload = json.dumps(
                 self._record, sort_keys=True, separators=(",", ":")
@@ -249,6 +311,26 @@ class Rollout:
                 "rollout record persist failed (resume fidelity "
                 "degraded): %s", e,
             )
+
+    def _fence(self) -> None:
+        try:
+            raw = (self.kube.get_node(self._record_node)["metadata"]
+                   .get("annotations") or {}).get(L.ROLLOUT_ANNOTATION)
+            if raw:
+                current = json.loads(raw)
+                if (isinstance(current, dict)
+                        and current.get("id") == self._record.get("id")
+                        and current.get("owner")
+                        not in (None, self._owner)):
+                    raise OwnershipLostError(
+                        f"rollout record {self._record.get('id')!r} was "
+                        f"taken over by owner {current.get('owner')!r}; "
+                        "stopping this writer"
+                    )
+        except OwnershipLostError:
+            raise
+        except (ApiException, ValueError):
+            pass  # can't read back: proceed best-effort, as before
 
     def _record_group(self, gname: str, nodes: List[str], outcome: str,
                       detail: str = "") -> None:
@@ -367,6 +449,10 @@ class Rollout:
                 # intent was persisted before the patch: relaunching is
                 # an idempotent re-patch + fresh judge window
                 pending = deque(list(relaunch) + list(pending))
+            if not self.dry_run:
+                # claim the record NOW: the stamped heartbeat tells other
+                # would-be adopters a live process is driving it again
+                self._persist()
             log.info(
                 "resuming rollout %s to %r: %d judged, %d to relaunch/"
                 "drain, %d pending, remaining budget %d",
@@ -556,6 +642,14 @@ class Rollout:
                     self._record_group(gname, members, "not_attempted",
                                        "rollout aborted")
                 pending.clear()
+            if (
+                self._record is not None
+                and time.monotonic() - self._last_heartbeat
+                >= HEARTBEAT_PERIOD_S
+            ):
+                # no state transition lately: refresh liveness so a slow
+                # group doesn't make this rollout look abandoned
+                self._persist()
             if in_flight:
                 time.sleep(self.poll_s)
 
