@@ -633,6 +633,37 @@ def test_persist_fences_foreign_owner():
     assert rec["owner"] == "adopter-b"
 
 
+def test_persist_fences_foreign_unfinished_record_id():
+    """A wedged writer must also stop when the anchor carries a
+    DIFFERENT unfinished record (its own rollout was adopted, finished,
+    and a newer one launched) — clobbering the newer record would mask
+    it from every resume/concurrency guard. A COMPLETE foreign record
+    is history and may be overwritten."""
+    from tpu_cc_manager.rollout import OwnershipLostError, Rollout
+
+    kube = FakeKube()
+    kube.add_node(_node("n0"))
+    newer = {"id": "q9", "complete": False, "owner": "c", "groups": {}}
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(newer)}
+    )
+    r = Rollout(kube, "on")
+    r._record = {"id": "q2-old", "complete": False, "groups": {}}
+    r._record_node = "n0"
+    with pytest.raises(OwnershipLostError, match="stale"):
+        r._persist()
+    # ...but overwriting a COMPLETE old record is the normal new-rollout
+    # path
+    kube.set_node_annotations("n0", {L.ROLLOUT_ANNOTATION: json.dumps(
+        {"id": "done", "complete": True, "groups": {}}
+    )})
+    r._persist()  # no raise
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["id"] == "q2-old"
+
+
 def test_revived_owner_stops_after_adoption():
     """End-to-end takeover: an adopter resumes a stale record (seizing
     ownership); when the original owner's process comes back and tries

@@ -18,7 +18,10 @@ Modes of operation (parity with both reference CLIs):
   declare (see tpu_cc_manager.policy);
 - ``webhook``: admission webhook steering requires-cc pods onto
   verified nodes and rejecting contradictory specs (see
-  tpu_cc_manager.webhook).
+  tpu_cc_manager.webhook);
+- ``doctor``: node-local trust-surface diagnostic — statefile, gate,
+  holders, labels, evidence cross-checked in one JSON report (see
+  tpu_cc_manager.doctor).
 """
 
 from __future__ import annotations
@@ -157,6 +160,11 @@ def main(argv=None) -> int:
         except (ValueError, OSError) as e:
             log.error("policy-controller refused: %s", e)
             return 1
+
+    if args.command == "doctor":
+        from tpu_cc_manager.doctor import main_from_args
+
+        return main_from_args(cfg, args)
 
     if args.command == "webhook":
         from tpu_cc_manager.webhook import AdmissionServer

@@ -261,6 +261,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--key", default=os.environ.get("WEBHOOK_KEY"),
         help="TLS server key (env WEBHOOK_KEY; defaults to --cert)",
     )
+    doc = sub.add_parser(
+        "doctor",
+        help="cross-check every node-local trust surface (statefile, "
+             "device gate, holders, labels, evidence) and print a JSON "
+             "report; exits non-zero iff a check fails",
+    )
+    doc.add_argument(
+        "--offline", action="store_true",
+        help="skip the cluster checks (no API server access attempted)",
+    )
     return p
 
 
@@ -270,7 +280,7 @@ def parse_config(argv: Optional[List[str]] = None):
     args = build_parser().parse_args(argv)
     if not args.node_name and args.command not in (
         "get-cc-mode", "probe-devices", "rollout", "fleet-controller",
-        "policy-controller", "webhook",
+        "policy-controller", "webhook", "doctor",
     ):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"

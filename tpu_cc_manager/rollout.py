@@ -318,15 +318,29 @@ class Rollout:
                    .get("annotations") or {}).get(L.ROLLOUT_ANNOTATION)
             if raw:
                 current = json.loads(raw)
-                if (isinstance(current, dict)
-                        and current.get("id") == self._record.get("id")
-                        and current.get("owner")
-                        not in (None, self._owner)):
-                    raise OwnershipLostError(
-                        f"rollout record {self._record.get('id')!r} was "
-                        f"taken over by owner {current.get('owner')!r}; "
-                        "stopping this writer"
-                    )
+                if isinstance(current, dict):
+                    if current.get("id") != self._record.get("id"):
+                        # a DIFFERENT record sits on the anchor. A
+                        # complete one is history (a finished earlier
+                        # rollout) and may be overwritten; an unfinished
+                        # one means a newer rollout superseded this
+                        # writer while it was wedged — clobbering it
+                        # would mask the live record from every
+                        # resume/concurrency guard
+                        if not current.get("complete"):
+                            raise OwnershipLostError(
+                                f"anchor now carries a different "
+                                f"unfinished rollout "
+                                f"{current.get('id')!r}; this writer "
+                                f"({self._record.get('id')!r}) is stale"
+                            )
+                    elif current.get("owner") not in (None, self._owner):
+                        raise OwnershipLostError(
+                            f"rollout record {self._record.get('id')!r} "
+                            f"was taken over by owner "
+                            f"{current.get('owner')!r}; stopping this "
+                            "writer"
+                        )
         except OwnershipLostError:
             raise
         except (ApiException, ValueError):
