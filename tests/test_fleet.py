@@ -158,3 +158,64 @@ def test_scan_loop_survives_non_api_exceptions():
     assert ctrl.consecutive_errors == 2
     assert not ctrl.healthy
     assert ctrl.metrics.scans_total.value("error") == 2
+
+
+def test_doctor_aggregation_and_policy_summaries():
+    """/report is the single operator pane: published doctor verdicts
+    are aggregated (malformed ones count as failing) and TPUCCPolicy
+    statuses are summarized; both disappear gracefully when absent."""
+    kube = FakeKube()
+    kube.add_node(_node("n-ok", desired="on", state="on"))
+    kube.add_node(_node("n-bad", desired="on", state="on"))
+    kube.add_node(_node("n-silent", desired="on", state="on"))
+    kube.add_node(_node("n-garbled", desired="on", state="on"))
+    kube.set_node_annotations("n-ok", {L.DOCTOR_ANNOTATION: json.dumps(
+        {"ok": True, "fail": [], "warn": [], "at": "2026-07-30T00:00:00Z"}
+    )})
+    kube.set_node_annotations("n-bad", {L.DOCTOR_ANNOTATION: json.dumps(
+        {"ok": False, "fail": ["state-label"], "warn": [],
+         "at": "2026-07-30T00:00:00Z"}
+    )})
+    kube.set_node_annotations("n-garbled", {L.DOCTOR_ANNOTATION: "{nope"})
+    kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+        "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+        "kind": L.POLICY_KIND,
+        "metadata": {"name": "prod"},
+        "spec": {"mode": "on",
+                 "nodeSelector": L.TPU_ACCELERATOR_LABEL},
+        "status": {"phase": "Converged", "nodes": 4, "converged": 4,
+                   "message": "all good"},
+    })
+    ctrl = FleetController(kube, port=0)
+    report = ctrl.scan_once()
+    doctor = report["doctor"]
+    assert doctor["reported"] == 3
+    assert [d["node"] for d in doctor["failing"]] == ["n-bad", "n-garbled"]
+    assert doctor["failing"][0]["fail"] == ["state-label"]
+    assert report["policies"] == [{
+        "name": "prod", "mode": "on", "phase": "Converged",
+        "nodes": 4, "converged": 4, "message": "all good",
+    }]
+    assert any(
+        "tpu_cc_fleet_doctor_failing_nodes 2" in line
+        for line in ctrl.metrics.render().splitlines()
+    )
+
+
+def test_doctor_publish_round_trip(tmp_path, monkeypatch):
+    """doctor --publish -> fleet aggregation, end to end through the
+    annotation channel."""
+    from test_doctor import _backend, _flip
+
+    from tpu_cc_manager.doctor import publish_report, run_doctor
+
+    backend = _backend(tmp_path, monkeypatch)
+    _flip(backend, "on")
+    kube = FakeKube()
+    kube.add_node(_node("pub-node", desired="on", state="off"))  # lying
+    report = run_doctor(kube=kube, node_name="pub-node", backend=backend)
+    assert report["ok"] is False  # state label contradicts devices
+    assert publish_report(kube, "pub-node", report)
+    fleet = FleetController(kube, port=0).scan_once()
+    assert [d["node"] for d in fleet["doctor"]["failing"]] == ["pub-node"]
+    assert "state-label" in fleet["doctor"]["failing"][0]["fail"]

@@ -271,6 +271,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--offline", action="store_true",
         help="skip the cluster checks (no API server access attempted)",
     )
+    doc.add_argument(
+        "--publish", action="store_true",
+        help="also push the compact verdict as the cc.doctor node "
+             "annotation for the fleet controller to aggregate",
+    )
     return p
 
 

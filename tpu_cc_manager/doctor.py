@@ -303,6 +303,31 @@ def run_doctor(kube=None, node_name: Optional[str] = None,
     }
 
 
+def publish_report(kube, node_name: str, report: dict) -> bool:
+    """Push a compact doctor verdict as a node annotation for the fleet
+    controller to aggregate. Best-effort."""
+    import time
+
+    summary = {
+        "ok": report["ok"],
+        "fail": sorted({c["name"] for c in report["checks"]
+                        if c["severity"] == "fail"}),
+        "warn": sorted({c["name"] for c in report["checks"]
+                        if c["severity"] == "warn"}),
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        kube.set_node_annotations(node_name, {
+            L.DOCTOR_ANNOTATION: json.dumps(
+                summary, sort_keys=True, separators=(",", ":")
+            ),
+        })
+        return True
+    except Exception:
+        log.warning("doctor verdict publication failed", exc_info=True)
+        return False
+
+
 def main_from_args(cfg, args) -> int:
     """CLI glue (called from __main__): build the kube client when
     possible, run, print, exit 0/1."""
@@ -315,5 +340,9 @@ def main_from_args(cfg, args) -> int:
         except Exception as e:
             log.warning("no API access (%s); running device-local only", e)
     report = run_doctor(kube=kube, node_name=cfg.node_name or None)
+    if args.publish and kube is not None and cfg.node_name:
+        publish_report(kube, cfg.node_name, report)
+    elif args.publish:
+        log.warning("--publish needs API access and NODE_NAME; skipped")
     print(json.dumps(report, indent=2, sort_keys=True))
     return 0 if report["ok"] else 1

@@ -70,6 +70,13 @@ SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"
 #: fleet controller.
 EVIDENCE_ANNOTATION = "tpu.google.com/cc.evidence"
 
+#: Node-local doctor verdict (tpu_cc_manager.doctor --publish): a
+#: compact {ok, fail[], warn[]} summary of the node's trust-surface
+#: checks, aggregated fleet-wide by the fleet controller — the
+#: "deep-scan" channel that doesn't trust labels because it is produced
+#: by the same cross-checks that catch lying labels.
+DOCTOR_ANNOTATION = "tpu.google.com/cc.doctor"
+
 #: Durable rollout record (tpu_cc_manager.rollout): the group plan,
 #: per-group outcomes, and budget of the pool's current/last rollout,
 #: stored as an annotation on the pool's anchor node so an operator-side
