@@ -388,3 +388,53 @@ def test_agent_emits_slice_abort_event(tmp_path):
     reasons = [e["reason"] for e in kube.cluster_events]
     assert reasons == ["CCSliceAborted"]
     assert kube.cluster_events[0]["type"] == "Warning"
+
+
+def test_agent_publishes_doctor_verdict_on_idle_tick(tmp_path):
+    """The agent's periodic doctor self-check (TPU_CC_DOCTOR_INTERVAL_S)
+    publishes the cc.doctor annotation without anyone running doctor by
+    hand — keeping the fleet controller's aggregation fresh."""
+    import json
+
+    backend = fake_backend(n_chips=1)
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path, doctor_interval_s=0.2)
+    t = threading.Thread(target=agent.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 15
+        raw = None
+        while time.monotonic() < deadline:
+            raw = kube.get_node("n1")["metadata"].get(
+                "annotations", {}
+            ).get(L.DOCTOR_ANNOTATION)
+            if raw:
+                break
+            time.sleep(0.05)
+        assert raw, "doctor verdict never published"
+        verdict = json.loads(raw)
+        assert verdict["ok"] is True
+        assert verdict["fail"] == []
+        assert "at" in verdict
+    finally:
+        agent.shutdown()
+        t.join(timeout=10)
+
+
+def test_doctor_interval_zero_disables_self_check(tmp_path):
+    backend = fake_backend(n_chips=1)
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    agent = _agent(kube, tmp_path, doctor_interval_s=0)
+    t = threading.Thread(target=agent.run, daemon=True)
+    t.start()
+    try:
+        time.sleep(1.0)
+        assert L.DOCTOR_ANNOTATION not in kube.get_node("n1")[
+            "metadata"].get("annotations", {})
+    finally:
+        agent.shutdown()
+        t.join(timeout=10)

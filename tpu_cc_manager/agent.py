@@ -131,6 +131,9 @@ class CCManagerAgent:
         self._evidence_wanted_gen = 0
         self._evidence_published_gen = 0
         self._evidence_retry_due = 0.0
+        # periodic doctor self-check throttle (first run shortly after
+        # startup, then every doctor_interval_s)
+        self._doctor_due = 0.0
         # idle-tick gate drift-heal throttle
         self._gate_reassert_due = 0.0
         # Event-name uniqueness: per-process counter + a startup-unique
@@ -361,6 +364,53 @@ class CCManagerAgent:
                 self.reconcile_count += 1
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
 
+    def _publish_doctor(self) -> None:
+        """Periodic trust-surface self-check (tpu_cc_manager.doctor)
+        published as the cc.doctor annotation for the fleet controller
+        to aggregate. Runs on the idle tick, so it must never raise and
+        never block the mailbox for long; the report build is local
+        reads plus one get_node, and the annotation write is deferred
+        to the recorder worker like Events and evidence."""
+        import json as _json
+
+        from tpu_cc_manager import device as devlayer
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.doctor import run_doctor
+
+        try:
+            backend = self._backend or devlayer.get_backend()
+            report = run_doctor(
+                kube=self.kube, node_name=self.cfg.node_name,
+                backend=backend,
+            )
+            summary = {
+                "ok": report["ok"],
+                "fail": sorted({c["name"] for c in report["checks"]
+                                if c["severity"] == "fail"}),
+                "warn": sorted({c["name"] for c in report["checks"]
+                                if c["severity"] == "warn"}),
+                "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            }
+            payload = _json.dumps(summary, sort_keys=True,
+                                  separators=(",", ":"))
+        except Exception:
+            log.warning("doctor self-check failed", exc_info=True)
+            return
+        if not report["ok"]:
+            log.warning("doctor self-check failing: %s", summary["fail"])
+
+        def task():
+            try:
+                self.kube.set_node_annotations(self.cfg.node_name, {
+                    L.DOCTOR_ANNOTATION: payload,
+                })
+            except Exception as e:
+                log.warning("doctor verdict publish failed: %s", e)
+
+        if self._enqueue_recorder_item(task) == "full":
+            log.warning("doctor verdict dropped (recorder queue full); "
+                        "next interval republishes")
+
     def _emit_reconcile_event(self, mode: str, outcome: str, dur: float) -> None:
         """Best-effort core/v1 Event so `kubectl describe node` carries
         the mode-flip history (the reference records outcomes only in a
@@ -502,6 +552,12 @@ class CCManagerAgent:
         if self.cfg.repair_interval_s and now >= self._gate_reassert_due:
             self._gate_reassert_due = now + self.cfg.repair_interval_s
             self.engine.reassert_gate()
+        # periodic doctor self-check published as the cc.doctor
+        # annotation: keeps the fleet controller's trust-surface
+        # aggregation fresh without anyone running doctor by hand
+        if self.cfg.doctor_interval_s and now >= self._doctor_due:
+            self._doctor_due = now + self.cfg.doctor_interval_s
+            self._publish_doctor()
         if self._repair_mode is None or time.monotonic() < self._repair_due:
             return
         mode = self._repair_mode

@@ -84,6 +84,11 @@ class AgentConfig:
     #: (tpu_cc_manager.evidence). Best-effort; TPU_CC_EVIDENCE=false
     #: disables.
     emit_evidence: bool = True
+    #: Seconds between periodic doctor self-checks published as the
+    #: cc.doctor node annotation (tpu_cc_manager.doctor), keeping the
+    #: fleet controller's trust-surface aggregation fresh without
+    #: operator action. 0 disables. TPU_CC_DOCTOR_INTERVAL_S.
+    doctor_interval_s: float = 300.0
 
     def __post_init__(self):
         if self.drain_strategy not in ("components", "node", "none"):
@@ -95,6 +100,11 @@ class AgentConfig:
             raise ValueError(
                 f"invalid REPAIR_INTERVAL_S {self.repair_interval_s!r}: "
                 "must be >= 0 (0 disables self-repair)"
+            )
+        if self.doctor_interval_s < 0:
+            raise ValueError(
+                f"invalid TPU_CC_DOCTOR_INTERVAL_S "
+                f"{self.doctor_interval_s!r}: must be >= 0 (0 disables)"
             )
 
 
@@ -305,5 +315,8 @@ def parse_config(argv: Optional[List[str]] = None):
         trace_file=os.environ.get("CC_TRACE_FILE") or None,
         emit_events=_env_bool("EMIT_EVENTS", True),
         emit_evidence=_env_bool("TPU_CC_EVIDENCE", True),
+        doctor_interval_s=float(
+            os.environ.get("TPU_CC_DOCTOR_INTERVAL_S", "300")
+        ),
     )
     return cfg, args
