@@ -183,6 +183,190 @@ def test_list_cluster_custom_sorted_and_scoped():
 # custom-resource plumbing: real wire protocol
 # ---------------------------------------------------------------------------
 
+def test_fake_custom_resource_watch():
+    kube = FakeKube()
+    rv = kube.latest_rv
+    kube.add_custom(G, P, make_policy("w1"))
+    kube.patch_cluster_custom(G, V, P, "w1", {"spec": {"mode": "off"}})
+    events = list(kube.watch_cluster_custom(G, V, P, resource_version=rv,
+                                            timeout_s=0.3))
+    assert [(t, o["metadata"]["name"]) for t, o in events] == [
+        ("ADDED", "w1"), ("MODIFIED", "w1"),
+    ]
+    # a different collection's watcher sees nothing
+    assert list(kube.watch_cluster_custom(
+        G, "othercoll", "othercoll", resource_version=rv, timeout_s=0.2
+    )) == []
+
+
+def test_custom_resource_watch_over_the_wire():
+    store = FakeKube()
+    with FakeApiServer(store) as srv:
+        client = HttpKubeClient(
+            KubeConfig("127.0.0.1", srv.port, use_tls=False)
+        )
+        rv = store.latest_rv
+        got = []
+        done = threading.Event()
+
+        def watch():
+            for etype, obj in client.watch_cluster_custom(
+                G, V, P, resource_version=rv, timeout_s=3
+            ):
+                got.append((etype, obj["metadata"]["name"]))
+                if len(got) >= 2:
+                    break
+            done.set()
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        store.add_custom(G, P, make_policy("wired"))
+        store.patch_cluster_custom(G, V, P, "wired",
+                                   {"spec": {"paused": True}})
+        assert done.wait(5)
+        t.join(timeout=5)
+        assert got == [("ADDED", "wired"), ("MODIFIED", "wired")]
+
+
+def test_run_loop_reacts_to_policy_events_before_interval():
+    """Event-driven reconciliation: with a one-hour interval, a newly
+    created policy must still converge the pool within seconds because
+    the CR watch wakes the scan loop."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    c = controller(kube, interval_s=3600)
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    t = threading.Thread(target=c.run, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.3)  # first (empty) scan done; loop is now waiting
+        kube.add_custom(G, P, make_policy(
+            "evt", strategy={"groupTimeoutSeconds": 10},
+        ))
+        deadline = time.monotonic() + 10
+        phase = None
+        while time.monotonic() < deadline:
+            try:
+                phase = kube.get_cluster_custom(
+                    G, V, P, "evt"
+                ).get("status", {}).get("phase")
+            except ApiException:
+                phase = None
+            if phase == "Converged":
+                break
+            time.sleep(0.05)
+        assert phase == "Converged"
+        labels = kube.get_node("n0")["metadata"]["labels"]
+        assert labels[L.CC_MODE_STATE_LABEL] == "on"
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+        c.stop()
+        t.join(timeout=10)
+
+
+def test_own_status_patches_do_not_self_wake():
+    """The controller's status writes echo back as MODIFIED watch
+    events with an unchanged generation; waking on them would re-scan
+    after every scan that wrote status."""
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    scans = []
+
+    class Counting(PolicyController):
+        def scan_once(self):
+            scans.append(time.monotonic())
+            return super().scan_once()
+
+    c = Counting(kube, interval_s=3600, poll_s=0.02)
+    kube.add_custom(G, P, make_policy("p"))
+    t = threading.Thread(target=c.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not scans:
+            time.sleep(0.05)
+        assert scans, "no initial scan"
+        # let startup scans (incl. the reconnect gap-cover wake)
+        # stabilize, then prove the steady state is quiet: each scan
+        # published status (a MODIFIED event), and waking on those
+        # would produce an unending scan->patch->wake loop
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            n = len(scans)
+            time.sleep(1.0)
+            if len(scans) == n:
+                break
+        stable = len(scans)
+        time.sleep(1.0)
+        assert len(scans) == stable, (
+            f"{len(scans) - stable} extra scans: status patches "
+            "self-woke the loop"
+        )
+        # a real spec change still wakes it
+        kube.patch_cluster_custom(G, V, P, "p", {"spec": {"paused": True}})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(scans) < 2:
+            time.sleep(0.05)
+        assert len(scans) >= 2
+    finally:
+        c.stop()
+        t.join(timeout=10)
+
+
+def test_watch_outage_gap_is_covered_by_a_scan():
+    """Events during a watch outage are not replayed by a from-scratch
+    reconnect; the restart must wake one scan so a policy created in
+    the gap doesn't wait out a long interval."""
+    fail = {"n": 1}
+
+    class FlakyWatchKube(FakeKube):
+        def watch_cluster_custom(self, *a, **k):
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                raise ApiException(500, "watch transport lost")
+            return super().watch_cluster_custom(*a, **k)
+
+    kube = FlakyWatchKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    c = controller(kube, interval_s=3600)
+    # the policy is created while the watch is down (before run starts
+    # its first successful watch): only the restart-wake can see it
+    # before the hour is up... but the first scan at startup would too,
+    # so create it after the first scan. Easiest deterministic order:
+    # let the first watch attempt fail, then create the policy in the
+    # 5s retry window.
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    t = threading.Thread(target=c.run, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.3)  # first scan (no policies) done; watch failed
+        kube.add_custom(G, P, make_policy(
+            "gap", strategy={"groupTimeoutSeconds": 10},
+        ))
+        deadline = time.monotonic() + 15
+        phase = None
+        while time.monotonic() < deadline:
+            try:
+                phase = kube.get_cluster_custom(
+                    G, V, P, "gap"
+                ).get("status", {}).get("phase")
+            except ApiException:
+                phase = None
+            if phase == "Converged":
+                break
+            time.sleep(0.1)
+        assert phase == "Converged"
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+        c.stop()
+        t.join(timeout=10)
+
+
 def test_custom_resources_over_the_wire():
     store = FakeKube()
     store.add_custom(G, P, make_policy("wire-pol"))

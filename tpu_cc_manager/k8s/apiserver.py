@@ -17,7 +17,7 @@ Endpoints:
 - ``POST   /api/v1/namespaces/{ns}/pods/{name}/eviction``
 - ``POST   /api/v1/namespaces/{ns}/events``
 - ``GET    /api/v1/namespaces/{ns}/events``
-- ``GET    /apis/{group}/{ver}/{plural}``          (cluster-scoped CRs)
+- ``GET    /apis/{group}/{ver}/{plural}``          (cluster-scoped CRs; watch=true)
 - ``GET    /apis/{group}/{ver}/{plural}/{name}``
 - ``PATCH  /apis/{group}/{ver}/{plural}/{name}[/status]``
 
@@ -149,6 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if parts[0] == "apis" and len(parts) == 4:
                 group, ver, plural = parts[1], parts[2], parts[3]
+                if q.get("watch") == "true":
+                    return self._stream_custom_watch(group, ver, plural, q)
                 items = self.store.list_cluster_custom(group, ver, plural)
                 return self._send_json(200, _list_obj("List", items, None))
             if parts[0] == "apis" and len(parts) == 5:
@@ -240,14 +242,33 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_error_status(e)
 
     # ------------------------------------------------------------- watch
+    def _stream_custom_watch(self, group: str, ver: str, plural: str,
+                             q: dict) -> None:
+        self._stream_events(
+            lambda: self.store.watch_cluster_custom(
+                group, ver, plural,
+                resource_version=q.get("resourceVersion"),
+                timeout_s=float(q.get("timeoutSeconds", "300")),
+            )
+        )
+
     def _stream_watch(self, q: dict) -> None:
         name: Optional[str] = None
         fs = q.get("fieldSelector", "")
         if fs.startswith("metadata.name="):
             name = fs.split("=", 1)[1]
-        timeout_s = float(q.get("timeoutSeconds", "300"))
-        rv = q.get("resourceVersion")
+        self._stream_events(
+            lambda: self.store.watch_nodes(
+                name=name,
+                resource_version=q.get("resourceVersion"),
+                timeout_s=float(q.get("timeoutSeconds", "300")),
+                allow_bookmarks=q.get("allowWatchBookmarks") == "true",
+            )
+        )
 
+    def _stream_events(self, iter_factory) -> None:
+        """Serve one watch stream (chunked NDJSON, ERROR event on
+        ApiException, clean EOF at timeout) from any event iterator."""
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -259,12 +280,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             try:
-                for etype, obj in self.store.watch_nodes(
-                    name=name,
-                    resource_version=rv,
-                    timeout_s=timeout_s,
-                    allow_bookmarks=q.get("allowWatchBookmarks") == "true",
-                ):
+                for etype, obj in iter_factory():
                     _chunk(
                         json.dumps({"type": etype, "object": obj}).encode()
                         + b"\n"

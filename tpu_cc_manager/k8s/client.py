@@ -130,6 +130,18 @@ class KubeClient(abc.ABC):
         like the real API server, never bumps ``metadata.generation``)."""
         raise ApiException(501, "custom resources not supported by this client")
 
+    def watch_cluster_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+    ) -> Iterator[Tuple[str, dict]]:
+        """Watch a cluster-scoped CR collection; yields (event_type,
+        object) until the server-side timeout, like watch_nodes."""
+        raise ApiException(501, "custom resources not supported by this client")
+
     # convenience built on the primitives -------------------------------
     def set_node_labels(self, name: str, labels: Dict[str, Optional[str]]) -> dict:
         return self.patch_node(name, {"metadata": {"labels": labels}})
@@ -714,6 +726,46 @@ class HttpKubeClient(KubeClient):
             params["resourceVersion"] = str(resource_version)
         path = "/api/v1/nodes?" + urllib.parse.urlencode(params)
 
+        yield from self._stream_watch(
+            path, timeout_s,
+            retry=(
+                (lambda: self.watch_nodes(
+                    name=name, resource_version=resource_version,
+                    timeout_s=timeout_s, _auth_retry=False,
+                )) if _auth_retry else None
+            ),
+        )
+
+    def watch_cluster_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+        _auth_retry: bool = True,
+    ) -> Iterator[Tuple[str, dict]]:
+        params = {"watch": "true", "timeoutSeconds": str(timeout_s)}
+        if resource_version is not None:
+            params["resourceVersion"] = str(resource_version)
+        path = (f"/apis/{group}/{version}/{plural}?"
+                + urllib.parse.urlencode(params))
+        yield from self._stream_watch(
+            path, timeout_s,
+            retry=(
+                (lambda: self.watch_cluster_custom(
+                    group, version, plural,
+                    resource_version=resource_version,
+                    timeout_s=timeout_s, _auth_retry=False,
+                )) if _auth_retry else None
+            ),
+        )
+
+    def _stream_watch(self, path: str, timeout_s: int,
+                      retry=None) -> Iterator[Tuple[str, dict]]:
+        """Shared NDJSON watch transport: dial, 401 invalidate-and-retry
+        (via ``retry``, which re-invokes the caller once), stream until
+        the server-side timeout closes the connection."""
         try:
             conn = self._connect(read_timeout=timeout_s + 30)
         except ExecCredentialError as e:
@@ -726,18 +778,14 @@ class HttpKubeClient(KubeClient):
                 raise ApiException(0, f"exec credential failure: {e}") from e
             except OSError as e:
                 raise ApiException(0, f"transport error: {e}") from e
-            if resp.status == 401 and _auth_retry and self.config.exec_plugin:
+            if (resp.status == 401 and retry is not None
+                    and self.config.exec_plugin):
                 # same invalidate-and-retry as _request: a revoked cached
                 # exec credential must not burn the watcher's consecutive-
                 # error budget when one plugin re-run fixes it
                 self.config.exec_plugin.invalidate()
                 resp.read()
-                yield from self.watch_nodes(
-                    name=name,
-                    resource_version=resource_version,
-                    timeout_s=timeout_s,
-                    _auth_retry=False,
-                )
+                yield from retry()
                 return
             if resp.status >= 400:
                 raise ApiException(resp.status, resp.read().decode("utf-8", "replace")[:200])

@@ -72,6 +72,10 @@ class FakeKube(KubeClient):
         #: version is deliberately not part of the key: the fake serves
         #: one storage version, like a real API server does
         self._customs: Dict[Tuple[str, str, str], dict] = {}
+        #: watch history for custom resources: (rv, type, group, plural,
+        #: snapshot) — separate from the node history so node churn
+        #: can't 410 a policy watcher
+        self._custom_events: List[Tuple[int, str, str, str, dict]] = []
 
     # ------------------------------------------------------------ helpers
     def _bump(self, obj: dict) -> None:
@@ -251,6 +255,7 @@ class FakeKube(KubeClient):
             stored.setdefault("metadata", {}).setdefault("generation", 1)
             self._bump(stored)
             self._customs[(group, plural, stored["metadata"]["name"])] = stored
+            self._record_custom("ADDED", group, plural, stored)
             return copy.deepcopy(stored)
 
     def list_cluster_custom(
@@ -315,7 +320,51 @@ class FakeKube(KubeClient):
             merged["metadata"]["name"] = name
             self._customs[(group, plural, name)] = merged
             self._bump(merged)
+            self._record_custom("MODIFIED", group, plural, merged)
             return copy.deepcopy(merged)
+
+    def _record_custom(self, etype: str, group: str, plural: str,
+                       obj: dict) -> None:
+        self._custom_events.append(
+            (self._rv, etype, group, plural, copy.deepcopy(obj))
+        )
+        if len(self._custom_events) > self._history_limit:
+            self._custom_events = self._custom_events[-self._history_limit:]
+        self._lock.notify_all()
+
+    def watch_cluster_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+    ) -> Iterator[Tuple[str, dict]]:
+        """Watch one cluster-scoped CR collection; the same rv / replay
+        / server-timeout semantics as watch_nodes. No 410 compaction
+        model here (policy objects are few and slow-moving); a caller
+        that falls behind simply re-lists."""
+        deadline = time.monotonic() + timeout_s
+        last_rv = int(resource_version) if resource_version is not None else None
+        while True:
+            with self._lock:
+                if last_rv is None:
+                    last_rv = self._rv
+                pending = [
+                    (rv, t, obj)
+                    for (rv, t, g, p, obj) in self._custom_events
+                    if rv > last_rv and g == group and p == plural
+                ]
+                if self._custom_events:
+                    last_rv = max(last_rv, self._custom_events[-1][0])
+                if not pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._lock.wait(timeout=min(remaining, 0.5))
+                    continue
+            for rv, etype, obj in pending:
+                yield etype, copy.deepcopy(obj)
 
     # ------------------------------------------------------------- watch
     def watch_nodes(

@@ -208,6 +208,13 @@ class PolicyController:
         #: wall clock) against local time.
         self._hb_seen: Dict[str, Tuple[object, float]] = {}
         self._stop = threading.Event()
+        #: set by the watch thread on any policy change: the run loop
+        #: scans immediately instead of waiting out the interval —
+        #: event-driven like the reference's informer (resync 0,
+        #: cmd/main.go:193), with the interval as the level-trigger
+        #: fallback for node-side drift the policy watch can't see
+        self._wake = threading.Event()
+        self.watch_timeout_s = 300
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
         self._server.add_route("/metrics", self._metrics_route)
@@ -614,14 +621,74 @@ class PolicyController:
         return 200, body, "application/json"
 
     # ---------------------------------------------------------------- run
+    def _watch_loop(self) -> None:
+        """Background watch on the policy collection; any event wakes
+        the scan loop. Falls back to pure interval polling when the
+        client doesn't support CR watches (501) — and keeps retrying
+        through CRD-not-installed (404) and transient errors, since
+        both are expected deployment states."""
+        rv = None
+        gens: Dict[str, object] = {}  # name -> last generation seen
+        while not self._stop.is_set():
+            if rv is None:
+                # a from-scratch watch (startup, or reconnect after an
+                # outage/410) starts at "now" and cannot replay what
+                # happened before it — wake one scan to cover the gap.
+                # Set HERE, after any backoff sleep, so events that
+                # landed during the sleep are inside the covered window
+                self._wake.set()
+            try:
+                for etype, obj in self.kube.watch_cluster_custom(
+                    L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL,
+                    resource_version=rv,
+                    timeout_s=self.watch_timeout_s,
+                ):
+                    meta = obj.get("metadata", {})
+                    rv = meta.get("resourceVersion", rv)
+                    name = meta.get("name", "")
+                    gen = meta.get("generation")
+                    # only spec-level changes wake the loop: the
+                    # controller's own status patches echo back as
+                    # MODIFIED events with an unchanged generation
+                    # (status subresource never bumps it) — waking on
+                    # those would re-scan after every scan that wrote
+                    if etype == "DELETED":
+                        gens.pop(name, None)
+                        self._wake.set()
+                    elif gens.get(name) != gen:
+                        gens[name] = gen
+                        self._wake.set()
+                    if self._stop.is_set():
+                        return
+            except ApiException as e:
+                if e.status == 501:
+                    log.info("client has no CR watch support; "
+                             "interval polling only")
+                    return
+                # stale rv (410) or transient failure: back off, then
+                # restart from "now" (the rv=None branch above wakes
+                # one gap-covering scan on reconnect)
+                rv = None
+                self._stop.wait(5.0)
+            except Exception:
+                log.warning("policy watch failed; retrying",
+                            exc_info=True)
+                rv = None
+                self._stop.wait(5.0)
+
     def run(self) -> int:
         self._server.start()
         log.info(
-            "policy controller serving on :%d (every %.0fs)",
-            self.port, self.interval_s,
+            "policy controller serving on :%d (every %.0fs + "
+            "watch-triggered)", self.port, self.interval_s,
         )
+        watcher = threading.Thread(
+            target=self._watch_loop, name="policy-watch", daemon=True
+        )
+        watcher.start()
         try:
             while not self._stop.is_set():
+                self._wake.clear()
                 try:
                     report = self.scan_once()
                     log.info(
@@ -636,11 +703,13 @@ class PolicyController:
                             self.consecutive_errors,
                         )
                         return 1
-                self._stop.wait(self.interval_s)
+                # interval tick OR an immediate wake from the watch
+                self._wake.wait(self.interval_s)
             return 0
         finally:
             self.stop()
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock the run loop promptly
         self._server.stop()
