@@ -267,6 +267,46 @@ def test_run_loop_reacts_to_policy_events_before_interval():
         t.join(timeout=10)
 
 
+def test_policy_events_record_rollout_history():
+    """kubectl-describe-tpuccpolicy visibility: rollout start/outcome
+    and conflict ENTRY (not every scan while it persists) post Events
+    against the policy object."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy(
+        "p", strategy={"groupTimeoutSeconds": 10},
+    ))
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    c = controller(kube)
+    try:
+        c.scan_once()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    events = [
+        (e["reason"], e["involvedObject"]["kind"],
+         e["involvedObject"]["name"])
+        for e in kube.list_events("default")
+    ]
+    assert ("PolicyRolloutStarted", "TPUCCPolicy", "p") in events
+    assert ("PolicyRolloutSucceeded", "TPUCCPolicy", "p") in events
+
+    # conflict entry fires once, then stays quiet while it persists
+    # (paused so the earlier-named claimant never drives a rollout of
+    # its own — claiming is independent of pause)
+    kube.add_custom(G, P, make_policy("aaa", mode="off", paused=True))
+    c.scan_once()
+    c.scan_once()
+    conflicts = [
+        e for e in kube.list_events("default")
+        if e["reason"] == "PolicyConflict"
+    ]
+    assert len(conflicts) == 1
+    assert conflicts[0]["involvedObject"]["name"] == "p"
+    assert conflicts[0]["type"] == "Warning"
+
+
 def test_own_status_patches_do_not_self_wake():
     """The controller's status writes echo back as MODIFIED watch
     events with an unchanged generation; waking on them would re-scan

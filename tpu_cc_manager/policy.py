@@ -200,6 +200,7 @@ class PolicyController:
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
         self._warned_no_crd = False
+        self._event_warned = False
         self.adopt_after_s = adopt_after_s
         #: heartbeat observation per record id: (last value seen,
         #: monotonic time it was FIRST seen unchanged). Staleness is
@@ -306,6 +307,13 @@ class PolicyController:
                 seen_nodes[n["metadata"]["name"]] = n
             st = self._derive_status(pol, spec, own, conflicted)
             statuses[name] = st
+            if (st["phase"] == "Conflicted"
+                    and (pol.get("status") or {}).get("phase")
+                    != "Conflicted"):
+                # entering conflict (not every scan while it persists)
+                self._emit_policy_event(
+                    name, "PolicyConflict", st["message"], "Warning"
+                )
             # an empty pool is Pending but not actionable: there is
             # nothing to roll until nodes appear
             if st["phase"] == "Pending" and own:
@@ -411,6 +419,44 @@ class PolicyController:
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),
         }
+
+    # ------------------------------------------------------------- events
+    def _emit_policy_event(self, policy_name: str, reason: str,
+                           message: str, etype: str = "Normal") -> None:
+        """Best-effort core/v1 Event attached to the TPUCCPolicy, so
+        `kubectl describe tpuccpolicy` carries the rollout history the
+        same way `kubectl describe node` carries reconcile history.
+        Cluster-scoped involvedObjects' events live in "default"."""
+        import uuid as _uuid
+
+        from tpu_cc_manager.drain import post_event_best_effort
+
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        event = {
+            "kind": "Event",
+            "apiVersion": "v1",
+            "metadata": {
+                "name": (f"{policy_name}.ccpolicy."
+                         f"{_uuid.uuid4().hex[:8]}"),
+                "namespace": "default",
+            },
+            "involvedObject": {
+                "kind": L.POLICY_KIND,
+                "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+                "name": policy_name,
+            },
+            "reason": reason,
+            "message": message,
+            "type": etype,
+            "source": {"component": "tpu-cc-policy-controller"},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        _, warned = post_event_best_effort(
+            self.kube, event, self._event_warned
+        )
+        self._event_warned = self._event_warned or warned
 
     # ----------------------------------------------------------- rollouts
     def _adopt_unfinished(
@@ -518,6 +564,11 @@ class PolicyController:
         """Run one bounded rollout for this policy; mutate its status
         with the outcome. Returns the metrics outcome label."""
         name = pol["metadata"]["name"]
+        self._emit_policy_event(
+            name, "PolicyRolloutStarted",
+            f"rolling {spec['mode']!r} (window {spec['max_unavailable']}, "
+            f"budget {spec['failure_budget']})",
+        )
         try:
             rollout = Rollout(
                 self.kube, spec["mode"],
@@ -536,6 +587,9 @@ class PolicyController:
             st["phase"] = "Degraded"
             st["message"] = f"rollout refused: {e}"
             log.warning("policy %s: rollout refused: %s", name, e)
+            self._emit_policy_event(
+                name, "PolicyRolloutRefused", str(e), "Warning"
+            )
             return "refused"
         st["lastRollout"] = {
             "mode": report.mode,
@@ -555,6 +609,9 @@ class PolicyController:
             )
             st["converged"] += st["divergent"]
             st["divergent"] = 0
+            self._emit_policy_event(
+                name, "PolicyRolloutSucceeded", st["message"]
+            )
             return "ok"
         st["phase"] = "Degraded"
         st["message"] = (
@@ -562,6 +619,12 @@ class PolicyController:
             f"groups {report.failed}"
         )
         log.warning("policy %s: %s", name, st["message"])
+        self._emit_policy_event(
+            name,
+            "PolicyRolloutAborted" if report.aborted
+            else "PolicyRolloutFailed",
+            st["message"], "Warning",
+        )
         return "aborted" if report.aborted else "failed"
 
     # ------------------------------------------------------------- status
