@@ -184,3 +184,81 @@ def test_concurrent_set_node_labels_no_lost_updates():
     labels = kube.get_node("n")["metadata"]["labels"]
     stress_keys = [k for k in labels if k.startswith("stress/")]
     assert len(stress_keys) == n_threads * n_keys
+
+
+def test_policy_controller_survives_spec_churn():
+    """Operator churn on the declarative surface: policy specs flip
+    repeatedly while the controller's watch+scan loop and real reactive
+    node 'agents' run. The controller must neither crash nor wedge, and
+    once the churn stops the fleet converges to the final spec."""
+    from tpu_cc_manager import labels as L
+    from tpu_cc_manager.k8s.client import ApiException
+    from tpu_cc_manager.policy import PolicyController
+
+    G, V, P = L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL
+    kube = FakeKube()
+    names = [f"ch-{i}" for i in range(4)]
+    for n in names:
+        kube.add_node(make_node(n, labels={
+            L.TPU_ACCELERATOR_LABEL: "tpu-v5e-slice",
+            L.CC_MODE_LABEL: "off",
+            L.CC_MODE_STATE_LABEL: "off",
+        }))
+    kube.add_custom(G, P, {
+        "apiVersion": f"{G}/{V}", "kind": L.POLICY_KIND,
+        "metadata": {"name": "churny"},
+        "spec": {"mode": "off",
+                 "nodeSelector": L.TPU_ACCELERATOR_LABEL,
+                 "strategy": {"maxUnavailable": 4,
+                              "groupTimeoutSeconds": 10}},
+    })
+
+    stop = threading.Event()
+
+    def agent_sim():
+        while not stop.is_set():
+            for n in names:
+                labels = kube.get_node(n)["metadata"]["labels"]
+                desired = labels.get(L.CC_MODE_LABEL)
+                if desired and labels.get(L.CC_MODE_STATE_LABEL) != desired:
+                    kube.set_node_labels(
+                        n, {L.CC_MODE_STATE_LABEL: desired})
+            time.sleep(0.01)
+
+    sim = threading.Thread(target=agent_sim, daemon=True)
+    sim.start()
+    ctrl = PolicyController(kube, interval_s=0.3, poll_s=0.02)
+    t = threading.Thread(target=ctrl.run, daemon=True)
+    t.start()
+    try:
+        # churn: flip the spec through the mode vocabulary rapidly
+        modes = ["on", "devtools", "ici", "on", "off", "devtools"]
+        for m in modes:
+            kube.patch_cluster_custom(G, V, P, "churny",
+                                      {"spec": {"mode": m}})
+            time.sleep(0.15)
+        final = "on"
+        kube.patch_cluster_custom(G, V, P, "churny",
+                                  {"spec": {"mode": final}})
+        deadline = time.monotonic() + 30
+        done = False
+        while time.monotonic() < deadline and not done:
+            labels_ok = all(
+                kube.get_node(n)["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL) == final
+                for n in names
+            )
+            try:
+                phase = kube.get_cluster_custom(
+                    G, V, P, "churny").get("status", {}).get("phase")
+            except ApiException:
+                phase = None
+            done = labels_ok and phase == "Converged"
+            time.sleep(0.1)
+        assert done, "fleet never converged to the final spec"
+        assert ctrl.healthy
+    finally:
+        stop.set()
+        sim.join(timeout=5)
+        ctrl.stop()
+        t.join(timeout=10)
