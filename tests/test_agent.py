@@ -438,3 +438,67 @@ def test_doctor_interval_zero_disables_self_check(tmp_path):
     finally:
         agent.shutdown()
         t.join(timeout=10)
+
+
+def test_sigterm_is_a_clean_shutdown(tmp_path):
+    """The kubelet stops pods with SIGTERM: the real entrypoint must
+    exit 0 (clean shutdown, recorder flushed) — parity with the C++
+    agent's on_signal and the bash engine's traps."""
+    import signal
+    import subprocess
+    import sys
+
+    import yaml
+
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    from tpu_cc_manager.k8s.objects import make_node as _mk
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sysfs = tmp_path / "sysfs" / "accel0" / "device"
+    sysfs.mkdir(parents=True)
+    (sysfs / "vendor").write_text("0x1ae0\n")
+    (sysfs / "device").write_text("0x0063\n")
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev" / "accel0").write_text("")
+    with FakeApiServer() as srv:
+        srv.store.add_node(_mk("sig-node", labels={
+            L.CC_MODE_LABEL: "off"}))
+        kubeconfig = tmp_path / "kubeconfig.yaml"
+        kubeconfig.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Config",
+            "current-context": "t",
+            "contexts": [{"name": "t",
+                          "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {
+                "server": f"http://127.0.0.1:{srv.port}"}}],
+            "users": [{"name": "u", "user": {}}],
+        }))
+        ready = tmp_path / "ready"
+        env = dict(
+            os.environ,
+            NODE_NAME="sig-node",
+            KUBECONFIG=str(kubeconfig),
+            PYTHONPATH=repo,
+            TPU_SYSFS_ROOT=str(tmp_path / "sysfs"),
+            TPU_DEV_ROOT=str(tmp_path / "dev"),
+            TPU_CC_STATE_DIR=str(tmp_path / "state"),
+            DRAIN_STRATEGY="none",
+            CC_READINESS_FILE=str(ready),
+            HEALTH_PORT="0",
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_cc_manager"], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not ready.exists():
+                time.sleep(0.1)
+            assert ready.exists(), "agent never became ready"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=20)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        out = proc.stdout.read().decode()
+        assert rc == 0, f"SIGTERM exit {rc}; log tail: {out[-1500:]}"

@@ -274,3 +274,63 @@ def test_cli_webhook_requires_cert(capsys):
     from tpu_cc_manager.__main__ import main
 
     assert main(["webhook", "--port", "0"]) == 1
+
+
+def test_serving_cert_hot_reload(tmp_path):
+    """cert-manager rotates the Secret under a running pod; the server
+    must pick up the new chain for new handshakes without a restart,
+    and keep the old one through a torn mid-rotation read."""
+    import shutil
+    import subprocess
+
+    def gen(cn, prefix):
+        cert = tmp_path / f"{prefix}.crt"
+        key = tmp_path / f"{prefix}.key"
+        r = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", f"/CN={cn}",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"openssl unavailable: {r.stderr}")
+        return str(cert), str(key)
+
+    cert_a, key_a = gen("127.0.0.1", "a")
+    cert_b, key_b = gen("127.0.0.1", "b")
+    # the server serves from mutable paths (the Secret mount analog)
+    live_cert = tmp_path / "tls.crt"
+    live_key = tmp_path / "tls.key"
+    shutil.copy(cert_a, live_cert)
+    shutil.copy(key_a, live_key)
+
+    with AdmissionServer(0, cert_file=str(live_cert),
+                         key_file=str(live_key),
+                         reload_check_s=3600) as srv:  # manual trigger
+        base = f"https://127.0.0.1:{srv.port}"
+
+        def handshake_ok(ca):
+            ctx = ssl.create_default_context(cafile=ca)
+            try:
+                urllib.request.urlopen(f"{base}/healthz", context=ctx,
+                                       timeout=5)
+                return True
+            except ssl.SSLError:
+                return False
+            except urllib.error.URLError as e:
+                if isinstance(e.reason, ssl.SSLError):
+                    return False
+                raise
+
+        assert handshake_ok(cert_a) and not handshake_ok(cert_b)
+
+        # torn rotation: key not swapped yet -> reload refused, old
+        # chain keeps serving
+        shutil.copy(cert_b, live_cert)
+        assert srv.reload_certs_if_changed() is False
+        assert handshake_ok(cert_a)
+
+        # rotation completes -> new chain serves new handshakes
+        shutil.copy(key_b, live_key)
+        assert srv.reload_certs_if_changed() is True
+        assert handshake_ok(cert_b) and not handshake_ok(cert_a)

@@ -44,6 +44,26 @@ def _kube_client(cfg):
     return HttpKubeClient(KubeConfig.load(cfg.kubeconfig))
 
 
+def _stop_on_sigterm(stop_fn) -> None:
+    """Make SIGTERM (the kubelet's pod-stop signal) a clean shutdown for
+    long-running commands, like the C++ agent's on_signal
+    (native/agent.cpp) and the bash engine's traps. The stop runs on a
+    helper thread: a handler calling it inline could re-enter a lock
+    the interrupted main thread already holds."""
+    import signal
+    import threading
+
+    def handler(signum, frame):
+        threading.Thread(
+            target=stop_fn, daemon=True, name="sigterm-stop"
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        pass  # not the main thread (embedded use): skip
+
+
 def main(argv=None) -> int:
     cfg, args = parse_config(argv)
     setup_logging(cfg.debug)
@@ -139,6 +159,7 @@ def main(argv=None) -> int:
                 interval_s=args.interval,
                 port=args.port,
             )
+            _stop_on_sigterm(controller.stop)
             # OSError belongs inside the guard too: RouteServer binds
             # lazily in run(), so a busy --port surfaces here
             return controller.run()
@@ -156,6 +177,7 @@ def main(argv=None) -> int:
                 port=args.port,
                 verify_evidence=not args.no_verify_evidence,
             )
+            _stop_on_sigterm(controller.stop)
             return controller.run()
         except (ValueError, OSError) as e:
             log.error("policy-controller refused: %s", e)
@@ -176,6 +198,7 @@ def main(argv=None) -> int:
         except (ValueError, OSError) as e:
             log.error("webhook refused: %s", e)
             return 1
+        _stop_on_sigterm(server.stop)
         return server.serve_forever()
 
     if args.command == "set-cc-mode":
@@ -263,6 +286,7 @@ def main(argv=None) -> int:
 
         slice_coordinator = SliceCoordinator(kube, cfg.node_name)
     agent = CCManagerAgent(kube, cfg, slice_coordinator=slice_coordinator)
+    _stop_on_sigterm(agent.shutdown)
     return agent.run()
 
 

@@ -193,6 +193,7 @@ class AdmissionServer:
         cert_file: Optional[str] = None,
         key_file: Optional[str] = None,
         tls: bool = True,
+        reload_check_s: float = 60.0,
     ):
         if tls and not cert_file:
             raise ValueError(
@@ -256,22 +257,107 @@ class AdmissionServer:
             {"request_queue_size": 64},
         )
         self.httpd = server_cls(("0.0.0.0", port), Handler)
+        self._ctx: Optional[ssl.SSLContext] = None
+        self._cert_file = cert_file
+        self._key_file = key_file or cert_file
+        self._cert_sig = None
+        self.reload_check_s = reload_check_s
         if tls:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(cert_file, key_file or cert_file)
-            self.httpd.socket = ctx.wrap_socket(
+            self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ctx.load_cert_chain(self._cert_file, self._key_file)
+            self._cert_sig = self._cert_signature()
+            self.httpd.socket = self._ctx.wrap_socket(
                 self.httpd.socket, server_side=True
             )
         self.httpd.daemon_threads = True
         self.reviews = 0
         self.rejected_malformed = 0
         self._thread: Optional[threading.Thread] = None
+        self._reload_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -------------------------------------------------- cert hot-reload
+    def _cert_signature(self):
+        import os
+
+        sig = []
+        for path in (self._cert_file, self._key_file):
+            try:
+                st = os.stat(path)
+                sig.append((st.st_mtime_ns, st.st_size, st.st_ino))
+            except OSError:
+                sig.append(None)
+        return tuple(sig)
+
+    def reload_certs_if_changed(self) -> bool:
+        """Re-load the serving cert/key when the files changed on disk —
+        cert-manager (and the gen-webhook-certs flow) rotate the Secret
+        under a running pod, and kubelet updates the mounted files in
+        place. New TLS handshakes pick up the reloaded chain; a torn
+        mid-rotation read keeps serving the previous cert and retries
+        next check. True when a reload happened."""
+        if self._ctx is None:
+            return False
+        sig = self._cert_signature()
+        if sig == self._cert_sig or None in sig:
+            return False
+        # load_cert_chain on the live context is not atomic: a
+        # mid-rotation cert/key mismatch would leave it torn and break
+        # ALL handshakes, old cert included. And the live context cannot
+        # simply be replaced (the listening SSLSocket is bound to it).
+        # So: snapshot the files to private temps, PROVE the snapshot
+        # valid on a throwaway context, then load the same proven bytes
+        # into the live context — which therefore cannot fail.
+        import os
+        import tempfile
+
+        tmps = []
+        try:
+            try:
+                for src in (self._cert_file, self._key_file):
+                    with open(src, "rb") as f:
+                        data = f.read()
+                    fd, p = tempfile.mkstemp(prefix=".certreload-")
+                    tmps.append(p)
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(data)
+                probe = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                probe.load_cert_chain(tmps[0], tmps[1])
+            except (ssl.SSLError, OSError) as e:
+                log.warning(
+                    "serving-cert reload failed (keeping previous): %s", e
+                )
+                return False
+            self._ctx.load_cert_chain(tmps[0], tmps[1])
+        finally:
+            for p in tmps:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        self._cert_sig = sig
+        log.info("serving certificate reloaded")
+        return True
+
+    def _reload_loop(self) -> None:
+        while not self._stop.wait(self.reload_check_s):
+            self.reload_certs_if_changed()
 
     @property
     def port(self) -> int:
         return self.httpd.server_address[1]
 
+    def _start_reloader(self) -> None:
+        if self._ctx is None or self.reload_check_s <= 0:
+            return
+        self._reload_thread = threading.Thread(
+            target=self._reload_loop, name="webhook-cert-reload",
+            daemon=True,
+        )
+        self._reload_thread.start()
+
     def start(self) -> "AdmissionServer":
+        self._start_reloader()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="webhook-http",
             daemon=True,
@@ -281,6 +367,7 @@ class AdmissionServer:
 
     def serve_forever(self) -> int:
         log.info("admission webhook serving on :%d", self.port)
+        self._start_reloader()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:  # pragma: no cover - operator stop
@@ -288,10 +375,13 @@ class AdmissionServer:
         return 0
 
     def stop(self) -> None:
+        self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._reload_thread:
+            self._reload_thread.join(timeout=5)
 
     def __enter__(self) -> "AdmissionServer":
         return self.start()
