@@ -1176,3 +1176,22 @@ def test_scan_failure_degrades_healthz():
 def test_interval_validation():
     with pytest.raises(ValueError, match="interval"):
         PolicyController(FakeKube(), interval_s=0)
+
+
+def test_cli_policy_controller_once(monkeypatch, capsys):
+    """--once: one pass, report on stdout, exit code reflects policy
+    health (cron/CI usage)."""
+    from tpu_cc_manager import __main__ as cli
+
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("healthy"))
+    monkeypatch.setattr(cli, "_kube_client", lambda cfg: kube)
+    rc = cli.main(["policy-controller", "--once"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["policies"]["healthy"]["phase"] == "Converged"
+
+    kube.add_custom(G, P, make_policy("broken", mode="bogus"))
+    rc = cli.main(["policy-controller", "--once"])
+    assert rc == 1

@@ -142,6 +142,20 @@ def test_validate_allows_unrelated_tolerations(tol):
     assert validate_pod(make_pod(requires="on", tolerations=[tol]))[0]
 
 
+def test_validate_rejects_direct_node_binding():
+    """spec.nodeName bypasses the scheduler, so the injected
+    nodeSelector is never evaluated — the one placement path the
+    guarantee can't cover must be refused for opted-in pods."""
+    pod = make_pod(requires="on")
+    pod["spec"]["nodeName"] = "some-node"
+    ok, reason = validate_pod(pod)
+    assert not ok and "nodeName" in reason
+    # pods that don't opt in may direct-bind freely
+    plain = make_pod()
+    plain["spec"]["nodeName"] = "some-node"
+    assert validate_pod(plain)[0]
+
+
 def test_unopted_pod_with_wildcard_toleration_is_allowed():
     # the webhook only polices pods that ASK for confidential placement
     assert validate_pod(

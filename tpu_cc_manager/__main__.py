@@ -34,7 +34,9 @@ from tpu_cc_manager.agent import CCManagerAgent
 from tpu_cc_manager.config import parse_config
 from tpu_cc_manager.drain import build_drainer, set_cc_mode_state_label
 from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
-from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+from tpu_cc_manager.k8s.client import (
+    ApiException, HttpKubeClient, KubeConfig,
+)
 from tpu_cc_manager.obs import setup_logging
 
 log = logging.getLogger("tpu-cc-manager")
@@ -177,9 +179,21 @@ def main(argv=None) -> int:
                 port=args.port,
                 verify_evidence=not args.no_verify_evidence,
             )
+            if args.once:
+                # cron/CI mode: one pass, report on stdout, exit code
+                # says whether every policy is in a healthy phase
+                report = controller.scan_once()
+                print(json.dumps(report, indent=2, sort_keys=True))
+                bad = sorted(
+                    name for name, st in report["policies"].items()
+                    if st["phase"] in ("Invalid", "Conflicted", "Degraded")
+                )
+                if bad:
+                    log.error("unhealthy policies: %s", bad)
+                return 1 if bad else 0
             _stop_on_sigterm(controller.stop)
             return controller.run()
-        except (ValueError, OSError) as e:
+        except (ValueError, OSError, ApiException) as e:
             log.error("policy-controller refused: %s", e)
             return 1
 

@@ -251,6 +251,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="trust cc.mode.state labels without cross-checking the "
              "per-node attestation evidence",
     )
+    pol.add_argument(
+        "--once", action="store_true",
+        help="run one reconcile pass, print the report, and exit "
+             "non-zero if any policy is Invalid/Conflicted/Degraded "
+             "(cron/CI usage)",
+    )
     wh = sub.add_parser(
         "webhook",
         help="run the admission webhook: steer pods labeled "

@@ -17,7 +17,9 @@ admission time:
 - **Validating** (``POST /validate``): reject specs that contradict the
   requirement — an explicit nodeSelector pinning a DIFFERENT mode, a
   toleration of the flip taint (which would let the pod land mid-flip,
-  exactly when the device gate is locked), or a nonsense required mode.
+  exactly when the device gate is locked), a direct ``spec.nodeName``
+  bind (which bypasses the scheduler and therefore the nodeSelector
+  guarantee entirely), or a nonsense required mode.
 
 Both endpoints speak the ``admission.k8s.io/v1`` AdmissionReview wire
 protocol over HTTPS (the API server refuses plaintext webhooks);
@@ -127,6 +129,17 @@ def validate_pod(pod: dict) -> Tuple[bool, str]:
         return False, str(e)
     if mode is None:
         return True, ""
+    if (pod.get("spec") or {}).get("nodeName"):
+        # spec.nodeName bypasses the scheduler entirely: the injected
+        # nodeSelector is never evaluated and the pod lands on the named
+        # node regardless of its mode — the one placement path the
+        # nodeSelector guarantee cannot cover, so it is refused outright
+        return False, (
+            f"pod requires cc mode {mode!r} but sets spec.nodeName, "
+            "which bypasses the scheduler (and therefore the "
+            "requires-cc placement guarantee); remove nodeName and let "
+            "the injected nodeSelector place it"
+        )
     selector = (pod.get("spec") or {}).get("nodeSelector") or {}
     pinned = selector.get(L.CC_MODE_STATE_LABEL)
     if pinned is not None and pinned != mode:
