@@ -1195,3 +1195,18 @@ def test_cli_policy_controller_once(monkeypatch, capsys):
     kube.add_custom(G, P, make_policy("broken", mode="bogus"))
     rc = cli.main(["policy-controller", "--once"])
     assert rc == 1
+
+
+def test_cli_once_fails_when_crd_missing(monkeypatch, capsys):
+    """A one-shot has no next tick: exiting green against a cluster
+    where the CRD is absent would lie to the pipeline."""
+    from tpu_cc_manager import __main__ as cli
+
+    class NoCrdKube(FakeKube):
+        def list_cluster_custom(self, *a, **k):
+            raise ApiException(404, "not found")
+
+    monkeypatch.setattr(cli, "_kube_client", lambda cfg: NoCrdKube())
+    rc = cli.main(["policy-controller", "--once"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["crd_missing"] is True

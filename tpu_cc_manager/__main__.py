@@ -182,11 +182,21 @@ def main(argv=None) -> int:
             if args.once:
                 # cron/CI mode: one pass, report on stdout, exit code
                 # says whether every policy is in a healthy phase
+                from tpu_cc_manager.policy import UNHEALTHY_PHASES
+
                 report = controller.scan_once()
                 print(json.dumps(report, indent=2, sort_keys=True))
+                if report.get("crd_missing"):
+                    # the long-running controller rides this out (next
+                    # tick retries) — a one-shot has no next tick, and a
+                    # green exit against a cluster where nothing can be
+                    # reconciled would lie to the pipeline
+                    log.error("TPUCCPolicy CRD not installed (or wrong "
+                              "cluster): nothing was reconciled")
+                    return 1
                 bad = sorted(
                     name for name, st in report["policies"].items()
-                    if st["phase"] in ("Invalid", "Conflicted", "Degraded")
+                    if st["phase"] in UNHEALTHY_PHASES
                 )
                 if bad:
                     log.error("unhealthy policies: %s", bad)

@@ -77,6 +77,11 @@ PHASES = (
     "Converged",
 )
 
+#: Phases that mean an operator must act — the health classification
+#: `policy-controller --once` (cron/CI) exits non-zero on. Lives here,
+#: next to PHASES, so a future phase is classified where it is defined.
+UNHEALTHY_PHASES = ("Invalid", "Conflicted", "Degraded")
+
 _STRATEGY_DEFAULTS = {
     "maxUnavailable": 1,
     "failureBudget": 0,
