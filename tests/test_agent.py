@@ -418,6 +418,8 @@ def test_agent_publishes_doctor_verdict_on_idle_tick(tmp_path):
         assert verdict["ok"] is True
         assert verdict["fail"] == []
         assert "at" in verdict
+        assert kube.get_node("n1")["metadata"]["labels"][
+            L.DOCTOR_OK_LABEL] == "true"
     finally:
         agent.shutdown()
         t.join(timeout=10)

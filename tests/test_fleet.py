@@ -219,3 +219,7 @@ def test_doctor_publish_round_trip(tmp_path, monkeypatch):
     fleet = FleetController(kube, port=0).scan_once()
     assert [d["node"] for d in fleet["doctor"]["failing"]] == ["pub-node"]
     assert "state-label" in fleet["doctor"]["failing"][0]["fail"]
+    # the selectable mirror: kubectl get nodes -l cc.doctor.ok=false
+    assert kube.get_node("pub-node")["metadata"]["labels"][
+        L.DOCTOR_OK_LABEL] == "false"
+    assert kube.list_nodes(f"{L.DOCTOR_OK_LABEL}=false")

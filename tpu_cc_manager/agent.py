@@ -399,11 +399,15 @@ class CCManagerAgent:
         if not report["ok"]:
             log.warning("doctor self-check failing: %s", summary["fail"])
 
+        ok_label = "true" if report["ok"] else "false"
+
         def task():
             try:
-                self.kube.set_node_annotations(self.cfg.node_name, {
-                    L.DOCTOR_ANNOTATION: payload,
-                })
+                # annotation = detail, label = selectable mirror
+                self.kube.patch_node(self.cfg.node_name, {"metadata": {
+                    "annotations": {L.DOCTOR_ANNOTATION: payload},
+                    "labels": {L.DOCTOR_OK_LABEL: ok_label},
+                }})
             except Exception as e:
                 log.warning("doctor verdict publish failed: %s", e)
 

@@ -317,11 +317,19 @@ def publish_report(kube, node_name: str, report: dict) -> bool:
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     try:
-        kube.set_node_annotations(node_name, {
-            L.DOCTOR_ANNOTATION: json.dumps(
-                summary, sort_keys=True, separators=(",", ":")
-            ),
-        })
+        # one merge patch for both: the annotation carries the detail,
+        # the label is the selectable mirror (kubectl get nodes
+        # -l cc.doctor.ok=false)
+        kube.patch_node(node_name, {"metadata": {
+            "annotations": {
+                L.DOCTOR_ANNOTATION: json.dumps(
+                    summary, sort_keys=True, separators=(",", ":")
+                ),
+            },
+            "labels": {
+                L.DOCTOR_OK_LABEL: "true" if summary["ok"] else "false",
+            },
+        }})
         return True
     except Exception:
         log.warning("doctor verdict publication failed", exc_info=True)

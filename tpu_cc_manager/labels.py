@@ -77,6 +77,12 @@ EVIDENCE_ANNOTATION = "tpu.google.com/cc.evidence"
 #: by the same cross-checks that catch lying labels.
 DOCTOR_ANNOTATION = "tpu.google.com/cc.doctor"
 
+#: Selectable mirror of the doctor verdict ("true"/"false"): label
+#: selectors can't see annotations, and operators need
+#: ``kubectl get nodes -l tpu.google.com/cc.doctor.ok=false`` to find
+#: the nodes failing trust-surface checks without parsing JSON.
+DOCTOR_OK_LABEL = "tpu.google.com/cc.doctor.ok"
+
 #: Durable rollout record (tpu_cc_manager.rollout): the group plan,
 #: per-group outcomes, and budget of the pool's current/last rollout,
 #: stored as an annotation on the pool's anchor node so an operator-side
