@@ -74,6 +74,12 @@ int g_api_port = 8001;
 std::string g_engine_cmd =
     "python3 -m tpu_cc_manager set-cc-mode -m %s";
 int g_watch_timeout_s = 300; /* TPU_CC_WATCH_TIMEOUT_S; tests shrink it */
+/* Periodic doctor self-check on the idle tick — native-path parity
+ * with the Python agent's _publish_doctor (TPU_CC_DOCTOR_INTERVAL_S,
+ * 0 disables). Runs only between reconciles (the hot loop's TIMEOUT
+ * branch), never concurrently with the engine. */
+std::string g_doctor_cmd = "python3 -m tpu_cc_manager doctor --publish";
+int g_doctor_interval_s = 300; /* TPU_CC_DOCTOR_INTERVAL_S */
 std::string g_token_file; /* BEARER_TOKEN_FILE; re-read per request —
                            * bound SA tokens rotate on disk (~1h) and a
                            * cached copy would 401 a long-lived daemon */
@@ -127,6 +133,23 @@ class SyncableModeConfig {
     return true;
   }
   void Wake() { cv_.notify_all(); }
+
+  enum GetResult { GOT, TIMEOUT, STOPPED };
+  /* bounded Get: returns TIMEOUT after timeout_ms with no change, so
+   * the hot loop can run idle-tick work (the periodic doctor exec)
+   * between reconciles — by construction never concurrently with one. */
+  GetResult GetFor(std::string *out, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool changed =
+        cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return g_stop.load() || (has_value_ && current_ != last_read_);
+        });
+    if (g_stop.load()) return STOPPED;
+    if (!changed) return TIMEOUT;
+    last_read_ = current_;
+    *out = current_;
+    return GOT;
+  }
 
  private:
   std::mutex mu_;
@@ -467,6 +490,31 @@ int run_engine(const std::string &mode) {
   return -1;
 }
 
+/* Idle-tick doctor self-check: exec the (fixed, operator-configured)
+ * doctor command; its own CLI publishes the cc.doctor annotation +
+ * selectable label. rc 1 means checks are FAILING — still published,
+ * logged here so the pod log carries it too. No state-label writes:
+ * the doctor is diagnosis, not reconciliation. */
+void run_doctor() {
+  const char *child_argv[] = {"sh", "-c", g_doctor_cmd.c_str(), nullptr};
+  pid_t pid = fork();
+  if (pid < 0) return;
+  if (pid == 0) {
+    execve("/bin/sh", const_cast<char *const *>(child_argv), environ);
+    _exit(127);
+  }
+  int status = 0;
+  while (waitpid(pid, &status, 0) < 0) {
+    if (errno != EINTR) return;
+  }
+  int rc = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+  if (rc == 1) {
+    logf("WARN", "doctor self-check reports failing checks");
+  } else if (rc != 0) {
+    logf("WARN", "doctor self-check could not run (rc=%d)", rc);
+  }
+}
+
 /* ------------------------------------------------------------- watcher */
 
 struct NodeState {
@@ -677,6 +725,12 @@ int main(int argc, char **argv) {
     }
   }
   if ((env = getenv("BEARER_TOKEN_FILE"))) g_token_file = env;
+  if ((env = getenv("TPU_CC_DOCTOR_CMD"))) g_doctor_cmd = env;
+  if ((env = getenv("TPU_CC_DOCTOR_INTERVAL_S"))) {
+    /* 0 disables; garbage parses to 0 via atoi, which is the safe
+     * reading (no surprise exec cadence) */
+    g_doctor_interval_s = atoi(env);
+  }
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&](const char *flag) -> const char * {
@@ -705,7 +759,8 @@ int main(int argc, char **argv) {
           "[--api-host H] [--api-port P] [--engine-cmd CMD] [--version]\n"
           "env: NODE_NAME DEFAULT_CC_MODE KUBE_API_HOST KUBE_API_PORT "
           "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE TPU_CC_WATCH_TIMEOUT_S "
-          "KUBE_API_TLS KUBE_CA_FILE TPU_CC_OPENSSL\n");
+          "KUBE_API_TLS KUBE_CA_FILE TPU_CC_OPENSSL "
+          "TPU_CC_DOCTOR_CMD TPU_CC_DOCTOR_INTERVAL_S\n");
       return 0;
     } else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
@@ -772,10 +827,21 @@ int main(int argc, char **argv) {
   SyncableModeConfig config;
   std::thread watcher(watch_loop, &config);
 
-  /* hot loop (reference cmd/main.go:155-170) */
+  /* hot loop (reference cmd/main.go:155-170), with an idle tick: when
+   * no change arrives within a second, the periodic doctor self-check
+   * may run — between reconciles by construction. */
+  time_t doctor_due = 0; /* first idle tick publishes */
   while (!g_stop.load()) {
     std::string value;
-    if (!config.Get(&value)) break;
+    SyncableModeConfig::GetResult r = config.GetFor(&value, 1000);
+    if (r == SyncableModeConfig::STOPPED) break;
+    if (r == SyncableModeConfig::TIMEOUT) {
+      if (g_doctor_interval_s > 0 && time(nullptr) >= doctor_due) {
+        doctor_due = time(nullptr) + g_doctor_interval_s;
+        run_doctor();
+      }
+      continue;
+    }
     std::string mode = value.empty() ? g_default_mode : value;
     if (mode.empty()) continue;
     int rc = run_engine(mode);

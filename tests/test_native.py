@@ -700,3 +700,75 @@ def test_cpp_agent_wrong_ca_rejected(native_build, tmp_path, tls_pki):
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+def test_cpp_agent_runs_doctor_on_idle_tick(native_build, apiserver, tmp_path):
+    """Native-path parity with the Python agent's periodic doctor
+    self-check: on idle ticks (never concurrently with a reconcile) the
+    agent execs TPU_CC_DOCTOR_CMD every TPU_CC_DOCTOR_INTERVAL_S."""
+    engine_file = tmp_path / "engine.txt"
+    doctor_file = tmp_path / "doctor.txt"
+    apiserver.store.add_node(
+        make_node("docnode", labels={L.CC_MODE_LABEL: "off"})
+    )
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="docnode",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        TPU_CC_ENGINE_CMD=f"echo %s >> {engine_file}",
+        TPU_CC_DOCTOR_CMD=f"echo tick >> {doctor_file}",
+        TPU_CC_DOCTOR_INTERVAL_S="1",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if (doctor_file.exists()
+                    and len(doctor_file.read_text().split()) >= 2):
+                break
+            time.sleep(0.1)
+        ticks = doctor_file.read_text().split() if doctor_file.exists() else []
+        assert len(ticks) >= 2, f"doctor never ran periodically: {ticks}"
+        # the reconcile path still worked alongside
+        assert engine_file.read_text().split() == ["off"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_cpp_agent_doctor_disabled_with_zero_interval(
+    native_build, apiserver, tmp_path
+):
+    doctor_file = tmp_path / "doctor.txt"
+    apiserver.store.add_node(
+        make_node("nodoc", labels={L.CC_MODE_LABEL: "off"})
+    )
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="nodoc",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        TPU_CC_ENGINE_CMD="true",
+        TPU_CC_DOCTOR_CMD=f"echo tick >> {doctor_file}",
+        TPU_CC_DOCTOR_INTERVAL_S="0",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        time.sleep(3)
+        assert not doctor_file.exists()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
