@@ -123,6 +123,233 @@ def test_parse_policy_spec_rejects(mutate, match):
         parse_policy_spec(pol)
 
 
+def test_window_open_semantics():
+    from tpu_cc_manager.policy import window_open
+
+    assert window_open(None, 0) and window_open(None, 1439)
+    day = (9 * 60, 17 * 60)  # 09:00-17:00
+    assert window_open(day, 9 * 60)
+    assert window_open(day, 12 * 60)
+    assert not window_open(day, 17 * 60)  # end exclusive
+    assert not window_open(day, 3 * 60)
+    night = (22 * 60, 4 * 60)  # 22:00-04:00 spans midnight
+    assert window_open(night, 23 * 60)
+    assert window_open(night, 2 * 60)
+    assert not window_open(night, 12 * 60)
+    frozen = (6 * 60, 6 * 60)  # start == end: never
+    assert not window_open(frozen, 6 * 60)
+
+
+def test_window_spec_validation():
+    pol = make_policy("w", strategy={"window": {"start": "26:00",
+                                                "end": "04:00"}})
+    with pytest.raises(PolicySpecError, match="out of range"):
+        parse_policy_spec(pol)
+    pol = make_policy("w", strategy={"window": "02:00-04:00"})
+    with pytest.raises(PolicySpecError, match="window"):
+        parse_policy_spec(pol)
+    spec = parse_policy_spec(make_policy(
+        "w", strategy={"window": {"start": "22:30", "end": "04:00"}}
+    ))
+    assert spec["window"] == (22 * 60 + 30, 4 * 60)
+
+
+def test_maintenance_window_gates_rollout_starts():
+    """Outside the window a divergent policy stays Pending with an
+    explanatory message; once the clock enters the window, the same
+    scan logic rolls it."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy("p", strategy={
+        "groupTimeoutSeconds": 10,
+        "window": {"start": "02:00", "end": "04:00"},
+    }))
+    clock = {"m": 12 * 60}  # noon: closed
+    c = PolicyController(kube, poll_s=0.02,
+                         utcnow_minutes_fn=lambda: clock["m"])
+    st = c.scan_once()["policies"]["p"]
+    assert st["phase"] == "Pending"
+    assert "maintenance window" in st["message"]
+    assert kube.get_node("n0")["metadata"]["labels"][L.CC_MODE_LABEL] \
+        == "off"  # nothing patched
+
+    clock["m"] = 3 * 60  # 03:00: open
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    try:
+        st = c.scan_once()["policies"]["p"]
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert st["phase"] == "Converged"
+
+
+# ---------------------------------------------------------------------------
+# canary groups (rollout layer)
+# ---------------------------------------------------------------------------
+
+def test_canary_serializes_then_widens_window():
+    """With canary=1 and max_unavailable=3, the first group must run
+    alone and succeed before the remaining groups run wide."""
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    names = [f"c{i}" for i in range(4)]
+    for n in names:
+        kube.add_node(_node(n, desired="off", state="off"))
+    concurrency = []
+
+    orig_set = kube.set_node_labels
+
+    def recording_set(name, labels):
+        if L.CC_MODE_LABEL in labels:
+            concurrency.append(name)
+        return orig_set(name, labels)
+
+    kube.set_node_labels = recording_set
+    agents = _ReactiveAgents(kube, names, delay_s=0.1)
+    agents.start()
+    try:
+        report = Rollout(kube, "on", max_unavailable=3, canary=1,
+                         poll_s=0.02, group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.ok
+    assert len(report.succeeded) == 4
+    # the canary (first group, name order) was patched strictly before
+    # any other group's desired label
+    assert concurrency[0] == "c0"
+    # by the time the second patch happened, the canary had converged
+    # (serial phase) — meaning c0's state was already 'on'
+    rec = json.loads(
+        kube.get_node(sorted(names)[0])["metadata"]["annotations"][
+            L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["canary_left"] == 0
+
+
+def test_canary_failure_aborts_despite_budget():
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    names = [f"c{i}" for i in range(3)]
+    for n in names:
+        kube.add_node(_node(n, desired="off", state="off"))
+    agents = _ReactiveAgents(kube, names, fail_nodes={"c0"})
+    agents.start()
+    try:
+        report = Rollout(kube, "on", canary=1, failure_budget=5,
+                         poll_s=0.02, group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.aborted
+    by = {g.name: g.outcome for g in report.groups}
+    assert by["node/c0"] == "failed"
+    # the budget (5) would have tolerated it; the canary does not
+    assert by["node/c1"] == "not_attempted"
+    assert by["node/c2"] == "not_attempted"
+
+
+def test_canary_failure_and_abort_persist_in_one_write():
+    """The abort flag must ride in the SAME record write as the failed
+    canary outcome: a crash between two separate persists would leave a
+    record that resumes as a budget-excused ordinary failure, wide
+    window and all."""
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    for i in range(3):
+        kube.add_node(_node(f"c{i}", desired="off", state="off"))
+    snapshots = []
+    orig = kube.set_node_annotations
+
+    def recording(name, ann):
+        if L.ROLLOUT_ANNOTATION in ann:
+            snapshots.append(json.loads(ann[L.ROLLOUT_ANNOTATION]))
+        return orig(name, ann)
+
+    kube.set_node_annotations = recording
+    agents = _ReactiveAgents(kube, [f"c{i}" for i in range(3)],
+                             fail_nodes={"c0"})
+    agents.start()
+    try:
+        report = Rollout(kube, "on", canary=1, failure_budget=5,
+                         poll_s=0.02, group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.aborted
+    # EVERY persisted record in which the canary shows 'failed' must
+    # already carry aborted=true — no intermediate crash window
+    saw_failed = False
+    for rec in snapshots:
+        if rec.get("groups", {}).get("node/c0", {}).get("outcome") \
+                == "failed":
+            saw_failed = True
+            assert rec.get("aborted") is True, rec
+    assert saw_failed
+
+
+def test_canary_discipline_survives_resume():
+    """A crash during the canary phase must not let the resumed rollout
+    skip the canary: canary_left rides in the durable record."""
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    for i in range(3):
+        kube.add_node(_node(f"c{i}", desired="off", state="off"))
+    # a crashed canary rollout: canary group in flight, 2 pending
+    record = {
+        "id": "cnry01", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 3,
+        "failure_budget": 0, "canary_left": 1,
+        "complete": False, "aborted": False,
+        "groups": {
+            "node/c0": {"nodes": ["c0"], "outcome": "in_flight"},
+            "node/c1": {"nodes": ["c1"], "outcome": "pending"},
+            "node/c2": {"nodes": ["c2"], "outcome": "pending"},
+        },
+    }
+    kube.set_node_annotations(
+        "c0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    # the canary node will FAIL: the resumed run must abort, not roll
+    # c1/c2 under the wide window
+    agents = _ReactiveAgents(kube, ["c0", "c1", "c2"],
+                             fail_nodes={"c0"})
+    agents.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.02,
+                                group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.aborted
+    by = {g.name: g.outcome for g in report.groups}
+    assert by["node/c1"] == "not_attempted"
+    assert by["node/c2"] == "not_attempted"
+
+
+def test_policy_canary_flows_through():
+    kube = FakeKube()
+    for i in range(2):
+        kube.add_node(_node(f"n{i}", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy("p", strategy={
+        "canary": 1, "maxUnavailable": 2, "groupTimeoutSeconds": 10,
+    }))
+    agents = _ReactiveAgents(kube, ["n0", "n1"])
+    agents.start()
+    try:
+        st = controller(kube).scan_once()["policies"]["p"]
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert st["phase"] == "Converged"
+    assert st["lastRollout"]["ok"] is True
+
+
 # ---------------------------------------------------------------------------
 # custom-resource plumbing: FakeKube semantics
 # ---------------------------------------------------------------------------

@@ -117,10 +117,12 @@ def main(argv=None) -> int:
                     log.error("--resume takes the mode from the durable "
                               "record; do not pass --mode")
                     return 1
-                if args.max_unavailable != 1 or args.failure_budget != 0:
-                    log.error("--resume takes the window and budget from "
-                              "the durable record; do not pass "
-                              "--max-unavailable/--failure-budget")
+                if (args.max_unavailable != 1 or args.failure_budget != 0
+                        or args.canary != 0):
+                    log.error("--resume takes the window, budget, and "
+                              "canary from the durable record; do not "
+                              "pass --max-unavailable/--failure-budget/"
+                              "--canary")
                     return 1
                 rollout = Rollout.resume(
                     _kube_client(cfg),
@@ -139,6 +141,7 @@ def main(argv=None) -> int:
                     selector=args.selector,
                     max_unavailable=args.max_unavailable,
                     failure_budget=args.failure_budget,
+                    canary=args.canary,
                     group_timeout_s=args.group_timeout,
                     force=args.force,
                     dry_run=args.dry_run,

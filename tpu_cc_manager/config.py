@@ -192,6 +192,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="failed groups tolerated before aborting (default 0)",
     )
     roll.add_argument(
+        "--canary", type=int, default=0,
+        help="first N groups roll serially and must each succeed "
+             "before the window opens; any canary failure aborts "
+             "(default 0 = no canary)",
+    )
+    roll.add_argument(
         "--group-timeout", type=float, default=600.0,
         help="seconds to wait for one group to converge (default 600)",
     )

@@ -86,11 +86,50 @@ _STRATEGY_DEFAULTS = {
     "maxUnavailable": 1,
     "failureBudget": 0,
     "groupTimeoutSeconds": 600,
+    "canary": 0,
 }
 
 
 class PolicySpecError(ValueError):
     """The policy's spec cannot be acted on (bad mode, bad strategy)."""
+
+
+def _parse_hhmm(value, field: str) -> int:
+    """'HH:MM' -> minutes since midnight; raises PolicySpecError."""
+    if (not isinstance(value, str) or len(value) != 5
+            or value[2] != ":"):
+        raise PolicySpecError(
+            f"{field}: expected 'HH:MM' (UTC), got {value!r}"
+        )
+    try:
+        h, m = int(value[:2]), int(value[3:])
+    except ValueError:
+        raise PolicySpecError(
+            f"{field}: expected 'HH:MM' (UTC), got {value!r}"
+        ) from None
+    if not (0 <= h <= 23 and 0 <= m <= 59):
+        raise PolicySpecError(f"{field}: {value!r} out of range")
+    return h * 60 + m
+
+
+def _utc_minutes_now() -> int:
+    t = time.gmtime()
+    return t.tm_hour * 60 + t.tm_min
+
+
+def window_open(window, now_minutes: int) -> bool:
+    """Is ``now_minutes`` (UTC minutes since midnight) inside the
+    maintenance window? None = always open. A window whose start is
+    after its end spans midnight (22:00-04:00). start == end means a
+    zero-length window, i.e. never open — an explicit freeze."""
+    if window is None:
+        return True
+    start, end = window
+    if start == end:
+        return False
+    if start < end:
+        return start <= now_minutes < end
+    return now_minutes >= start or now_minutes < end
 
 
 def parse_policy_spec(policy: dict) -> dict:
@@ -118,6 +157,7 @@ def parse_policy_spec(policy: dict) -> dict:
         max_unavailable = int(strategy["maxUnavailable"])
         failure_budget = int(strategy["failureBudget"])
         group_timeout = float(strategy["groupTimeoutSeconds"])
+        canary = int(strategy["canary"])
     except (TypeError, ValueError) as e:
         raise PolicySpecError(f"spec.strategy: {e}") from None
     if max_unavailable < 1:
@@ -128,6 +168,21 @@ def parse_policy_spec(policy: dict) -> dict:
         raise PolicySpecError(
             "spec.strategy.groupTimeoutSeconds must be > 0"
         )
+    if canary < 0:
+        raise PolicySpecError("spec.strategy.canary must be >= 0")
+    window = None
+    raw_window = strategy.get("window")
+    if raw_window is not None:
+        if not isinstance(raw_window, dict):
+            raise PolicySpecError(
+                "spec.strategy.window must be {start, end} ('HH:MM' UTC)"
+            )
+        window = (
+            _parse_hhmm(raw_window.get("start"),
+                        "spec.strategy.window.start"),
+            _parse_hhmm(raw_window.get("end"),
+                        "spec.strategy.window.end"),
+        )
     return {
         "mode": mode,
         "selector": selector,
@@ -135,6 +190,9 @@ def parse_policy_spec(policy: dict) -> dict:
         "max_unavailable": max_unavailable,
         "failure_budget": failure_budget,
         "group_timeout_s": group_timeout,
+        "canary": canary,
+        "window": window,
+        "window_raw": raw_window,
     }
 
 
@@ -190,6 +248,7 @@ class PolicyController:
         max_consecutive_errors: int = 10,
         verify_evidence: bool = True,
         adopt_after_s: float = HEARTBEAT_STALE_S,
+        utcnow_minutes_fn=None,
     ):
         if interval_s <= 0:
             raise ValueError(
@@ -207,6 +266,9 @@ class PolicyController:
         self._warned_no_crd = False
         self._event_warned = False
         self.adopt_after_s = adopt_after_s
+        #: injectable clock for maintenance-window checks (tests):
+        #: returns UTC minutes since midnight
+        self._utcnow_minutes = utcnow_minutes_fn or _utc_minutes_now
         #: heartbeat observation per record id: (last value seen,
         #: monotonic time it was FIRST seen unchanged). Staleness is
         #: judged on this controller's own clock by watching whether the
@@ -322,7 +384,18 @@ class PolicyController:
             # an empty pool is Pending but not actionable: there is
             # nothing to roll until nodes appear
             if st["phase"] == "Pending" and own:
-                actionable.append((pol, spec))
+                if not window_open(spec["window"],
+                                   self._utcnow_minutes()):
+                    # maintenance windows gate rollout STARTS only —
+                    # an in-flight/adopted rollout still finishes, since
+                    # abandoning half-flipped state at the window edge
+                    # would be worse than overrunning it
+                    st["message"] += (
+                        "; waiting for maintenance window "
+                        f"{spec['window_raw']}"
+                    )
+                else:
+                    actionable.append((pol, spec))
 
         # ---- pass 2: adopt any unfinished rollout left on the pool
         # (this controller's crashed run, or an operator's) before
@@ -580,6 +653,7 @@ class PolicyController:
                 selector=spec["selector"],
                 max_unavailable=spec["max_unavailable"],
                 failure_budget=spec["failure_budget"],
+                canary=spec["canary"],
                 group_timeout_s=spec["group_timeout_s"],
                 poll_s=self.poll_s,
                 verify_evidence=self.verify_evidence,

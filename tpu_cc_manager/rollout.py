@@ -189,6 +189,7 @@ class Rollout:
         selector: str = L.TPU_ACCELERATOR_LABEL,
         max_unavailable: int = 1,
         failure_budget: int = 0,
+        canary: int = 0,
         group_timeout_s: float = 600.0,
         poll_s: float = 0.5,
         force: bool = False,
@@ -202,6 +203,14 @@ class Rollout:
             raise RolloutError("max_unavailable must be >= 1")
         self.max_unavailable = max_unavailable
         self.failure_budget = failure_budget
+        if canary < 0:
+            raise RolloutError("canary must be >= 0")
+        #: first ``canary`` to-run groups launch serially (window 1)
+        #: and must each SUCCEED before the configured window opens; any
+        #: canary failure/timeout aborts the rollout outright (the
+        #: failure budget never excuses a canary — it exists to prove
+        #: the flip before the blast radius widens)
+        self.canary = canary
         self.group_timeout_s = group_timeout_s
         self.poll_s = poll_s
         self.force = force
@@ -234,6 +243,9 @@ class Rollout:
         #: set by resume(): the first persist claims the record from its
         #: previous (presumed-dead) owner instead of fencing against it
         self._force_claim = False
+        #: canary groups still to prove (set by run(); persisted in the
+        #: record so a resumed rollout keeps its canary discipline)
+        self._canary_left = 0
 
     @classmethod
     def resume(
@@ -421,6 +433,12 @@ class Rollout:
         if self._resume_from is not None:
             # -------- resume: the record, not re-planning, is the truth
             self._record, self._record_node = self._resume_from
+            try:
+                self._canary_left = max(
+                    0, int(self._record.get("canary_left", 0) or 0)
+                )
+            except (TypeError, ValueError):
+                self._canary_left = 0
             groups_rec = self._record.get("groups", {})
             relaunch = deque()
             for gname in sorted(groups_rec):
@@ -513,6 +531,7 @@ class Rollout:
                 import uuid as _uuid
 
                 self._record_node = sorted(by_name)[0]  # pool anchor
+                self._canary_left = min(self.canary, len(pending))
                 self._record = {
                     "id": _uuid.uuid4().hex[:8],
                     "started": time.time(),
@@ -520,6 +539,7 @@ class Rollout:
                     "selector": self.selector,
                     "max_unavailable": self.max_unavailable,
                     "failure_budget": self.failure_budget,
+                    "canary_left": self._canary_left,
                     "complete": False,
                     "aborted": False,
                     "groups": {},
@@ -560,13 +580,19 @@ class Rollout:
                 members, time.monotonic() + self.group_timeout_s,
                 stale_failed,
             )
+        canary_groups: set = set()
         while pending or in_flight:
             while (
                 pending
                 and budget >= 0
                 and not report.aborted
-                and len(in_flight) < self.max_unavailable
+                # canary phase: serial (window 1) until every canary
+                # group has been judged, regardless of max_unavailable
+                and len(in_flight) < (
+                    1 if self._canary_left > 0 else self.max_unavailable
+                )
             ):
+                was_canary = self._canary_left > 0
                 gname, members = pending.popleft()
                 # a member that vanished from the pool while the group sat
                 # in the queue (GKE node repair/deletion) fails the group
@@ -577,6 +603,9 @@ class Rollout:
                               f"launch: {gone}")
                     results.append(GroupResult(gname, members, "failed",
                                                detail))
+                    if was_canary:
+                        self._canary_failed(report, gname, "vanished",
+                                            persist=False)
                     self._record_group(gname, members, "failed", detail)
                     budget -= 1
                     continue
@@ -595,6 +624,8 @@ class Rollout:
                 # relaunches it (idempotent patch) instead of losing it
                 self._record_group(gname, members, "in_flight")
                 if self._launch(gname, members, by_name):
+                    if was_canary:
+                        canary_groups.add(gname)
                     in_flight[gname] = (
                         members,
                         time.monotonic() + self.group_timeout_s,
@@ -605,6 +636,9 @@ class Rollout:
                     results.append(
                         GroupResult(gname, members, "failed", detail)
                     )
+                    if was_canary:
+                        self._canary_failed(report, gname, "launch failed",
+                                            persist=False)
                     self._record_group(gname, members, "failed", detail)
                     budget -= 1
 
@@ -630,6 +664,18 @@ class Rollout:
                         continue
                     del in_flight[gname]
                     results.append(outcome)
+                    was_canary_group = gname in canary_groups
+                    if was_canary_group:
+                        canary_groups.discard(gname)
+                        self._canary_left = max(0, self._canary_left - 1)
+                        if self._record is not None:
+                            self._record["canary_left"] = self._canary_left
+                        if outcome.outcome != "succeeded":
+                            # set the abort flag BEFORE the outcome
+                            # persist below: one write carries both
+                            self._canary_failed(report, gname,
+                                                outcome.outcome,
+                                                persist=False)
                     self._record_group(
                         gname, outcome.nodes, outcome.outcome,
                         outcome.detail,
@@ -670,6 +716,27 @@ class Rollout:
         self._finish_record(report)
         report.groups.sort(key=lambda g: g.name)
         return report
+
+    def _canary_failed(self, report: RolloutReport, gname: str,
+                       how: str, persist: bool = True) -> None:
+        """A canary group did not succeed: abort outright — the canary
+        exists to prove the flip BEFORE the blast radius widens, so the
+        failure budget never excuses it. Callers that persist the group
+        outcome right after pass ``persist=False`` so ONE write carries
+        both the outcome and the abort flag — a crash between two
+        separate persists would leave a record that resumes as a
+        budget-excused ordinary failure, wide window and all."""
+        if report.aborted:
+            return
+        report.aborted = True
+        if self._record is not None:
+            self._record["aborted"] = True
+            if persist:
+                self._persist()
+        log.error(
+            "canary group %s did not succeed (%s); aborting rollout",
+            gname, how,
+        )
 
     def _finish_record(self, report: RolloutReport) -> None:
         """Mark the durable record complete (kept for audit; the next
