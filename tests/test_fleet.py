@@ -223,3 +223,42 @@ def test_doctor_publish_round_trip(tmp_path, monkeypatch):
     assert kube.get_node("pub-node")["metadata"]["labels"][
         L.DOCTOR_OK_LABEL] == "false"
     assert kube.list_nodes(f"{L.DOCTOR_OK_LABEL}=false")
+
+
+def test_fleet_problems_classification():
+    from tpu_cc_manager.fleet import fleet_problems
+
+    clean = {
+        "failed": [], "needs_flip": ["n1"],  # divergence alone is fine
+        "evidence_audit": {"missing": ["n9"], "invalid": [],
+                           "label_device_mismatch": []},
+        "doctor": {"reported": 1, "failing": []},
+        "half_flipped_slices": [],
+    }
+    assert fleet_problems(clean) == []
+    dirty = {
+        "failed": ["n2"],
+        "evidence_audit": {"missing": [], "invalid": ["n3"],
+                           "label_device_mismatch": ["n4"]},
+        "doctor": {"failing": [{"node": "n5", "fail": ["gate-perms"]}]},
+        "half_flipped_slices": ["s1"],
+    }
+    problems = fleet_problems(dirty)
+    assert len(problems) == 5
+    assert any("n2" in p for p in problems)
+    assert any("s1" in p for p in problems)
+
+
+def test_cli_fleet_controller_once(monkeypatch, capsys):
+    from tpu_cc_manager import __main__ as cli
+
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    monkeypatch.setattr(cli, "_kube_client", lambda cfg: kube)
+    rc = cli.main(["fleet-controller", "--once"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["nodes"] == 1
+
+    kube.add_node(_node("n2", desired="on", state="failed"))
+    rc = cli.main(["fleet-controller", "--once"])
+    assert rc == 1

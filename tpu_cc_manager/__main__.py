@@ -155,7 +155,7 @@ def main(argv=None) -> int:
         return 0 if report.ok else 1
 
     if args.command == "fleet-controller":
-        from tpu_cc_manager.fleet import FleetController
+        from tpu_cc_manager.fleet import FleetController, fleet_problems
 
         try:
             controller = FleetController(
@@ -164,11 +164,21 @@ def main(argv=None) -> int:
                 interval_s=args.interval,
                 port=args.port,
             )
+            if args.once:
+                # cron/CI audit: one scan, report on stdout, exit code
+                # says whether the fleet has problems an operator must
+                # look at
+                report = controller.scan_once()
+                print(json.dumps(report, indent=2, sort_keys=True))
+                problems = fleet_problems(report)
+                if problems:
+                    log.error("fleet audit found problems: %s", problems)
+                return 1 if problems else 0
             _stop_on_sigterm(controller.stop)
             # OSError belongs inside the guard too: RouteServer binds
             # lazily in run(), so a busy --port surfaces here
             return controller.run()
-        except (ValueError, OSError) as e:
+        except (ValueError, OSError, ApiException) as e:
             log.error("fleet-controller refused: %s", e)
             return 1
 

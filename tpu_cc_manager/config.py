@@ -235,6 +235,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=int(os.environ.get("FLEET_PORT", "8090")),
         help="HTTP port for /metrics, /report, /healthz (default 8090)",
     )
+    fleet.add_argument(
+        "--once", action="store_true",
+        help="run one fleet scan, print the report, and exit non-zero "
+             "if the audit found problems (failed nodes, evidence "
+             "issues, failing doctor verdicts, half-flipped slices) — "
+             "cron/CI usage",
+    )
     pol = sub.add_parser(
         "policy-controller",
         help="run the declarative TPUCCPolicy controller: continuously "

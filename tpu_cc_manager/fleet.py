@@ -38,6 +38,34 @@ from tpu_cc_manager.plan import analyze_fleet
 log = logging.getLogger("tpu-cc-manager.fleet")
 
 
+def fleet_problems(report: dict) -> List[str]:
+    """The audit findings that mean an operator must look — the health
+    classification ``fleet-controller --once`` (cron/CI) exits non-zero
+    on. Lives here, next to the report shape, so a new report section
+    is classified where it is produced. Divergence alone is NOT a
+    problem (agents may simply still be converging); failures, evidence
+    contradictions, failing doctor verdicts, and half-flipped slices
+    are."""
+    problems: List[str] = []
+    if report.get("failed"):
+        problems.append(f"failed nodes: {sorted(report['failed'])}")
+    audit = report.get("evidence_audit") or {}
+    for issue in ("invalid", "label_device_mismatch"):
+        if audit.get(issue):
+            problems.append(f"evidence {issue}: {sorted(audit[issue])}")
+    doctor = report.get("doctor") or {}
+    if doctor.get("failing"):
+        problems.append(
+            "doctor failing: "
+            f"{sorted(d['node'] for d in doctor['failing'])}"
+        )
+    if report.get("half_flipped_slices"):
+        problems.append(
+            f"half-flipped slices: {sorted(report['half_flipped_slices'])}"
+        )
+    return problems
+
+
 class FleetMetrics:
     def __init__(self):
         self.nodes = Gauge("tpu_cc_fleet_nodes", "Nodes in the fleet")
