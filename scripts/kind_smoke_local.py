@@ -352,6 +352,35 @@ def main():
                 failures.append(
                     f"webhook selector {injected} vs node {node_state}"
                 )
+
+            # 9. the diagnostic tour: doctor on the node (healthy ->
+            # rc 0, verdict published) and the one-shot fleet audit
+            r = subprocess.run(
+                [sys.executable, "-m", "tpu_cc_manager", "doctor",
+                 "--publish"],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            verdict = store.get_node(NODE)["metadata"].get(
+                "annotations", {}).get(L.DOCTOR_ANNOTATION)
+            if r.returncode == 0 and verdict and json.loads(verdict)["ok"]:
+                log("PASS doctor: healthy node, verdict published "
+                    "(cc.doctor.ok label set)")
+            else:
+                failures.append(
+                    f"doctor rc={r.returncode}: {r.stdout[-400:]}"
+                )
+            r = subprocess.run(
+                [sys.executable, "-m", "tpu_cc_manager",
+                 "fleet-controller", "--once"],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            if r.returncode == 0:
+                log("PASS fleet-controller --once: audit clean (rc 0)")
+            else:
+                failures.append(
+                    f"fleet --once rc={r.returncode}: "
+                    f"{(r.stdout + r.stderr)[-400:]}"
+                )
         finally:
             proc.terminate()
             try:

@@ -252,6 +252,19 @@ def test_canary_failure_aborts_despite_budget():
     assert by["node/c2"] == "not_attempted"
 
 
+def test_canary_dry_run_preview_marks_canary_groups():
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    for i in range(3):
+        kube.add_node(_node(f"c{i}", desired="off", state="off"))
+    report = Rollout(kube, "on", canary=1, dry_run=True).run()
+    by = {g.name: g for g in report.groups}
+    assert by["node/c0"].detail == "canary: serial, must succeed"
+    assert by["node/c1"].detail == ""
+    assert by["node/c2"].detail == ""
+
+
 def test_canary_failure_and_abort_persist_in_one_write():
     """The abort flag must ride in the SAME record write as the failed
     canary outcome: a crash between two separate persists would leave a

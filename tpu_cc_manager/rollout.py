@@ -524,7 +524,17 @@ class Rollout:
                                     f"already at {self.mode}")
                     )
                 elif self.dry_run:
-                    results.append(GroupResult(gname, members, "planned"))
+                    # the preview marks which groups would canary (the
+                    # first N to-run groups, matching the live run's
+                    # pending order)
+                    planned_so_far = sum(
+                        1 for r in results if r.outcome == "planned"
+                    )
+                    detail = ("canary: serial, must succeed"
+                              if planned_so_far < self.canary else "")
+                    results.append(
+                        GroupResult(gname, members, "planned", detail)
+                    )
                 else:
                     pending.append((gname, members))
             if not self.dry_run:
