@@ -1401,6 +1401,42 @@ def test_missing_crd_is_a_deployment_race_not_a_crash():
     assert c.scan_once()["policies"]["p"]["phase"] == "Converged"
 
 
+def test_missing_crd_does_not_busy_scan():
+    """With the CRD absent, the watch 404s and retries — but each retry
+    must NOT wake a gap-covering scan (there is nothing to reconcile),
+    or the CRD-missing state becomes a scan loop at the watch backoff
+    cadence instead of the interval."""
+    scans = []
+
+    class NoCrdKube(FakeKube):
+        def list_cluster_custom(self, *a, **k):
+            raise ApiException(404, "not found")
+
+        def watch_cluster_custom(self, *a, **k):
+            raise ApiException(404, "not found")
+
+    class Counting(PolicyController):
+        def scan_once(self):
+            scans.append(time.monotonic())
+            return super().scan_once()
+
+    c = Counting(NoCrdKube(), interval_s=3600, poll_s=0.02)
+    c.watch_backoff_s = 0.05
+    t = threading.Thread(target=c.run, daemon=True)
+    t.start()
+    try:
+        time.sleep(1.5)
+        # ~30 watch retries happened; scans must stay at startup count
+        # (1 initial + at most 1 from the startup gap-wake race)
+        assert len(scans) <= 2, (
+            f"{len(scans)} scans in 1.5s: 404 retries are waking the "
+            "scan loop"
+        )
+    finally:
+        c.stop()
+        t.join(timeout=10)
+
+
 def test_scan_failure_degrades_healthz():
     class BrokenKube(FakeKube):
         def list_cluster_custom(self, *a, **k):

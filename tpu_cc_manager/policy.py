@@ -283,6 +283,7 @@ class PolicyController:
         #: fallback for node-side drift the policy watch can't see
         self._wake = threading.Event()
         self.watch_timeout_s = 300
+        self.watch_backoff_s = 5.0
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
         self._server.add_route("/metrics", self._metrics_route)
@@ -771,14 +772,19 @@ class PolicyController:
         both are expected deployment states."""
         rv = None
         gens: Dict[str, object] = {}  # name -> last generation seen
+        gap_scan = True
         while not self._stop.is_set():
-            if rv is None:
+            if rv is None and gap_scan:
                 # a from-scratch watch (startup, or reconnect after an
                 # outage/410) starts at "now" and cannot replay what
                 # happened before it — wake one scan to cover the gap.
                 # Set HERE, after any backoff sleep, so events that
-                # landed during the sleep are inside the covered window
+                # landed during the sleep are inside the covered window.
+                # NOT after a 404 (CRD absent): there is nothing a scan
+                # could reconcile, and waking per retry would turn the
+                # CRD-missing state into a 5-second scan loop
                 self._wake.set()
+            gap_scan = True
             try:
                 for etype, obj in self.kube.watch_cluster_custom(
                     L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL,
@@ -809,14 +815,17 @@ class PolicyController:
                     return
                 # stale rv (410) or transient failure: back off, then
                 # restart from "now" (the rv=None branch above wakes
-                # one gap-covering scan on reconnect)
+                # one gap-covering scan on reconnect). 404 = CRD not
+                # installed: keep retrying quietly, but without the
+                # gap-scan wake
                 rv = None
-                self._stop.wait(5.0)
+                gap_scan = e.status != 404
+                self._stop.wait(self.watch_backoff_s)
             except Exception:
                 log.warning("policy watch failed; retrying",
                             exc_info=True)
                 rv = None
-                self._stop.wait(5.0)
+                self._stop.wait(self.watch_backoff_s)
 
     def run(self) -> int:
         self._server.start()
