@@ -1401,29 +1401,40 @@ def test_missing_crd_is_a_deployment_race_not_a_crash():
     assert c.scan_once()["policies"]["p"]["phase"] == "Converged"
 
 
-def test_missing_crd_does_not_busy_scan():
-    """With the CRD absent, the watch 404s and retries — but each retry
-    must NOT wake a gap-covering scan (there is nothing to reconcile),
-    or the CRD-missing state becomes a scan loop at the watch backoff
-    cadence instead of the interval."""
+def test_missing_crd_does_not_busy_scan_but_recovers_promptly():
+    """With the CRD absent, the watch layer probes quietly — no
+    gap-scan wakes per retry (that would be a scan loop at backoff
+    cadence). But the MOMENT the CRD appears, the probe's success must
+    wake a scan: a policy created before the watch establishes would
+    otherwise wait out watch_timeout_s/interval_s."""
     scans = []
+    crd = {"installed": False}
 
-    class NoCrdKube(FakeKube):
+    class RacingKube(FakeKube):
         def list_cluster_custom(self, *a, **k):
-            raise ApiException(404, "not found")
+            if not crd["installed"]:
+                raise ApiException(404, "not found")
+            return super().list_cluster_custom(*a, **k)
 
         def watch_cluster_custom(self, *a, **k):
-            raise ApiException(404, "not found")
+            if not crd["installed"]:
+                raise ApiException(404, "not found")
+            return super().watch_cluster_custom(*a, **k)
 
     class Counting(PolicyController):
         def scan_once(self):
             scans.append(time.monotonic())
             return super().scan_once()
 
-    c = Counting(NoCrdKube(), interval_s=3600, poll_s=0.02)
+    kube = RacingKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    c = Counting(kube, interval_s=3600, poll_s=0.02)
     c.watch_backoff_s = 0.05
+    c.watch_timeout_s = 300  # deliberately long: only the probe wake
     t = threading.Thread(target=c.run, daemon=True)
     t.start()
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
     try:
         time.sleep(1.5)
         # ~30 watch retries happened; scans must stay at startup count
@@ -1432,7 +1443,31 @@ def test_missing_crd_does_not_busy_scan():
             f"{len(scans)} scans in 1.5s: 404 retries are waking the "
             "scan loop"
         )
+        # CRD + policy land while the watch is still down: the probe's
+        # first success must wake the scan that reconciles it
+        crd["installed"] = True
+        kube.add_custom(G, P, make_policy(
+            "late", strategy={"groupTimeoutSeconds": 10},
+        ))
+        deadline = time.monotonic() + 10
+        phase = None
+        while time.monotonic() < deadline:
+            try:
+                phase = kube.get_cluster_custom(
+                    G, V, P, "late"
+                ).get("status", {}).get("phase")
+            except ApiException:
+                phase = None
+            if phase == "Converged":
+                break
+            time.sleep(0.05)
+        assert phase == "Converged", (
+            "policy created during the CRD-install window was not "
+            "reconciled promptly"
+        )
     finally:
+        agents.stop.set()
+        agents.join(timeout=2)
         c.stop()
         t.join(timeout=10)
 

@@ -772,19 +772,39 @@ class PolicyController:
         both are expected deployment states."""
         rv = None
         gens: Dict[str, object] = {}  # name -> last generation seen
-        gap_scan = True
+        crd_absent = False
         while not self._stop.is_set():
-            if rv is None and gap_scan:
+            if crd_absent:
+                # CRD not installed: probe with a cheap list instead of
+                # watch attempts. No wakes while it 404s (nothing a
+                # scan could reconcile — waking per retry would turn
+                # the CRD-missing state into a backoff-cadence scan
+                # loop); the moment the list succeeds we fall through,
+                # and the rv-None gap wake below covers any policies
+                # created before the watch establishes
+                try:
+                    self.kube.list_cluster_custom(
+                        L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL
+                    )
+                except ApiException as e:
+                    if e.status == 501:
+                        log.info("client has no CR watch support; "
+                                 "interval polling only")
+                        return
+                    self._stop.wait(self.watch_backoff_s)
+                    continue
+                except Exception:
+                    self._stop.wait(self.watch_backoff_s)
+                    continue
+                crd_absent = False
+            if rv is None:
                 # a from-scratch watch (startup, or reconnect after an
-                # outage/410) starts at "now" and cannot replay what
-                # happened before it — wake one scan to cover the gap.
-                # Set HERE, after any backoff sleep, so events that
-                # landed during the sleep are inside the covered window.
-                # NOT after a 404 (CRD absent): there is nothing a scan
-                # could reconcile, and waking per retry would turn the
-                # CRD-missing state into a 5-second scan loop
+                # outage/410/CRD install) starts at "now" and cannot
+                # replay what happened before it — wake one scan to
+                # cover the gap. Set HERE, after any backoff sleep, so
+                # events that landed during the sleep are inside the
+                # covered window
                 self._wake.set()
-            gap_scan = True
             try:
                 for etype, obj in self.kube.watch_cluster_custom(
                     L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL,
@@ -816,10 +836,9 @@ class PolicyController:
                 # stale rv (410) or transient failure: back off, then
                 # restart from "now" (the rv=None branch above wakes
                 # one gap-covering scan on reconnect). 404 = CRD not
-                # installed: keep retrying quietly, but without the
-                # gap-scan wake
+                # installed: switch to the quiet probe loop above
                 rv = None
-                gap_scan = e.status != 404
+                crd_absent = e.status == 404
                 self._stop.wait(self.watch_backoff_s)
             except Exception:
                 log.warning("policy watch failed; retrying",
