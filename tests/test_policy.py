@@ -745,6 +745,48 @@ def test_overlapping_policies_conflict_name_order():
     assert labels[L.CC_MODE_LABEL] == "on"
 
 
+def test_status_conditions_follow_k8s_conventions():
+    """`kubectl wait --for=condition=Converged tpuccpolicy/x` relies on
+    a conventional conditions array whose lastTransitionTime only moves
+    on an actual status flip."""
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.add_custom(G, P, make_policy("p"))
+    c = controller(kube)
+    c.scan_once()
+    conds = {
+        cd["type"]: cd
+        for cd in kube.get_cluster_custom(G, V, P, "p")["status"][
+            "conditions"]
+    }
+    assert conds["Converged"]["status"] == "True"
+    assert conds["Healthy"]["status"] == "True"
+    assert conds["Converged"]["reason"] == "Converged"
+    t0 = conds["Converged"]["lastTransitionTime"]
+
+    c.scan_once()  # steady state: no flip, no time movement, no write
+    conds2 = {
+        cd["type"]: cd
+        for cd in kube.get_cluster_custom(G, V, P, "p")["status"][
+            "conditions"]
+    }
+    assert conds2["Converged"]["lastTransitionTime"] == t0
+
+    # pause: Converged flips False (phase Paused), Healthy stays True
+    kube.patch_cluster_custom(G, V, P, "p", {"spec": {"paused": True}})
+    c.scan_once()
+    conds3 = {
+        cd["type"]: cd
+        for cd in kube.get_cluster_custom(G, V, P, "p")["status"][
+            "conditions"]
+    }
+    assert conds3["Converged"]["status"] == "False"
+    assert conds3["Converged"]["reason"] == "Paused"
+    assert conds3["Healthy"]["status"] == "True"
+    assert conds3["Healthy"]["lastTransitionTime"] == \
+        conds["Healthy"]["lastTransitionTime"]
+
+
 def test_observed_generation_tracks_spec_changes():
     kube = FakeKube()
     kube.add_node(_node("n1", desired="on", state="on"))

@@ -708,6 +708,39 @@ class PolicyController:
         return "aborted" if report.aborted else "failed"
 
     # ------------------------------------------------------------- status
+    def _conditions(self, pol: dict, status: dict) -> List[dict]:
+        """k8s-conventional ``status.conditions``, derived from the
+        phase, so ``kubectl wait --for=condition=Converged
+        tpuccpolicy/<name>`` works. ``lastTransitionTime`` only moves
+        when a condition's status actually flips (preserved from the
+        live object otherwise — the convention kubectl and controllers
+        rely on)."""
+        live = {
+            c.get("type"): c
+            for c in (pol.get("status") or {}).get("conditions") or []
+        }
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        out = []
+        for ctype, is_true in (
+            ("Converged", status["phase"] == "Converged"),
+            ("Healthy", status["phase"] not in UNHEALTHY_PHASES),
+        ):
+            value = "True" if is_true else "False"
+            prev = live.get(ctype)
+            out.append({
+                "type": ctype,
+                "status": value,
+                "reason": status["phase"],
+                "message": status["message"],
+                "lastTransitionTime": (
+                    prev["lastTransitionTime"]
+                    if prev and prev.get("status") == value
+                    and prev.get("lastTransitionTime")
+                    else now
+                ),
+            })
+        return out
+
     def _patch_status(self, pol: dict, status: dict) -> None:
         """Best-effort status publication — a status write failure must
         not stop reconciliation of the remaining policies. No-op patches
@@ -718,6 +751,7 @@ class PolicyController:
         gets its first write immediately, and nothing accumulates for
         policies that no longer exist."""
         name = pol["metadata"]["name"]
+        status = dict(status, conditions=self._conditions(pol, status))
         live = {
             k: v for k, v in (pol.get("status") or {}).items()
             if k != "lastScanTime"
