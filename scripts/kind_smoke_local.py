@@ -360,15 +360,29 @@ def main():
                  "--publish"],
                 env=env, capture_output=True, text=True, cwd=REPO,
             )
-            verdict = store.get_node(NODE)["metadata"].get(
-                "annotations", {}).get(L.DOCTOR_ANNOTATION)
-            if r.returncode == 0 and verdict and json.loads(verdict)["ok"]:
+            node_meta = store.get_node(NODE)["metadata"]
+            verdict_raw = node_meta.get("annotations", {}).get(
+                L.DOCTOR_ANNOTATION)
+            ok_label = node_meta.get("labels", {}).get(L.DOCTOR_OK_LABEL)
+            try:
+                verdict_ok = bool(
+                    verdict_raw and json.loads(verdict_raw)["ok"]
+                )
+            except (ValueError, KeyError):
+                verdict_ok = False
+            if r.returncode != 0:
+                failures.append(
+                    f"doctor rc={r.returncode}: "
+                    f"{(r.stdout + r.stderr)[-400:]}"
+                )
+            elif not verdict_ok or ok_label != "true":
+                failures.append(
+                    "doctor ran clean but publication is wrong: "
+                    f"verdict={verdict_raw!r} ok_label={ok_label!r}"
+                )
+            else:
                 log("PASS doctor: healthy node, verdict published "
                     "(cc.doctor.ok label set)")
-            else:
-                failures.append(
-                    f"doctor rc={r.returncode}: {r.stdout[-400:]}"
-                )
             r = subprocess.run(
                 [sys.executable, "-m", "tpu_cc_manager",
                  "fleet-controller", "--once"],

@@ -230,21 +230,32 @@ def test_fleet_problems_classification():
 
     clean = {
         "failed": [], "needs_flip": ["n1"],  # divergence alone is fine
-        "evidence_audit": {"missing": ["n9"], "invalid": [],
+        "evidence_audit": {"missing": [], "invalid": [],
                            "label_device_mismatch": []},
         "doctor": {"reported": 1, "failing": []},
-        "half_flipped_slices": [],
+        "half_flipped_slices": [], "incoherent_slices": [],
     }
     assert fleet_problems(clean) == []
+    # missing evidence IS a problem: the audit only reports it for
+    # nodes whose label claims success with nothing behind it — the
+    # simplest forgery, or an agent that died before committing
+    assert fleet_problems(dict(clean, evidence_audit={
+        "missing": ["n9"], "invalid": [],
+        "label_device_mismatch": [],
+    })) == ["evidence missing: ['n9']"]
+    # incoherent slices can never self-converge: operator action needed
+    assert fleet_problems(dict(clean, incoherent_slices=["s2"])) == [
+        "incoherent slices: ['s2']"
+    ]
     dirty = {
         "failed": ["n2"],
-        "evidence_audit": {"missing": [], "invalid": ["n3"],
+        "evidence_audit": {"missing": ["n9"], "invalid": ["n3"],
                            "label_device_mismatch": ["n4"]},
         "doctor": {"failing": [{"node": "n5", "fail": ["gate-perms"]}]},
-        "half_flipped_slices": ["s1"],
+        "half_flipped_slices": ["s1"], "incoherent_slices": ["s2"],
     }
     problems = fleet_problems(dirty)
-    assert len(problems) == 5
+    assert len(problems) == 7
     assert any("n2" in p for p in problems)
     assert any("s1" in p for p in problems)
 
@@ -253,12 +264,22 @@ def test_cli_fleet_controller_once(monkeypatch, capsys):
     from tpu_cc_manager import __main__ as cli
 
     kube = FakeKube()
-    kube.add_node(_node("n1", desired="on", state="on"))
+    # a node claiming success must carry evidence to count as clean —
+    # bare labels are the forgery case the audit flags. A node with no
+    # mode claim yet is clean.
+    kube.add_node(_node("n1"))
     monkeypatch.setattr(cli, "_kube_client", lambda cfg: kube)
     rc = cli.main(["fleet-controller", "--once"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["nodes"] == 1
 
-    kube.add_node(_node("n2", desired="on", state="failed"))
+    # a success claim without evidence now fails the audit
+    kube.add_node(_node("n2", desired="on", state="on"))
+    rc = cli.main(["fleet-controller", "--once"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["evidence_audit"]["missing"] == ["n2"]
+
+    kube.add_node(_node("n3", desired="on", state="failed"))
     rc = cli.main(["fleet-controller", "--once"])
     assert rc == 1

@@ -50,7 +50,13 @@ def fleet_problems(report: dict) -> List[str]:
     if report.get("failed"):
         problems.append(f"failed nodes: {sorted(report['failed'])}")
     audit = report.get("evidence_audit") or {}
-    for issue in ("invalid", "label_device_mismatch"):
+    # 'missing' IS a problem here: the audit only reports it for nodes
+    # whose label claims a SUCCESSFUL mode with no evidence behind it —
+    # the simplest forgery (no HMAC to defeat), or an agent that died
+    # between labeling and committing. The ROLLOUT judge tolerates
+    # missing evidence (pre-evidence agents must not brick a rollout);
+    # an audit's job is suspicion, not tolerance.
+    for issue in ("missing", "invalid", "label_device_mismatch"):
         if audit.get(issue):
             problems.append(f"evidence {issue}: {sorted(audit[issue])}")
     doctor = report.get("doctor") or {}
@@ -62,6 +68,13 @@ def fleet_problems(report: dict) -> List[str]:
     if report.get("half_flipped_slices"):
         problems.append(
             f"half-flipped slices: {sorted(report['half_flipped_slices'])}"
+        )
+    if report.get("incoherent_slices"):
+        # unlike plain divergence, incoherent DESIRED labels on one
+        # slice can never self-converge — members hold in slice_wait
+        # until an operator fixes the labels
+        problems.append(
+            f"incoherent slices: {sorted(report['incoherent_slices'])}"
         )
     return problems
 

@@ -505,6 +505,7 @@ class Rollout:
                     f"{existing.get('mode')!r}) already exists on this "
                     f"pool; finish it with --resume"
                 )
+            planned_count = 0
             for gname, members in self.plan_groups(nodes):
                 converged = all(
                     self._converged(by_name[m]) for m in members
@@ -527,11 +528,9 @@ class Rollout:
                     # the preview marks which groups would canary (the
                     # first N to-run groups, matching the live run's
                     # pending order)
-                    planned_so_far = sum(
-                        1 for r in results if r.outcome == "planned"
-                    )
                     detail = ("canary: serial, must succeed"
-                              if planned_so_far < self.canary else "")
+                              if planned_count < self.canary else "")
+                    planned_count += 1
                     results.append(
                         GroupResult(gname, members, "planned", detail)
                     )
