@@ -504,3 +504,48 @@ def test_sigterm_is_a_clean_shutdown(tmp_path):
                 proc.kill()
         out = proc.stdout.read().decode()
         assert rc == 0, f"SIGTERM exit {rc}; log tail: {out[-1500:]}"
+
+
+def test_failed_reconcile_fast_tracks_doctor_verdict(tmp_path):
+    """A failed flip changes the node's trust surfaces; the fleet
+    should see the updated doctor verdict within seconds instead of
+    waiting out the remaining doctor interval."""
+    import json
+
+    backend = fake_backend(n_chips=1)
+    chip = backend.find_tpus()[0][0]
+    set_backend(backend)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "on"}))
+    # a very long doctor interval: only the failure fast-track can
+    # explain a verdict refresh
+    agent = _agent(kube, tmp_path, doctor_interval_s=3600)
+    t = threading.Thread(target=agent.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            ann = kube.get_node("n1")["metadata"].get("annotations", {})
+            if L.DOCTOR_ANNOTATION in ann:
+                break
+            time.sleep(0.05)
+        first = kube.get_node("n1")["metadata"]["annotations"][
+            L.DOCTOR_ANNOTATION]
+        # now make the device fail and trigger a reconcile
+        chip.fail_set = True
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "devtools"})
+        deadline = time.monotonic() + 20
+        refreshed = None
+        while time.monotonic() < deadline:
+            ann = kube.get_node("n1")["metadata"].get("annotations", {})
+            raw = ann.get(L.DOCTOR_ANNOTATION)
+            if raw and raw != first:
+                refreshed = json.loads(raw)
+                break
+            time.sleep(0.05)
+        assert refreshed is not None, (
+            "doctor verdict never refreshed after the failed flip"
+        )
+    finally:
+        agent.shutdown()
+        t.join(timeout=10)

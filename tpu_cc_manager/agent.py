@@ -527,6 +527,11 @@ class CCManagerAgent:
             2 ** (self._repair_failures - 1), 32
         )
         self._repair_due = time.monotonic() + delay
+        # fast-track the next doctor self-check: a failed flip changes
+        # the node's trust surfaces (fail-secure gate lock, statefile
+        # state) and the fleet should see the failing verdict within
+        # seconds, not after the remaining doctor interval
+        self._doctor_due = 0.0
 
     def _maybe_repair(self) -> None:
         """Idle-tick self-repair: retry the last failed reconcile.
