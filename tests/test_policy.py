@@ -345,6 +345,73 @@ def test_canary_discipline_survives_resume():
     assert by["node/c2"] == "not_attempted"
 
 
+def test_rollout_progress_hook_reports_terminal_groups():
+    from tpu_cc_manager.rollout import Rollout
+
+    kube = FakeKube()
+    for i in range(3):
+        kube.add_node(_node(f"p{i}", desired="off", state="off"))
+    seen = []
+    agents = _ReactiveAgents(kube, [f"p{i}" for i in range(3)])
+    agents.start()
+    try:
+        report = Rollout(
+            kube, "on", poll_s=0.02, group_timeout_s=10,
+            on_group=lambda g, o, done, total: seen.append(
+                (g, o, done, total)),
+        ).run()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    assert report.ok
+    assert [s[1] for s in seen] == ["succeeded"] * 3
+    assert [s[2] for s in seen] == [1, 2, 3]  # done count advances
+    assert all(s[3] == 3 for s in seen)
+    # a hook that raises must not fail the rollout
+    kube2 = FakeKube()
+    kube2.add_node(_node("q0", desired="off", state="off"))
+    agents2 = _ReactiveAgents(kube2, ["q0"])
+    agents2.start()
+    try:
+        def boom(*a):
+            raise RuntimeError("observer bug")
+
+        assert Rollout(kube2, "on", poll_s=0.02, group_timeout_s=10,
+                       on_group=boom).run().ok
+    finally:
+        agents2.stop.set()
+        agents2.join(timeout=2)
+
+
+def test_policy_status_shows_mid_rollout_progress():
+    """During a rollout the policy status message carries per-group
+    progress, not just a static 'Rolling'."""
+    messages = []
+
+    class Capturing(FakeKube):
+        def patch_cluster_custom(self, *a, **k):
+            if k.get("subresource") == "status":
+                messages.append(a[4]["status"]["message"])
+            return super().patch_cluster_custom(*a, **k)
+
+    kube = Capturing()
+    for i in range(2):
+        kube.add_node(_node(f"n{i}", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy(
+        "p", strategy={"groupTimeoutSeconds": 10},
+    ))
+    agents = _ReactiveAgents(kube, ["n0", "n1"])
+    agents.start()
+    try:
+        controller(kube).scan_once()
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+    progress = [m for m in messages if "group(s) done" in m]
+    assert any("1/2" in m for m in progress)
+    assert any("2/2" in m for m in progress)
+
+
 def test_policy_canary_flows_through():
     kube = FakeKube()
     for i in range(2):

@@ -648,6 +648,16 @@ class PolicyController:
             f"rolling {spec['mode']!r} (window {spec['max_unavailable']}, "
             f"budget {spec['failure_budget']})",
         )
+        def progress(gname: str, outcome: str, done: int,
+                     total: int) -> None:
+            # live mid-rollout visibility: kubectl get tpuccpolicy
+            # shows per-group progress, not just a static 'Rolling'
+            st["message"] = (
+                f"rolling {spec['mode']!r}: {done}/{total} group(s) "
+                f"done (last: {gname} {outcome})"
+            )
+            self._patch_status(pol, st)
+
         try:
             rollout = Rollout(
                 self.kube, spec["mode"],
@@ -658,6 +668,7 @@ class PolicyController:
                 group_timeout_s=spec["group_timeout_s"],
                 poll_s=self.poll_s,
                 verify_evidence=self.verify_evidence,
+                on_group=progress,
             )
             report = rollout.run()
         except (RolloutError, ApiException) as e:

@@ -195,7 +195,13 @@ class Rollout:
         force: bool = False,
         dry_run: bool = False,
         verify_evidence: bool = True,
+        on_group=None,
     ):
+        #: optional progress hook called after every group reaches a
+        #: terminal outcome: on_group(name, outcome, done, total).
+        #: Exceptions are swallowed — a broken observer must not fail
+        #: the rollout.
+        self.on_group = on_group
         self.kube = kube
         self.mode = parse_mode(mode).value  # reject bad input before any patch
         self.selector = selector
@@ -360,13 +366,24 @@ class Rollout:
 
     def _record_group(self, gname: str, nodes: List[str], outcome: str,
                       detail: str = "") -> None:
-        if self._record is None:
-            return
-        g = self._record["groups"].setdefault(gname, {"nodes": list(nodes)})
-        g["outcome"] = outcome
-        if detail:
-            g["detail"] = detail
-        self._persist()
+        if self._record is not None:
+            g = self._record["groups"].setdefault(
+                gname, {"nodes": list(nodes)}
+            )
+            g["outcome"] = outcome
+            if detail:
+                g["detail"] = detail
+            self._persist()
+        if self.on_group is not None and outcome in _TERMINAL:
+            groups = (self._record or {}).get("groups", {})
+            done = sum(
+                1 for g in groups.values()
+                if g.get("outcome") in _TERMINAL
+            )
+            try:
+                self.on_group(gname, outcome, done, len(groups))
+            except Exception:
+                log.warning("rollout progress hook failed", exc_info=True)
 
     # ------------------------------------------------------------ planning
     def discover(self) -> List[dict]:
