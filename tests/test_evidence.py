@@ -239,6 +239,8 @@ def test_fleet_audit_flags_lying_missing_and_tampered(tmp_path, monkeypatch):
     audit = audit_evidence([honest, liar, bare, tampered, failed], key=None)
     assert audit == {
         "missing": ["bare"],
+        "unsigned": [],
+        "unverifiable": [],
         "invalid": ["tampered"],
         "label_device_mismatch": ["liar"],
     }
@@ -308,3 +310,151 @@ def test_dropped_evidence_publish_retried_from_idle_tick(tmp_path,
     due = agent._evidence_retry_due
     agent._maybe_repair()
     assert agent._evidence_retry_due == due
+
+
+def test_audit_distinguishes_unsigned_from_invalid(tmp_path, monkeypatch):
+    """A keyed auditor must separate two very different findings:
+    'unsigned' (internally-consistent plain-sha256 doc — almost always
+    the agent DaemonSet missing the key Secret, a DEPLOYMENT fix) from
+    'invalid' (digest mismatch / replay / garbage — a node to distrust).
+    The fleet problem line for unsigned names the manifest fix."""
+    from tpu_cc_manager.fleet import fleet_problems
+
+    be = _sysfs_backend(tmp_path, monkeypatch)
+    unsigned = build_evidence("n-unsigned", be, key=None)
+    tampered = dict(build_evidence("n-bad", be, key=b"pool-key"),
+                    node="someone-else")
+
+    nodes = [
+        make_node("n-unsigned", labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: json.dumps(unsigned)}),
+        make_node("n-bad", labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: json.dumps(tampered)}),
+    ]
+    audit = audit_evidence(nodes, key=b"pool-key")
+    assert audit["unsigned"] == ["n-unsigned"]
+    assert audit["invalid"] == ["n-bad"]
+    assert audit["missing"] == []
+
+    # an attack dressed as 'unsigned' keeps its forensic class: a plain
+    # doc with a broken digest, and a replayed plain doc bound to a
+    # different node, are both 'invalid' — never the fix-the-manifest
+    # bucket
+    broken = dict(unsigned, statefile_digest="sha256:beef")
+    replayed = build_evidence("elsewhere", be, key=None)
+    forged_nodes = [
+        make_node("n-broken", labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: json.dumps(broken)}),
+        make_node("n-replay", labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: json.dumps(replayed)}),
+    ]
+    audit_forged = audit_evidence(forged_nodes, key=b"pool-key")
+    assert audit_forged["invalid"] == ["n-broken", "n-replay"]
+    assert audit_forged["unsigned"] == []
+
+    # a LYING label on an unkeyed node is still the lie this audit
+    # exists to catch: unsigned-but-consistent evidence contradicting
+    # the state label lands in label_device_mismatch, never in the
+    # benign fix-the-manifest bucket
+    lying = [make_node("n-unsigned", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(unsigned)})]
+    audit_lying = audit_evidence(lying, key=b"pool-key")
+    assert audit_lying["label_device_mismatch"] == ["n-unsigned"]
+    assert audit_lying["unsigned"] == []
+
+    # agents-first enablement window: signed docs under an UNKEYED
+    # auditor are 'unverifiable' (close the blind spot by keying the
+    # controller), never 'invalid' — the whole fleet must not page
+    # mid-enablement
+    signed = build_evidence("n-signed", be, key=b"pool-key")
+    signed_nodes = [make_node("n-signed", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(signed)})]
+    audit_nokey = audit_evidence(signed_nodes, key=None)
+    assert audit_nokey["unverifiable"] == ["n-signed"]
+    assert audit_nokey["invalid"] == []
+    from tpu_cc_manager.fleet import fleet_problems as _fp
+    assert _fp({"evidence_audit": audit_nokey}) == []
+
+    # ...but 'unverifiable' never launders keyless-checkable problems:
+    # a signed doc replayed to another node is invalid, and a signed
+    # doc whose attested mode contradicts the label is a mismatch —
+    # node binding and mode claims need no key to read
+    nokey_bad = [
+        make_node("n-other", labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: json.dumps(signed)}),
+        make_node("n-signed", labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: "on"},
+            annotations={L.EVIDENCE_ANNOTATION: json.dumps(signed)}),
+    ]
+    audit_nokey2 = audit_evidence(nokey_bad, key=None)
+    assert audit_nokey2["invalid"] == ["n-other"]
+    assert audit_nokey2["label_device_mismatch"] == ["n-signed"]
+    assert audit_nokey2["unverifiable"] == []
+
+    problems = fleet_problems({"evidence_audit": audit})
+    unsigned_lines = [p for p in problems if "unsigned" in p]
+    assert len(unsigned_lines) == 1
+    # actionable: names the Secret and the enablement order
+    assert "tpu-cc-evidence-key" in unsigned_lines[0]
+    assert "BEFORE" in unsigned_lines[0]
+    assert any("invalid" in p for p in problems)
+
+    # an UNKEYED auditor sees the same unsigned doc as simply valid —
+    # the bucket only exists once a key is deployed
+    audit2 = audit_evidence(nodes, key=None)
+    assert audit2["unsigned"] == []
+
+
+def test_key_appearing_on_idle_node_resigns_evidence(tmp_path,
+                                                     monkeypatch):
+    """The agents-first enablement path on an ALREADY-CONVERGED fleet:
+    when the evidence-key Secret lands (kubelet populates the optional
+    mount in place), no mode flip will ever come to re-sign the stale
+    unsigned annotation — the idle tick must notice the key-posture
+    change and republish, or a keyed verifier reads the whole idle
+    fleet as 'unsigned' and tells the operator to apply the fix they
+    already applied."""
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    kube = FakeKube()
+    kube.add_node(make_node("idle-node"))
+    key_file = tmp_path / "evidence-key"
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY_FILE", str(key_file))
+    cfg = AgentConfig(node_name="idle-node", drain_strategy="none",
+                      health_port=0, emit_events=False)
+    agent = CCManagerAgent(kube, cfg, backend=be)
+
+    # converge while the Secret is absent: evidence is plain-sha256
+    assert agent.reconcile("on") is True
+    assert agent.flush_events(timeout=10)
+    ann = kube.get_node("idle-node")["metadata"]["annotations"]
+    assert json.loads(ann[L.EVIDENCE_ANNOTATION])["digest"].startswith(
+        "sha256:"
+    )
+
+    # idle ticks with no posture change do NOT republish
+    before = ann[L.EVIDENCE_ANNOTATION]
+    agent._maybe_repair()
+    assert agent.flush_events(timeout=10)
+    assert (kube.get_node("idle-node")["metadata"]["annotations"]
+            [L.EVIDENCE_ANNOTATION]) == before
+
+    # the Secret appears in place; next idle tick re-signs (the check
+    # itself is throttled to the repair cadence — force it due)
+    key_file.write_bytes(b"pool-secret")
+    agent._evidence_key_check_due = 0.0
+    agent._maybe_repair()
+    assert agent.flush_events(timeout=10)
+    doc = json.loads(kube.get_node("idle-node")["metadata"]
+                     ["annotations"][L.EVIDENCE_ANNOTATION])
+    assert doc["digest"].startswith("hmac-sha256:")
+    assert verify_evidence(doc, key=b"pool-secret") == (True, "ok")
+    # keyed audit now sees a clean fleet
+    audit = audit_evidence(kube.list_nodes(None), key=b"pool-secret")
+    assert audit["unsigned"] == [] and audit["invalid"] == []

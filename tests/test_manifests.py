@@ -1,0 +1,124 @@
+"""Deployment-manifest invariants (VERDICT r3 weak #1 and #6).
+
+These are pure-YAML checks — no cluster — guarding the sharp edges the
+manifests shipped with in round 3:
+
+- EVIDENCE-KEY SYMMETRY: every workload that *publishes* evidence (the
+  three agent DaemonSets) and every workload that *verifies* it (policy
+  and fleet controllers) must mount the same optional
+  ``tpu-cc-evidence-key`` Secret and point ``TPU_CC_EVIDENCE_KEY_FILE``
+  at it. The no-downgrade rule (evidence.py verify_evidence) makes a
+  keyed verifier reject unsigned documents — so a manifest set where
+  only the verifier holds the key bricks every rollout the moment the
+  Secret is created. That asymmetry shipped once; this test keeps it
+  from shipping again.
+"""
+
+import glob
+import os
+
+import pytest
+
+# PyYAML is not one of the pinned dev deps (requirements-dev.txt): the
+# whole file skips, rather than erroring at collection, where it is
+# absent — same posture as the inline imports in test_agent/test_modes
+yaml = pytest.importorskip("yaml")
+
+MANIFEST_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "deployments", "manifests"
+)
+
+EVIDENCE_SECRET = "tpu-cc-evidence-key"
+EVIDENCE_KEY_ENV = "TPU_CC_EVIDENCE_KEY_FILE"
+
+# workloads touching evidence: name -> (file, kind)
+EVIDENCE_WORKLOADS = {
+    "tpu-cc-manager": ("daemonset.yaml", "DaemonSet"),
+    "tpu-cc-manager-native": ("daemonset-native.yaml", "DaemonSet"),
+    "tpu-cc-manager-native-tls": ("daemonset-native-tls.yaml", "DaemonSet"),
+    "tpu-policy-controller": ("policy-controller.yaml", "Deployment"),
+    "tpu-fleet-controller": ("fleet-controller.yaml", "Deployment"),
+}
+
+
+def _load(fname):
+    with open(os.path.join(MANIFEST_DIR, fname)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _find(docs, kind, name):
+    for d in docs:
+        if d.get("kind") == kind and d["metadata"]["name"] == name:
+            return d
+    raise AssertionError(f"{kind}/{name} not found")
+
+
+def _pod_spec(workload):
+    return workload["spec"]["template"]["spec"]
+
+
+def test_all_manifests_parse():
+    files = sorted(glob.glob(os.path.join(MANIFEST_DIR, "*.yaml")))
+    assert files, "no manifests found"
+    for path in files:
+        with open(path) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        assert docs, f"{path} parsed to nothing"
+        for d in docs:
+            assert "kind" in d and "metadata" in d, path
+
+
+@pytest.mark.parametrize("name", sorted(EVIDENCE_WORKLOADS))
+def test_evidence_key_symmetry(name):
+    fname, kind = EVIDENCE_WORKLOADS[name]
+    spec = _pod_spec(_find(_load(fname), kind, name))
+
+    key_vols = [
+        v for v in spec.get("volumes", [])
+        if (v.get("secret") or {}).get("secretName") == EVIDENCE_SECRET
+    ]
+    assert key_vols, (
+        f"{fname}: {kind}/{name} does not mount the {EVIDENCE_SECRET} "
+        "Secret — unkeyed publishers/verifiers break the fleet the "
+        "moment the Secret exists (no-downgrade rule)"
+    )
+    secret_vols = [v["name"] for v in key_vols]
+    vol_entry = key_vols[0]
+    assert vol_entry["secret"].get("optional") is True, (
+        f"{fname}: the evidence-key Secret must be optional — pods must "
+        "start on clusters that have not created it"
+    )
+
+    # the main container (not the proxy sidecar) wires env + mount
+    containers = spec["containers"]
+    main = containers[0]
+    env = {e["name"]: e.get("value") for e in main.get("env", [])}
+    assert EVIDENCE_KEY_ENV in env, (
+        f"{fname}: container {main['name']} lacks {EVIDENCE_KEY_ENV}"
+    )
+    key_path = env[EVIDENCE_KEY_ENV]
+    mounts = main.get("volumeMounts", [])
+    mount = next(
+        (m for m in mounts if m["name"] in secret_vols), None
+    )
+    assert mount is not None, (
+        f"{fname}: container {main['name']} never mounts the key volume"
+    )
+    assert key_path.startswith(mount["mountPath"]), (
+        f"{fname}: {EVIDENCE_KEY_ENV}={key_path} is outside the key "
+        f"mount at {mount['mountPath']}"
+    )
+
+
+def test_evidence_key_paths_agree_across_manifests():
+    """All five workloads read the key from the SAME in-container path,
+    so one Secret + one docs/security.md instruction covers the fleet."""
+    paths = set()
+    for name, (fname, kind) in EVIDENCE_WORKLOADS.items():
+        spec = _pod_spec(_find(_load(fname), kind, name))
+        env = {
+            e["name"]: e.get("value")
+            for e in spec["containers"][0].get("env", [])
+        }
+        paths.add(env.get(EVIDENCE_KEY_ENV))
+    assert paths == {"/etc/tpu-cc/evidence-key"}, paths

@@ -1455,7 +1455,8 @@ def test_full_stack_policy_to_scheduler(tmp_path):
         # evidence audit: every node's label claim is evidence-backed
         audit = audit_evidence(nodes)
         assert audit == {
-            "missing": [], "invalid": [], "label_device_mismatch": [],
+            "missing": [], "unsigned": [], "unverifiable": [],
+            "invalid": [], "label_device_mismatch": [],
         }
         # admission: a confidential pod gets steered onto these nodes
         pod = {

@@ -745,20 +745,10 @@ def test_rollout_distrusts_lying_convergence_labels(tmp_path, monkeypatch):
     with no evidence at all (pre-evidence agents) still pass."""
     import json as _json
 
-    from tpu_cc_manager.device.tpu import SysfsTpuBackend
     from tpu_cc_manager.evidence import build_evidence
 
     # real statefile-backed evidence attesting cc=off
-    sysfs = tmp_path / "sysfs"
-    devd = sysfs / "accel0" / "device"
-    devd.mkdir(parents=True)
-    (devd / "vendor").write_text("0x1ae0\n")
-    (devd / "device").write_text("0x0063\n")
-    (tmp_path / "dev").mkdir()
-    (tmp_path / "dev" / "accel0").write_text("")
-    be = SysfsTpuBackend(sysfs_root=str(sysfs),
-                         dev_root=str(tmp_path / "dev"),
-                         state_dir=str(tmp_path / "state"))
+    be = _statefile_backend(tmp_path)
     off_evidence = _json.dumps(build_evidence("liar", be, key=None))
 
     kube = FakeKube()
@@ -791,19 +781,9 @@ def test_preconverged_liar_and_replayed_evidence_not_skipped(tmp_path):
     claim)."""
     import json as _json
 
-    from tpu_cc_manager.device.tpu import SysfsTpuBackend
     from tpu_cc_manager.evidence import build_evidence
 
-    sysfs = tmp_path / "sysfs"
-    devd = sysfs / "accel0" / "device"
-    devd.mkdir(parents=True)
-    (devd / "vendor").write_text("0x1ae0\n")
-    (devd / "device").write_text("0x0063\n")
-    (tmp_path / "dev").mkdir()
-    (tmp_path / "dev" / "accel0").write_text("")
-    be = SysfsTpuBackend(sysfs_root=str(sysfs),
-                         dev_root=str(tmp_path / "dev"),
-                         state_dir=str(tmp_path / "state"))
+    be = _statefile_backend(tmp_path)
     chips, _ = be.find_tpus()
     be.store.stage(chips[0].path, "cc", "on")
     be.store.commit(chips[0].path)
@@ -831,3 +811,166 @@ def test_preconverged_liar_and_replayed_evidence_not_skipped(tmp_path):
     assert "evidence" in outcomes["node/forged"].detail
     assert outcomes["node/copycat"].outcome == "timeout"
     assert "evidence" in outcomes["node/copycat"].detail
+
+
+def _statefile_backend(tmp_path):
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+    sysfs = tmp_path / "sysfs"
+    devd = sysfs / "accel0" / "device"
+    devd.mkdir(parents=True)
+    (devd / "vendor").write_text("0x1ae0\n")
+    (devd / "device").write_text("0x0063\n")
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev" / "accel0").write_text("")
+    return SysfsTpuBackend(sysfs_root=str(sysfs),
+                           dev_root=str(tmp_path / "dev"),
+                           state_dir=str(tmp_path / "state"))
+
+
+def test_keyed_agents_keyed_verifier_converge(tmp_path, monkeypatch):
+    """The intended production posture after the evidence-key Secret is
+    deployed everywhere (daemonset*.yaml + controllers all mount it):
+    agents sign with the pool key, the rollout verifier holds the same
+    key, and convergence counts. Guards the end-to-end keyed path the
+    round-3 manifests never exercised."""
+    import json as _json
+
+    from tpu_cc_manager.evidence import build_evidence
+
+    be = _statefile_backend(tmp_path)
+    chips, _ = be.find_tpus()
+    be.store.stage(chips[0].path, "cc", "on")
+    be.store.commit(chips[0].path)
+    signed_on = _json.dumps(build_evidence("k1", be, key=b"pool-secret"))
+
+    kube = FakeKube()
+    kube.add_node(make_node("k1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: signed_on}))
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    agents = _ReactiveAgents(kube, ["k1"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=10, poll_s=0.05).run()
+    finally:
+        agents.stop.set()
+    assert report.ok
+    assert [g.outcome for g in report.groups] == ["succeeded"]
+
+
+def test_unkeyed_agents_keyed_verifier_fail_actionably(tmp_path,
+                                                       monkeypatch):
+    """The round-3 shipped-manifest bug, now made LOUD: agents publish
+    unsigned (plain-sha256) evidence while the rollout verifier holds
+    the pool key. The no-downgrade rule still refuses convergence — but
+    the verdict must name the fix (mount the key Secret into the agent
+    DaemonSets), not read as a mystery timeout."""
+    import json as _json
+
+    from tpu_cc_manager.evidence import build_evidence
+
+    be = _statefile_backend(tmp_path)
+    chips, _ = be.find_tpus()
+    be.store.stage(chips[0].path, "cc", "on")
+    be.store.commit(chips[0].path)
+    # built BEFORE the key lands in the env: genuinely unsigned
+    unsigned_on = _json.dumps(build_evidence("u1", be, key=None))
+    assert "hmac" not in unsigned_on
+
+    kube = FakeKube()
+    kube.add_node(make_node("u1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: unsigned_on}))
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    agents = _ReactiveAgents(kube, ["u1"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=1.5,
+                         poll_s=0.05).run()
+    finally:
+        agents.stop.set()
+    assert not report.ok
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "unsigned" in group.detail
+    # the detail is the operator's runbook: it names the Secret, the
+    # env knob, and the enablement order
+    assert "tpu-cc-evidence-key" in group.detail
+    assert "TPU_CC_EVIDENCE_KEY_FILE" in group.detail
+
+
+def test_tampered_plain_doc_not_blamed_on_manifests(tmp_path, monkeypatch):
+    """An attack dressed as 'unsigned' — a plain-sha256 doc with a
+    broken digest under a keyed verifier — must keep its forensic
+    classification: the timeout verdict says digest_mismatch and does
+    NOT append the mount-the-Secret runbook, so a forgery is never
+    triaged as a deployment gap."""
+    import json as _json
+
+    from tpu_cc_manager.evidence import build_evidence
+
+    be = _statefile_backend(tmp_path)
+    chips, _ = be.find_tpus()
+    be.store.stage(chips[0].path, "cc", "on")
+    be.store.commit(chips[0].path)
+    doc = build_evidence("t1", be, key=None)
+    doc["statefile_digest"] = "sha256:beef"  # tamper AFTER digesting
+
+    kube = FakeKube()
+    kube.add_node(make_node("t1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: _json.dumps(doc)}))
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    agents = _ReactiveAgents(kube, ["t1"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=1.5,
+                         poll_s=0.05).run()
+    finally:
+        agents.stop.set()
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "digest_mismatch" in group.detail
+    assert "tpu-cc-evidence-key" not in group.detail
+
+
+def test_unkeyed_verifier_still_catches_keyless_contradictions(
+        tmp_path, monkeypatch):
+    """Mid-enablement the OTHER way: agents sign, the rollout operator
+    has no key. The digest is a tolerated blind spot (warned once) —
+    but a signed doc whose unauthenticated mode claim contradicts the
+    rollout target, and a signed doc replayed from another node, need
+    no key to read and must stay suspects (same triage as the fleet
+    audit's judge_evidence)."""
+    import json as _json
+
+    from tpu_cc_manager.evidence import build_evidence
+
+    be = _statefile_backend(tmp_path)
+    # evidence attests cc=off, signed with a key this verifier lacks
+    signed_off = _json.dumps(
+        build_evidence("contra", be, key=b"agents-only-key")
+    )
+
+    kube = FakeKube()
+    kube.add_node(make_node("contra", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: signed_off}))
+    monkeypatch.delenv("TPU_CC_EVIDENCE_KEY", raising=False)
+    monkeypatch.delenv("TPU_CC_EVIDENCE_KEY_FILE", raising=False)
+    agents = _ReactiveAgents(kube, ["contra"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=1.5,
+                         poll_s=0.05).run()
+    finally:
+        agents.stop.set()
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "attests 'off'" in group.detail
+    assert "no key here" in group.detail

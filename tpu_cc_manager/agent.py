@@ -53,6 +53,11 @@ def with_default(value: Optional[str], default: Optional[str]) -> Optional[str]:
 
 
 class CCManagerAgent:
+    #: sentinel for "no evidence build yet this process" — distinct from
+    #: None, which means "built unsigned" and must trigger a republish
+    #: when a key later appears
+    _KEY_UNSET = object()
+
     def __init__(
         self,
         kube: KubeClient,
@@ -131,6 +136,13 @@ class CCManagerAgent:
         self._evidence_wanted_gen = 0
         self._evidence_published_gen = 0
         self._evidence_retry_due = 0.0
+        self._evidence_key_check_due = 0.0
+        #: the key the last evidence build signed with; the idle tick
+        #: republishes when the live key differs (the Secret appearing
+        #: on a converged, otherwise-idle fleet must re-sign every
+        #: node's evidence — no mode flip will ever come to do it).
+        #: Sentinel: no build yet this process
+        self._evidence_key_used: object = self._KEY_UNSET
         # periodic doctor self-check throttle (first run shortly after
         # startup, then every doctor_interval_s)
         self._doctor_due = 0.0
@@ -224,7 +236,7 @@ class CCManagerAgent:
 
         from tpu_cc_manager import device as devlayer
         from tpu_cc_manager import labels as L
-        from tpu_cc_manager.evidence import build_evidence
+        from tpu_cc_manager.evidence import build_evidence, evidence_key
 
         # this publication's generation: anything that keeps it from
         # landing (build failure, queue overflow, write failure) leaves
@@ -240,10 +252,15 @@ class CCManagerAgent:
         # the API write is deferred.
         try:
             backend = self._backend or devlayer.get_backend()
+            key = evidence_key()
             payload = _json.dumps(
-                build_evidence(self.cfg.node_name, backend),
+                build_evidence(self.cfg.node_name, backend, key=key),
                 sort_keys=True, separators=(",", ":"),
             )
+            # recorded at build time (not publish time): what matters
+            # for the idle tick's re-sign check is the posture of the
+            # newest document headed for the cluster
+            self._evidence_key_used = key
         except Exception:
             log.warning("evidence build failed; will retry", exc_info=True)
             return
@@ -556,6 +573,27 @@ class CCManagerAgent:
                 self.cfg.repair_interval_s or 30.0
             )
             self._publish_evidence()
+        elif (self.cfg.emit_evidence
+                and self._evidence_key_used is not self._KEY_UNSET
+                and now >= self._evidence_key_check_due):
+            # key-posture change: the evidence-key Secret appeared (or
+            # rotated/vanished) on an idle, converged node. No mode flip
+            # will ever come to re-sign the annotation, and a keyed
+            # verifier would read the stale unsigned document as an
+            # 'unsigned' fleet problem telling the operator to apply a
+            # fix they already applied — so the agent re-signs here.
+            # Advanced on EVERY check, not just on change: idle ticks
+            # run ~1/s and the Secret file must not be opened that often
+            from tpu_cc_manager.evidence import evidence_key
+
+            self._evidence_key_check_due = now + (
+                self.cfg.repair_interval_s or 30.0
+            )
+            if evidence_key() != self._evidence_key_used:
+                log.info(
+                    "evidence key posture changed; re-signing evidence"
+                )
+                self._publish_evidence()
         # heal gate-perms drift on idle nodes (same cadence as repair;
         # local chmods only, no cluster traffic)
         if self.cfg.repair_interval_s and now >= self._gate_reassert_due:

@@ -46,10 +46,38 @@ log = logging.getLogger("tpu-cc-manager.evidence")
 
 EVIDENCE_VERSION = 1
 
+#: the one runbook line for the unkeyed-agent-under-keyed-verifier
+#: state, shared by the fleet audit and both rollout call sites so the
+#: Secret/env names can never drift between the three messages
+UNSIGNED_RUNBOOK = (
+    "mount the tpu-cc-evidence-key Secret (TPU_CC_EVIDENCE_KEY_FILE) "
+    "into the agent DaemonSet(s); agents must sign BEFORE any verifier "
+    "is keyed"
+)
+
+#: key-file paths already warned about, so a broken mount logs once per
+#: process instead of once per reconcile
+_warned_key_paths: set = set()
+
+#: default for the ``key`` parameters below: "resolve from the
+#: environment for me". Distinct from an explicit ``key=None``, which
+#: means a deliberately KEYLESS posture — a long-lived verifier (the
+#: rollout judge) resolves the key once at startup and must not
+#: re-open the key file per poll, nor flip to keyed mid-flight when
+#: the Secret lands
+_RESOLVE_KEY = object()
+
+
+def _resolve(key):
+    return evidence_key() if key is _RESOLVE_KEY else key
+
 
 def evidence_key() -> Optional[bytes]:
     """Node evidence key: TPU_CC_EVIDENCE_KEY (inline) or
-    TPU_CC_EVIDENCE_KEY_FILE (path, e.g. a mounted Secret)."""
+    TPU_CC_EVIDENCE_KEY_FILE (path, e.g. a mounted Secret). A missing
+    file is SILENT by design: every manifest sets the env var while the
+    Secret itself is optional, so the supported keyless posture would
+    otherwise warn on every reconcile of every node."""
     inline = os.environ.get("TPU_CC_EVIDENCE_KEY", "")
     if inline:
         return inline.encode()
@@ -58,8 +86,14 @@ def evidence_key() -> Optional[bytes]:
         try:
             with open(path, "rb") as f:
                 return f.read().strip() or None
+        except FileNotFoundError:
+            return None  # optional Secret not deployed: keyless posture
         except OSError as e:
-            log.warning("cannot read evidence key file %s: %s", path, e)
+            if path not in _warned_key_paths:
+                _warned_key_paths.add(path)
+                log.warning(
+                    "cannot read evidence key file %s: %s", path, e
+                )
             return None
     return None
 
@@ -118,11 +152,11 @@ def _device_entry(chip, store) -> dict:
 
 
 def build_evidence(node_name: str, backend,
-                   key: Optional[bytes] = None) -> dict:
+                   key=_RESOLVE_KEY) -> dict:
     """Evidence document for the node's current device state. ``key``
-    defaults to :func:`evidence_key`."""
-    if key is None:
-        key = evidence_key()
+    defaults to :func:`evidence_key`; pass ``None`` explicitly for a
+    deliberately unsigned document."""
+    key = _resolve(key)
     store = getattr(backend, "store", None)
     chips, err = backend.find_tpus()
     if err:
@@ -162,13 +196,42 @@ def evidence_mode(doc: dict) -> Optional[str]:
     return cc_modes.pop()
 
 
-def verify_evidence(doc: dict, *, key: Optional[bytes] = None,
+def plain_consistent(doc: dict) -> bool:
+    """Does the document's plain-sha256 digest match its body? Used to
+    triage an unsigned document under a keyed verifier: internally
+    consistent means a benign key-deployment gap; inconsistent means
+    tampering — the distinction decides whether the operator is told to
+    fix a manifest or to distrust a node."""
+    if not isinstance(doc, dict) or not isinstance(doc.get("digest"), str):
+        return False
+    body = {k: v for k, v in doc.items() if k != "digest"}
+    return hmac_mod.compare_digest(
+        _digest(_canonical(body), None), doc["digest"]
+    )
+
+
+def classify_unsigned(doc: dict, node_name: str) -> str:
+    """Forensic triage of a plain-sha256 document rejected by a keyed
+    verifier (reason 'unsigned'). Shared by the fleet audit and the
+    rollout judge so both classify the same document identically:
+    'unsigned' only when the doc is internally consistent AND bound to
+    ``node_name`` (the benign agent-never-got-the-key deployment gap);
+    'digest_mismatch' / 'node_mismatch' keep attack-shaped documents in
+    their forensic class."""
+    if not plain_consistent(doc):
+        return "digest_mismatch"
+    if doc.get("node") != node_name:
+        return "node_mismatch"
+    return "unsigned"
+
+
+def verify_evidence(doc: dict, *, key=_RESOLVE_KEY,
                     backend=None) -> Tuple[bool, str]:
     """Check a document's integrity, and — when ``backend`` is given —
     re-derive the statefile digest from disk so post-hoc statefile
-    tampering is detected. Returns (ok, reason)."""
-    if key is None:
-        key = evidence_key()
+    tampering is detected. Returns (ok, reason). ``key`` defaults to
+    :func:`evidence_key`; ``None`` means explicitly keyless."""
+    key = _resolve(key)
     if (not isinstance(doc, dict) or
             not isinstance(doc.get("digest"), str)):
         return False, "malformed"
@@ -196,6 +259,46 @@ def verify_evidence(doc: dict, *, key: Optional[bytes] = None,
     return True, "ok"
 
 
+def judge_evidence(doc: dict, node_name: str,
+                   key=_RESOLVE_KEY) -> Tuple[str, Optional[str]]:
+    """THE shared triage for a node's published evidence — the fleet
+    audit and the rollout judge both classify through here, so the same
+    document can never land in different buckets depending on which
+    verifier saw it. Returns ``(verdict, attested_mode)``:
+
+    - ``'ok'``: integrity verified and bound to ``node_name``;
+      ``attested_mode`` is the doc's device-truth claim.
+    - ``'no_key'``: HMAC-signed doc, keyless verifier, node-bound. The
+      digest cannot be judged, but the UNAUTHENTICATED mode claim is
+      still returned — a contradiction with the label/target needs no
+      key to read.
+    - ``'unsigned'``: plain doc under a keyed verifier, internally
+      consistent and node-bound — the benign agent-never-got-the-key
+      deployment gap (no-downgrade still refuses it as proof).
+    - ``'malformed'`` / ``'digest_mismatch'`` / ``'node_mismatch'``:
+      attack-shaped; ``attested_mode`` is None because nothing the doc
+      says is worth reading.
+    """
+    key = _resolve(key)
+    if not isinstance(doc, dict):
+        return "malformed", None
+    ok, reason = verify_evidence(doc, key=key)
+    if not ok and reason == "unsigned":
+        cls = classify_unsigned(doc, node_name)
+        if cls != "unsigned":
+            return cls, None
+        return "unsigned", evidence_mode(doc)
+    if not ok and reason == "no_key":
+        if doc.get("node") != node_name:
+            return "node_mismatch", None
+        return "no_key", evidence_mode(doc)
+    if not ok:
+        return reason, None
+    if doc.get("node") != node_name:
+        return "node_mismatch", None
+    return "ok", evidence_mode(doc)
+
+
 def publish_evidence(kube, node_name: str, backend=None) -> bool:
     """Build this node's evidence and publish it as the evidence
     annotation. Best-effort: returns False (after logging) on any
@@ -221,8 +324,7 @@ def publish_evidence(kube, node_name: str, backend=None) -> bool:
         return False
 
 
-def audit_evidence(nodes: List[dict],
-                   key: Optional[bytes] = None) -> dict:
+def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     """Fleet-wide evidence-vs-label audit (run by the fleet controller):
     every node whose ``cc.mode.state`` label claims a successfully
     applied mode must carry evidence that (a) passes integrity
@@ -230,12 +332,22 @@ def audit_evidence(nodes: List[dict],
     label is writable by anything with node-patch rights; the evidence
     binds the claim to independently-read device state — this is the
     'label vs device truth' cross-check the per-node agents cannot do
-    for each other (VERDICT r2 item 7)."""
+    for each other (VERDICT r2 item 7).
+
+    Buckets beyond the original three: ``unsigned`` (plain doc under a
+    keyed auditor — the agent DaemonSet is missing the key Secret, a
+    deployment fix, reported actionably by fleet_problems) and
+    ``unverifiable`` (signed doc, unkeyed auditor — the expected state
+    mid-enablement, metric-only). Forensic findings outrank both: a
+    replayed or label-contradicting document lands in invalid/mismatch
+    regardless of key posture, because node binding and mode claims
+    need no key to read."""
     from tpu_cc_manager import labels as L
 
-    if key is None:
-        key = evidence_key()
+    key = _resolve(key)
     missing: List[str] = []
+    unsigned: List[str] = []
+    unverifiable: List[str] = []
     invalid: List[str] = []
     mismatch: List[str] = []
     for node in nodes:
@@ -253,18 +365,22 @@ def audit_evidence(nodes: List[dict],
         # crash the fleet scan loop
         try:
             doc = json.loads(raw)
-            ok, _reason = verify_evidence(doc, key=key)
-            if not ok or doc.get("node") != name:
-                invalid.append(name)
-                continue
-            attested = evidence_mode(doc)
+            verdict, attested = judge_evidence(doc, name, key=key)
         except Exception:
             invalid.append(name)
             continue
-        if attested is not None and attested != state:
+        if verdict not in ("ok", "unsigned", "no_key"):
+            invalid.append(name)
+        elif attested is not None and attested != state:
             mismatch.append(name)
+        elif verdict == "unsigned":
+            unsigned.append(name)
+        elif verdict == "no_key":
+            unverifiable.append(name)
     return {
         "missing": sorted(missing),
+        "unsigned": sorted(unsigned),
+        "unverifiable": sorted(unverifiable),
         "invalid": sorted(invalid),
         "label_device_mismatch": sorted(mismatch),
     }

@@ -59,6 +59,19 @@ def fleet_problems(report: dict) -> List[str]:
     for issue in ("missing", "invalid", "label_device_mismatch"):
         if audit.get(issue):
             problems.append(f"evidence {issue}: {sorted(audit[issue])}")
+    if audit.get("unsigned"):
+        from tpu_cc_manager.evidence import UNSIGNED_RUNBOOK
+
+        # deployment asymmetry, not forgery: say exactly what to fix
+        problems.append(
+            f"evidence unsigned under a keyed verifier: "
+            f"{sorted(audit['unsigned'])} — these agents publish "
+            "plain-hashed evidence while this controller holds the "
+            f"pool key; {UNSIGNED_RUNBOOK}"
+        )
+    # 'unverifiable' (signed docs, unkeyed auditor) is deliberately NOT
+    # a problem: it is the expected state mid-enablement (agents keyed
+    # first). It stays visible via the evidence_issues metric.
     doctor = report.get("doctor") or {}
     if doctor.get("failing"):
         problems.append(
@@ -130,7 +143,8 @@ class FleetMetrics:
         self.incoherent_slices.set(len(report["incoherent_slices"]))
         self.half_flipped_slices.set(len(report["half_flipped_slices"]))
         audit = report.get("evidence_audit", {})
-        for issue in ("missing", "invalid", "label_device_mismatch"):
+        for issue in ("missing", "unsigned", "unverifiable", "invalid",
+                      "label_device_mismatch"):
             self.evidence_issues.set(len(audit.get(issue, [])), issue)
         self.doctor_failing.set(
             len(report.get("doctor", {}).get("failing", []))

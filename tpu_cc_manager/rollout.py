@@ -236,6 +236,10 @@ class Rollout:
             #: judge tick must not re-read the key file every poll
             self._evidence_key = evidence_key()
             self._warned_no_key = False
+            self._warned_unsigned = False
+        #: member -> why its evidence was rejected, for actionable
+        #: timeout verdicts (unsigned-under-key names the manifest fix)
+        self._suspect_reasons: Dict[str, str] = {}
         #: durable-record state (anchor-node annotation); set by run()
         self._record: Optional[dict] = None
         self._record_node: Optional[str] = None
@@ -863,11 +867,24 @@ class Rollout:
             # published asynchronously after the label, so keep waiting
             # — a persistent contradiction resolves via the timeout.
             if time.monotonic() >= deadline:
-                return GroupResult(
-                    gname, members, "timeout",
-                    f"labels reached {self.mode!r} but evidence "
-                    f"disagrees or fails verification on: {suspect}",
+                detail = ", ".join(
+                    f"{m}: {self._suspect_reasons.get(m, '?')}"
+                    for m in suspect
                 )
+                msg = (
+                    f"labels reached {self.mode!r} but evidence "
+                    f"disagrees or fails verification on: [{detail}]"
+                )
+                if any(self._suspect_reasons.get(m) == "unsigned"
+                       for m in suspect):
+                    from tpu_cc_manager.evidence import UNSIGNED_RUNBOOK
+
+                    msg += (
+                        " — agents are publishing unsigned evidence "
+                        "while this verifier holds the pool key: "
+                        f"{UNSIGNED_RUNBOOK}"
+                    )
+                return GroupResult(gname, members, "timeout", msg)
             return None
         if time.monotonic() >= deadline:
             lag = sorted(m for m, s in states.items() if s != self.mode)
@@ -880,13 +897,31 @@ class Rollout:
 
     def _evidence_suspects(self, members: List[str],
                            by_name: Dict[str, dict]) -> List[str]:
-        """Members whose PRESENT evidence annotation is invalid, belongs
-        to a DIFFERENT node (replayed from elsewhere), or attests a
-        different mode than this rollout's target. Two tolerated cases,
-        so misconfiguration never bricks a rollout: missing evidence
-        (pre-evidence agents) and a keyed document this operator cannot
-        check (no local TPU_CC_EVIDENCE_KEY — warned once)."""
-        from tpu_cc_manager.evidence import evidence_mode, verify_evidence
+        """Members whose PRESENT evidence annotation contradicts this
+        rollout counting them as converged. Classification is entirely
+        :func:`tpu_cc_manager.evidence.judge_evidence` — the same triage
+        the fleet audit uses, so a document can never pass one verifier
+        and fail the other:
+
+        - ``malformed``/``digest_mismatch``/``node_mismatch``: suspect.
+        - ``unsigned`` (plain doc, keyed verifier): suspect — the
+          no-downgrade rule refuses it as proof — but warned loudly on
+          first sighting with the deployment runbook, and the timeout
+          verdict names the manifest fix.
+        - ``no_key`` (signed doc, keyless verifier — the key is
+          resolved ONCE at construction and never re-read mid-flight):
+          tolerated blind spot, warned once... unless the doc's
+          unauthenticated mode claim contradicts the target, which
+          needs no key to read and stays a suspect.
+        - ``ok`` attesting a different mode than the target: suspect.
+
+        Missing evidence is tolerated (pre-evidence agents must not
+        brick a rollout). Per-member reasons land in
+        ``self._suspect_reasons`` so the timeout verdict says what to
+        FIX, not just who lagged."""
+        from tpu_cc_manager.evidence import (
+            UNSIGNED_RUNBOOK, judge_evidence,
+        )
 
         out: List[str] = []
         for m in members:
@@ -896,27 +931,47 @@ class Rollout:
                 continue
             try:
                 doc = json.loads(raw)
-                ok, reason = verify_evidence(doc, key=self._evidence_key)
-                if not ok and reason == "no_key":
-                    # the evidence is signed but this operator has no
-                    # key to judge it: not a contradiction, just a blind
-                    # spot — the fleet controller (which holds the key)
-                    # still audits it
-                    if not self._warned_no_key:
-                        self._warned_no_key = True
-                        log.warning(
-                            "evidence is HMAC-signed but no "
-                            "TPU_CC_EVIDENCE_KEY is configured here; "
-                            "skipping evidence verification"
-                        )
-                    continue
-                # evidence for another node pasted here verifies fine —
-                # the binding to THIS node is part of the claim
-                if ok and doc.get("node") != m:
-                    ok = False
-                attested = evidence_mode(doc) if ok else None
+                verdict, attested = judge_evidence(
+                    doc, m, key=self._evidence_key
+                )
             except Exception:
-                ok, attested = False, None
-            if not ok or (attested is not None and attested != self.mode):
+                verdict, attested = "malformed", None
+            if verdict == "no_key":
+                if not self._warned_no_key:
+                    self._warned_no_key = True
+                    log.warning(
+                        "evidence is HMAC-signed but no "
+                        "TPU_CC_EVIDENCE_KEY is configured here; "
+                        "skipping digest verification"
+                    )
+                if attested is not None and attested != self.mode:
+                    self._suspect_reasons[m] = (
+                        f"attests {attested!r}, not {self.mode!r} "
+                        "(digest unverifiable: no key here)"
+                    )
+                    out.append(m)
+                continue
+            if verdict == "unsigned":
+                # loud the FIRST time, not only at group timeout — an
+                # operator watching logs sees the fix minutes before
+                # the timeout would have reported a mystery
+                if not self._warned_unsigned:
+                    self._warned_unsigned = True
+                    log.warning(
+                        "node %s publishes UNSIGNED evidence while "
+                        "this verifier holds the pool key; its group "
+                        "will not count as converged — %s",
+                        m, UNSIGNED_RUNBOOK,
+                    )
+                self._suspect_reasons[m] = "unsigned"
+                out.append(m)
+                continue
+            if verdict != "ok":
+                self._suspect_reasons[m] = verdict
+                out.append(m)
+            elif attested is not None and attested != self.mode:
+                self._suspect_reasons[m] = (
+                    f"attests {attested!r}, not {self.mode!r}"
+                )
                 out.append(m)
         return sorted(out)
