@@ -11,6 +11,13 @@ import sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
+# Platform identity is opt-in per test: the default 'auto' would probe
+# the GCE metadata server from build_evidence, and on a GCP-hosted CI
+# runner that can MINT REAL TOKENS whose instance name contradicts the
+# tests' synthetic node names — nondeterministic identity_mismatch
+# findings. Tests that want identity set TPU_CC_IDENTITY=fake.
+os.environ.setdefault("TPU_CC_IDENTITY", "none")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest
@@ -39,6 +46,20 @@ def _reset_device_backend():
     device_base.set_backend(None)
     yield
     device_base.set_backend(None)
+
+
+@pytest.fixture(autouse=True)
+def _reset_identity_caches():
+    """The identity module process-caches providers (and their token
+    caches) on purpose; between tests that cache is cross-pollution —
+    a token minted under one test's key/env must not serve the next."""
+    from tpu_cc_manager import identity
+
+    identity._auto_cache = None
+    identity._explicit_cache.clear()
+    yield
+    identity._auto_cache = None
+    identity._explicit_cache.clear()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,512 @@
+"""Platform identity binding for the evidence chain (VERDICT r3
+missing #1: 'hardware-root the evidence').
+
+The drill these tests run: an adversary who stole the pool evidence
+HMAC key can SIGN arbitrary documents — but cannot mint the victim
+node's instance identity token (only the node's metadata server /
+identity key holder can). Verifiers must therefore flag:
+
+- a signed document carrying NO identity on an identity-bearing pool
+  (``identity_missing``),
+- a signed document carrying a token that speaks for a DIFFERENT node
+  or audience, or fails signature verification
+  (``identity_mismatch``),
+
+while uniform identity-less pools (platforms that mint no identities)
+stay clean, so nothing breaks off-GCE.
+"""
+
+import json
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.evidence import audit_evidence, build_evidence
+from tpu_cc_manager.identity import (
+    FakePlatformIdentity,
+    GceIdentity,
+    get_identity_provider,
+    judge_identity,
+    mint_fake_token,
+    verify_token,
+)
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+
+KEY = b"identity-test-key"
+
+
+# ------------------------------------------------------------- tokens
+def test_token_roundtrip_and_binding():
+    tok = mint_fake_token("node-a", KEY)
+    assert verify_token(tok, node_name="node-a", key=KEY) == ("ok", "ok")
+
+    # node binding: the same valid token does not speak for node-b
+    verdict, detail = verify_token(tok, node_name="node-b", key=KEY)
+    assert verdict == "mismatch"
+    assert "node-a" in detail and "node-b" in detail
+
+    # audience binding: a token minted for another service is refused
+    other = mint_fake_token("node-a", KEY, audience="some-other-svc")
+    verdict, _ = verify_token(other, node_name="node-a", key=KEY)
+    assert verdict == "mismatch"
+
+
+def test_token_tamper_and_expiry():
+    tok = mint_fake_token("node-a", KEY)
+    head, payload, sig = tok.split(".")
+    # re-signed with a different key: invalid
+    forged = mint_fake_token("node-a", b"wrong-key")
+    assert verify_token(forged, node_name="node-a", key=KEY)[0] == "invalid"
+    # spliced signature: invalid
+    spliced = ".".join([head, payload, forged.split(".")[2]])
+    assert verify_token(spliced, node_name="node-a", key=KEY)[0] == "invalid"
+    # expired: distinct verdict — staleness, not forgery. But binding
+    # failures outrank it: an expired token for the WRONG node is
+    # still a mismatch (replay), and a bad signature is still invalid
+    old = mint_fake_token("node-a", KEY, now=time.time() - 7200, ttl_s=60)
+    assert verify_token(old, node_name="node-a", key=KEY)[0] == "expired"
+    assert verify_token(old, node_name="node-b", key=KEY)[0] == "mismatch"
+    old_forged = mint_fake_token("node-a", b"wrong-key",
+                                 now=time.time() - 7200, ttl_s=60)
+    assert verify_token(old_forged, node_name="node-a",
+                        key=KEY)[0] == "invalid"
+    # garbage
+    assert verify_token("not-a-jwt", node_name="node-a",
+                        key=KEY)[0] == "invalid"
+
+
+def test_unverifiable_postures():
+    # HS256 token, verifier without the identity key: claims are still
+    # bound-checked, the signature verdict degrades honestly
+    tok = mint_fake_token("node-a", KEY)
+    assert verify_token(tok, node_name="node-a", key=None)[0] == (
+        "unverifiable"
+    )
+    # ...but a bound-check failure outranks unverifiable
+    assert verify_token(tok, node_name="node-b", key=None)[0] == "mismatch"
+
+
+def test_gce_identity_fetch(tmp_path):
+    """GceIdentity speaks the metadata-server wire contract: GET the
+    identity path with Metadata-Flavor: Google, audience passthrough."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    seen = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen["path"] = self.path
+            seen["flavor"] = self.headers.get("Metadata-Flavor")
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"tok-from-metadata\n")
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host = f"127.0.0.1:{srv.server_port}"
+        tok = GceIdentity(metadata_host=host).token(
+            "ignored", audience="aud-x"
+        )
+    finally:
+        srv.shutdown()
+    assert tok == "tok-from-metadata"
+    assert seen["flavor"] == "Google"
+    assert "audience=aud-x" in seen["path"]
+    assert "format=full" in seen["path"]
+
+
+def test_provider_resolution(monkeypatch):
+    monkeypatch.setenv("TPU_CC_IDENTITY", "none")
+    assert get_identity_provider() is None
+    monkeypatch.setenv("TPU_CC_IDENTITY", "fake")
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", "k")
+    assert isinstance(get_identity_provider(), FakePlatformIdentity)
+    monkeypatch.setenv("TPU_CC_IDENTITY", "gce")
+    assert isinstance(get_identity_provider(), GceIdentity)
+    # auto with an unreachable metadata host: resolves to None and the
+    # probe outcome is cached (second call does not re-dial)
+    monkeypatch.setenv("TPU_CC_IDENTITY", "auto")
+    monkeypatch.setenv("TPU_CC_METADATA_HOST", "127.0.0.1:1")
+    t0 = time.monotonic()
+    assert get_identity_provider(refresh=True) is None
+    first = time.monotonic() - t0
+    t0 = time.monotonic()
+    assert get_identity_provider() is None
+    assert time.monotonic() - t0 < first + 0.05
+
+
+# --------------------------------------------------------- evidence
+def _backend(tmp_path, monkeypatch, mode=None):
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+    sysfs = tmp_path / "sysfs"
+    d = sysfs / "accel0" / "device"
+    d.mkdir(parents=True)
+    (d / "vendor").write_text("0x1ae0\n")
+    (d / "device").write_text("0x0063\n")
+    (tmp_path / "dev").mkdir(exist_ok=True)
+    (tmp_path / "dev" / "accel0").write_text("")
+    monkeypatch.setenv("TPU_CC_DEVICE_GATING", "none")
+    be = SysfsTpuBackend(sysfs_root=str(sysfs),
+                         dev_root=str(tmp_path / "dev"),
+                         state_dir=str(tmp_path / "state"))
+    if mode:
+        chips, _ = be.find_tpus()
+        be.store.stage(chips[0].path, "cc", mode)
+        be.store.commit(chips[0].path)
+    return be
+
+
+def _node_with(name, state, doc):
+    return make_node(name, labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_STATE_LABEL: state},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(doc)})
+
+
+def test_evidence_carries_identity_inside_digest(tmp_path, monkeypatch):
+    be = _backend(tmp_path, monkeypatch)
+    ident = FakePlatformIdentity(KEY)
+    doc = build_evidence("n1", be, key=b"pool", identity_provider=ident)
+    assert doc["identity"]["provider"] == "fake"
+    assert judge_identity(doc, "n1", key=KEY) == ("ok", "ok")
+    # the digest covers the token: swapping it in is detected before
+    # identity is ever judged
+    from tpu_cc_manager.evidence import verify_evidence
+
+    swapped = dict(doc, identity={
+        "provider": "fake",
+        "token": mint_fake_token("n1", KEY, now=time.time() + 30)})
+    assert verify_evidence(swapped, key=b"pool")[0] is False
+
+
+def test_stolen_pool_key_without_identity_is_flagged(tmp_path,
+                                                     monkeypatch):
+    """THE drill: same pool key signs an honest doc (with identity) on
+    node A and a forged doc (no identity — the thief can't mint one)
+    for node B. The mixed pool exposes the forgery as
+    identity_missing."""
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    be = _backend(tmp_path, monkeypatch, mode="on")
+    honest = build_evidence("node-a", be, key=b"pool",
+                            identity_provider=FakePlatformIdentity(KEY))
+    forged = build_evidence("node-b", be, key=b"pool",
+                            identity_provider=None)
+    audit = audit_evidence(
+        [_node_with("node-a", "on", honest),
+         _node_with("node-b", "on", forged)],
+        key=b"pool",
+    )
+    assert audit["identity_missing"] == ["node-b"]
+    assert audit["identity_mismatch"] == []
+    assert audit["invalid"] == []  # the digest itself verifies fine
+
+    from tpu_cc_manager.fleet import fleet_problems
+
+    problems = fleet_problems({"evidence_audit": audit})
+    assert any("identity" in p and "node-b" in p for p in problems)
+
+
+def test_replayed_identity_token_is_mismatch(tmp_path, monkeypatch):
+    """The thief gets cleverer: embeds node A's VALID token in the doc
+    forged for node B. Node binding in the token claims catches it."""
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    be = _backend(tmp_path, monkeypatch, mode="on")
+
+    class ReplayingProvider:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return mint_fake_token("node-a", KEY)  # always node A's
+
+    forged = build_evidence("node-b", be, key=b"pool",
+                            identity_provider=ReplayingProvider())
+    audit = audit_evidence([_node_with("node-b", "on", forged)],
+                           key=b"pool")
+    assert audit["identity_mismatch"] == ["node-b"]
+
+
+def test_uniform_identityless_pool_is_clean(tmp_path, monkeypatch):
+    """Off-GCE pools mint no identities; an all-missing pool is not a
+    finding unless TPU_CC_REQUIRE_IDENTITY demands it."""
+    be = _backend(tmp_path, monkeypatch, mode="on")
+    doc = build_evidence("n1", be, key=b"pool", identity_provider=None)
+    nodes = [_node_with("n1", "on", doc)]
+    audit = audit_evidence(nodes, key=b"pool")
+    assert audit["identity_missing"] == []
+
+    monkeypatch.setenv("TPU_CC_REQUIRE_IDENTITY", "true")
+    audit = audit_evidence(nodes, key=b"pool")
+    assert audit["identity_missing"] == ["n1"]
+
+
+def test_rollout_flags_identity_mismatch(tmp_path, monkeypatch):
+    """The rollout judge runs the same triage: a member whose evidence
+    carries a foreign identity token never counts as converged, and
+    the verdict says 'identity'."""
+    import threading
+
+    from tpu_cc_manager.rollout import Rollout
+
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool")
+    be = _backend(tmp_path, monkeypatch, mode="on")
+
+    class ReplayingProvider:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return mint_fake_token("victim", KEY)
+
+    forged = build_evidence("copycat", be, key=b"pool",
+                            identity_provider=ReplayingProvider())
+    kube = FakeKube()
+    kube.add_node(make_node("copycat", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(forged)}))
+
+    stop = threading.Event()
+
+    def agent():
+        while not stop.is_set():
+            labels = kube.get_node("copycat")["metadata"]["labels"]
+            want = labels.get(L.CC_MODE_LABEL)
+            if want and labels.get(L.CC_MODE_STATE_LABEL) != want:
+                kube.set_node_labels(
+                    "copycat", {L.CC_MODE_STATE_LABEL: want})
+            time.sleep(0.02)
+
+    t = threading.Thread(target=agent, daemon=True)
+    t.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=1.5,
+                         poll_s=0.05).run()
+    finally:
+        stop.set()
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "identity" in group.detail
+
+
+def test_agent_publishes_identity_bearing_evidence(tmp_path,
+                                                   monkeypatch):
+    """End-to-end through the agent: TPU_CC_IDENTITY=fake makes every
+    reconcile's evidence carry a verifiable identity token."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    monkeypatch.setenv("TPU_CC_IDENTITY", "fake")
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    be = _backend(tmp_path, monkeypatch)
+    kube = FakeKube()
+    kube.add_node(make_node("id-node"))
+    cfg = AgentConfig(node_name="id-node", drain_strategy="none",
+                      health_port=0, emit_events=False)
+    agent = CCManagerAgent(kube, cfg, backend=be)
+    assert agent.reconcile("on") is True
+    assert agent.flush_events(timeout=10)
+    doc = json.loads(kube.get_node("id-node")["metadata"]["annotations"]
+                     [L.EVIDENCE_ANNOTATION])
+    assert judge_identity(doc, "id-node", key=KEY) == ("ok", "ok")
+    audit = audit_evidence(kube.list_nodes(None), key=None)
+    assert audit["identity_mismatch"] == []
+    assert audit["identity_missing"] == []
+
+
+def test_expired_identity_classed_as_staleness_not_forgery(tmp_path,
+                                                           monkeypatch):
+    """An idle node whose token aged out lands in identity_missing
+    (refresh broke), never identity_mismatch (forgery) — an idle fleet
+    must not read as under attack."""
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    be = _backend(tmp_path, monkeypatch, mode="on")
+
+    class StaleProvider:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return mint_fake_token(node_name, KEY,
+                                   now=time.time() - 7200, ttl_s=60)
+
+    doc = build_evidence("idle-1", be, key=b"pool",
+                         identity_provider=StaleProvider())
+    audit = audit_evidence([_node_with("idle-1", "on", doc)],
+                           key=b"pool")
+    assert audit["identity_missing"] == ["idle-1"]
+    assert audit["identity_mismatch"] == []
+
+
+def test_agent_refreshes_evidence_before_token_expiry(tmp_path,
+                                                      monkeypatch):
+    """No flip ever comes on an idle node: the agent must republish
+    evidence from its idle tick before the embedded token's verifier-
+    visible expiry, keeping the identity perpetually fresh."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    monkeypatch.setenv("TPU_CC_IDENTITY", "fake")
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    be = _backend(tmp_path, monkeypatch)
+    kube = FakeKube()
+    kube.add_node(make_node("fresh-node"))
+    cfg = AgentConfig(node_name="fresh-node", drain_strategy="none",
+                      health_port=0, emit_events=False)
+    agent = CCManagerAgent(kube, cfg, backend=be)
+    assert agent.reconcile("on") is True
+    assert agent.flush_events(timeout=10)
+    first = kube.get_node("fresh-node")["metadata"]["annotations"][
+        L.EVIDENCE_ANNOTATION]
+    # the refresh deadline was computed from the token's exp
+    assert agent._evidence_identity_refresh_at is not None
+
+    # idle tick BEFORE the deadline: no republish
+    agent._evidence_key_check_due = 0.0
+    agent._maybe_repair()
+    assert agent.flush_events(timeout=10)
+    assert (kube.get_node("fresh-node")["metadata"]["annotations"]
+            [L.EVIDENCE_ANNOTATION]) == first
+
+    # cross the deadline (simulate the token aging): republish with a
+    # fresh token — and the deadline advances so it doesn't loop
+    agent._evidence_identity_refresh_at = time.time() - 1
+    agent._evidence_key_check_due = 0.0
+    # the provider cache would still serve the cached token (it is not
+    # past ITS margin in this accelerated test) — drop it so the
+    # rebuild mints fresh, as a real margin-crossing would
+    from tpu_cc_manager.identity import get_identity_provider as _gip
+
+    _gip()._cache.clear()
+    time.sleep(1.1)  # fake mints at 1 s resolution; force a new iat
+    agent._maybe_repair()
+    assert agent.flush_events(timeout=10)
+    second = kube.get_node("fresh-node")["metadata"]["annotations"][
+        L.EVIDENCE_ANNOTATION]
+    assert second != first
+    assert agent._evidence_identity_refresh_at > time.time() - 1
+    doc = json.loads(second)
+    assert judge_identity(doc, "fresh-node", key=KEY) == ("ok", "ok")
+
+
+def test_unkeyed_rollout_judge_still_checks_identity(tmp_path,
+                                                     monkeypatch):
+    """The audit/rollout lockstep invariant, no_key edition: a rollout
+    operator WITHOUT the evidence key still refuses a member whose
+    signed document embeds a foreign identity token — node binding in
+    the token needs no evidence key to read."""
+    import threading
+
+    from tpu_cc_manager.rollout import Rollout
+
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    monkeypatch.delenv("TPU_CC_EVIDENCE_KEY", raising=False)
+    monkeypatch.delenv("TPU_CC_EVIDENCE_KEY_FILE", raising=False)
+    be = _backend(tmp_path, monkeypatch, mode="on")
+
+    class ReplayingProvider:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return mint_fake_token("victim", KEY)
+
+    # signed with a key the rollout judge does NOT hold -> no_key path
+    forged = build_evidence("copycat", be, key=b"agents-key",
+                            identity_provider=ReplayingProvider())
+    kube = FakeKube()
+    kube.add_node(make_node("copycat", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(forged)}))
+
+    stop = threading.Event()
+
+    def agent():
+        while not stop.is_set():
+            labels = kube.get_node("copycat")["metadata"]["labels"]
+            want = labels.get(L.CC_MODE_LABEL)
+            if want and labels.get(L.CC_MODE_STATE_LABEL) != want:
+                kube.set_node_labels(
+                    "copycat", {L.CC_MODE_STATE_LABEL: want})
+            time.sleep(0.02)
+
+    t = threading.Thread(target=agent, daemon=True)
+    t.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=1.5,
+                         poll_s=0.05).run()
+    finally:
+        stop.set()
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "identity" in group.detail
+
+
+def test_cached_token_survives_fetch_blip(monkeypatch):
+    """A refresh blip inside the margin serves the still-valid cached
+    token instead of stripping identity; expired cache + dead fetch
+    raises."""
+    calls = {"n": 0}
+
+    class Flaky(FakePlatformIdentity):
+        def token(self, node_name, audience=None):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise OSError("metadata blip")
+            return mint_fake_token(node_name, KEY, ttl_s=10)
+
+    p = Flaky(KEY)
+    tok1 = p.cached_token("n1")
+    # push past the refresh margin but not past expiry: fetch fails,
+    # the cached token is served
+    p._cache[("n1", "tpu-cc-manager")] = (
+        tok1, time.time() - 9, time.time() + 1)
+    assert p.cached_token("n1") == tok1
+    # past expiry: the blip propagates
+    p._cache[("n1", "tpu-cc-manager")] = (
+        tok1, time.time() - 20, time.time() - 1)
+    with pytest.raises(OSError):
+        p.cached_token("n1")
+
+
+def test_identity_fetch_blip_retried_from_idle_tick(tmp_path,
+                                                    monkeypatch):
+    """A metadata blip during a publish must not strip identity for
+    the process lifetime: the agent schedules a retry deadline even
+    though the published doc carries no token."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    monkeypatch.setenv("TPU_CC_IDENTITY", "fake")
+    # no identity key: the fake provider's token() raises -> the doc
+    # publishes identity-less, exactly like a metadata outage
+    monkeypatch.delenv("TPU_CC_IDENTITY_KEY", raising=False)
+    be = _backend(tmp_path, monkeypatch)
+    kube = FakeKube()
+    kube.add_node(make_node("blip-node"))
+    cfg = AgentConfig(node_name="blip-node", drain_strategy="none",
+                      health_port=0, emit_events=False)
+    agent = CCManagerAgent(kube, cfg, backend=be)
+    assert agent.reconcile("on") is True
+    assert agent.flush_events(timeout=10)
+    doc = json.loads(kube.get_node("blip-node")["metadata"]
+                     ["annotations"][L.EVIDENCE_ANNOTATION])
+    assert "identity" not in doc
+    # ...but a retry is scheduled, because a provider IS configured
+    assert agent._evidence_identity_refresh_at is not None
+
+    # the 'metadata server' recovers; the due idle tick attaches
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    agent._evidence_identity_refresh_at = time.time() - 1
+    agent._evidence_key_check_due = 0.0
+    agent._maybe_repair()
+    assert agent.flush_events(timeout=10)
+    doc = json.loads(kube.get_node("blip-node")["metadata"]
+                     ["annotations"][L.EVIDENCE_ANNOTATION])
+    assert judge_identity(doc, "blip-node", key=KEY) == ("ok", "ok")

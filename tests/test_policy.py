@@ -1454,10 +1454,8 @@ def test_full_stack_policy_to_scheduler(tmp_path):
             assert n["metadata"]["labels"][L.CC_MODE_STATE_LABEL] == "on"
         # evidence audit: every node's label claim is evidence-backed
         audit = audit_evidence(nodes)
-        assert audit == {
-            "missing": [], "unsigned": [], "unverifiable": [],
-            "invalid": [], "label_device_mismatch": [],
-        }
+        # every bucket empty, whatever buckets the audit grows
+        assert {k: v for k, v in audit.items() if v} == {}
         # admission: a confidential pod gets steered onto these nodes
         pod = {
             "metadata": {"name": "train",

@@ -974,3 +974,36 @@ def test_unkeyed_verifier_still_catches_keyless_contradictions(
     assert group.outcome == "timeout"
     assert "attests 'off'" in group.detail
     assert "no key here" in group.detail
+
+
+def test_unsigned_doc_attesting_wrong_mode_is_forensic(tmp_path,
+                                                       monkeypatch):
+    """Forensic outranks the runbook in the rollout judge too (audit
+    lockstep): an unsigned doc whose mode claim contradicts the target
+    reports 'attests', not the mount-the-Secret runbook — re-keying
+    agents would not make this node honest."""
+    import json as _json
+
+    from tpu_cc_manager.evidence import build_evidence
+
+    be = _statefile_backend(tmp_path)
+    # device truth stays 'off'; the doc attests it honestly, unsigned
+    unsigned_off = _json.dumps(build_evidence("w1", be, key=None))
+
+    kube = FakeKube()
+    kube.add_node(make_node("w1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: unsigned_off}))
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    agents = _ReactiveAgents(kube, ["w1"])
+    agents.start()
+    try:
+        report = Rollout(kube, "on", group_timeout_s=1.5,
+                         poll_s=0.05).run()
+    finally:
+        agents.stop.set()
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "attests 'off'" in group.detail
+    assert "tpu-cc-evidence-key" not in group.detail

@@ -137,6 +137,9 @@ class CCManagerAgent:
         self._evidence_published_gen = 0
         self._evidence_retry_due = 0.0
         self._evidence_key_check_due = 0.0
+        #: wall-clock deadline to republish evidence before its
+        #: embedded identity token expires (None: no expiring token)
+        self._evidence_identity_refresh_at: Optional[float] = None
         #: the key the last evidence build signed with; the idle tick
         #: republishes when the live key differs (the Secret appearing
         #: on a converged, otherwise-idle fleet must re-sign every
@@ -253,14 +256,16 @@ class CCManagerAgent:
         try:
             backend = self._backend or devlayer.get_backend()
             key = evidence_key()
-            payload = _json.dumps(
-                build_evidence(self.cfg.node_name, backend, key=key),
-                sort_keys=True, separators=(",", ":"),
-            )
+            doc = build_evidence(self.cfg.node_name, backend, key=key)
+            payload = _json.dumps(doc, sort_keys=True,
+                                  separators=(",", ":"))
             # recorded at build time (not publish time): what matters
             # for the idle tick's re-sign check is the posture of the
             # newest document headed for the cluster
             self._evidence_key_used = key
+            self._evidence_identity_refresh_at = (
+                self._identity_refresh_deadline(doc)
+            )
         except Exception:
             log.warning("evidence build failed; will retry", exc_info=True)
             return
@@ -282,6 +287,42 @@ class CCManagerAgent:
         if self._enqueue_recorder_item(task) == "full":
             log.warning("evidence publish dropped (recorder queue full); "
                         "retrying from the idle tick")
+
+    def _identity_refresh_deadline(self, doc: dict) -> Optional[float]:
+        """Wall-clock time at which the evidence should be republished
+        because its embedded identity token nears expiry (verifiers
+        class an expired token with 'missing'; an idle node must
+        refresh BEFORE that, since no flip will come to do it). None
+        when no identity is expected or the token carries no expiry."""
+        try:
+            token = (doc.get("identity") or {}).get("token")
+            if not token:
+                from tpu_cc_manager.identity import get_identity_provider
+
+                if get_identity_provider() is not None:
+                    # a provider is configured but the fetch failed
+                    # (metadata blip): RETRY from the idle tick — one
+                    # blip must not strip identity from this node's
+                    # evidence for the rest of the process lifetime
+                    return time.time() + 2 * (
+                        self.cfg.repair_interval_s or 30.0
+                    )
+                return None
+            from tpu_cc_manager.identity import token_claims
+
+            _, claims = token_claims(token)
+            exp = claims.get("exp")
+            iat = claims.get("iat", time.time())
+            if not isinstance(exp, (int, float)):
+                return None
+            # refresh when 20% of the lifetime remains: INSIDE the
+            # provider token cache's 25% refresh margin (so the rebuild
+            # actually fetches a fresh token instead of re-serving the
+            # cached one and looping) while still comfortably ahead of
+            # the verifier-visible expiry (~12 min for 1 h GCE tokens)
+            return float(exp) - 0.2 * max(float(exp) - float(iat), 0.0)
+        except Exception:
+            return None
 
     def _on_fatal_watch(self, exc: Exception) -> None:
         self._fatal = exc
@@ -593,6 +634,15 @@ class CCManagerAgent:
                 log.info(
                     "evidence key posture changed; re-signing evidence"
                 )
+                self._publish_evidence()
+            elif (self._evidence_identity_refresh_at is not None
+                    and time.time()
+                    >= self._evidence_identity_refresh_at):
+                # the embedded identity token nears expiry and no flip
+                # is coming: republish so verifiers never see this
+                # idle node's identity age out into 'expired'
+                log.info("identity token nearing expiry; refreshing "
+                         "evidence")
                 self._publish_evidence()
         # heal gate-perms drift on idle nodes (same cadence as repair;
         # local chmods only, no cluster traffic)

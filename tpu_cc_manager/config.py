@@ -27,6 +27,13 @@ flag                      env                            default
 (none)                    TPU_CC_HOLD_WAIT_S             30 (grace period for holders to leave)
 (none)                    TPU_CC_EVIDENCE                true (per-flip evidence annotation)
 (none)                    TPU_CC_EVIDENCE_KEY[_FILE]     "" (HMAC key; unset = plain sha256)
+(none)                    TPU_CC_IDENTITY                auto | gce | fake | none (platform
+                                                        identity attached to evidence)
+(none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)
+(none)                    TPU_CC_IDENTITY_AUDIENCE       tpu-cc-manager (token audience)
+(none)                    TPU_CC_METADATA_HOST           metadata.google.internal
+(none)                    TPU_CC_REQUIRE_IDENTITY        false (verifiers flag identity-less
+                                                        evidence even on uniform pools)
 (none)                    KUBE_API_TLS                   false (native agent + bash engine:
                                                         direct HTTPS, no proxy sidecar)
 (none)                    KUBE_CA_FILE                   serviceaccount ca.crt (with TLS)

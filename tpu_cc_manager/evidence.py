@@ -151,11 +151,25 @@ def _device_entry(chip, store) -> dict:
     return entry
 
 
+#: warned-once flag for identity-fetch failures: a flapping metadata
+#: server must not spam every reconcile
+_warned_identity_fetch = False
+
+
 def build_evidence(node_name: str, backend,
-                   key=_RESOLVE_KEY) -> dict:
+                   key=_RESOLVE_KEY, identity_provider="auto") -> dict:
     """Evidence document for the node's current device state. ``key``
     defaults to :func:`evidence_key`; pass ``None`` explicitly for a
-    deliberately unsigned document."""
+    deliberately unsigned document.
+
+    ``identity_provider``: ``"auto"`` resolves via
+    :func:`tpu_cc_manager.identity.get_identity_provider` (GCE metadata
+    server when reachable — so the sysfs/jaxdev backends on real GKE
+    nodes attach platform identity automatically); ``None`` attaches
+    none; otherwise a provider instance. The token lands INSIDE the
+    digested body, binding the platform identity to the device
+    attestation: a pool-key holder on node A cannot mint a document
+    carrying node B's identity."""
     key = _resolve(key)
     store = getattr(backend, "store", None)
     chips, err = backend.find_tpus()
@@ -175,6 +189,31 @@ def build_evidence(node_name: str, backend,
             store, [d["path"] for d in devices]
         ),
     }
+    if identity_provider == "auto":
+        from tpu_cc_manager.identity import get_identity_provider
+
+        identity_provider = get_identity_provider()
+    if identity_provider is not None:
+        # best-effort, warned once: a metadata-server blip must not
+        # fail evidence (and with it the reconcile's audit trail) —
+        # a document without identity degrades honestly to the
+        # identity_missing audit finding, it doesn't vanish
+        global _warned_identity_fetch
+        try:
+            # cached_token keeps the metadata-server round trip OFF the
+            # reconcile path in steady state: the agent's idle tick
+            # refreshes evidence (and the cache) before tokens expire
+            fetch = getattr(identity_provider, "cached_token", None) \
+                or identity_provider.token
+            doc["identity"] = {
+                "provider": identity_provider.provider,
+                "token": fetch(node_name),
+            }
+        except Exception:
+            if not _warned_identity_fetch:
+                _warned_identity_fetch = True
+                log.warning("platform identity fetch failed; evidence "
+                            "will carry no identity", exc_info=True)
     doc["digest"] = _digest(_canonical(doc), key)
     return doc
 
@@ -201,13 +240,10 @@ def plain_consistent(doc: dict) -> bool:
     triage an unsigned document under a keyed verifier: internally
     consistent means a benign key-deployment gap; inconsistent means
     tampering — the distinction decides whether the operator is told to
-    fix a manifest or to distrust a node."""
-    if not isinstance(doc, dict) or not isinstance(doc.get("digest"), str):
-        return False
-    body = {k: v for k, v in doc.items() if k != "digest"}
-    return hmac_mod.compare_digest(
-        _digest(_canonical(body), None), doc["digest"]
-    )
+    fix a manifest or to distrust a node. Delegates to the explicitly
+    keyless verifier so the triage can never diverge from the digest
+    rules it triages for."""
+    return verify_evidence(doc, key=None)[0]
 
 
 def classify_unsigned(doc: dict, node_name: str) -> str:
@@ -341,8 +377,18 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     mid-enablement, metric-only). Forensic findings outrank both: a
     replayed or label-contradicting document lands in invalid/mismatch
     regardless of key posture, because node binding and mode claims
-    need no key to read."""
+    need no key to read.
+
+    Platform identity (tpu_cc_manager.identity): ``identity_mismatch``
+    collects nodes whose document carries a token speaking for a
+    different node/audience or failing signature verification — the
+    stolen-pool-key forgery drill. ``identity_missing`` collects nodes
+    without identity, flagged only when TPU_CC_REQUIRE_IDENTITY is set
+    or the pool is MIXED (some nodes attach identity, some don't —
+    uniformity is the tell; an all-missing pool is simply not running
+    on a platform that mints identities)."""
     from tpu_cc_manager import labels as L
+    from tpu_cc_manager.identity import judge_identity, require_identity
 
     key = _resolve(key)
     missing: List[str] = []
@@ -350,6 +396,9 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     unverifiable: List[str] = []
     invalid: List[str] = []
     mismatch: List[str] = []
+    ident_missing: List[str] = []
+    ident_mismatch: List[str] = []
+    saw_identity = False
     for node in nodes:
         meta = node.get("metadata", {})
         name = meta.get("name", "?")
@@ -371,18 +420,46 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
             continue
         if verdict not in ("ok", "unsigned", "no_key"):
             invalid.append(name)
-        elif attested is not None and attested != state:
+            continue
+        if attested is not None and attested != state:
             mismatch.append(name)
         elif verdict == "unsigned":
             unsigned.append(name)
         elif verdict == "no_key":
             unverifiable.append(name)
+        # identity is judged for every digest-plausible document, even
+        # ones already flagged above — a mismatched label AND a foreign
+        # identity are two findings, not one
+        try:
+            iverdict, _ = judge_identity(doc, name)
+        except Exception:
+            iverdict = "invalid"
+        if iverdict == "missing":
+            ident_missing.append(name)
+        else:
+            # any attached token — even a bad one — marks this as an
+            # identity-bearing pool for the mixed-pool heuristic
+            saw_identity = True
+            if iverdict in ("mismatch", "invalid"):
+                ident_mismatch.append(name)
+            elif iverdict == "expired":
+                # staleness, not forgery: the binding checks passed,
+                # the token simply aged out (idle node whose agent
+                # stopped refreshing) — classed with missing so an
+                # idle fleet doesn't read as under attack
+                ident_missing.append(name)
+    if not (require_identity() or saw_identity):
+        # uniform all-missing pool without the require knob: not a
+        # finding — the platform simply mints no identities here
+        ident_missing = []
     return {
         "missing": sorted(missing),
         "unsigned": sorted(unsigned),
         "unverifiable": sorted(unverifiable),
         "invalid": sorted(invalid),
         "label_device_mismatch": sorted(mismatch),
+        "identity_missing": sorted(ident_missing),
+        "identity_mismatch": sorted(ident_mismatch),
     }
 
 

@@ -72,6 +72,25 @@ def fleet_problems(report: dict) -> List[str]:
     # 'unverifiable' (signed docs, unkeyed auditor) is deliberately NOT
     # a problem: it is the expected state mid-enablement (agents keyed
     # first). It stays visible via the evidence_issues metric.
+    if audit.get("identity_mismatch"):
+        # the forged-evidence drill: a document whose platform-identity
+        # token speaks for another node (or fails verification) means
+        # someone with the pool evidence key — but without control of
+        # THIS node's metadata server — minted it
+        problems.append(
+            "evidence identity mismatch (token speaks for another "
+            f"node or fails verification): "
+            f"{sorted(audit['identity_mismatch'])}"
+        )
+    if audit.get("identity_missing"):
+        # only populated on mixed pools or under TPU_CC_REQUIRE_IDENTITY
+        # (audit_evidence encodes that rule)
+        problems.append(
+            "evidence lacks platform identity on an identity-bearing "
+            f"pool: {sorted(audit['identity_missing'])} — a stolen "
+            "pool key can sign evidence but cannot mint the node's "
+            "instance identity token"
+        )
     doctor = report.get("doctor") or {}
     if doctor.get("failing"):
         problems.append(
@@ -144,7 +163,8 @@ class FleetMetrics:
         self.half_flipped_slices.set(len(report["half_flipped_slices"]))
         audit = report.get("evidence_audit", {})
         for issue in ("missing", "unsigned", "unverifiable", "invalid",
-                      "label_device_mismatch"):
+                      "label_device_mismatch", "identity_missing",
+                      "identity_mismatch"):
             self.evidence_issues.set(len(audit.get(issue, [])), issue)
         self.doctor_failing.set(
             len(report.get("doctor", {}).get("failing", []))

@@ -922,6 +922,9 @@ class Rollout:
         from tpu_cc_manager.evidence import (
             UNSIGNED_RUNBOOK, judge_evidence,
         )
+        from tpu_cc_manager.identity import (
+            judge_identity, require_identity,
+        )
 
         out: List[str] = []
         for m in members:
@@ -936,22 +939,18 @@ class Rollout:
                 )
             except Exception:
                 verdict, attested = "malformed", None
-            if verdict == "no_key":
-                if not self._warned_no_key:
-                    self._warned_no_key = True
-                    log.warning(
-                        "evidence is HMAC-signed but no "
-                        "TPU_CC_EVIDENCE_KEY is configured here; "
-                        "skipping digest verification"
-                    )
+            if verdict == "unsigned":
+                # forensic outranks the deployment-gap runbook, same
+                # rule as the audit: an unsigned doc attesting the
+                # WRONG mode is a label/device contradiction first —
+                # re-keying the agents would not make this node honest
                 if attested is not None and attested != self.mode:
                     self._suspect_reasons[m] = (
                         f"attests {attested!r}, not {self.mode!r} "
-                        "(digest unverifiable: no key here)"
+                        "(and unsigned under a keyed verifier)"
                     )
                     out.append(m)
-                continue
-            if verdict == "unsigned":
+                    continue
                 # loud the FIRST time, not only at group timeout — an
                 # operator watching logs sees the fix minutes before
                 # the timeout would have reported a mystery
@@ -966,12 +965,51 @@ class Rollout:
                 self._suspect_reasons[m] = "unsigned"
                 out.append(m)
                 continue
-            if verdict != "ok":
+            if verdict == "no_key":
+                # tolerated blind spot (the fleet controller holding
+                # the key still audits the digest) — but the keyless-
+                # checkable claims below still run
+                if not self._warned_no_key:
+                    self._warned_no_key = True
+                    log.warning(
+                        "evidence is HMAC-signed but no "
+                        "TPU_CC_EVIDENCE_KEY is configured here; "
+                        "skipping digest verification"
+                    )
+            elif verdict != "ok":
                 self._suspect_reasons[m] = verdict
                 out.append(m)
-            elif attested is not None and attested != self.mode:
+                continue
+            # keyless-checkable claims, for 'ok' AND 'no_key' docs —
+            # the same invariant the fleet audit holds: a document can
+            # never pass the rollout judge but fail the audit
+            if attested is not None and attested != self.mode:
+                qualifier = (
+                    " (digest unverifiable: no key here)"
+                    if verdict == "no_key" else ""
+                )
                 self._suspect_reasons[m] = (
-                    f"attests {attested!r}, not {self.mode!r}"
+                    f"attests {attested!r}, not {self.mode!r}{qualifier}"
+                )
+                out.append(m)
+                continue
+            # platform identity: a token speaking for another node (or
+            # failing verification) is the stolen-pool-key forgery and
+            # always a suspect; a MISSING or merely expired token is
+            # one only when the operator requires identity — the
+            # rollout must keep working on platforms that mint none
+            try:
+                iverdict, idetail = judge_identity(doc, m)
+            except Exception:
+                iverdict, idetail = "invalid", "identity judge failed"
+            if iverdict in ("mismatch", "invalid"):
+                self._suspect_reasons[m] = f"identity: {idetail}"
+                out.append(m)
+            elif (iverdict in ("missing", "expired")
+                    and require_identity()):
+                self._suspect_reasons[m] = (
+                    f"identity {iverdict} "
+                    "(TPU_CC_REQUIRE_IDENTITY is set)"
                 )
                 out.append(m)
         return sorted(out)
