@@ -24,6 +24,10 @@
 #   TPUDEVCTL            — path to tpudevctl (default: alongside script or PATH)
 #   CC_READINESS_FILE    — touched after successful set (reference :536)
 #   EMIT_EVENTS          — default true; post core/v1 Events per outcome
+#   SLICE_COORDINATION   — "false" opts a slice-labeled node out of the
+#                          slice-aware delegation (flip unilaterally)
+#   TPU_CC_SLICE_DELEGATE_CMD — printf template exec'd for slice members
+#                          (default "python3 -m tpu_cc_manager set-cc-mode -m %s")
 set -eo pipefail
 [ -n "$TPU_CC_DEBUG" ] && set -x   # reference runs with set -x (:3)
 
@@ -498,6 +502,69 @@ _device_at_mode() {
   esac
 }
 
+# ---------------------------------------------------- slice coherence
+SLICE_LABEL="tpu.google.com/cc.slice"
+
+_slice_guard() {
+  # Multi-host slice coherence on the bash/native path. The repo's
+  # flagship slice guarantee (slice_coord.py:19-42) is that members of
+  # one slice flip all-or-nothing; this engine has no quorum protocol,
+  # so a slice-labeled node must NEVER flip unilaterally from here.
+  # Resolution order:
+  #   SLICE_COORDINATION=false  -> explicit opt-out, flip locally
+  #   slice label absent        -> plain node, flip locally
+  #   else                      -> exec the slice-aware Python one-shot
+  #                                (same delegation pattern as doctor,
+  #                                native/agent.cpp g_doctor_cmd); if
+  #                                it is unavailable, REFUSE loudly —
+  #                                a half-flipped slice is worse than
+  #                                a failed reconcile
+  local mode="$1" target_dev="$2"
+  [ "${SLICE_COORDINATION:-}" = "false" ] && return 0
+  local node_json slice_id
+  if ! node_json="$(_fetch_node_json)"; then
+    # FAIL CLOSED: an unreadable node means we cannot prove this isn't
+    # a slice member, and a unilateral flip on one is the exact
+    # half-flipped state this guard exists to prevent (same refusal
+    # _evict_components makes on an unreadable node)
+    log "ERROR: cannot read node to check slice membership; refusing" \
+        "to flip. Set SLICE_COORDINATION=false to override explicitly."
+    _post_event "CCSliceAborted" "Warning" \
+      "refusing flip: node unreadable, slice membership unknown"
+    exit 1
+  fi
+  slice_id="$(_label_from_json "$node_json" "$SLICE_LABEL")"
+  [ -z "$slice_id" ] && return 0
+  if [ -n "$target_dev" ]; then
+    # a single-device flip on a slice member can't be quorum-coherent
+    # (the protocol flips whole nodes), and silently broadening it to
+    # all devices would be worse — refuse explicitly
+    log "ERROR: per-device flip (-d $target_dev) refused on slice" \
+        "'$slice_id' member; slice rounds are whole-node. Use -a, or" \
+        "SLICE_COORDINATION=false to override explicitly."
+    _post_event "CCSliceAborted" "Warning" \
+      "refusing per-device flip on slice '$slice_id' member"
+    exit 1
+  fi
+  local delegate="${TPU_CC_SLICE_DELEGATE_CMD:-python3 -m tpu_cc_manager set-cc-mode -m %s}"
+  local delegate_bin="${delegate%% *}"
+  if [ -n "$delegate" ] && command -v "$delegate_bin" >/dev/null 2>&1; then
+    log "slice '$slice_id' member: delegating to the slice-aware engine"
+    local cmd
+    # shellcheck disable=SC2059
+    printf -v cmd "$delegate" "$mode"
+    # exec replaces this process: exactly one engine owns the flip,
+    # and the delegate's exit code IS this engine's exit code
+    SLICE_COORDINATION=true exec $cmd
+  fi
+  log "ERROR: node is in slice '$slice_id' but the slice-aware engine" \
+      "('$delegate_bin') is unavailable; refusing a unilateral flip." \
+      "Set SLICE_COORDINATION=false to override explicitly."
+  _post_event "CCSliceAborted" "Warning" \
+    "refusing unilateral flip on slice '$slice_id' member: no slice-aware engine available"
+  exit 1
+}
+
 # ---------------------------------------------------------------- commands
 _parse_mode() {
   # reference _parse_mode (:125-134): reject unknown values loudly
@@ -510,6 +577,7 @@ _parse_mode() {
 set_cc_mode() {
   local mode="$1" target_dev="$2"
   _require_node_name
+  _slice_guard "$mode" "$target_dev"
   local devices=()
   while read -r dev is_switch capable; do
     [ -n "$target_dev" ] && [ "$dev" != "$target_dev" ] && continue

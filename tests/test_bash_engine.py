@@ -332,3 +332,167 @@ def test_drain_wait_fails_when_pods_never_listable(env):
     # devices untouched: the flip never ran
     q = run_sh(e2, "get-cc-mode", "-a")
     assert "cc=off" in q.stdout
+
+
+# ------------------------------------------------- slice coherence guard
+def _add_slice_node(server, name, slice_id):
+    server.store.add_node(make_node(name, labels={
+        DP: "true", L.TPU_SLICE_LABEL: slice_id}))
+
+
+def test_slice_member_delegates_not_flips(env, tmp_path):
+    """A slice-labeled node must never be flipped unilaterally by the
+    bash engine: it execs the slice-aware delegate instead, leaving
+    devices and labels for the delegate to own."""
+    e, server, root = env
+    server.store.add_node(make_node("slice-node", labels={
+        DP: "true", L.TPU_SLICE_LABEL: "s-1"}))
+    e = dict(e, NODE_NAME="slice-node")
+    marker = tmp_path / "delegated"
+    stub = tmp_path / "stub.sh"
+    stub.write_text(f"#!/bin/sh\necho \"$@\" > {marker}\nexit 0\n")
+    stub.chmod(0o755)
+    e["TPU_CC_SLICE_DELEGATE_CMD"] = f"{stub} %s"
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 0, r.stderr
+    assert marker.read_text().strip() == "on"
+    # the bash engine touched NOTHING itself
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(str(root / "dev" / "accel0"), "cc") == "off"
+    labels = server.store.get_node("slice-node")["metadata"]["labels"]
+    assert L.CC_MODE_STATE_LABEL not in labels
+
+
+def test_slice_member_refuses_without_delegate(env):
+    """No slice-aware engine available: refuse loudly (Event + rc 1)
+    rather than produce a half-flipped slice."""
+    e, server, root = env
+    server.store.add_node(make_node("slice-node", labels={
+        DP: "true", L.TPU_SLICE_LABEL: "s-1"}))
+    e = dict(e, NODE_NAME="slice-node")
+    e["TPU_CC_SLICE_DELEGATE_CMD"] = "/nonexistent-slice-engine %s"
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 1
+    assert "refusing a unilateral flip" in r.stderr
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(str(root / "dev" / "accel0"), "cc") == "off"
+    reasons = [ev.get("reason") for ev in server.store.cluster_events]
+    assert "CCSliceAborted" in reasons
+
+
+def test_slice_optout_flips_locally(env):
+    """SLICE_COORDINATION=false is the explicit single-host opt-out:
+    the engine flips directly even on a slice-labeled node."""
+    e, server, root = env
+    server.store.add_node(make_node("slice-node", labels={
+        DP: "true", L.TPU_SLICE_LABEL: "s-1"}))
+    e = dict(e, NODE_NAME="slice-node", SLICE_COORDINATION="false")
+    e["TPU_CC_SLICE_DELEGATE_CMD"] = "/nonexistent-slice-engine %s"
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 0, r.stderr
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(str(root / "dev" / "accel0"), "cc") == "on"
+
+
+def test_slice_delegation_runs_real_python_engine(env, tmp_path):
+    """Full native-path drill with the DEFAULT delegate: bash engine ->
+    python one-shot -> slice quorum protocol -> devices flipped +
+    state label set. A single-member slice reaches quorum alone, so
+    the whole chain runs hermetically."""
+    import sys
+
+    e, server, root = env
+    server.store.add_node(make_node("slice-node", labels={
+        DP: "true", L.TPU_SLICE_LABEL: "s-solo"}))
+    kubeconfig = tmp_path / "kubeconfig.yaml"
+    kubeconfig.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: c, user: u}}}}]
+clusters: [{{name: c, cluster: {{server: "http://127.0.0.1:{server.port}"}}}}]
+users: [{{name: u, user: {{}}}}]
+""")
+    e = dict(e, NODE_NAME="slice-node", KUBECONFIG=str(kubeconfig),
+             PYTHONPATH=REPO, DRAIN_STRATEGY="none",
+             TPU_CC_DEVICE_GATING="none", HEALTH_PORT="0")
+    e["TPU_CC_SLICE_DELEGATE_CMD"] = (
+        f"{sys.executable} -m tpu_cc_manager set-cc-mode -m %s"
+    )
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "devtools")
+    assert r.returncode == 0, r.stderr + r.stdout
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(str(root / "dev" / "accel0"), "cc") == "devtools"
+    labels = server.store.get_node("slice-node")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "devtools"
+
+
+def test_slice_delegate_aborts_on_missing_quorum(env, tmp_path):
+    """Two-member slice, one member silent: the delegated one-shot
+    times out on quorum WITHOUT flipping — exactly the half-flipped
+    state the delegation exists to prevent — and the abort propagates
+    as the engine's exit code."""
+    import sys
+
+    import time as _time
+
+    e, server, root = env
+    for name in ("m1", "m2"):
+        server.store.add_node(make_node(name, labels={
+            DP: "true", L.TPU_SLICE_LABEL: "s-pair"}))
+    # m2 must be ALIVE (fresh slice heartbeat) to be counted into the
+    # quorum — dead members are deliberately excluded so they cannot
+    # brick a slice forever
+    server.store.set_node_annotations(
+        "m2", {"tpu.google.com/cc.slice.hb": str(_time.time())})
+    kubeconfig = tmp_path / "kubeconfig.yaml"
+    kubeconfig.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: c, user: u}}}}]
+clusters: [{{name: c, cluster: {{server: "http://127.0.0.1:{server.port}"}}}}]
+users: [{{name: u, user: {{}}}}]
+""")
+    e = dict(e, NODE_NAME="m1", KUBECONFIG=str(kubeconfig),
+             PYTHONPATH=REPO, DRAIN_STRATEGY="none",
+             TPU_CC_DEVICE_GATING="none", HEALTH_PORT="0",
+             TPU_CC_SLICE_COMMIT_TIMEOUT_S="3")
+    e["TPU_CC_SLICE_DELEGATE_CMD"] = (
+        f"{sys.executable} -m tpu_cc_manager set-cc-mode -m %s"
+    )
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 1
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(str(root / "dev" / "accel0"), "cc") == "off"
+    labels = server.store.get_node("m1")["metadata"]["labels"]
+    assert labels.get(L.CC_MODE_STATE_LABEL) != "on"
+    reasons = [ev.get("reason") for ev in server.store.cluster_events]
+    assert "CCSliceAborted" in reasons
+
+
+def test_slice_guard_fails_closed_on_unreadable_node(env):
+    """Membership unknown = refuse: if the node can't be read the
+    engine cannot prove it isn't a slice member, so it must not flip."""
+    e, server, root = env
+    e = dict(e, NODE_NAME="never-created-node")
+    r = run_sh(e, "set-cc-mode", "-a", "-m", "on")
+    assert r.returncode == 1
+    assert "cannot read node to check slice membership" in r.stderr
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(str(root / "dev" / "accel0"), "cc") == "off"
+
+
+def test_slice_member_refuses_per_device_flip(env):
+    """-d on a slice member is refused: slice rounds are whole-node,
+    and silently broadening a single-device request would be worse."""
+    e, server, root = env
+    server.store.add_node(make_node("slice-node", labels={
+        DP: "true", L.TPU_SLICE_LABEL: "s-1"}))
+    e = dict(e, NODE_NAME="slice-node")
+    dev0 = str(root / "dev" / "accel0")
+    r = run_sh(e, "set-cc-mode", "-d", dev0, "-m", "on")
+    assert r.returncode == 1
+    assert "per-device flip" in r.stderr
+    store = ModeStateStore(str(root / "state"))
+    assert store.effective(dev0, "cc") == "off"

@@ -18,6 +18,7 @@ flag                      env                            default
 (none)                    CC_CAPABLE_DEVICE_IDS          "" (all Google chips capable)
 --health-port             HEALTH_PORT                    8089 (0 disables)
 (none)                    SLICE_COORDINATION             "false"
+(none)                    TPU_CC_SLICE_COMMIT_TIMEOUT_S  600 (quorum wait before abort)
 (none)                    REPAIR_INTERVAL_S              30 (0 disables self-repair)
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
 (none)                    EMIT_EVENTS                    true (reconcile Events)
@@ -96,6 +97,10 @@ class AgentConfig:
     #: fleet controller's trust-surface aggregation fresh without
     #: operator action. 0 disables. TPU_CC_DOCTOR_INTERVAL_S.
     doctor_interval_s: float = 300.0
+    #: Seconds a slice member waits for quorum before aborting the round
+    #: (slice_coord). Shared by the agent, the one-shot CLI, and through
+    #: it the bash engine's slice delegation. TPU_CC_SLICE_COMMIT_TIMEOUT_S.
+    slice_commit_timeout_s: float = 600.0
 
     def __post_init__(self):
         if self.drain_strategy not in ("components", "node", "none"):
@@ -112,6 +117,11 @@ class AgentConfig:
             raise ValueError(
                 f"invalid TPU_CC_DOCTOR_INTERVAL_S "
                 f"{self.doctor_interval_s!r}: must be >= 0 (0 disables)"
+            )
+        if self.slice_commit_timeout_s <= 0:
+            raise ValueError(
+                f"invalid TPU_CC_SLICE_COMMIT_TIMEOUT_S "
+                f"{self.slice_commit_timeout_s!r}: must be > 0"
             )
 
 
@@ -343,6 +353,9 @@ def parse_config(argv: Optional[List[str]] = None):
         emit_evidence=_env_bool("TPU_CC_EVIDENCE", True),
         doctor_interval_s=float(
             os.environ.get("TPU_CC_DOCTOR_INTERVAL_S", "300")
+        ),
+        slice_commit_timeout_s=float(
+            os.environ.get("TPU_CC_SLICE_COMMIT_TIMEOUT_S", "600")
         ),
     )
     return cfg, args

@@ -128,7 +128,7 @@ class SliceCoordinator:
         *,
         hb_period_s: float = HB_PERIOD_S,
         hb_ttl_s: float = HB_TTL_S,
-        commit_timeout_s: float = COMMIT_TIMEOUT_S,
+        commit_timeout_s: Optional[float] = None,
         poll_s: float = POLL_S,
         clock=time.time,
         tracer: Optional[Tracer] = None,
@@ -139,7 +139,13 @@ class SliceCoordinator:
         self.tracer = tracer or get_tracer()
         self.hb_period_s = hb_period_s
         self.hb_ttl_s = hb_ttl_s
-        self.commit_timeout_s = commit_timeout_s
+        # env parsing/validation lives in config.py
+        # (TPU_CC_SLICE_COMMIT_TIMEOUT_S -> cfg.slice_commit_timeout_s,
+        # threaded in by __main__) — None here just means the default
+        self.commit_timeout_s = (
+            COMMIT_TIMEOUT_S if commit_timeout_s is None
+            else commit_timeout_s
+        )
         self.poll_s = poll_s
         self.clock = clock
         #: Optional callable polled during the commit wait with the
@@ -226,6 +232,14 @@ class SliceCoordinator:
         multi-host slice. Raises SliceAbortError when the round never
         reached a commit (the local device state was not touched).
         """
+        # validate BEFORE any ack is published: a typo'd mode must be
+        # the instant InvalidModeError rejection every other path gives
+        # (engine.set_mode would catch it, but only after this member
+        # acked garbage to the slice and waited out the whole quorum
+        # timeout on peers who will never ack it)
+        from tpu_cc_manager.modes import parse_mode
+
+        parse_mode(raw_mode)
         slice_id = self.slice_id()
         if not slice_id:
             return engine.set_mode(raw_mode)
