@@ -623,9 +623,9 @@ def test_own_status_patches_do_not_self_wake():
     scans = []
 
     class Counting(PolicyController):
-        def scan_once(self):
+        def scan_once(self, wait_rollout=True):
             scans.append(time.monotonic())
-            return super().scan_once()
+            return super().scan_once(wait_rollout=wait_rollout)
 
     c = Counting(kube, interval_s=3600, poll_s=0.02)
     kube.add_custom(G, P, make_policy("p"))
@@ -1530,9 +1530,9 @@ def test_missing_crd_does_not_busy_scan_but_recovers_promptly():
             return super().watch_cluster_custom(*a, **k)
 
     class Counting(PolicyController):
-        def scan_once(self):
+        def scan_once(self, wait_rollout=True):
             scans.append(time.monotonic())
-            return super().scan_once()
+            return super().scan_once(wait_rollout=wait_rollout)
 
     kube = RacingKube()
     kube.add_node(_node("n0", desired="off", state="off"))
@@ -1629,3 +1629,108 @@ def test_cli_once_fails_when_crd_missing(monkeypatch, capsys):
     rc = cli.main(["policy-controller", "--once"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 1 and out["crd_missing"] is True
+
+
+# ---------------------------------------------------------------------------
+# fairness + non-blocking scans (VERDICT r3 weak #2/#3)
+# ---------------------------------------------------------------------------
+
+def test_starving_policy_cannot_block_others():
+    """Policy 'aaa' (first in name order) owns a pool that never
+    converges; 'bbb' owns a healthy pool. Backoff + round-robin must
+    give 'bbb' the slot within a couple of ticks — name order alone
+    must not starve it."""
+    kube = FakeKube()
+    kube.add_node(_node("dead-1", desired="off", state="off",
+                        extra={"pool": "a"}))
+    kube.add_node(_node("ok-1", desired="off", state="off",
+                        extra={"pool": "b"}))
+    kube.add_custom(G, P, make_policy(
+        "aaa", selector="pool=a",
+        strategy={"groupTimeoutSeconds": 1},
+    ))
+    kube.add_custom(G, P, make_policy(
+        "bbb", selector="pool=b",
+        strategy={"groupTimeoutSeconds": 30},
+    ))
+    agents = _ReactiveAgents(kube, ["ok-1"])  # dead-1 has NO agent
+    agents.start()
+    c = controller(kube, interval_s=0.2)
+    try:
+        # scan 1: aaa wins the slot, times out (1s), backs off
+        st = c.scan_once()["policies"]
+        assert st["aaa"]["phase"] == "Degraded"
+        # scan 2: aaa is backing off -> bbb converges
+        st = c.scan_once()["policies"]
+        assert st["bbb"]["phase"] == "Converged", st["bbb"]
+        assert "backing off" in st["aaa"]["message"]
+    finally:
+        agents.stop.set()
+
+
+def test_round_robin_rotates_launch_slot():
+    """With neither policy failing, consecutive ticks alternate which
+    actionable policy gets the rollout slot."""
+    kube = FakeKube()
+    kube.add_node(_node("a-1", desired="off", state="off",
+                        extra={"pool": "a"}))
+    kube.add_node(_node("b-1", desired="off", state="off",
+                        extra={"pool": "b"}))
+    kube.add_custom(G, P, make_policy("aaa", selector="pool=a"))
+    kube.add_custom(G, P, make_policy("bbb", selector="pool=b"))
+    agents = _ReactiveAgents(kube, ["a-1", "b-1"])
+    agents.start()
+    c = controller(kube, interval_s=0.2)
+    try:
+        launched = []
+        orig = c._drive_rollout
+
+        def recording(pol, spec, st):
+            launched.append(pol["metadata"]["name"])
+            return orig(pol, spec, st)
+
+        c._drive_rollout = recording
+        c.scan_once()
+        # both pools now converged; force both divergent again
+        kube.set_node_labels("a-1", {L.CC_MODE_STATE_LABEL: "off",
+                                     L.CC_MODE_LABEL: "off"})
+        kube.set_node_labels("b-1", {L.CC_MODE_STATE_LABEL: "off",
+                                     L.CC_MODE_LABEL: "off"})
+        c.scan_once()
+        assert launched[0] != launched[1], launched
+    finally:
+        agents.stop.set()
+
+
+def test_scan_stays_live_during_slow_rollout():
+    """wait_rollout=False (the run-loop mode): while one policy's
+    rollout drains a dead pool, further scans return promptly, keep the
+    rolling policy's live worker status, and keep OTHER policies'
+    statuses fresh."""
+    kube = FakeKube()
+    kube.add_node(_node("dead-1", desired="off", state="off",
+                        extra={"pool": "a"}))
+    kube.add_node(_node("idle-1", desired="on", state="on",
+                        extra={"pool": "b"}))
+    kube.add_custom(G, P, make_policy(
+        "slow", selector="pool=a",
+        strategy={"groupTimeoutSeconds": 4},
+    ))
+    kube.add_custom(G, P, make_policy("fine", selector="pool=b"))
+    c = controller(kube, interval_s=0.2)
+    try:
+        r1 = c.scan_once(wait_rollout=False)
+        assert r1["policies"]["slow"]["phase"] == "Rolling"
+        # the worker is still draining its 4s group timeout; scans in
+        # the meantime are fast and fully-populated
+        t0 = time.monotonic()
+        r2 = c.scan_once(wait_rollout=False)
+        assert time.monotonic() - t0 < 2.0
+        assert r2.get("rolling") == "slow"
+        assert r2["policies"]["slow"]["phase"] == "Rolling"
+        assert r2["policies"]["fine"]["phase"] == "Converged"
+        # the on-cluster status of 'fine' was refreshed mid-roll
+        live = kube.get_cluster_custom(G, V, P, "fine")
+        assert live["status"]["phase"] == "Converged"
+    finally:
+        c._join_worker()

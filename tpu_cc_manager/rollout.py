@@ -240,6 +240,9 @@ class Rollout:
         #: member -> why its evidence was rejected, for actionable
         #: timeout verdicts (unsigned-under-key names the manifest fix)
         self._suspect_reasons: Dict[str, str] = {}
+        #: total groups this run will judge (set once planning is done);
+        #: the progress hook's denominator
+        self._planned_total: Optional[int] = None
         #: durable-record state (anchor-node annotation); set by run()
         self._record: Optional[dict] = None
         self._record_node: Optional[str] = None
@@ -384,8 +387,11 @@ class Rollout:
                 1 for g in groups.values()
                 if g.get("outcome") in _TERMINAL
             )
+            total = self._planned_total
+            if total is None or total < len(groups):
+                total = len(groups)
             try:
-                self.on_group(gname, outcome, done, len(groups))
+                self.on_group(gname, outcome, done, total)
             except Exception:
                 log.warning("rollout progress hook failed", exc_info=True)
 
@@ -585,6 +591,14 @@ class Rollout:
                     }
                 self._persist()
 
+        # the denominator the progress hook reports: every group this
+        # run will ultimately judge — already-judged + queued + adopted
+        # in-flight — not just the ones recorded so far (queued groups
+        # only enter the record at launch, so len(record.groups) would
+        # read '3/3 done' with work still pending, ADVICE r3)
+        self._planned_total = (
+            len(results) + len(pending) + len(in_flight_seed)
+        )
         report = RolloutReport(self.mode, results, aborted=aborted,
                                preflight=preflight)
         if self.dry_run or (not pending and not in_flight_seed):
