@@ -1,0 +1,292 @@
+"""Lease-based leader election (tpu_cc_manager.leader) — VERDICT r3
+missing #3: two controller replicas must not double-scan or double-
+launch rollouts. Mirrors client-go's leaderelection semantics on a
+coordination.k8s.io/v1 Lease: CAS acquire/renew, observed-staleness
+takeover (never wall-clock comparison), release-on-shutdown for
+immediate failover.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, ConflictError
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.leader import LeaderElector
+
+
+def _elector(kube, ident, **kw):
+    kw.setdefault("lease_duration_s", 0.4)
+    kw.setdefault("renew_period_s", 0.1)
+    kw.setdefault("retry_period_s", 0.05)
+    return LeaderElector(kube, name="test-lease", identity=ident, **kw)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------------ lease CAS
+def test_lease_crud_and_cas_fake():
+    kube = FakeKube()
+    with pytest.raises(ApiException) as ei:
+        kube.get_lease("ns", "l")
+    assert ei.value.status == 404
+    lease = kube.create_lease("ns", {
+        "metadata": {"name": "l"},
+        "spec": {"holderIdentity": "a"},
+    })
+    rv = lease["metadata"]["resourceVersion"]
+    # same-rv replace lands; the rv moves
+    lease2 = kube.replace_lease("ns", "l", lease)
+    assert lease2["metadata"]["resourceVersion"] != rv
+    # a stale-rv replace is the losing side of the CAS
+    with pytest.raises(ConflictError):
+        kube.replace_lease("ns", "l", lease)
+    with pytest.raises(ApiException) as ei:
+        kube.create_lease("ns", {"metadata": {"name": "l"}, "spec": {}})
+    assert ei.value.status == 409
+
+
+def test_lease_over_the_wire():
+    """The same trio through the HTTP client against the fake API
+    server — the wire contract the real apiserver speaks."""
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+
+    with FakeApiServer() as srv:
+        kube = HttpKubeClient(
+            KubeConfig(host="127.0.0.1", port=srv.port, use_tls=False)
+        )
+        with pytest.raises(ApiException) as ei:
+            kube.get_lease("tpu-system", "ctl")
+        assert ei.value.status == 404
+        created = kube.create_lease("tpu-system", {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "ctl"},
+            "spec": {"holderIdentity": "pod-a"},
+        })
+        got = kube.get_lease("tpu-system", "ctl")
+        assert got["spec"]["holderIdentity"] == "pod-a"
+        got["spec"]["holderIdentity"] = "pod-b"
+        kube.replace_lease("tpu-system", "ctl", got)
+        with pytest.raises(ConflictError):
+            kube.replace_lease("tpu-system", "ctl", created)
+
+
+# ------------------------------------------------------------- election
+def test_single_elector_acquires_and_renews():
+    kube = FakeKube()
+    e = _elector(kube, "a")
+    assert e.try_acquire_or_renew() is True
+    lease = kube.get_lease("tpu-system", "test-lease")
+    assert lease["spec"]["holderIdentity"] == "a"
+    first_renew = lease["spec"]["renewTime"]
+    time.sleep(0.01)
+    assert e.try_acquire_or_renew() is True
+    assert kube.get_lease("tpu-system", "test-lease")["spec"][
+        "leaseTransitions"] == 0
+
+
+def test_candidate_waits_out_live_holder_then_takes_over():
+    kube = FakeKube()
+    a, b = _elector(kube, "a"), _elector(kube, "b")
+    assert a.try_acquire_or_renew()
+    # b observes a live holder: no takeover while a keeps renewing
+    for _ in range(6):
+        assert b.try_acquire_or_renew() is False
+        assert a.try_acquire_or_renew() is True
+        time.sleep(0.08)
+    # a dies (stops renewing); b takes over only after the observed
+    # renewTime sat unchanged a full lease duration on b's clock
+    t0 = time.monotonic()
+    assert _wait(lambda: b.try_acquire_or_renew(), timeout=3)
+    assert time.monotonic() - t0 >= 0.3  # not instant
+    lease = kube.get_lease("tpu-system", "test-lease")
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert lease["spec"]["leaseTransitions"] == 1
+    # the deposed leader's next renew loses the CAS
+    assert a.try_acquire_or_renew() is False
+
+
+def test_create_race_has_one_winner():
+    kube = FakeKube()
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def race(ident):
+        e = _elector(kube, ident)
+        barrier.wait()
+        results[ident] = e.try_acquire_or_renew()
+
+    ts = [threading.Thread(target=race, args=(i,)) for i in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(results.values()) == [False, True]
+
+
+def test_release_on_stop_gives_immediate_failover():
+    kube = FakeKube()
+    a = _elector(kube, "a").start()
+    assert _wait(lambda: a.is_leader)
+    b = _elector(kube, "b")
+    assert b.try_acquire_or_renew() is False
+    a.stop()  # releases the lease
+    assert kube.get_lease("tpu-system", "test-lease")["spec"][
+        "holderIdentity"] == ""
+    # no staleness wait: a released lease is claimed on the next step
+    assert b.try_acquire_or_renew() is True
+
+
+# ----------------------------------------------- controller integration
+def _policy(name="pol"):
+    return {
+        "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+        "kind": L.POLICY_KIND,
+        "metadata": {"name": name},
+        "spec": {"mode": "on",
+                 "nodeSelector": L.TPU_ACCELERATOR_LABEL},
+    }
+
+
+def test_two_controllers_exactly_one_scans_and_failover():
+    """THE scenario election exists for: two policy controllers over
+    one cluster — exactly one scans (no double status writes, no
+    double rollout launch); kill the leader and the standby takes over
+    within the lease duration and finishes the work."""
+    from tpu_cc_manager.policy import PolicyController
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"}))
+    kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, _policy())
+
+    # reactive agent so rollouts converge
+    stop_agent = threading.Event()
+
+    def agent():
+        while not stop_agent.is_set():
+            labels = kube.get_node("n1")["metadata"]["labels"]
+            want = labels.get(L.CC_MODE_LABEL)
+            if want and labels.get(L.CC_MODE_STATE_LABEL) != want:
+                kube.set_node_labels("n1",
+                                     {L.CC_MODE_STATE_LABEL: want})
+            time.sleep(0.02)
+
+    threading.Thread(target=agent, daemon=True).start()
+
+    scans = {"a": 0, "b": 0}
+
+    def make_controller(ident):
+        elector = LeaderElector(
+            kube, name="tpu-cc-policy-controller", identity=ident,
+            lease_duration_s=0.5, renew_period_s=0.1,
+            retry_period_s=0.05,
+        )
+        c = PolicyController(kube, interval_s=0.1, poll_s=0.02,
+                             port=0, leader_elector=elector)
+        orig = c.scan_once
+
+        def counting(wait_rollout=True):
+            scans[ident] += 1
+            return orig(wait_rollout=wait_rollout)
+
+        c.scan_once = counting
+        return c
+
+    ca, cb = make_controller("a"), make_controller("b")
+    ta = threading.Thread(target=ca.run, daemon=True)
+    ta.start()
+    assert _wait(lambda: scans["a"] > 0)
+    tb = threading.Thread(target=cb.run, daemon=True)
+    tb.start()
+    # give b time to (not) scan while a leads
+    time.sleep(1.0)
+    assert scans["b"] == 0, "standby must not scan while the leader lives"
+    assert cb.healthy  # hot standby stays healthy
+    a_scans = scans["a"]
+    assert a_scans > 1
+
+    # leader dies; standby takes over and the policy still converges
+    ca.stop()
+    assert _wait(lambda: scans["b"] > 0, timeout=5), "no failover"
+    assert _wait(
+        lambda: (kube.get_cluster_custom(
+            L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL, "pol"
+        ).get("status") or {}).get("phase") == "Converged",
+        timeout=5,
+    )
+    cb.stop()
+    stop_agent.set()
+
+
+def test_demotion_stops_rollout_and_leaves_record_adoptable():
+    """A deposed leader must stop ACTING, not just stop scanning: its
+    in-flight rollout worker walks away mid-roll, leaving the durable
+    record unfinished (heartbeat dead) so the NEW leader adopts and
+    finishes it."""
+    from tpu_cc_manager.policy import PolicyController
+    from tpu_cc_manager.rollout import load_rollout_record
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"}))
+    kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+        "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+        "kind": L.POLICY_KIND, "metadata": {"name": "pol"},
+        "spec": {"mode": "on", "nodeSelector": L.TPU_ACCELERATOR_LABEL,
+                 "strategy": {"groupTimeoutSeconds": 60}},
+    })
+    elector = LeaderElector(kube, name="tpu-cc-policy-controller",
+                            identity="a", lease_duration_s=0.5,
+                            renew_period_s=0.1, retry_period_s=0.05)
+    c = PolicyController(kube, interval_s=0.2, poll_s=0.02, port=0,
+                         leader_elector=elector)
+    assert elector.try_acquire_or_renew()
+    elector._set_leader(True)
+    # launch the rollout worker against a pool with NO agent: it would
+    # otherwise sit in the 60s group timeout
+    r = c.scan_once(wait_rollout=False)
+    assert r["policies"]["pol"]["phase"] == "Rolling"
+    assert _wait(lambda: c._current_rollout is not None)
+
+    c._on_demoted()  # leadership lost mid-roll
+    assert _wait(lambda: c._active is None, timeout=5), \
+        "worker did not stop after demotion"
+    record, _ = load_rollout_record(kube, kube.list_nodes(None))
+    assert record is not None
+    assert record["complete"] is False  # adoptable, not finished
+    assert record["aborted"] is False
+
+
+def test_readyz_is_leader_aware():
+    """Standby: healthy (liveness ok) but NOT ready — the Service must
+    not route /metrics//report scrapes to a replica serving standby
+    emptiness."""
+    from tpu_cc_manager.policy import PolicyController
+
+    kube = FakeKube()
+    elector = _elector(kube, "a")
+    c = PolicyController(kube, interval_s=1, port=0,
+                         leader_elector=elector)
+    assert c._healthz()[0] == 200
+    assert c._readyz()[0] == 503  # candidate, not leader yet
+    assert b"standby" in c._readyz()[1]
+    assert elector.try_acquire_or_renew()
+    elector._set_leader(True)
+    assert c._readyz()[0] == 200
+    # no elector configured: always ready when healthy
+    c2 = PolicyController(kube, interval_s=1, port=0)
+    assert c2._readyz()[0] == 200

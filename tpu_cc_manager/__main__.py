@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 
 from tpu_cc_manager.agent import CCManagerAgent
@@ -44,6 +45,30 @@ log = logging.getLogger("tpu-cc-manager")
 
 def _kube_client(cfg):
     return HttpKubeClient(KubeConfig.load(cfg.kubeconfig))
+
+
+def _leader_elector(kube, lease_name: str):
+    """LeaderElector for a controller, when TPU_CC_LEADER_ELECT=true
+    (manifests set it; single-replica/dev runs skip election). Identity
+    is the pod name (downward API) so `kubectl get lease` names the
+    actual holder pod."""
+    if os.environ.get("TPU_CC_LEADER_ELECT", "").lower() not in (
+            "1", "true", "yes"):
+        return None
+    import socket
+
+    from tpu_cc_manager.leader import LeaderElector
+
+    identity = (
+        os.environ.get("POD_NAME")
+        or f"{socket.gethostname()}-{os.getpid()}"
+    )
+    return LeaderElector(
+        kube,
+        name=lease_name,
+        identity=identity,
+        namespace=os.environ.get("OPERATOR_NAMESPACE", "tpu-system"),
+    )
 
 
 def _stop_on_sigterm(stop_fn) -> None:
@@ -158,11 +183,15 @@ def main(argv=None) -> int:
         from tpu_cc_manager.fleet import FleetController, fleet_problems
 
         try:
+            kube = _kube_client(cfg)
             controller = FleetController(
-                _kube_client(cfg),
+                kube,
                 selector=args.selector,
                 interval_s=args.interval,
                 port=args.port,
+                leader_elector=_leader_elector(
+                    kube, "tpu-cc-fleet-controller"
+                ),
             )
             if args.once:
                 # cron/CI audit: one scan, report on stdout, exit code
@@ -186,11 +215,15 @@ def main(argv=None) -> int:
         from tpu_cc_manager.policy import PolicyController
 
         try:
+            kube = _kube_client(cfg)
             controller = PolicyController(
-                _kube_client(cfg),
+                kube,
                 interval_s=args.interval,
                 port=args.port,
                 verify_evidence=not args.no_verify_evidence,
+                leader_elector=_leader_elector(
+                    kube, "tpu-cc-policy-controller"
+                ),
             )
             if args.once:
                 # cron/CI mode: one pass, report on stdout, exit code

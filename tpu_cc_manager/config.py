@@ -41,6 +41,12 @@ flag                      env                            default
 (none)                    BEARER_TOKEN_FILE              "" (SA token for direct API auth)
 --interval (fleet)        FLEET_SCAN_INTERVAL            30 (seconds)
 --port (fleet)            FLEET_PORT                     8090
+(none)                    TPU_CC_LEADER_ELECT            false (controllers: Lease-based
+                                                        leader election; replicas: 2 safe)
+(none)                    POD_NAME                       "" (lease holder identity; the
+                                                        manifests set it via downward API)
+(none)                    OPERATOR_NAMESPACE             tpu-system (also where the
+                                                        election Leases live)
 ========================  =============================  =======================
 """
 

@@ -191,9 +191,14 @@ class FleetController:
         interval_s: float = 30.0,
         port: int = 8090,
         max_consecutive_errors: int = 10,
+        leader_elector=None,
     ):
         self.kube = kube
         self.selector = selector
+        #: optional tpu_cc_manager.leader.LeaderElector: when set, run()
+        #: scans only while holding the Lease (standby replicas stay
+        #: hot but quiet — see policy.py's identical gating)
+        self.leader_elector = leader_elector
         if interval_s <= 0:
             raise ValueError(
                 f"scan interval must be > 0, got {interval_s!r} "
@@ -207,6 +212,7 @@ class FleetController:
         self._stop = threading.Event()
         self._server = RouteServer(port, name="fleet-http")
         self._server.add_route("/healthz", self._healthz)
+        self._server.add_route("/readyz", self._readyz)
         self._server.add_route("/metrics", self._metrics_route)
         self._server.add_route("/report", self._report_route)
 
@@ -304,6 +310,16 @@ class FleetController:
         return ((200, b"ok", "text/plain") if self.healthy
                 else (503, b"unhealthy", "text/plain"))
 
+    def _readyz(self):
+        """Leader-aware readiness (see policy.py _readyz): standbys are
+        healthy but not Ready, keeping Service traffic on the scanner."""
+        if not self.healthy:
+            return 503, b"unhealthy", "text/plain"
+        if (self.leader_elector is not None
+                and not self.leader_elector.is_leader):
+            return 503, b"standby (not leader)", "text/plain"
+        return 200, b"ok", "text/plain"
+
     def _metrics_route(self):
         return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
 
@@ -320,8 +336,15 @@ class FleetController:
             "fleet controller serving on :%d (selector %r, every %.0fs)",
             self.port, self.selector, self.interval_s,
         )
+        if self.leader_elector is not None:
+            self.leader_elector.start()
         try:
             while not self._stop.is_set():
+                if (self.leader_elector is not None
+                        and not self.leader_elector.is_leader):
+                    self.last_report = {"standby": True}
+                    self._stop.wait(self.leader_elector.retry_period_s)
+                    continue
                 try:
                     report = self.scan_once()
                     log.info(
@@ -344,4 +367,6 @@ class FleetController:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.leader_elector is not None:
+            self.leader_elector.stop()  # release: standby takes over now
         self._server.stop()

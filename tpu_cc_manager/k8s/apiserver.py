@@ -20,6 +20,9 @@ Endpoints:
 - ``GET    /apis/{group}/{ver}/{plural}``          (cluster-scoped CRs; watch=true)
 - ``GET    /apis/{group}/{ver}/{plural}/{name}``
 - ``PATCH  /apis/{group}/{ver}/{plural}/{name}[/status]``
+- ``GET    /apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}``
+- ``POST   /apis/coordination.k8s.io/v1/namespaces/{ns}/leases``
+- ``PUT    /apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}``  (CAS -> 409)
 
 Watch responses are newline-delimited JSON event streams, ending when the
 ``timeoutSeconds`` window elapses (clean EOF), or a single ERROR event for
@@ -147,6 +150,15 @@ class _Handler(BaseHTTPRequestHandler):
                     _list_obj("EventList",
                               self.store.list_events(parts[3]), None),
                 )
+            if (
+                len(parts) == 7
+                and parts[1] == "coordination.k8s.io"
+                and parts[3] == "namespaces"
+                and parts[5] == "leases"
+            ):
+                return self._send_json(
+                    200, self.store.get_lease(parts[4], parts[6])
+                )
             if parts[0] == "apis" and len(parts) == 4:
                 group, ver, plural = parts[1], parts[2], parts[3]
                 if q.get("watch") == "true":
@@ -195,6 +207,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(
                     200, self.store.replace_node(parts[3], self._read_body())
                 )
+            if (
+                len(parts) == 7
+                and parts[1] == "coordination.k8s.io"
+                and parts[3] == "namespaces"
+                and parts[5] == "leases"
+            ):
+                return self._send_json(
+                    200,
+                    self.store.replace_lease(
+                        parts[4], parts[6], self._read_body()
+                    ),
+                )
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:
             return self._send_error_status(e)
@@ -236,6 +260,16 @@ class _Handler(BaseHTTPRequestHandler):
             ):
                 return self._send_json(
                     201, self.store.create_event(parts[3], self._read_body())
+                )
+            if (
+                len(parts) == 6
+                and parts[1] == "coordination.k8s.io"
+                and parts[3] == "namespaces"
+                and parts[5] == "leases"
+            ):
+                return self._send_json(
+                    201,
+                    self.store.create_lease(parts[4], self._read_body()),
                 )
             return self._send_error_status(ApiException(404, f"no route {self.path}"))
         except ApiException as e:

@@ -142,6 +142,24 @@ class KubeClient(abc.ABC):
         object) until the server-side timeout, like watch_nodes."""
         raise ApiException(501, "custom resources not supported by this client")
 
+    # leases (coordination.k8s.io/v1) -----------------------------------
+    # the leader-election primitive (tpu_cc_manager.leader): namespaced
+    # Lease objects with optimistic-concurrency replace — exactly the
+    # trio client-go's resourcelock.LeaseLock uses
+    def get_lease(self, namespace: str, name: str) -> dict:
+        raise ApiException(501, "leases not supported by this client")
+
+    def create_lease(self, namespace: str, lease: dict) -> dict:
+        """POST; raises ApiException(409) if it already exists."""
+        raise ApiException(501, "leases not supported by this client")
+
+    def replace_lease(self, namespace: str, name: str,
+                      lease: dict) -> dict:
+        """PUT with the object's metadata.resourceVersion; raises
+        ConflictError when the server's moved on (someone else renewed
+        or took the lease first — the CAS that makes election safe)."""
+        raise ApiException(501, "leases not supported by this client")
+
     # convenience built on the primitives -------------------------------
     def set_node_labels(self, name: str, labels: Dict[str, Optional[str]]) -> dict:
         return self.patch_node(name, {"metadata": {"labels": labels}})
@@ -702,6 +720,28 @@ class HttpKubeClient(KubeClient):
             "GET", f"/api/v1/namespaces/{namespace}/events"
         )
         return resp.get("items", [])
+
+    # -- leases (coordination.k8s.io/v1) ---------------------------------
+    _LEASE_BASE = "/apis/coordination.k8s.io/v1/namespaces"
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET", f"{self._LEASE_BASE}/{namespace}/leases/{name}"
+        )
+
+    def create_lease(self, namespace: str, lease: dict) -> dict:
+        return self._request(
+            "POST", f"{self._LEASE_BASE}/{namespace}/leases", body=lease
+        )
+
+    def replace_lease(self, namespace: str, name: str,
+                      lease: dict) -> dict:
+        # PUT carries metadata.resourceVersion; the server 409s when it
+        # moved — surfaced as ConflictError by _request
+        return self._request(
+            "PUT", f"{self._LEASE_BASE}/{namespace}/leases/{name}",
+            body=lease,
+        )
 
     # -- watch ----------------------------------------------------------
     def watch_nodes(

@@ -76,6 +76,8 @@ class FakeKube(KubeClient):
         #: snapshot) — separate from the node history so node churn
         #: can't 410 a policy watcher
         self._custom_events: List[Tuple[int, str, str, str, dict]] = []
+        #: coordination.k8s.io/v1 Leases, keyed (namespace, name)
+        self._leases: Dict[Tuple[str, str], dict] = {}
 
     # ------------------------------------------------------------ helpers
     def _bump(self, obj: dict) -> None:
@@ -167,6 +169,48 @@ class FakeKube(KubeClient):
             self._nodes[name] = new
             self._bump(new)
             self._record("MODIFIED", new)
+            return copy.deepcopy(new)
+
+    # ------------------------------------------------------------- leases
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            if lease is None:
+                raise ApiException(404, f"lease {namespace}/{name} not found")
+            return copy.deepcopy(lease)
+
+    def create_lease(self, namespace: str, lease: dict) -> dict:
+        with self._lock:
+            name = lease["metadata"]["name"]
+            if (namespace, name) in self._leases:
+                raise ApiException(
+                    409, f"lease {namespace}/{name} already exists"
+                )
+            new = copy.deepcopy(lease)
+            new["metadata"]["namespace"] = namespace
+            self._bump(new)
+            self._leases[(namespace, name)] = new
+            return copy.deepcopy(new)
+
+    def replace_lease(self, namespace: str, name: str,
+                      lease: dict) -> dict:
+        with self._lock:
+            cur = self._leases.get((namespace, name))
+            if cur is None:
+                raise ApiException(404, f"lease {namespace}/{name} not found")
+            if (lease["metadata"].get("resourceVersion")
+                    != cur["metadata"]["resourceVersion"]):
+                # the CAS two would-be leaders race on: exactly one
+                # replace lands per observed rv
+                raise ConflictError(
+                    f"rv {lease['metadata'].get('resourceVersion')} != "
+                    f"{cur['metadata']['resourceVersion']}"
+                )
+            new = copy.deepcopy(lease)
+            new["metadata"]["name"] = name
+            new["metadata"]["namespace"] = namespace
+            self._bump(new)
+            self._leases[(namespace, name)] = new
             return copy.deepcopy(new)
 
     # -------------------------------------------------------------- pods

@@ -249,6 +249,7 @@ class PolicyController:
         verify_evidence: bool = True,
         adopt_after_s: float = HEARTBEAT_STALE_S,
         utcnow_minutes_fn=None,
+        leader_elector=None,
     ):
         if interval_s <= 0:
             raise ValueError(
@@ -300,10 +301,25 @@ class PolicyController:
         self._rr_last: Optional[str] = None
         self._failures: Dict[str, int] = {}
         self._retry_after: Dict[str, float] = {}
+        #: optional tpu_cc_manager.leader.LeaderElector: when set, run()
+        #: scans only while holding the Lease — a standby replica keeps
+        #: its HTTP surface up (healthy, reporting standby) and takes
+        #: over within one lease duration of the leader dying. Closes
+        #: the two-replica double-rollout-launch race by construction.
+        self.leader_elector = leader_elector
+        #: the Rollout instance the worker is currently driving, so a
+        #: demotion can stop it mid-roll (record left for adoption)
+        self._current_rollout = None
+        if leader_elector is not None:
+            # a deposed leader must stop ACTING, not just stop scanning:
+            # the in-flight rollout worker walks away from its record
+            # (unfinished, heartbeat stops) and the new leader adopts it
+            leader_elector.on_stopped_leading = self._on_demoted
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
+        self._server.add_route("/readyz", self._readyz)
         self._server.add_route("/metrics", self._metrics_route)
         self._server.add_route("/report", self._report_route)
 
@@ -610,6 +626,14 @@ class PolicyController:
         t.start()
         return name
 
+    def _on_demoted(self) -> None:
+        """Leadership lost: stop the in-flight rollout at its next loop
+        turn. The record stays unfinished with a dead heartbeat, which
+        is precisely what the new leader's adoption path looks for."""
+        rollout = self._current_rollout
+        if rollout is not None:
+            rollout.request_stop("leadership lost")
+
     def _join_worker(self) -> Optional[dict]:
         """Wait out the in-flight worker (if any); returns its final
         status snapshot (None for adoption workers, which own no policy
@@ -790,10 +814,15 @@ class PolicyController:
 
         def work():
             try:
-                report = Rollout.resume(
+                rollout = Rollout.resume(
                     self.kube, poll_s=self.poll_s,
                     verify_evidence=self.verify_evidence,
-                ).run()
+                )
+                self._current_rollout = rollout
+                try:
+                    report = rollout.run()
+                finally:
+                    self._current_rollout = None
                 outcome = "resumed_ok" if report.ok else "resumed_failed"
             except (RolloutError, ApiException) as e:
                 log.warning("rollout adoption failed: %s", e)
@@ -868,7 +897,11 @@ class PolicyController:
                 verify_evidence=self.verify_evidence,
                 on_group=progress,
             )
-            report = rollout.run()
+            self._current_rollout = rollout
+            try:
+                report = rollout.run()
+            finally:
+                self._current_rollout = None
         except (RolloutError, ApiException) as e:
             # preflight refusal (broken fleet) or transport failure: the
             # controller is level-triggered, so next tick retries; the
@@ -997,6 +1030,18 @@ class PolicyController:
         return ((200, b"ok", "text/plain") if self.healthy
                 else (503, b"unhealthy", "text/plain"))
 
+    def _readyz(self):
+        """Readiness is leader-aware: a hot standby is HEALTHY (liveness
+        passes, no restart) but NOT READY — the Service must route
+        /metrics and /report to the replica that actually scans, not
+        round-robin half the scrapes onto standby emptiness."""
+        if not self.healthy:
+            return 503, b"unhealthy", "text/plain"
+        if (self.leader_elector is not None
+                and not self.leader_elector.is_leader):
+            return 503, b"standby (not leader)", "text/plain"
+        return 200, b"ok", "text/plain"
+
     def _metrics_route(self):
         return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
 
@@ -1099,8 +1144,24 @@ class PolicyController:
             target=self._watch_loop, name="policy-watch", daemon=True
         )
         watcher.start()
+        if self.leader_elector is not None:
+            self.leader_elector.start()
         try:
             while not self._stop.is_set():
+                if (self.leader_elector is not None
+                        and not self.leader_elector.is_leader):
+                    # hot standby: surface healthy, scan nothing — two
+                    # replicas scanning would double-write statuses and
+                    # race the rollout launch guard
+                    self.last_report = {
+                        "policies": {}, "claimed_nodes": 0,
+                        "scanned": 0, "standby": True,
+                    }
+                    self._wake.wait(
+                        self.leader_elector.retry_period_s
+                    )
+                    self._wake.clear()
+                    continue
                 self._wake.clear()
                 try:
                     # wait_rollout=False: the scan loop keeps serving
@@ -1128,4 +1189,7 @@ class PolicyController:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()  # unblock the run loop promptly
+        if self.leader_elector is not None:
+            # releases the Lease so the standby takes over immediately
+            self.leader_elector.stop()
         self._server.stop()

@@ -56,6 +56,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -243,6 +244,12 @@ class Rollout:
         #: total groups this run will judge (set once planning is done);
         #: the progress hook's denominator
         self._planned_total: Optional[int] = None
+        #: cooperative stop (leader demotion): the driver stops
+        #: launching/judging and leaves the durable record UNFINISHED,
+        #: so the new leader adopts it via the heartbeat-staleness path
+        #: instead of two leaders driving the same record
+        self._stop_requested = threading.Event()
+        self._stop_reason = ""
         #: durable-record state (anchor-node annotation); set by run()
         self._record: Optional[dict] = None
         self._record_node: Optional[str] = None
@@ -754,12 +761,46 @@ class Rollout:
                 # no state transition lately: refresh liveness so a slow
                 # group doesn't make this rollout look abandoned
                 self._persist()
+            if self._stop_requested.is_set():
+                # cooperative stop (leader demotion): DON'T finish the
+                # record — stop stamping its heartbeat and walk away, so
+                # the new leader's observed-staleness adoption picks the
+                # same record up and finishes the remaining groups.
+                # In-flight desired labels are already patched; agents
+                # keep converging them; the adopter re-judges them.
+                reason = self._stop_reason or "stop requested"
+                for gname, members in list(in_flight.items()):
+                    results.append(GroupResult(
+                        gname, members, "stopped", reason
+                    ))
+                for gname, members in pending:
+                    results.append(GroupResult(
+                        gname, members, "stopped", reason
+                    ))
+                report.aborted = True  # report-level only: not ok, but
+                # the RECORD stays non-aborted + incomplete = adoptable
+                log.warning(
+                    "rollout stopped (%s): leaving record %s for "
+                    "adoption (%d in-flight, %d pending)", reason,
+                    (self._record or {}).get("id"), len(in_flight),
+                    len(pending),
+                )
+                report.groups.sort(key=lambda g: g.name)
+                return report
             if in_flight:
                 time.sleep(self.poll_s)
 
         self._finish_record(report)
         report.groups.sort(key=lambda g: g.name)
         return report
+
+    def request_stop(self, reason: str = "stop requested") -> None:
+        """Ask a running rollout to stop at its next loop turn without
+        finishing the durable record (see the in-loop handler). Safe
+        from any thread; used by the policy controller when it loses
+        leader election mid-roll."""
+        self._stop_reason = reason
+        self._stop_requested.set()
 
     def _canary_failed(self, report: RolloutReport, gname: str,
                        how: str, persist: bool = True) -> None:
