@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Bench trend gate (VERDICT r3 weak #4): the round-3 4.8x reconcile
+regression arrived silently because nothing compared BENCH_rN against
+BENCH_rN-1. This check fails CI when the newest benchmark regressed
+more than REGRESSION_FACTOR on either headline axis — p50 latency up
+or flips/min down — unless the regression is acknowledged in a note
+(extras.regression_note in the newer BENCH file, or a "## r<N>"
+section in BENCH_NOTES.md). A noted regression is a decision; an
+unnoted one is a bug.
+
+Usage: python scripts/bench_trend.py [repo_root]
+Exit 0 = no unexplained regression (or <2 bench files to compare).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+REGRESSION_FACTOR = 2.0
+
+
+def _round_num(path):
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def _load_bench(path):
+    """The driver's BENCH_r*.json wraps the bench's one-line JSON
+    inside a {"cmd", "rc", "tail"} envelope; accept both shapes."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "value" in doc:
+        return doc
+    for line in reversed((doc.get("tail") or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None
+    return None
+
+
+def main(root: str = ".") -> int:
+    files = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                   key=_round_num)
+    if len(files) < 2:
+        print("bench-trend: <2 BENCH_r*.json files; nothing to compare")
+        return 0
+    prev_path, cur_path = files[-2], files[-1]
+    prev = _load_bench(prev_path)
+    cur = _load_bench(cur_path)
+    if prev is None or cur is None:
+        print("bench-trend: could not parse bench result(s); skipping")
+        return 0
+
+    problems = []
+    p50_prev, p50_cur = prev.get("value"), cur.get("value")
+    if (isinstance(p50_prev, (int, float)) and p50_prev > 0
+            and isinstance(p50_cur, (int, float))
+            and p50_cur > p50_prev * REGRESSION_FACTOR):
+        problems.append(
+            f"p50 {p50_prev} -> {p50_cur} "
+            f"({p50_cur / p50_prev:.1f}x slower)"
+        )
+    fpm_prev = (prev.get("extras") or {}).get("flips_per_min")
+    fpm_cur = (cur.get("extras") or {}).get("flips_per_min")
+    if (isinstance(fpm_prev, (int, float)) and fpm_prev > 0
+            and isinstance(fpm_cur, (int, float)) and fpm_cur > 0
+            and fpm_cur < fpm_prev / REGRESSION_FACTOR):
+        problems.append(
+            f"flips/min {fpm_prev} -> {fpm_cur} "
+            f"({fpm_prev / fpm_cur:.1f}x fewer)"
+        )
+    if not problems:
+        print(f"bench-trend: {os.path.basename(cur_path)} within "
+              f"{REGRESSION_FACTOR}x of {os.path.basename(prev_path)}")
+        return 0
+
+    # regression found: is it acknowledged?
+    note = (cur.get("extras") or {}).get("regression_note")
+    if note:
+        print(f"bench-trend: regression noted in bench extras: {note}")
+        return 0
+    notes_path = os.path.join(root, "BENCH_NOTES.md")
+    cur_round = _round_num(cur_path)
+    if os.path.exists(notes_path):
+        with open(notes_path) as f:
+            notes = f.read()
+        if re.search(rf"^##\s*r0*{cur_round}\b", notes, re.M):
+            print(f"bench-trend: regression explained in BENCH_NOTES.md "
+                  f"(## r{cur_round})")
+            return 0
+    print("bench-trend: UNEXPLAINED regression vs "
+          f"{os.path.basename(prev_path)}:", file=sys.stderr)
+    for p in problems:
+        print(f"  - {p}", file=sys.stderr)
+    print("  add extras.regression_note to the bench output or a "
+          f"'## r{cur_round}' section to BENCH_NOTES.md explaining it",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))

@@ -1,0 +1,77 @@
+"""The bench trend gate (scripts/bench_trend.py) — VERDICT r3 weak #4:
+the next silent >2x regression must fail CI unless it comes with an
+explanation."""
+
+import importlib.util
+import json
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "bench_trend",
+    os.path.join(os.path.dirname(__file__), "..", "scripts",
+                 "bench_trend.py"),
+)
+bench_trend = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench_trend)
+
+
+def _write(root, n, value, fpm, extras=None, envelope=False):
+    body = {"metric": "pool32_reconcile_p50_s", "value": value,
+            "unit": "s", "vs_baseline": 1.0,
+            "extras": dict({"flips_per_min": fpm}, **(extras or {}))}
+    path = root / f"BENCH_r{n:02d}.json"
+    if envelope:
+        # the driver's wrapper shape: bench JSON inside "tail" text
+        path.write_text(json.dumps({
+            "n": n, "rc": 0,
+            "tail": "some log noise\n" + json.dumps(body) + "\n",
+        }))
+    else:
+        path.write_text(json.dumps(body))
+    return path
+
+
+def test_within_budget_passes(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000)
+    _write(tmp_path, 2, 0.15, 800)
+    assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_unexplained_p50_regression_fails(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000)
+    _write(tmp_path, 2, 0.50, 1000)
+    assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_unexplained_throughput_regression_fails(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000)
+    _write(tmp_path, 2, 0.10, 300)
+    assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_note_in_extras_acknowledges(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000)
+    _write(tmp_path, 2, 0.50, 1000,
+           extras={"regression_note": "added per-flip attestation"})
+    assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_notes_md_acknowledges(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000)
+    _write(tmp_path, 2, 0.50, 1000)
+    (tmp_path / "BENCH_NOTES.md").write_text(
+        "# notes\n\n## r02: slower on purpose\nbecause reasons\n"
+    )
+    assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_driver_envelope_shape_parsed(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000, envelope=True)
+    _write(tmp_path, 2, 0.50, 1000, envelope=True)
+    assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_single_file_or_empty_passes(tmp_path):
+    assert bench_trend.main(str(tmp_path)) == 0
+    _write(tmp_path, 1, 0.10, 1000)
+    assert bench_trend.main(str(tmp_path)) == 0

@@ -253,15 +253,22 @@ def test_flip_taint_cleared_even_on_failure(tmp_path):
     chip = FakeChip(path=_dev_file(tmp_path))
     chip.fail_reset = True
     states = []
+    observed = []
     engine = ModeEngine(
         set_state_label=states.append,
+        notify_state_label=observed.append,
         backend=FakeBackend(chips=[chip]),
         evict_components=False,
         gate=DeviceGate(enabled=True),
         flip_taint=NodeFlipTaint(kube, "n1"),
     )
     assert engine.set_mode("on") is False
-    assert states == ["failed"]
+    # the taint-clear replace carried the failed label in the same
+    # write; observers (metric gauge hook) still heard the transition
+    labels = kube.get_node("n1")["metadata"].get("labels", {})
+    assert labels.get(L.CC_MODE_STATE_LABEL) == "failed"
+    assert observed == ["failed"]
+    assert states == []  # no separate label write happened
     taints = kube.get_node("n1").get("spec", {}).get("taints") or []
     assert not any(t.get("key") == L.FLIP_TAINT_KEY for t in taints)
 

@@ -122,3 +122,63 @@ def test_evidence_key_paths_agree_across_manifests():
         }
         paths.add(env.get(EVIDENCE_KEY_ENV))
     assert paths == {"/etc/tpu-cc/evidence-key"}, paths
+
+
+# ------------------------------------------------- one-command deploy
+def _gen_kustomize():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "gen_kustomize",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "gen_kustomize.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_kustomize_renders_single_coherent_stack():
+    """`kubectl apply -k deployments/kustomize` must deploy ONE
+    coherent stack: zero duplicate resource IDs (the standalone
+    manifests each redeclare the Namespace/RBAC), exactly one
+    Namespace, and exactly ONE agent DaemonSet (the three agent
+    manifests are alternatives — deploying all three would schedule
+    three agents per node)."""
+    mod = _gen_kustomize()
+    docs = [d for d in yaml.safe_load_all(
+        mod.render()["resources.yaml"]) if d]
+    ids = [(d["kind"], d["metadata"].get("namespace", ""),
+            d["metadata"]["name"]) for d in docs]
+    assert len(ids) == len(set(ids)), "duplicate resource IDs"
+    assert ids.count(("Namespace", "", "tpu-system")) == 1
+    daemonsets = [i for i in ids if i[0] == "DaemonSet"]
+    assert daemonsets == [("DaemonSet", "tpu-system", "tpu-cc-manager")]
+
+
+def test_kustomize_covers_every_source_resource():
+    """Deduplication must only drop IDENTICAL shared declarations —
+    every resource of the default-stack manifests is present in the
+    rendering."""
+    mod = _gen_kustomize()
+    rendered = {
+        (d["kind"], d["metadata"].get("namespace", ""),
+         d["metadata"]["name"])
+        for d in yaml.safe_load_all(mod.render()["resources.yaml"]) if d
+    }
+    for fname in mod.SOURCES:
+        for d in _load(fname):
+            rid = (d["kind"], d["metadata"].get("namespace", ""),
+                   d["metadata"]["name"])
+            assert rid in rendered, f"{fname}: {rid} missing"
+
+
+def test_kustomize_tree_is_fresh():
+    """The committed deployments/kustomize tree matches a fresh render
+    — the generated tree can never drift from the standalone manifests
+    (CI runs gen_kustomize.py --check too)."""
+    mod = _gen_kustomize()
+    out_dir = os.path.join(MANIFEST_DIR, "..", "kustomize")
+    for name, content in mod.render().items():
+        with open(os.path.join(out_dir, name)) as f:
+            assert f.read() == content, f"{name} is stale"

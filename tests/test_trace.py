@@ -122,8 +122,9 @@ def test_engine_emits_phase_spans():
     assert engine.set_mode("on")
     names = [s["name"] for s in tr.recent()]
     assert names == [
-        "enumerate", "plan", "evict", "holder_check", "flip",
-        "holder_check", "flip", "reschedule", "state_label",
+        "enumerate", "plan", "taint_set", "evict", "holder_check",
+        "flip", "holder_check", "flip", "reschedule", "taint_clear",
+        "state_label",
     ]
     plan_span = next(s for s in tr.recent() if s["name"] == "plan")
     assert plan_span["attrs"] == {"mode": "on", "devices": 2, "divergent": 2}

@@ -52,8 +52,9 @@ def _leader_elector(kube, lease_name: str):
     (manifests set it; single-replica/dev runs skip election). Identity
     is the pod name (downward API) so `kubectl get lease` names the
     actual holder pod."""
-    if os.environ.get("TPU_CC_LEADER_ELECT", "").lower() not in (
-            "1", "true", "yes"):
+    from tpu_cc_manager.config import _env_bool
+
+    if not _env_bool("TPU_CC_LEADER_ELECT", False):
         return None
     import socket
 

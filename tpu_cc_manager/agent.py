@@ -116,6 +116,9 @@ class CCManagerAgent:
             backend=backend,
             tracer=self.tracer,
             flip_taint=NodeFlipTaint(kube, cfg.node_name),
+            # when the taint-clear replace carries the label, the
+            # current-mode gauge still has to move
+            notify_state_label=self.metrics.set_current_mode,
         )
         self.health: Optional[HealthServer] = None
         self._fatal: Optional[Exception] = None
@@ -254,11 +257,13 @@ class CCManagerAgent:
         # mid-transition state under the old reconcile's banner. Only
         # the API write is deferred.
         try:
-            backend = self._backend or devlayer.get_backend()
-            key = evidence_key()
-            doc = build_evidence(self.cfg.node_name, backend, key=key)
-            payload = _json.dumps(doc, sort_keys=True,
-                                  separators=(",", ":"))
+            with self.tracer.span("evidence_build"):
+                backend = self._backend or devlayer.get_backend()
+                key = evidence_key()
+                doc = build_evidence(self.cfg.node_name, backend,
+                                     key=key)
+                payload = _json.dumps(doc, sort_keys=True,
+                                      separators=(",", ":"))
             # recorded at build time (not publish time): what matters
             # for the idle tick's re-sign check is the posture of the
             # newest document headed for the cluster
@@ -272,9 +277,13 @@ class CCManagerAgent:
 
         def task():
             try:
-                self.kube.set_node_annotations(self.cfg.node_name, {
-                    L.EVIDENCE_ANNOTATION: payload,
-                })
+                # spanned so the phase histogram separates the deferred
+                # API write from the synchronous build — the write runs
+                # on the recorder thread, OFF the reconcile hot path
+                with self.tracer.span("evidence_publish"):
+                    self.kube.set_node_annotations(self.cfg.node_name, {
+                        L.EVIDENCE_ANNOTATION: payload,
+                    })
                 # advance published only to THIS task's generation — a
                 # stale queued task's success must not mask a newer miss
                 self._evidence_published_gen = max(
@@ -436,11 +445,12 @@ class CCManagerAgent:
         from tpu_cc_manager.doctor import run_doctor
 
         try:
-            backend = self._backend or devlayer.get_backend()
-            report = run_doctor(
-                kube=self.kube, node_name=self.cfg.node_name,
-                backend=backend,
-            )
+            with self.tracer.span("doctor"):
+                backend = self._backend or devlayer.get_backend()
+                report = run_doctor(
+                    kube=self.kube, node_name=self.cfg.node_name,
+                    backend=backend,
+                )
             summary = {
                 "ok": report["ok"],
                 "fail": sorted({c["name"] for c in report["checks"]

@@ -83,6 +83,16 @@ class FlipTaint:
     def clear(self) -> None:
         pass
 
+    def clear_and_publish_state(self, state: str) -> bool:
+        """Clear the taint AND publish ``cc.mode.state=state`` in ONE
+        node write where the implementation can (NodeFlipTaint's CAS
+        replace already holds the whole node object — folding the label
+        in halves the post-flip API round trips, the reconcile hot
+        path's perf budget). Returns True when the label was published;
+        False means the caller must publish it itself."""
+        self.clear()
+        return False
+
 
 #: One unit of planned device work: the device and the per-domain targets
 #: it diverges on ({"cc": "on"} / {"ici": "off"} / both).
@@ -102,8 +112,14 @@ class ModeEngine:
         gate: Optional[DeviceGate] = None,
         flip_taint: Optional[FlipTaint] = None,
         holder_check: Optional[HolderCheck] = None,
+        notify_state_label: Optional[Callable[[str], None]] = None,
     ):
         self._set_state_label = set_state_label
+        #: observation-only hook invoked when the state label's WIRE
+        #: write rode the taint-clear replace (clear_and_publish_state)
+        #: instead of going through set_state_label — metric gauges and
+        #: similar observers must still see every transition
+        self._notify_state_label = notify_state_label
         self._drainer = drainer or NullDrainer()
         self._evict_components = evict_components
         self._boot_timeout_s = boot_timeout_s
@@ -263,7 +279,8 @@ class ModeEngine:
         # devices are about to be gated. Best-effort — a node that can't
         # be tainted (RBAC gap) still gets the drain + gate protections.
         try:
-            self._flip_taint.set()
+            with self._tracer.span("taint_set"):
+                self._flip_taint.set()
         except Exception:
             log.warning("failed to set flip taint; continuing", exc_info=True)
         try:
@@ -288,12 +305,28 @@ class ModeEngine:
                         self._drainer.reschedule()
                 except Exception:
                     log.exception("failed to reschedule drained components")
+            state = state_on_success if ok else STATE_FAILED
+            published = False
             try:
-                self._flip_taint.clear()
+                # one node write clears the taint AND publishes the
+                # state label when the taint impl supports it — the
+                # separate clear-then-patch pair was two of the five
+                # API round trips on the flip hot path
+                with self._tracer.span("taint_clear"):
+                    published = (
+                        self._flip_taint.clear_and_publish_state(state)
+                    )
             except Exception:
                 log.warning("failed to clear flip taint", exc_info=True)
-        with self._tracer.span("state_label"):
-            self._set_state_label(state_on_success if ok else STATE_FAILED)
+        if published:
+            # the wire write rode the taint-clear replace; observers
+            # wired through the callback (agent metrics' current-mode
+            # gauge) still need to hear about the transition
+            if self._notify_state_label is not None:
+                self._notify_state_label(state)
+        else:
+            with self._tracer.span("state_label"):
+                self._set_state_label(state)
         return ok
 
     def _apply_plan(self, plan: Sequence[PlanItem]) -> bool:

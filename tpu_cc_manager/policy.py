@@ -310,11 +310,17 @@ class PolicyController:
         #: the Rollout instance the worker is currently driving, so a
         #: demotion can stop it mid-roll (record left for adoption)
         self._current_rollout = None
+        #: latched by _on_demoted and cleared on (re)gaining leadership:
+        #: closes the window where demotion fires while the worker is
+        #: still CONSTRUCTING its Rollout (before _current_rollout is
+        #: assigned) — the worker re-checks this right after assignment
+        self._demoted = False
         if leader_elector is not None:
             # a deposed leader must stop ACTING, not just stop scanning:
             # the in-flight rollout worker walks away from its record
             # (unfinished, heartbeat stops) and the new leader adopts it
             leader_elector.on_stopped_leading = self._on_demoted
+            leader_elector.on_started_leading = self._on_promoted
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
         self._server = RouteServer(port, name="policy-http")
@@ -629,9 +635,23 @@ class PolicyController:
     def _on_demoted(self) -> None:
         """Leadership lost: stop the in-flight rollout at its next loop
         turn. The record stays unfinished with a dead heartbeat, which
-        is precisely what the new leader's adoption path looks for."""
+        is precisely what the new leader's adoption path looks for. The
+        latch covers a rollout still being constructed when this
+        fires — the worker re-checks it after assignment."""
+        self._demoted = True
         rollout = self._current_rollout
         if rollout is not None:
+            rollout.request_stop("leadership lost")
+
+    def _on_promoted(self) -> None:
+        self._demoted = False
+
+    def _arm_rollout(self, rollout) -> None:
+        """Publish the worker's live Rollout for demotion delivery,
+        closing the construction-window race: a demotion that fired
+        while the Rollout was still being built is applied here."""
+        self._current_rollout = rollout
+        if self._demoted:
             rollout.request_stop("leadership lost")
 
     def _join_worker(self) -> Optional[dict]:
@@ -818,7 +838,7 @@ class PolicyController:
                     self.kube, poll_s=self.poll_s,
                     verify_evidence=self.verify_evidence,
                 )
-                self._current_rollout = rollout
+                self._arm_rollout(rollout)
                 try:
                     report = rollout.run()
                 finally:
@@ -897,7 +917,7 @@ class PolicyController:
                 verify_evidence=self.verify_evidence,
                 on_group=progress,
             )
-            self._current_rollout = rollout
+            self._arm_rollout(rollout)
             try:
                 report = rollout.run()
             finally:
