@@ -94,6 +94,25 @@ std::string g_openssl = "openssl"; /* TPU_CC_OPENSSL: s_client binary */
 std::string g_initial_label = "\x01unset";
 std::atomic<bool> g_stop{false};
 
+/* ------------------------------------------------------ health state */
+/* Observability the Python agent serves on HEALTH_PORT (obs.py) and
+ * the native path lacked (internal-parity gap, daemonset.yaml probes
+ * vs the proxy-sidecar exec probe): a minimal /healthz + /metrics
+ * surface fed by atomics the hot loop/doctor/watcher update. HEALTH
+ * semantics: alive while the watch loop keeps making progress; a watch
+ * thread wedged past 3 full stream timeouts is dead enough to restart.
+ * HEALTH_PORT env (same knob as the Python agent); 0/unset disables. */
+int g_health_port = 0;
+std::atomic<time_t> g_watch_progress{0};  /* last watch-loop iteration */
+std::atomic<long> g_reconciles_ok{0};
+std::atomic<long> g_reconciles_failed{0};
+std::atomic<int> g_last_reconcile_rc{-1}; /* -1 = none yet */
+std::atomic<int> g_doctor_last_rc{-1};    /* -1 = never ran */
+int g_doctor_timeout_s = 120; /* TPU_CC_DOCTOR_TIMEOUT_S: a wedged
+                               * doctor child must not stall the hot
+                               * loop forever (it runs inline on the
+                               * idle tick) */
+
 void logf(const char *level, const char *fmt, ...) {
   char msg[1024];
   va_list ap;
@@ -435,12 +454,19 @@ bool is_valid_mode(const std::string &mode) {
   return false;
 }
 
+void record_reconcile(int rc) {
+  g_last_reconcile_rc.store(rc);
+  if (rc == 0) g_reconciles_ok.fetch_add(1);
+  else g_reconciles_failed.fetch_add(1);
+}
+
 int run_engine(const std::string &mode) {
   if (!is_valid_mode(mode)) {
     logf("ERROR", "refusing to exec engine for invalid mode '%s'",
          mode.c_str());
     if (!patch_state_label("failed"))
       logf("WARN", "could not publish cc.mode.state=failed");
+    record_reconcile(-1);
     return -1;
   }
   /* Structural injection safety (on top of the allowlist above): the
@@ -477,17 +503,18 @@ int run_engine(const std::string &mode) {
   envp.push_back(nullptr);
   const char *child_argv[] = {"sh", "-c", cmd.c_str(), nullptr};
   pid_t pid = fork();
-  if (pid < 0) return -1;
+  if (pid < 0) { record_reconcile(-1); return -1; }
   if (pid == 0) {
     execve("/bin/sh", const_cast<char *const *>(child_argv), envp.data());
     _exit(127);
   }
   int status = 0;
   while (waitpid(pid, &status, 0) < 0) {
-    if (errno != EINTR) return -1;
+    if (errno != EINTR) { record_reconcile(-1); return -1; }
   }
-  if (WIFEXITED(status)) return WEXITSTATUS(status);
-  return -1;
+  int rc = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+  record_reconcile(rc);
+  return rc;
 }
 
 /* Idle-tick doctor self-check: exec the (fixed, operator-configured)
@@ -500,19 +527,160 @@ void run_doctor() {
   pid_t pid = fork();
   if (pid < 0) return;
   if (pid == 0) {
+    /* own process group: the deadline kill below must reach the WHOLE
+     * tree — the realistic wedge is a grandchild (python -> tpudevctl
+     * stuck in sysfs), and killing only the shell would orphan it onto
+     * this agent (PID 1 in the container) still holding the device */
+    setpgid(0, 0);
     execve("/bin/sh", const_cast<char *const *>(child_argv), environ);
     _exit(127);
   }
+  /* Deadline-bounded reap: the doctor runs inline on the hot loop's
+   * idle tick, so a wedged child (hung device backend, stuck API
+   * path) would otherwise stall mode reconciliation indefinitely —
+   * the idle-tick diagnostic must never become an enforcement outage.
+   * Poll with WNOHANG; past the deadline, SIGKILL and reap. */
+  time_t deadline = time(nullptr) + g_doctor_timeout_s;
   int status = 0;
-  while (waitpid(pid, &status, 0) < 0) {
-    if (errno != EINTR) return;
+  int rc = -1;
+  for (;;) {
+    pid_t r = waitpid(pid, &status, WNOHANG);
+    if (r == pid) {
+      rc = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+      break;
+    }
+    if (r < 0 && errno != EINTR) break;
+    if (time(nullptr) >= deadline || g_stop.load()) {
+      logf("WARN", "doctor self-check exceeded %ds; killing it",
+           g_doctor_timeout_s);
+      kill(-pid, SIGKILL); /* the whole process group (see setpgid) */
+      while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {}
+      rc = -2; /* killed */
+      break;
+    }
+    struct timespec ts = {0, 200 * 1000 * 1000};
+    nanosleep(&ts, nullptr);
   }
-  int rc = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+  g_doctor_last_rc.store(rc);
   if (rc == 1) {
     logf("WARN", "doctor self-check reports failing checks");
   } else if (rc != 0) {
     logf("WARN", "doctor self-check could not run (rc=%d)", rc);
   }
+}
+
+/* ------------------------------------------------------ health server */
+
+/* Watch liveness window: the watch loop touches g_watch_progress at
+ * least once per stream timeout; three missed windows (plus slack for
+ * backoff sleeps) means the thread is wedged, not just idle. */
+bool watch_alive() {
+  time_t last = g_watch_progress.load();
+  if (last == 0) return true; /* watcher not started yet (startup) */
+  return time(nullptr) - last <= 3 * g_watch_timeout_s + 60;
+}
+
+void health_serve_client(int fd) {
+  /* one tiny request per connection: read the request line, route,
+   * respond, close — kubelet probes and Prometheus both cope fine.
+   * Bounded I/O: this server is single-threaded, so a client that
+   * connects and sends nothing must time out instead of wedging
+   * /healthz for everyone (and getting a healthy agent killed by its
+   * own liveness probe). */
+  struct timeval tv = {2, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  char buf[1024];
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  if (n <= 0) { close(fd); return; }
+  buf[n] = '\0';
+  std::string req(buf);
+  std::string path;
+  size_t sp1 = req.find(' ');
+  if (sp1 != std::string::npos) {
+    size_t sp2 = req.find(' ', sp1 + 1);
+    if (sp2 != std::string::npos) path = req.substr(sp1 + 1, sp2 - sp1 - 1);
+  }
+  std::string status = "200 OK", body;
+  if (path == "/healthz") {
+    if (watch_alive()) {
+      body = "ok\n";
+    } else {
+      status = "503 Service Unavailable";
+      body = "watch loop stalled\n";
+    }
+  } else if (path == "/metrics") {
+    char m[1024];
+    snprintf(m, sizeof(m),
+             "# TYPE tpu_cc_native_reconciles_total counter\n"
+             "tpu_cc_native_reconciles_total{outcome=\"success\"} %ld\n"
+             "tpu_cc_native_reconciles_total{outcome=\"failure\"} %ld\n"
+             "# TYPE tpu_cc_native_last_reconcile_rc gauge\n"
+             "tpu_cc_native_last_reconcile_rc %d\n"
+             "# TYPE tpu_cc_native_watch_idle_seconds gauge\n"
+             "tpu_cc_native_watch_idle_seconds %ld\n"
+             "# TYPE tpu_cc_native_doctor_last_rc gauge\n"
+             "tpu_cc_native_doctor_last_rc %d\n",
+             g_reconciles_ok.load(), g_reconciles_failed.load(),
+             g_last_reconcile_rc.load(),
+             g_watch_progress.load() == 0
+                 ? 0L
+                 : (long)(time(nullptr) - g_watch_progress.load()),
+             g_doctor_last_rc.load());
+    body = m;
+  } else {
+    status = "404 Not Found";
+    body = "not found\n";
+  }
+  char hdr[256];
+  snprintf(hdr, sizeof(hdr),
+           "HTTP/1.1 %s\r\nContent-Type: text/plain\r\n"
+           "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+           status.c_str(), body.size());
+  (void)!write(fd, hdr, strlen(hdr));
+  (void)!write(fd, body.data(), body.size());
+  close(fd);
+}
+
+void health_loop() {
+  /* Bind with retry: the manifests PROBE this port, so giving up on a
+   * transient EADDRINUSE (fast restart racing the old listener's
+   * TIME_WAIT) would leave kubelet probing a void and restart-looping
+   * an agent whose reconcile loops are fine. Keep trying; the agent
+   * keeps reconciling in the meantime. */
+  int lfd = -1;
+  while (!g_stop.load()) {
+    lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) return;
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr = {};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY); /* kubelet probes pod IP */
+    addr.sin_port = htons((uint16_t)g_health_port);
+    if (bind(lfd, (struct sockaddr *)&addr, sizeof(addr)) == 0 &&
+        listen(lfd, 16) == 0) {
+      break;
+    }
+    logf("WARN", "health server cannot bind :%d (%s); retrying in 5s",
+         g_health_port, strerror(errno));
+    close(lfd);
+    lfd = -1;
+    for (int i = 0; i < 50 && !g_stop.load(); ++i) {
+      struct timespec ts = {0, 100 * 1000 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  if (lfd < 0) return;
+  logf("INFO", "health server on :%d (/healthz /metrics)", g_health_port);
+  while (!g_stop.load()) {
+    struct pollfd pfd = {lfd, POLLIN, 0};
+    int pr = poll(&pfd, 1, 500);
+    if (pr <= 0) continue;
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd >= 0) health_serve_client(cfd);
+  }
+  close(lfd);
 }
 
 /* ------------------------------------------------------------- watcher */
@@ -556,6 +724,7 @@ void watch_loop(SyncableModeConfig *config) {
     }
   }
   while (!g_stop.load()) {
+    g_watch_progress.store(time(nullptr)); /* health: loop is moving */
     /* allowWatchBookmarks: the server periodically reports the latest
      * resourceVersion even when this node is quiet, so resuming after a
      * disconnect doesn't 410 into a full re-list at cluster scale
@@ -731,6 +900,15 @@ int main(int argc, char **argv) {
      * reading (no surprise exec cadence) */
     g_doctor_interval_s = atoi(env);
   }
+  if ((env = getenv("TPU_CC_DOCTOR_TIMEOUT_S"))) {
+    int v = atoi(env);
+    if (v > 0) g_doctor_timeout_s = v;
+  }
+  if ((env = getenv("HEALTH_PORT"))) {
+    /* same knob name as the Python agent (config.py); 0 disables.
+     * Default stays 0 for the bare binary — the manifests set 8089 */
+    g_health_port = atoi(env);
+  }
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&](const char *flag) -> const char * {
@@ -760,7 +938,8 @@ int main(int argc, char **argv) {
           "env: NODE_NAME DEFAULT_CC_MODE KUBE_API_HOST KUBE_API_PORT "
           "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE TPU_CC_WATCH_TIMEOUT_S "
           "KUBE_API_TLS KUBE_CA_FILE TPU_CC_OPENSSL "
-          "TPU_CC_DOCTOR_CMD TPU_CC_DOCTOR_INTERVAL_S\n");
+          "TPU_CC_DOCTOR_CMD TPU_CC_DOCTOR_INTERVAL_S "
+          "TPU_CC_DOCTOR_TIMEOUT_S HEALTH_PORT\n");
       return 0;
     } else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
@@ -795,9 +974,22 @@ int main(int argc, char **argv) {
   signal(SIGTERM, on_signal);
   signal(SIGPIPE, SIG_IGN); /* a dying s_client child must not kill us */
 
+  /* health surface up BEFORE the startup reconcile: kubelet probes
+   * must reach the pod while the initial API retries ride out a
+   * control-plane blip */
+  std::thread health;
+  if (g_health_port > 0) health = std::thread(health_loop);
+
   /* initial read + default apply (reference cmd/main.go:131-149);
    * transient API unavailability at startup gets the watch loop's
    * backoff treatment (10 attempts x 5s, like main.py:664-689) */
+  /* early exits must reap the health thread — a joinable std::thread
+   * destroyed on return would std::terminate */
+  auto die = [&](int code) {
+    g_stop.store(true);
+    if (health.joinable()) health.join();
+    return code;
+  };
   NodeState st;
   for (int attempt = 1;; ++attempt) {
     st = read_node();
@@ -805,7 +997,7 @@ int main(int argc, char **argv) {
     if (attempt >= 10 || g_stop.load()) {
       logf("ERROR", "cannot read node %s from API server after %d attempts",
            g_node_name.c_str(), attempt);
-      return 1;
+      return die(1);
     }
     logf("WARN", "startup node read failed (%d); retrying in 5s", attempt);
     sleep(5);
@@ -814,7 +1006,7 @@ int main(int argc, char **argv) {
   if (st.mode.empty() && !g_default_mode.empty()) {
     if (run_engine(g_default_mode) != 0) {
       logf("ERROR", "initial default-mode apply failed; exiting");
-      return 1; /* reference cmd/main.go:141-145 */
+      return die(1); /* reference cmd/main.go:141-145 */
     }
   } else if (!st.mode.empty()) {
     if (run_engine(st.mode) != 0) {
@@ -850,5 +1042,6 @@ int main(int argc, char **argv) {
   }
   config.Wake();
   watcher.join();
+  if (health.joinable()) health.join();
   return 0;
 }
