@@ -510,3 +510,121 @@ def test_identity_fetch_blip_retried_from_idle_tick(tmp_path,
     doc = json.loads(kube.get_node("blip-node")["metadata"]
                      ["annotations"][L.EVIDENCE_ANNOTATION])
     assert judge_identity(doc, "blip-node", key=KEY) == ("ok", "ok")
+
+
+# --------------------------------------------------------------- RS256
+@pytest.fixture(scope="module")
+def rsa_key(tmp_path_factory):
+    """Real RSA keypair via the openssl CLI (stdlib can't generate
+    RSA); returns (private_pem_path, jwks_dict with kid 'test-kid')."""
+    import base64
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl binary unavailable")
+    d = tmp_path_factory.mktemp("rsa")
+    key = d / "key.pem"
+    r = subprocess.run(["openssl", "genrsa", "-out", str(key), "2048"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"openssl genrsa unavailable: {r.stderr}")
+    mod = subprocess.run(
+        ["openssl", "rsa", "-in", str(key), "-noout", "-modulus"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    n = bytes.fromhex(mod.split("=", 1)[1])
+
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    jwks = {"keys": [{
+        "kty": "RSA", "kid": "test-kid", "alg": "RS256", "use": "sig",
+        "n": b64url(n), "e": b64url((65537).to_bytes(3, "big")),
+    }]}
+    return str(key), jwks
+
+
+def _mint_rs256(key_path, node, audience=None, now=None, kid="test-kid"):
+    """RS256 JWT shaped like a real GCE full-format token, signed with
+    the test key through the openssl CLI (an implementation that shares
+    NOTHING with the verifier under test)."""
+    import base64
+    import subprocess
+    import tempfile
+
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    now = time.time() if now is None else now
+    header = {"alg": "RS256", "typ": "JWT", "kid": kid}
+    payload = {
+        "iss": "https://accounts.google.com",
+        "aud": audience or "tpu-cc-manager",
+        "iat": int(now), "exp": int(now + 3600),
+        "google": {"compute_engine": {"instance_name": node}},
+    }
+    signing_input = (
+        b64url(json.dumps(header, sort_keys=True).encode()) + "." +
+        b64url(json.dumps(payload, sort_keys=True).encode())
+    )
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+        f.write(signing_input.encode())
+        f.flush()
+        sig = subprocess.run(
+            ["openssl", "dgst", "-sha256", "-sign", key_path, f.name],
+            capture_output=True, check=True,
+        ).stdout
+    return signing_input + "." + b64url(sig)
+
+
+def test_rs256_verified_against_provisioned_jwks(rsa_key, tmp_path,
+                                                 monkeypatch):
+    """With a provisioned JWKS (the Google certs document, mounted as
+    a ConfigMap in production) a real RS256 GCE token verifies FULLY
+    offline — no more 'unverifiable' blind spot."""
+    key_path, jwks = rsa_key
+    jwks_file = tmp_path / "jwks.json"
+    jwks_file.write_text(json.dumps(jwks))
+    monkeypatch.setenv("TPU_CC_IDENTITY_JWKS_FILE", str(jwks_file))
+
+    tok = _mint_rs256(key_path, "gke-node-1")
+    assert verify_token(tok, node_name="gke-node-1") == ("ok", "ok")
+    # node binding still outranks the signature
+    assert verify_token(tok, node_name="other")[0] == "mismatch"
+    # expired-but-valid classes as staleness
+    old = _mint_rs256(key_path, "gke-node-1", now=time.time() - 7200)
+    assert verify_token(old, node_name="gke-node-1")[0] == "expired"
+
+
+def test_rs256_forgeries_rejected_with_jwks(rsa_key, tmp_path,
+                                            monkeypatch):
+    key_path, jwks = rsa_key
+    jwks_file = tmp_path / "jwks.json"
+    jwks_file.write_text(json.dumps(jwks))
+    monkeypatch.setenv("TPU_CC_IDENTITY_JWKS_FILE", str(jwks_file))
+
+    tok = _mint_rs256(key_path, "gke-node-1")
+    head, payload, sig = tok.split(".")
+    # payload swapped under the same signature: invalid
+    other = _mint_rs256(key_path, "victim")
+    spliced = ".".join([head, other.split(".")[1], sig])
+    verdict, detail = verify_token(spliced, node_name="victim")
+    assert verdict == "invalid" and "signature" in detail
+    # unknown kid: NOT forgery — Google rotates keys and the mounted
+    # JWKS can lag; a stale verifier artifact is a blind spot, not an
+    # attack, so the fleet must not page as identity_mismatch
+    rogue = _mint_rs256(key_path, "gke-node-1", kid="unknown-kid")
+    verdict, detail = verify_token(rogue, node_name="gke-node-1")
+    assert verdict == "unverifiable" and "kid" in detail
+    # garbage signature bytes: invalid
+    bad = ".".join([head, payload, "AAAA"])
+    assert verify_token(bad, node_name="gke-node-1")[0] == "invalid"
+
+
+def test_rs256_without_jwks_still_degrades_honestly(rsa_key,
+                                                    monkeypatch):
+    key_path, _ = rsa_key
+    monkeypatch.delenv("TPU_CC_IDENTITY_JWKS_FILE", raising=False)
+    tok = _mint_rs256(key_path, "gke-node-1")
+    assert verify_token(tok, node_name="gke-node-1")[0] == "unverifiable"

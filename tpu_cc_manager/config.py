@@ -32,6 +32,8 @@ flag                      env                            default
                                                         identity attached to evidence)
 (none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)
 (none)                    TPU_CC_IDENTITY_AUDIENCE       tpu-cc-manager (token audience)
+(none)                    TPU_CC_IDENTITY_JWKS_FILE      "" (JWKS for offline RS256
+                                                        verification of GCE tokens)
 (none)                    TPU_CC_METADATA_HOST           metadata.google.internal
 (none)                    TPU_CC_REQUIRE_IDENTITY        false (verifiers flag identity-less
                                                         evidence even on uniform pools)

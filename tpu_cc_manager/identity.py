@@ -298,6 +298,94 @@ def get_identity_provider(refresh: bool = False):
     return _auto_cache or None
 
 
+# ------------------------------------------------------------- JWKS
+#: SHA-256 DigestInfo prefix for EMSA-PKCS1-v1_5 (RFC 8017 §9.2)
+_SHA256_DIGEST_INFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420"
+)
+
+#: cached parsed JWKS: path -> {kid: (n, e)}; mtime-checked so a
+#: rotated ConfigMap mount is picked up without a restart
+_jwks_cache: dict = {}
+
+
+def load_jwks(path: str) -> dict:
+    """Parse a JWKS document (the shape of Google's
+    https://www.googleapis.com/oauth2/v3/certs, provisioned out-of-band
+    — e.g. a ConfigMap refreshed by cluster tooling; this framework has
+    no business dialing the public internet from a verifier) into
+    {kid: (n, e)} RSA public numbers."""
+    import json as _json
+
+    with open(path) as f:
+        doc = _json.load(f)
+    keys = {}
+    for key in doc.get("keys", []):
+        if key.get("kty") != "RSA" or not key.get("kid"):
+            continue
+        try:
+            n = int.from_bytes(_b64url_decode(key["n"]), "big")
+            e = int.from_bytes(_b64url_decode(key["e"]), "big")
+        except Exception:
+            continue
+        keys[key["kid"]] = (n, e)
+    return keys
+
+
+def _jwks_for_env() -> Optional[dict]:
+    """JWKS from TPU_CC_IDENTITY_JWKS_FILE, cached on (path, mtime).
+    Missing file is silent — the optional-ConfigMap posture, same as
+    the evidence key."""
+    path = os.environ.get("TPU_CC_IDENTITY_JWKS_FILE", "")
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    hit = _jwks_cache.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    try:
+        keys = load_jwks(path)
+        if not keys:
+            # present-but-unusable (EC-only keys, wrong document): the
+            # operator believes offline verification is on — say once
+            # per file version that the blind spot is still open
+            log.warning(
+                "JWKS file %s contains no usable RSA keys; RS256 "
+                "tokens will degrade to 'unverifiable'", path,
+            )
+    except Exception:
+        log.warning("cannot parse JWKS file %s", path, exc_info=True)
+        keys = None
+    # cache failures too (keyed on mtime): a broken file must not be
+    # re-parsed and re-warned for every node of every fleet scan
+    _jwks_cache[path] = (mtime, keys)
+    return keys
+
+
+def _rsa_pkcs1_sha256_verify(n: int, e: int, signing_input: bytes,
+                             sig: bytes) -> bool:
+    """RSASSA-PKCS1-v1_5 / SHA-256 verification from the public
+    numbers, pure stdlib: s^e mod n must equal the EMSA-PKCS1-v1_5
+    encoding of the hash. That encoding is fully deterministic, so
+    verification is an exact compare — no parsing of attacker-shaped
+    ASN.1 (the class of bug behind historic BER-laxity forgeries)."""
+    k = (n.bit_length() + 7) // 8
+    if len(sig) != k:
+        return False
+    m = pow(int.from_bytes(sig, "big"), e, n)
+    em = m.to_bytes(k, "big")
+    digest = hashlib.sha256(signing_input).digest()
+    pad_len = k - 3 - len(_SHA256_DIGEST_INFO) - len(digest)
+    if pad_len < 8:
+        return False
+    expected = (b"\x00\x01" + b"\xff" * pad_len + b"\x00"
+                + _SHA256_DIGEST_INFO + digest)
+    return hmac_mod.compare_digest(em, expected)
+
+
 # ---------------------------------------------------------- verifying
 def token_claims(token: str) -> Tuple[dict, dict]:
     """Parse (header, payload) WITHOUT verifying — callers must treat
@@ -323,6 +411,7 @@ def claimed_node(payload: dict) -> Optional[str]:
 def verify_token(token: str, *, node_name: str,
                  audience: Optional[str] = None,
                  key: Optional[bytes] = None,
+                 jwks: Optional[dict] = None,
                  now: Optional[float] = None) -> Tuple[str, str]:
     """Judge an identity token. Returns (verdict, detail):
 
@@ -373,10 +462,37 @@ def verify_token(token: str, *, node_name: str,
             return "invalid", "bad HS256 signature"
         return ("expired", "token expired") if expired else ("ok", "ok")
     if alg == "RS256":
-        # Google-signed: full verification needs Google's JWKS, which
-        # an offline/air-gapped verifier cannot fetch. The claims are
-        # still bound-checked above; the signature verdict degrades
+        # Google-signed. With a provisioned JWKS
+        # (TPU_CC_IDENTITY_JWKS_FILE, or the jwks param) the signature
+        # is FULLY verified offline; without one, the claims are still
+        # bound-checked above and the signature verdict degrades
         # honestly instead of rejecting every real GCE token
+        if jwks is None:
+            jwks = _jwks_for_env()
+        if jwks:
+            kid = header.get("kid")
+            pub = jwks.get(kid)
+            if pub is None:
+                # NOT forgery: Google rotates its signing keys on the
+                # order of days, and the provisioned ConfigMap can lag.
+                # A stale verifier artifact must read as a blind spot
+                # (same staleness-is-not-forgery posture as 'expired'),
+                # never flag the whole fleet as under attack
+                return ("expired", "token expired") if expired else (
+                    "unverifiable",
+                    f"no JWKS key for kid {kid!r} — JWKS ConfigMap "
+                    "lagging a key rotation? refresh it",
+                )
+            signing_input, _, sig_b64 = token.rpartition(".")
+            try:
+                sig = _b64url_decode(sig_b64)
+            except Exception:
+                return "invalid", "malformed RS256 signature"
+            if not _rsa_pkcs1_sha256_verify(
+                    pub[0], pub[1], signing_input.encode(), sig):
+                return "invalid", "bad RS256 signature"
+            return ("expired", "token expired") if expired else (
+                "ok", "ok")
         return ("expired", "token expired") if expired else (
             "unverifiable", "RS256 signature needs Google JWKS")
     return "invalid", f"unsupported alg {alg!r}"
