@@ -80,6 +80,15 @@ int g_watch_timeout_s = 300; /* TPU_CC_WATCH_TIMEOUT_S; tests shrink it */
  * branch), never concurrently with the engine. */
 std::string g_doctor_cmd = "python3 -m tpu_cc_manager doctor --publish";
 int g_doctor_interval_s = 300; /* TPU_CC_DOCTOR_INTERVAL_S */
+/* Idle-tick evidence healer (TPU_CC_EVIDENCE_SYNC_INTERVAL_S, 0
+ * disables): this path's evidence is otherwise published only per
+ * reconcile (bash engine), so a converged idle node would keep stale
+ * unsigned evidence forever after the evidence-key Secret lands, and
+ * an embedded identity token would silently age out. The --sync mode
+ * republishes ONLY when out of sync — most ticks are one GET. */
+std::string g_evidence_sync_cmd =
+    "python3 -m tpu_cc_manager.evidence --sync";
+int g_evidence_sync_interval_s = 300;
 std::string g_token_file; /* BEARER_TOKEN_FILE; re-read per request —
                            * bound SA tokens rotate on disk (~1h) and a
                            * cached copy would 401 a long-lived daemon */
@@ -522,45 +531,45 @@ int run_engine(const std::string &mode) {
  * selectable label. rc 1 means checks are FAILING — still published,
  * logged here so the pod log carries it too. No state-label writes:
  * the doctor is diagnosis, not reconciliation. */
-void run_doctor() {
-  const char *child_argv[] = {"sh", "-c", g_doctor_cmd.c_str(), nullptr};
+/* Deadline-bounded child run for idle-tick work (doctor, evidence
+ * sync): these exec inline on the hot loop, so a wedged child (hung
+ * device backend, stuck API path) would otherwise stall mode
+ * reconciliation indefinitely — an idle-tick helper must never become
+ * an enforcement outage. The child gets its own process group so the
+ * deadline kill reaches the WHOLE tree: the realistic wedge is a
+ * grandchild (python -> tpudevctl stuck in sysfs), and killing only
+ * the shell would orphan it onto this agent (PID 1 in the container)
+ * still holding the device. Returns the exit code, or -2 if killed. */
+int run_bounded(const std::string &cmd, int timeout_s,
+                const char *what) {
+  const char *child_argv[] = {"sh", "-c", cmd.c_str(), nullptr};
   pid_t pid = fork();
-  if (pid < 0) return;
+  if (pid < 0) return -1;
   if (pid == 0) {
-    /* own process group: the deadline kill below must reach the WHOLE
-     * tree — the realistic wedge is a grandchild (python -> tpudevctl
-     * stuck in sysfs), and killing only the shell would orphan it onto
-     * this agent (PID 1 in the container) still holding the device */
     setpgid(0, 0);
     execve("/bin/sh", const_cast<char *const *>(child_argv), environ);
     _exit(127);
   }
-  /* Deadline-bounded reap: the doctor runs inline on the hot loop's
-   * idle tick, so a wedged child (hung device backend, stuck API
-   * path) would otherwise stall mode reconciliation indefinitely —
-   * the idle-tick diagnostic must never become an enforcement outage.
-   * Poll with WNOHANG; past the deadline, SIGKILL and reap. */
-  time_t deadline = time(nullptr) + g_doctor_timeout_s;
+  time_t deadline = time(nullptr) + timeout_s;
   int status = 0;
-  int rc = -1;
   for (;;) {
     pid_t r = waitpid(pid, &status, WNOHANG);
-    if (r == pid) {
-      rc = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
-      break;
-    }
-    if (r < 0 && errno != EINTR) break;
+    if (r == pid) return WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+    if (r < 0 && errno != EINTR) return -1;
     if (time(nullptr) >= deadline || g_stop.load()) {
-      logf("WARN", "doctor self-check exceeded %ds; killing it",
-           g_doctor_timeout_s);
-      kill(-pid, SIGKILL); /* the whole process group (see setpgid) */
+      logf("WARN", "%s exceeded %ds; killing it", what, timeout_s);
+      kill(-pid, SIGKILL); /* the whole process group */
       while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {}
-      rc = -2; /* killed */
-      break;
+      return -2;
     }
     struct timespec ts = {0, 200 * 1000 * 1000};
     nanosleep(&ts, nullptr);
   }
+}
+
+void run_doctor() {
+  int rc = run_bounded(g_doctor_cmd, g_doctor_timeout_s,
+                       "doctor self-check");
   g_doctor_last_rc.store(rc);
   if (rc == 1) {
     logf("WARN", "doctor self-check reports failing checks");
@@ -904,6 +913,10 @@ int main(int argc, char **argv) {
     int v = atoi(env);
     if (v > 0) g_doctor_timeout_s = v;
   }
+  if ((env = getenv("TPU_CC_EVIDENCE_SYNC_CMD")))
+    g_evidence_sync_cmd = env;
+  if ((env = getenv("TPU_CC_EVIDENCE_SYNC_INTERVAL_S")))
+    g_evidence_sync_interval_s = atoi(env); /* 0 disables */
   if ((env = getenv("HEALTH_PORT"))) {
     /* same knob name as the Python agent (config.py); 0 disables.
      * Default stays 0 for the bare binary — the manifests set 8089 */
@@ -939,7 +952,8 @@ int main(int argc, char **argv) {
           "TPU_CC_ENGINE_CMD BEARER_TOKEN_FILE TPU_CC_WATCH_TIMEOUT_S "
           "KUBE_API_TLS KUBE_CA_FILE TPU_CC_OPENSSL "
           "TPU_CC_DOCTOR_CMD TPU_CC_DOCTOR_INTERVAL_S "
-          "TPU_CC_DOCTOR_TIMEOUT_S HEALTH_PORT\n");
+          "TPU_CC_DOCTOR_TIMEOUT_S HEALTH_PORT "
+          "TPU_CC_EVIDENCE_SYNC_CMD TPU_CC_EVIDENCE_SYNC_INTERVAL_S\n");
       return 0;
     } else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
@@ -1023,6 +1037,7 @@ int main(int argc, char **argv) {
    * no change arrives within a second, the periodic doctor self-check
    * may run — between reconciles by construction. */
   time_t doctor_due = 0; /* first idle tick publishes */
+  time_t evidence_sync_due = 0;
   while (!g_stop.load()) {
     std::string value;
     SyncableModeConfig::GetResult r = config.GetFor(&value, 1000);
@@ -1031,6 +1046,14 @@ int main(int argc, char **argv) {
       if (g_doctor_interval_s > 0 && time(nullptr) >= doctor_due) {
         doctor_due = time(nullptr) + g_doctor_interval_s;
         run_doctor();
+      }
+      if (g_evidence_sync_interval_s > 0 &&
+          time(nullptr) >= evidence_sync_due) {
+        evidence_sync_due = time(nullptr) + g_evidence_sync_interval_s;
+        int rc = run_bounded(g_evidence_sync_cmd, g_doctor_timeout_s,
+                             "evidence sync");
+        if (rc != 0)
+          logf("WARN", "evidence sync failed (rc=%d)", rc);
       }
       continue;
     }

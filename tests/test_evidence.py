@@ -456,3 +456,122 @@ def test_key_appearing_on_idle_node_resigns_evidence(tmp_path,
     # keyed audit now sees a clean fleet
     audit = audit_evidence(kube.list_nodes(None), key=b"pool-secret")
     assert audit["unsigned"] == [] and audit["invalid"] == []
+
+
+def test_sync_evidence_heals_posture_and_staleness(tmp_path,
+                                                   monkeypatch):
+    """The native-path idle-tick healer (`evidence --sync`): republish
+    ONLY when the on-cluster doc is out of sync — missing, unsigned
+    under a new key, stale device truth — and no-op otherwise."""
+    from tpu_cc_manager.evidence import sync_evidence
+
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    kube = FakeKube()
+    kube.add_node(make_node("s-node"))
+    writes = []
+    real_set = kube.set_node_annotations
+
+    def counting_set(name, ann):
+        writes.append(name)
+        return real_set(name, ann)
+
+    kube.set_node_annotations = counting_set
+
+    # missing annotation: published
+    assert sync_evidence(kube, "s-node", backend=be)
+    assert len(writes) == 1
+    # in sync: no write
+    assert sync_evidence(kube, "s-node", backend=be)
+    assert len(writes) == 1
+    # the evidence-key Secret lands: posture changed -> re-signed
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-key")
+    assert sync_evidence(kube, "s-node", backend=be)
+    assert len(writes) == 2
+    doc = json.loads(kube.get_node("s-node")["metadata"]["annotations"]
+                     [L.EVIDENCE_ANNOTATION])
+    assert doc["digest"].startswith("hmac-sha256:")
+    assert sync_evidence(kube, "s-node", backend=be)  # now in sync
+    assert len(writes) == 2
+    # device truth moves without a flip: healed
+    chips, _ = be.find_tpus()
+    be.store.stage(chips[0].path, "cc", "on")
+    be.store.commit(chips[0].path)
+    assert sync_evidence(kube, "s-node", backend=be)
+    assert len(writes) == 3
+    doc = json.loads(kube.get_node("s-node")["metadata"]["annotations"]
+                     [L.EVIDENCE_ANNOTATION])
+    assert evidence_mode(doc) == "on"
+
+
+def test_sync_evidence_refreshes_aging_identity(tmp_path, monkeypatch):
+    from tpu_cc_manager.evidence import evidence_in_sync
+
+    monkeypatch.setenv("TPU_CC_IDENTITY", "fake")
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", "ik")
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    fresh = build_evidence("n", be)
+    assert fresh["identity"]["provider"] == "fake"
+    assert evidence_in_sync(fresh, fresh)
+    # an on-cluster doc whose token is inside its last 20% of life is
+    # out of sync even though nothing else changed
+    from tpu_cc_manager.identity import mint_fake_token
+    import time as _time
+
+    aging = dict(fresh, identity={
+        "provider": "fake",
+        "token": mint_fake_token("n", b"ik",
+                                 now=_time.time() - 3300, ttl_s=3600)})
+    assert not evidence_in_sync(aging, fresh)
+
+
+def test_sync_evidence_heals_key_rotation_and_keeps_identity_on_blip(
+        tmp_path, monkeypatch):
+    from tpu_cc_manager.evidence import evidence_in_sync, sync_evidence
+
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    kube = FakeKube()
+    kube.add_node(make_node("r-node"))
+    # signed with the OLD key
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "old-key")
+    assert sync_evidence(kube, "r-node", backend=be)
+    # key ROTATES: same scheme, different key -> out of sync, healed
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "new-key")
+    assert sync_evidence(kube, "r-node", backend=be)
+    doc = json.loads(kube.get_node("r-node")["metadata"]["annotations"]
+                     [L.EVIDENCE_ANNOTATION])
+    assert verify_evidence(doc, key=b"new-key")[0] is True
+
+    # a fresh build that LOST identity (metadata blip) must not strip a
+    # still-valid token from the on-cluster doc (docs built properly so
+    # their digests cover the identity field)
+    from tpu_cc_manager.identity import (
+        FakePlatformIdentity, mint_fake_token,
+    )
+
+    cur = build_evidence("r-node", be,
+                         identity_provider=FakePlatformIdentity(b"ik"))
+    fresh_no_ident = build_evidence("r-node", be, identity_provider=None)
+    assert evidence_in_sync(cur, fresh_no_ident) is True
+
+    # a token with exp but NO iat must not read as perpetually aging
+    # (that would republish every tick forever)
+    import base64 as _b64
+
+    tok = mint_fake_token("r-node", b"ik", ttl_s=3600)
+    h, p, s = tok.split(".")
+    claims = json.loads(_b64.urlsafe_b64decode(p + "=="))
+    del claims["iat"]
+    p2 = _b64.urlsafe_b64encode(
+        json.dumps(claims, sort_keys=True).encode()
+    ).rstrip(b"=").decode()
+    no_iat = ".".join([h, p2, s])
+
+    class NoIatProvider:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return no_iat
+
+    cur2 = build_evidence("r-node", be,
+                          identity_provider=NoIatProvider())
+    assert evidence_in_sync(cur2, cur2) is True

@@ -906,3 +906,71 @@ def test_cpp_agent_doctor_timeout_does_not_stall_reconciles(
             proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_cpp_agent_evidence_sync_heals_missing_evidence(
+        native_build, apiserver, tmp_path):
+    """The native path's idle-tick evidence healer: the agent execs
+    `python -m tpu_cc_manager.evidence --sync` periodically, so a node
+    whose evidence never got published (here: a stub engine that
+    publishes nothing) converges to verifiable on-cluster evidence
+    without any flip."""
+    import json
+
+    from tpu_cc_manager.evidence import verify_evidence
+
+    out_file = tmp_path / "calls.txt"
+    sysfs, dev = make_accel_tree(tmp_path)
+    kubeconfig = tmp_path / "kubeconfig.yaml"
+    kubeconfig.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: c, user: u}}}}]
+clusters: [{{name: c, cluster: {{server: "http://127.0.0.1:{apiserver.port}"}}}}]
+users: [{{name: u, user: {{}}}}]
+""")
+    apiserver.store.add_node(
+        make_node("ev-sync-node", labels={L.CC_MODE_LABEL: "on"})
+    )
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="ev-sync-node",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        KUBECONFIG=str(kubeconfig),
+        PYTHONPATH=REPO,
+        TPU_SYSFS_ROOT=sysfs,
+        TPU_DEV_ROOT=dev,
+        TPU_CC_STATE_DIR=str(tmp_path / "state"),
+        TPU_CC_DEVICE_GATING="none",
+        TPU_CC_IDENTITY="none",
+        TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",  # publishes nothing
+        TPU_CC_EVIDENCE_SYNC_INTERVAL_S="1",
+        TPU_CC_DOCTOR_INTERVAL_S="0",
+        TPU_CC_WATCH_TIMEOUT_S="2",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 20
+        doc = None
+        while time.monotonic() < deadline:
+            ann = apiserver.store.get_node("ev-sync-node")["metadata"] \
+                .get("annotations", {})
+            raw = ann.get(L.EVIDENCE_ANNOTATION)
+            if raw:
+                doc = json.loads(raw)
+                break
+            time.sleep(0.2)
+        assert doc is not None, "evidence sync never published"
+        assert doc["node"] == "ev-sync-node"
+        assert verify_evidence(doc, key=None)[0] is True
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -324,12 +324,15 @@ class CCManagerAgent:
             iat = claims.get("iat", time.time())
             if not isinstance(exp, (int, float)):
                 return None
-            # refresh when 20% of the lifetime remains: INSIDE the
-            # provider token cache's 25% refresh margin (so the rebuild
-            # actually fetches a fresh token instead of re-serving the
-            # cached one and looping) while still comfortably ahead of
-            # the verifier-visible expiry (~12 min for 1 h GCE tokens)
-            return float(exp) - 0.2 * max(float(exp) - float(iat), 0.0)
+            # refresh when REPUBLISH_MARGIN of the lifetime remains
+            # (see identity.py for why it sits inside the token cache's
+            # serve margin) — comfortably ahead of the verifier-visible
+            # expiry (~12 min for 1 h GCE tokens)
+            from tpu_cc_manager.identity import REPUBLISH_MARGIN
+
+            return float(exp) - REPUBLISH_MARGIN * max(
+                float(exp) - float(iat), 0.0
+            )
         except Exception:
             return None
 

@@ -34,6 +34,8 @@ flag                      env                            default
 (none)                    TPU_CC_IDENTITY_AUDIENCE       tpu-cc-manager (token audience)
 (none)                    TPU_CC_IDENTITY_JWKS_FILE      "" (JWKS for offline RS256
                                                         verification of GCE tokens)
+(none)                    TPU_CC_EVIDENCE_SYNC_INTERVAL_S 300 (native agent: idle-tick
+                                                        evidence healer; 0 disables)
 (none)                    TPU_CC_METADATA_HOST           metadata.google.internal
 (none)                    TPU_CC_REQUIRE_IDENTITY        false (verifiers flag identity-less
                                                         evidence even on uniform pools)

@@ -463,6 +463,113 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     }
 
 
+def evidence_in_sync(current: Optional[dict], fresh: dict) -> bool:
+    """Is the on-cluster document still an honest representation of
+    this node's state and signing posture? Timestamps always differ, so
+    the comparison is on what verifiers actually judge:
+
+    - the digest verifies under the CURRENTLY resolved key (covers the
+      unsigned->signed posture flip, a key ROTATION, and tampering —
+      not just the scheme name),
+    - the statefile digest and per-device modes (device truth),
+    - identity presence, and the embedded token's freshness
+      (identity.REPUBLISH_MARGIN of lifetime remaining — the same
+      threshold the Python agent's idle tick republishes at).
+    """
+    if not isinstance(current, dict):
+        return False
+    # digest under the current key: an old-key or tampered signature is
+    # out of sync no matter how alike the documents look
+    if not verify_evidence(current)[0]:
+        return False
+    if current.get("statefile_digest") != fresh.get("statefile_digest"):
+        return False
+
+    def modes(doc):
+        return [(d.get("path"), d.get("cc"), d.get("ici"))
+                for d in doc.get("devices") or []]
+
+    if modes(current) != modes(fresh):
+        return False
+    cur_tok = (current.get("identity") or {}).get("token")
+    fresh_tok = (fresh.get("identity") or {}).get("token")
+    if cur_tok is None:
+        # attach identity the moment the fresh build can mint it
+        return fresh_tok is None
+    from tpu_cc_manager.identity import REPUBLISH_MARGIN, token_claims
+
+    try:
+        _, claims = token_claims(cur_tok)
+        exp = claims.get("exp")
+        iat = claims.get("iat")
+        if isinstance(exp, (int, float)):
+            if isinstance(iat, (int, float)):
+                margin = REPUBLISH_MARGIN * max(
+                    float(exp) - float(iat), 0.0
+                )
+            else:
+                # lifetime unknown (no iat): refresh a fixed window
+                # ahead of expiry rather than assuming epoch-0 issue
+                # (which would read as perpetually aging and republish
+                # every tick forever)
+                margin = 300.0
+            if time.time() >= float(exp) - margin:
+                # aging out. Only out-of-sync if the fresh build
+                # actually HAS a replacement — a metadata blip must
+                # not strip a still-valid token from the cluster
+                # (same guard as the in-process agent's refresh path)
+                return fresh_tok is None and time.time() < float(exp)
+    except Exception:
+        return False  # unparseable token on the cluster: replace it
+    # current token valid and not aging: in sync — including when the
+    # fresh build LOST identity to a metadata blip (keep the better
+    # document rather than stripping a still-valid token)
+    return True
+
+
+def sync_evidence(kube, node_name: str, backend=None) -> bool:
+    """Idle-tick evidence healer for engines without a long-lived
+    Python agent (the native/bash path; the C++ agent execs
+    ``python -m tpu_cc_manager.evidence --sync`` periodically): rebuild
+    this node's evidence and publish it ONLY when the on-cluster
+    document is out of sync — key posture changed (the evidence-key
+    Secret landed on a converged node), device truth moved without a
+    flip, identity token nearing expiry, or the annotation is missing
+    (a dropped publish). Returns False only on failure; an in-sync
+    no-op is success."""
+    try:
+        if backend is None:
+            from tpu_cc_manager import device as devlayer
+
+            backend = devlayer.get_backend()
+        from tpu_cc_manager import labels as L
+
+        node = kube.get_node(node_name)
+        raw = (node["metadata"].get("annotations") or {}).get(
+            L.EVIDENCE_ANNOTATION
+        )
+        current = None
+        if raw:
+            try:
+                current = json.loads(raw)
+            except ValueError:
+                current = None
+        fresh = build_evidence(node_name, backend)
+        if evidence_in_sync(current, fresh):
+            return True
+        log.info("evidence out of sync (posture/device/identity); "
+                 "republishing")
+        kube.set_node_annotations(node_name, {
+            L.EVIDENCE_ANNOTATION: json.dumps(
+                fresh, sort_keys=True, separators=(",", ":")
+            ),
+        })
+        return True
+    except Exception:
+        log.warning("evidence sync failed", exc_info=True)
+        return False
+
+
 def main(argv=None) -> int:
     """CLI (``python -m tpu_cc_manager.evidence``): print the node
     merge-patch carrying this host's evidence annotation. The bash
@@ -473,12 +580,26 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(prog="tpu-cc-evidence")
     ap.add_argument("--node-name", default=os.environ.get("NODE_NAME"))
+    ap.add_argument(
+        "--sync", action="store_true",
+        help="talk to the API server directly: republish this node's "
+             "evidence only if the on-cluster document is out of sync "
+             "(key posture, device truth, identity freshness). The "
+             "native agent execs this on its idle tick.",
+    )
+    ap.add_argument("--kubeconfig", default=os.environ.get("KUBECONFIG"))
     args = ap.parse_args(argv)
     if not args.node_name:
         print("NODE_NAME required", file=sys.stderr)
         return 1
     from tpu_cc_manager import device as devlayer
     from tpu_cc_manager import labels as L
+
+    if args.sync:
+        from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+
+        kube = HttpKubeClient(KubeConfig.load(args.kubeconfig or None))
+        return 0 if sync_evidence(kube, args.node_name) else 1
 
     doc = build_evidence(args.node_name, devlayer.get_backend())
     patch = {"metadata": {"annotations": {

@@ -65,6 +65,14 @@ log = logging.getLogger("tpu-cc-manager.identity")
 
 DEFAULT_AUDIENCE = "tpu-cc-manager"
 
+#: fraction of a token's lifetime remaining at which evidence should
+#: be REPUBLISHED with a fresh token (agent idle tick, native-path
+#: `evidence --sync`). Deliberately INSIDE _TokenCaching.refresh_margin
+#: (0.25): by the time a republish is due, the provider cache already
+#: refuses to serve the old token, so the rebuild fetches fresh instead
+#: of re-serving and looping.
+REPUBLISH_MARGIN = 0.2
+
 #: metadata-server path serving instance identity tokens (GCE contract)
 GCE_IDENTITY_PATH = (
     "/computeMetadata/v1/instance/service-accounts/default/identity"
