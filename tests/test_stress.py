@@ -368,3 +368,115 @@ def test_full_control_plane_soak():
         fleet.stop()
         pt.join(timeout=10)
         ft.join(timeout=10)
+
+
+def test_leader_churn_soak():
+    """Election under churn: three controller replicas with aggressive
+    lease timing while the leader is repeatedly killed. Invariants:
+    at most one leader at any sampled instant, scans never come from a
+    non-leader, and the policy still converges through the churn."""
+    import threading
+    import time
+
+    from tpu_cc_manager import labels as L
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.k8s.objects import make_node
+    from tpu_cc_manager.leader import LeaderElector
+    from tpu_cc_manager.policy import PolicyController
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"}))
+    kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+        "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+        "kind": L.POLICY_KIND, "metadata": {"name": "churn"},
+        "spec": {"mode": "on",
+                 "nodeSelector": L.TPU_ACCELERATOR_LABEL},
+    })
+    stop = threading.Event()
+
+    def agent():
+        while not stop.is_set():
+            labels = kube.get_node("n1")["metadata"]["labels"]
+            want = labels.get(L.CC_MODE_LABEL)
+            if want and labels.get(L.CC_MODE_STATE_LABEL) != want:
+                kube.set_node_labels("n1", {L.CC_MODE_STATE_LABEL: want})
+            time.sleep(0.01)
+
+    threading.Thread(target=agent, daemon=True).start()
+
+    controllers = {}
+    threads = {}
+    bad_scans = []
+
+    def make(ident):
+        elector = LeaderElector(
+            kube, name="tpu-cc-policy-controller", identity=ident,
+            lease_duration_s=0.4, renew_period_s=0.08,
+            retry_period_s=0.04,
+        )
+        c = PolicyController(kube, interval_s=0.05, poll_s=0.02,
+                             port=0, leader_elector=elector)
+        orig = c.scan_once
+
+        def guarded(wait_rollout=True):
+            if not elector.is_leader:
+                bad_scans.append(ident)
+            return orig(wait_rollout=wait_rollout)
+
+        c.scan_once = guarded
+        controllers[ident] = c
+        t = threading.Thread(target=c.run, daemon=True)
+        threads[ident] = t
+        t.start()
+
+    for ident in ("r0", "r1", "r2"):
+        make(ident)
+
+    leaders_seen = set()
+    overlap_started = None
+    sustained_overlaps = []
+    start = time.monotonic()
+    deadline = start + 8
+    kills = 0
+    while time.monotonic() < deadline:
+        leading = [i for i, c in controllers.items()
+                   if c.leader_elector.is_leader]
+        # a BRIEF dual-true window is inherent to lease elections (a
+        # GIL-starved leader learns of its deposition at its next
+        # failed renew — client-go has the same gap); what must never
+        # happen is SUSTAINED dual leadership beyond a lease duration
+        now = time.monotonic()
+        if len(leading) > 1:
+            if overlap_started is None:
+                overlap_started = now
+            elif now - overlap_started > 0.4:
+                sustained_overlaps.append(tuple(leading))
+        else:
+            overlap_started = None
+        if leading:
+            leaders_seen.add(leading[0])
+            if kills < 2 and now - start > (kills + 1) * 2.5:
+                # kill the current leader (clean stop releases the
+                # lease); a standby must take over
+                controllers[leading[0]].stop()
+                kills += 1
+        time.sleep(0.02)
+
+    try:
+        assert sustained_overlaps == [], (
+            f"sustained dual leadership: {sustained_overlaps}"
+        )
+        assert len(leaders_seen) >= 2, "failover never happened"
+        assert bad_scans == [], f"non-leader scanned: {bad_scans}"
+        st = (kube.get_cluster_custom(
+            L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL, "churn"
+        ).get("status") or {})
+        assert st.get("phase") == "Converged", st
+    finally:
+        stop.set()
+        for c in controllers.values():
+            c.stop()
+        for t in threads.values():
+            t.join(timeout=5)
