@@ -143,23 +143,73 @@ class NodeFlipTaint(FlipTaint):
     def __init__(self, kube: KubeClient, node_name: str):
         self.kube = kube
         self.node_name = node_name
+        #: node returned by our own last successful replace — the
+        #: freshest possible seed for the NEXT write of the same flip
+        #: (set -> clear), making the steady-state clear a single round
+        #: trip instead of GET+PUT (BENCH phase_p50_s: taint ops are
+        #: the flip hot path's dominant cost). Note a watcher-event
+        #: hint was tried and measured SLOWER: async evidence/event
+        #: writes land between the event and the taint write, so the
+        #: seeded CAS usually lost and paid a wasted PUT on top of the
+        #: fallback read. Our own replace return can't be stale that
+        #: way within one flip.
+        self._cached: Optional[dict] = None
 
-    def _edit_taints(self, edit) -> None:
+    def _seed(self) -> Optional[dict]:
+        if self._cached is not None:
+            node, self._cached = self._cached, None
+            return node
+        return None
+
+    def invalidate_cache(self) -> None:
+        """Drop the cached node. The engine calls this after drain
+        pause/restore label patches (which bump the node's rv and would
+        make the seeded clear pay a doomed PUT before its fallback)."""
+        self._cached = None
+
+    def _cas_loop(self, mutate, cache_result: bool) -> bool:
+        """Read(or seed)-modify-replace with 409 retry. ``mutate(node)``
+        edits in place and returns True to write, None for no-op. A
+        no-op judged on a SEED is re-confirmed against a fresh read —
+        a stale seed may hide work that is actually needed. Returns
+        True only when a replace actually LANDED (a retry that finds
+        the work already done returns False).
+
+        ``cache_result``: only the flip's OPENING write (set) caches
+        its replace return — it is fresh for the same flip's closing
+        write. The closing write must NOT cache: by the next reconcile
+        the label change itself has moved the rv, and a stale seed
+        costs a doomed PUT before the fallback read (measured: it
+        roughly doubled taint_set)."""
         from tpu_cc_manager.k8s.client import ConflictError
 
+        seed = self._seed()
         for _ in range(self.MAX_CAS_ATTEMPTS):
-            node = self.kube.get_node(self.node_name)
-            taints = list(node.get("spec", {}).get("taints") or [])
-            new = edit(taints)
-            if new is None:  # already in the desired state
-                return
-            node.setdefault("spec", {})["taints"] = new
+            seeded = seed is not None
+            node = seed if seeded else self.kube.get_node(self.node_name)
+            seed = None
+            if mutate(node) is None:
+                if seeded:
+                    continue  # confirm the no-op on a fresh read
+                return False
             try:
-                self.kube.replace_node(self.node_name, node)
-                return
+                result = self.kube.replace_node(self.node_name, node)
+                self._cached = result if cache_result else None
+                return True
             except ConflictError:
                 continue
         raise ApiException(409, "taint update kept conflicting")
+
+    def _edit_taints(self, edit, cache_result: bool = False) -> None:
+        def mutate(node):
+            taints = list(node.get("spec", {}).get("taints") or [])
+            new = edit(taints)
+            if new is None:
+                return None
+            node.setdefault("spec", {})["taints"] = new
+            return True
+
+        self._cas_loop(mutate, cache_result)
 
     def set(self) -> None:
         def add(taints):
@@ -173,7 +223,7 @@ class NodeFlipTaint(FlipTaint):
 
         log.info("tainting %s %s=%s:%s for the flip", self.node_name,
                  L.FLIP_TAINT_KEY, L.FLIP_TAINT_VALUE, L.FLIP_TAINT_EFFECT)
-        self._edit_taints(add)
+        self._edit_taints(add, cache_result=True)
 
     def clear(self) -> None:
         def remove(taints):
@@ -195,29 +245,23 @@ class NodeFlipTaint(FlipTaint):
         Returns True when the label was published here; False when the
         taint was already absent (no replace happened — the caller's
         plain label write is cheaper than a read-modify-write)."""
-        from tpu_cc_manager.k8s.client import ConflictError
-
         log.info(
             "removing flip taint from %s and setting %s=%s",
             self.node_name, L.CC_MODE_STATE_LABEL, state,
         )
-        for _ in range(self.MAX_CAS_ATTEMPTS):
-            node = self.kube.get_node(self.node_name)
+        def mutate(node):
             taints = list(node.get("spec", {}).get("taints") or [])
             kept = [
                 t for t in taints if t.get("key") != L.FLIP_TAINT_KEY
             ]
             if len(kept) == len(taints):
-                return False  # no taint to clear: plain patch is cheaper
+                return None  # no taint to clear: plain patch is cheaper
             node.setdefault("spec", {})["taints"] = kept
             node["metadata"].setdefault("labels", {})[
                 L.CC_MODE_STATE_LABEL] = state
-            try:
-                self.kube.replace_node(self.node_name, node)
-                return True
-            except ConflictError:
-                continue
-        raise ApiException(409, "taint update kept conflicting")
+            return True
+
+        return self._cas_loop(mutate, cache_result=False)
 
 
 def paused_value(original: str) -> str:
@@ -265,6 +309,9 @@ class ComponentDrainer(Drainer):
             for k, v in current.items()
             if not v.startswith(L.PAUSED_STR) and v != "false"
         }
+        # node-write tracking for the engine's taint-seed cache: a node
+        # with nothing to pause leaves the node object untouched
+        self.wrote_node = bool(to_pause)
         if not to_pause:
             log.info("no TPU-stack components deployed on %s; nothing to drain",
                      self.node_name)
@@ -315,6 +362,7 @@ class ComponentDrainer(Drainer):
             log.info("restoring components on %s: %s", self.node_name,
                      sorted(restore))
             self.kube.set_node_labels(self.node_name, restore)
+            self.wrote_node = True
 
 
 class NodeDrainer(Drainer):

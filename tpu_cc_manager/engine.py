@@ -52,6 +52,12 @@ class FatalModeError(Exception):
 class Drainer:
     """L2 collaborator interface; see tpu_cc_manager.drain for real impls."""
 
+    #: did the last evict/reschedule pair WRITE the node object (pause/
+    #: restore labels, cordon)? The engine uses this to decide whether
+    #: the taint layer's cached node survived the drain. Conservative
+    #: default: assume writes.
+    wrote_node = True
+
     def evict(self) -> None:  # pragma: no cover - interface
         raise NotImplementedError
 
@@ -61,6 +67,8 @@ class Drainer:
 
 class NullDrainer(Drainer):
     """No-op drainer (EVICT_OPERATOR_COMPONENTS=false, reference main.py:94-96)."""
+
+    wrote_node = False
 
     def evict(self) -> None:
         pass
@@ -305,6 +313,17 @@ class ModeEngine:
                         self._drainer.reschedule()
                 except Exception:
                     log.exception("failed to reschedule drained components")
+                # pause/restore patched node labels: any node object the
+                # taint layer cached from its own set() is stale now —
+                # but only when the drainer actually WROTE (a node with
+                # no components deployed keeps the seed, and the clear
+                # stays a single round trip)
+                if getattr(self._drainer, "wrote_node", True):
+                    invalidate = getattr(
+                        self._flip_taint, "invalidate_cache", None
+                    )
+                    if invalidate is not None:
+                        invalidate()
             state = state_on_success if ok else STATE_FAILED
             published = False
             try:

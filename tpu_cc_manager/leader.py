@@ -89,6 +89,11 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._is_leader = False
+        #: monotonic stamp of the last demotion — observers judging
+        #: "did work happen while not leading?" must grant the
+        #: deposition window (a leader learns of its deposition at its
+        #: next failed renew; work started just before is legitimate)
+        self.deposed_at = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: observed (renewTime value, monotonic first seen unchanged) of
@@ -113,6 +118,7 @@ class LeaderElector:
             log.warning("%s: lost leadership (%s)", self.name,
                         self.identity)
             self._is_leader = False
+            self.deposed_at = time.monotonic()
             if self.on_stopped_leading:
                 self.on_stopped_leading()
 
