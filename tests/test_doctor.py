@@ -249,3 +249,61 @@ def test_cli_doctor_offline(tmp_path, monkeypatch, capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["ok"] is True
     assert any(c["name"] == "independent-read" for c in out["checks"])
+
+
+def test_doctor_judges_identity_posture(tmp_path, monkeypatch):
+    """The node self-diagnoses its identity posture: ok with a bound
+    verifiable token, warn when a configured provider produced no
+    token, fail on a foreign token — so identity problems surface on
+    the node before the fleet audit pages."""
+    from tpu_cc_manager.doctor import run_doctor
+    from tpu_cc_manager.evidence import build_evidence
+    from tpu_cc_manager.identity import FakePlatformIdentity, mint_fake_token
+
+    monkeypatch.setenv("TPU_CC_IDENTITY", "fake")
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", "dk")
+    be = _backend(tmp_path, monkeypatch)
+    kube = FakeKube()
+
+    def publish(doc):
+        import json as _json
+
+        kube.set_node_annotations("doc-node", {
+            L.EVIDENCE_ANNOTATION: _json.dumps(doc)})
+
+    def check_named(report, name):
+        return next(c for c in report["checks"] if c["name"] == name)
+
+    kube.add_node(make_node("doc-node"))
+    # healthy: identity bound + verifiable
+    publish(build_evidence(
+        "doc-node", be, identity_provider=FakePlatformIdentity(b"dk")))
+    c = check_named(run_doctor(kube=kube, node_name="doc-node",
+                               backend=be), "identity")
+    assert c["severity"] == "ok", c
+
+    # provider configured but token missing from the published doc
+    publish(build_evidence("doc-node", be, identity_provider=None))
+    c = check_named(run_doctor(kube=kube, node_name="doc-node",
+                               backend=be), "identity")
+    assert c["severity"] == "warn", c
+    assert "no token" in c["detail"]
+
+    # foreign token (replay): fail
+    class Replaying:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return mint_fake_token("other-node", b"dk")
+
+    publish(build_evidence("doc-node", be, identity_provider=Replaying()))
+    c = check_named(run_doctor(kube=kube, node_name="doc-node",
+                               backend=be), "identity")
+    assert c["severity"] == "fail", c
+
+    # no provider configured at all: absence is healthy
+    monkeypatch.setenv("TPU_CC_IDENTITY", "none")
+    publish(build_evidence("doc-node", be, identity_provider=None))
+    c = check_named(run_doctor(kube=kube, node_name="doc-node",
+                               backend=be), "identity")
+    assert c["severity"] == "ok", c

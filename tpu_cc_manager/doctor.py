@@ -36,6 +36,10 @@ skipped with a ``warn`` otherwise):
   is a ``warn``: the agent may simply still be working);
 - ``evidence``           — the published evidence annotation verifies,
   matches the local statefiles, and attests the labeled mode;
+- ``identity``           — the evidence's platform-identity token
+  verifies and binds to THIS node (``fail`` on a foreign/invalid
+  token; ``warn`` when an explicitly configured provider produced no
+  token, the token is expired, or the signature is unverifiable here);
 - ``flip-taint``         — no leftover flip taint outside a flip.
 """
 
@@ -73,6 +77,49 @@ def _node_mode_from_devices(chips, store) -> Optional[str]:
                             if store is not None else c.query_ici_mode())
         devices.append(entry)
     return evidence_mode({"devices": devices})
+
+
+def _identity_check(checks: List[dict], doc: dict,
+                    node_name: str) -> None:
+    """The node diagnoses its OWN identity posture, so a broken
+    metadata path / lapsed token / foreign token surfaces here first,
+    not as a fleet-wide audit finding. Reuses the already-parsed
+    evidence document; never dials the metadata server (that would add
+    a blocking probe to every doctor run) — the provider MODE comes
+    from the env alone."""
+    import os as _os
+
+    from tpu_cc_manager.identity import judge_identity
+
+    iverdict, idetail = judge_identity(doc, node_name)
+    mode = _os.environ.get("TPU_CC_IDENTITY", "auto").lower()
+    if iverdict == "ok":
+        _check(checks, "identity", "ok",
+               "platform identity token verifies and binds to this node")
+    elif iverdict == "unverifiable":
+        _check(checks, "identity", "warn",
+               f"identity present but {idetail}")
+    elif iverdict == "missing" and mode in ("fake", "gce"):
+        _check(checks, "identity", "warn",
+               f"TPU_CC_IDENTITY={mode} is configured but the "
+               "published evidence carries no token — metadata path "
+               "broken at publish time? (heals on the next evidence "
+               "sync)")
+    elif iverdict == "missing":
+        # auto/none: absence is the normal posture off-GCE. A GCE
+        # metadata OUTAGE also lands here (this host cannot tell the
+        # two apart without probing) — the fleet audit's mixed-pool
+        # identity_missing finding is the detector for that case.
+        _check(checks, "identity", "ok",
+               "no identity attached (no platform identity provider "
+               "configured/detected)")
+    elif iverdict == "expired":
+        _check(checks, "identity", "warn",
+               "identity token expired — the refresh loop is not "
+               "keeping up")
+    else:  # mismatch / invalid
+        _check(checks, "identity", "fail",
+               f"identity {iverdict}: {idetail}")
 
 
 def run_doctor(kube=None, node_name: Optional[str] = None,
@@ -280,6 +327,7 @@ def run_doctor(kube=None, node_name: Optional[str] = None,
                 else:
                     _check(checks, "evidence", "ok",
                            f"verifies ({reason}), attests {attested!r}")
+                _identity_check(checks, doc, node_name)
             except Exception as e:
                 _check(checks, "evidence", "fail",
                        f"evidence unreadable: {e}")
