@@ -1734,3 +1734,98 @@ def test_scan_stays_live_during_slow_rollout():
         assert live["status"]["phase"] == "Converged"
     finally:
         c._join_worker()
+
+
+def test_adoption_attributes_progress_to_matching_policy():
+    """After a failover (or crash), the adopted rollout is the normal
+    continuation of some policy's work: the policy whose spec matches
+    the record (selector + mode) shows live adoption progress and the
+    final outcome in its status, instead of going dark for the whole
+    resume."""
+    kube = FakeKube()
+    kube.add_node(_node("a0", desired="off", state="off",
+                        extra={"pool": "a"}))
+    kube.add_node(_node("a1", desired="on", state="off",
+                        extra={"pool": "a"}))
+    record = {
+        "id": "cafe01", "started": time.time(), "mode": "on",
+        "selector": "pool=a", "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "groups": {
+            "node/a1": {"nodes": ["a1"], "outcome": "in_flight"},
+            "node/a0": {"nodes": ["a0"], "outcome": "pending"},
+        },
+    }
+    kube.set_node_annotations(
+        "a0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    kube.add_custom(G, P, make_policy(
+        "matching", selector="pool=a",
+        strategy={"groupTimeoutSeconds": 10},
+    ))
+    kube.add_custom(G, P, make_policy("other", selector="pool=b"))
+
+    seen_messages = []
+    agents = _ReactiveAgents(kube, ["a0", "a1"])
+    agents.start()
+    c = controller(kube, adopt_after_s=0)
+    orig_patch = c._patch_status
+
+    def recording_patch(pol, st):
+        if pol["metadata"]["name"] == "matching":
+            seen_messages.append((st["phase"], st["message"]))
+        return orig_patch(pol, st)
+
+    c._patch_status = recording_patch
+    try:
+        c.scan_once()  # observes the static heartbeat
+        st = c.scan_once()["policies"]["matching"]  # adopts + finishes
+        # the report carries the worker's final status
+        assert st["phase"] == "Converged"
+        assert "adopted rollout 'cafe01'" in st["message"]
+        # mid-roll the policy showed the adoption and per-group progress
+        assert any("adopted unfinished rollout 'cafe01'" in m
+                   for _, m in seen_messages), seen_messages
+        assert any("group(s) done" in m for _, m in seen_messages), \
+            seen_messages
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)
+
+
+def test_adoption_without_matching_policy_still_resumes():
+    """A record no current policy claims (operator-run rollout, or the
+    policy was deleted) still resumes; no policy status is touched."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    record = {
+        "id": "feed02", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "groups": {
+            "node/n0": {"nodes": ["n0"], "outcome": "in_flight"},
+        },
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    # a policy with a DIFFERENT mode: must not claim the adoption
+    kube.add_custom(G, P, make_policy("off-policy", mode="off"))
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    c = controller(kube, adopt_after_s=0)
+    try:
+        c.scan_once()
+        c.scan_once()  # adopts
+        rec = json.loads(
+            kube.get_node("n0")["metadata"]["annotations"][
+                L.ROLLOUT_ANNOTATION
+            ]
+        )
+        assert rec["complete"] is True
+        live = kube.get_cluster_custom(G, V, P, "off-policy")
+        msg = (live.get("status") or {}).get("message", "")
+        assert "adopted" not in msg
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)

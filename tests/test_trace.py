@@ -218,8 +218,16 @@ def test_agent_wires_reconcile_spans():
     root = next(s for s in spans if s["name"] == "reconcile")
     assert root["attrs"]["outcome"] == "success"
     assert root.get("parent") is None
+    # every span of the reconcile TREE shares its trace id. Spans
+    # emitted from the async recorder thread (evidence_publish) are
+    # deliberately their own roots — the publish happens OFF the
+    # reconcile path, and the tracer's stacks are thread-local — and
+    # they may or may not have landed yet (that's the async contract,
+    # and why they are excluded rather than awaited here)
+    async_roots = ("evidence_publish",)
     for s in spans:
-        if s["name"] != "reconcile":
-            assert s["trace"] == root["trace"]
+        if s["name"] == "reconcile" or s["name"] in async_roots:
+            continue
+        assert s["trace"] == root["trace"], s
     assert agent.metrics.phase_duration.labels("reconcile").count == 1
     assert agent.metrics.phase_duration.labels("flip").count == 1

@@ -292,6 +292,9 @@ class PolicyController:
         #: scan_once() (tests, --once) still joins the worker so its
         #: callers keep synchronous semantics.
         self._active: Optional[dict] = None
+        #: launch-time record of the current scan's worker (see
+        #: _join_worker); reset at each scan start
+        self._last_worker: Optional[dict] = None
         self._active_lock = threading.Lock()
         #: fairness state (VERDICT r3 weak #2): the launch slot rotates
         #: round-robin among actionable policies, and a policy whose
@@ -484,12 +487,21 @@ class PolicyController:
                 "rolling": rolling_name,
             }
 
+        # the launch-time worker record is scan-scoped: it exists so
+        # THIS scan's join can outlive a fast-finishing worker, never
+        # so a later scan could re-join (and re-apply) an old outcome
+        with self._active_lock:
+            self._last_worker = None
+
         # ---- pass 2: adopt any unfinished rollout left on the pool
         # (this controller's crashed run, or an operator's) before
         # launching anything new — resume IS the crash-safety story
-        adopted = self._adopt_unfinished(
+        adopted, adopted_owner = self._adopt_unfinished(
             list(seen_nodes.values()), paused_claims, statuses,
             claims_incomplete=claims_incomplete,
+            policies_by_name={
+                p["metadata"]["name"]: p for p in policies
+            },
         )
 
         # ---- pass 3: launch at most one rollout worker this tick
@@ -503,16 +515,23 @@ class PolicyController:
                     "this tick, so selector overlap cannot be ruled out"
                 )
             actionable = []
-        launched_name = None
+        # the worker's policy (fresh launch or claimed adoption) is the
+        # worker's to patch — pass 4 must not race it, even when the
+        # worker finishes before this line runs
+        launched_name = adopted_owner
         if not adopted and actionable:
             launched_name = self._launch_fair(actionable, statuses)
 
         # sync mode (scan_once/--once/tests): the report must reflect
         # the rollout's outcome, so wait for the worker here
         if wait_rollout:
-            final = self._join_worker()
-            if launched_name is not None and final is not None:
-                statuses[launched_name] = final
+            joined = self._join_worker()
+            if joined is not None:
+                jname, jstatus = joined
+                if jname is not None and jstatus is not None \
+                        and jname in statuses:
+                    statuses[jname] = jstatus
+                    launched_name = jname  # worker already patched it
 
         # ---- pass 4: publish statuses. The launched policy is skipped
         # either way: mid-roll (async) the worker owns its patches, and
@@ -607,15 +626,7 @@ class PolicyController:
                 if self._active is not None:
                     self._active["status"] = dict(wst)  # final snapshot
                 self.metrics.rollouts.inc(outcome)
-                if outcome == "ok":
-                    self._failures.pop(name, None)
-                    self._retry_after.pop(name, None)
-                else:
-                    n = self._failures.get(name, 0) + 1
-                    self._failures[name] = n
-                    self._retry_after[name] = time.monotonic() + min(
-                        self.interval_s * (2 ** (n - 1)), 900.0
-                    )
+                self._note_outcome_locked(name, outcome == "ok")
                 self._active = None
             try:
                 self._patch_status(pol, wst)  # final outcome, worker-owned
@@ -629,6 +640,7 @@ class PolicyController:
         )
         with self._active_lock:
             self._active = {"name": name, "status": dict(st), "thread": t}
+            self._last_worker = self._active
         t.start()
         return name
 
@@ -654,17 +666,49 @@ class PolicyController:
         if self._demoted:
             rollout.request_stop("leadership lost")
 
-    def _join_worker(self) -> Optional[dict]:
-        """Wait out the in-flight worker (if any); returns its final
-        status snapshot (None for adoption workers, which own no policy
-        status)."""
+    def _publish_worker_status(self, pol, st) -> None:
+        """The one way a rollout worker publishes: refresh the snapshot
+        concurrent scans//report serve, then patch the cluster. Shared
+        by the launch and adoption paths so the snapshot/locking
+        protocol cannot drift between them."""
         with self._active_lock:
-            active = self._active
+            if self._active is not None:
+                self._active["status"] = dict(st)
+        self._patch_status(pol, st)
+
+    def _note_outcome_locked(self, name: str, ok: bool) -> None:
+        """Fairness bookkeeping for a finished rollout (caller holds
+        ``_active_lock``): success clears the policy's backoff, failure
+        backs it off exponentially — the ADOPTED path must feed this
+        too, or every crash/failover would reset the backoff the
+        fairness mechanism exists to enforce."""
+        if ok:
+            self._failures.pop(name, None)
+            self._retry_after.pop(name, None)
+        else:
+            n = self._failures.get(name, 0) + 1
+            self._failures[name] = n
+            self._retry_after[name] = time.monotonic() + min(
+                self.interval_s * (2 ** (n - 1)), 900.0
+            )
+
+    def _join_worker(self):
+        """Wait out the in-flight worker (if any); returns
+        ``(policy_name, final_status_snapshot)`` — name/status are None
+        for adoptions no policy claimed. Falls back to the launch-time
+        record so a worker that finished (and cleared ``_active``)
+        before the join is still joinable and its final snapshot still
+        readable."""
+        with self._active_lock:
+            active = self._active or self._last_worker
         if active is None:
             return None
         active["thread"].join()
         status = active.get("status")
-        return dict(status) if status is not None else None
+        return (
+            active.get("name"),
+            dict(status) if status is not None else None,
+        )
 
     # --------------------------------------------------------- derivation
     def _derive_status(self, pol: dict, spec: dict, own: List[dict],
@@ -766,12 +810,15 @@ class PolicyController:
         paused_claims: Dict[str, str],
         statuses: Dict[str, dict],
         claims_incomplete: bool = False,
-    ) -> bool:
+        policies_by_name: Optional[Dict[str, dict]] = None,
+    ):
         """Resume a crashed rollout if one exists on the policies' own
-        nodes. True when the tick's rollout slot is consumed (a resume
-        ran, or an unfinished record is being held by a paused policy —
-        launching anything new would just trip the rollout layer's
-        concurrent-record guard).
+        nodes. Returns ``(consumed, owner)``: consumed=True when the
+        tick's rollout slot is taken (a resume ran, or an unfinished
+        record is being held by a paused policy — launching anything
+        new would just trip the rollout layer's concurrent-record
+        guard); owner is the policy the adoption attributed itself to
+        (spec matches the record), if any.
 
         Scope is deliberately the union of the policies' node lists, not
         a full-cluster scan: records the controller itself wrote always
@@ -780,7 +827,7 @@ class PolicyController:
         record, _ = load_rollout_record(self.kube, nodes)
         if record is None or record.get("complete"):
             self._hb_seen.clear()  # no unfinished record: reset watch
-            return False
+            return False, None
         if not self._record_observed_stale(record):
             # the heartbeat is still moving (or we haven't watched it
             # long enough): a rollout process — a human-run `rollout`,
@@ -792,7 +839,7 @@ class PolicyController:
                 "unfinished rollout %s: heartbeat still under "
                 "observation; waiting for its owner", record.get("id"),
             )
-            return True
+            return True, None
         if claims_incomplete:
             # a policy's node list failed this tick, so paused_claims may
             # be missing exactly the paused policy whose brake should
@@ -803,7 +850,7 @@ class PolicyController:
                 "failed this tick, pause coverage unknown",
                 record.get("id"),
             )
-            return True
+            return True, None
         held_by = sorted({
             paused_claims[m]
             for g in (record.get("groups") or {}).values()
@@ -825,18 +872,55 @@ class PolicyController:
                 record.get("id"),
                 "y" if len(held_by) == 1 else "ies", held_by,
             )
-            return True
+            return True, None
         log.info(
             "adopting unfinished rollout %s (mode %r)",
             record.get("id"), record.get("mode"),
         )
         self._hb_seen.clear()  # adopting: the old observation is moot
 
+        # attribute the adoption to the policy whose spec matches the
+        # record (selector + mode): after a leader failover this is the
+        # normal continuation of that policy's rollout, and its status
+        # must show live progress — not go dark until the resume ends
+        owner = None
+        pol = None
+        for name, p in (policies_by_name or {}).items():
+            try:
+                spec = parse_policy_spec(p)
+            except PolicySpecError:
+                continue
+            if (spec["selector"] == record.get("selector")
+                    and spec["mode"] == record.get("mode")):
+                owner, pol = name, p
+                break
+        wst = None
+        if owner is not None and owner in statuses:
+            wst = dict(statuses[owner])
+            wst["phase"] = "Rolling"
+            wst["message"] = (
+                f"adopted unfinished rollout {record.get('id')!r}; "
+                "resuming"
+            )
+            statuses[owner] = dict(wst)
+            self._patch_status(pol, wst)
+
+        def progress(gname, outcome, done, total):
+            if wst is None:
+                return
+            wst["message"] = (
+                f"adopted rollout {record.get('id')!r}: {done}/{total} "
+                f"group(s) done (last: {gname} {outcome})"
+            )
+            self._publish_worker_status(pol, wst)
+
         def work():
+            report = None
             try:
                 rollout = Rollout.resume(
                     self.kube, poll_s=self.poll_s,
                     verify_evidence=self.verify_evidence,
+                    on_group=progress if wst is not None else None,
                 )
                 self._arm_rollout(rollout)
                 try:
@@ -844,15 +928,47 @@ class PolicyController:
                 finally:
                     self._current_rollout = None
                 outcome = "resumed_ok" if report.ok else "resumed_failed"
+                ok = report.ok
             except (RolloutError, ApiException) as e:
                 log.warning("rollout adoption failed: %s", e)
-                outcome = "resume_error"
+                outcome, ok = "resume_error", False
             except Exception:
                 log.exception("rollout adoption crashed")
-                outcome = "resume_error"
+                outcome, ok = "resume_error", False
+            if wst is not None:
+                wst["phase"] = "Converged" if ok else "Degraded"
+                wst["message"] = (
+                    f"adopted rollout {record.get('id')!r} "
+                    f"{'converged' if ok else 'did not converge'}"
+                )
+                if report is not None:
+                    wst["lastRollout"] = {
+                        "mode": report.mode,
+                        "ok": report.ok,
+                        "aborted": report.aborted,
+                        "succeeded": report.succeeded,
+                        "failed": report.failed,
+                        "adopted": True,
+                        "finishedAt": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                    }
             with self._active_lock:
+                if self._active is not None and wst is not None:
+                    self._active["status"] = dict(wst)
                 self.metrics.rollouts.inc(outcome)
+                if owner is not None:
+                    # a failed ADOPTED rollout backs its policy off
+                    # like a failed fresh one — failover must not
+                    # reset the fairness mechanism
+                    self._note_outcome_locked(owner, ok)
                 self._active = None
+            if wst is not None:
+                try:
+                    self._patch_status(pol, wst)
+                except Exception:
+                    log.warning("adoption status patch failed",
+                                exc_info=True)
             self._wake.set()
 
         # adoption runs on the same single worker slot as fresh
@@ -861,9 +977,14 @@ class PolicyController:
             target=work, daemon=True, name="rollout-adoption"
         )
         with self._active_lock:
-            self._active = {"name": None, "status": None, "thread": t}
+            self._active = {
+                "name": owner,
+                "status": dict(wst) if wst is not None else None,
+                "thread": t,
+            }
+            self._last_worker = self._active
         t.start()
-        return True
+        return True, owner
 
     def _record_observed_stale(self, record: dict) -> bool:
         """Has this record's heartbeat sat UNCHANGED for adopt_after_s
@@ -899,11 +1020,7 @@ class PolicyController:
                 f"rolling {spec['mode']!r}: {done}/{total} group(s) "
                 f"done (last: {gname} {outcome})"
             )
-            # refresh the snapshot concurrent scans/report serve
-            with self._active_lock:
-                if self._active is not None:
-                    self._active["status"] = dict(st)
-            self._patch_status(pol, st)
+            self._publish_worker_status(pol, st)
 
         try:
             rollout = Rollout(

@@ -277,6 +277,7 @@ class Rollout:
         poll_s: float = 0.5,
         dry_run: bool = False,
         verify_evidence: bool = True,
+        on_group=None,
     ) -> "Rollout":
         """Rebuild a Rollout from the pool's unfinished durable record.
         Mode, window, budget, AND selector come from the record (the
@@ -301,6 +302,7 @@ class Rollout:
             failure_budget=int(record.get("failure_budget", 0)),
             group_timeout_s=group_timeout_s, poll_s=poll_s, force=True,
             dry_run=dry_run, verify_evidence=verify_evidence,
+            on_group=on_group,
         )
         r._resume_from = (record, record_node)
         r._force_claim = True
