@@ -283,3 +283,37 @@ def test_cli_fleet_controller_once(monkeypatch, capsys):
     kube.add_node(_node("n3", desired="on", state="failed"))
     rc = cli.main(["fleet-controller", "--once"])
     assert rc == 1
+
+
+def test_report_carries_election_state(monkeypatch):
+    """/report is the one operator pane: when leader election is live,
+    the report names each controller's lease holder and failover
+    count; absent Leases contribute nothing; with election disabled
+    the lookups are skipped entirely."""
+    from tpu_cc_manager.fleet import FleetController
+
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    kube.create_lease("tpu-system", {
+        "metadata": {"name": "tpu-cc-policy-controller"},
+        "spec": {"holderIdentity": "replica-a",
+                 "renewTime": "2026-07-30T00:00:00.000000Z",
+                 "leaseTransitions": 3},
+    })
+    monkeypatch.setenv("TPU_CC_LEADER_ELECT", "true")
+    c = FleetController(kube, interval_s=30, port=0)
+    report = c.scan_once()
+    elections = report["leader_elections"]
+    assert elections["tpu-cc-policy-controller"]["holder"] == "replica-a"
+    assert elections["tpu-cc-policy-controller"]["transitions"] == 3
+    assert "tpu-cc-fleet-controller" not in elections  # no Lease: absent
+
+    # election off (no elector, no env): the report stays empty and
+    # no lease GETs are issued
+    monkeypatch.delenv("TPU_CC_LEADER_ELECT")
+    calls = []
+    orig = kube.get_lease
+    kube.get_lease = lambda *a: (calls.append(a), orig(*a))[1]
+    c2 = FleetController(kube, interval_s=30, port=0)
+    assert c2.scan_once()["leader_elections"] == {}
+    assert calls == []

@@ -1829,3 +1829,37 @@ def test_adoption_without_matching_policy_still_resumes():
     finally:
         agents.stop.set()
         agents.join(timeout=2)
+
+
+def test_adoption_posts_policy_event():
+    """`kubectl describe tpuccpolicy` must carry the failover history:
+    adopting an unfinished record posts PolicyRolloutAdopted on the
+    owning policy."""
+    kube = FakeKube()
+    kube.add_node(_node("e0", desired="on", state="off"))
+    record = {
+        "id": "ev123", "started": time.time(), "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "max_unavailable": 1,
+        "failure_budget": 0, "complete": False, "aborted": False,
+        "groups": {
+            "node/e0": {"nodes": ["e0"], "outcome": "in_flight"},
+        },
+    }
+    kube.set_node_annotations(
+        "e0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    kube.add_custom(G, P, make_policy("evpol"))
+    agents = _ReactiveAgents(kube, ["e0"])
+    agents.start()
+    c = controller(kube, adopt_after_s=0)
+    try:
+        c.scan_once()
+        c.scan_once()  # adopts
+        reasons = [
+            (e.get("reason"), e.get("involvedObject", {}).get("name"))
+            for e in kube.cluster_events
+        ]
+        assert ("PolicyRolloutAdopted", "evpol") in reasons, reasons
+    finally:
+        agents.stop.set()
+        agents.join(timeout=2)

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from typing import List, Optional
@@ -199,6 +200,20 @@ class FleetController:
         #: scans only while holding the Lease (standby replicas stay
         #: hot but quiet — see policy.py's identical gating)
         self.leader_elector = leader_elector
+        #: election reporting: namespace resolved ONCE at construction
+        #: (embedders inject an elector with their own namespace; the
+        #: env default matches _leader_elector in __main__), and the
+        #: lease lookups are skipped entirely when election is off —
+        #: no point paying two guaranteed-404 GETs per scan
+        from tpu_cc_manager.config import _env_bool
+
+        self._election_ns = os.environ.get(
+            "OPERATOR_NAMESPACE", "tpu-system"
+        )
+        self._election_enabled = (
+            leader_elector is not None
+            or _env_bool("TPU_CC_LEADER_ELECT", False)
+        )
         if interval_s <= 0:
             raise ValueError(
                 f"scan interval must be > 0, got {interval_s!r} "
@@ -233,6 +248,7 @@ class FleetController:
             report["evidence_audit"] = audit_evidence(nodes)
             report["doctor"] = self._aggregate_doctor(nodes)
             report["policies"] = self._policy_summaries()
+            report["leader_elections"] = self._election_summaries()
             self.metrics.scan_duration.observe(time.monotonic() - t0)
             self.metrics.update(report)
             self.last_report = report
@@ -273,6 +289,29 @@ class FleetController:
                                 "at": None})
         return {"reported": reported,
                 "failing": sorted(failing, key=lambda d: d["node"])}
+
+    def _election_summaries(self) -> dict:
+        """Election state of both controllers, so /report is the one
+        pane for HA debugging too: who leads, for how long, how many
+        failovers. Empty entries when the Lease doesn't exist (election
+        disabled) or the client has no lease support."""
+        out = {}
+        if not self._election_enabled:
+            return out
+        for name in ("tpu-cc-policy-controller",
+                     "tpu-cc-fleet-controller"):
+            try:
+                lease = self.kube.get_lease(self._election_ns, name)
+            except Exception:
+                continue
+            spec = lease.get("spec") or {}
+            out[name] = {
+                "holder": spec.get("holderIdentity"),
+                "acquireTime": spec.get("acquireTime"),
+                "renewTime": spec.get("renewTime"),
+                "transitions": spec.get("leaseTransitions", 0),
+            }
+        return out
 
     def _policy_summaries(self) -> List[dict]:
         """Status summaries of the cluster's TPUCCPolicies, so /report

@@ -94,6 +94,22 @@ class PolicySpecError(ValueError):
     """The policy's spec cannot be acted on (bad mode, bad strategy)."""
 
 
+def _last_rollout_status(report, adopted: bool = False) -> dict:
+    """``status.lastRollout`` from a RolloutReport — ONE shape for the
+    fresh-launch and adoption paths, so the two can't drift."""
+    out = {
+        "mode": report.mode,
+        "ok": report.ok,
+        "aborted": report.aborted,
+        "succeeded": report.succeeded,
+        "failed": report.failed,
+        "finishedAt": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if adopted:
+        out["adopted"] = True
+    return out
+
+
 def _parse_hhmm(value, field: str) -> int:
     """'HH:MM' -> minutes since midnight; raises PolicySpecError."""
     if (not isinstance(value, str) or len(value) != 5
@@ -904,6 +920,13 @@ class PolicyController:
             )
             statuses[owner] = dict(wst)
             self._patch_status(pol, wst)
+            # failover history on `kubectl describe tpuccpolicy`
+            self._emit_policy_event(
+                owner, "PolicyRolloutAdopted",
+                f"adopted unfinished rollout {record.get('id')!r} "
+                f"(mode {record.get('mode')!r}) left by a previous "
+                "driver",
+            )
 
         def progress(gname, outcome, done, total):
             if wst is None:
@@ -916,6 +939,7 @@ class PolicyController:
 
         def work():
             report = None
+            noop = False
             try:
                 rollout = Rollout.resume(
                     self.kube, poll_s=self.poll_s,
@@ -929,30 +953,51 @@ class PolicyController:
                     self._current_rollout = None
                 outcome = "resumed_ok" if report.ok else "resumed_failed"
                 ok = report.ok
-            except (RolloutError, ApiException) as e:
+            except RolloutError as e:
+                if "no unfinished rollout" in str(e):
+                    # benign race: the original driver completed the
+                    # record between the staleness judgment and our
+                    # resume — nothing failed, nobody gets backed off
+                    log.info("adoption no-op: %s", e)
+                    outcome, ok, noop = "resume_noop", True, True
+                else:
+                    log.warning("rollout adoption failed: %s", e)
+                    outcome, ok = "resume_error", False
+            except ApiException as e:
                 log.warning("rollout adoption failed: %s", e)
                 outcome, ok = "resume_error", False
             except Exception:
                 log.exception("rollout adoption crashed")
                 outcome, ok = "resume_error", False
             if wst is not None:
-                wst["phase"] = "Converged" if ok else "Degraded"
-                wst["message"] = (
-                    f"adopted rollout {record.get('id')!r} "
-                    f"{'converged' if ok else 'did not converge'}"
-                )
+                if noop:
+                    # the original driver finished the record between
+                    # the staleness judgment and our resume: nothing
+                    # failed, nothing to report as degraded
+                    wst["phase"] = "Converged" if wst.get(
+                        "divergent", 0) == 0 else "Pending"
+                    wst["message"] = (
+                        f"rollout {record.get('id')!r} was completed "
+                        "by its original driver"
+                    )
+                else:
+                    wst["phase"] = "Converged" if ok else "Degraded"
+                    wst["message"] = (
+                        f"adopted rollout {record.get('id')!r} "
+                        f"{'converged' if ok else 'did not converge'}"
+                    )
+                if ok and not noop:
+                    # fresh-rollout parity: converged work is no longer
+                    # divergent — kubectl columns must agree with the
+                    # Converged condition until the next scan re-derives
+                    wst["converged"] = (
+                        wst.get("converged", 0) + wst.get("divergent", 0)
+                    )
+                    wst["divergent"] = 0
                 if report is not None:
-                    wst["lastRollout"] = {
-                        "mode": report.mode,
-                        "ok": report.ok,
-                        "aborted": report.aborted,
-                        "succeeded": report.succeeded,
-                        "failed": report.failed,
-                        "adopted": True,
-                        "finishedAt": time.strftime(
-                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-                        ),
-                    }
+                    wst["lastRollout"] = _last_rollout_status(
+                        report, adopted=True
+                    )
             with self._active_lock:
                 if self._active is not None and wst is not None:
                     self._active["status"] = dict(wst)
@@ -1050,16 +1095,7 @@ class PolicyController:
                 name, "PolicyRolloutRefused", str(e), "Warning"
             )
             return "refused"
-        st["lastRollout"] = {
-            "mode": report.mode,
-            "ok": report.ok,
-            "aborted": report.aborted,
-            "succeeded": report.succeeded,
-            "failed": report.failed,
-            "finishedAt": time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-            ),
-        }
+        st["lastRollout"] = _last_rollout_status(report)
         if report.ok:
             st["phase"] = "Converged"
             st["message"] = (
