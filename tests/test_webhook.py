@@ -348,3 +348,50 @@ def test_serving_cert_hot_reload(tmp_path):
         shutil.copy(key_b, live_key)
         assert srv.reload_certs_if_changed() is True
         assert handshake_ok(cert_b) and not handshake_ok(cert_a)
+
+
+def test_doctor_aware_steering_opt_in(monkeypatch):
+    """TPU_CC_WEBHOOK_REQUIRE_DOCTOR=true additionally pins opted-in
+    pods to doctor-healthy nodes (cc.doctor.ok=true); off by default so
+    mixed fleets (nodes that never published a verdict) aren't
+    stranded."""
+    from tpu_cc_manager.webhook import mutate_pod
+
+    from tpu_cc_manager.webhook import validate_pod
+
+    monkeypatch.delenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", raising=False)
+    pod = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+           "spec": {}}
+    # default off: only the state-label pin
+    ops = mutate_pod(pod)
+    paths = [o["path"] for o in ops]
+    assert not any("doctor" in p for p in paths), paths
+
+    monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "true")
+    ops = mutate_pod(pod)
+    values = {o["path"]: o.get("value") for o in ops}
+    doctor_path = next(p for p in values if "doctor" in p)
+    assert values[doctor_path] == "true"
+    # an existing CORRECT doctor pin is left alone
+    pod2 = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+            "spec": {"nodeSelector": {L.DOCTOR_OK_LABEL: "true"}}}
+    ops2 = mutate_pod(pod2)
+    assert sum("doctor" in o["path"] for o in ops2) == 0
+
+    # a pod that brought its OWN matching mode pin must not dodge the
+    # doctor requirement
+    pod3 = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+            "spec": {"nodeSelector": {L.CC_MODE_STATE_LABEL: "on"}}}
+    ops3 = mutate_pod(pod3)
+    assert sum("doctor" in o["path"] for o in ops3) == 1, ops3
+
+    # an explicit pin onto doctor-UNHEALTHY nodes is REJECTED, same
+    # contradiction treatment as a wrong mode pin
+    pod4 = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+            "spec": {"nodeSelector": {L.CC_MODE_STATE_LABEL: "on",
+                                      L.DOCTOR_OK_LABEL: "false"}}}
+    allowed, reason = validate_pod(pod4)
+    assert not allowed and "doctor" in reason
+    # ...but only while the knob is on
+    monkeypatch.delenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR")
+    assert validate_pod(pod4)[0] is True

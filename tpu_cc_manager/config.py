@@ -36,6 +36,8 @@ flag                      env                            default
                                                         verification of GCE tokens)
 (none)                    TPU_CC_EVIDENCE_SYNC_INTERVAL_S 300 (native agent: idle-tick
                                                         evidence healer; 0 disables)
+(none)                    TPU_CC_WEBHOOK_REQUIRE_DOCTOR  false (webhook also pins opted-in
+                                                        pods to cc.doctor.ok=true nodes)
 (none)                    TPU_CC_METADATA_HOST           metadata.google.internal
 (none)                    TPU_CC_REQUIRE_IDENTITY        false (verifiers flag identity-less
                                                         evidence even on uniform pools)
@@ -61,6 +63,7 @@ import dataclasses
 import os
 from typing import List, Optional
 
+from tpu_cc_manager import __version__
 from tpu_cc_manager import labels as L
 
 #: Readiness file signalling "initial reconcile done" to the validation
@@ -139,6 +142,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu-cc-manager",
         description="TPU confidential-computing mode manager for Kubernetes",
+    )
+    p.add_argument(
+        "--version", action="version",
+        # native-agent parity (agent.cpp --version; reference Go agent's
+        # urfave/cli -v): the image smoke and operators both probe it
+        version=f"%(prog)s {__version__}",
     )
     p.add_argument(
         "--kubeconfig",

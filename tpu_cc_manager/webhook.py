@@ -71,6 +71,19 @@ def required_mode(pod: dict) -> Optional[str]:
     return value
 
 
+def _require_doctor() -> bool:
+    """TPU_CC_WEBHOOK_REQUIRE_DOCTOR: also pin opted-in pods to nodes
+    whose published doctor verdict is healthy (``cc.doctor.ok=true``).
+    OFF by default: nodes that have never published a verdict (agents
+    predating the doctor, doctor interval disabled) lack the label
+    entirely, and a nodeSelector cannot express 'true-or-absent' — so
+    requiring it on a mixed fleet would strand confidential pods.
+    Turn it on once every agent publishes verdicts."""
+    from tpu_cc_manager.config import _env_bool
+
+    return _env_bool("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", False)
+
+
 def mutate_pod(pod: dict) -> List[dict]:
     """JSON-patch ops steering an opted-in pod onto nodes whose observed
     mode matches. Empty list = no change (not opted in, the selector is
@@ -82,18 +95,35 @@ def mutate_pod(pod: dict) -> List[dict]:
     if mode is None:
         return []
     selector = (pod.get("spec") or {}).get("nodeSelector")
-    if selector is not None and L.CC_MODE_STATE_LABEL in selector:
-        return []
     ops: List[dict] = []
+    need_mode_pin = selector is None or L.CC_MODE_STATE_LABEL not in selector
+    # trust-surface steering: the mode label is a CLAIM; the doctor
+    # verdict is the node's own cross-check of its gate perms,
+    # statefiles, and evidence. With the knob on, confidential pods
+    # only land where both agree — including pods that brought their
+    # OWN matching mode pin (a self-pinned pod must not dodge the
+    # doctor requirement).
+    need_doctor_pin = _require_doctor() and (
+        selector is None or L.DOCTOR_OK_LABEL not in selector
+    )
+    if not (need_mode_pin or need_doctor_pin):
+        return []
     if selector is None:
         ops.append({
             "op": "add", "path": "/spec/nodeSelector", "value": {},
         })
-    ops.append({
-        "op": "add",
-        "path": f"/spec/nodeSelector/{_escape(L.CC_MODE_STATE_LABEL)}",
-        "value": mode,
-    })
+    if need_mode_pin:
+        ops.append({
+            "op": "add",
+            "path": f"/spec/nodeSelector/{_escape(L.CC_MODE_STATE_LABEL)}",
+            "value": mode,
+        })
+    if need_doctor_pin:
+        ops.append({
+            "op": "add",
+            "path": f"/spec/nodeSelector/{_escape(L.DOCTOR_OK_LABEL)}",
+            "value": "true",
+        })
     return ops
 
 
@@ -147,6 +177,17 @@ def validate_pod(pod: dict) -> Tuple[bool, str]:
             f"pod requires cc mode {mode!r} but its nodeSelector pins "
             f"{L.CC_MODE_STATE_LABEL}={pinned!r}"
         )
+    if _require_doctor():
+        doctor_pin = selector.get(L.DOCTOR_OK_LABEL)
+        if doctor_pin is not None and doctor_pin != "true":
+            # same reject-contradiction treatment the mode pin gets: a
+            # pod explicitly pinning itself onto doctor-UNHEALTHY nodes
+            # would defeat the knob's guarantee from inside the spec
+            return False, (
+                f"pod requires cc mode {mode!r} but its nodeSelector "
+                f"pins {L.DOCTOR_OK_LABEL}={doctor_pin!r} while "
+                "TPU_CC_WEBHOOK_REQUIRE_DOCTOR demands 'true'"
+            )
     if _tolerates_flip_taint(pod):
         return False, (
             f"pod requires cc mode {mode!r} but tolerates the flip "
