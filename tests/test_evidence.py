@@ -524,6 +524,114 @@ def test_sync_evidence_refreshes_aging_identity(tmp_path, monkeypatch):
     assert not evidence_in_sync(aging, fresh)
 
 
+def test_key_rotation_tail_is_stale_not_attack(tmp_path, monkeypatch):
+    """Rotating the evidence-key Secret to ``<new>\\n<old>`` must never
+    read as an attack: verifiers accept the rotation-tail signature,
+    the fleet audit buckets still-old signatures as ``stale_key`` (not
+    invalid), the sync healer re-signs with the new primary, and the
+    bucket empties — the operator's cue to drop the old line."""
+    from tpu_cc_manager.evidence import (
+        evidence_key, evidence_keys, signed_with_primary, sync_evidence,
+    )
+
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    kube = FakeKube()
+    kube.add_node(make_node(
+        "rot-node", labels={L.CC_MODE_STATE_LABEL: "off"},
+    ))
+    key_file = tmp_path / "evkey"
+    old_keys_file = tmp_path / "old-keys"
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY_FILE", str(key_file))
+    monkeypatch.setenv("TPU_CC_EVIDENCE_OLD_KEYS_FILE",
+                       str(old_keys_file))
+
+    key_file.write_bytes(b"old-key\n")
+    assert evidence_keys() == (b"old-key",)
+    assert sync_evidence(kube, "rot-node", backend=be)
+    old_doc = json.loads(kube.get_node("rot-node")["metadata"]
+                         ["annotations"][L.EVIDENCE_ANNOTATION])
+    assert signed_with_primary(old_doc)
+
+    # rotate: the new key signs, the old key moves to the verify-only
+    # old-keys entry of the same Secret
+    key_file.write_bytes(b"new-key\n")
+    old_keys_file.write_bytes(b"old-key\n")
+    assert evidence_keys() == (b"new-key", b"old-key")
+    assert evidence_key() == b"new-key"
+    # the fleet's still-old signature verifies (NOT digest_mismatch)...
+    assert verify_evidence(old_doc) == (True, "ok")
+    # ...but is recognisably not fresh, and a key outside the set fails
+    assert not signed_with_primary(old_doc)
+    assert verify_evidence(old_doc, key=b"other") == (
+        False, "digest_mismatch",
+    )
+    # audit: rotation-in-progress, not forgery
+    audit = audit_evidence(kube.list_nodes(None))
+    assert audit["stale_key"] == ["rot-node"]
+    assert audit["invalid"] == []
+
+    # the healer treats tail-signed as out of sync and re-signs
+    assert sync_evidence(kube, "rot-node", backend=be)
+    doc = json.loads(kube.get_node("rot-node")["metadata"]
+                     ["annotations"][L.EVIDENCE_ANNOTATION])
+    assert signed_with_primary(doc)
+    audit = audit_evidence(kube.list_nodes(None))
+    assert audit["stale_key"] == [] and audit["invalid"] == []
+
+    # rotation complete: the old-keys entry goes, everything verifies
+    old_keys_file.unlink()
+    assert evidence_keys() == (b"new-key",)
+    assert verify_evidence(doc) == (True, "ok")
+    assert sync_evidence(kube, "rot-node", backend=be)  # in-sync no-op
+    assert (kube.get_node("rot-node")["metadata"]["annotations"]
+            [L.EVIDENCE_ANNOTATION]) == json.dumps(
+        doc, sort_keys=True, separators=(",", ":"))
+
+
+def test_newline_bearing_primary_key_keeps_whole_file_semantics(
+        tmp_path, monkeypatch):
+    """The primary key file is the WHOLE stripped content — exactly
+    the pre-rotation reader's semantics. A raw-random-bytes Secret
+    containing 0x0A must neither change meaning on upgrade (rejecting
+    the fleet's signatures) nor silently truncate to its first line (a
+    few-byte HMAC key would be offline-brute-forceable). Rotation
+    state lives in the SEPARATE old-keys file, which is line-split."""
+    from tpu_cc_manager.evidence import (
+        evidence_key, evidence_keys, signed_with_primary,
+    )
+
+    be = _sysfs_backend(tmp_path, monkeypatch, n=1)
+    legacy = b"rand\nom-bytes"
+    doc = build_evidence("n1", be, key=legacy)  # signed pre-upgrade
+
+    key_file = tmp_path / "evkey"
+    key_file.write_bytes(legacy)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY_FILE", str(key_file))
+    assert evidence_keys() == (legacy,)
+    assert evidence_key() == legacy
+    assert verify_evidence(doc) == (True, "ok")
+    assert signed_with_primary(doc)  # nothing to re-sign on upgrade
+
+    # retired keys ride the old-keys file; an absent/empty file and
+    # duplicate-of-primary lines are no-ops
+    old_keys = tmp_path / "old-keys"
+    monkeypatch.setenv("TPU_CC_EVIDENCE_OLD_KEYS_FILE", str(old_keys))
+    assert evidence_keys() == (legacy,)
+    old_keys.write_bytes(b"retired-1\n\nretired-2\n")
+    assert evidence_keys() == (legacy, b"retired-1", b"retired-2")
+    retired_doc = build_evidence("n1", be, key=b"retired-1")
+    assert verify_evidence(retired_doc) == (True, "ok")
+    assert not signed_with_primary(retired_doc)
+
+    # old keys WITHOUT a primary must not make this process a keyed
+    # verifier (it would refuse an unkeyed fleet's plain documents)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY_FILE",
+                       str(tmp_path / "absent"))
+    assert evidence_keys() == ()
+    plain = build_evidence("n1", be, key=None)
+    assert verify_evidence(plain) == (True, "ok")
+
+
 def test_sync_evidence_heals_key_rotation_and_keeps_identity_on_blip(
         tmp_path, monkeypatch):
     from tpu_cc_manager.evidence import evidence_in_sync, sync_evidence

@@ -355,9 +355,7 @@ def test_doctor_aware_steering_opt_in(monkeypatch):
     pods to doctor-healthy nodes (cc.doctor.ok=true); off by default so
     mixed fleets (nodes that never published a verdict) aren't
     stranded."""
-    from tpu_cc_manager.webhook import mutate_pod
-
-    from tpu_cc_manager.webhook import validate_pod
+    from tpu_cc_manager.webhook import mutate_pod, validate_pod
 
     monkeypatch.delenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", raising=False)
     pod = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},

@@ -28,6 +28,8 @@ flag                      env                            default
 (none)                    TPU_CC_HOLD_WAIT_S             30 (grace period for holders to leave)
 (none)                    TPU_CC_EVIDENCE                true (per-flip evidence annotation)
 (none)                    TPU_CC_EVIDENCE_KEY[_FILE]     "" (HMAC key; unset = plain sha256)
+(none)                    TPU_CC_EVIDENCE_OLD_KEYS_FILE  "" (retired keys, one per line,
+                                                        verify-only — key rotation)
 (none)                    TPU_CC_IDENTITY                auto | gce | fake | none (platform
                                                         identity attached to evidence)
 (none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)

@@ -298,11 +298,18 @@ def run_doctor(kube=None, node_name: Optional[str] = None,
         else:
             try:
                 from tpu_cc_manager.evidence import (
-                    evidence_mode, verify_evidence,
+                    evidence_keys, evidence_mode, signed_with_primary,
+                    verify_evidence,
                 )
 
                 doc = json.loads(raw)
-                ok, reason = verify_evidence(doc, backend=backend)
+                # one key-file read, one snapshot: the verify below and
+                # the stale-key check further down must judge against
+                # the SAME key set, or a Secret rotating between two
+                # reads yields a self-contradictory verdict
+                ekeys = evidence_keys()
+                ok, reason = verify_evidence(doc, key=ekeys,
+                                             backend=backend)
                 attested = evidence_mode(doc) if ok else None
                 if not ok and reason == "no_key":
                     # signed evidence, no local key: a blind spot for
@@ -325,8 +332,19 @@ def run_doctor(kube=None, node_name: Optional[str] = None,
                            f"evidence attests {attested!r} but label "
                            f"claims {state!r}")
                 else:
-                    _check(checks, "evidence", "ok",
-                           f"verifies ({reason}), attests {attested!r}")
+                    if (len(ekeys) > 1
+                            and not signed_with_primary(doc, key=ekeys)):
+                        # mid-rotation: valid under the tail key only —
+                        # the sync healer will re-sign; warn (not fail)
+                        # so a rotating fleet doesn't read as broken
+                        _check(checks, "evidence", "warn",
+                               "evidence verifies only under a "
+                               "rotation-tail key; re-sign pending "
+                               "(evidence sync will heal this)")
+                    else:
+                        _check(checks, "evidence", "ok",
+                               f"verifies ({reason}), "
+                               f"attests {attested!r}")
                 _identity_check(checks, doc, node_name)
             except Exception as e:
                 _check(checks, "evidence", "fail",

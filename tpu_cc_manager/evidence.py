@@ -62,40 +62,96 @@ _warned_key_paths: set = set()
 #: default for the ``key`` parameters below: "resolve from the
 #: environment for me". Distinct from an explicit ``key=None``, which
 #: means a deliberately KEYLESS posture — a long-lived verifier (the
-#: rollout judge) resolves the key once at startup and must not
+#: rollout judge) resolves the key set once at startup and must not
 #: re-open the key file per poll, nor flip to keyed mid-flight when
 #: the Secret lands
 _RESOLVE_KEY = object()
 
 
-def _resolve(key):
-    return evidence_key() if key is _RESOLVE_KEY else key
+def _resolve_keys(key) -> Tuple[bytes, ...]:
+    """Normalise every accepted ``key=`` spelling to the tuple of
+    accepted verification keys, signing key first: the resolve sentinel
+    reads the environment, ``None`` is the deliberately keyless
+    posture, a single ``bytes`` key is itself, and a list/tuple (a
+    rotation set a long-lived verifier resolved once) passes through."""
+    if key is _RESOLVE_KEY:
+        return evidence_keys()
+    if key is None:
+        return ()
+    if isinstance(key, (list, tuple)):
+        return tuple(k for k in key if k)
+    return (key,)
+
+
+def _read_key_file(path: str) -> Optional[bytes]:
+    """Raw stripped bytes of a key file; None when absent/unreadable.
+    A missing file is SILENT by design: every manifest sets the env
+    vars while the Secret entries themselves are optional, so the
+    supported keyless posture would otherwise warn on every reconcile
+    of every node."""
+    try:
+        with open(path, "rb") as f:
+            return f.read().strip() or None
+    except FileNotFoundError:
+        return None  # optional Secret not deployed
+    except OSError as e:
+        if path not in _warned_key_paths:
+            _warned_key_paths.add(path)
+            log.warning("cannot read evidence key file %s: %s", path, e)
+        return None
+
+
+def evidence_keys() -> Tuple[bytes, ...]:
+    """All accepted evidence keys, SIGNING key first.
+
+    The PRIMARY key — TPU_CC_EVIDENCE_KEY (inline) or the WHOLE
+    stripped content of TPU_CC_EVIDENCE_KEY_FILE (a mounted Secret
+    entry; may be arbitrary bytes, newlines included) — signs every
+    new document. TPU_CC_EVIDENCE_OLD_KEYS_FILE (optional; in the
+    shipped manifests an ``old-keys`` entry in the SAME Secret) lists
+    retired keys one per line, accepted for verification only.
+
+    That split is the key-ROTATION posture: move the old key into
+    ``old-keys``, put the new key in ``evidence-key``, let agents
+    re-sign (per reconcile, plus the idle-tick sync healer), then
+    delete ``old-keys`` once the fleet audit's ``stale_key`` bucket
+    is empty. Without the verify-only tail, rotating the Secret would
+    make every verifier reject the fleet's still-old signatures as
+    ``digest_mismatch`` — an attack-shaped verdict for a routine
+    operation. Two files (not lines of one file) so the primary keeps
+    its legacy whole-file semantics: a raw-random key containing a
+    newline neither changes meaning on upgrade nor silently truncates.
+    Retired keys in ``old-keys`` must therefore be newline-free
+    (base64/hex keys are; raw-binary retired keys should be re-cut)."""
+    primary_key = evidence_key()
+    if primary_key is None:
+        # keyless posture: retired keys alone must not make this
+        # process a "keyed verifier" — that would refuse the plain
+        # documents an unkeyed fleet is legitimately publishing
+        return ()
+    keys = (primary_key,)
+    old_path = os.environ.get("TPU_CC_EVIDENCE_OLD_KEYS_FILE", "")
+    if old_path:
+        raw = _read_key_file(old_path)
+        if raw:
+            for line in raw.splitlines():
+                line = line.strip()
+                if line and line not in keys:
+                    keys = keys + (line,)
+    return keys
 
 
 def evidence_key() -> Optional[bytes]:
-    """Node evidence key: TPU_CC_EVIDENCE_KEY (inline) or
-    TPU_CC_EVIDENCE_KEY_FILE (path, e.g. a mounted Secret). A missing
-    file is SILENT by design: every manifest sets the env var while the
-    Secret itself is optional, so the supported keyless posture would
-    otherwise warn on every reconcile of every node."""
+    """The PRIMARY (signing) evidence key, or None in the keyless
+    posture. Verifiers should resolve :func:`evidence_keys` instead so
+    rotation-tail keys stay accepted. Reads only the primary source —
+    the agent's throttled idle tick calls this to detect posture flips
+    and must not pay an old-keys read whose result can't matter."""
     inline = os.environ.get("TPU_CC_EVIDENCE_KEY", "")
     if inline:
         return inline.encode()
     path = os.environ.get("TPU_CC_EVIDENCE_KEY_FILE", "")
-    if path:
-        try:
-            with open(path, "rb") as f:
-                return f.read().strip() or None
-        except FileNotFoundError:
-            return None  # optional Secret not deployed: keyless posture
-        except OSError as e:
-            if path not in _warned_key_paths:
-                _warned_key_paths.add(path)
-                log.warning(
-                    "cannot read evidence key file %s: %s", path, e
-                )
-            return None
-    return None
+    return _read_key_file(path) if path else None
 
 
 def _canonical(doc: dict) -> bytes:
@@ -170,7 +226,8 @@ def build_evidence(node_name: str, backend,
     digested body, binding the platform identity to the device
     attestation: a pool-key holder on node A cannot mint a document
     carrying node B's identity."""
-    key = _resolve(key)
+    keys = _resolve_keys(key)
+    key = keys[0] if keys else None  # always SIGN with the primary
     store = getattr(backend, "store", None)
     chips, err = backend.find_tpus()
     if err:
@@ -266,25 +323,33 @@ def verify_evidence(doc: dict, *, key=_RESOLVE_KEY,
     """Check a document's integrity, and — when ``backend`` is given —
     re-derive the statefile digest from disk so post-hoc statefile
     tampering is detected. Returns (ok, reason). ``key`` defaults to
-    :func:`evidence_key`; ``None`` means explicitly keyless."""
-    key = _resolve(key)
+    :func:`evidence_keys`; ``None`` means explicitly keyless. A signed
+    document verifies under ANY accepted key — the rotation tail keeps
+    old-key signatures valid while agents re-sign."""
+    keys = _resolve_keys(key)
     if (not isinstance(doc, dict) or
             not isinstance(doc.get("digest"), str)):
         return False, "malformed"
     body = {k: v for k, v in doc.items() if k != "digest"}
     claimed = doc["digest"]
-    if claimed.startswith("hmac-sha256:") and key is None:
+    if claimed.startswith("hmac-sha256:") and not keys:
         return False, "no_key"
-    if key is not None and not claimed.startswith("hmac-sha256:"):
+    if keys and not claimed.startswith("hmac-sha256:"):
         # no downgrade: a keyed verifier rejects unsigned documents —
         # otherwise a forger without the key could bypass the HMAC by
         # publishing a plain-sha256 doc
         return False, "unsigned"
-    recomputed = _digest(
-        _canonical(body),
-        key if claimed.startswith("hmac-sha256:") else None,
-    )
-    if not hmac_mod.compare_digest(recomputed, claimed):
+    payload = _canonical(body)
+    if claimed.startswith("hmac-sha256:"):
+        # any accepted key; every candidate is compared (no early
+        # break) so timing reveals nothing about WHICH key matched
+        matched = False
+        for k in keys:
+            if hmac_mod.compare_digest(_digest(payload, k), claimed):
+                matched = True
+        if not matched:
+            return False, "digest_mismatch"
+    elif not hmac_mod.compare_digest(_digest(payload, None), claimed):
         return False, "digest_mismatch"
     if backend is not None:
         store = getattr(backend, "store", None)
@@ -293,6 +358,23 @@ def verify_evidence(doc: dict, *, key=_RESOLVE_KEY,
         if actual != doc.get("statefile_digest"):
             return False, "statefile_mismatch"
     return True, "ok"
+
+
+def signed_with_primary(doc: dict, key=_RESOLVE_KEY) -> bool:
+    """Is the document's digest exactly what a fresh signing would
+    produce — HMAC under the PRIMARY key (or plain sha256 in the
+    keyless posture)? A document that merely verifies under a
+    rotation-tail key is NOT primary-signed: the sync healer treats it
+    as out of sync (re-sign now) and the fleet audit buckets it as
+    ``stale_key`` (rotation in progress) — that pair is what lets an
+    operator drop the old key line the moment the bucket empties."""
+    if (not isinstance(doc, dict) or
+            not isinstance(doc.get("digest"), str)):
+        return False
+    keys = _resolve_keys(key)
+    body = {k: v for k, v in doc.items() if k != "digest"}
+    expect = _digest(_canonical(body), keys[0] if keys else None)
+    return hmac_mod.compare_digest(expect, doc["digest"])
 
 
 def judge_evidence(doc: dict, node_name: str,
@@ -315,7 +397,7 @@ def judge_evidence(doc: dict, node_name: str,
       attack-shaped; ``attested_mode`` is None because nothing the doc
       says is worth reading.
     """
-    key = _resolve(key)
+    key = _resolve_keys(key)
     if not isinstance(doc, dict):
         return "malformed", None
     ok, reason = verify_evidence(doc, key=key)
@@ -372,9 +454,13 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
 
     Buckets beyond the original three: ``unsigned`` (plain doc under a
     keyed auditor — the agent DaemonSet is missing the key Secret, a
-    deployment fix, reported actionably by fleet_problems) and
+    deployment fix, reported actionably by fleet_problems),
     ``unverifiable`` (signed doc, unkeyed auditor — the expected state
-    mid-enablement, metric-only). Forensic findings outrank both: a
+    mid-enablement, metric-only), and ``stale_key`` (verifies, but
+    only under a rotation-tail key — the node has not re-signed since
+    the Secret rotated; the old key line may be dropped once this
+    bucket is empty, metric-only because the sync healer empties it on
+    its own). Forensic findings outrank both: a
     replayed or label-contradicting document lands in invalid/mismatch
     regardless of key posture, because node binding and mode claims
     need no key to read.
@@ -390,10 +476,11 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     from tpu_cc_manager import labels as L
     from tpu_cc_manager.identity import judge_identity, require_identity
 
-    key = _resolve(key)
+    key = _resolve_keys(key)
     missing: List[str] = []
     unsigned: List[str] = []
     unverifiable: List[str] = []
+    stale_key: List[str] = []
     invalid: List[str] = []
     mismatch: List[str] = []
     ident_missing: List[str] = []
@@ -427,6 +514,9 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
             unsigned.append(name)
         elif verdict == "no_key":
             unverifiable.append(name)
+        elif (verdict == "ok" and len(key) > 1
+                and not signed_with_primary(doc, key=key)):
+            stale_key.append(name)
         # identity is judged for every digest-plausible document, even
         # ones already flagged above — a mismatched label AND a foreign
         # identity are two findings, not one
@@ -456,6 +546,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
         "missing": sorted(missing),
         "unsigned": sorted(unsigned),
         "unverifiable": sorted(unverifiable),
+        "stale_key": sorted(stale_key),
         "invalid": sorted(invalid),
         "label_device_mismatch": sorted(mismatch),
         "identity_missing": sorted(ident_missing),
@@ -463,14 +554,17 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     }
 
 
-def evidence_in_sync(current: Optional[dict], fresh: dict) -> bool:
+def evidence_in_sync(current: Optional[dict], fresh: dict,
+                     key=_RESOLVE_KEY) -> bool:
     """Is the on-cluster document still an honest representation of
     this node's state and signing posture? Timestamps always differ, so
     the comparison is on what verifiers actually judge:
 
-    - the digest verifies under the CURRENTLY resolved key (covers the
-      unsigned->signed posture flip, a key ROTATION, and tampering —
-      not just the scheme name),
+    - the digest is exactly what signing would produce TODAY — HMAC
+      under the current PRIMARY key (covers the unsigned->signed
+      posture flip, a key ROTATION where the old signature still
+      *verifies* via the rotation tail but must be refreshed so the
+      old key can eventually be dropped, and tampering),
     - the statefile digest and per-device modes (device truth),
     - identity presence, and the embedded token's freshness
       (identity.REPUBLISH_MARGIN of lifetime remaining — the same
@@ -478,9 +572,9 @@ def evidence_in_sync(current: Optional[dict], fresh: dict) -> bool:
     """
     if not isinstance(current, dict):
         return False
-    # digest under the current key: an old-key or tampered signature is
-    # out of sync no matter how alike the documents look
-    if not verify_evidence(current)[0]:
+    # primary-key signature: an old-key (rotation-tail) or tampered
+    # signature is out of sync no matter how alike the documents look
+    if not signed_with_primary(current, key=key):
         return False
     if current.get("statefile_digest") != fresh.get("statefile_digest"):
         return False
@@ -554,8 +648,13 @@ def sync_evidence(kube, node_name: str, backend=None) -> bool:
                 current = json.loads(raw)
             except ValueError:
                 current = None
-        fresh = build_evidence(node_name, backend)
-        if evidence_in_sync(current, fresh):
+        # one key-file read, one snapshot: the build (signs with the
+        # primary) and the in-sync judgement must see the SAME key
+        # set, or a rotation landing between two reads would publish
+        # a document signed with the just-retired key
+        keys = evidence_keys()
+        fresh = build_evidence(node_name, backend, key=keys or None)
+        if evidence_in_sync(current, fresh, key=keys or None):
             return True
         log.info("evidence out of sync (posture/device/identity); "
                  "republishing")

@@ -73,6 +73,9 @@ def fleet_problems(report: dict) -> List[str]:
     # 'unverifiable' (signed docs, unkeyed auditor) is deliberately NOT
     # a problem: it is the expected state mid-enablement (agents keyed
     # first). It stays visible via the evidence_issues metric.
+    # 'stale_key' (verifies only under a rotation-tail key) likewise:
+    # the sync healer re-signs on its own cadence; the bucket/metric
+    # exists so the operator knows when the old key line can go.
     if audit.get("identity_mismatch"):
         # the forged-evidence drill: a document whose platform-identity
         # token speaks for another node (or fails verification) means
@@ -163,9 +166,9 @@ class FleetMetrics:
         self.incoherent_slices.set(len(report["incoherent_slices"]))
         self.half_flipped_slices.set(len(report["half_flipped_slices"]))
         audit = report.get("evidence_audit", {})
-        for issue in ("missing", "unsigned", "unverifiable", "invalid",
-                      "label_device_mismatch", "identity_missing",
-                      "identity_mismatch"):
+        for issue in ("missing", "unsigned", "unverifiable", "stale_key",
+                      "invalid", "label_device_mismatch",
+                      "identity_missing", "identity_mismatch"):
             self.evidence_issues.set(len(audit.get(issue, [])), issue)
         self.doctor_failing.set(
             len(report.get("doctor", {}).get("failing", []))

@@ -231,11 +231,13 @@ class Rollout:
         #: brick a rollout.
         self.verify_evidence = verify_evidence
         if verify_evidence:
-            from tpu_cc_manager.evidence import evidence_key
+            from tpu_cc_manager.evidence import evidence_keys
 
-            #: resolved once: the key is static for the process, and the
-            #: judge tick must not re-read the key file every poll
-            self._evidence_key = evidence_key()
+            #: resolved once: the key set is static for the process, and
+            #: the judge tick must not re-read the key file every poll.
+            #: The full set (primary + rotation tail), so a mid-rotation
+            #: fleet's old-key evidence still counts as converged
+            self._evidence_key = evidence_keys() or None
             self._warned_no_key = False
             self._warned_unsigned = False
         #: member -> why its evidence was rejected, for actionable
