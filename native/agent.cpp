@@ -50,6 +50,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -89,6 +90,44 @@ int g_doctor_interval_s = 300; /* TPU_CC_DOCTOR_INTERVAL_S */
 std::string g_evidence_sync_cmd =
     "python3 -m tpu_cc_manager.evidence --sync";
 int g_evidence_sync_interval_s = 300;
+
+/* Key-posture watch: kubelet rotates a mounted Secret in place (the
+ * ..data symlink swap), so a stat-signature change on the evidence
+ * key files means the signing posture changed NOW — the idle tick
+ * then runs the evidence sync immediately instead of waiting out the
+ * interval. Without this, a freshly keyed or rotated fleet reads as
+ * unsigned/stale_key to keyed verifiers for up to
+ * TPU_CC_EVIDENCE_SYNC_INTERVAL_S (default 300 s) per node. Two
+ * stat() calls per idle second are noise. */
+static unsigned long long key_posture_sig() {
+  static const char *kKeyEnvs[2] = {"TPU_CC_EVIDENCE_KEY_FILE",
+                                    "TPU_CC_EVIDENCE_OLD_KEYS_FILE"};
+  unsigned long long sig = 1469598103934665603ULL; /* FNV-1a */
+  for (int i = 0; i < 2; ++i) {
+    const char *p = getenv(kKeyEnvs[i]);
+    unsigned long long v;
+    if (!p || !*p) {
+      v = 0; /* env unset: constant contribution */
+    } else {
+      struct stat st;
+      if (stat(p, &st) != 0) {
+        v = 0x9e3779b97f4a7c15ULL; /* env set, file absent */
+      } else {
+        /* nanosecond mtime: a same-second in-place rewrite to a
+         * same-length key (fixed-size HMAC keys are the norm) must
+         * still change the signature */
+        v = ((unsigned long long)st.st_mtime << 20) ^
+            (unsigned long long)st.st_mtim.tv_nsec ^
+            (unsigned long long)st.st_size ^
+            ((unsigned long long)st.st_ino << 1);
+        if (v == 0) v = 1; /* never collide with the unset bucket */
+      }
+    }
+    sig = (sig ^ v) * 1099511628211ULL;
+    sig = (sig ^ (unsigned long long)(i + 1)) * 1099511628211ULL;
+  }
+  return sig;
+}
 std::string g_token_file; /* BEARER_TOKEN_FILE; re-read per request —
                            * bound SA tokens rotate on disk (~1h) and a
                            * cached copy would 401 a long-lived daemon */
@@ -1038,6 +1077,7 @@ int main(int argc, char **argv) {
    * may run — between reconciles by construction. */
   time_t doctor_due = 0; /* first idle tick publishes */
   time_t evidence_sync_due = 0;
+  unsigned long long key_sig = key_posture_sig();
   while (!g_stop.load()) {
     std::string value;
     SyncableModeConfig::GetResult r = config.GetFor(&value, 1000);
@@ -1046,6 +1086,15 @@ int main(int argc, char **argv) {
       if (g_doctor_interval_s > 0 && time(nullptr) >= doctor_due) {
         doctor_due = time(nullptr) + g_doctor_interval_s;
         run_doctor();
+      }
+      if (g_evidence_sync_interval_s > 0) {
+        unsigned long long s = key_posture_sig();
+        if (s != key_sig) {
+          key_sig = s;
+          evidence_sync_due = 0; /* posture changed: sync NOW */
+          logf("INFO",
+               "evidence key posture changed on disk; syncing now");
+        }
       }
       if (g_evidence_sync_interval_s > 0 &&
           time(nullptr) >= evidence_sync_due) {

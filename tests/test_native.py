@@ -974,3 +974,93 @@ users: [{{name: u, user: {{}}}}]
             proc.wait(timeout=5)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_cpp_agent_key_posture_change_syncs_immediately(
+        native_build, apiserver, tmp_path):
+    """The native agent's key-posture watch: the evidence-key Secret
+    landing (kubelet updates the mounted file in place) must trigger
+    the evidence sync NOW, not after the full
+    TPU_CC_EVIDENCE_SYNC_INTERVAL_S (the residual 300 s window the
+    round-3 security doc recorded). Here the interval is set far past
+    the test horizon, so a prompt re-sign can only come from the
+    stat-signature watch."""
+    import json
+
+    from tpu_cc_manager.evidence import verify_evidence
+
+    out_file = tmp_path / "calls.txt"
+    sysfs, dev = make_accel_tree(tmp_path)
+    kubeconfig = tmp_path / "kubeconfig.yaml"
+    kubeconfig.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: t
+contexts: [{{name: t, context: {{cluster: c, user: u}}}}]
+clusters: [{{name: c, cluster: {{server: "http://127.0.0.1:{apiserver.port}"}}}}]
+users: [{{name: u, user: {{}}}}]
+""")
+    apiserver.store.add_node(
+        make_node("key-watch-node", labels={L.CC_MODE_LABEL: "on"})
+    )
+    key_file = tmp_path / "evidence-key"  # absent at start
+    env = dict(os.environ)
+    env.update(
+        NODE_NAME="key-watch-node",
+        KUBE_API_HOST="127.0.0.1",
+        KUBE_API_PORT=str(apiserver.port),
+        KUBECONFIG=str(kubeconfig),
+        PYTHONPATH=REPO,
+        TPU_SYSFS_ROOT=sysfs,
+        TPU_DEV_ROOT=dev,
+        TPU_CC_STATE_DIR=str(tmp_path / "state"),
+        TPU_CC_DEVICE_GATING="none",
+        TPU_CC_IDENTITY="none",
+        TPU_CC_EVIDENCE_KEY_FILE=str(key_file),
+        TPU_CC_ENGINE_CMD=f"echo %s >> {out_file}",  # publishes nothing
+        # far beyond the poll deadline: only the posture watch can
+        # make the second sync happen in time
+        TPU_CC_EVIDENCE_SYNC_INTERVAL_S="3600",
+        TPU_CC_DOCTOR_INTERVAL_S="0",
+        TPU_CC_WATCH_TIMEOUT_S="2",
+    )
+    proc = subprocess.Popen(
+        [os.path.join(native_build, "tpu-cc-manager-agent")],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        def evidence(pred, deadline_s):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                raw = (apiserver.store.get_node("key-watch-node")
+                       ["metadata"].get("annotations", {})
+                       .get(L.EVIDENCE_ANNOTATION))
+                if raw:
+                    doc = json.loads(raw)
+                    if pred(doc):
+                        return doc
+                time.sleep(0.2)
+            return None
+
+        # startup sync (due=0) publishes a plain-sha256 document
+        doc = evidence(
+            lambda d: d["digest"].startswith("sha256:"), 20,
+        )
+        assert doc is not None, "startup evidence sync never published"
+
+        # the Secret lands: the posture watch must re-sign promptly,
+        # 3600 s before the interval would
+        key_file.write_bytes(b"pool-key")
+        doc = evidence(
+            lambda d: d["digest"].startswith("hmac-sha256:"), 15,
+        )
+        assert doc is not None, (
+            "evidence not re-signed after key file appeared"
+        )
+        assert verify_evidence(doc, key=b"pool-key") == (True, "ok")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
