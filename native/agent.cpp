@@ -1101,8 +1101,17 @@ int main(int argc, char **argv) {
         evidence_sync_due = time(nullptr) + g_evidence_sync_interval_s;
         int rc = run_bounded(g_evidence_sync_cmd, g_doctor_timeout_s,
                              "evidence sync");
-        if (rc != 0)
-          logf("WARN", "evidence sync failed (rc=%d)", rc);
+        if (rc != 0) {
+          /* retry a transient failure soon, not a full interval out —
+           * a posture-change sync that hit an apiserver blip would
+           * otherwise leave stale/unsigned evidence up for the whole
+           * window the posture watch exists to close */
+          int retry = g_evidence_sync_interval_s < 30
+                          ? g_evidence_sync_interval_s : 30;
+          evidence_sync_due = time(nullptr) + retry;
+          logf("WARN", "evidence sync failed (rc=%d); retrying in %ds",
+               rc, retry);
+        }
       }
       continue;
     }

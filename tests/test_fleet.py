@@ -196,9 +196,17 @@ def test_doctor_aggregation_and_policy_summaries():
         "name": "prod", "mode": "on", "phase": "Converged",
         "nodes": 4, "converged": 4, "message": "all good",
     }]
+    # the REQUIRE_DOCTOR preflight: silent nodes are named, and the
+    # gauge lets an operator alert on "enforce only at zero"
+    assert doctor["unreported"] == ["n-silent"]
+    rendered = ctrl.metrics.render().splitlines()
     assert any(
         "tpu_cc_fleet_doctor_failing_nodes 2" in line
-        for line in ctrl.metrics.render().splitlines()
+        for line in rendered
+    )
+    assert any(
+        "tpu_cc_fleet_doctor_unreported_nodes 1" in line
+        for line in rendered
     )
 
 

@@ -393,3 +393,68 @@ def test_doctor_aware_steering_opt_in(monkeypatch):
     # ...but only while the knob is on
     monkeypatch.delenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR")
     assert validate_pod(pod4)[0] is True
+
+
+def test_doctor_steering_warn_mode_rehearses_without_enforcing(
+        monkeypatch):
+    """TPU_CC_WEBHOOK_REQUIRE_DOCTOR=warn is the enablement rehearsal:
+    admission behaves exactly as off (no doctor pin injected, no
+    denial), but every opted-in review response carries AdmissionReview
+    ``warnings`` describing what enforce would have done — kubectl
+    shows them to the submitter, so an operator can run warn until the
+    fleet is quiet, then flip to true without stranding pods."""
+    from tpu_cc_manager.webhook import (
+        mutate_pod, review_response, validate_pod,
+    )
+
+    monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "warn")
+    pod = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+           "spec": {}}
+    # no pin injected (enforcement unchanged from off)...
+    assert not any("doctor" in o["path"] for o in mutate_pod(pod))
+    # ...but both endpoints carry the would-pin warning
+    for kind in ("mutate", "validate"):
+        out = review_response(
+            {"request": {"uid": "u1", "object": pod}}, kind,
+        )
+        assert out["response"]["allowed"] is True
+        warns = out["response"].get("warnings")
+        assert warns and any("doctor.unreported" in w for w in warns)
+        # the API server truncates warnings >256 chars — exactly where
+        # the actionable tail would live
+        assert all(len(w) <= 256 for w in warns), warns
+
+    # a contradictory pin is ALLOWED in warn mode, with a would-reject
+    # warning (enforce mode denies it)
+    pod2 = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+            "spec": {"nodeSelector": {L.DOCTOR_OK_LABEL: "false"}}}
+    assert validate_pod(pod2)[0] is True
+    out = review_response(
+        {"request": {"uid": "u2", "object": pod2}}, "validate",
+    )
+    assert out["response"]["allowed"] is True
+    assert "REJECT" in out["response"]["warnings"][0]
+
+    # a correct pin or a non-opted-in pod warns about nothing
+    pod3 = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+            "spec": {"nodeSelector": {L.DOCTOR_OK_LABEL: "true"}}}
+    out = review_response(
+        {"request": {"uid": "u3", "object": pod3}}, "mutate",
+    )
+    assert "warnings" not in out["response"]
+    out = review_response(
+        {"request": {"uid": "u4", "object": {"metadata": {}, "spec": {}}}},
+        "mutate",
+    )
+    assert "warnings" not in out["response"]
+
+    # enforce mode is unaffected by the warn plumbing; 'enforce' is an
+    # accepted synonym of 'true'
+    for value in ("true", "enforce"):
+        monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", value)
+        assert any("doctor" in o["path"] for o in mutate_pod(pod))
+        assert validate_pod(pod2)[0] is False
+    # a typo reads as OFF (and logs), never as silent enforcement
+    monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "warm")
+    assert not any("doctor" in o["path"] for o in mutate_pod(pod))
+    assert validate_pod(pod2)[0] is True

@@ -146,6 +146,12 @@ class FleetMetrics:
             "tpu_cc_fleet_doctor_failing_nodes",
             "Nodes whose published doctor verdict has failing checks",
         )
+        self.doctor_unreported = Gauge(
+            "tpu_cc_fleet_doctor_unreported_nodes",
+            "Nodes publishing no doctor verdict at all (the "
+            "TPU_CC_WEBHOOK_REQUIRE_DOCTOR preflight: enforce only "
+            "at zero)",
+        )
         self.scans_total = Counter(
             "tpu_cc_fleet_scans_total", "Fleet scans, by outcome", ("outcome",)
         )
@@ -173,13 +179,17 @@ class FleetMetrics:
         self.doctor_failing.set(
             len(report.get("doctor", {}).get("failing", []))
         )
+        self.doctor_unreported.set(
+            len(report.get("doctor", {}).get("unreported", []))
+        )
 
     def render(self) -> str:
         lines: List[str] = []
         for m in (
             self.nodes, self.nodes_by_mode, self.needs_flip, self.failed,
             self.incoherent_slices, self.half_flipped_slices,
-            self.evidence_issues, self.doctor_failing, self.scans_total,
+            self.evidence_issues, self.doctor_failing,
+            self.doctor_unreported, self.scans_total,
             self.scan_duration,
         ):
             lines.extend(m.render())
@@ -266,18 +276,26 @@ class FleetController:
     @staticmethod
     def _aggregate_doctor(nodes: List[dict]) -> dict:
         """Fleet view of published doctor verdicts (doctor --publish):
-        which nodes report failing trust-surface checks. A malformed
-        annotation counts as failing — a node that can't even publish a
-        parseable verdict deserves a look, not silence."""
+        which nodes report failing trust-surface checks, and which
+        report NOTHING. A malformed annotation counts as failing — a
+        node that can't even publish a parseable verdict deserves a
+        look, not silence. ``unreported`` (no verdict at all: agent
+        predates the doctor, interval disabled, or publication broken)
+        is the preflight for TPU_CC_WEBHOOK_REQUIRE_DOCTOR — enforcing
+        the doctor pin while any node is unreported strands
+        confidential pods off those nodes; enable once this list is
+        empty (rehearse with the webhook's warn mode)."""
         failing = []
+        unreported = []
         reported = 0
         for n in nodes:
+            name = n["metadata"].get("name", "?")
             raw = (n["metadata"].get("annotations") or {}).get(
                 L.DOCTOR_ANNOTATION
             )
             if not raw:
+                unreported.append(name)
                 continue
-            name = n["metadata"].get("name", "?")
             reported += 1
             try:
                 verdict = json.loads(raw)
@@ -291,6 +309,7 @@ class FleetController:
                 failing.append({"node": name, "fail": ["unparseable"],
                                 "at": None})
         return {"reported": reported,
+                "unreported": sorted(unreported),
                 "failing": sorted(failing, key=lambda d: d["node"])}
 
     def _election_summaries(self) -> dict:

@@ -71,17 +71,48 @@ def required_mode(pod: dict) -> Optional[str]:
     return value
 
 
-def _require_doctor() -> bool:
-    """TPU_CC_WEBHOOK_REQUIRE_DOCTOR: also pin opted-in pods to nodes
-    whose published doctor verdict is healthy (``cc.doctor.ok=true``).
+def _doctor_mode() -> str:
+    """TPU_CC_WEBHOOK_REQUIRE_DOCTOR — ``off`` | ``warn`` |
+    ``enforce``: also pin opted-in pods to nodes whose published
+    doctor verdict is healthy (``cc.doctor.ok=true``).
+
     OFF by default: nodes that have never published a verdict (agents
     predating the doctor, doctor interval disabled) lack the label
     entirely, and a nodeSelector cannot express 'true-or-absent' — so
     requiring it on a mixed fleet would strand confidential pods.
-    Turn it on once every agent publishes verdicts."""
-    from tpu_cc_manager.config import _env_bool
 
-    return _env_bool("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", False)
+    ``warn`` is the enablement rehearsal: admission is unchanged, but
+    every response carries AdmissionReview ``warnings`` describing
+    what enforce mode would have done (kubectl surfaces them to the
+    submitter). Run warn until the warnings — and the fleet report's
+    ``doctor.unreported`` list — are quiet, then set ``true``."""
+    import os
+
+    raw = os.environ.get("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "")
+    value = raw.strip().lower()
+    if value == "warn":
+        return "warn"
+    if value in ("1", "true", "yes", "on", "enforce"):
+        return "enforce"
+    if value not in ("", "0", "false", "no", "off"):
+        # a typo ('warm', 'ture') must not silently disable a security
+        # knob the operator believes is on — warn once per value
+        if value not in _warned_doctor_values:
+            _warned_doctor_values.add(value)
+            log.warning(
+                "TPU_CC_WEBHOOK_REQUIRE_DOCTOR=%r not recognised "
+                "(off|warn|true/enforce); treating as OFF", raw,
+            )
+    return "off"
+
+
+#: unrecognised TPU_CC_WEBHOOK_REQUIRE_DOCTOR values already warned
+#: about (once per process, not per admission review)
+_warned_doctor_values: set = set()
+
+
+def _require_doctor() -> bool:
+    return _doctor_mode() == "enforce"
 
 
 def mutate_pod(pod: dict) -> List[dict]:
@@ -125,6 +156,43 @@ def mutate_pod(pod: dict) -> List[dict]:
             "value": "true",
         })
     return ops
+
+
+def doctor_warnings(pod: dict) -> List[str]:
+    """Warn-mode preview (``TPU_CC_WEBHOOK_REQUIRE_DOCTOR=warn``):
+    what WOULD enforce mode have done to this pod? Returned as
+    AdmissionReview ``warnings`` — admission itself is unchanged, the
+    submitter just sees the rehearsal output in kubectl. Empty unless
+    warn mode is on and the pod opts in."""
+    if _doctor_mode() != "warn":
+        return []
+    try:
+        mode = required_mode(pod)
+    except ValueError:
+        return []  # invalid opt-in is denied regardless; no preview
+    if mode is None:
+        return []
+    selector = (pod.get("spec") or {}).get("nodeSelector") or {}
+    pin = selector.get(L.DOCTOR_OK_LABEL)
+    if pin is None:
+        # two short warnings, each under Kubernetes' 256-char
+        # per-warning cap (the API server truncates longer ones —
+        # which would cut exactly the actionable tail)
+        return [
+            f"TPU_CC_WEBHOOK_REQUIRE_DOCTOR=warn: enforce would pin "
+            f"this pod to {L.DOCTOR_OK_LABEL}=true "
+            "(doctor-healthy nodes only)",
+            "preflight: enforce only when the fleet report's "
+            "doctor.unreported list is empty — unverdicted nodes "
+            "lack the label and would strand this pod",
+        ]
+    if pin != "true":
+        return [
+            f"TPU_CC_WEBHOOK_REQUIRE_DOCTOR=warn: this pod pins "
+            f"{L.DOCTOR_OK_LABEL}={pin!r}; enforce mode would REJECT "
+            "it (the pin contradicts the doctor-health requirement)"
+        ]
+    return []
 
 
 def _tolerates_flip_taint(pod: dict) -> bool:
@@ -228,6 +296,9 @@ def review_response(review: dict, kind: str) -> dict:
             resp["allowed"] = allowed
             if not allowed:
                 resp["status"] = {"message": reason, "code": 403}
+        warns = doctor_warnings(pod)
+        if warns:
+            resp["warnings"] = warns
     return {
         "apiVersion": "admission.k8s.io/v1",
         "kind": "AdmissionReview",
