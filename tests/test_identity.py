@@ -214,6 +214,80 @@ def test_stolen_pool_key_without_identity_is_flagged(tmp_path,
     assert any("identity" in p and "node-b" in p for p in problems)
 
 
+def test_uniform_identity_outage_detected_across_scans(tmp_path,
+                                                       monkeypatch):
+    """A fleet-wide metadata outage eventually strips EVERY token
+    (tokens age out; the healers republish token-less docs rather than
+    keep expired ones). Within one scan that uniform absence is
+    indistinguishable from a never-on-GCE pool — so the fleet
+    controller carries the tell ACROSS scans: once any scan saw an
+    identity-bearing document, a later all-missing pool alarms instead
+    of fading back to silence."""
+    from tpu_cc_manager.fleet import FleetController
+
+    monkeypatch.setenv("TPU_CC_IDENTITY_KEY", KEY.decode())
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool")
+    be = _backend(tmp_path, monkeypatch, mode="on")
+    with_id = build_evidence(
+        "node-a", be, key=b"pool",
+        identity_provider=FakePlatformIdentity(KEY),
+    )
+    without_id = build_evidence("node-a", be, key=b"pool",
+                                identity_provider=None)
+
+    kube = FakeKube()
+    kube.add_node(_node_with("node-a", "on", with_id))
+    ctrl = FleetController(kube, port=0)
+    r1 = ctrl.scan_once()
+    assert r1["evidence_audit"]["identity_missing"] == []
+    assert r1["evidence_audit"]["identity_seen"] is True
+
+    # the outage: every doc on the pool loses its token
+    kube.set_node_annotations(
+        "node-a", {L.EVIDENCE_ANNOTATION: json.dumps(without_id)},
+    )
+    r2 = ctrl.scan_once()
+    assert r2["evidence_audit"]["identity_missing"] == ["node-a"]
+    # ...and stays flagged on every later scan, not just the first
+    assert ctrl.scan_once()["evidence_audit"]["identity_missing"] == \
+        ["node-a"]
+
+    # a controller that NEVER saw identity (restart mid-outage, or an
+    # off-GCE pool) keeps the old silence — the sticky tell is
+    # process-local by design
+    fresh = FleetController(kube, port=0)
+    assert fresh.scan_once()["evidence_audit"]["identity_missing"] == []
+    # the pure function's default is unchanged for direct callers
+    audit = audit_evidence([_node_with("node-a", "on", without_id)],
+                           key=b"pool")
+    assert audit["identity_missing"] == []
+
+    # the latch arms ONLY on a VERIFIED token: the evidence annotation
+    # is hostile input, and a single garbage/forged token must not
+    # lock a never-on-GCE pool into permanent alarms (it still trips
+    # the per-scan mixed-pool heuristic while the doc is present)
+    class GarbageProvider:
+        provider = "fake"
+
+        def token(self, node_name, audience=None):
+            return "eyJub3BlIjo1fQ.garbage.token"
+
+    hostile = build_evidence("node-a", be, key=b"pool",
+                             identity_provider=GarbageProvider())
+    kube.set_node_annotations(
+        "node-a", {L.EVIDENCE_ANNOTATION: json.dumps(hostile)},
+    )
+    ctrl3 = FleetController(kube, port=0)
+    r = ctrl3.scan_once()
+    assert r["evidence_audit"]["identity_mismatch"] == ["node-a"]
+    assert r["evidence_audit"]["identity_seen"] is False  # not armed
+    # the hostile doc heals away; the pool returns to silence
+    kube.set_node_annotations(
+        "node-a", {L.EVIDENCE_ANNOTATION: json.dumps(without_id)},
+    )
+    assert ctrl3.scan_once()["evidence_audit"]["identity_missing"] == []
+
+
 def test_replayed_identity_token_is_mismatch(tmp_path, monkeypatch):
     """The thief gets cleverer: embeds node A's VALID token in the doc
     forged for node B. Node binding in the token claims catches it."""

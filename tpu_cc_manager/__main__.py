@@ -199,8 +199,12 @@ def main(argv=None) -> int:
                 # says whether the fleet has problems an operator must
                 # look at
                 report = controller.scan_once()
-                print(json.dumps(report, indent=2, sort_keys=True))
+                # problems INSIDE the printed JSON: a CI consumer gets
+                # the actionable lines from stdout, not just the exit
+                # code (stderr logging kept for humans watching cron)
                 problems = fleet_problems(report)
+                report["problems"] = problems
+                print(json.dumps(report, indent=2, sort_keys=True))
                 if problems:
                     log.error("fleet audit found problems: %s", problems)
                 return 1 if problems else 0

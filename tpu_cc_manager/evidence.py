@@ -442,7 +442,8 @@ def publish_evidence(kube, node_name: str, backend=None) -> bool:
         return False
 
 
-def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
+def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
+                   identity_seen_before: bool = False) -> dict:
     """Fleet-wide evidence-vs-label audit (run by the fleet controller):
     every node whose ``cc.mode.state`` label claims a successfully
     applied mode must carry evidence that (a) passes integrity
@@ -472,7 +473,22 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     without identity, flagged only when TPU_CC_REQUIRE_IDENTITY is set
     or the pool is MIXED (some nodes attach identity, some don't —
     uniformity is the tell; an all-missing pool is simply not running
-    on a platform that mints identities)."""
+    on a platform that mints identities). ``identity_seen_before``
+    extends the mixed-pool tell ACROSS scans: the fleet controller
+    passes True once any scan has seen an identity-bearing document,
+    so a uniform metadata outage — every token expiring out and the
+    healers republishing token-less docs — degrades to a loud
+    ``identity_missing`` finding instead of fading back to the
+    never-on-GCE silence. The returned ``identity_seen`` bool is what
+    the caller feeds back on the next scan (deliberately process-local
+    state: decommissioning identity on purpose is acknowledged by
+    restarting the controller, see docs/security.md). It is True only
+    for a VERIFIED token (verdict ``ok``): the evidence annotation is
+    hostile input, and latching the fleet-wide alarm off a forged or
+    garbage token would let one bad document turn every later scan
+    into noise until restart. (Pools whose tokens are merely
+    ``unverifiable`` — no JWKS provisioned — don't arm the latch;
+    provision the JWKS, or set TPU_CC_REQUIRE_IDENTITY.)"""
     from tpu_cc_manager import labels as L
     from tpu_cc_manager.identity import judge_identity, require_identity
 
@@ -486,6 +502,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
     ident_missing: List[str] = []
     ident_mismatch: List[str] = []
     saw_identity = False
+    saw_verified_identity = False
     for node in nodes:
         meta = node.get("metadata", {})
         name = meta.get("name", "?")
@@ -528,8 +545,12 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
             ident_missing.append(name)
         else:
             # any attached token — even a bad one — marks this as an
-            # identity-bearing pool for the mixed-pool heuristic
+            # identity-bearing pool for the PER-SCAN mixed-pool
+            # heuristic (transient, self-healing when the doc goes);
+            # only a VERIFIED token arms the cross-scan latch below
             saw_identity = True
+            if iverdict == "ok":
+                saw_verified_identity = True
             if iverdict in ("mismatch", "invalid"):
                 ident_mismatch.append(name)
             elif iverdict == "expired":
@@ -538,11 +559,12 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY) -> dict:
                 # stopped refreshing) — classed with missing so an
                 # idle fleet doesn't read as under attack
                 ident_missing.append(name)
-    if not (require_identity() or saw_identity):
+    if not (require_identity() or saw_identity or identity_seen_before):
         # uniform all-missing pool without the require knob: not a
         # finding — the platform simply mints no identities here
         ident_missing = []
     return {
+        "identity_seen": saw_verified_identity,
         "missing": sorted(missing),
         "unsigned": sorted(unsigned),
         "unverifiable": sorted(unverifiable),

@@ -87,8 +87,10 @@ def fleet_problems(report: dict) -> List[str]:
             f"{sorted(audit['identity_mismatch'])}"
         )
     if audit.get("identity_missing"):
-        # only populated on mixed pools or under TPU_CC_REQUIRE_IDENTITY
-        # (audit_evidence encodes that rule)
+        # populated on mixed pools, under TPU_CC_REQUIRE_IDENTITY, or
+        # when an earlier scan of this controller process saw VERIFIED
+        # identity (the fleet-wide-outage latch; audit_evidence's
+        # identity_seen_before encodes all three)
         problems.append(
             "evidence lacks platform identity on an identity-bearing "
             f"pool: {sorted(audit['identity_missing'])} — a stolen "
@@ -237,6 +239,13 @@ class FleetController:
         self.metrics = FleetMetrics()
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
+        #: sticky across scans: once any scan sees an identity-bearing
+        #: evidence document, a LATER uniform all-missing pool is a
+        #: metadata outage to flag, not a never-on-GCE pool to ignore
+        #: (audit_evidence's identity_seen_before). Process-local by
+        #: design — deliberately decommissioning identity is
+        #: acknowledged by restarting the controller
+        self._identity_ever_seen = False
         self._stop = threading.Event()
         self._server = RouteServer(port, name="fleet-http")
         self._server.add_route("/healthz", self._healthz)
@@ -258,7 +267,13 @@ class FleetController:
             # label-vs-device truth: the JAX planner trusts label text;
             # the evidence audit cross-checks it against what each
             # node's agent independently attested (VERDICT r2 item 7)
-            report["evidence_audit"] = audit_evidence(nodes)
+            audit = audit_evidence(
+                nodes, identity_seen_before=self._identity_ever_seen,
+            )
+            self._identity_ever_seen = (
+                self._identity_ever_seen or audit.get("identity_seen", False)
+            )
+            report["evidence_audit"] = audit
             report["doctor"] = self._aggregate_doctor(nodes)
             report["policies"] = self._policy_summaries()
             report["leader_elections"] = self._election_summaries()
