@@ -458,3 +458,25 @@ def test_doctor_steering_warn_mode_rehearses_without_enforcing(
     monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "warm")
     assert not any("doctor" in o["path"] for o in mutate_pod(pod))
     assert validate_pod(pod2)[0] is True
+
+    # the rehearsal is fleet-visible: /metrics counts warned responses
+    from tpu_cc_manager.webhook import AdmissionServer
+
+    monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "warn")
+    with AdmissionServer(0, tls=False) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, out = _post(
+            f"{base}/mutate",
+            {"request": {"uid": "m-1", "object": pod}},
+        )
+        assert status == 200 and out["response"]["warnings"]
+        _post(f"{base}/mutate",
+              {"request": {"uid": "m-2",
+                           "object": {"metadata": {}, "spec": {}}}})
+        import urllib.request
+
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=5,
+        ).read().decode()
+        assert "tpu_cc_webhook_warned_total 1" in metrics
+        assert "tpu_cc_webhook_reviews_total 2" in metrics

@@ -355,6 +355,12 @@ class AdmissionServer:
                         "# TYPE tpu_cc_webhook_malformed_total counter\n"
                         f"tpu_cc_webhook_malformed_total "
                         f"{outer.rejected_malformed}\n"
+                        "# HELP tpu_cc_webhook_warned_total Review "
+                        "responses carrying warnings (REQUIRE_DOCTOR "
+                        "warn-mode rehearsal activity; enforce when "
+                        "this stays flat)\n"
+                        "# TYPE tpu_cc_webhook_warned_total counter\n"
+                        f"tpu_cc_webhook_warned_total {outer.warned}\n"
                     ).encode()
                     return self._send(
                         200, body, "text/plain; version=0.0.4"
@@ -375,6 +381,8 @@ class AdmissionServer:
                         400, json.dumps({"error": str(e)}).encode()
                     )
                 outer.reviews += 1
+                if out.get("response", {}).get("warnings"):
+                    outer.warned += 1
                 return self._send(200, json.dumps(out).encode())
 
         server_cls = type(
@@ -397,6 +405,9 @@ class AdmissionServer:
         self.httpd.daemon_threads = True
         self.reviews = 0
         self.rejected_malformed = 0
+        #: responses that carried warnings — the warn-mode rehearsal's
+        #: fleet-visible signal: enforce once this stops moving
+        self.warned = 0
         self._thread: Optional[threading.Thread] = None
         self._reload_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
