@@ -353,6 +353,60 @@ def test_bookmark_rv_survives_foreign_churn(server, client):
     assert "MODIFIED" in etypes
 
 
+def test_client_side_flow_control(server, monkeypatch):
+    """client-go rest.Config QPS/Burst parity: a QPS-limited client
+    delays (never drops) requests past the burst, off by default, and
+    reads TPU_CC_KUBE_QPS/_BURST from the env — the shipped controller
+    manifests set it so a fleet-scale scan can't hammer the API
+    server."""
+    import time as _time
+
+    server.store.add_node(make_node("fc-node"))
+
+    # burst=1, 10 QPS: 5 calls -> at least 4 waits of ~0.1 s
+    limited = HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False),
+        qps=10, burst=1,
+    )
+    t0 = _time.monotonic()
+    for _ in range(5):
+        limited.get_node("fc-node")
+    elapsed = _time.monotonic() - t0
+    assert elapsed >= 0.35, elapsed
+
+    # default: no limiter (flip latency must not pay for politeness)
+    assert HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False)
+    )._bucket is None
+
+    # env wiring, ctor args win; garbage env reads as off
+    monkeypatch.setenv("TPU_CC_KUBE_QPS", "25")
+    env_client = HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False)
+    )
+    assert env_client._bucket is not None
+    assert env_client._bucket.qps == 25 and env_client._bucket.burst == 50
+    monkeypatch.setenv("TPU_CC_KUBE_BURST", "5")
+    assert HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False)
+    )._bucket.burst == 5
+    monkeypatch.setenv("TPU_CC_KUBE_QPS", "not-a-number")
+    assert HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False)
+    )._bucket is None
+
+    # a burst is spent without waiting: 3 calls under burst=10 consume
+    # tokens instead of sleeping (bucket state, not wall clock — a
+    # loaded CI machine must not flake a timing bound)
+    burst_client = HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False),
+        qps=1, burst=10,
+    )
+    for _ in range(3):
+        burst_client.get_node("fc-node")
+    assert burst_client._bucket._tokens <= 7.5
+
+
 def test_http_client_creates_events_over_the_wire():
     with FakeApiServer() as srv:
         kube = HttpKubeClient(KubeConfig("127.0.0.1", srv.port, use_tls=False))

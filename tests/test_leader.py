@@ -290,3 +290,23 @@ def test_readyz_is_leader_aware():
     # no elector configured: always ready when healthy
     c2 = PolicyController(kube, interval_s=1, port=0)
     assert c2._readyz()[0] == 200
+
+
+def test_elector_client_is_never_flow_controlled(monkeypatch):
+    """The elector gets its OWN unlimited client when the controller's
+    client carries TPU_CC_KUBE_QPS flow control: a lease renewal that
+    queues behind throttled scan/rollout traffic past the lease
+    duration would self-demote the leader mid-rollout — the classic
+    shared-limiter footgun."""
+    from tpu_cc_manager.__main__ import _leader_elector
+    from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+
+    monkeypatch.setenv("TPU_CC_LEADER_ELECT", "true")
+    monkeypatch.setenv("TPU_CC_KUBE_QPS", "5")
+    throttled = HttpKubeClient(KubeConfig("127.0.0.1", 1, use_tls=False))
+    assert throttled._bucket is not None  # env limiter is active
+    elector = _leader_elector(throttled, "tpu-cc-test-lease")
+    assert elector is not None
+    assert elector.kube is not throttled
+    assert elector.kube._bucket is None  # renewals bypass the bucket
+    assert elector.kube.config is throttled.config  # same cluster/auth

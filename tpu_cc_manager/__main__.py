@@ -64,6 +64,16 @@ def _leader_elector(kube, lease_name: str):
         os.environ.get("POD_NAME")
         or f"{socket.gethostname()}-{os.getpid()}"
     )
+    from tpu_cc_manager.k8s.client import HttpKubeClient
+
+    if isinstance(kube, HttpKubeClient):
+        # the elector gets its OWN unlimited client: lease renewals
+        # must never queue behind flow-controlled scan/rollout traffic
+        # (a renew delayed past the lease duration self-demotes the
+        # leader mid-rollout — the classic client-go shared-limiter
+        # footgun). Lease traffic is one GET+PUT per renew interval;
+        # unlimited is safe by construction.
+        kube = HttpKubeClient(kube.config, qps=0)
     return LeaderElector(
         kube,
         name=lease_name,
@@ -236,6 +246,14 @@ def main(argv=None) -> int:
                 from tpu_cc_manager.policy import UNHEALTHY_PHASES
 
                 report = controller.scan_once()
+                # like fleet --once: the actionable list rides INSIDE
+                # the printed JSON so CI consumers read stdout, not
+                # stderr + exit code
+                bad = sorted(
+                    name for name, st in report["policies"].items()
+                    if st["phase"] in UNHEALTHY_PHASES
+                )
+                report["unhealthy_policies"] = bad
                 print(json.dumps(report, indent=2, sort_keys=True))
                 if report.get("crd_missing"):
                     # the long-running controller rides this out (next
@@ -245,10 +263,6 @@ def main(argv=None) -> int:
                     log.error("TPUCCPolicy CRD not installed (or wrong "
                               "cluster): nothing was reconciled")
                     return 1
-                bad = sorted(
-                    name for name, st in report["policies"].items()
-                    if st["phase"] in UNHEALTHY_PHASES
-                )
                 if bad:
                     log.error("unhealthy policies: %s", bad)
                 return 1 if bad else 0

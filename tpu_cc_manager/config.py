@@ -30,6 +30,9 @@ flag                      env                            default
 (none)                    TPU_CC_EVIDENCE_KEY[_FILE]     "" (HMAC key; unset = plain sha256)
 (none)                    TPU_CC_EVIDENCE_OLD_KEYS_FILE  "" (retired keys, one per line,
                                                         verify-only — key rotation)
+(none)                    TPU_CC_KUBE_QPS[/_BURST]       0 = off (client-side API flow
+                                                        control; controllers set 50 —
+                                                        client-go QPS/Burst parity)
 (none)                    TPU_CC_IDENTITY                auto | gce | fake | none (platform
                                                         identity attached to evidence)
 (none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)

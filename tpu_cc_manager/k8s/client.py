@@ -18,6 +18,7 @@ import ssl
 import subprocess
 import tempfile
 import threading
+import time
 import urllib.parse
 from http.client import (
     BadStatusLine,
@@ -477,13 +478,48 @@ class KubeConfig:
 # --------------------------------------------------------------------------
 
 
+class _TokenBucket:
+    """client-go-style client-side flow control (QPS + burst,
+    vendor/k8s.io/client-go rest.Config's QPS/Burst): a shared bucket
+    refilled at ``qps`` tokens/second, holding at most ``burst``.
+    ``acquire`` blocks until a token is available — requests are
+    delayed, never dropped, so a controller storm degrades to a steady
+    trickle instead of hammering a contended API server. Thread-safe;
+    one bucket serves every thread of a client instance."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._updated = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    float(self.burst),
+                    self._tokens + (now - self._updated) * self.qps,
+                )
+                self._updated = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
 class HttpKubeClient(KubeClient):
     #: items per page for list requests; the server may return fewer and a
     #: ``metadata.continue`` token, which list_nodes/list_pods follow —
     #: required at fleet scale (client-go informers paginate the same way)
     LIST_PAGE_LIMIT = 500
 
-    def __init__(self, config: KubeConfig, list_page_limit: Optional[int] = None):
+    def __init__(self, config: KubeConfig,
+                 list_page_limit: Optional[int] = None,
+                 qps: Optional[float] = None,
+                 burst: Optional[int] = None):
         self.config = config
         self.list_page_limit = list_page_limit or self.LIST_PAGE_LIMIT
         # one persistent keep-alive connection per thread: the agent
@@ -491,6 +527,30 @@ class HttpKubeClient(KubeClient):
         # at 1 Hz — dialing TCP(+TLS) fresh for each was hundreds of
         # handshakes/minute at pool scale (r1 VERDICT weak #3)
         self._local = threading.local()
+        # client-side flow control (TPU_CC_KUBE_QPS / TPU_CC_KUBE_BURST,
+        # ctor args win): OFF by default — a per-node agent makes a
+        # handful of writes per reconcile and must not trade flip
+        # latency for politeness. The shipped controller manifests set
+        # a QPS: one fleet/policy controller scanning thousands of
+        # nodes is where client-go reaches for rest.Config.QPS/Burst,
+        # and the reference's ecosystem gets that limiter for free
+        # (vendor/k8s.io/client-go in the reference tree)
+        if qps is None:
+            try:
+                qps = float(os.environ.get("TPU_CC_KUBE_QPS", "") or 0)
+            except ValueError:
+                qps = 0
+        self._bucket: Optional[_TokenBucket] = None
+        if qps and qps > 0:
+            if burst is None:
+                try:
+                    burst = int(
+                        os.environ.get("TPU_CC_KUBE_BURST", "") or 0
+                    ) or None
+                except ValueError:
+                    burst = None
+            # client-go's default Burst is 2x QPS-ish (5/10); same ratio
+            self._bucket = _TokenBucket(qps, burst or int(2 * qps))
 
     # -- plumbing -------------------------------------------------------
     def _pooled(self, read_timeout: Optional[float]) -> Tuple[HTTPConnection, bool]:
@@ -561,6 +621,8 @@ class HttpKubeClient(KubeClient):
         read_timeout: Optional[float] = 30.0,
         _auth_retry: bool = True,
     ) -> dict:
+        if self._bucket is not None:
+            self._bucket.acquire()
         resp = data = None
         for attempt in (0, 1):
             try:
@@ -805,7 +867,12 @@ class HttpKubeClient(KubeClient):
                       retry=None) -> Iterator[Tuple[str, dict]]:
         """Shared NDJSON watch transport: dial, 401 invalidate-and-retry
         (via ``retry``, which re-invokes the caller once), stream until
-        the server-side timeout closes the connection."""
+        the server-side timeout closes the connection. Watch STARTS
+        count against the flow-control bucket (client-go does the
+        same) — a hot relist loop is exactly a request storm; the
+        long-lived stream itself is free."""
+        if self._bucket is not None:
+            self._bucket.acquire()
         try:
             conn = self._connect(read_timeout=timeout_s + 30)
         except ExecCredentialError as e:
