@@ -429,6 +429,89 @@ def main():
                     f"fleet --once rc={r.returncode}: "
                     f"{(r.stdout + r.stderr)[-400:]}"
                 )
+
+            # 10. key rotation against the LIVE agent: the Secret
+            # rotates in place (new primary; old key retired to the
+            # old-keys entry). The interim old signature must verify
+            # under the rotated set (stale, never digest_mismatch),
+            # the running agent's idle tick re-signs with the new
+            # primary, and the keyed one-shot audit stays clean.
+            from tpu_cc_manager.evidence import signed_with_primary
+
+            rotated_keys = (b"smoke-pool-key-2", b"smoke-pool-key")
+            old_keys_file = os.path.join(scratch, "old-keys")
+            with open(old_keys_file, "w") as f:
+                f.write("smoke-pool-key\n")
+            with open(evidence_key, "w") as f:
+                f.write("smoke-pool-key-2")
+            env["TPU_CC_EVIDENCE_OLD_KEYS_FILE"] = old_keys_file
+            deadline = time.monotonic() + 45
+            resigned = False
+            while time.monotonic() < deadline:
+                raw = store.get_node(NODE)["metadata"].get(
+                    "annotations", {}).get(L.EVIDENCE_ANNOTATION)
+                doc = json.loads(raw) if raw else None
+                if doc and verify_evidence(
+                        doc, key=rotated_keys)[0] is not True:
+                    failures.append(
+                        "rotation: interim signature rejected "
+                        f"({verify_evidence(doc, key=rotated_keys)})"
+                    )
+                    break
+                if doc and signed_with_primary(doc, key=rotated_keys):
+                    resigned = True
+                    break
+                time.sleep(0.5)
+            if resigned:
+                log("PASS key rotation: agent re-signed with the new "
+                    "primary; interim old-key doc verified throughout")
+            elif not any("rotation" in f for f in failures):
+                failures.append("rotation: agent never re-signed")
+            r = subprocess.run(
+                [sys.executable, "-m", "tpu_cc_manager",
+                 "fleet-controller", "--once"],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            if r.returncode == 0:
+                log("PASS keyed audit clean after rotation "
+                    "(stale_key drained)")
+            else:
+                failures.append(
+                    f"post-rotation fleet --once rc={r.returncode}: "
+                    f"{(r.stdout + r.stderr)[-400:]}"
+                )
+
+            # 11. webhook warn-mode rehearsal: admission unchanged,
+            # warnings describe what enforce would do, each within the
+            # API server's 256-char per-warning truncation limit
+            os.environ["TPU_CC_WEBHOOK_REQUIRE_DOCTOR"] = "warn"
+            try:
+                with AdmissionServer(0, tls=False) as wh:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{wh.port}/mutate",
+                        data=json.dumps(review).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    )
+                    resp = json.loads(
+                        urllib.request.urlopen(req, timeout=5).read()
+                    )
+            finally:
+                del os.environ["TPU_CC_WEBHOOK_REQUIRE_DOCTOR"]
+            wr = resp["response"]
+            ops = json.loads(_b64.b64decode(wr["patch"]))
+            warn_ok = (
+                wr["allowed"]
+                and wr.get("warnings")
+                and all(len(w) <= 256 for w in wr["warnings"])
+                and not any("doctor" in op["path"] for op in ops)
+            )
+            if warn_ok:
+                log("PASS webhook warn mode: admission unchanged, "
+                    f"{len(wr['warnings'])} rehearsal warning(s) "
+                    "within the 256-char cap")
+            else:
+                failures.append(f"webhook warn mode: {wr}")
         finally:
             proc.terminate()
             try:
