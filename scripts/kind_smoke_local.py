@@ -442,8 +442,13 @@ def main():
             old_keys_file = os.path.join(scratch, "old-keys")
             with open(old_keys_file, "w") as f:
                 f.write("smoke-pool-key\n")
-            with open(evidence_key, "w") as f:
+            # atomic swap, the way kubelet rotates a Secret mount — an
+            # in-place truncate-then-write would race the agent's 1 Hz
+            # key watch into reading an EMPTY (keyless) file
+            tmp_key = evidence_key + ".new"
+            with open(tmp_key, "w") as f:
                 f.write("smoke-pool-key-2")
+            os.replace(tmp_key, evidence_key)
             env["TPU_CC_EVIDENCE_OLD_KEYS_FILE"] = old_keys_file
             deadline = time.monotonic() + 45
             resigned = False

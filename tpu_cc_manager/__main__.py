@@ -191,7 +191,7 @@ def main(argv=None) -> int:
         return 0 if report.ok else 1
 
     if args.command == "fleet-controller":
-        from tpu_cc_manager.fleet import FleetController, fleet_problems
+        from tpu_cc_manager.fleet import FleetController
 
         try:
             kube = _kube_client(cfg)
@@ -209,11 +209,11 @@ def main(argv=None) -> int:
                 # says whether the fleet has problems an operator must
                 # look at
                 report = controller.scan_once()
-                # problems INSIDE the printed JSON: a CI consumer gets
+                # problems INSIDE the printed JSON (scan_once computes
+                # them for the live /report too): a CI consumer gets
                 # the actionable lines from stdout, not just the exit
                 # code (stderr logging kept for humans watching cron)
-                problems = fleet_problems(report)
-                report["problems"] = problems
+                problems = report["problems"]
                 print(json.dumps(report, indent=2, sort_keys=True))
                 if problems:
                     log.error("fleet audit found problems: %s", problems)

@@ -277,6 +277,10 @@ class FleetController:
             report["doctor"] = self._aggregate_doctor(nodes)
             report["policies"] = self._policy_summaries()
             report["leader_elections"] = self._election_summaries()
+            # the actionable digest rides in the report itself, so the
+            # live /report and `--once` stdout agree — an operator (or
+            # alert rule) reads one field either way
+            report["problems"] = fleet_problems(report)
             self.metrics.scan_duration.observe(time.monotonic() - t0)
             self.metrics.update(report)
             self.last_report = report
