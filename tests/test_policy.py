@@ -1501,7 +1501,7 @@ def test_missing_crd_is_a_deployment_race_not_a_crash():
         report = c.scan_once()
         assert report == {
             "policies": {}, "claimed_nodes": 0, "scanned": 0,
-            "crd_missing": True,
+            "crd_missing": True, "unhealthy_policies": [],
         }
     assert c.healthy and c.consecutive_errors == 0
     crd["installed"] = True

@@ -243,17 +243,11 @@ def main(argv=None) -> int:
             if args.once:
                 # cron/CI mode: one pass, report on stdout, exit code
                 # says whether every policy is in a healthy phase
-                from tpu_cc_manager.policy import UNHEALTHY_PHASES
-
                 report = controller.scan_once()
-                # like fleet --once: the actionable list rides INSIDE
-                # the printed JSON so CI consumers read stdout, not
-                # stderr + exit code
-                bad = sorted(
-                    name for name, st in report["policies"].items()
-                    if st["phase"] in UNHEALTHY_PHASES
-                )
-                report["unhealthy_policies"] = bad
+                # the actionable list rides INSIDE the printed JSON
+                # (scan_once computes it for the live /report too) so
+                # CI consumers read stdout, not stderr + exit code
+                bad = report["unhealthy_policies"]
                 print(json.dumps(report, indent=2, sort_keys=True))
                 if report.get("crd_missing"):
                     # the long-running controller rides this out (next

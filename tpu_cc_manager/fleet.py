@@ -422,7 +422,9 @@ class FleetController:
             while not self._stop.is_set():
                 if (self.leader_elector is not None
                         and not self.leader_elector.is_leader):
-                    self.last_report = {"standby": True}
+                    # field contract: every /report carries the digest,
+                    # standby included (consumers index it)
+                    self.last_report = {"standby": True, "problems": []}
                     self._stop.wait(self.leader_elector.retry_period_s)
                     continue
                 try:

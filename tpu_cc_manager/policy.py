@@ -359,6 +359,13 @@ class PolicyController:
         t0 = time.monotonic()
         try:
             report = self._scan(wait_rollout=wait_rollout)
+            # the actionable digest rides in the report itself, so the
+            # live /report and `--once` stdout agree (fleet.py does the
+            # same with its problems list)
+            report["unhealthy_policies"] = sorted(
+                name for name, st in report["policies"].items()
+                if st.get("phase") in UNHEALTHY_PHASES
+            )
             self.metrics.scan_duration.observe(time.monotonic() - t0)
             self.metrics.update(report["policies"])
             self.last_report = report
@@ -1329,6 +1336,9 @@ class PolicyController:
                     self.last_report = {
                         "policies": {}, "claimed_nodes": 0,
                         "scanned": 0, "standby": True,
+                        # field contract: every /report carries the
+                        # digest, standby included (consumers index it)
+                        "unhealthy_policies": [],
                     }
                     self._wake.wait(
                         self.leader_elector.retry_period_s
