@@ -425,7 +425,7 @@ def test_key_appearing_on_idle_node_resigns_evidence(tmp_path,
     key_file = tmp_path / "evidence-key"
     monkeypatch.setenv("TPU_CC_EVIDENCE_KEY_FILE", str(key_file))
     cfg = AgentConfig(node_name="idle-node", drain_strategy="none",
-                      health_port=0, emit_events=False)
+                      health_port=0, emit_events=True)
     agent = CCManagerAgent(kube, cfg, backend=be)
 
     # converge while the Secret is absent: evidence is plain-sha256
@@ -456,6 +456,10 @@ def test_key_appearing_on_idle_node_resigns_evidence(tmp_path,
     # keyed audit now sees a clean fleet
     audit = audit_evidence(kube.list_nodes(None), key=b"pool-secret")
     assert audit["unsigned"] == [] and audit["invalid"] == []
+    # ...and the re-sign is fleet-visible as a node Event, so rotation
+    # progress shows in `kubectl get events` while stale_key drains
+    reasons = [e["reason"] for e in kube.list_events("default")]
+    assert "CCEvidenceResigned" in reasons
 
 
 def test_sync_evidence_heals_posture_and_staleness(tmp_path,

@@ -26,7 +26,7 @@ from typing import Optional
 
 from tpu_cc_manager.config import AgentConfig
 from tpu_cc_manager.drain import (
-    NodeFlipTaint, build_drainer, build_reconcile_event,
+    EVENT_FOR_OUTCOME, NodeFlipTaint, build_drainer, build_node_event,
     post_event_best_effort, set_cc_mode_state_label,
 )
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
@@ -149,6 +149,10 @@ class CCManagerAgent:
         #: node's evidence — no mode flip will ever come to do it).
         #: Sentinel: no build yet this process
         self._evidence_key_used: object = self._KEY_UNSET
+        #: the key of the last SUCCESSFULLY PUBLISHED document — the
+        #: CCEvidenceResigned Event compares against this, so it fires
+        #: only for re-signs that landed, on whichever path landed them
+        self._evidence_published_key: object = self._KEY_UNSET
         # periodic doctor self-check throttle (first run shortly after
         # startup, then every doctor_interval_s)
         self._doctor_due = 0.0
@@ -289,6 +293,21 @@ class CCManagerAgent:
                 self._evidence_published_gen = max(
                     self._evidence_published_gen, gen
                 )
+                # rotation progress is fleet-visible only for documents
+                # that actually LANDED: compare signing posture against
+                # the last successfully published one, so the Event is
+                # truthful (never claims a failed publish) and fires on
+                # whichever path re-signed — the idle-tick posture
+                # check, the dropped-publish retry, or a plain flip
+                prev = self._evidence_published_key
+                self._evidence_published_key = key
+                if prev is not self._KEY_UNSET and key != prev:
+                    self._emit_node_event(
+                        "CCEvidenceResigned",
+                        "evidence key posture changed (Secret "
+                        "appeared/rotated/removed); re-signed "
+                        "attestation evidence with the current key",
+                    )
             except Exception:
                 log.warning("evidence publish failed; will retry",
                             exc_info=True)
@@ -490,21 +509,36 @@ class CCManagerAgent:
         """Best-effort core/v1 Event so `kubectl describe node` carries
         the mode-flip history (the reference records outcomes only in a
         label + pod logs). Never interferes with the reconcile result."""
+        hit = EVENT_FOR_OUTCOME.get(outcome)
+        if hit is None:
+            return
+        reason, etype = hit
+        self._emit_node_event(
+            reason,
+            f"cc mode reconcile to '{mode}': {outcome} in {dur:.2f}s",
+            etype, infix="cc-reconcile",
+        )
+
+    def _emit_node_event(self, reason: str, message: str,
+                         etype: str = "Normal", *,
+                         infix: str = "cc-maint") -> None:
+        """Best-effort node Event through the async recorder — reconcile
+        outcomes and trust-surface maintenance (key rotation) both show
+        in `kubectl describe node`. ``infix`` keeps the two name
+        spaces distinct."""
         if not self.cfg.emit_events:
             return
         self._event_seq += 1
-        event = build_reconcile_event(
-            self.cfg.node_name, mode, outcome, dur,
+        event = build_node_event(
+            self.cfg.node_name, reason, message, etype,
             name=(
-                f"{self.cfg.node_name}.cc-reconcile."
+                f"{self.cfg.node_name}.{infix}."
                 f"{self._event_token}.{self._event_seq}"
             ),
         )
-        if event is None:
-            return
         if self._enqueue_recorder_item(event) == "full":
             self.metrics.events_dropped_total.inc()
-            log.debug("event queue full; dropping %s", event["reason"])
+            log.debug("event queue full; dropping %s", reason)
 
     def _enqueue_recorder_item(self, item) -> str:
         """Hand an Event dict or a callable task to the async recorder
@@ -647,6 +681,8 @@ class CCManagerAgent:
                 log.info(
                     "evidence key posture changed; re-signing evidence"
                 )
+                # the CCEvidenceResigned Event rides the publish task:
+                # it fires only once the re-signed document LANDS
                 self._publish_evidence()
             elif (self._evidence_identity_refresh_at is not None
                     and time.time()

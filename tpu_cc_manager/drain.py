@@ -66,19 +66,12 @@ EVENT_FOR_OUTCOME = {
 }
 
 
-def build_reconcile_event(
-    node_name: str, mode: str, outcome: str, duration_s: float, name: str
-) -> Optional[dict]:
-    """Core/v1 Event for one reconcile outcome, shared by the agent's
-    async recorder and the one-shot CLI (the bash engine builds the same
-    shape in _post_event). None for outcomes that don't record. Events
-    for cluster-scoped Nodes must live in the "default" namespace —
-    a real apiserver rejects event.namespace != involvedObject.namespace
-    (which is empty for Nodes)."""
-    hit = EVENT_FOR_OUTCOME.get(outcome)
-    if hit is None:
-        return None
-    reason, etype = hit
+def build_node_event(node_name: str, reason: str, message: str,
+                     etype: str, name: str) -> dict:
+    """Core/v1 Event against a Node. Events for cluster-scoped Nodes
+    must live in the "default" namespace — a real apiserver rejects
+    event.namespace != involvedObject.namespace (which is empty for
+    Nodes)."""
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     return {
         "kind": "Event",
@@ -88,15 +81,30 @@ def build_reconcile_event(
             "kind": "Node", "apiVersion": "v1", "name": node_name,
         },
         "reason": reason,
-        "message": (
-            f"cc mode reconcile to '{mode}': {outcome} in {duration_s:.2f}s"
-        ),
+        "message": message,
         "type": etype,
         "source": {"component": "tpu-cc-manager", "host": node_name},
         "firstTimestamp": now,
         "lastTimestamp": now,
         "count": 1,
     }
+
+
+def build_reconcile_event(
+    node_name: str, mode: str, outcome: str, duration_s: float, name: str
+) -> Optional[dict]:
+    """Core/v1 Event for one reconcile outcome, shared by the agent's
+    async recorder and the one-shot CLI (the bash engine builds the same
+    shape in _post_event). None for outcomes that don't record."""
+    hit = EVENT_FOR_OUTCOME.get(outcome)
+    if hit is None:
+        return None
+    reason, etype = hit
+    return build_node_event(
+        node_name, reason,
+        f"cc mode reconcile to '{mode}': {outcome} in {duration_s:.2f}s",
+        etype, name,
+    )
 
 
 def post_event_best_effort(kube: KubeClient, event: dict,
