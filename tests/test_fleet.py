@@ -325,3 +325,99 @@ def test_report_carries_election_state(monkeypatch):
     c2 = FleetController(kube, interval_s=30, port=0)
     assert c2.scan_once()["leader_elections"] == {}
     assert calls == []
+
+
+def test_node_fingerprint_ignores_doctor_timestamp():
+    """The watch wake filter: a periodic doctor republish that changes
+    only its timestamp must not wake a scan; a state-label or verdict
+    change must."""
+    from tpu_cc_manager.fleet import FleetController
+
+    def node(state="on", doctor_at="t1", ok=True, evidence="e1"):
+        return {
+            "metadata": {
+                "name": "n1",
+                "labels": {L.TPU_ACCELERATOR_LABEL: "v5p",
+                           L.CC_MODE_STATE_LABEL: state,
+                           "unrelated": "x"},
+                "annotations": {
+                    L.DOCTOR_ANNOTATION: json.dumps(
+                        {"ok": ok, "fail": [], "at": doctor_at}),
+                    L.EVIDENCE_ANNOTATION: evidence,
+                },
+            },
+        }
+
+    fp = FleetController._node_fingerprint
+    base = fp(node())
+    assert fp(node(doctor_at="t2")) == base           # timestamp only
+    assert fp(node(state="off")) != base              # mode moved
+    assert fp(node(ok=False)) != base                 # verdict flipped
+    assert fp(node(evidence="e2")) != base            # evidence moved
+    # unrelated label churn (kubelet heartbeat analogs) is invisible
+    n = node()
+    n["metadata"]["labels"]["unrelated"] = "y"
+    assert fp(n) == base
+
+    # the annotation is node-writable (hostile input): odd-but-parseable
+    # shapes must normalise stably, never throw in the watch thread
+    for hostile in ('{"ok": true, "fail": 5}', "null", "5", "{nope"):
+        h = node()
+        h["metadata"]["annotations"][L.DOCTOR_ANNOTATION] = hostile
+        assert fp(h) == fp(h)  # total + deterministic
+
+
+def test_watch_triggered_scan_beats_the_interval():
+    """A state change on a node must surface in /report within the min
+    scan gap, not the interval — the watch wakes the loop. The interval
+    here is far beyond the test horizon, so only the watch can explain
+    a fresh report."""
+    import threading as _threading
+
+    from tpu_cc_manager.fleet import FleetController
+
+    kube = FakeKube()
+    kube.add_node(_node("n1", desired="on", state="on"))
+    ctrl = FleetController(kube, interval_s=300.0, port=0)
+    ctrl.min_scan_gap_s = 0.2
+    t = _threading.Thread(target=ctrl.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            r = ctrl.last_report
+            if r and r.get("nodes") == 1:
+                break
+            time.sleep(0.1)
+        assert ctrl.last_report and ctrl.last_report["nodes"] == 1
+
+        # divergence appears; the watch must surface it well before the
+        # 300 s interval
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "off"})
+        deadline = time.monotonic() + 15
+        seen = None
+        while time.monotonic() < deadline:
+            r = ctrl.last_report
+            if r and r.get("needs_flip"):
+                seen = r["needs_flip"]
+                break
+            time.sleep(0.1)
+        assert seen == ["n1"], ctrl.last_report
+    finally:
+        ctrl.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_watchless_client_degrades_to_polling():
+    """A minimal clientset without node-watch support must not crash
+    the watch thread — the controller degrades to interval polling."""
+    from tpu_cc_manager.fleet import FleetController
+    from tpu_cc_manager.k8s.client import ApiException
+
+    class Minimal(FakeKube):
+        def watch_nodes(self, *a, **kw):
+            raise ApiException(501, "no watch here")
+
+    ctrl = FleetController(Minimal(), port=0)
+    ctrl._watch_loop()  # returns promptly instead of raising/looping

@@ -33,6 +33,8 @@ flag                      env                            default
 (none)                    TPU_CC_KUBE_QPS[/_BURST]       0 = off (client-side API flow
                                                         control; controllers set 50 —
                                                         client-go QPS/Burst parity)
+(none)                    TPU_CC_FLEET_MIN_SCAN_GAP_S    5 (coalescing gap between
+                                                        watch-triggered fleet scans)
 (none)                    TPU_CC_IDENTITY                auto | gce | fake | none (platform
                                                         identity attached to evidence)
 (none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)

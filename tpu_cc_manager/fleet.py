@@ -26,11 +26,11 @@ import logging
 import os
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.evidence import audit_evidence
-from tpu_cc_manager.k8s.client import KubeClient
+from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.obs import (
     OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
 )
@@ -246,6 +246,20 @@ class FleetController:
         #: design — deliberately decommissioning identity is
         #: acknowledged by restarting the controller
         self._identity_ever_seen = False
+        #: watch-triggered scans: a node watch wakes the scan loop the
+        #: moment report-relevant state changes, so mode divergence /
+        #: failed flips / doctor verdicts surface in seconds instead of
+        #: at the next interval tick; the interval remains the liveness
+        #: fallback. Bursts coalesce through the min scan gap.
+        self._wake = threading.Event()
+        self.watch_timeout_s = 300
+        self.watch_backoff_s = 5.0
+        try:
+            self.min_scan_gap_s = float(
+                os.environ.get("TPU_CC_FLEET_MIN_SCAN_GAP_S", "") or 5.0
+            )
+        except ValueError:
+            self.min_scan_gap_s = 5.0
         self._stop = threading.Event()
         self._server = RouteServer(port, name="fleet-http")
         self._server.add_route("/healthz", self._healthz)
@@ -409,13 +423,107 @@ class FleetController:
         body = json.dumps(self.last_report, indent=2, sort_keys=True).encode()
         return 200, body, "application/json"
 
+    # -------------------------------------------------------------- watch
+    @staticmethod
+    def _node_fingerprint(node: dict):
+        """Hashable digest of exactly the node state the fleet report
+        depends on: tpu labels (desired/state/slice/doctor-ok and the
+        accelerator selector), the evidence annotation, and the STABLE
+        part of the doctor verdict (ok + failing checks — not its
+        timestamp, or every periodic doctor publish would wake a scan
+        that finds nothing new)."""
+        meta = node.get("metadata", {})
+        labels = meta.get("labels") or {}
+        ann = meta.get("annotations") or {}
+        relevant = tuple(sorted(
+            (k, v) for k, v in labels.items()
+            if "tpu.google.com" in k or k == L.TPU_ACCELERATOR_LABEL
+        ))
+        doctor = ann.get(L.DOCTOR_ANNOTATION)
+        if doctor:
+            # the annotation is node-writable (hostile input): the
+            # normalisation must be TOTAL — any parseable-but-odd shape
+            # ('null', '5', fail as a scalar) reduces to a stable
+            # string instead of throwing in the watch thread
+            try:
+                d = json.loads(doctor)
+                if isinstance(d, dict):
+                    doctor = json.dumps(
+                        {"ok": d.get("ok"), "fail": d.get("fail")},
+                        sort_keys=True,
+                    )
+            except ValueError:
+                pass  # malformed stays raw — itself a stable value
+        return (relevant, ann.get(L.EVIDENCE_ANNOTATION), doctor)
+
+    def _watch_loop(self) -> None:
+        """Background node watch; report-relevant changes wake the scan
+        loop (same shape as the policy controller's CR watch). Falls
+        back to pure interval polling when the client has no node-watch
+        support; transient failures back off and re-establish, with a
+        gap-covering wake on every from-scratch reconnect."""
+        rv = None
+        prints: Dict[str, object] = {}  # node -> last fingerprint
+        while not self._stop.is_set():
+            if rv is None:
+                # a fresh watch starts at "now" and cannot replay what
+                # happened before it: wake one scan to cover the gap
+                self._wake.set()
+            try:
+                # the no-watch probe is scoped to the CALL alone: a
+                # TypeError from event processing must hit the generic
+                # backoff-and-retry below, not masquerade as a
+                # clientset without watch support
+                try:
+                    stream = iter(self.kube.watch_nodes(
+                        resource_version=rv,
+                        timeout_s=self.watch_timeout_s,
+                    ))
+                except TypeError:
+                    log.info("client has no node-watch support; "
+                             "interval polling only")
+                    return
+                for etype, obj in stream:
+                    meta = obj.get("metadata", {})
+                    rv = meta.get("resourceVersion", rv)
+                    if etype == "BOOKMARK":
+                        continue
+                    name = meta.get("name", "")
+                    if etype == "DELETED":
+                        prints.pop(name, None)
+                        self._wake.set()
+                        continue
+                    fp = self._node_fingerprint(obj)
+                    if prints.get(name) != fp:
+                        prints[name] = fp
+                        self._wake.set()
+                    if self._stop.is_set():
+                        return
+            except ApiException as e:
+                if e.status == 501:
+                    log.info("client has no node-watch support; "
+                             "interval polling only")
+                    return
+                rv = None
+                self._stop.wait(self.watch_backoff_s)
+            except Exception:
+                log.warning("fleet node watch failed; retrying",
+                            exc_info=True)
+                rv = None
+                self._stop.wait(self.watch_backoff_s)
+
     # ---------------------------------------------------------------- run
     def run(self) -> int:
         self._server.start()
         log.info(
-            "fleet controller serving on :%d (selector %r, every %.0fs)",
+            "fleet controller serving on :%d (selector %r, every %.0fs "
+            "+ watch-triggered)",
             self.port, self.selector, self.interval_s,
         )
+        watcher = threading.Thread(
+            target=self._watch_loop, name="fleet-watch", daemon=True
+        )
+        watcher.start()
         if self.leader_elector is not None:
             self.leader_elector.start()
         try:
@@ -442,13 +550,20 @@ class FleetController:
                             self.consecutive_errors,
                         )
                         return 1
-                self._stop.wait(self.interval_s)
+                # wake-aware sleep: a watch event ends it early, the
+                # interval is the liveness fallback. The min scan gap
+                # coalesces event bursts (a 32-node rollout is one or
+                # two scans, not 32) and bounds watch-driven scan rate
+                if self._wake.wait(self.interval_s):
+                    self._wake.clear()
+                    self._stop.wait(self.min_scan_gap_s)
             return 0
         finally:
             self.stop()
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock a wake-aware sleep immediately
         if self.leader_elector is not None:
             self.leader_elector.stop()  # release: standby takes over now
         self._server.stop()
