@@ -156,6 +156,13 @@ std::atomic<long> g_reconciles_ok{0};
 std::atomic<long> g_reconciles_failed{0};
 std::atomic<int> g_last_reconcile_rc{-1}; /* -1 = none yet */
 std::atomic<int> g_doctor_last_rc{-1};    /* -1 = never ran */
+/* rotation visibility on the native path: how often the key-posture
+ * watch fired and how the evidence syncs went — a node stuck in the
+ * audit's stale_key bucket shows WHY here (sync failures climbing vs
+ * posture change never observed) */
+std::atomic<long> g_key_posture_changes{0};
+std::atomic<long> g_evidence_syncs_ok{0};
+std::atomic<long> g_evidence_syncs_failed{0};
 int g_doctor_timeout_s = 120; /* TPU_CC_DOCTOR_TIMEOUT_S: a wedged
                                * doctor child must not stall the hot
                                * loop forever (it runs inline on the
@@ -658,7 +665,7 @@ void health_serve_client(int fd) {
       body = "watch loop stalled\n";
     }
   } else if (path == "/metrics") {
-    char m[1024];
+    char m[1536];
     snprintf(m, sizeof(m),
              "# TYPE tpu_cc_native_reconciles_total counter\n"
              "tpu_cc_native_reconciles_total{outcome=\"success\"} %ld\n"
@@ -668,13 +675,23 @@ void health_serve_client(int fd) {
              "# TYPE tpu_cc_native_watch_idle_seconds gauge\n"
              "tpu_cc_native_watch_idle_seconds %ld\n"
              "# TYPE tpu_cc_native_doctor_last_rc gauge\n"
-             "tpu_cc_native_doctor_last_rc %d\n",
+             "tpu_cc_native_doctor_last_rc %d\n"
+             "# TYPE tpu_cc_native_key_posture_changes_total counter\n"
+             "tpu_cc_native_key_posture_changes_total %ld\n"
+             "# TYPE tpu_cc_native_evidence_syncs_total counter\n"
+             "tpu_cc_native_evidence_syncs_total{outcome=\"success\"}"
+             " %ld\n"
+             "tpu_cc_native_evidence_syncs_total{outcome=\"failure\"}"
+             " %ld\n",
              g_reconciles_ok.load(), g_reconciles_failed.load(),
              g_last_reconcile_rc.load(),
              g_watch_progress.load() == 0
                  ? 0L
                  : (long)(time(nullptr) - g_watch_progress.load()),
-             g_doctor_last_rc.load());
+             g_doctor_last_rc.load(),
+             g_key_posture_changes.load(),
+             g_evidence_syncs_ok.load(),
+             g_evidence_syncs_failed.load());
     body = m;
   } else {
     status = "404 Not Found";
@@ -1092,6 +1109,7 @@ int main(int argc, char **argv) {
         if (s != key_sig) {
           key_sig = s;
           evidence_sync_due = 0; /* posture changed: sync NOW */
+          g_key_posture_changes.fetch_add(1);
           logf("INFO",
                "evidence key posture changed on disk; syncing now");
         }
@@ -1102,6 +1120,7 @@ int main(int argc, char **argv) {
         int rc = run_bounded(g_evidence_sync_cmd, g_doctor_timeout_s,
                              "evidence sync");
         if (rc != 0) {
+          g_evidence_syncs_failed.fetch_add(1);
           /* retry a transient failure soon, not a full interval out —
            * a posture-change sync that hit an apiserver blip would
            * otherwise leave stale/unsigned evidence up for the whole
@@ -1111,6 +1130,8 @@ int main(int argc, char **argv) {
           evidence_sync_due = time(nullptr) + retry;
           logf("WARN", "evidence sync failed (rc=%d); retrying in %ds",
                rc, retry);
+        } else {
+          g_evidence_syncs_ok.fetch_add(1);
         }
       }
       continue;

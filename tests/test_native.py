@@ -15,6 +15,18 @@ from tpu_cc_manager.k8s.apiserver import FakeApiServer
 from tpu_cc_manager.k8s.objects import make_node
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    """A free ephemeral port for the agent's health server (bind 0,
+    read it back, release — the agent re-binds moments later)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 NATIVE = os.path.join(REPO, "native")
 BUILD = os.path.join(NATIVE, "build")
 
@@ -779,18 +791,13 @@ def test_cpp_agent_health_surface(native_build, apiserver, tmp_path):
     /metrics (watch-loop liveness, last reconcile outcome, doctor
     verdict) so daemonset-native*.yaml can probe the agent container
     directly instead of a sidecar."""
-    import socket
     import urllib.request
 
     out_file = tmp_path / "calls.txt"
     apiserver.store.add_node(
         make_node("hnode", labels={L.CC_MODE_LABEL: "on"})
     )
-    # free ephemeral port for the health server
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = _free_port()
     env = dict(os.environ)
     env.update(
         NODE_NAME="hnode",
@@ -1024,6 +1031,8 @@ users: [{{name: u, user: {{}}}}]
         TPU_CC_DOCTOR_INTERVAL_S="0",
         TPU_CC_WATCH_TIMEOUT_S="2",
     )
+    health_port = _free_port()
+    env["HEALTH_PORT"] = str(health_port)
     proc = subprocess.Popen(
         [os.path.join(native_build, "tpu-cc-manager-agent")],
         env=env, stderr=subprocess.PIPE, text=True,
@@ -1058,6 +1067,28 @@ users: [{{name: u, user: {{}}}}]
             "evidence not re-signed after key file appeared"
         )
         assert verify_evidence(doc, key=b"pool-key") == (True, "ok")
+
+        # rotation visibility on /metrics: the posture watch fired and
+        # both syncs (startup + posture-change) succeeded. Polled: the
+        # annotation lands while the sync CHILD is still exiting, and
+        # the counter only advances once the parent reaps it
+        import urllib.request
+
+        deadline = time.monotonic() + 10
+        metrics = ""
+        while time.monotonic() < deadline:
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{health_port}/metrics", timeout=5,
+            ).read().decode()
+            if ('tpu_cc_native_evidence_syncs_total'
+                    '{outcome="success"} 2') in metrics:
+                break
+            time.sleep(0.2)
+        assert "tpu_cc_native_key_posture_changes_total 1" in metrics
+        assert ('tpu_cc_native_evidence_syncs_total{outcome="success"}'
+                " 2") in metrics
+        assert ('tpu_cc_native_evidence_syncs_total{outcome="failure"}'
+                " 0") in metrics
     finally:
         proc.terminate()
         try:
