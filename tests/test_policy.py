@@ -628,6 +628,10 @@ def test_own_status_patches_do_not_self_wake():
             return super().scan_once(wait_rollout=wait_rollout)
 
     c = Counting(kube, interval_s=3600, poll_s=0.02)
+    # no coalescing gap: every wake becomes a scan immediately, so the
+    # stability windows below observe wakes directly (the gap would
+    # defer a pending startup wake past them and read as a self-wake)
+    c.min_scan_gap_s = 0.0
     kube.add_custom(G, P, make_policy("p"))
     t = threading.Thread(target=c.run, daemon=True)
     t.start()
@@ -1863,3 +1867,45 @@ def test_adoption_posts_policy_event():
     finally:
         agents.stop.set()
         agents.join(timeout=2)
+
+
+def test_node_watch_refreshes_status_between_intervals():
+    """An agent converging OUT-OF-BAND (drift heal, operator fix) must
+    refresh the policy's converged counts promptly via the NODE watch —
+    the interval here is an hour, and a paused policy never rolls, so
+    only the node watch can explain a fresh status."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    kube.add_custom(G, P, make_policy("pw", paused=True))
+    c = controller(kube, interval_s=3600)
+    c.min_scan_gap_s = 0.2
+
+    def status():
+        try:
+            return kube.get_cluster_custom(G, V, P, "pw").get(
+                "status") or {}
+        except ApiException:
+            return {}
+
+    t = threading.Thread(target=c.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if status().get("nodes") == 1:
+                break
+            time.sleep(0.05)
+        assert status().get("nodes") == 1
+        assert status().get("converged") == 0
+
+        kube.set_node_labels("n0", {L.CC_MODE_STATE_LABEL: "on"})
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if status().get("converged") == 1:
+                break
+            time.sleep(0.1)
+        assert status().get("converged") == 1, status()
+    finally:
+        c.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()

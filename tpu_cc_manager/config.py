@@ -35,6 +35,8 @@ flag                      env                            default
                                                         client-go QPS/Burst parity)
 (none)                    TPU_CC_FLEET_MIN_SCAN_GAP_S    5 (coalescing gap between
                                                         watch-triggered fleet scans)
+(none)                    TPU_CC_POLICY_MIN_SCAN_GAP_S   2 (coalescing gap after any
+                                                        policy-scan wake)
 (none)                    TPU_CC_IDENTITY                auto | gce | fake | none (platform
                                                         identity attached to evidence)
 (none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)
@@ -76,6 +78,16 @@ from tpu_cc_manager import labels as L
 #: Readiness file signalling "initial reconcile done" to the validation
 #: framework (reference main.py:64: /run/nvidia/validations/...).
 DEFAULT_READINESS_FILE = "/run/tpu/validations/.cc-manager-ctr-ready"
+
+
+def _env_float(name: str, default: float) -> float:
+    """Float env knob: unset, empty, or unparseable reads as the
+    default (a typo must degrade to documented behavior, not crash a
+    controller at startup)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def _env_bool(name: str, default: bool) -> bool:

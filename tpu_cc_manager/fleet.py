@@ -117,6 +117,98 @@ def fleet_problems(report: dict) -> List[str]:
     return problems
 
 
+def run_node_watch(kube, stop: threading.Event, wake,
+                   *, timeout_s: int, backoff_s: float,
+                   logger, who: str) -> None:
+    """Shared node-watch pump for both controllers: stream node events,
+    call ``wake()`` for report-relevant changes (fingerprint-filtered —
+    see :func:`node_report_fingerprint`), wake once per from-scratch
+    (re)connect to cover the unreplayable gap, back off and
+    re-establish on transient failures, and return — degrading the
+    caller to pure interval polling — when the client has no
+    node-watch support (501, or a clientset whose ``watch_nodes``
+    isn't a generator)."""
+    rv = None
+    prints: Dict[str, object] = {}
+    while not stop.is_set():
+        if rv is None:
+            # a fresh watch starts at "now" and cannot replay what
+            # happened before it: wake one scan to cover the gap
+            wake()
+        try:
+            # the no-watch probe is scoped to the CALL alone: a
+            # TypeError from event processing must hit the generic
+            # backoff-and-retry below, not masquerade as a clientset
+            # without watch support
+            try:
+                stream = iter(kube.watch_nodes(
+                    resource_version=rv, timeout_s=timeout_s,
+                ))
+            except TypeError:
+                logger.info("%s: client has no node-watch support; "
+                            "interval polling only", who)
+                return
+            for etype, obj in stream:
+                meta = obj.get("metadata", {})
+                rv = meta.get("resourceVersion", rv)
+                if etype == "BOOKMARK":
+                    continue
+                name = meta.get("name", "")
+                if etype == "DELETED":
+                    prints.pop(name, None)
+                    wake()
+                    continue
+                fp = node_report_fingerprint(obj)
+                if prints.get(name) != fp:
+                    prints[name] = fp
+                    wake()
+                if stop.is_set():
+                    return
+        except ApiException as e:
+            if e.status == 501:
+                logger.info("%s: client has no node-watch support; "
+                            "interval polling only", who)
+                return
+            rv = None
+            stop.wait(backoff_s)
+        except Exception:
+            logger.warning("%s: node watch failed; retrying", who,
+                           exc_info=True)
+            rv = None
+            stop.wait(backoff_s)
+
+
+def node_report_fingerprint(node: dict):
+    """Comparable digest of exactly the node state the controllers'
+    reports depend on: tpu labels (desired/state/slice/doctor-ok and
+    the accelerator selector), the evidence annotation, and the STABLE
+    part of the doctor verdict (ok + failing checks — not its
+    timestamp, or every periodic doctor publish would wake a scan that
+    finds nothing new). Shared by the fleet and policy controllers'
+    node-watch wake filters. Total over hostile node-writable
+    annotations: any parseable-but-odd shape reduces to a stable value
+    instead of throwing in a watch thread."""
+    meta = node.get("metadata", {})
+    labels = meta.get("labels") or {}
+    ann = meta.get("annotations") or {}
+    relevant = tuple(sorted(
+        (k, v) for k, v in labels.items()
+        if "tpu.google.com" in k or k == L.TPU_ACCELERATOR_LABEL
+    ))
+    doctor = ann.get(L.DOCTOR_ANNOTATION)
+    if doctor:
+        try:
+            d = json.loads(doctor)
+            if isinstance(d, dict):
+                doctor = json.dumps(
+                    {"ok": d.get("ok"), "fail": d.get("fail")},
+                    sort_keys=True,
+                )
+        except ValueError:
+            pass  # malformed stays raw — itself a stable value
+    return (relevant, ann.get(L.EVIDENCE_ANNOTATION), doctor)
+
+
 class FleetMetrics:
     def __init__(self):
         self.nodes = Gauge("tpu_cc_fleet_nodes", "Nodes in the fleet")
@@ -254,12 +346,11 @@ class FleetController:
         self._wake = threading.Event()
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
-        try:
-            self.min_scan_gap_s = float(
-                os.environ.get("TPU_CC_FLEET_MIN_SCAN_GAP_S", "") or 5.0
-            )
-        except ValueError:
-            self.min_scan_gap_s = 5.0
+        from tpu_cc_manager.config import _env_float
+
+        self.min_scan_gap_s = _env_float(
+            "TPU_CC_FLEET_MIN_SCAN_GAP_S", 5.0
+        )
         self._stop = threading.Event()
         self._server = RouteServer(port, name="fleet-http")
         self._server.add_route("/healthz", self._healthz)
@@ -424,93 +515,17 @@ class FleetController:
         return 200, body, "application/json"
 
     # -------------------------------------------------------------- watch
-    @staticmethod
-    def _node_fingerprint(node: dict):
-        """Hashable digest of exactly the node state the fleet report
-        depends on: tpu labels (desired/state/slice/doctor-ok and the
-        accelerator selector), the evidence annotation, and the STABLE
-        part of the doctor verdict (ok + failing checks — not its
-        timestamp, or every periodic doctor publish would wake a scan
-        that finds nothing new)."""
-        meta = node.get("metadata", {})
-        labels = meta.get("labels") or {}
-        ann = meta.get("annotations") or {}
-        relevant = tuple(sorted(
-            (k, v) for k, v in labels.items()
-            if "tpu.google.com" in k or k == L.TPU_ACCELERATOR_LABEL
-        ))
-        doctor = ann.get(L.DOCTOR_ANNOTATION)
-        if doctor:
-            # the annotation is node-writable (hostile input): the
-            # normalisation must be TOTAL — any parseable-but-odd shape
-            # ('null', '5', fail as a scalar) reduces to a stable
-            # string instead of throwing in the watch thread
-            try:
-                d = json.loads(doctor)
-                if isinstance(d, dict):
-                    doctor = json.dumps(
-                        {"ok": d.get("ok"), "fail": d.get("fail")},
-                        sort_keys=True,
-                    )
-            except ValueError:
-                pass  # malformed stays raw — itself a stable value
-        return (relevant, ann.get(L.EVIDENCE_ANNOTATION), doctor)
+    _node_fingerprint = staticmethod(node_report_fingerprint)
 
     def _watch_loop(self) -> None:
-        """Background node watch; report-relevant changes wake the scan
-        loop (same shape as the policy controller's CR watch). Falls
-        back to pure interval polling when the client has no node-watch
-        support; transient failures back off and re-establish, with a
-        gap-covering wake on every from-scratch reconnect."""
-        rv = None
-        prints: Dict[str, object] = {}  # node -> last fingerprint
-        while not self._stop.is_set():
-            if rv is None:
-                # a fresh watch starts at "now" and cannot replay what
-                # happened before it: wake one scan to cover the gap
-                self._wake.set()
-            try:
-                # the no-watch probe is scoped to the CALL alone: a
-                # TypeError from event processing must hit the generic
-                # backoff-and-retry below, not masquerade as a
-                # clientset without watch support
-                try:
-                    stream = iter(self.kube.watch_nodes(
-                        resource_version=rv,
-                        timeout_s=self.watch_timeout_s,
-                    ))
-                except TypeError:
-                    log.info("client has no node-watch support; "
-                             "interval polling only")
-                    return
-                for etype, obj in stream:
-                    meta = obj.get("metadata", {})
-                    rv = meta.get("resourceVersion", rv)
-                    if etype == "BOOKMARK":
-                        continue
-                    name = meta.get("name", "")
-                    if etype == "DELETED":
-                        prints.pop(name, None)
-                        self._wake.set()
-                        continue
-                    fp = self._node_fingerprint(obj)
-                    if prints.get(name) != fp:
-                        prints[name] = fp
-                        self._wake.set()
-                    if self._stop.is_set():
-                        return
-            except ApiException as e:
-                if e.status == 501:
-                    log.info("client has no node-watch support; "
-                             "interval polling only")
-                    return
-                rv = None
-                self._stop.wait(self.watch_backoff_s)
-            except Exception:
-                log.warning("fleet node watch failed; retrying",
-                            exc_info=True)
-                rv = None
-                self._stop.wait(self.watch_backoff_s)
+        """Background node watch via :func:`run_node_watch`;
+        report-relevant changes wake the scan loop."""
+        run_node_watch(
+            self.kube, self._stop, self._wake.set,
+            timeout_s=self.watch_timeout_s,
+            backoff_s=self.watch_backoff_s,
+            logger=log, who="fleet",
+        )
 
     # ---------------------------------------------------------------- run
     def run(self) -> int:

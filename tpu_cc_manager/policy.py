@@ -342,6 +342,18 @@ class PolicyController:
             leader_elector.on_started_leading = self._on_promoted
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
+        #: coalescing gap applied after a NODE-event wake before the
+        #: next scan: bounds the watch-driven scan rate — a 32-node
+        #: rollout's label churn is one or two scans, not 32. CR-spec
+        #: and internal wakes (rollout finished, adoption) stay
+        #: immediate: kubectl-apply responsiveness and queued-rollout
+        #: dispatch must not pay the gap
+        from tpu_cc_manager.config import _env_float
+
+        self.min_scan_gap_s = _env_float(
+            "TPU_CC_POLICY_MIN_SCAN_GAP_S", 2.0
+        )
+        self._wake_gap_pending = False
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
         self._server.add_route("/readyz", self._readyz)
@@ -1314,6 +1326,31 @@ class PolicyController:
                 rv = None
                 self._stop.wait(self.watch_backoff_s)
 
+    def _node_wake(self) -> None:
+        """Wake from the NODE watch: marks the wake as coalescable —
+        the run loop sleeps the min scan gap before scanning, folding a
+        rollout's per-flip label churn into one scan. CR-spec and
+        internal wakes (rollout finished, adoption) stay immediate."""
+        self._wake_gap_pending = True
+        self._wake.set()
+
+    def _node_watch_loop(self) -> None:
+        """Background NODE watch (the CR watch's sibling, pumped by
+        fleet.run_node_watch): agents converging, drift-healing, or
+        publishing evidence change the per-policy converged counts and
+        conflict picture, and waiting out the interval to notice makes
+        the statuses stale mid-flight. Fingerprint-filtered — periodic
+        doctor republish timestamps don't wake. Degrades silently to
+        interval polling when the client has no node watch."""
+        from tpu_cc_manager.fleet import run_node_watch
+
+        run_node_watch(
+            self.kube, self._stop, self._node_wake,
+            timeout_s=self.watch_timeout_s,
+            backoff_s=self.watch_backoff_s,
+            logger=log, who="policy",
+        )
+
     def run(self) -> int:
         self._server.start()
         log.info(
@@ -1324,6 +1361,11 @@ class PolicyController:
             target=self._watch_loop, name="policy-watch", daemon=True
         )
         watcher.start()
+        node_watcher = threading.Thread(
+            target=self._node_watch_loop, name="policy-node-watch",
+            daemon=True,
+        )
+        node_watcher.start()
         if self.leader_elector is not None:
             self.leader_elector.start()
         try:
@@ -1363,8 +1405,16 @@ class PolicyController:
                             self.consecutive_errors,
                         )
                         return 1
-                # interval tick OR an immediate wake from the watch
-                self._wake.wait(self.interval_s)
+                # interval tick OR a wake from either watch. Only a
+                # node-event wake sleeps the coalescing gap (so a
+                # rollout group's label churn folds into one scan);
+                # the flag is reset after reading, so a later internal
+                # wake is never delayed by an earlier node one
+                if self._wake.wait(self.interval_s):
+                    needs_gap = self._wake_gap_pending
+                    self._wake_gap_pending = False
+                    if needs_gap:
+                        self._stop.wait(self.min_scan_gap_s)
             return 0
         finally:
             self.stop()
