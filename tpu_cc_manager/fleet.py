@@ -571,7 +571,10 @@ class FleetController:
                 # two scans, not 32) and bounds watch-driven scan rate
                 if self._wake.wait(self.interval_s):
                     self._wake.clear()
-                    self._stop.wait(self.min_scan_gap_s)
+                    # capped at the interval: a wake may only ever make
+                    # the next scan SOONER than the tick it replaced
+                    self._stop.wait(min(self.min_scan_gap_s,
+                                        self.interval_s))
             return 0
         finally:
             self.stop()

@@ -1386,8 +1386,13 @@ class PolicyController:
                         self.leader_elector.retry_period_s
                     )
                     self._wake.clear()
+                    self._wake_gap_pending = False
                     continue
+                # the gap flag travels WITH the wake it annotated:
+                # clearing a consumed wake without resetting it would
+                # make a later internal wake pay a stale node-gap
                 self._wake.clear()
+                self._wake_gap_pending = False
                 try:
                     # wait_rollout=False: the scan loop keeps serving
                     # statuses/conflicts/metrics for every other policy
@@ -1414,7 +1419,11 @@ class PolicyController:
                     needs_gap = self._wake_gap_pending
                     self._wake_gap_pending = False
                     if needs_gap:
-                        self._stop.wait(self.min_scan_gap_s)
+                        # capped at the interval: a wake may only ever
+                        # make the next scan SOONER than the tick it
+                        # replaced, never later
+                        self._stop.wait(min(self.min_scan_gap_s,
+                                            self.interval_s))
             return 0
         finally:
             self.stop()
