@@ -270,6 +270,25 @@ def test_demotion_stops_rollout_and_leaves_record_adoptable():
     assert record["complete"] is False  # adoptable, not finished
     assert record["aborted"] is False
 
+    # the stop is a HANDOFF, not a failure: no Degraded status, no
+    # Warning event, no fairness backoff — a brief leadership flap
+    # must not penalize a healthy policy for up to 900s
+    pol = kube.get_cluster_custom(
+        L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL, "pol"
+    )
+    status = pol.get("status") or {}
+    assert status.get("phase") != "Degraded", status
+    assert "handed off" in status.get("message", ""), status
+    assert "lastRollout" not in status  # the adopter writes the real one
+    assert c._retry_after == {}, "handoff must not back the policy off"
+    assert c._failures == {}
+    reasons = [e.get("reason") for e in kube.cluster_events]
+    assert "PolicyRolloutHandedOff" in reasons
+    assert "PolicyRolloutAborted" not in reasons
+    warning_types = [e.get("type") for e in kube.cluster_events
+                     if e.get("reason", "").startswith("PolicyRollout")]
+    assert "Warning" not in warning_types
+
 
 def test_readyz_is_leader_aware():
     """Standby: healthy (liveness ok) but NOT ready — the Service must

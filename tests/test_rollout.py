@@ -1007,3 +1007,79 @@ def test_unsigned_doc_attesting_wrong_mode_is_forensic(tmp_path,
     assert group.outcome == "timeout"
     assert "attests 'off'" in group.detail
     assert "tpu-cc-evidence-key" not in group.detail
+
+
+def test_report_surfaces_stopped_groups_as_handoff():
+    """A cooperative stop's groups are first-class: named by
+    ``report.stopped``, excluded from ``failed``, and flagged
+    ``stopped_early`` in the serialized report — downstream consumers
+    (policy lastRollout, operators reading --json) must be able to
+    tell a handoff from a failure."""
+    from tpu_cc_manager.rollout import GroupResult, RolloutReport
+
+    report = RolloutReport(
+        "on",
+        [
+            GroupResult("g0", ["n1"], "succeeded"),
+            GroupResult("g1", ["n2"], "stopped", "leadership lost"),
+            GroupResult("g2", ["n3"], "stopped", "leadership lost"),
+        ],
+        aborted=True,
+        preflight={},
+        stopped_early=True,
+        stop_reason="leadership lost",
+    )
+    assert report.stopped == ["g1", "g2"]
+    assert report.failed == []  # a handoff is not a failure
+    assert not report.ok  # but work remains
+    d = report.to_dict()
+    assert d["stopped_early"] is True
+    assert d["stop_reason"] == "leadership lost"
+    # a finished report carries no stop keys at all
+    done = RolloutReport(
+        "on", [GroupResult("g0", ["n1"], "succeeded")],
+        aborted=False, preflight={},
+    )
+    assert "stopped_early" not in done.to_dict()
+    assert done.stopped == []
+
+
+def test_stop_of_already_aborted_rollout_stays_a_failure():
+    """A demotion stop arriving while an ALREADY-aborted rollout
+    (canary/budget failure, record persisted aborted=True) drains its
+    in-flight groups must not relabel the failure as a clean handoff:
+    ``stopped_early`` stays False so the policy still goes Degraded,
+    emits the Warning event, and applies backoff."""
+    kube = FakeKube()
+    _pool(kube,
+          _node("e0", desired="on", state="on"),     # succeeded pre-crash
+          _node("e1", desired="on", state="off"))    # in flight, no agent
+    _write_record(kube, "e0", {
+        "id": "stopabort", "started": 1.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": True,
+        "groups": {
+            "node/e0": {"nodes": ["e0"], "outcome": "succeeded"},
+            "node/eX": {"nodes": ["eX"], "outcome": "failed",
+                        "detail": "budget burner"},
+            "node/e1": {"nodes": ["e1"], "outcome": "in_flight"},
+        },
+    })
+    roll = Rollout.resume(kube, poll_s=0.05, group_timeout_s=30)
+    box = {}
+
+    def run():
+        box["report"] = roll.run()
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)  # let the drain loop spin on the dead in-flight group
+    roll.request_stop("leadership lost")
+    t.join(timeout=10)
+    assert not t.is_alive()
+    report = box["report"]
+    assert report.aborted
+    assert report.stopped_early is False, \
+        "a pre-existing abort must not be masked as a handoff"
+    assert "node/eX" in report.failed

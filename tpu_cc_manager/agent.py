@@ -17,6 +17,7 @@ Error philosophy (reference cmd/main.go:164-167 + main.py:300-307):
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -161,8 +162,12 @@ class CCManagerAgent:
         # Event-name uniqueness: per-process counter + a startup-unique
         # token, so a restarted agent never collides with the previous
         # process's still-live events (409 AlreadyExists would silently
-        # drop them)
-        self._event_seq = 0
+        # drop them). itertools.count: next() is atomic under the GIL,
+        # and events are emitted from two threads (reconcile outcomes,
+        # and CCEvidenceResigned from inside the recorder's publish
+        # task) — a racing += could mint duplicate names whose second
+        # create 409s and is silently dropped.
+        self._event_seq = itertools.count(1)
         self._event_token = uuid.uuid4().hex[:8]
         self._event_warned = False
         # Async event delivery (client-go EventRecorder parity): the
@@ -528,12 +533,12 @@ class CCManagerAgent:
         spaces distinct."""
         if not self.cfg.emit_events:
             return
-        self._event_seq += 1
+        seq = next(self._event_seq)
         event = build_node_event(
             self.cfg.node_name, reason, message, etype,
             name=(
                 f"{self.cfg.node_name}.{infix}."
-                f"{self._event_token}.{self._event_seq}"
+                f"{self._event_token}.{seq}"
             ),
         )
         if self._enqueue_recorder_item(event) == "full":

@@ -661,7 +661,7 @@ class PolicyController:
                 if self._active is not None:
                     self._active["status"] = dict(wst)  # final snapshot
                 self.metrics.rollouts.inc(outcome)
-                self._note_outcome_locked(name, outcome == "ok")
+                self._note_outcome_locked(name, outcome)
                 self._active = None
             try:
                 self._patch_status(pol, wst)  # final outcome, worker-owned
@@ -711,13 +711,19 @@ class PolicyController:
                 self._active["status"] = dict(st)
         self._patch_status(pol, st)
 
-    def _note_outcome_locked(self, name: str, ok: bool) -> None:
+    def _note_outcome_locked(self, name: str, outcome: str) -> None:
         """Fairness bookkeeping for a finished rollout (caller holds
         ``_active_lock``): success clears the policy's backoff, failure
         backs it off exponentially — the ADOPTED path must feed this
         too, or every crash/failover would reset the backoff the
-        fairness mechanism exists to enforce."""
-        if ok:
+        fairness mechanism exists to enforce. A cooperative stop
+        (leader demotion handoff) is neither: the policy did nothing
+        wrong and its record is being left for adoption, so its backoff
+        state is left untouched — a brief leadership flap must not
+        penalize a healthy policy."""
+        if outcome in ("stopped", "resumed_stopped"):
+            return
+        if outcome in ("ok", "resumed_ok", "resume_noop"):
             self._failures.pop(name, None)
             self._retry_after.pop(name, None)
         else:
@@ -970,8 +976,14 @@ class PolicyController:
                     report = rollout.run()
                 finally:
                     self._current_rollout = None
-                outcome = "resumed_ok" if report.ok else "resumed_failed"
-                ok = report.ok
+                if report.stopped_early:
+                    # demoted again mid-resume: another handoff, not a
+                    # failure — same treatment as the fresh-launch path
+                    outcome, ok = "resumed_stopped", False
+                else:
+                    outcome = ("resumed_ok" if report.ok
+                               else "resumed_failed")
+                    ok = report.ok
             except RolloutError as e:
                 if "no unfinished rollout" in str(e):
                     # benign race: the original driver completed the
@@ -999,6 +1011,13 @@ class PolicyController:
                         f"rollout {record.get('id')!r} was completed "
                         "by its original driver"
                     )
+                elif outcome == "resumed_stopped":
+                    wst["phase"] = "Rolling"
+                    wst["message"] = (
+                        f"adopted rollout {record.get('id')!r} handed "
+                        f"off again ({report.stop_reason}): record "
+                        "left for adoption"
+                    )
                 else:
                     wst["phase"] = "Converged" if ok else "Degraded"
                     wst["message"] = (
@@ -1013,7 +1032,7 @@ class PolicyController:
                         wst.get("converged", 0) + wst.get("divergent", 0)
                     )
                     wst["divergent"] = 0
-                if report is not None:
+                if report is not None and not report.stopped_early:
                     wst["lastRollout"] = _last_rollout_status(
                         report, adopted=True
                     )
@@ -1024,8 +1043,8 @@ class PolicyController:
                 if owner is not None:
                     # a failed ADOPTED rollout backs its policy off
                     # like a failed fresh one — failover must not
-                    # reset the fairness mechanism
-                    self._note_outcome_locked(owner, ok)
+                    # reset the fairness mechanism (handoffs exempt)
+                    self._note_outcome_locked(owner, outcome)
                 self._active = None
             if wst is not None:
                 try:
@@ -1114,6 +1133,22 @@ class PolicyController:
                 name, "PolicyRolloutRefused", str(e), "Warning"
             )
             return "refused"
+        if report.stopped_early:
+            # cooperative stop (leader demotion): a handoff, not a
+            # failure — the record was intentionally left unfinished for
+            # the new leader's adoption. No Degraded phase, no Warning
+            # event, no backoff, and no lastRollout (the adopter
+            # finishes the rollout and writes the real one).
+            st["phase"] = "Rolling"
+            st["message"] = (
+                f"rollout handed off ({report.stop_reason}): "
+                f"{len(report.stopped)} group(s) left for adoption"
+            )
+            log.info("policy %s: %s", name, st["message"])
+            self._emit_policy_event(
+                name, "PolicyRolloutHandedOff", st["message"]
+            )
+            return "stopped"
         st["lastRollout"] = _last_rollout_status(report)
         if report.ok:
             st["phase"] = "Converged"

@@ -139,6 +139,9 @@ class GroupResult:
     name: str
     nodes: List[str]
     #: skipped | planned | succeeded | failed | timeout | not_attempted
+    #: | stopped — ``stopped`` marks groups left behind by a cooperative
+    #: stop (leader demotion): intentionally unfinished, the durable
+    #: record stays adoptable, and the group is NOT a failure
     outcome: str
     detail: str = ""
 
@@ -155,6 +158,13 @@ class RolloutReport:
     groups: List[GroupResult]
     aborted: bool
     preflight: dict
+    #: True when the rollout exited via a cooperative stop (leader
+    #: demotion) rather than finishing or aborting on failures. The
+    #: report is still not ``ok`` — work remains — but the durable
+    #: record was intentionally left unfinished for adoption, so
+    #: consumers must read this as a handoff, not a failure.
+    stopped_early: bool = False
+    stop_reason: str = ""
 
     @property
     def failed(self) -> List[str]:
@@ -165,17 +175,26 @@ class RolloutReport:
         return [g.name for g in self.groups if g.outcome == "succeeded"]
 
     @property
+    def stopped(self) -> List[str]:
+        """Groups handed off unfinished by a cooperative stop."""
+        return [g.name for g in self.groups if g.outcome == "stopped"]
+
+    @property
     def ok(self) -> bool:
         return not self.aborted and not self.failed
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "mode": self.mode,
             "ok": self.ok,
             "aborted": self.aborted,
             "groups": [g.to_dict() for g in self.groups],
             "preflight": self.preflight,
         }
+        if self.stopped_early:
+            out["stopped_early"] = True
+            out["stop_reason"] = self.stop_reason
+        return out
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2, sort_keys=True)
@@ -781,8 +800,17 @@ class Rollout:
                     results.append(GroupResult(
                         gname, members, "stopped", reason
                     ))
-                report.aborted = True  # report-level only: not ok, but
-                # the RECORD stays non-aborted + incomplete = adoptable
+                # a rollout that had ALREADY aborted (canary/budget
+                # failure, record persisted aborted=True) stays a
+                # failure — the stop only cuts its in-flight drain
+                # short; flagging it as a clean handoff would mask the
+                # abort from the policy's Degraded status and backoff
+                if not report.aborted:
+                    report.stopped_early = True
+                    report.stop_reason = reason
+                report.aborted = True  # report-level only: for a pure
+                # handoff the RECORD stays non-aborted + incomplete =
+                # adoptable
                 log.warning(
                     "rollout stopped (%s): leaving record %s for "
                     "adoption (%d in-flight, %d pending)", reason,
