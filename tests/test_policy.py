@@ -1909,3 +1909,69 @@ def test_node_watch_refreshes_status_between_intervals():
         c.stop()
         t.join(timeout=10)
         assert not t.is_alive()
+
+
+def test_future_record_version_holds_slot_and_warns():
+    """Rolling-upgrade skew: an unfinished record written by a NEWER
+    controller (schema version > supported) must not be adopted — its
+    shape cannot be parsed safely — but its existence still holds the
+    rollout slot so this controller does not start a second rollout
+    over the same nodes. Loudness: error-level status message on the
+    owning policy, plus ONE Warning PolicyRolloutVersionSkew event."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    record = {
+        "version": 99, "id": "futrec", "started": time.time(),
+        "mode": "on", "selector": L.TPU_ACCELERATOR_LABEL,
+        "complete": False,
+        # the evolved shape this controller cannot understand
+        "phases": [{"wave": 1, "members": ["n0"], "state": "rolling"}],
+    }
+    kube.set_node_annotations(
+        "n0", {L.ROLLOUT_ANNOTATION: json.dumps(record)}
+    )
+    kube.add_custom(G, P, make_policy("skewpol"))
+    c = controller(kube, adopt_after_s=0)
+    r1 = c.scan_once()
+    r2 = c.scan_once()  # would adopt were the version supported
+    for r in (r1, r2):
+        st = r["policies"]["skewpol"]
+        assert "version 99" in st["message"], st
+        assert "refusing to adopt" in st["message"]
+    # slot held: no worker ever launched, no new rollout started
+    assert c._active is None
+    rec = json.loads(
+        kube.get_node("n0")["metadata"]["annotations"][
+            L.ROLLOUT_ANNOTATION]
+    )
+    assert rec == record, "the future record must not be touched"
+    skew_events = [e for e in kube.cluster_events
+                   if e.get("reason") == "PolicyRolloutVersionSkew"]
+    assert len(skew_events) == 1, "event fires once per record"
+    assert skew_events[0]["type"] == "Warning"
+    assert skew_events[0]["involvedObject"]["name"] == "skewpol"
+
+
+def test_version_skew_event_waits_for_resolvable_owner():
+    """The one-shot PolicyRolloutVersionSkew Warning must not be burned
+    while the owning policy is unresolvable (created a tick later, or
+    its spec momentarily unparseable): the event fires on the first
+    tick the owner resolves."""
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="on", state="off"))
+    kube.set_node_annotations("n0", {L.ROLLOUT_ANNOTATION: json.dumps({
+        "version": 99, "id": "laterec", "started": time.time(),
+        "mode": "on", "selector": L.TPU_ACCELERATOR_LABEL,
+        "complete": False, "groups": {},
+    })})
+    c = controller(kube, adopt_after_s=0)
+    c.scan_once()  # no policy yet: slot held, no event to attach
+    assert not [e for e in kube.cluster_events
+                if e.get("reason") == "PolicyRolloutVersionSkew"]
+    kube.add_custom(G, P, make_policy("latepol"))
+    c.scan_once()
+    c.scan_once()
+    skew = [e for e in kube.cluster_events
+            if e.get("reason") == "PolicyRolloutVersionSkew"]
+    assert len(skew) == 1, "fires once, on the first resolvable tick"
+    assert skew[0]["involvedObject"]["name"] == "latepol"

@@ -1083,3 +1083,98 @@ def test_stop_of_already_aborted_rollout_stays_a_failure():
     assert report.stopped_early is False, \
         "a pre-existing abort must not be masked as a handoff"
     assert "node/eX" in report.failed
+
+
+def test_record_schema_version_round_trip_and_skew():
+    """The durable record carries a schema version (the rollout sibling
+    of EVIDENCE_VERSION): new records are stamped v1; versionless
+    records (pre-versioning controllers) resume as v1 and get the
+    stamp on their next persist; records from the FUTURE — including
+    unparseable versions — are refused with a message naming both
+    versions, never misparsed."""
+    import json as _json
+
+    from tpu_cc_manager.rollout import (
+        ROLLOUT_RECORD_VERSION, rollout_record_version,
+    )
+
+    # fresh rollouts stamp the current version into the record
+    kube = FakeKube()
+    _pool(kube, _node("v0", desired="off", state="off"))
+    agents = _ReactiveAgents(kube, ["v0"])
+    agents.start()
+    try:
+        Rollout(kube, "on", poll_s=0.05, group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+    rec = _json.loads(
+        kube.get_node("v0")["metadata"]["annotations"][
+            L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["version"] == ROLLOUT_RECORD_VERSION == 1
+
+    # versionless = v1 (claim helper), unparseable = future
+    assert rollout_record_version({}) == 1
+    assert rollout_record_version({"version": 1}) == 1
+    assert rollout_record_version({"version": "2"}) == 2
+    assert rollout_record_version({"version": "two"}) > 1
+
+
+def test_resume_accepts_versionless_record_and_stamps_v1():
+    """A record written by a pre-versioning controller (no "version"
+    key) resumes cleanly — the old-record/new-controller skew
+    direction — and the resumed run's persists stamp it v1."""
+    kube = FakeKube()
+    _pool(kube, _node("w0", desired="on", state="off"))
+    _write_record(kube, "w0", {
+        "id": "oldrec", "started": 1.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": False,
+        "groups": {"node/w0": {"nodes": ["w0"], "outcome": "in_flight"}},
+    })
+    agents = _ReactiveAgents(kube, ["w0"])
+    agents.start()
+    try:
+        report = Rollout.resume(kube, poll_s=0.05,
+                                group_timeout_s=10).run()
+    finally:
+        agents.stop.set()
+    import json as _json
+
+    assert report.ok
+    rec = _json.loads(
+        kube.get_node("w0")["metadata"]["annotations"][
+            L.ROLLOUT_ANNOTATION]
+    )
+    assert rec["complete"] is True
+    assert rec["version"] == 1
+
+
+def test_resume_refuses_future_record_version():
+    """The new-record/old-controller skew direction: a record whose
+    shape evolved under a newer schema version (here: group state moved
+    to an unknown key) must be refused with both versions named — a
+    silent misparse would resume the rollout with every group
+    invisible."""
+    kube = FakeKube()
+    _pool(kube, _node("f0", desired="on", state="off"))
+    _write_record(kube, "f0", {
+        "version": 99, "id": "futurerec", "started": 1.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "complete": False,
+        # the migrated shape this controller cannot understand:
+        "phases": [{"wave": 1, "members": ["f0"], "state": "rolling"}],
+    })
+    with pytest.raises(RolloutError) as ei:
+        Rollout.resume(kube, poll_s=0.05)
+    assert "version 99" in str(ei.value)
+    assert "v1" in str(ei.value)
+    # unparseable version strings are refused the same way
+    _write_record(kube, "f0", {
+        "version": "two", "id": "junkver", "started": 1.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL, "complete": False,
+        "groups": {},
+    })
+    with pytest.raises(RolloutError):
+        Rollout.resume(kube, poll_s=0.05)

@@ -65,7 +65,8 @@ from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.modes import InvalidModeError, parse_mode
 from tpu_cc_manager.obs import Counter, Gauge, Histogram, RouteServer
 from tpu_cc_manager.rollout import (
-    HEARTBEAT_STALE_S, Rollout, RolloutError, load_rollout_record,
+    HEARTBEAT_STALE_S, ROLLOUT_RECORD_VERSION, Rollout, RolloutError,
+    load_rollout_record, rollout_record_version,
 )
 
 log = logging.getLogger("tpu-cc-manager.policy")
@@ -292,6 +293,10 @@ class PolicyController:
         #: value moves — never by comparing the stamp (another host's
         #: wall clock) against local time.
         self._hb_seen: Dict[str, Tuple[object, float]] = {}
+        #: record ids whose future-schema-version refusal has already
+        #: been announced with an Event — the log stays loud every
+        #: tick, the Event fires once per record
+        self._future_record_warned: set = set()
         self._stop = threading.Event()
         #: set by the watch thread on any policy change: the run loop
         #: scans immediately instead of waiting out the interval —
@@ -869,6 +874,34 @@ class PolicyController:
         if record is None or record.get("complete"):
             self._hb_seen.clear()  # no unfinished record: reset watch
             return False, None
+        ver = rollout_record_version(record)
+        if ver > ROLLOUT_RECORD_VERSION:
+            # a NEWER controller wrote this record: its shape cannot be
+            # parsed safely by this version — adopting could silently
+            # drop groups or corrupt its state. Hold the slot (the
+            # record's existence still means a rollout is in flight on
+            # these nodes) and be loud: error-log every tick, Event
+            # once, and say so in the matching policy's status.
+            rid = str(record.get("id"))
+            msg = (
+                f"unfinished rollout {rid!r} has record schema "
+                f"version {ver} > supported v{ROLLOUT_RECORD_VERSION} "
+                "(written by a newer controller); refusing to adopt — "
+                "upgrade this controller or let the newer one finish"
+            )
+            log.error("%s", msg)
+            owner = self._match_record_owner(record, policies_by_name)
+            if owner is not None and owner[0] in statuses:
+                statuses[owner[0]]["message"] = msg
+            # mark warned only once the event actually lands on a
+            # resolved owner — a policy that appears (or parses) a tick
+            # later must still get its one Warning
+            if owner is not None and rid not in self._future_record_warned:
+                self._future_record_warned.add(rid)
+                self._emit_policy_event(
+                    owner[0], "PolicyRolloutVersionSkew", msg, "Warning"
+                )
+            return True, None
         if not self._record_observed_stale(record):
             # the heartbeat is still moving (or we haven't watched it
             # long enough): a rollout process — a human-run `rollout`,
@@ -924,17 +957,9 @@ class PolicyController:
         # record (selector + mode): after a leader failover this is the
         # normal continuation of that policy's rollout, and its status
         # must show live progress — not go dark until the resume ends
-        owner = None
-        pol = None
-        for name, p in (policies_by_name or {}).items():
-            try:
-                spec = parse_policy_spec(p)
-            except PolicySpecError:
-                continue
-            if (spec["selector"] == record.get("selector")
-                    and spec["mode"] == record.get("mode")):
-                owner, pol = name, p
-                break
+        owner, pol = self._match_record_owner(
+            record, policies_by_name
+        ) or (None, None)
         wst = None
         if owner is not None and owner in statuses:
             wst = dict(statuses[owner])
@@ -1018,6 +1043,11 @@ class PolicyController:
                         f"off again ({report.stop_reason}): record "
                         "left for adoption"
                     )
+                    # failover-history parity with the fresh-launch
+                    # handoff: every demotion shows in the event trail
+                    self._emit_policy_event(
+                        owner, "PolicyRolloutHandedOff", wst["message"]
+                    )
                 else:
                     wst["phase"] = "Converged" if ok else "Degraded"
                     wst["message"] = (
@@ -1068,6 +1098,22 @@ class PolicyController:
             self._last_worker = self._active
         t.start()
         return True, owner
+
+    @staticmethod
+    def _match_record_owner(record, policies_by_name):
+        """The policy a durable record belongs to (spec selector+mode
+        match) -> (name, policy) or None — shared by adoption
+        attribution and the version-skew refusal, so the two cannot
+        disagree about ownership."""
+        for name, p in (policies_by_name or {}).items():
+            try:
+                spec = parse_policy_spec(p)
+            except PolicySpecError:
+                continue
+            if (spec["selector"] == record.get("selector")
+                    and spec["mode"] == record.get("mode")):
+                return name, p
+        return None
 
     def _record_observed_stale(self, record: dict) -> bool:
         """Has this record's heartbeat sat UNCHANGED for adopt_after_s

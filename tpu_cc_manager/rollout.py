@@ -93,6 +93,31 @@ HEARTBEAT_PERIOD_S = 5.0
 #: record counts as abandoned.
 HEARTBEAT_STALE_S = 30.0
 
+#: Durable-record schema version (the rollout-record sibling of
+#: evidence.EVIDENCE_VERSION): bump on any incompatible change to the
+#: record's SHAPE. The record is cluster state parsed by every future
+#: controller version, so skew is a fact of life during rolling
+#: controller upgrades: records WITHOUT a version (written by
+#: pre-versioning controllers) read as v1; records from the FUTURE (a
+#: newer controller evolved the shape) are refused loudly by
+#: resume/adoption — misparsing them could silently drop or corrupt a
+#: resumable rollout — while the concurrent-rollout guard still honors
+#: them (their existence is meaningful even when their shape is not
+#: parseable).
+ROLLOUT_RECORD_VERSION = 1
+
+
+def rollout_record_version(record: dict) -> int:
+    """The schema version a record claims: versionless = v1 (the shape
+    every pre-versioning controller wrote); an unparseable version is
+    treated as from the future — whatever wrote it, it was not any
+    released controller, so refusing beats guessing."""
+    v = record.get("version", 1)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return ROLLOUT_RECORD_VERSION + 1
+
 
 class OwnershipLostError(RolloutError):
     """Another process took over this rollout's durable record (the
@@ -316,6 +341,16 @@ class Rollout:
             )
         if record is None or record.get("complete"):
             raise RolloutError("no unfinished rollout to resume on this pool")
+        ver = rollout_record_version(record)
+        if ver > ROLLOUT_RECORD_VERSION:
+            raise RolloutError(
+                f"rollout record {record.get('id')!r} has schema "
+                f"version {ver}, newer than this controller's supported "
+                f"v{ROLLOUT_RECORD_VERSION}: a newer controller wrote "
+                "it; upgrade this controller (or let the newer one "
+                "finish) instead of resuming with a shape this version "
+                "cannot parse safely"
+            )
         r = cls(
             kube, record["mode"],
             selector=record.get("selector", selector),
@@ -325,6 +360,10 @@ class Rollout:
             dry_run=dry_run, verify_evidence=verify_evidence,
             on_group=on_group,
         )
+        # a versionless (pre-versioning) record is adopted as v1: this
+        # controller maintains a v1 shape from here on, and persists say
+        # so explicitly
+        record.setdefault("version", ROLLOUT_RECORD_VERSION)
         r._resume_from = (record, record_node)
         r._force_claim = True
         return r
@@ -599,6 +638,7 @@ class Rollout:
                 self._record_node = sorted(by_name)[0]  # pool anchor
                 self._canary_left = min(self.canary, len(pending))
                 self._record = {
+                    "version": ROLLOUT_RECORD_VERSION,
                     "id": _uuid.uuid4().hex[:8],
                     "started": time.time(),
                     "mode": self.mode,
