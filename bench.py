@@ -206,7 +206,7 @@ def _wait_pool(store, names, target, timeout=240.0):
 
 def _run_pool_convergence(names, readiness_dir, prefix, *,
                           slice_of=None, drained=False, dwell_s=0.5,
-                          flip=None):
+                          flip=None, extra_labels=None):
     """Shared convergence harness for the dominator scenarios: build a
     pool, run one real agent per node, flip every desired label to "on"
     (or let ``flip(store, server, names)`` initiate the change — the
@@ -243,6 +243,8 @@ def _run_pool_convergence(names, readiness_dir, prefix, *,
         }
         if slice_of is not None:
             labels[L.TPU_SLICE_LABEL] = slice_of(name)
+        if extra_labels is not None:
+            labels.update(extra_labels(name))
         if drained:
             labels[dp_label] = "true"
         store.add_node(make_node(name, labels=labels))
@@ -413,6 +415,49 @@ def run_policy_bench(n_nodes, readiness_dir):
     return _run_pool_convergence(names, readiness_dir, "po", flip=flip)
 
 
+def run_multi_policy_bench(n_pools, nodes_per_pool, readiness_dir):
+    """Concurrent-rollout scenario (round 5): N TPUCCPolicies over N
+    DISJOINT pools land in one tick and ONE controller converges them
+    all in parallel worker slots (policy.py TPU_CC_MAX_ROLLOUTS) —
+    the serialized alternative would be ~N x one pool's chain. The
+    number is the whole wall clock from policy creation to the LAST
+    pool's evidence-verified convergence."""
+    from tpu_cc_manager.policy import PolicyController
+
+    names = [
+        f"mp{p}-{i:02d}"
+        for p in range(n_pools) for i in range(nodes_per_pool)
+    ]
+
+    def pool_of(name):
+        return name.split("-", 1)[0]
+
+    def flip(store, server, names):
+        for p in range(n_pools):
+            store.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+                "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+                "kind": L.POLICY_KIND,
+                "metadata": {"name": f"bench-policy-{p}"},
+                "spec": {
+                    "mode": "on",
+                    "nodeSelector": f"bench.pool=mp{p}",
+                    "strategy": {"maxUnavailable": nodes_per_pool,
+                                 "groupTimeoutSeconds": 120},
+                },
+            })
+        kube = HttpKubeClient(
+            KubeConfig("127.0.0.1", server.port, use_tls=False)
+        )
+        ctrl = PolicyController(kube, poll_s=0.05,
+                                max_rollouts=n_pools)
+        threading.Thread(target=ctrl.scan_once, daemon=True).start()
+
+    return _run_pool_convergence(
+        names, readiness_dir, "mp", flip=flip,
+        extra_labels=lambda n: {"bench.pool": pool_of(n)},
+    )
+
+
 def bench_real_chip(state_dir: str):
     """Real-hardware L0 extra: when the host exposes a live TPU through
     PJRT, drive one full stage→reset→wait→verify flip cycle on the real
@@ -495,6 +540,12 @@ def main():
         # controller -> rollout -> agents -> evidence-backed convergence
         result["extras"]["policy_pool_convergence_s"] = run_policy_bench(
             args.nodes, d
+        )
+        # concurrent rollout slots (round 5): 3 disjoint pools through
+        # ONE controller in parallel — compare against ~3x the
+        # policy_pool_convergence_s chain a serialized scheduler paid
+        result["extras"]["multi_policy_parallel_convergence_s"] = (
+            run_multi_policy_bench(3, 4, d)
         )
     print(json.dumps(result))
 

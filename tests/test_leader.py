@@ -260,10 +260,12 @@ def test_demotion_stops_rollout_and_leaves_record_adoptable():
     # otherwise sit in the 60s group timeout
     r = c.scan_once(wait_rollout=False)
     assert r["policies"]["pol"]["phase"] == "Rolling"
-    assert _wait(lambda: c._current_rollout is not None)
+    assert _wait(lambda: any(
+        w.get("rollout") is not None for w in c._workers.values()
+    ))
 
     c._on_demoted()  # leadership lost mid-roll
-    assert _wait(lambda: c._active is None, timeout=5), \
+    assert _wait(lambda: not c._workers, timeout=5), \
         "worker did not stop after demotion"
     record, _ = load_rollout_record(kube, kube.list_nodes(None))
     assert record is not None

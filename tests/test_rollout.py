@@ -699,10 +699,22 @@ def test_resume_uses_recorded_selector_and_guard_sees_foreign_records():
             "node/c1": {"nodes": ["c1"], "outcome": "pending"},
         },
     })
-    # a new rollout over a DIFFERENT selector is refused
-    kube.add_node(_node("other1", desired="off", state="off"))
+    # a new rollout whose pool OVERLAPS the record's nodes is refused
+    # (here: the same custom selector) — selector strings differing is
+    # irrelevant, node overlap is what the guard scopes on
     with pytest.raises(RolloutError, match="--resume"):
-        Rollout(kube, "on").run()
+        Rollout(kube, "off", selector="pool=custom").run()
+    # a DISJOINT pool may roll concurrently (per-pool records): the
+    # default-selector node is untouched by the custom-pool record
+    kube.add_node(_node("other1", desired="off", state="off"))
+    agents_d = _ReactiveAgents(kube, ["other1"])
+    agents_d.start()
+    try:
+        rep_d = Rollout(kube, "on", poll_s=0.05,
+                        group_timeout_s=30).run()
+    finally:
+        agents_d.stop.set()
+    assert rep_d.ok
     # resume with the DEFAULT selector still finds + scopes the record
     agents = _ReactiveAgents(kube, ["c0", "c1"])
     agents.start()
@@ -1178,3 +1190,34 @@ def test_resume_refuses_future_record_version():
     })
     with pytest.raises(RolloutError):
         Rollout.resume(kube, poll_s=0.05)
+
+
+def test_explicit_selector_resume_never_wanders_to_another_pool():
+    """`rollout --resume --selector pool=a` with pool a's record
+    COMPLETE must refuse — not fall back to a cluster-wide search and
+    force-claim pool b's (possibly live) rollout out from under its
+    driver. The unscoped default still finds pool b's record."""
+    kube = FakeKube()
+    kube.add_node(make_node("pa0", labels={
+        "pool": "a", L.CC_MODE_LABEL: "on",
+        L.CC_MODE_STATE_LABEL: "on"}))
+    _write_record(kube, "pa0", {
+        "version": 1, "id": "adone", "started": 5.0, "mode": "on",
+        "selector": "pool=a", "complete": True, "aborted": False,
+        "groups": {"node/pa0": {"nodes": ["pa0"],
+                                "outcome": "succeeded"}},
+    })
+    _pool(kube, _node("pb0", desired="on", state="off"))
+    _write_record(kube, "pb0", {
+        "version": 1, "id": "blive", "started": 6.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": False,
+        "groups": {"node/pb0": {"nodes": ["pb0"],
+                                "outcome": "in_flight"}},
+    })
+    with pytest.raises(RolloutError, match="no unfinished rollout"):
+        Rollout.resume(kube, selector="pool=a", poll_s=0.05)
+    # unscoped: pool b's unfinished record is fair game
+    r = Rollout.resume(kube, poll_s=0.05, dry_run=True)
+    assert r._resume_from[0]["id"] == "blive"

@@ -31,6 +31,7 @@ import logging
 import os
 import sys
 
+from tpu_cc_manager import labels as L
 from tpu_cc_manager.agent import CCManagerAgent
 from tpu_cc_manager.config import parse_config
 from tpu_cc_manager.drain import build_drainer, set_cc_mode_state_label
@@ -174,7 +175,7 @@ def main(argv=None) -> int:
                 rollout = Rollout(
                     _kube_client(cfg),
                     args.mode,
-                    selector=args.selector,
+                    selector=args.selector or L.TPU_ACCELERATOR_LABEL,
                     max_unavailable=args.max_unavailable,
                     failure_budget=args.failure_budget,
                     canary=args.canary,

@@ -37,6 +37,10 @@ flag                      env                            default
                                                         watch-triggered fleet scans)
 (none)                    TPU_CC_POLICY_MIN_SCAN_GAP_S   2 (coalescing gap after any
                                                         policy-scan wake)
+(none)                    TPU_CC_MAX_ROLLOUTS            3 (policy controller rollout
+                                                        worker slots: disjoint pools
+                                                        roll concurrently; 1 = strict
+                                                        serialization)
 (none)                    TPU_CC_IDENTITY                auto | gce | fake | none (platform
                                                         identity attached to evidence)
 (none)                    TPU_CC_IDENTITY_KEY[_FILE]     "" (HS256 key, fake provider only)
@@ -235,8 +239,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     roll.add_argument(
         "--selector",
-        default=L.TPU_ACCELERATOR_LABEL,
-        help="label selector scoping the pool",
+        default=None,
+        help="label selector scoping the pool (default: "
+             f"{L.TPU_ACCELERATOR_LABEL}). With --resume, an EXPLICIT "
+             "selector narrows the search to that pool only — it will "
+             "not wander into another pool's unfinished record",
     )
     roll.add_argument(
         "--max-unavailable", type=int, default=1,

@@ -32,11 +32,12 @@ Semantics:
   from node labels; nodes added to the pool later (autoscaling, repair)
   converge on the next tick with no operator action. A failed rollout is
   retried next tick — the scan interval is the retry backoff.
-- **One rollout at a time, deterministic order.** Policies are
-  processed in name order and at most one rollout runs per tick
-  (the rollout layer's cluster-wide durable-record guard refuses
-  concurrency anyway); a policy whose turn hasn't come reports
-  ``Pending``.
+- **Bounded concurrency, deterministic order.** Policies are processed
+  in name order; up to ``TPU_CC_MAX_ROLLOUTS`` (default 3) rollout
+  workers run at once, and only over DISJOINT node sets — overlapping
+  pools serialize here and via the rollout layer's overlapping-record
+  guard. A policy whose turn hasn't come reports ``Pending`` with a
+  queued-behind message.
 - **Crash-safe by adoption.** Before launching anything, the controller
   resumes any unfinished rollout record found on the pool (its own
   crashed rollout or an operator's) via the same ``--resume`` machinery,
@@ -54,8 +55,10 @@ Semantics:
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -66,7 +69,7 @@ from tpu_cc_manager.modes import InvalidModeError, parse_mode
 from tpu_cc_manager.obs import Counter, Gauge, Histogram, RouteServer
 from tpu_cc_manager.rollout import (
     HEARTBEAT_STALE_S, ROLLOUT_RECORD_VERSION, Rollout, RolloutError,
-    load_rollout_record, rollout_record_version,
+    load_rollout_records, record_node_names, rollout_record_version,
 )
 
 log = logging.getLogger("tpu-cc-manager.policy")
@@ -226,6 +229,11 @@ class PolicyMetrics:
             "Rollouts driven by the policy controller, by outcome",
             ("outcome",),
         )
+        self.active_rollouts = Gauge(
+            "tpu_cc_policy_active_rollouts",
+            "Rollout workers currently in flight (bounded by "
+            "TPU_CC_MAX_ROLLOUTS)",
+        )
         self.scans = Counter(
             "tpu_cc_policy_scans_total", "Policy scans, by outcome",
             ("outcome",),
@@ -245,8 +253,8 @@ class PolicyMetrics:
 
     def render(self) -> str:
         lines: List[str] = []
-        for m in (self.policies, self.by_phase, self.rollouts, self.scans,
-                  self.scan_duration):
+        for m in (self.policies, self.by_phase, self.rollouts,
+                  self.active_rollouts, self.scans, self.scan_duration):
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
@@ -267,6 +275,7 @@ class PolicyController:
         adopt_after_s: float = HEARTBEAT_STALE_S,
         utcnow_minutes_fn=None,
         leader_elector=None,
+        max_rollouts: Optional[int] = None,
     ):
         if interval_s <= 0:
             raise ValueError(
@@ -284,6 +293,19 @@ class PolicyController:
         self._warned_no_crd = False
         self._event_warned = False
         self.adopt_after_s = adopt_after_s
+        #: rollout-worker slots (TPU_CC_MAX_ROLLOUTS, default 3):
+        #: disjoint pools converge concurrently up to this bound. 1
+        #: restores strict serialization; the bound exists because each
+        #: worker drives drains/flips against the API server — an
+        #: unbounded fleet of simultaneous rollouts is an operator
+        #: surprise, not a throughput win.
+        if max_rollouts is None:
+            try:
+                max_rollouts = int(os.environ.get(
+                    "TPU_CC_MAX_ROLLOUTS", "3"))
+            except ValueError:
+                max_rollouts = 3
+        self.max_rollouts = max(1, max_rollouts)
         #: injectable clock for maintenance-window checks (tests):
         #: returns UTC minutes since midnight
         self._utcnow_minutes = utcnow_minutes_fn or _utc_minutes_now
@@ -304,18 +326,25 @@ class PolicyController:
         #: cmd/main.go:193), with the interval as the level-trigger
         #: fallback for node-side drift the policy watch can't see
         self._wake = threading.Event()
-        #: the in-flight rollout worker, if any: {"name": policy name
-        #: (None for record adoption), "status": the live status dict
-        #: the worker keeps patching, "thread": Thread}. Rollouts run
-        #: OFF the scan loop (VERDICT r3 weak #3): a slow pool must not
-        #: freeze status publication, conflict detection, and metrics
-        #: for every other policy for groups x groupTimeoutSeconds.
-        #: scan_once() (tests, --once) still joins the worker so its
+        #: in-flight rollout workers, worker-id -> {"name": policy name
+        #: (None for unclaimed record adoption), "nodes": frozenset of
+        #: the rollout's node names (disjointness is judged on these),
+        #: "status": the live status dict the worker keeps patching,
+        #: "thread": Thread, "rollout": the live Rollout (for demotion
+        #: stop)}. Rollouts run OFF the scan loop (VERDICT r3 weak #3):
+        #: a slow pool must not freeze status publication for every
+        #: other policy. Up to ``max_rollouts`` workers run at once —
+        #: policies over DISJOINT node sets converge in parallel
+        #: (VERDICT r4 weak #1: one global slot serialized independent
+        #: pools); overlapping pools still serialize via the node-set
+        #: checks here plus the rollout layer's overlap guard.
+        #: scan_once() (tests, --once) still joins all workers so its
         #: callers keep synchronous semantics.
-        self._active: Optional[dict] = None
-        #: launch-time record of the current scan's worker (see
-        #: _join_worker); reset at each scan start
-        self._last_worker: Optional[dict] = None
+        self._workers: Dict[int, dict] = {}
+        self._wid_seq = itertools.count(1)
+        #: launch-time worker entries of the current scan (see
+        #: _join_workers); reset at each scan start
+        self._scan_workers: List[dict] = []
         self._active_lock = threading.Lock()
         #: fairness state (VERDICT r3 weak #2): the launch slot rotates
         #: round-robin among actionable policies, and a policy whose
@@ -331,13 +360,11 @@ class PolicyController:
         #: over within one lease duration of the leader dying. Closes
         #: the two-replica double-rollout-launch race by construction.
         self.leader_elector = leader_elector
-        #: the Rollout instance the worker is currently driving, so a
-        #: demotion can stop it mid-roll (record left for adoption)
-        self._current_rollout = None
         #: latched by _on_demoted and cleared on (re)gaining leadership:
-        #: closes the window where demotion fires while the worker is
-        #: still CONSTRUCTING its Rollout (before _current_rollout is
-        #: assigned) — the worker re-checks this right after assignment
+        #: closes the window where demotion fires while a worker is
+        #: still CONSTRUCTING its Rollout (before the worker entry's
+        #: "rollout" is assigned) — _arm_rollout re-checks this right
+        #: after assignment
         self._demoted = False
         if leader_elector is not None:
             # a deposed leader must stop ACTING, not just stop scanning:
@@ -423,7 +450,9 @@ class PolicyController:
         claims: Dict[str, str] = {}  # node -> owning policy (name order)
         paused_claims: Dict[str, str] = {}  # node -> paused owning policy
         seen_nodes: Dict[str, dict] = {}  # union of all listed nodes
-        actionable: List[Tuple[dict, dict]] = []  # (policy, parsed spec)
+        #: (policy, parsed spec, own node names): the node set rides
+        #: along so the launch pass can judge pool disjointness
+        actionable: List[Tuple[dict, dict, frozenset]] = []
         claims_incomplete = False
 
         # ---- pass 1: validate, claim nodes, derive label-truth counts
@@ -483,7 +512,9 @@ class PolicyController:
                         f"{spec['window_raw']}"
                     )
                 else:
-                    actionable.append((pol, spec))
+                    actionable.append((pol, spec, frozenset(
+                        n["metadata"]["name"] for n in own
+                    )))
 
         # prune fairness state for policies that no longer exist (under
         # the lock: the rollout worker inserts into these dicts)
@@ -493,130 +524,143 @@ class PolicyController:
                 for gone in [k for k in d if k not in live_names]:
                     del d[gone]
 
-        # ---- pass 2+3 are skipped entirely while a rollout worker is
-        # in flight: the worker owns its policy's status (live per-group
-        # progress) and the rollout layer's record guard owns exclusion.
-        # THIS is what makes a slow pool unable to freeze the scan loop.
+        # ---- pass 2: overlay live workers. The scan CONTINUES while
+        # rollouts run (status freshness, conflict detection, and
+        # metrics for every other policy stay live — VERDICT r3 weak
+        # #3); each worker owns its policy's status, and its node set
+        # removes those nodes from this tick's launch budget. The
+        # launch-time worker list is scan-scoped: it exists so THIS
+        # scan's join can outlive a fast-finishing worker, never so a
+        # later scan could re-join (and re-apply) an old outcome.
         with self._active_lock:
-            active = self._active
-            if active is not None and not active["thread"].is_alive():
-                active = None  # worker finished between scans
-            worker_status = (
-                dict(active["status"]) if active is not None
-                and active["status"] is not None else None
-            )
-        if active is not None:
-            rolling_name = active["name"]
-            if rolling_name is not None and rolling_name in statuses:
+            for wid in [w for w, e in self._workers.items()
+                        if not e["thread"].is_alive()]:
+                self._workers.pop(wid)  # crashed without cleanup
+            live = [
+                {
+                    "name": w["name"],
+                    "status": (dict(w["status"])
+                               if w["status"] is not None else None),
+                    "nodes": w["nodes"],
+                }
+                for w in self._workers.values()
+            ]
+            free_slots = self.max_rollouts - len(self._workers)
+            self._scan_workers = list(self._workers.values())
+            self.metrics.active_rollouts.set(len(self._workers))
+        busy_nodes: set = set()
+        for w in live:
+            busy_nodes |= w["nodes"]
+        rolling_names = sorted(
+            w["name"] for w in live if w["name"] is not None
+        )
+        for w in live:
+            if w["name"] in statuses and w["status"] is not None:
                 # the worker's live status snapshot wins over pass 1's
                 # label-derived view — without this, a scan mid-roll
                 # would overwrite 'Rolling: 2/5 groups' with 'Pending'
-                statuses[rolling_name] = worker_status
-            for pol, _ in actionable:
-                self._note_queued(
-                    statuses, pol["metadata"]["name"], rolling_name
-                )
-            for pol in policies:
-                name = pol["metadata"]["name"]
-                if name != rolling_name:
-                    self._patch_status(pol, statuses[name])
-            return {
-                "policies": statuses,
-                "claimed_nodes": len(claims),
-                "scanned": len(policies),
-                "rolling": rolling_name,
-            }
+                statuses[w["name"]] = w["status"]
 
-        # the launch-time worker record is scan-scoped: it exists so
-        # THIS scan's join can outlive a fast-finishing worker, never
-        # so a later scan could re-join (and re-apply) an old outcome
-        with self._active_lock:
-            self._last_worker = None
-
-        # ---- pass 2: adopt any unfinished rollout left on the pool
-        # (this controller's crashed run, or an operator's) before
-        # launching anything new — resume IS the crash-safety story
-        adopted, adopted_owner = self._adopt_unfinished(
-            list(seen_nodes.values()), paused_claims, statuses,
-            claims_incomplete=claims_incomplete,
-            policies_by_name={
-                p["metadata"]["name"]: p for p in policies
-            },
-        )
-
-        # ---- pass 3: launch at most one rollout worker this tick
-        if claims_incomplete and actionable:
+        # ---- pass 3: adopt unfinished rollouts (crash recovery comes
+        # before anything new — resume IS the crash-safety story), then
+        # launch fresh workers into the remaining slots. Disjoint pools
+        # roll concurrently up to max_rollouts; anything overlapping a
+        # live worker or an unfinished record queues.
+        blocked: set = set()
+        block_all = False
+        adopted_names: List[str] = []
+        if claims_incomplete:
             # hold everything: with one policy's node list unknown, a
-            # later policy acting on an overlap would flip-flop the pool
-            for pol, _ in actionable:
+            # later policy acting on an overlap would flip-flop the
+            # pool, and adoption could bypass a paused policy's brake
+            # (pause coverage is unknown too)
+            block_all = True
+            for pol, _, _ in actionable:
                 lname = pol["metadata"]["name"]
                 statuses[lname]["message"] += (
                     "; holding — an earlier policy's node list failed "
                     "this tick, so selector overlap cannot be ruled out"
                 )
             actionable = []
-        # the worker's policy (fresh launch or claimed adoption) is the
-        # worker's to patch — pass 4 must not race it, even when the
-        # worker finishes before this line runs
-        launched_name = adopted_owner
-        if not adopted and actionable:
-            launched_name = self._launch_fair(actionable, statuses)
+        else:
+            blocked, block_all, adopted_names, free_slots = (
+                self._adopt_unfinished(
+                    list(seen_nodes.values()), paused_claims, statuses,
+                    policies_by_name={
+                        p["metadata"]["name"]: p for p in policies
+                    },
+                    busy_nodes=busy_nodes,
+                    free_slots=free_slots,
+                )
+            )
+        launched: List[str] = list(adopted_names)
+        if actionable and not block_all:
+            launched += self._launch_fair(
+                actionable, statuses,
+                # a policy adopted THIS tick is as worker-owned as one
+                # rolling from a previous tick: skip it, or its fresh
+                # 'adopted...resuming' status gets a contradictory
+                # queued-behind suffix
+                set(rolling_names) | set(adopted_names),
+                busy_nodes | blocked, free_slots,
+            )
+
+        # every policy a worker owns this tick — live from a previous
+        # scan, adopted, or freshly launched — is the worker's to
+        # patch; pass 4 must not race it, even when the worker
+        # finishes before that line runs
+        owned = set(rolling_names) | set(launched)
 
         # sync mode (scan_once/--once/tests): the report must reflect
-        # the rollout's outcome, so wait for the worker here
+        # the rollouts' outcomes, so wait for every worker here
         if wait_rollout:
-            joined = self._join_worker()
-            if joined is not None:
-                jname, jstatus = joined
+            for jname, jstatus in self._join_workers():
                 if jname is not None and jstatus is not None \
                         and jname in statuses:
                     statuses[jname] = jstatus
-                    launched_name = jname  # worker already patched it
+                    owned.add(jname)  # worker already patched it
 
-        # ---- pass 4: publish statuses. The launched policy is skipped
-        # either way: mid-roll (async) the worker owns its patches, and
-        # post-join (sync) the worker already patched the final status —
-        # re-patching the identical payload would be a wasted API write
+        # ---- pass 4: publish statuses. Worker-owned policies are
+        # skipped either way: mid-roll (async) the worker owns its
+        # patches, and post-join (sync) the worker already patched the
+        # final status — re-patching the identical payload would be a
+        # wasted API write
         for pol in policies:
             name = pol["metadata"]["name"]
-            if name != launched_name:
+            if name not in owned:
                 self._patch_status(pol, statuses[name])
-        return {
+        out = {
             "policies": statuses,
             "claimed_nodes": len(claims),
             "scanned": len(policies),
         }
-
-    @staticmethod
-    def _note_queued(statuses: Dict[str, dict], lname: str,
-                     rolling_name: Optional[str]) -> None:
-        """Append the one queued-behind message (shared by the mid-roll
-        early-return and the launch path) unless ``lname`` IS the
-        rolling policy."""
-        if lname == rolling_name:
-            return
-        behind = (
-            f"policy {rolling_name!r}" if rolling_name
-            else "an adopted rollout"
-        )
-        statuses[lname]["message"] = (
-            statuses[lname]["message"] + f"; queued behind {behind}"
-        ).lstrip("; ")
+        rolling_now = sorted(set(rolling_names) | set(launched))
+        if rolling_now:
+            # policies with a rollout worker this tick (async callers:
+            # in flight; sync callers: the ones that ran)
+            out["rolling"] = rolling_now
+        return out
 
     # ------------------------------------------------- rollout scheduling
-    def _launch_fair(self, actionable, statuses) -> Optional[str]:
-        """Pick the next policy fairly and start its rollout worker.
-        Returns the launched policy's name (None if every actionable
-        policy is backing off). Fairness has two parts: per-policy
+    def _launch_fair(self, actionable, statuses, rolling_names,
+                     unavailable_nodes, free_slots) -> List[str]:
+        """Launch rollout workers for as many actionable policies as
+        the free slots and pool-disjointness allow; returns the
+        launched policies' names. Fairness has two parts: per-policy
         exponential backoff after failed/timed-out rollouts, and a
-        round-robin rotation of the launch slot, so one never-converging
-        pool cannot re-win the slot every tick."""
+        round-robin rotation of the launch ORDER, so one
+        never-converging pool cannot re-win a slot every tick. A
+        policy whose nodes overlap a live worker, an unfinished
+        record, or an earlier launch this tick queues with a message
+        saying why; so does everything past the slot budget."""
         now = time.monotonic()
         eligible = []
         with self._active_lock:
             retry_after = dict(self._retry_after)
-        for pol, spec in actionable:
+        for pol, spec, own_names in actionable:
             name = pol["metadata"]["name"]
+            if name in rolling_names:
+                continue  # its own worker is mid-roll
             wait = retry_after.get(name, 0.0) - now
             if wait > 0:
                 statuses[name]["message"] = (
@@ -625,49 +669,75 @@ class PolicyController:
                     f"({wait:.0f}s left)"
                 ).lstrip("; ")
             else:
-                eligible.append((pol, spec))
+                eligible.append((pol, spec, own_names))
         if not eligible:
-            return None
-        names = [p["metadata"]["name"] for p, _ in eligible]
-        pick = 0
+            return []
+        # round-robin: rotate the order so the policy after last
+        # tick's final launch goes first
+        start = 0
         if self._rr_last is not None:
-            for i, n in enumerate(names):
-                if n > self._rr_last:
-                    pick = i
+            for i, (p, _, _) in enumerate(eligible):
+                if p["metadata"]["name"] > self._rr_last:
+                    start = i
                     break
-        pol, spec = eligible[pick]
+        launched: List[str] = []
+        taken = set(unavailable_nodes)
+        for pol, spec, own_names in eligible[start:] + eligible[:start]:
+            name = pol["metadata"]["name"]
+            if free_slots <= 0:
+                statuses[name]["message"] = (
+                    statuses[name]["message"]
+                    + f"; queued — all {self.max_rollouts} rollout "
+                    "slot(s) busy"
+                ).lstrip("; ")
+                continue
+            if own_names & taken:
+                statuses[name]["message"] = (
+                    statuses[name]["message"]
+                    + "; queued behind a rollout overlapping node(s) "
+                    f"{sorted(own_names & taken)[:3]}"
+                ).lstrip("; ")
+                continue
+            free_slots -= 1
+            taken |= own_names
+            self._rr_last = name
+            self._launch_worker(pol, spec, own_names, statuses[name])
+            launched.append(name)
+        return launched
+
+    def _launch_worker(self, pol, spec, own_names, st) -> None:
+        """Start one policy's rollout worker in its own slot."""
         name = pol["metadata"]["name"]
-        self._rr_last = name
-        st = statuses[name]
         st["phase"] = "Rolling"
         st["message"] = (
             f"rolling {spec['mode']!r} across "
             f"{st['divergent']} divergent node(s)"
         )
         self._patch_status(pol, st)  # visible before the first group
-        for later, _ in actionable:
-            lname = later["metadata"]["name"]
-            if retry_after.get(lname, 0.0) <= now:
-                self._note_queued(statuses, lname, name)
 
         # the worker mutates a PRIVATE copy; other threads only ever
         # see immutable snapshots swapped in under the lock — the
         # worker's dict-key insertions must never race a scan's dict()
         # copy or the /report route's json.dumps
         wst = dict(st)
+        wid = next(self._wid_seq)
+        entry = {
+            "name": name, "status": dict(st),
+            "nodes": frozenset(own_names), "thread": None,
+            "rollout": None,
+        }
 
         def work():
             try:
-                outcome = self._drive_rollout(pol, spec, wst)
+                outcome = self._drive_rollout(pol, spec, wst, entry)
             except Exception:
                 log.exception("rollout worker crashed (policy %s)", name)
                 outcome = "error"
             with self._active_lock:
-                if self._active is not None:
-                    self._active["status"] = dict(wst)  # final snapshot
+                entry["status"] = dict(wst)  # final snapshot
                 self.metrics.rollouts.inc(outcome)
                 self._note_outcome_locked(name, outcome)
-                self._active = None
+                self._workers.pop(wid, None)
             try:
                 self._patch_status(pol, wst)  # final outcome, worker-owned
             except Exception:
@@ -678,42 +748,44 @@ class PolicyController:
         t = threading.Thread(
             target=work, daemon=True, name=f"rollout-{name}"
         )
+        entry["thread"] = t
         with self._active_lock:
-            self._active = {"name": name, "status": dict(st), "thread": t}
-            self._last_worker = self._active
+            self._workers[wid] = entry
+            self._scan_workers.append(entry)
         t.start()
-        return name
 
     def _on_demoted(self) -> None:
-        """Leadership lost: stop the in-flight rollout at its next loop
-        turn. The record stays unfinished with a dead heartbeat, which
-        is precisely what the new leader's adoption path looks for. The
-        latch covers a rollout still being constructed when this
-        fires — the worker re-checks it after assignment."""
+        """Leadership lost: stop EVERY in-flight rollout at its next
+        loop turn. The records stay unfinished with dead heartbeats,
+        which is precisely what the new leader's adoption path looks
+        for. The latch covers rollouts still being constructed when
+        this fires — _arm_rollout re-checks it after assignment."""
         self._demoted = True
-        rollout = self._current_rollout
-        if rollout is not None:
-            rollout.request_stop("leadership lost")
+        with self._active_lock:
+            rollouts = [w.get("rollout") for w in self._workers.values()]
+        for rollout in rollouts:
+            if rollout is not None:
+                rollout.request_stop("leadership lost")
 
     def _on_promoted(self) -> None:
         self._demoted = False
 
-    def _arm_rollout(self, rollout) -> None:
-        """Publish the worker's live Rollout for demotion delivery,
+    def _arm_rollout(self, entry, rollout) -> None:
+        """Publish a worker's live Rollout for demotion delivery,
         closing the construction-window race: a demotion that fired
         while the Rollout was still being built is applied here."""
-        self._current_rollout = rollout
+        with self._active_lock:
+            entry["rollout"] = rollout
         if self._demoted:
             rollout.request_stop("leadership lost")
 
-    def _publish_worker_status(self, pol, st) -> None:
+    def _publish_worker_status(self, pol, st, entry) -> None:
         """The one way a rollout worker publishes: refresh the snapshot
         concurrent scans//report serve, then patch the cluster. Shared
         by the launch and adoption paths so the snapshot/locking
         protocol cannot drift between them."""
         with self._active_lock:
-            if self._active is not None:
-                self._active["status"] = dict(st)
+            entry["status"] = dict(st)
         self._patch_status(pol, st)
 
     def _note_outcome_locked(self, name: str, outcome: str) -> None:
@@ -738,23 +810,27 @@ class PolicyController:
                 self.interval_s * (2 ** (n - 1)), 900.0
             )
 
-    def _join_worker(self):
-        """Wait out the in-flight worker (if any); returns
-        ``(policy_name, final_status_snapshot)`` — name/status are None
-        for adoptions no policy claimed. Falls back to the launch-time
-        record so a worker that finished (and cleared ``_active``)
-        before the join is still joinable and its final snapshot still
-        readable."""
+    def _join_workers(self):
+        """Wait out every worker live or launched during this scan;
+        returns ``[(policy_name, final_status_snapshot)]`` — name and
+        status are None for adoptions no policy claimed. Reads the
+        scan-scoped launch-time entries so a worker that finished (and
+        removed itself from ``_workers``) before the join is still
+        joinable and its final snapshot still readable."""
         with self._active_lock:
-            active = self._active or self._last_worker
-        if active is None:
-            return None
-        active["thread"].join()
-        status = active.get("status")
-        return (
-            active.get("name"),
-            dict(status) if status is not None else None,
-        )
+            entries = list(self._scan_workers)
+        out = []
+        for entry in entries:
+            t = entry.get("thread")
+            if t is not None:
+                t.join()
+            with self._active_lock:
+                status = entry.get("status")
+                out.append((
+                    entry.get("name"),
+                    dict(status) if status is not None else None,
+                ))
+        return out
 
     # --------------------------------------------------------- derivation
     def _derive_status(self, pol: dict, spec: dict, own: List[dict],
@@ -855,104 +931,163 @@ class PolicyController:
         nodes: List[dict],
         paused_claims: Dict[str, str],
         statuses: Dict[str, dict],
-        claims_incomplete: bool = False,
         policies_by_name: Optional[Dict[str, dict]] = None,
+        busy_nodes: Optional[set] = None,
+        free_slots: int = 1,
     ):
-        """Resume a crashed rollout if one exists on the policies' own
-        nodes. Returns ``(consumed, owner)``: consumed=True when the
-        tick's rollout slot is taken (a resume ran, or an unfinished
-        record is being held by a paused policy — launching anything
-        new would just trip the rollout layer's concurrent-record
-        guard); owner is the policy the adoption attributed itself to
-        (spec matches the record), if any.
+        """Resume crashed rollouts left on the policies' own nodes.
+        With per-pool concurrent rollouts there can be SEVERAL
+        unfinished records (one per disjoint pool): each adoptable one
+        gets its own worker slot, and every unfinished record —
+        adopted or held — contributes its node set to the launch
+        pass's blocked set so nothing new starts on top of it.
 
-        Scope is deliberately the union of the policies' node lists, not
-        a full-cluster scan: records the controller itself wrote always
-        live there, and an operator's rollout on pools no policy owns is
-        the operator's to resume, not ours."""
-        record, _ = load_rollout_record(self.kube, nodes)
-        if record is None or record.get("complete"):
-            self._hb_seen.clear()  # no unfinished record: reset watch
-            return False, None
-        ver = rollout_record_version(record)
-        if ver > ROLLOUT_RECORD_VERSION:
-            # a NEWER controller wrote this record: its shape cannot be
-            # parsed safely by this version — adopting could silently
-            # drop groups or corrupt its state. Hold the slot (the
-            # record's existence still means a rollout is in flight on
-            # these nodes) and be loud: error-log every tick, Event
-            # once, and say so in the matching policy's status.
+        Returns ``(blocked_nodes, block_all, adopted_names,
+        free_slots_left)``: blocked_nodes is the union of unfinished
+        records' node sets (minus live workers' own records);
+        block_all is True when a record's scope could not be parsed
+        (unknown scope is treated as maximal); adopted_names are the
+        policies adoptions attributed themselves to.
+
+        Scope is deliberately the union of the policies' node lists,
+        not a full-cluster scan: records the controller itself wrote
+        always live there, and an operator's rollout on pools no
+        policy owns is the operator's to resume, not ours."""
+        busy = set(busy_nodes or ())
+        unfinished = [
+            (rec, anchor)
+            for rec, anchor in load_rollout_records(self.kube, nodes)
+            if not rec.get("complete")
+        ]
+        current_ids = {str(rec.get("id")) for rec, _ in unfinished}
+        # prune observation state for records that no longer exist —
+        # and keep the one-shot version-skew warnings bounded
+        for gone in [r for r in self._hb_seen if r not in current_ids]:
+            del self._hb_seen[gone]
+        self._future_record_warned &= current_ids
+        blocked: set = set()
+        block_all = False
+        adopted_names: List[str] = []
+        for record, anchor in unfinished:
             rid = str(record.get("id"))
-            msg = (
-                f"unfinished rollout {rid!r} has record schema "
-                f"version {ver} > supported v{ROLLOUT_RECORD_VERSION} "
-                "(written by a newer controller); refusing to adopt — "
-                "upgrade this controller or let the newer one finish"
-            )
-            log.error("%s", msg)
-            owner = self._match_record_owner(record, policies_by_name)
-            if owner is not None and owner[0] in statuses:
-                statuses[owner[0]]["message"] = msg
-            # mark warned only once the event actually lands on a
-            # resolved owner — a policy that appears (or parses) a tick
-            # later must still get its one Warning
-            if owner is not None and rid not in self._future_record_warned:
-                self._future_record_warned.add(rid)
-                self._emit_policy_event(
-                    owner[0], "PolicyRolloutVersionSkew", msg, "Warning"
+            rec_nodes = record_node_names(record)
+            ver = rollout_record_version(record)
+            if ver > ROLLOUT_RECORD_VERSION:
+                # a NEWER controller wrote this record: its shape
+                # cannot be parsed safely by this version — adopting
+                # could silently drop groups or corrupt its state.
+                # Block its nodes (unknown scope blocks everything; the
+                # record's existence still means a rollout is in
+                # flight) and be loud: error-log every tick, Event
+                # once, and say so in the matching policy's status.
+                msg = (
+                    f"unfinished rollout {rid!r} has record schema "
+                    f"version {ver} > supported "
+                    f"v{ROLLOUT_RECORD_VERSION} (written by a newer "
+                    "controller); refusing to adopt — upgrade this "
+                    "controller or let the newer one finish"
                 )
-            return True, None
-        if not self._record_observed_stale(record):
-            # the heartbeat is still moving (or we haven't watched it
-            # long enough): a rollout process — a human-run `rollout`,
-            # or another controller replica — may still be driving it.
-            # Adopting now would mean two writers judging the same
-            # groups. Hold the slot; once the heartbeat stops moving for
-            # adopt_after_s on OUR clock, the next tick adopts for real.
-            log.info(
-                "unfinished rollout %s: heartbeat still under "
-                "observation; waiting for its owner", record.get("id"),
-            )
-            return True, None
-        if claims_incomplete:
-            # a policy's node list failed this tick, so paused_claims may
-            # be missing exactly the paused policy whose brake should
-            # hold this record — resuming now could bypass it. Hold the
-            # slot; next tick retries with complete claims.
-            log.info(
-                "unfinished rollout %s held: a policy's node list "
-                "failed this tick, pause coverage unknown",
-                record.get("id"),
-            )
-            return True, None
-        held_by = sorted({
-            paused_claims[m]
-            for g in (record.get("groups") or {}).values()
-            for m in g.get("nodes", [])
-            if m in paused_claims
-        })
-        if held_by:
-            # the emergency brake: a paused policy freezes even the
-            # crash-recovery path for its nodes — visible in status, and
-            # released the moment the operator unpauses
-            for pname in held_by:
-                if pname in statuses:
-                    statuses[pname]["message"] = (
-                        f"unfinished rollout {record.get('id')!r} held "
-                        "by pause; unpause to let it resume"
+                log.error("%s", msg)
+                owner = self._match_record_owner(
+                    record, policies_by_name
+                )
+                if owner is not None and owner[0] in statuses:
+                    statuses[owner[0]]["message"] = msg
+                # mark warned only once the event actually lands on a
+                # resolved owner — a policy that appears (or parses) a
+                # tick later must still get its one Warning
+                if owner is not None \
+                        and rid not in self._future_record_warned:
+                    self._future_record_warned.add(rid)
+                    self._emit_policy_event(
+                        owner[0], "PolicyRolloutVersionSkew", msg,
+                        "Warning",
                     )
-            log.info(
-                "unfinished rollout %s held by paused polic%s %s",
-                record.get("id"),
-                "y" if len(held_by) == 1 else "ies", held_by,
+                if rec_nodes:
+                    blocked |= rec_nodes
+                else:
+                    block_all = True
+                continue
+            if not rec_nodes:
+                # a v1 record with no parseable groups: scope unknown,
+                # treat as maximal — never as 'touches nothing'
+                log.warning(
+                    "unfinished rollout %s has no parseable node "
+                    "scope; holding all launches this tick", rid,
+                )
+                block_all = True
+                continue
+            if rec_nodes <= busy:
+                # a live worker's own record (its heartbeat is moving;
+                # its nodes are already excluded via busy_nodes)
+                continue
+            blocked |= rec_nodes
+            if rec_nodes & busy:
+                # PARTIAL overlap with a live worker: a foreign record
+                # (e.g. an operator rollout spanning two pools) that
+                # slipped through the overlap guard's record-write
+                # window. Its remaining nodes stay blocked so nothing
+                # launches on them; adoption waits until the worker
+                # finishes and the full scope is free.
+                continue
+            if not self._record_observed_stale(record):
+                # the heartbeat is still moving (or we haven't watched
+                # it long enough): a rollout process — a human-run
+                # `rollout`, or another controller replica — may still
+                # be driving it. Adopting now would mean two writers
+                # judging the same groups. Its nodes stay blocked; once
+                # the heartbeat stops moving for adopt_after_s on OUR
+                # clock, the next tick adopts for real.
+                log.info(
+                    "unfinished rollout %s: heartbeat still under "
+                    "observation; waiting for its owner", rid,
+                )
+                continue
+            held_by = sorted({
+                paused_claims[m] for m in rec_nodes
+                if m in paused_claims
+            })
+            if held_by:
+                # the emergency brake: a paused policy freezes even the
+                # crash-recovery path for its nodes — visible in
+                # status, and released the moment the operator unpauses
+                for pname in held_by:
+                    if pname in statuses:
+                        statuses[pname]["message"] = (
+                            f"unfinished rollout {rid!r} held "
+                            "by pause; unpause to let it resume"
+                        )
+                log.info(
+                    "unfinished rollout %s held by paused polic%s %s",
+                    rid, "y" if len(held_by) == 1 else "ies", held_by,
+                )
+                continue
+            if free_slots <= 0:
+                log.info(
+                    "unfinished rollout %s adoptable but all %d "
+                    "rollout slot(s) busy; next tick", rid,
+                    self.max_rollouts,
+                )
+                continue
+            free_slots -= 1
+            busy |= rec_nodes
+            self._hb_seen.pop(rid, None)  # adopting: observation moot
+            owner_name = self._spawn_adoption(
+                record, anchor, rec_nodes, statuses, policies_by_name
             )
-            return True, None
+            if owner_name is not None:
+                adopted_names.append(owner_name)
+        return blocked, block_all, adopted_names, free_slots
+
+    def _spawn_adoption(self, record, anchor, rec_nodes, statuses,
+                        policies_by_name) -> Optional[str]:
+        """Start one adoption worker for ``record`` in its own slot;
+        returns the policy name the adoption attributed itself to (spec
+        matches the record), if any."""
         log.info(
             "adopting unfinished rollout %s (mode %r)",
             record.get("id"), record.get("mode"),
         )
-        self._hb_seen.clear()  # adopting: the old observation is moot
-
         # attribute the adoption to the policy whose spec matches the
         # record (selector + mode): after a leader failover this is the
         # normal continuation of that policy's rollout, and its status
@@ -977,7 +1112,13 @@ class PolicyController:
                 f"(mode {record.get('mode')!r}) left by a previous "
                 "driver",
             )
-
+        wid = next(self._wid_seq)
+        entry = {
+            "name": owner,
+            "status": dict(wst) if wst is not None else None,
+            "nodes": frozenset(rec_nodes), "thread": None,
+            "rollout": None,
+        }
         def progress(gname, outcome, done, total):
             if wst is None:
                 return
@@ -985,7 +1126,7 @@ class PolicyController:
                 f"adopted rollout {record.get('id')!r}: {done}/{total} "
                 f"group(s) done (last: {gname} {outcome})"
             )
-            self._publish_worker_status(pol, wst)
+            self._publish_worker_status(pol, wst, entry)
 
         def work():
             report = None
@@ -995,12 +1136,15 @@ class PolicyController:
                     self.kube, poll_s=self.poll_s,
                     verify_evidence=self.verify_evidence,
                     on_group=progress if wst is not None else None,
+                    # pin the record (and its anchor, carried from the
+                    # scheduling pass's listing): with several
+                    # unfinished records in the cluster, resume's own
+                    # search could pick a different one than this
+                    # scheduling decision chose
+                    record=record, record_node=anchor,
                 )
-                self._arm_rollout(rollout)
-                try:
-                    report = rollout.run()
-                finally:
-                    self._current_rollout = None
+                self._arm_rollout(entry, rollout)
+                report = rollout.run()
                 if report.stopped_early:
                     # demoted again mid-resume: another handoff, not a
                     # failure — same treatment as the fresh-launch path
@@ -1067,15 +1211,15 @@ class PolicyController:
                         report, adopted=True
                     )
             with self._active_lock:
-                if self._active is not None and wst is not None:
-                    self._active["status"] = dict(wst)
+                if wst is not None:
+                    entry["status"] = dict(wst)
                 self.metrics.rollouts.inc(outcome)
                 if owner is not None:
                     # a failed ADOPTED rollout backs its policy off
                     # like a failed fresh one — failover must not
                     # reset the fairness mechanism (handoffs exempt)
                     self._note_outcome_locked(owner, outcome)
-                self._active = None
+                self._workers.pop(wid, None)
             if wst is not None:
                 try:
                     self._patch_status(pol, wst)
@@ -1084,20 +1228,17 @@ class PolicyController:
                                 exc_info=True)
             self._wake.set()
 
-        # adoption runs on the same single worker slot as fresh
-        # rollouts: the scan loop stays live while a long resume drains
+        # adoption runs on the same worker slots as fresh rollouts:
+        # the scan loop stays live while a long resume drains
         t = threading.Thread(
             target=work, daemon=True, name="rollout-adoption"
         )
+        entry["thread"] = t
         with self._active_lock:
-            self._active = {
-                "name": owner,
-                "status": dict(wst) if wst is not None else None,
-                "thread": t,
-            }
-            self._last_worker = self._active
+            self._workers[wid] = entry
+            self._scan_workers.append(entry)
         t.start()
-        return True, owner
+        return owner
 
     @staticmethod
     def _match_record_owner(record, policies_by_name):
@@ -1132,7 +1273,8 @@ class PolicyController:
             return False
         return now - prev[1] >= self.adopt_after_s
 
-    def _drive_rollout(self, pol: dict, spec: dict, st: dict) -> str:
+    def _drive_rollout(self, pol: dict, spec: dict, st: dict,
+                       entry: dict) -> str:
         """Run one bounded rollout for this policy; mutate its status
         with the outcome. Returns the metrics outcome label."""
         name = pol["metadata"]["name"]
@@ -1149,7 +1291,7 @@ class PolicyController:
                 f"rolling {spec['mode']!r}: {done}/{total} group(s) "
                 f"done (last: {gname} {outcome})"
             )
-            self._publish_worker_status(pol, st)
+            self._publish_worker_status(pol, st, entry)
 
         try:
             rollout = Rollout(
@@ -1163,11 +1305,8 @@ class PolicyController:
                 verify_evidence=self.verify_evidence,
                 on_group=progress,
             )
-            self._arm_rollout(rollout)
-            try:
-                report = rollout.run()
-            finally:
-                self._current_rollout = None
+            self._arm_rollout(entry, rollout)
+            report = rollout.run()
         except (RolloutError, ApiException) as e:
             # preflight refusal (broken fleet) or transport failure: the
             # controller is level-triggered, so next tick retries; the

@@ -159,6 +159,52 @@ def load_rollout_record(kube: KubeClient, nodes: Sequence[dict]
     return best, best_node
 
 
+def load_rollout_records(kube: KubeClient, nodes: Sequence[dict]
+                         ) -> List[Tuple[dict, str]]:
+    """EVERY distinct rollout record on these nodes -> [(record,
+    anchor node)]. With concurrent per-pool rollouts there can be one
+    unfinished record per disjoint pool; callers that schedule
+    (adoption, the concurrency guard) must see all of them, not the
+    single 'best' one ``load_rollout_record`` picks for resume.
+    Deduped by record id (an id lives on one anchor; if churn ever
+    duplicates it, the copy with the newest heartbeat wins)."""
+    by_id: Dict[str, Tuple[dict, str]] = {}
+    for n in nodes:
+        raw = (n["metadata"].get("annotations") or {}).get(
+            L.ROLLOUT_ANNOTATION)
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        rid = str(rec.get("id"))
+        prev = by_id.get(rid)
+        if prev is None or (
+            (rec.get("heartbeat") or 0) > (prev[0].get("heartbeat") or 0)
+        ):
+            by_id[rid] = (rec, n["metadata"]["name"])
+    return sorted(by_id.values(), key=lambda t: t[0].get("started", 0))
+
+
+def record_node_names(record: dict) -> set:
+    """The node names a record's rollout touches (union of its groups'
+    members). Empty for shapes this version cannot parse (future
+    schema, missing groups) — callers treat empty as UNKNOWN scope and
+    act conservatively (block everything), never as 'touches
+    nothing'."""
+    names: set = set()
+    groups = record.get("groups")
+    if isinstance(groups, dict):
+        for g in groups.values():
+            if isinstance(g, dict):
+                for m in g.get("nodes") or []:
+                    names.add(m)
+    return names
+
+
 @dataclasses.dataclass
 class GroupResult:
     name: str
@@ -318,27 +364,48 @@ class Rollout:
         cls,
         kube: KubeClient,
         *,
-        selector: str = L.TPU_ACCELERATOR_LABEL,
+        selector: Optional[str] = None,
         group_timeout_s: float = 600.0,
         poll_s: float = 0.5,
         dry_run: bool = False,
         verify_evidence: bool = True,
         on_group=None,
+        record: Optional[dict] = None,
+        record_node: Optional[str] = None,
     ) -> "Rollout":
         """Rebuild a Rollout from the pool's unfinished durable record.
         Mode, window, budget, AND selector come from the record (the
         record persists the selector precisely so the resumed run scopes
         the same node set); ``force`` is implied (a mid-rollout pool
         legitimately contains half-flipped slices — that's what is being
-        resumed). ``dry_run`` previews the resume without patching."""
-        nodes = kube.list_nodes(selector)
-        record, record_node = load_rollout_record(kube, nodes)
-        if record is None:
-            # the record's anchor may sit outside the caller's selector
-            # (original rollout used a different one): scan the cluster
-            record, record_node = load_rollout_record(
-                kube, kube.list_nodes(None)
+        resumed). ``dry_run`` previews the resume without patching.
+        ``record``/``record_node`` PIN the record to resume: with
+        concurrent per-pool rollouts a cluster can hold several
+        unfinished records, and a scheduling caller (policy adoption)
+        that already chose one must not have the search below pick a
+        different, newer one out from under it. An EXPLICIT
+        ``selector`` scopes the search to that pool only: when its
+        record is complete, resume refuses rather than wandering
+        cluster-wide and force-claiming some OTHER pool's rollout —
+        possibly a live one — out from under its driver."""
+        if record is not None and record_node is not None:
+            pass  # pinned by the caller
+        else:
+            explicit = selector is not None
+            nodes = kube.list_nodes(
+                selector if explicit else L.TPU_ACCELERATOR_LABEL
             )
+            record, record_node = load_rollout_record(kube, nodes)
+            if record is None or (record.get("complete")
+                                  and not explicit):
+                # the record's anchor may sit outside the searched
+                # selector (original rollout used a different one), or
+                # — with per-pool concurrent records — the default
+                # pool's own COMPLETE record may mask an unfinished one
+                # on another pool: scan the cluster
+                record, record_node = load_rollout_record(
+                    kube, kube.list_nodes(None)
+                )
         if record is None or record.get("complete"):
             raise RolloutError("no unfinished rollout to resume on this pool")
         ver = rollout_record_version(record)
@@ -590,17 +657,33 @@ class Rollout:
             )
         else:
             # the guard must see records on ANY node, not just this
-            # selector's pool — a second rollout with a disjoint selector
-            # could otherwise run concurrently over the same nodes
-            existing, _ = load_rollout_record(
-                self.kube, self.kube.list_nodes(None)
-            )
-            if existing and not existing.get("complete") and not self.dry_run:
-                raise RolloutError(
-                    f"an unfinished rollout (id {existing.get('id')}, mode "
-                    f"{existing.get('mode')!r}) already exists on this "
-                    f"pool; finish it with --resume"
-                )
+            # selector's pool — two selectors can overlap without being
+            # equal. Scope: an unfinished record only blocks THIS
+            # rollout when its node set intersects ours (disjoint pools
+            # legitimately roll concurrently, one record per pool
+            # anchor); a record whose node set cannot be parsed (future
+            # schema) blocks everything — unknown scope is treated as
+            # maximal, never as empty.
+            if not self.dry_run:
+                my_names = {n["metadata"]["name"] for n in nodes}
+                for existing, _ in load_rollout_records(
+                    self.kube, self.kube.list_nodes(None)
+                ):
+                    if existing.get("complete"):
+                        continue
+                    rec_nodes = record_node_names(existing)
+                    if rec_nodes and not (rec_nodes & my_names):
+                        continue
+                    scope = (
+                        f"over node(s) {sorted(rec_nodes & my_names)[:5]}"
+                        if rec_nodes else "of unknown scope"
+                    )
+                    raise RolloutError(
+                        f"an unfinished rollout (id {existing.get('id')},"
+                        f" mode {existing.get('mode')!r}) {scope} "
+                        "already overlaps this pool; finish it with "
+                        "--resume"
+                    )
             planned_count = 0
             for gname, members in self.plan_groups(nodes):
                 converged = all(
