@@ -2129,3 +2129,38 @@ def test_multiple_crashed_records_adopted_concurrently():
     finally:
         agents.stop.set()
         agents.join(timeout=2)
+
+
+def test_overlapping_unfinished_records_are_never_adopted():
+    """Two unfinished records sharing a node (the overlap guard's
+    record-write window can produce this): adopting EITHER would put
+    two drivers on the shared node, so both are held — and nothing
+    new launches on their nodes."""
+    kube = FakeKube()
+    kube.add_node(_node("s1", desired="on", state="off"))
+    kube.add_node(_node("s2", desired="on", state="off"))
+    now = time.time()
+    kube.set_node_annotations("s1", {L.ROLLOUT_ANNOTATION: json.dumps({
+        "version": 1, "id": "older", "started": now - 60, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "complete": False, "aborted": False,
+        "groups": {"node/s1": {"nodes": ["s1"], "outcome": "in_flight"},
+                   "node/s2": {"nodes": ["s2"], "outcome": "pending"}},
+    })})
+    kube.set_node_annotations("s2", {L.ROLLOUT_ANNOTATION: json.dumps({
+        "version": 1, "id": "newer", "started": now, "mode": "off",
+        "selector": "pool=other",
+        "complete": False, "aborted": False,
+        "groups": {"node/s2": {"nodes": ["s2"],
+                               "outcome": "in_flight"}},
+    })})
+    kube.add_custom(G, P, make_policy("olpol"))
+    c = controller(kube, adopt_after_s=0)
+    c.scan_once()  # observe heartbeats (both static -> stale next tick)
+    report = c.scan_once()
+    assert not c._workers, "overlapped records must not be adopted"
+    assert report.get("rolling") is None
+    for node, rid in (("s1", "older"), ("s2", "newer")):
+        rec = json.loads(kube.get_node(node)["metadata"][
+            "annotations"][L.ROLLOUT_ANNOTATION])
+        assert rec["id"] == rid and rec["complete"] is False

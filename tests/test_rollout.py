@@ -1221,3 +1221,42 @@ def test_explicit_selector_resume_never_wanders_to_another_pool():
     # unscoped: pool b's unfinished record is fair game
     r = Rollout.resume(kube, poll_s=0.05, dry_run=True)
     assert r._resume_from[0]["id"] == "blive"
+
+
+def test_legacy_record_without_selector_scopes_default_pool():
+    """A pre-selector-persisting record (no 'selector' key) must
+    resume scoped to the default TPU pool, never to the whole cluster
+    — a None selector would drain and flip non-TPU nodes."""
+    kube = FakeKube()
+    _pool(kube, _node("lg0", desired="on", state="off"))
+    # a non-TPU node the resume must never touch
+    kube.add_node(make_node("web-1", labels={"role": "web"}))
+    _write_record(kube, "lg0", {
+        "id": "legacy", "started": 1.0, "mode": "on",
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": False,
+        "groups": {"node/lg0": {"nodes": ["lg0"],
+                                "outcome": "in_flight"}},
+    })
+    r = Rollout.resume(kube, poll_s=0.05, dry_run=True)
+    assert r.selector == L.TPU_ACCELERATOR_LABEL
+    report = r.run()
+    assert all("web-1" not in g.nodes for g in report.groups)
+
+
+def test_explicit_selector_with_no_record_refuses():
+    """A typo'd (or churned-away) --selector that matches no record
+    must refuse, not widen to the cluster and force-claim another
+    pool's live rollout."""
+    kube = FakeKube()
+    _pool(kube, _node("lv0", desired="on", state="off"))
+    _write_record(kube, "lv0", {
+        "version": 1, "id": "live0", "started": 1.0, "mode": "on",
+        "selector": L.TPU_ACCELERATOR_LABEL,
+        "max_unavailable": 1, "failure_budget": 0,
+        "complete": False, "aborted": False,
+        "groups": {"node/lv0": {"nodes": ["lv0"],
+                                "outcome": "in_flight"}},
+    })
+    with pytest.raises(RolloutError, match="no unfinished rollout"):
+        Rollout.resume(kube, selector="pool=typo", poll_s=0.05)

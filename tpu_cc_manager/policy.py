@@ -965,6 +965,14 @@ class PolicyController:
         for gone in [r for r in self._hb_seen if r not in current_ids]:
             del self._hb_seen[gone]
         self._future_record_warned &= current_ids
+        # nodes claimed by MORE than one unfinished record (possible
+        # via the overlap guard's record-write window): adopting either
+        # record would race whatever drives the other, so overlapped
+        # records are held, never adopted
+        claim_counts: Dict[str, int] = {}
+        for rec, _ in unfinished:
+            for m in record_node_names(rec):
+                claim_counts[m] = claim_counts.get(m, 0) + 1
         blocked: set = set()
         block_all = False
         adopted_names: List[str] = []
@@ -1029,6 +1037,18 @@ class PolicyController:
                 # window. Its remaining nodes stay blocked so nothing
                 # launches on them; adoption waits until the worker
                 # finishes and the full scope is free.
+                continue
+            if any(claim_counts.get(m, 0) > 1 for m in rec_nodes):
+                # this record overlaps ANOTHER unfinished record:
+                # adopting it would put two drivers on the shared
+                # nodes (the other record's owner may be live, paused-
+                # held, or version-skewed). Hold — the nodes are
+                # already blocked — until an operator untangles it or
+                # one record completes.
+                log.warning(
+                    "unfinished rollout %s overlaps another unfinished "
+                    "record; holding adoption", rid,
+                )
                 continue
             if not self._record_observed_stale(record):
                 # the heartbeat is still moving (or we haven't watched

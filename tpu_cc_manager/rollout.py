@@ -396,13 +396,17 @@ class Rollout:
                 selector if explicit else L.TPU_ACCELERATOR_LABEL
             )
             record, record_node = load_rollout_record(kube, nodes)
-            if record is None or (record.get("complete")
-                                  and not explicit):
-                # the record's anchor may sit outside the searched
-                # selector (original rollout used a different one), or
-                # — with per-pool concurrent records — the default
-                # pool's own COMPLETE record may mask an unfinished one
-                # on another pool: scan the cluster
+            if not explicit and (record is None
+                                 or record.get("complete")):
+                # unscoped resume: the record's anchor may sit outside
+                # the default pool (original rollout used a different
+                # selector), or — with per-pool concurrent records —
+                # the default pool's own COMPLETE record may mask an
+                # unfinished one on another pool: scan the cluster. An
+                # EXPLICIT selector never widens, even when its pool
+                # shows nothing — a typo'd or churned-away selector
+                # must not land on some OTHER pool's record and
+                # force-claim a live rollout from its driver.
                 record, record_node = load_rollout_record(
                     kube, kube.list_nodes(None)
                 )
@@ -420,7 +424,12 @@ class Rollout:
             )
         r = cls(
             kube, record["mode"],
-            selector=record.get("selector", selector),
+            # a legacy record without a persisted selector must scope
+            # to the default TPU pool, never to None (= every node in
+            # the cluster — a resume would drain and flip non-TPU
+            # nodes)
+            selector=(record.get("selector") or selector
+                      or L.TPU_ACCELERATOR_LABEL),
             max_unavailable=int(record.get("max_unavailable", 1)),
             failure_budget=int(record.get("failure_budget", 0)),
             group_timeout_s=group_timeout_s, poll_s=poll_s, force=True,
