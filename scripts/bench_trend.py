@@ -65,13 +65,24 @@ def main(root: str = ".") -> int:
             f"p50 {p50_prev} -> {p50_cur} "
             f"({p50_cur / p50_prev:.1f}x slower)"
         )
-    fpm_prev = (prev.get("extras") or {}).get("flips_per_min")
-    fpm_cur = (cur.get("extras") or {}).get("flips_per_min")
+    # prefer the WINDOWED throughput when both rounds carry it (round
+    # 5+): flips/elapsed dilutes with setup/teardown time, so a mix
+    # change can look like a 40% regression while steady-state
+    # throughput is flat (the r03->r04 story). Mixed-era comparisons
+    # fall back to the old number.
+    prev_x, cur_x = prev.get("extras") or {}, cur.get("extras") or {}
+    key = ("flips_per_min_windowed"
+           if isinstance(prev_x.get("flips_per_min_windowed"),
+                         (int, float))
+           and isinstance(cur_x.get("flips_per_min_windowed"),
+                          (int, float))
+           else "flips_per_min")
+    fpm_prev, fpm_cur = prev_x.get(key), cur_x.get(key)
     if (isinstance(fpm_prev, (int, float)) and fpm_prev > 0
             and isinstance(fpm_cur, (int, float)) and fpm_cur > 0
             and fpm_cur < fpm_prev / REGRESSION_FACTOR):
         problems.append(
-            f"flips/min {fpm_prev} -> {fpm_cur} "
+            f"{key} {fpm_prev} -> {fpm_cur} "
             f"({fpm_prev / fpm_cur:.1f}x fewer)"
         )
     if not problems:

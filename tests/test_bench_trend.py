@@ -75,3 +75,20 @@ def test_single_file_or_empty_passes(tmp_path):
     assert bench_trend.main(str(tmp_path)) == 0
     _write(tmp_path, 1, 0.10, 1000)
     assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_gate_prefers_windowed_flips(tmp_path):
+    """Round 5+: when both rounds carry flips_per_min_windowed, the
+    gate judges THAT number — a whole-elapsed drop caused by
+    setup/teardown dilution (the r03->r04 story) no longer trips it,
+    and a real windowed drop does."""
+    # un-windowed fell 3x (would trip the old gate) but windowed flat
+    _write(tmp_path, 1, 0.1, 6000,
+           extras={"flips_per_min_windowed": 8000})
+    _write(tmp_path, 2, 0.1, 2000,
+           extras={"flips_per_min_windowed": 7900})
+    assert bench_trend.main(str(tmp_path)) == 0
+    # windowed itself fell 3x: trips even though un-windowed is flat
+    _write(tmp_path, 3, 0.1, 2000,
+           extras={"flips_per_min_windowed": 2500})
+    assert bench_trend.main(str(tmp_path)) == 1
