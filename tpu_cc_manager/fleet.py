@@ -33,6 +33,7 @@ from tpu_cc_manager.evidence import audit_evidence
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.obs import (
     OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
+    kube_throttle_wait_histogram, wire_throttle_observer,
 )
 from tpu_cc_manager.plan import analyze_fleet
 
@@ -253,6 +254,7 @@ class FleetMetrics:
             "tpu_cc_fleet_scan_duration_seconds",
             "Wall-clock duration of one fleet scan",
         )
+        self.kube_throttle_wait = kube_throttle_wait_histogram()
 
     def update(self, report: dict) -> None:
         self.nodes.set(report["nodes"])
@@ -284,7 +286,7 @@ class FleetMetrics:
             self.incoherent_slices, self.half_flipped_slices,
             self.evidence_issues, self.doctor_failing,
             self.doctor_unreported, self.scans_total,
-            self.scan_duration,
+            self.scan_duration, self.kube_throttle_wait,
         ):
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
@@ -329,6 +331,10 @@ class FleetController:
         self.interval_s = interval_s
         self.max_consecutive_errors = max_consecutive_errors
         self.metrics = FleetMetrics()
+        # the QPS token bucket's per-request wait lands on THIS
+        # controller's /metrics — "is the limiter throttling us at
+        # fleet scale?" must be a histogram, not a guess
+        wire_throttle_observer(kube, self.metrics.kube_throttle_wait)
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
         #: sticky across scans: once any scan sees an identity-bearing

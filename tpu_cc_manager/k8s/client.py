@@ -494,7 +494,11 @@ class _TokenBucket:
         self._updated = time.monotonic()
         self._lock = threading.Lock()
 
-    def acquire(self) -> None:
+    def acquire(self) -> float:
+        """Take one token, blocking as needed; returns the seconds this
+        caller spent waiting — the number that turns "is the limiter
+        actually throttling us?" from a guess into a metric."""
+        waited = 0.0
         while True:
             with self._lock:
                 now = time.monotonic()
@@ -505,9 +509,10 @@ class _TokenBucket:
                 self._updated = now
                 if self._tokens >= 1.0:
                     self._tokens -= 1.0
-                    return
+                    return waited
                 wait = (1.0 - self._tokens) / self.qps
             time.sleep(wait)
+            waited += wait
 
 
 class HttpKubeClient(KubeClient):
@@ -551,6 +556,36 @@ class HttpKubeClient(KubeClient):
                     burst = None
             # client-go's default Burst is 2x QPS-ish (5/10); same ratio
             self._bucket = _TokenBucket(qps, burst or int(2 * qps))
+        # throttle visibility (client-go's
+        # rest_client_rate_limiter_duration_seconds analog): plain
+        # best-effort totals here (metrics, not bookkeeping — an
+        # unsynchronized += across threads can at worst lose a sample),
+        # plus an optional observer the owning controller wires to its
+        # own Histogram so /metrics carries the distribution.
+        self.throttle_waits = 0
+        self.throttle_wait_s_total = 0.0
+        self._throttle_observers: list = []
+
+    def add_throttle_observer(self, fn) -> None:
+        """Wire a callable(seconds) observed on EVERY flow-controlled
+        request (zero when no wait): the controllers pass their
+        ``tpu_cc_kube_throttle_wait_seconds`` histogram's observe. A
+        LIST, not a slot — two controllers sharing one client must
+        both see the waits, not whoever registered last."""
+        self._throttle_observers.append(fn)
+
+    def _acquire_token(self) -> None:
+        if self._bucket is None:
+            return
+        waited = self._bucket.acquire()
+        if waited > 0:
+            self.throttle_waits += 1
+            self.throttle_wait_s_total += waited
+        for fn in self._throttle_observers:
+            try:
+                fn(waited)
+            except Exception:
+                pass  # observability must never sink a request
 
     # -- plumbing -------------------------------------------------------
     def _pooled(self, read_timeout: Optional[float]) -> Tuple[HTTPConnection, bool]:
@@ -621,8 +656,7 @@ class HttpKubeClient(KubeClient):
         read_timeout: Optional[float] = 30.0,
         _auth_retry: bool = True,
     ) -> dict:
-        if self._bucket is not None:
-            self._bucket.acquire()
+        self._acquire_token()
         resp = data = None
         for attempt in (0, 1):
             try:
@@ -871,8 +905,7 @@ class HttpKubeClient(KubeClient):
         count against the flow-control bucket (client-go does the
         same) — a hot relist loop is exactly a request storm; the
         long-lived stream itself is free."""
-        if self._bucket is not None:
-            self._bucket.acquire()
+        self._acquire_token()
         try:
             conn = self._connect(read_timeout=timeout_s + 30)
         except ExecCredentialError as e:

@@ -167,6 +167,27 @@ class Histogram:
         ] + self.render_series(self.name)
 
 
+def kube_throttle_wait_histogram() -> Histogram:
+    """The one definition of ``tpu_cc_kube_throttle_wait_seconds``
+    (client-side flow-control wait per API request). Both controllers
+    expose this series; a shared factory keeps name/help/buckets
+    identical by construction — two differently-bucketed expositions
+    under one metric name would corrupt aggregation."""
+    return Histogram(
+        "tpu_cc_kube_throttle_wait_seconds",
+        "Client-side flow-control wait per API request (QPS token "
+        "bucket; zero = no throttling)",
+        buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+    )
+
+
+def wire_throttle_observer(kube, hist: Histogram) -> None:
+    """Attach ``hist`` to the client's flow-control waits when the
+    client supports it (HttpKubeClient does; fakes don't need to)."""
+    if hasattr(kube, "add_throttle_observer"):
+        kube.add_throttle_observer(hist.observe)
+
+
 def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else repr(float(v))
 

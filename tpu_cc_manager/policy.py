@@ -66,7 +66,10 @@ from typing import Dict, List, Optional, Tuple
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.modes import InvalidModeError, parse_mode
-from tpu_cc_manager.obs import Counter, Gauge, Histogram, RouteServer
+from tpu_cc_manager.obs import (
+    Counter, Gauge, Histogram, RouteServer, kube_throttle_wait_histogram,
+    wire_throttle_observer,
+)
 from tpu_cc_manager.rollout import (
     HEARTBEAT_STALE_S, ROLLOUT_RECORD_VERSION, Rollout, RolloutError,
     load_rollout_records, record_node_names, rollout_record_version,
@@ -242,6 +245,7 @@ class PolicyMetrics:
             "tpu_cc_policy_scan_duration_seconds",
             "Wall-clock duration of one policy scan",
         )
+        self.kube_throttle_wait = kube_throttle_wait_histogram()
 
     def update(self, statuses: Dict[str, dict]) -> None:
         self.policies.set(len(statuses))
@@ -254,7 +258,8 @@ class PolicyMetrics:
     def render(self) -> str:
         lines: List[str] = []
         for m in (self.policies, self.by_phase, self.rollouts,
-                  self.active_rollouts, self.scans, self.scan_duration):
+                  self.active_rollouts, self.scans, self.scan_duration,
+                  self.kube_throttle_wait):
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
@@ -288,6 +293,8 @@ class PolicyController:
         self.max_consecutive_errors = max_consecutive_errors
         self.verify_evidence = verify_evidence
         self.metrics = PolicyMetrics()
+        # flow-control waits surface on this controller's /metrics
+        wire_throttle_observer(kube, self.metrics.kube_throttle_wait)
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
         self._warned_no_crd = False
