@@ -630,6 +630,13 @@ set_cc_mode() {
       _exit_failed
     fi
   done
+  # measured flip history (tpu_cc_manager/attest.py): a REAL transition
+  # happened on this path (the idempotent fast path returned earlier),
+  # so extend the PCR BEFORE publishing evidence — the quote attached
+  # by the evidence build must already see this flip. Best-effort, and
+  # a no-op unless TPU_CC_ATTESTATION configures a provider.
+  python3 -m tpu_cc_manager.attest --extend "$mode" 2>/dev/null \
+    || log "WARN: attestation extend failed (measured history will lag)"
   _set_state_label "$mode"
   _publish_evidence
   _post_event "CCModeApplied" "Normal" \

@@ -17,6 +17,9 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 # tests' synthetic node names — nondeterministic identity_mismatch
 # findings. Tests that want identity set TPU_CC_IDENTITY=fake.
 os.environ.setdefault("TPU_CC_IDENTITY", "none")
+# same posture for the TEE rung: tests that want attestation set
+# TPU_CC_ATTESTATION=fake (plus TPU_CC_TPM_STATE_DIR/TPU_CC_TPM_KEY)
+os.environ.setdefault("TPU_CC_ATTESTATION", "none")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,318 @@
+"""Platform attestation (tpu_cc_manager.attest) — the TEE rung of the
+evidence chain (VERDICT r4 missing #1 / next #3). The headline drill:
+node root rewrites the statefile, re-signs with the node's own pool
+key, carries the node's own identity — and is STILL flagged, because
+the forged claim contradicts the measured flip history inside the
+quote, and extend-only history cannot be rewritten.
+"""
+
+import json
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.attest import (
+    FakeTpm, PCR_INITIAL, attestation_nonce, extend_pcr, get_attestor,
+    judge_attestation, measured_mode, replay_log, verify_quote,
+)
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+
+KEY = b"aik-test-key"
+
+
+@pytest.fixture
+def tpm(tmp_path, monkeypatch):
+    """A FakeTpm rooted in tmp, with env wired so build_evidence and
+    judge_attestation resolve the same provider/key."""
+    state = tmp_path / "tpm"
+    keyfile = tmp_path / "tpm.key"
+    keyfile.write_bytes(KEY)
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    monkeypatch.setenv("TPU_CC_TPM_STATE_DIR", str(state))
+    monkeypatch.setenv("TPU_CC_TPM_KEY_FILE", str(keyfile))
+    get_attestor(refresh=True)
+    yield FakeTpm(state_dir=str(state), key=KEY)
+    get_attestor(refresh=True)
+
+
+def _statefile_backend(tmp_path):
+    """Synthetic-sysfs backend with a durable statefile (the thing the
+    drill's attacker rewrites)."""
+    from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+    sysfs = tmp_path / "sysfs"
+    devd = sysfs / "accel0" / "device"
+    devd.mkdir(parents=True)
+    (devd / "vendor").write_text("0x1ae0\n")
+    (devd / "device").write_text("0x0063\n")
+    (tmp_path / "dev").mkdir()
+    (tmp_path / "dev" / "accel0").write_text("")
+    return SysfsTpuBackend(sysfs_root=str(sysfs),
+                           dev_root=str(tmp_path / "dev"),
+                           state_dir=str(tmp_path / "state"))
+
+
+# ------------------------------------------------------- PCR mechanics
+def test_extend_and_replay_agree():
+    events = ["mode:on", "mode:off", "mode:devtools"]
+    pcr = PCR_INITIAL
+    for e in events:
+        pcr = extend_pcr(pcr, e)
+    assert replay_log(events) == pcr
+    assert replay_log(events[:-1]) != pcr  # truncation changes the PCR
+    assert measured_mode(events) == "devtools"
+    assert measured_mode(["boot"]) is None
+    assert measured_mode([]) is None
+
+
+def test_fake_tpm_state_survives_reopen(tmp_path):
+    t1 = FakeTpm(state_dir=str(tmp_path / "t"), key=KEY)
+    t1.extend("mode:on")
+    t1.extend("mode:off")
+    # a new handle over the same "hardware" sees the same history
+    t2 = FakeTpm(state_dir=str(tmp_path / "t"), key=KEY)
+    q = t2.quote("00" * 32)
+    assert q["log"] == ["mode:on", "mode:off"]
+    assert replay_log(q["log"]) == q["pcr"]
+    verdict, _ = verify_quote(q, "00" * 32, key=KEY)
+    assert verdict == "ok"
+
+
+def test_quote_verification_catches_each_tamper(tmp_path):
+    tpm = FakeTpm(state_dir=str(tmp_path / "t"), key=KEY)
+    tpm.extend("mode:on")
+    nonce = "ab" * 32
+    good = tpm.quote(nonce)
+    assert verify_quote(good, nonce, key=KEY)[0] == "ok"
+    # replayed onto a different document
+    assert verify_quote(good, "cd" * 32, key=KEY)[0] == "mismatch"
+    # log rewritten without re-folding the PCR
+    bad_log = dict(good, log=["mode:devtools"])
+    assert verify_quote(bad_log, nonce, key=KEY)[0] == "mismatch"
+    # signature from a different key
+    other = FakeTpm(state_dir=str(tmp_path / "t"), key=b"other").quote(
+        nonce
+    )
+    assert verify_quote(other, nonce, key=KEY)[0] == "mismatch"
+    # keyless verifier: structure checks pass, authentication cannot
+    assert verify_quote(good, nonce, key=None)[0] == "unverifiable"
+
+
+# -------------------------------------------------- evidence integration
+def test_build_evidence_attaches_verifying_quote(tmp_path, tpm,
+                                                 monkeypatch):
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.evidence import build_evidence, verify_evidence
+
+    be = _statefile_backend(tmp_path)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False, backend=be)
+    assert engine.set_mode("on")
+    doc = build_evidence("w1", be)
+    assert doc["attestation"]["provider"] == "fake-tpm"
+    # the pool-key digest covers the quote
+    ok, reason = verify_evidence(doc)
+    assert ok, reason
+    verdict, detail = judge_attestation(doc, "w1")
+    assert verdict == "ok", detail
+    # the engine extended on the real transition
+    assert measured_mode(doc["attestation"]["log"]) == "on"
+
+
+def test_idempotent_reconcile_does_not_extend(tmp_path, tpm,
+                                              monkeypatch):
+    """The measured log is FLIP history: the idempotent fast path must
+    not grow it, or steady-state reconciles would bloat every quote."""
+    from tpu_cc_manager.engine import ModeEngine
+
+    be = _statefile_backend(tmp_path)
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False, backend=be)
+    assert engine.set_mode("on")
+    assert engine.set_mode("on")  # fast path
+    assert engine.set_mode("on")
+    _, events = tpm._read_state()
+    assert events == ["mode:on"]
+    assert engine.set_mode("off")  # real transition
+    _, events = tpm._read_state()
+    assert events == ["mode:on", "mode:off"]
+
+
+def test_node_root_forgery_drill(tmp_path, tpm, monkeypatch):
+    """THE drill this module exists for: root rewrites the statefile to
+    claim CC without a real flip, re-signs with the node's own pool
+    key (root can read the mount), and even requests a fresh quote —
+    the TPM obliges, but the measured history still says 'off', so the
+    forged document lands in attestation mismatch everywhere: judge,
+    doctor, and the fleet audit's problems digest."""
+    from tpu_cc_manager.doctor import _attestation_check
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.evidence import (
+        audit_evidence, build_evidence, verify_evidence,
+    )
+    from tpu_cc_manager.fleet import fleet_problems
+
+    be = _statefile_backend(tmp_path)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False, backend=be)
+    # honest lifecycle with REAL measured transitions: on, then off
+    # (a fresh statefile is already off, so the first set_mode("off")
+    # would be the idempotent fast path and measure nothing)
+    assert engine.set_mode("on")
+    assert engine.set_mode("off")  # honest state: CC off, measured
+
+    # --- the attack: rewrite device truth OUTSIDE the engine path
+    # (root writing the statefile directly — no drain, no gate, no
+    # measured extend, no actual device work)
+    for chip in be.find_tpus()[0]:
+        be.store.stage(chip.path, "cc", "on")
+        be.store.commit(chip.path)
+    forged = build_evidence("w1", be)  # root runs the same tooling
+    # the forgery is pool-key perfect...
+    ok, _ = verify_evidence(forged)
+    assert ok
+    assert forged["devices"][0]["cc"] == "on"
+    # ...but the quote's measured history contradicts the claim
+    verdict, detail = judge_attestation(forged, "w1")
+    assert verdict == "mismatch"
+    assert "measured flip history" in detail
+    assert "'off'" in detail
+
+    # doctor: fail-severity attestation check
+    checks = []
+    _attestation_check(checks, forged, "w1")
+    (c,) = [c for c in checks if c["name"] == "attestation"]
+    assert c["severity"] == "fail"
+
+    # fleet audit: attestation_mismatch bucket + problems line
+    node = make_node("w1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(forged)})
+    audit = audit_evidence([node])
+    assert audit["attestation_mismatch"] == ["w1"]
+    problems = fleet_problems({"evidence_audit": audit})
+    assert any("attestation mismatch" in p for p in problems)
+
+
+def test_quote_replay_onto_other_document_is_mismatch(tmp_path, tpm,
+                                                      monkeypatch):
+    """Splicing a genuine quote into a different document breaks the
+    nonce commitment even when the attacker re-signs the envelope with
+    the pool key."""
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.evidence import (
+        _canonical, _digest, build_evidence,
+    )
+
+    be = _statefile_backend(tmp_path)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False, backend=be)
+    assert engine.set_mode("on")
+    honest = build_evidence("w1", be)
+    tampered = dict(honest)
+    tampered["timestamp"] = "2031-01-01T00:00:00Z"  # any body change
+    tampered.pop("digest")
+    tampered["digest"] = _digest(_canonical(tampered), b"pool-secret")
+    verdict, detail = judge_attestation(tampered, "w1")
+    assert verdict == "mismatch"
+    assert "commit" in detail
+
+
+def test_audit_attestation_missing_mirrors_identity_rules(tmp_path,
+                                                          monkeypatch):
+    """Missing quotes flag only on MIXED pools or under
+    TPU_CC_REQUIRE_ATTESTATION — an all-missing pool simply has no TEE
+    configured; quote-bearing pools make the bare node the tell."""
+    from tpu_cc_manager.evidence import audit_evidence, build_evidence
+
+    be = _statefile_backend(tmp_path)
+    bare_doc = json.dumps(build_evidence("bare", be, key=None))
+
+    def node(name, doc):
+        return make_node(name, labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p",
+            L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: doc})
+
+    # uniform quote-less pool: not a finding
+    audit = audit_evidence([node("bare", bare_doc)])
+    assert audit["attestation_missing"] == []
+
+    # required: flagged even when uniform
+    monkeypatch.setenv("TPU_CC_REQUIRE_ATTESTATION", "true")
+    audit = audit_evidence([node("bare", bare_doc)])
+    assert audit["attestation_missing"] == ["bare"]
+    monkeypatch.delenv("TPU_CC_REQUIRE_ATTESTATION")
+
+    # mixed pool: the quote-bearing node makes the bare one the tell
+    state = tmp_path / "tpm2"
+    keyfile = tmp_path / "tpm2.key"
+    keyfile.write_bytes(KEY)
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    monkeypatch.setenv("TPU_CC_TPM_STATE_DIR", str(state))
+    monkeypatch.setenv("TPU_CC_TPM_KEY_FILE", str(keyfile))
+    get_attestor(refresh=True)
+    try:
+        attested_doc = json.dumps(build_evidence("att", be, key=None))
+    finally:
+        monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
+        get_attestor(refresh=True)
+    # the attested doc carries node name "att" but judges under its own
+    # node; the bare node is the missing one
+    audit = audit_evidence([
+        node("att", attested_doc), node("bare", bare_doc),
+    ])
+    assert audit["attestation_missing"] == ["bare"]
+
+
+def test_unverifiable_bucket_when_no_trust_root(tmp_path, monkeypatch):
+    """Quote present, verifier without the attestation key: visible as
+    attestation_unverifiable (metric), never a problem line — the
+    mid-enablement posture, mirroring identity's unverifiable."""
+    from tpu_cc_manager.evidence import audit_evidence, build_evidence
+    from tpu_cc_manager.fleet import fleet_problems
+
+    be = _statefile_backend(tmp_path)
+    state = tmp_path / "tpm3"
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    monkeypatch.setenv("TPU_CC_TPM_STATE_DIR", str(state))
+    monkeypatch.setenv("TPU_CC_TPM_KEY", "agent-only-key")
+    get_attestor(refresh=True)
+    try:
+        doc = json.dumps(build_evidence("w1", be, key=None))
+    finally:
+        monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
+        monkeypatch.delenv("TPU_CC_TPM_KEY")
+        get_attestor(refresh=True)
+    n = make_node("w1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: doc})
+    audit = audit_evidence([n])
+    assert audit["attestation_unverifiable"] == ["w1"]
+    assert audit["attestation_mismatch"] == []
+    assert not any(
+        "attestation" in p
+        for p in fleet_problems({"evidence_audit": audit})
+    )
+
+
+def test_get_attestor_resolution(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
+    assert get_attestor(refresh=True) is None
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    att = get_attestor(refresh=True)
+    assert isinstance(att, FakeTpm)
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "bogus-provider")
+    assert get_attestor(refresh=True) is None
+    # auto without a Confidential Space socket: none
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "auto")
+    monkeypatch.setenv("TPU_CC_CS_SOCKET", str(tmp_path / "nope.sock"))
+    assert get_attestor(refresh=True) is None
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
+    get_attestor(refresh=True)

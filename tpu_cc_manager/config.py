@@ -54,6 +54,18 @@ flag                      env                            default
 (none)                    TPU_CC_METADATA_HOST           metadata.google.internal
 (none)                    TPU_CC_REQUIRE_IDENTITY        false (verifiers flag identity-less
                                                         evidence even on uniform pools)
+(none)                    TPU_CC_ATTESTATION             auto | fake | confidential-space |
+                                                        none (TEE quote over evidence;
+                                                        auto = CS launcher socket if
+                                                        present)
+(none)                    TPU_CC_TPM_STATE_DIR           $TPU_CC_STATE_DIR/tpm (FakeTpm
+                                                        PCR + measured flip log)
+(none)                    TPU_CC_TPM_KEY[_FILE]          "" (FakeTpm quote key — the test
+                                                        double's AIK stand-in)
+(none)                    TPU_CC_ATTESTATION_JWKS_FILE   "" (JWKS for offline verification
+                                                        of Confidential Space tokens)
+(none)                    TPU_CC_REQUIRE_ATTESTATION     false (verifiers flag quote-less
+                                                        evidence even on uniform pools)
 (none)                    KUBE_API_TLS                   false (native agent + bash engine:
                                                         direct HTTPS, no proxy sidecar)
 (none)                    KUBE_CA_FILE                   serviceaccount ca.crt (with TLS)

@@ -122,6 +122,41 @@ def _identity_check(checks: List[dict], doc: dict,
                f"identity {iverdict}: {idetail}")
 
 
+def _attestation_check(checks: List[dict], doc: dict,
+                       node_name: str) -> None:
+    """The node diagnoses its OWN attestation posture: a quote that
+    fails to verify, commits to a different document, or contradicts
+    the measured flip history fails HERE first — before the fleet
+    audit's attestation_mismatch finding. Mirrors _identity_check's
+    severity vocabulary so the two trust rungs read alike."""
+    from tpu_cc_manager.attest import get_attestor, judge_attestation
+
+    averdict, adetail = judge_attestation(doc, node_name)
+    # resolved provider, not the env string: 'auto' on a Confidential
+    # Space VM HAS a provider, and a quote-less document there is the
+    # degradation worth warning about
+    configured = get_attestor() is not None
+    if averdict == "ok":
+        _check(checks, "attestation", "ok",
+               "TEE quote verifies and matches the measured flip "
+               "history")
+    elif averdict == "unverifiable":
+        _check(checks, "attestation", "warn",
+               f"attestation present but {adetail}")
+    elif averdict == "missing" and configured:
+        _check(checks, "attestation", "warn",
+               "an attestation provider is configured/detected but "
+               "the published evidence carries no quote (heals on "
+               "the next evidence sync)")
+    elif averdict == "missing":
+        _check(checks, "attestation", "ok",
+               "no attestation attached (no TEE provider "
+               "configured/detected)")
+    else:  # mismatch / invalid
+        _check(checks, "attestation", "fail",
+               f"attestation {averdict}: {adetail}")
+
+
 def run_doctor(kube=None, node_name: Optional[str] = None,
                backend=None) -> dict:
     """Execute every check; returns the report dict. Never raises — a
@@ -346,6 +381,7 @@ def run_doctor(kube=None, node_name: Optional[str] = None,
                                f"verifies ({reason}), "
                                f"attests {attested!r}")
                 _identity_check(checks, doc, node_name)
+                _attestation_check(checks, doc, node_name)
             except Exception as e:
                 _check(checks, "evidence", "fail",
                        f"evidence unreadable: {e}")

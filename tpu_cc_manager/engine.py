@@ -233,7 +233,19 @@ class ModeEngine:
             "mode plan: %s",
             [(d.path, changes) for d, changes in plan],
         )
-        return self._drain_wrapped(lambda: self._apply_plan(plan), mode.value)
+        ok = self._drain_wrapped(
+            lambda: self._apply_plan(plan), mode.value
+        )
+        if ok:
+            # measured flip history (tpu_cc_manager.attest): only REAL
+            # transitions extend the PCR — the idempotent fast path
+            # returned above, so the log records flips, not reconciles.
+            # Best-effort inside note_mode_applied; a TPM hiccup must
+            # not fail a flip that already landed.
+            from tpu_cc_manager.attest import note_mode_applied
+
+            note_mode_applied(mode.value)
+        return ok
 
     # ------------------------------------------------------------- planning
     def _all_devices(self) -> List[TpuChip]:

@@ -210,6 +210,8 @@ def _device_entry(chip, store) -> dict:
 #: warned-once flag for identity-fetch failures: a flapping metadata
 #: server must not spam every reconcile
 _warned_identity_fetch = False
+#: same posture for attestation-quote failures
+_warned_attestation = False
 
 
 def build_evidence(node_name: str, backend,
@@ -271,6 +273,23 @@ def build_evidence(node_name: str, backend,
                 _warned_identity_fetch = True
                 log.warning("platform identity fetch failed; evidence "
                             "will carry no identity", exc_info=True)
+    # platform attestation (tpu_cc_manager.attest): a TEE-rooted quote
+    # whose nonce commits to everything above — attached BEFORE the
+    # pool-key digest, so the digest covers the quote and the quote
+    # covers the body. Best-effort like identity: a broken attestor
+    # degrades to the attestation_missing audit finding.
+    global _warned_attestation
+    try:
+        from tpu_cc_manager.attest import attestation_nonce, get_attestor
+
+        attestor = get_attestor()
+        if attestor is not None:
+            doc["attestation"] = attestor.quote(attestation_nonce(doc))
+    except Exception:
+        if not _warned_attestation:
+            _warned_attestation = True
+            log.warning("attestation quote failed; evidence will carry "
+                        "no attestation", exc_info=True)
     doc["digest"] = _digest(_canonical(doc), key)
     return doc
 
@@ -490,6 +509,9 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
     ``unverifiable`` — no JWKS provisioned — don't arm the latch;
     provision the JWKS, or set TPU_CC_REQUIRE_IDENTITY.)"""
     from tpu_cc_manager import labels as L
+    from tpu_cc_manager.attest import (
+        judge_attestation, require_attestation,
+    )
     from tpu_cc_manager.identity import judge_identity, require_identity
 
     key = _resolve_keys(key)
@@ -501,8 +523,12 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
     mismatch: List[str] = []
     ident_missing: List[str] = []
     ident_mismatch: List[str] = []
+    att_missing: List[str] = []
+    att_mismatch: List[str] = []
+    att_unverifiable: List[str] = []
     saw_identity = False
     saw_verified_identity = False
+    saw_attestation = False
     for node in nodes:
         meta = node.get("metadata", {})
         name = meta.get("name", "?")
@@ -559,10 +585,35 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
                 # stopped refreshing) — classed with missing so an
                 # idle fleet doesn't read as under attack
                 ident_missing.append(name)
+        # attestation is a SEPARATE axis from identity: a document can
+        # carry a verified identity and a forged device claim — the
+        # TEE quote's measured-history check is what catches the
+        # node-root statefile rewrite identity cannot see
+        try:
+            averdict, _ = judge_attestation(doc, name)
+        except Exception:
+            averdict = "invalid"
+        if averdict == "missing":
+            att_missing.append(name)
+        else:
+            saw_attestation = True
+            if averdict in ("mismatch", "invalid"):
+                att_mismatch.append(name)
+            elif averdict == "unverifiable":
+                # quote present, no trust root provisioned: visible
+                # (metric) but not a problem line — the expected state
+                # mid-enablement, like identity's unverifiable
+                att_unverifiable.append(name)
     if not (require_identity() or saw_identity or identity_seen_before):
         # uniform all-missing pool without the require knob: not a
         # finding — the platform simply mints no identities here
         ident_missing = []
+    if not (require_attestation() or saw_attestation):
+        # mirror identity's mixed-pool rule (per-scan only; the
+        # cross-scan latch stays identity's — attestation enablement
+        # is operator-driven via TPU_CC_ATTESTATION, and the require
+        # knob is the decommission-proof posture)
+        att_missing = []
     return {
         "identity_seen": saw_verified_identity,
         "missing": sorted(missing),
@@ -573,6 +624,9 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         "label_device_mismatch": sorted(mismatch),
         "identity_missing": sorted(ident_missing),
         "identity_mismatch": sorted(ident_mismatch),
+        "attestation_missing": sorted(att_missing),
+        "attestation_mismatch": sorted(att_mismatch),
+        "attestation_unverifiable": sorted(att_unverifiable),
     }
 
 

@@ -87,6 +87,25 @@ def fleet_problems(report: dict) -> List[str]:
             f"node or fails verification): "
             f"{sorted(audit['identity_mismatch'])}"
         )
+    if audit.get("attestation_mismatch"):
+        # the node-root drill: the document verifies under the pool key
+        # and may even carry the node's own identity, but the TEE quote
+        # contradicts it — nonce replay, bad quote signature, or a
+        # device claim that disagrees with the measured flip history
+        # (state changed outside the measured engine path)
+        problems.append(
+            "evidence attestation mismatch (TEE quote contradicts the "
+            f"document): {sorted(audit['attestation_mismatch'])}"
+        )
+    if audit.get("attestation_missing"):
+        # gated upstream like identity_missing: populated on mixed
+        # pools or under TPU_CC_REQUIRE_ATTESTATION
+        problems.append(
+            "evidence lacks attestation on an attestation-bearing "
+            f"pool: {sorted(audit['attestation_missing'])} — node root "
+            "can re-sign evidence, but cannot mint a TEE quote whose "
+            "measured history matches a forged claim"
+        )
     if audit.get("identity_missing"):
         # populated on mixed pools, under TPU_CC_REQUIRE_IDENTITY, or
         # when an earlier scan of this controller process saw VERIFIED
