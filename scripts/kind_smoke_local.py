@@ -156,6 +156,12 @@ def main():
         identity_key = os.path.join(scratch, "identity-key")
         with open(identity_key, "w") as f:
             f.write("smoke-identity-key")
+        # the TEE rung (round 5): the fake TPM measures every real
+        # flip, so the closing node-root drill can prove a forged
+        # statefile is flagged even when re-signed with the pool key
+        tpm_key = os.path.join(scratch, "tpm-key")
+        with open(tpm_key, "w") as f:
+            f.write("smoke-aik-key")
         env.update(
             KUBECONFIG=kubeconfig,  # kind: in-cluster SA
             PYTHONPATH=REPO,
@@ -166,6 +172,9 @@ def main():
             TPU_CC_EVIDENCE_KEY_FILE=evidence_key,  # kind: Secret mount
             TPU_CC_IDENTITY="fake",
             TPU_CC_IDENTITY_KEY_FILE=identity_key,
+            TPU_CC_ATTESTATION="fake",
+            TPU_CC_TPM_STATE_DIR=os.path.join(scratch, "tpm"),
+            TPU_CC_TPM_KEY_FILE=tpm_key,
         )
         log("starting agent: python -m tpu_cc_manager "
             f"(NODE_NAME={NODE}, DRAIN_STRATEGY="
@@ -517,6 +526,97 @@ def main():
                     "within the 256-char cap")
             else:
                 failures.append(f"webhook warn mode: {wr}")
+
+            # 12. the node-root forgery drill (round 5, TEE rung):
+            # the live evidence's quote verifies and matches measured
+            # history; then "root" rewrites the statefile OUTSIDE the
+            # engine path, republishes pool-key-perfect evidence with
+            # a fresh quote via the same tooling — and the keyed audit
+            # flags attestation mismatch, because the measured flip
+            # log cannot be rewritten.
+            from tpu_cc_manager.attest import judge_attestation
+
+            raw = store.get_node(NODE)["metadata"].get(
+                "annotations", {}).get(L.EVIDENCE_ANNOTATION)
+            live_doc = json.loads(raw) if raw else {}
+            averdict, adetail = judge_attestation(
+                live_doc, NODE, key=b"smoke-aik-key")
+            if averdict == "ok":
+                log("PASS attestation: live quote verifies and "
+                    "matches the measured flip history")
+            else:
+                failures.append(
+                    f"attestation on live doc: {averdict} ({adetail})")
+            # stop the agent first: its self-repair would re-flip the
+            # drift (a REAL flip) and honestly heal the forgery
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            from tpu_cc_manager.device.tpu import SysfsTpuBackend
+
+            be = SysfsTpuBackend(
+                sysfs_root=sysfs, dev_root=dev,
+                state_dir=os.path.join(scratch, "state"),
+            )
+            # forge a mode DIFFERENT from the last MEASURED flip (the
+            # state label can diverge from measured history when an
+            # upstream check regressed — deriving from the log keeps
+            # this drill's diagnostic truthful even then): the attack
+            # claims a state no real flip produced
+            from tpu_cc_manager.attest import FakeTpm, measured_mode
+
+            _, tpm_events = FakeTpm(
+                state_dir=os.path.join(scratch, "tpm"),
+            )._read_state()
+            honest = measured_mode(tpm_events)
+            forged_mode = "on" if honest != "on" else "devtools"
+            for chip in be.find_tpus()[0]:
+                be.store.stage(chip.path, "cc", forged_mode)
+                be.store.commit(chip.path)
+            store.set_node_labels(NODE, {
+                L.CC_MODE_LABEL: forged_mode,
+                L.CC_MODE_STATE_LABEL: forged_mode,
+            })
+            r = subprocess.run(
+                [sys.executable, "-m", "tpu_cc_manager.evidence",
+                 "--sync"],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            r2 = subprocess.run(
+                [sys.executable, "-m", "tpu_cc_manager",
+                 "fleet-controller", "--once"],
+                env=env, capture_output=True, text=True, cwd=REPO,
+            )
+            flagged = False
+            rep = None
+            try:
+                rep = json.loads(r2.stdout)
+                flagged = any(
+                    "attestation mismatch" in p
+                    for p in rep.get("problems", [])
+                )
+            except ValueError:
+                pass
+            if r.returncode == 0 and r2.returncode != 0 and flagged:
+                log("PASS node-root drill: forged statefile re-signed "
+                    "with the pool key is flagged as attestation "
+                    "mismatch (measured history contradicts the claim)")
+            else:
+                post = store.get_node(NODE)["metadata"].get(
+                    "annotations", {}).get(L.EVIDENCE_ANNOTATION, "")
+                try:
+                    post_att = json.loads(post).get("attestation")
+                except ValueError:
+                    post_att = "<unparseable>"
+                failures.append(
+                    "node-root drill not flagged: sync rc="
+                    f"{r.returncode} ({(r.stdout + r.stderr)[-200:]}) "
+                    f"audit rc={r2.returncode} flagged={flagged} "
+                    f"problems={rep.get('problems') if rep else '?'} "
+                    f"post_attestation={str(post_att)[:300]}"
+                )
         finally:
             proc.terminate()
             try:
