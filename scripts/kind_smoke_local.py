@@ -540,7 +540,8 @@ def main():
                 "annotations", {}).get(L.EVIDENCE_ANNOTATION)
             live_doc = json.loads(raw) if raw else {}
             averdict, adetail = judge_attestation(
-                live_doc, NODE, key=b"smoke-aik-key")
+                live_doc, NODE,
+                key=open(tpm_key, "rb").read())
             if averdict == "ok":
                 log("PASS attestation: live quote verifies and "
                     "matches the measured flip history")

@@ -392,6 +392,11 @@ def test_cpp_agent_full_native_path_through_proxy_sidecar(
         TPUDEVCTL=os.path.join(native_build, "tpudevctl"),
         EVICT_OPERATOR_COMPONENTS="false",
         CC_READINESS_FILE=str(tmp_path / "run" / ".ready"),
+        # the TEE rung rides the native path too: the bash engine
+        # extends the measured log before publishing evidence
+        TPU_CC_ATTESTATION="fake",
+        TPU_CC_TPM_STATE_DIR=str(tmp_path / "tpm"),
+        TPU_CC_TPM_KEY="native-aik",
     )
     proc = subprocess.Popen(
         [os.path.join(native_build, "tpu-cc-manager-agent")],
@@ -430,6 +435,38 @@ def test_cpp_agent_full_native_path_through_proxy_sidecar(
             time.sleep(0.1)
         # reference :536 parity
         assert os.path.exists(env["CC_READINESS_FILE"])
+
+        # the TEE rung on the native path: the bash engine extended
+        # the measured log before publishing, so the evidence carries
+        # a quote whose history ends at the real flip
+        import json as _json
+
+        from tpu_cc_manager.attest import judge_attestation
+
+        from tpu_cc_manager.evidence import evidence_mode
+
+        deadline = time.monotonic() + 15
+        verdict, detail = "missing", ""
+        while time.monotonic() < deadline:
+            raw = apiserver.store.get_node("native-node")[
+                "metadata"].get("annotations", {}).get(
+                L.EVIDENCE_ANNOTATION)
+            if raw:
+                doc = _json.loads(raw)
+                # wait for the POST-FLIP document (the initial off
+                # reconcile publishes an attested doc too, with an
+                # empty measured log)
+                if doc.get("attestation") and \
+                        evidence_mode(doc) == "on":
+                    verdict, detail = judge_attestation(
+                        doc, "native-node",
+                        key=env["TPU_CC_TPM_KEY"].encode())
+                    break
+            time.sleep(0.2)
+        assert verdict == "ok", (verdict, detail)
+        from tpu_cc_manager.attest import measured_mode
+
+        assert measured_mode(doc["attestation"]["log"]) == "on"
     finally:
         proc.terminate()
         try:
