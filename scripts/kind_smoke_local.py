@@ -539,9 +539,10 @@ def main():
             raw = store.get_node(NODE)["metadata"].get(
                 "annotations", {}).get(L.EVIDENCE_ANNOTATION)
             live_doc = json.loads(raw) if raw else {}
+            with open(tpm_key, "rb") as kf:
+                smoke_aik = kf.read()
             averdict, adetail = judge_attestation(
-                live_doc, NODE,
-                key=open(tpm_key, "rb").read())
+                live_doc, NODE, key=smoke_aik)
             if averdict == "ok":
                 log("PASS attestation: live quote verifies and "
                     "matches the measured flip history")

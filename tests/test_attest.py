@@ -316,3 +316,90 @@ def test_get_attestor_resolution(monkeypatch, tmp_path):
     assert get_attestor(refresh=True) is None
     monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
     get_attestor(refresh=True)
+
+
+def test_rollout_refuses_attestation_mismatched_convergence(
+        tmp_path, tpm, monkeypatch):
+    """The rollout judge holds the same line as the fleet audit: a
+    node whose label converged but whose evidence quote contradicts
+    the measured flip history (the node-root forgery) must NOT count
+    as converged — its group times out naming the attestation
+    contradiction."""
+    import threading
+    import time as _time
+
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.evidence import build_evidence
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.rollout import Rollout
+
+    be = _statefile_backend(tmp_path)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False, backend=be)
+    # measured history ends at 'off'...
+    assert engine.set_mode("on")
+    assert engine.set_mode("off")
+    # ...but root rewrites device truth to 'on' and publishes a
+    # pool-key-perfect document claiming it
+    for chip in be.find_tpus()[0]:
+        be.store.stage(chip.path, "cc", "on")
+        be.store.commit(chip.path)
+    forged = json.dumps(build_evidence("fg1", be))
+
+    kube = FakeKube()
+    kube.add_node(make_node("fg1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: forged}))
+
+    # a label-only "agent": converges the state label without touching
+    # the planted forged evidence (exactly what the forgery wants)
+    stop = threading.Event()
+
+    def agent():
+        while not stop.is_set():
+            labels = kube.get_node("fg1")["metadata"]["labels"]
+            want = labels.get(L.CC_MODE_LABEL)
+            if want and labels.get(L.CC_MODE_STATE_LABEL) != want:
+                kube.set_node_labels(
+                    "fg1", {L.CC_MODE_STATE_LABEL: want})
+            _time.sleep(0.02)
+
+    t = threading.Thread(target=agent, daemon=True)
+    t.start()
+    try:
+        report = Rollout(kube, "on", poll_s=0.05,
+                         group_timeout_s=1.5).run()
+    finally:
+        stop.set()
+        t.join(timeout=2)
+    (group,) = report.groups
+    assert group.outcome == "timeout"
+    assert "attestation" in group.detail
+    assert "measured flip history" in group.detail
+
+
+def test_keyless_verifier_still_catches_history_contradiction(
+        tmp_path, monkeypatch):
+    """A verifier WITHOUT the attestation key can't authenticate the
+    quote, but the measured-history-vs-claim comparison needs no key
+    (nonce + PCR replay are structural): a lazy forger who reuses the
+    real TPM's quote still lands in mismatch; only a fully fabricated
+    quote passes (caught by the keyed fleet audit)."""
+    tpm = FakeTpm(state_dir=str(tmp_path / "t"), key=KEY)
+    tpm.extend("mode:off")
+    doc = {"version": 1, "node": "k1", "devices": [
+        {"path": "/dev/accel0", "cc": "on", "ici": None}]}
+    from tpu_cc_manager.attest import attestation_nonce
+
+    doc["attestation"] = tpm.quote(attestation_nonce(doc))
+    verdict, detail = judge_attestation(doc, "k1", key=None)
+    assert verdict == "mismatch"
+    assert "needs no key" in detail
+    # an honest doc under a keyless verifier reads unverifiable
+    honest = {"version": 1, "node": "k1", "devices": [
+        {"path": "/dev/accel0", "cc": "off", "ici": None}]}
+    honest["attestation"] = tpm.quote(attestation_nonce(honest))
+    verdict, _ = judge_attestation(honest, "k1", key=None)
+    assert verdict == "unverifiable"

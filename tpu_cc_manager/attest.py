@@ -461,24 +461,37 @@ def judge_attestation(doc: dict, node_name: Optional[str] = None, *,
             ConfidentialSpaceAttestor.provider:
         return _judge_cs_token(att, expected)
     verdict, detail = verify_quote(att, expected, key=key)
-    if verdict != "ok":
+    if verdict not in ("ok", "unverifiable"):
         return verdict, detail
     # the root-forgery check: the document's device-truth claim must
-    # agree with the MEASURED flip history. An empty log is lenient
-    # (attestation enabled mid-life, no transition measured yet) —
-    # strictness there would flag every fresh enablement.
+    # agree with the MEASURED flip history. This comparison needs NO
+    # key — the nonce commitment and PCR replay already passed — so it
+    # runs even for 'unverifiable' quotes (keyless verifier host):
+    # same principle as the evidence path's keyless-checkable claims.
+    # It only catches forgers too lazy to fabricate a whole quote
+    # there (no signature binds the log), but a contradiction is a
+    # contradiction. An empty log is lenient (attestation enabled
+    # mid-life, no transition measured yet) — strictness there would
+    # flag every fresh enablement.
     from tpu_cc_manager.evidence import evidence_mode
 
     measured = measured_mode(att.get("log") or [])
     claimed = evidence_mode(doc)
     if measured is not None and claimed is not None \
             and measured != claimed:
+        qualifier = (
+            " (quote signature unverifiable here — but the claim "
+            "contradiction needs no key to read)"
+            if verdict == "unverifiable" else ""
+        )
         return "mismatch", (
             f"document attests mode {claimed!r} but the measured flip "
             f"history's last real transition was to {measured!r} — "
             "state was changed outside the measured engine path "
-            "(node-root statefile rewrite?)"
+            f"(node-root statefile rewrite?){qualifier}"
         )
+    if verdict == "unverifiable":
+        return verdict, detail
     return "ok", "quote verifies and matches measured history"
 
 

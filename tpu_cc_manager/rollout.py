@@ -330,6 +330,7 @@ class Rollout:
             self._evidence_key = evidence_keys() or None
             self._warned_no_key = False
             self._warned_unsigned = False
+            self._warned_attestation_unverifiable = False
         #: member -> why its evidence was rejected, for actionable
         #: timeout verdicts (unsigned-under-key names the manifest fix)
         self._suspect_reasons: Dict[str, str] = {}
@@ -1133,11 +1134,18 @@ class Rollout:
           unauthenticated mode claim contradicts the target, which
           needs no key to read and stays a suspect.
         - ``ok`` attesting a different mode than the target: suspect.
+        - identity or TEE-attestation contradictions (foreign token,
+          quote that does not commit to the document or disagrees
+          with the measured flip history): suspect — same verdicts as
+          the fleet audit's mismatch buckets.
 
         Missing evidence is tolerated (pre-evidence agents must not
         brick a rollout). Per-member reasons land in
         ``self._suspect_reasons`` so the timeout verdict says what to
         FIX, not just who lagged."""
+        from tpu_cc_manager.attest import (
+            judge_attestation, require_attestation,
+        )
         from tpu_cc_manager.evidence import (
             UNSIGNED_RUNBOOK, judge_evidence,
         )
@@ -1224,6 +1232,7 @@ class Rollout:
             if iverdict in ("mismatch", "invalid"):
                 self._suspect_reasons[m] = f"identity: {idetail}"
                 out.append(m)
+                continue
             elif (iverdict in ("missing", "expired")
                     and require_identity()):
                 self._suspect_reasons[m] = (
@@ -1231,4 +1240,39 @@ class Rollout:
                     "(TPU_CC_REQUIRE_IDENTITY is set)"
                 )
                 out.append(m)
+                continue
+            # TEE attestation, same shape as identity: a quote that
+            # CONTRADICTS the document (nonce replay, bad signature,
+            # or a claim disagreeing with the measured flip history —
+            # the node-root forgery) is always a suspect; a missing
+            # quote is one only under TPU_CC_REQUIRE_ATTESTATION, so
+            # rollouts keep working on TEE-less pools. A rollout must
+            # not count a forged-state node as converged when the
+            # fleet audit would flag it a scan later.
+            try:
+                averdict, adetail = judge_attestation(doc, m)
+            except Exception:
+                averdict, adetail = "invalid", "attestation judge failed"
+            if averdict in ("mismatch", "invalid"):
+                self._suspect_reasons[m] = f"attestation: {adetail}"
+                out.append(m)
+            elif averdict == "missing" and require_attestation():
+                self._suspect_reasons[m] = (
+                    "attestation missing "
+                    "(TPU_CC_REQUIRE_ATTESTATION is set)"
+                )
+                out.append(m)
+            elif (averdict == "unverifiable"
+                    and not self._warned_attestation_unverifiable):
+                # tolerated blind spot, said out loud (the evidence
+                # no_key posture): the measured-history contradiction
+                # check above still ran keylessly, but a fully
+                # fabricated quote would pass this verifier — the
+                # keyed fleet audit remains the backstop
+                self._warned_attestation_unverifiable = True
+                log.warning(
+                    "evidence attestation present but unverifiable "
+                    "here (%s); quote authenticity is not being "
+                    "checked by this rollout", adetail,
+                )
         return sorted(out)
