@@ -485,3 +485,131 @@ def test_leader_churn_soak():
             c.stop()
         for t in threads.values():
             t.join(timeout=5)
+
+
+def test_concurrent_rollout_churn_soak():
+    """Round-5 concurrency under churn: four disjoint pools repeatedly
+    re-diverged while a controller with multiple worker slots drives
+    them and leadership flaps demote/promote it mid-roll. Invariants:
+    never more than max_rollouts live workers; no two live workers
+    ever share a node; every record on the cluster stays parseable
+    (version 1, sane shape); and once the churn stops, every pool
+    converges and every record completes."""
+    import json
+    import threading
+    import time
+
+    from tpu_cc_manager import labels as L
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.k8s.objects import make_node
+    from tpu_cc_manager.policy import PolicyController
+    from tpu_cc_manager.rollout import load_rollout_records
+
+    N_POOLS = 4
+    kube = FakeKube()
+    names = []
+    for p in range(N_POOLS):
+        for i in range(2):
+            name = f"cs{p}-{i}"
+            names.append(name)
+            kube.add_node(make_node(name, labels={
+                L.TPU_ACCELERATOR_LABEL: "v5p", "churn.pool": f"p{p}",
+                L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"}))
+        kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+            "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+            "kind": L.POLICY_KIND, "metadata": {"name": f"cp{p}"},
+            "spec": {"mode": "on", "nodeSelector": f"churn.pool=p{p}",
+                     "strategy": {"maxUnavailable": 2,
+                                  "groupTimeoutSeconds": 10}},
+        })
+    stop = threading.Event()
+
+    def agent():
+        while not stop.is_set():
+            for n in names:
+                labels = kube.get_node(n)["metadata"]["labels"]
+                want = labels.get(L.CC_MODE_LABEL)
+                if want and labels.get(L.CC_MODE_STATE_LABEL) != want:
+                    kube.set_node_labels(
+                        n, {L.CC_MODE_STATE_LABEL: want})
+            time.sleep(0.01)
+
+    threading.Thread(target=agent, daemon=True).start()
+
+    c = PolicyController(kube, interval_s=0.05, poll_s=0.02, port=0,
+                         adopt_after_s=0.3, max_rollouts=3)
+    run_t = threading.Thread(target=c.run, daemon=True)
+    run_t.start()
+
+    violations = []
+    deadline = time.monotonic() + 8
+    last_churn = 0.0
+    churn_i = 0
+    while time.monotonic() < deadline:
+        # invariant sampling
+        with c._active_lock:
+            workers = [dict(w) for w in c._workers.values()]
+        if len(workers) > c.max_rollouts:
+            violations.append(f"{len(workers)} workers > slots")
+        seen_nodes: set = set()
+        for w in workers:
+            if w["nodes"] & seen_nodes:
+                violations.append(
+                    f"two live workers share node(s) "
+                    f"{sorted(w['nodes'] & seen_nodes)}"
+                )
+            seen_nodes |= w["nodes"]
+        for rec, _ in load_rollout_records(kube, kube.list_nodes(None)):
+            if rec.get("version") not in (None, 1):
+                violations.append(f"record version {rec.get('version')}")
+            if not isinstance(rec.get("groups"), dict):
+                violations.append("record without groups dict")
+        # churn: every ~0.5s, re-diverge a pool and flap leadership
+        now = time.monotonic()
+        if now - last_churn > 0.5:
+            last_churn = now
+            # deterministic rotation: every pool gets churned mid-roll
+            # (a timing-derived pick can alias to half the pools)
+            p = churn_i % N_POOLS
+            churn_i += 1
+            for i in range(2):
+                kube.set_node_labels(f"cs{p}-{i}", {
+                    L.CC_MODE_LABEL: "off",
+                    L.CC_MODE_STATE_LABEL: "off"})
+            c._on_demoted()
+            time.sleep(0.05)
+            c._on_promoted()
+        time.sleep(0.02)
+
+    try:
+        assert not violations, violations[:5]
+        # churn over: everything converges and every record completes
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            labels_ok = all(
+                kube.get_node(n)["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL) == "on"
+                for n in names
+            )
+            recs = load_rollout_records(kube, kube.list_nodes(None))
+            recs_done = all(r.get("complete") for r, _ in recs)
+            if labels_ok and recs_done and not c._workers:
+                break
+            time.sleep(0.1)
+        assert not c._workers, "worker slot leaked past convergence"
+        assert all(
+            kube.get_node(n)["metadata"]["labels"].get(
+                L.CC_MODE_STATE_LABEL) == "on"
+            for n in names
+        ), "pools never reconverged after churn"
+        for rec, anchor in load_rollout_records(
+                kube, kube.list_nodes(None)):
+            assert rec.get("complete"), (
+                f"record {rec.get('id')} on {anchor} never completed: "
+                f"{json.dumps(rec)[:300]}"
+            )
+    finally:
+        c.stop()
+        run_t.join(timeout=5)
+        stop.set()
+    assert not run_t.is_alive(), "controller run loop hung on shutdown"
