@@ -101,10 +101,13 @@ int g_evidence_sync_interval_s = 300;
  * TPU_CC_EVIDENCE_SYNC_INTERVAL_S (default 300 s) per node. Two
  * stat() calls per idle second are noise. */
 static unsigned long long key_posture_sig() {
-  static const char *kKeyEnvs[2] = {"TPU_CC_EVIDENCE_KEY_FILE",
-                                    "TPU_CC_EVIDENCE_OLD_KEYS_FILE"};
+  /* TPU_CC_TPM_KEY_FILE rides along: a rotated attestation key must
+   * re-sign quotes the same way a rotated pool key re-signs digests */
+  static const char *kKeyEnvs[3] = {"TPU_CC_EVIDENCE_KEY_FILE",
+                                    "TPU_CC_EVIDENCE_OLD_KEYS_FILE",
+                                    "TPU_CC_TPM_KEY_FILE"};
   unsigned long long sig = 1469598103934665603ULL; /* FNV-1a */
-  for (int i = 0; i < 2; ++i) {
+  for (int i = 0; i < 3; ++i) {
     const char *p = getenv(kKeyEnvs[i]);
     unsigned long long v;
     if (!p || !*p) {

@@ -330,7 +330,6 @@ def test_rollout_refuses_attestation_mismatched_convergence(
 
     from tpu_cc_manager.engine import ModeEngine
     from tpu_cc_manager.evidence import build_evidence
-    from tpu_cc_manager.k8s.fake import FakeKube
     from tpu_cc_manager.rollout import Rollout
 
     be = _statefile_backend(tmp_path)
@@ -403,3 +402,172 @@ def test_keyless_verifier_still_catches_history_contradiction(
     honest["attestation"] = tpm.quote(attestation_nonce(honest))
     verdict, _ = judge_attestation(honest, "k1", key=None)
     assert verdict == "unverifiable"
+
+
+def test_idle_agent_requotes_on_tpm_key_rotation(tmp_path, monkeypatch):
+    """Rotating the attestation key (TPU_CC_TPM_KEY_FILE swapped in
+    place, like any mounted Secret) must re-sign quotes on the idle
+    tick, exactly as a rotated pool key re-signs digests — otherwise
+    every idle node's quote fails verification under the new key
+    until the next periodic sync."""
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+
+    be = _statefile_backend(tmp_path)
+    kube = FakeKube()
+    kube.add_node(make_node("tk-node"))
+    state = tmp_path / "tpm"
+    keyfile = tmp_path / "tpm.key"
+    keyfile.write_bytes(b"aik-v1")
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    monkeypatch.setenv("TPU_CC_TPM_STATE_DIR", str(state))
+    monkeypatch.setenv("TPU_CC_TPM_KEY_FILE", str(keyfile))
+    get_attestor(refresh=True)
+    try:
+        cfg = AgentConfig(node_name="tk-node", drain_strategy="none",
+                          health_port=0, emit_events=False)
+        agent = CCManagerAgent(kube, cfg, backend=be)
+        assert agent.reconcile("on") is True
+        assert agent.flush_events(timeout=10)
+        doc = json.loads(kube.get_node("tk-node")["metadata"]
+                         ["annotations"][L.EVIDENCE_ANNOTATION])
+        assert judge_attestation(doc, "tk-node", key=b"aik-v1")[0] == "ok"
+
+        # rotate the attestation key in place; force the throttled
+        # check due and idle-tick
+        keyfile.write_bytes(b"aik-v2")
+        agent._evidence_key_check_due = 0.0
+        agent._maybe_repair()
+        assert agent.flush_events(timeout=10)
+        doc = json.loads(kube.get_node("tk-node")["metadata"]
+                         ["annotations"][L.EVIDENCE_ANNOTATION])
+        assert judge_attestation(doc, "tk-node", key=b"aik-v2")[0] == "ok"
+    finally:
+        get_attestor(refresh=True)
+
+
+# ------------------------------------------------- Confidential Space
+@pytest.fixture(scope="module")
+def cs_rsa(tmp_path_factory):
+    """Real RSA keypair via the openssl CLI for Confidential Space
+    token verification (same shape as identity's RS256 fixture; an
+    implementation sharing nothing with the verifier under test)."""
+    import base64
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl binary unavailable")
+    d = tmp_path_factory.mktemp("cs-rsa")
+    key = d / "key.pem"
+    r = subprocess.run(["openssl", "genrsa", "-out", str(key), "2048"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"openssl genrsa unavailable: {r.stderr}")
+    mod = subprocess.run(
+        ["openssl", "rsa", "-in", str(key), "-noout", "-modulus"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    n = bytes.fromhex(mod.split("=", 1)[1])
+
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    jwks = {"keys": [{
+        "kty": "RSA", "kid": "cs-kid", "alg": "RS256", "use": "sig",
+        "n": b64url(n), "e": b64url((65537).to_bytes(3, "big")),
+    }]}
+    return str(key), jwks
+
+
+def _mint_cs_token(key_path, nonce, *, exp_delta=3600.0):
+    """An attestation token shaped like Confidential Space's: RS256,
+    eat_nonce claim carrying the evidence nonce."""
+    import base64
+    import subprocess
+    import tempfile
+    import time as _time
+
+    def b64url(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    now = _time.time()
+    header = {"alg": "RS256", "typ": "JWT", "kid": "cs-kid"}
+    payload = {
+        "iss": "https://confidentialcomputing.googleapis.com",
+        "aud": "tpu-cc-manager",
+        "iat": int(now), "exp": int(now + exp_delta),
+        "eat_nonce": [nonce],
+        "submods": {"container": {"image_digest": "sha256:feedface"}},
+    }
+    signing_input = (
+        b64url(json.dumps(header, sort_keys=True).encode()) + "." +
+        b64url(json.dumps(payload, sort_keys=True).encode())
+    )
+    with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+        f.write(signing_input.encode())
+        f.flush()
+        sig = subprocess.run(
+            ["openssl", "dgst", "-sha256", "-sign", key_path, f.name],
+            capture_output=True, check=True,
+        ).stdout
+    return signing_input + "." + b64url(sig)
+
+
+def test_confidential_space_token_judging(cs_rsa, tmp_path,
+                                          monkeypatch):
+    """The real-TEE path end to end at the verifier: a CS-shaped RS256
+    token with the right eat_nonce verifies offline against the
+    provisioned JWKS; a replayed token (wrong nonce) is a mismatch; an
+    aged-out token is 'expired' (staleness, missing-shaped in the
+    audit — never the forgery alarm); no JWKS means unverifiable."""
+    from tpu_cc_manager.attest import attestation_nonce
+    from tpu_cc_manager.evidence import audit_evidence
+
+    key_path, jwks = cs_rsa
+    jwks_file = tmp_path / "jwks.json"
+    jwks_file.write_text(json.dumps(jwks))
+
+    doc = {"version": 1, "node": "csn", "devices": [
+        {"path": "/dev/accel0", "cc": "on", "ici": None}]}
+    nonce = attestation_nonce(doc)
+    doc["attestation"] = {
+        "version": 1, "provider": "confidential-space",
+        "nonce": nonce, "token": _mint_cs_token(key_path, nonce),
+    }
+
+    # no JWKS provisioned: unverifiable, never an alarm
+    monkeypatch.delenv("TPU_CC_ATTESTATION_JWKS_FILE", raising=False)
+    assert judge_attestation(doc, "csn")[0] == "unverifiable"
+
+    monkeypatch.setenv("TPU_CC_ATTESTATION_JWKS_FILE", str(jwks_file))
+    verdict, detail = judge_attestation(doc, "csn")
+    assert verdict == "ok", detail
+
+    # replay onto a different document: nonce no longer commits
+    other = dict(doc)
+    other["devices"] = [{"path": "/dev/accel0", "cc": "off",
+                         "ici": None}]
+    assert judge_attestation(other, "csn")[0] == "mismatch"
+
+    # aged-out token: expired (classed with missing by the audit)
+    stale = {"version": 1, "node": "csn", "devices": [
+        {"path": "/dev/accel0", "cc": "on", "ici": None}]}
+    snonce = attestation_nonce(stale)
+    stale["attestation"] = {
+        "version": 1, "provider": "confidential-space",
+        "nonce": snonce,
+        "token": _mint_cs_token(key_path, snonce, exp_delta=-60),
+    }
+    assert judge_attestation(stale, "csn")[0] == "expired"
+    # the audit only judges attestation on digest-plausible documents
+    from tpu_cc_manager.evidence import _canonical, _digest
+
+    stale["digest"] = _digest(_canonical(stale), None)
+    node = make_node("csn", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(stale)})
+    audit = audit_evidence([node], key=None)
+    assert audit["attestation_mismatch"] == []
+    assert audit["attestation_missing"] == ["csn"]

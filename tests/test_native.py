@@ -1126,6 +1126,38 @@ users: [{{name: u, user: {{}}}}]
                 " 2") in metrics
         assert ('tpu_cc_native_evidence_syncs_total{outcome="failure"}'
                 " 0") in metrics
+
+        # the ATTESTATION key is part of the same posture signature: a
+        # rotated TPM key must re-quote as promptly as a rotated pool
+        # key re-signs (the sync rebuild picks the new key up)
+        tpm_key_file = tmp_path / "tpm-key"
+        # note: TPU_CC_TPM_KEY_FILE was NOT in env at start — the env
+        # var must be set for the watch to consider it; this test
+        # restarts with it set
+        proc.terminate()
+        proc.wait(timeout=5)
+        env["TPU_CC_TPM_KEY_FILE"] = str(tpm_key_file)
+        env["TPU_CC_ATTESTATION"] = "fake"
+        env["TPU_CC_TPM_STATE_DIR"] = str(tmp_path / "tpm")
+        proc = subprocess.Popen(
+            [os.path.join(native_build, "tpu-cc-manager-agent")],
+            env=env, stderr=subprocess.PIPE, text=True,
+        )
+        doc = evidence(lambda d: d.get("attestation") is not None, 20)
+        assert doc is not None, "attested evidence never published"
+        before_sig = doc["attestation"].get("sig")
+        tpm_key_file.write_bytes(b"aik-rotated")
+        doc = evidence(
+            lambda d: d.get("attestation", {}).get("sig")
+            not in (None, before_sig), 15,
+        )
+        assert doc is not None, (
+            "quote not re-signed after TPM key rotation"
+        )
+        from tpu_cc_manager.attest import judge_attestation
+
+        assert judge_attestation(
+            doc, "key-watch-node", key=b"aik-rotated")[0] == "ok"
     finally:
         proc.terminate()
         try:

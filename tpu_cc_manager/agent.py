@@ -150,6 +150,9 @@ class CCManagerAgent:
         #: node's evidence — no mode flip will ever come to do it).
         #: Sentinel: no build yet this process
         self._evidence_key_used: object = self._KEY_UNSET
+        #: the attestation (fake-TPM quote) key of the last build —
+        #: same posture-watch treatment as the evidence key
+        self._attest_key_used: object = self._KEY_UNSET
         #: the key of the last SUCCESSFULLY PUBLISHED document — the
         #: CCEvidenceResigned Event compares against this, so it fires
         #: only for re-signs that landed, on whichever path landed them
@@ -267,18 +270,30 @@ class CCManagerAgent:
         # the API write is deferred.
         try:
             with self.tracer.span("evidence_build"):
+                from tpu_cc_manager.attest import tpm_key
+
                 backend = self._backend or devlayer.get_backend()
                 key = evidence_key()
+                # snapshot BEFORE the build: a rotation landing between
+                # this read and the quote's own would then record the
+                # OLD key against a new-key quote — one harmless extra
+                # republish on the next idle tick; reading AFTER would
+                # record the NEW key against an old-key quote and
+                # suppress the re-sign forever
+                akey = tpm_key()
                 doc = build_evidence(self.cfg.node_name, backend,
                                      key=key)
                 payload = _json.dumps(doc, sort_keys=True,
                                       separators=(",", ":"))
             # recorded at build time (not publish time): what matters
             # for the idle tick's re-sign check is the posture of the
-            # newest document headed for the cluster
+            # newest document headed for the cluster. The attestation
+            # key rides along: a rotated TPM key must re-sign quotes
+            # the same way a rotated pool key re-signs digests.
             self._evidence_key_used = key
+            self._attest_key_used = akey
             self._evidence_identity_refresh_at = (
-                self._identity_refresh_deadline(doc)
+                self._evidence_refresh_deadline(doc)
             )
         except Exception:
             log.warning("evidence build failed; will retry", exc_info=True)
@@ -320,6 +335,21 @@ class CCManagerAgent:
         if self._enqueue_recorder_item(task) == "full":
             log.warning("evidence publish dropped (recorder queue full); "
                         "retrying from the idle tick")
+
+    def _evidence_refresh_deadline(self, doc: dict) -> Optional[float]:
+        """The earlier of the identity-token and attestation-token
+        refresh deadlines: either aging out makes the idle tick
+        republish. Fake-tpm quotes carry no expiry (their freshness is
+        the key posture check)."""
+        from tpu_cc_manager.attest import quote_refresh_deadline
+
+        deadlines = [
+            d for d in (
+                self._identity_refresh_deadline(doc),
+                quote_refresh_deadline(doc),
+            ) if d is not None
+        ]
+        return min(deadlines) if deadlines else None
 
     def _identity_refresh_deadline(self, doc: dict) -> Optional[float]:
         """Wall-clock time at which the evidence should be republished
@@ -677,12 +707,14 @@ class CCManagerAgent:
             # fix they already applied — so the agent re-signs here.
             # Advanced on EVERY check, not just on change: idle ticks
             # run ~1/s and the Secret file must not be opened that often
+            from tpu_cc_manager.attest import tpm_key
             from tpu_cc_manager.evidence import evidence_key
 
             self._evidence_key_check_due = now + (
                 self.cfg.repair_interval_s or 30.0
             )
-            if evidence_key() != self._evidence_key_used:
+            if (evidence_key() != self._evidence_key_used
+                    or tpm_key() != self._attest_key_used):
                 log.info(
                     "evidence key posture changed; re-signing evidence"
                 )

@@ -430,7 +430,10 @@ def _judge_cs_token(att: dict, expected_nonce: str) -> Tuple[str, str]:
         return "invalid", "token signature undecodable"
     exp = payload.get("exp")
     if isinstance(exp, (int, float)) and exp < time.time():
-        return "mismatch", "attestation token expired"
+        # staleness, not forgery: the platform DID attest, the token
+        # simply aged out on an idle node — classed like identity's
+        # expired (missing-shaped), never as the forgery alarm
+        return "expired", "attestation token expired"
     nonces = payload.get("eat_nonce")
     if isinstance(nonces, str):
         nonces = [nonces]
@@ -445,12 +448,13 @@ def judge_attestation(doc: dict, node_name: Optional[str] = None, *,
                       key: Optional[bytes] = None
                       ) -> Tuple[str, str]:
     """Judge the ``attestation`` field of an evidence document. Returns
-    (verdict, detail) with verdicts ``ok | missing | invalid |
-    mismatch | unverifiable`` — a separate axis from identity, so the
-    fleet audit can distinguish "no TEE" from "TEE contradicts the
-    evidence". The node-root drill lands in ``mismatch``: a forged
-    claim's measured flip history disagrees with the mode the document
-    attests."""
+    (verdict, detail) with verdicts ``ok | missing | expired | invalid
+    | mismatch | unverifiable`` — a separate axis from identity, so
+    the fleet audit can distinguish "no TEE" from "TEE contradicts the
+    evidence". ``expired`` (a Confidential Space token that aged out)
+    is staleness, classed with missing by every verifier. The
+    node-root drill lands in ``mismatch``: a forged claim's measured
+    flip history disagrees with the mode the document attests."""
     if not isinstance(doc, dict):
         return "invalid", "document malformed"
     att = doc.get("attestation")
@@ -493,6 +497,37 @@ def judge_attestation(doc: dict, node_name: Optional[str] = None, *,
     if verdict == "unverifiable":
         return verdict, detail
     return "ok", "quote verifies and matches measured history"
+
+
+def quote_refresh_deadline(doc: dict) -> Optional[float]:
+    """Wall-clock time at which the evidence should be republished
+    because its attestation token nears expiry — the attestation twin
+    of the agent's identity-refresh deadline, and the freshness input
+    ``evidence_in_sync`` uses for Confidential Space quotes (fake-tpm
+    quotes carry no expiry: their freshness is the key posture). None
+    when there is nothing to age out."""
+    att = doc.get("attestation") if isinstance(doc, dict) else None
+    if not isinstance(att, dict) or att.get("provider") != \
+            ConfidentialSpaceAttestor.provider:
+        return None
+    token = att.get("token")
+    if not isinstance(token, str) or token.count(".") != 2:
+        return None
+    from tpu_cc_manager.identity import REPUBLISH_MARGIN, token_claims
+
+    try:
+        _, claims = token_claims(token)
+        exp = claims.get("exp")
+        if not isinstance(exp, (int, float)):
+            return None
+        iat = claims.get("iat")
+        if isinstance(iat, (int, float)):
+            margin = REPUBLISH_MARGIN * max(float(exp) - float(iat), 0.0)
+        else:
+            margin = 300.0
+        return float(exp) - margin
+    except Exception:
+        return None
 
 
 # --------------------------------------------------------------- CLI

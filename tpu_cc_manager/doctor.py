@@ -148,6 +148,10 @@ def _attestation_check(checks: List[dict], doc: dict,
                "an attestation provider is configured/detected but "
                "the published evidence carries no quote (heals on "
                "the next evidence sync)")
+    elif averdict == "expired":
+        _check(checks, "attestation", "warn",
+               "attestation token expired — the evidence sync is not "
+               "keeping up")
     elif averdict == "missing":
         _check(checks, "attestation", "ok",
                "no attestation attached (no TEE provider "

@@ -599,6 +599,12 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
             saw_attestation = True
             if averdict in ("mismatch", "invalid"):
                 att_mismatch.append(name)
+            elif averdict == "expired":
+                # staleness, not forgery (identity's expired rule):
+                # the idle node's token aged out before a republish —
+                # missing-shaped, so an idle fleet never reads as
+                # under attack
+                att_missing.append(name)
             elif averdict == "unverifiable":
                 # quote present, no trust root provisioned: visible
                 # (metric) but not a problem line — the expected state
@@ -661,6 +667,37 @@ def evidence_in_sync(current: Optional[dict], fresh: dict,
 
     if modes(current) != modes(fresh):
         return False
+    # attestation posture, mirroring identity's: the quote must exist
+    # iff TODAY's build attaches one (enabling attestation mid-life
+    # republishes; a broken attestor must not strip a still-good
+    # quote), and a fake-tpm quote must still verify under TODAY's
+    # attestation key — a rotated TPM key re-quotes the same way a
+    # rotated pool key re-signs.
+    cur_att = current.get("attestation")
+    fresh_att = fresh.get("attestation")
+    if (cur_att is None) != (fresh_att is None):
+        if cur_att is None:
+            return False  # today's build attests; the cluster doc doesn't
+        # cluster doc has a quote the fresh build could not mint
+        # (attestor blip or decommission): keep the better document
+    elif isinstance(cur_att, dict) and cur_att.get("provider") == "fake-tpm":
+        from tpu_cc_manager.attest import attestation_nonce, verify_quote
+
+        averdict, _ = verify_quote(
+            cur_att, attestation_nonce(current)
+        )
+        if averdict == "mismatch":
+            return False  # quote no longer verifies under today's key
+    elif isinstance(cur_att, dict):
+        # Confidential Space: the token ages out like an identity
+        # token — republish BEFORE verifiers class it expired. The
+        # presence check above already guaranteed the fresh build has
+        # a replacement quote.
+        from tpu_cc_manager.attest import quote_refresh_deadline
+
+        deadline = quote_refresh_deadline(current)
+        if deadline is not None and time.time() >= deadline:
+            return False
     cur_tok = (current.get("identity") or {}).get("token")
     fresh_tok = (fresh.get("identity") or {}).get("token")
     if cur_tok is None:

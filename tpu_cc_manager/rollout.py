@@ -1256,9 +1256,10 @@ class Rollout:
             if averdict in ("mismatch", "invalid"):
                 self._suspect_reasons[m] = f"attestation: {adetail}"
                 out.append(m)
-            elif averdict == "missing" and require_attestation():
+            elif (averdict in ("missing", "expired")
+                    and require_attestation()):
                 self._suspect_reasons[m] = (
-                    "attestation missing "
+                    f"attestation {averdict} "
                     "(TPU_CC_REQUIRE_ATTESTATION is set)"
                 )
                 out.append(m)
