@@ -140,6 +140,27 @@ def test_idempotent_reconcile_does_not_extend(tmp_path, tpm,
     assert events == ["mode:on", "mode:off"]
 
 
+def _forged_backend(tmp_path, monkeypatch):
+    """The node-root drill's shared setup: a statefile backend with
+    REAL measured history ending at 'off' (a fresh statefile is
+    already off, so the honest lifecycle flips on THEN off — the
+    first set_mode("off") alone would be the idempotent fast path and
+    measure nothing), then root rewrites device truth to 'on' OUTSIDE
+    the engine path (no drain, no gate, no measured extend)."""
+    from tpu_cc_manager.engine import ModeEngine
+
+    be = _statefile_backend(tmp_path)
+    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False, backend=be)
+    assert engine.set_mode("on")
+    assert engine.set_mode("off")
+    for chip in be.find_tpus()[0]:
+        be.store.stage(chip.path, "cc", "on")
+        be.store.commit(chip.path)
+    return be
+
+
 def test_node_root_forgery_drill(tmp_path, tpm, monkeypatch):
     """THE drill this module exists for: root rewrites the statefile to
     claim CC without a real flip, re-signs with the node's own pool
@@ -148,28 +169,12 @@ def test_node_root_forgery_drill(tmp_path, tpm, monkeypatch):
     forged document lands in attestation mismatch everywhere: judge,
     doctor, and the fleet audit's problems digest."""
     from tpu_cc_manager.doctor import _attestation_check
-    from tpu_cc_manager.engine import ModeEngine
     from tpu_cc_manager.evidence import (
         audit_evidence, build_evidence, verify_evidence,
     )
     from tpu_cc_manager.fleet import fleet_problems
 
-    be = _statefile_backend(tmp_path)
-    monkeypatch.setenv("TPU_CC_EVIDENCE_KEY", "pool-secret")
-    engine = ModeEngine(set_state_label=lambda v: None,
-                        evict_components=False, backend=be)
-    # honest lifecycle with REAL measured transitions: on, then off
-    # (a fresh statefile is already off, so the first set_mode("off")
-    # would be the idempotent fast path and measure nothing)
-    assert engine.set_mode("on")
-    assert engine.set_mode("off")  # honest state: CC off, measured
-
-    # --- the attack: rewrite device truth OUTSIDE the engine path
-    # (root writing the statefile directly — no drain, no gate, no
-    # measured extend, no actual device work)
-    for chip in be.find_tpus()[0]:
-        be.store.stage(chip.path, "cc", "on")
-        be.store.commit(chip.path)
+    be = _forged_backend(tmp_path, monkeypatch)
     forged = build_evidence("w1", be)  # root runs the same tooling
     # the forgery is pool-key perfect...
     ok, _ = verify_evidence(forged)
@@ -571,3 +576,44 @@ def test_confidential_space_token_judging(cs_rsa, tmp_path,
     audit = audit_evidence([node], key=None)
     assert audit["attestation_mismatch"] == []
     assert audit["attestation_missing"] == ["csn"]
+
+
+def test_forged_attestation_fails_doctor_and_webhook_steers_away(
+        tmp_path, tpm, monkeypatch):
+    """The scheduler-level consequence of the node-root drill: the
+    forged node's doctor verdict goes unhealthy (attestation check
+    fails), cc.doctor.ok flips to false, and with
+    TPU_CC_WEBHOOK_REQUIRE_DOCTOR=true the admission webhook pins
+    confidential pods onto doctor-healthy nodes — the forged node
+    stops receiving requires-cc workloads without any new webhook
+    machinery."""
+    from tpu_cc_manager.doctor import publish_report, run_doctor
+    from tpu_cc_manager.evidence import build_evidence
+    from tpu_cc_manager.webhook import mutate_pod
+
+    be = _forged_backend(tmp_path, monkeypatch)
+
+    kube = FakeKube()
+    kube.add_node(make_node("fw1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(
+            build_evidence("fw1", be))}))
+    report = run_doctor(kube=kube, node_name="fw1", backend=be)
+    att_checks = [c for c in report["checks"]
+                  if c["name"] == "attestation"]
+    assert att_checks and att_checks[0]["severity"] == "fail"
+    assert report["ok"] is False
+    assert publish_report(kube, "fw1", report)
+    labels = kube.get_node("fw1")["metadata"]["labels"]
+    assert labels[L.DOCTOR_OK_LABEL] == "false"
+
+    # the webhook's doctor pin now excludes this node by construction
+    monkeypatch.setenv("TPU_CC_WEBHOOK_REQUIRE_DOCTOR", "true")
+    pod = {"metadata": {"labels": {L.REQUIRES_CC_LABEL: "on"}},
+           "spec": {}}
+    ops = mutate_pod(pod)
+    values = {o["path"]: o.get("value") for o in ops}
+    doctor_pin = next(v for p, v in values.items() if "doctor" in p)
+    assert doctor_pin == "true"
+    assert labels[L.DOCTOR_OK_LABEL] != doctor_pin
