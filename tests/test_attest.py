@@ -617,3 +617,31 @@ def test_forged_attestation_fails_doctor_and_webhook_steers_away(
     doctor_pin = next(v for p, v in values.items() if "doctor" in p)
     assert doctor_pin == "true"
     assert labels[L.DOCTOR_OK_LABEL] != doctor_pin
+
+
+def test_fleet_metrics_carry_attestation_buckets(tmp_path, tpm,
+                                                 monkeypatch):
+    """The audit's attestation buckets must reach /metrics — a bucket
+    that exists only in the JSON report cannot be alerted on."""
+    from tpu_cc_manager.evidence import audit_evidence, build_evidence
+    from tpu_cc_manager.fleet import FleetMetrics
+
+    be = _forged_backend(tmp_path, monkeypatch)
+    node = make_node("m1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on"},
+        annotations={L.EVIDENCE_ANNOTATION: json.dumps(
+            build_evidence("m1", be))})
+    audit = audit_evidence([node])
+    assert audit["attestation_mismatch"] == ["m1"]
+    metrics = FleetMetrics()
+    metrics.update({
+        "nodes": 1, "mode_counts": {}, "needs_flip": [], "failed": [],
+        "incoherent_slices": [], "half_flipped_slices": [],
+        "evidence_audit": audit,
+    })
+    body = metrics.render()
+    assert ('tpu_cc_fleet_evidence_issues'
+            '{issue="attestation_mismatch"} 1') in body
+    assert ('tpu_cc_fleet_evidence_issues'
+            '{issue="attestation_missing"} 0') in body

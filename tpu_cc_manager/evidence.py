@@ -461,6 +461,18 @@ def publish_evidence(kube, node_name: str, backend=None) -> bool:
         return False
 
 
+#: The audit's bucket vocabulary — ONE list shared with the fleet
+#: metrics (FleetMetrics.update iterates it), so a new bucket cannot
+#: reach the JSON report while silently dropping out of /metrics (the
+#: attestation buckets did exactly that before this constant existed).
+EVIDENCE_ISSUE_KEYS = (
+    "missing", "unsigned", "unverifiable", "stale_key", "invalid",
+    "label_device_mismatch", "identity_missing", "identity_mismatch",
+    "attestation_missing", "attestation_mismatch",
+    "attestation_unverifiable",
+)
+
+
 def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
                    identity_seen_before: bool = False) -> dict:
     """Fleet-wide evidence-vs-label audit (run by the fleet controller):
@@ -621,7 +633,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         # knob is the decommission-proof posture)
         att_missing = []
     return {
-        "identity_seen": saw_verified_identity,
+        "identity_seen": saw_verified_identity,  # bool, not a bucket
         "missing": sorted(missing),
         "unsigned": sorted(unsigned),
         "unverifiable": sorted(unverifiable),

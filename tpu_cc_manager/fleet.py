@@ -287,9 +287,12 @@ class FleetMetrics:
         self.incoherent_slices.set(len(report["incoherent_slices"]))
         self.half_flipped_slices.set(len(report["half_flipped_slices"]))
         audit = report.get("evidence_audit", {})
-        for issue in ("missing", "unsigned", "unverifiable", "stale_key",
-                      "invalid", "label_device_mismatch",
-                      "identity_missing", "identity_mismatch"):
+        from tpu_cc_manager.evidence import EVIDENCE_ISSUE_KEYS
+
+        # the canonical bucket vocabulary (shared with audit_evidence):
+        # iterating a fixed tuple keeps zero-out semantics when a
+        # bucket is absent from this scan's audit
+        for issue in EVIDENCE_ISSUE_KEYS:
             self.evidence_issues.set(len(audit.get(issue, [])), issue)
         self.doctor_failing.set(
             len(report.get("doctor", {}).get("failing", []))
