@@ -20,6 +20,15 @@ import sys
 
 REGRESSION_FACTOR = 2.0
 
+#: extras axes gated like the headline pair — seconds-valued, bigger
+#: is worse. Rounds where either side lacks the axis (older bench, a
+#: CPU-only host for real_chip) skip the comparison silently, so
+#: mixed-era histories stay green; once both rounds carry a number,
+#: an unnoted >2x regression fails CI. real_chip_flip_s joined after
+#: the r05 4.43s jump arrived unnoticed (VERDICT r5 weak #3);
+#: pool256_convergence_s is the simlab live-fleet scenario.
+GATED_EXTRA_AXES = ("real_chip_flip_s", "pool256_convergence_s")
+
 
 def _round_num(path):
     m = re.search(r"BENCH_r(\d+)\.json$", path)
@@ -85,6 +94,14 @@ def main(root: str = ".") -> int:
             f"{key} {fpm_prev} -> {fpm_cur} "
             f"({fpm_prev / fpm_cur:.1f}x fewer)"
         )
+    for axis in GATED_EXTRA_AXES:
+        a, b = prev_x.get(axis), cur_x.get(axis)
+        if (isinstance(a, (int, float)) and a > 0
+                and isinstance(b, (int, float)) and b > 0
+                and b > a * REGRESSION_FACTOR):
+            problems.append(
+                f"{axis} {a} -> {b} ({b / a:.1f}x slower)"
+            )
     if not problems:
         print(f"bench-trend: {os.path.basename(cur_path)} within "
               f"{REGRESSION_FACTOR}x of {os.path.basename(prev_path)}")

@@ -645,3 +645,89 @@ def test_fleet_metrics_carry_attestation_buckets(tmp_path, tpm,
             '{issue="attestation_mismatch"} 1') in body
     assert ('tpu_cc_fleet_evidence_issues'
             '{issue="attestation_missing"} 0') in body
+
+
+def test_attestation_outage_latch(tmp_path, monkeypatch):
+    """VERDICT r5 weak #5: identity's cross-scan latch, granted to
+    attestation for the failure identity cannot see. A fleet whose
+    quotes VERIFIED once dropping wholesale to 'unverifiable' means the
+    VERIFIER lost its trust root — that must be a problems line, not a
+    metric fade. A fleet still mid-enablement (never verified) stays
+    quiet."""
+    from tpu_cc_manager.evidence import audit_evidence, build_evidence
+    from tpu_cc_manager.fleet import fleet_problems
+
+    be = _statefile_backend(tmp_path)
+    keyfile = tmp_path / "aik.key"
+    keyfile.write_bytes(KEY)
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    monkeypatch.setenv("TPU_CC_TPM_STATE_DIR", str(tmp_path / "tpm"))
+    monkeypatch.setenv("TPU_CC_TPM_KEY_FILE", str(keyfile))
+    get_attestor(refresh=True)
+    try:
+        doc = json.dumps(build_evidence("n1", be, key=None))
+    finally:
+        monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
+        get_attestor(refresh=True)
+
+    def node(name):
+        return make_node(name, labels={
+            L.TPU_ACCELERATOR_LABEL: "v5p",
+            L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+            annotations={L.EVIDENCE_ANNOTATION: doc})
+
+    # scan 1, verifier keyed: quote verifies -> latch feed True, quiet
+    audit = audit_evidence([node("n1")])
+    assert audit["attestation_seen"] is True
+    assert audit["attestation_outage"] == []
+
+    # verifier loses the key: unverifiable everywhere
+    monkeypatch.delenv("TPU_CC_TPM_KEY_FILE")
+    # fresh fleet (latch never armed): enablement-in-progress, quiet
+    audit = audit_evidence([node("n1")])
+    assert audit["attestation_seen"] is False
+    assert audit["attestation_unverifiable"] == ["n1"]
+    assert audit["attestation_outage"] == []
+    assert not any("trust root" in p for p in
+                   fleet_problems({"evidence_audit": audit}))
+
+    # latched fleet: the same scan is now a loud verifier outage
+    audit = audit_evidence([node("n1")], attestation_seen_before=True)
+    assert audit["attestation_outage"] == ["n1"]
+    problems = fleet_problems({"evidence_audit": audit})
+    assert any("trust root" in p and "n1" in p for p in problems)
+
+
+def test_fleet_controller_arms_attestation_latch_across_scans(
+        tmp_path, monkeypatch):
+    """End to end through the controller: keyed scan arms the sticky
+    latch; the key vanishing turns the NEXT scan's report loud."""
+    from tpu_cc_manager.evidence import build_evidence
+    from tpu_cc_manager.fleet import FleetController
+
+    be = _statefile_backend(tmp_path)
+    keyfile = tmp_path / "aik.key"
+    keyfile.write_bytes(KEY)
+    monkeypatch.setenv("TPU_CC_ATTESTATION", "fake")
+    monkeypatch.setenv("TPU_CC_TPM_STATE_DIR", str(tmp_path / "tpm"))
+    monkeypatch.setenv("TPU_CC_TPM_KEY_FILE", str(keyfile))
+    get_attestor(refresh=True)
+    try:
+        doc = json.dumps(build_evidence("f1", be, key=None))
+    finally:
+        monkeypatch.setenv("TPU_CC_ATTESTATION", "none")
+        get_attestor(refresh=True)
+    kube = FakeKube()
+    kube.add_node(make_node("f1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p",
+        L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off"},
+        annotations={L.EVIDENCE_ANNOTATION: doc}))
+    ctrl = FleetController(kube, interval_s=30, port=0)
+    report = ctrl.scan_once()
+    assert report["evidence_audit"]["attestation_outage"] == []
+    assert not any("trust root" in p for p in report["problems"])
+    # verifier key lost between scans
+    monkeypatch.delenv("TPU_CC_TPM_KEY_FILE")
+    report = ctrl.scan_once()
+    assert report["evidence_audit"]["attestation_outage"] == ["f1"]
+    assert any("trust root" in p for p in report["problems"])

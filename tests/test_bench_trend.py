@@ -92,3 +92,36 @@ def test_gate_prefers_windowed_flips(tmp_path):
     _write(tmp_path, 3, 0.1, 2000,
            extras={"flips_per_min_windowed": 2500})
     assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_gated_extra_axis_real_chip_regression_fails(tmp_path):
+    """The r05 lesson (VERDICT r5 weak #3): the one real-hardware
+    number regressed 2.4x and nothing noticed — the extras axes are
+    now compared like the headline pair."""
+    _write(tmp_path, 1, 0.10, 1000, extras={"real_chip_flip_s": 1.87})
+    _write(tmp_path, 2, 0.10, 1000, extras={"real_chip_flip_s": 4.43})
+    assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_gated_extra_axis_simlab_convergence_fails(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000,
+           extras={"pool256_convergence_s": 8.0})
+    _write(tmp_path, 2, 0.10, 1000,
+           extras={"pool256_convergence_s": 30.0})
+    assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_gated_extra_axis_mixed_era_skips(tmp_path):
+    """A CPU-only host (no real_chip number) or a pre-simlab round must
+    not fail the comparison — absent on either side skips the axis."""
+    _write(tmp_path, 1, 0.10, 1000, extras={"real_chip_flip_s": 1.87})
+    _write(tmp_path, 2, 0.10, 1000)  # no hardware this round
+    assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_gated_extra_axis_noted_regression_passes(tmp_path):
+    _write(tmp_path, 1, 0.10, 1000, extras={"real_chip_flip_s": 1.87})
+    _write(tmp_path, 2, 0.10, 1000,
+           extras={"real_chip_flip_s": 4.43,
+                   "regression_note": "firmware reflash mid-bench"})
+    assert bench_trend.main(str(tmp_path)) == 0

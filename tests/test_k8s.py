@@ -437,3 +437,48 @@ def test_http_apiserver_lists_events_by_namespace():
                 "reason": "CCModeApplied", "message": "m", "type": "Normal"})
         items = kube.list_events("default")
         assert [e["metadata"]["name"] for e in items] == ["e1", "e2"]
+
+
+# ------------------------------------------- accept-layer error handling
+def test_rude_disconnect_prints_no_traceback(server, client, capfd):
+    """VERDICT r5 weak #6: a client vanishing at the accept/readline
+    layer (RST mid-request) used to print socketserver's full traceback
+    into the smoke's green log. handle_error must swallow the benign
+    disconnect classes — and the server must keep serving."""
+    import socket
+    import struct
+
+    s = socket.create_connection(("127.0.0.1", server.port))
+    # SO_LINGER(on, 0): close() sends RST, so the handler thread gets
+    # ECONNRESET at the readline layer, not a clean FIN
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                 struct.pack("ii", 1, 0))
+    s.send(b"GET /api/v1/nodes HTT")  # partial request line
+    time.sleep(0.05)
+    s.close()
+    time.sleep(0.2)
+    # still serving after the rude client
+    server.store.add_node(make_node("post-rst", labels={}))
+    assert client.get_node("post-rst")["metadata"]["name"] == "post-rst"
+    out, err = capfd.readouterr()
+    assert "Traceback" not in err and "Traceback" not in out
+
+
+def test_handle_error_swallows_benign_logs_others(server, caplog):
+    """Direct contract: client-gone classes are silent; anything else
+    logs ONE warning line (no traceback)."""
+    import logging
+
+    httpd = server.httpd
+    try:
+        raise ConnectionResetError("peer reset")
+    except ConnectionResetError:
+        httpd.handle_error(None, ("127.0.0.1", 1))  # must not print
+    with caplog.at_level(logging.WARNING,
+                         logger="tpu-cc-manager.fake-apiserver"):
+        try:
+            raise RuntimeError("genuinely unexpected")
+        except RuntimeError:
+            httpd.handle_error(None, ("127.0.0.1", 2))
+    assert any("genuinely unexpected" in r.message
+               for r in caplog.records)

@@ -1,0 +1,254 @@
+"""simlab — the fleet-scale scenario lab (tpu_cc_manager/simlab).
+
+Three surfaces under test: the STRICT scenario schema (unknown keys
+anywhere are errors — the freshness gate depends on it), the committed
+``scenarios/*.json`` examples (parse + validate + canonical formatting,
+the kustomize-tree treatment from test_manifests.py), and the live
+harness itself — replicas, shared watch pump, worker pool, fault
+injector — run small enough for the suite but through the same wire
+path the 256-node scenario uses."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from tpu_cc_manager.simlab.scenario import (
+    ScenarioError, canonical_scenario_text, load_scenario,
+    validate_scenario,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCENARIO_DIR = os.path.join(ROOT, "scenarios")
+
+
+def _minimal(**over):
+    doc = {
+        "version": 1,
+        "name": "t",
+        "nodes": 4,
+        "actions": [{"at": 0.0, "action": "set_mode", "mode": "on"}],
+        "converge": {"mode": "on", "timeout_s": 30},
+    }
+    doc.update(over)
+    return doc
+
+
+# ---------------------------------------------------------------- schema
+def test_minimal_scenario_validates():
+    sc = validate_scenario(_minimal())
+    assert sc.nodes == 4 and sc.workers == 8 and sc.qps == 0.0
+    assert sc.converge.mode == "on"
+    assert [a.kind for a in sc.actions] == ["set_mode"]
+
+
+def test_unknown_keys_rejected_everywhere():
+    with pytest.raises(ScenarioError, match="unknown key"):
+        validate_scenario(_minimal(extra=1))
+    with pytest.raises(ScenarioError, match="unknown key"):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "set_mode", "mode": "on",
+                      "bogus": 1}]))
+    with pytest.raises(ScenarioError, match="unknown key"):
+        validate_scenario(_minimal(
+            converge={"mode": "on", "bogus": 1}))
+    with pytest.raises(ScenarioError, match="unknown key"):
+        validate_scenario(_minimal(controllers={"bogus": True}))
+
+
+def test_version_gate_refuses_future_schema():
+    with pytest.raises(ScenarioError, match="version"):
+        validate_scenario(_minimal(version=2))
+
+
+def test_invalid_modes_and_faults_rejected():
+    with pytest.raises(ScenarioError):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "set_mode",
+                      "mode": "warp-speed"}]))
+    with pytest.raises(ScenarioError, match="unknown fault"):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "fault",
+                      "fault": "meteor_strike"}]))
+    with pytest.raises(ScenarioError, match="missing required"):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "fault",
+                      "fault": "agent_crash"}]))  # no count
+
+
+def test_cross_field_requirements():
+    # policy actions need the policy controller
+    with pytest.raises(ScenarioError, match="controllers.policy"):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "create_policy",
+                      "mode": "on"}]))
+    # leader_flap needs the elected pair
+    with pytest.raises(ScenarioError, match="leader_elect"):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "fault",
+                      "fault": "leader_flap"}]))
+    # leader_elect without policy is meaningless
+    with pytest.raises(ScenarioError, match="requires controllers.policy"):
+        validate_scenario(_minimal(
+            controllers={"leader_elect": True}))
+
+
+def test_actions_sorted_by_time():
+    sc = validate_scenario(_minimal(actions=[
+        {"at": 2.0, "action": "set_mode", "mode": "on"},
+        {"at": 0.5, "action": "set_mode", "mode": "off"},
+    ]))
+    assert [a.at for a in sc.actions] == [0.5, 2.0]
+
+
+# --------------------------------------------- committed-example freshness
+def test_committed_scenarios_validate_and_are_fresh():
+    """Every scenarios/*.json must parse, validate, and match the
+    canonical formatting byte for byte — the schema-example staleness
+    gate (test_manifests.py's kustomize freshness treatment). A schema
+    change that orphans an example fails here, not in a user's lap."""
+    paths = sorted(glob.glob(os.path.join(SCENARIO_DIR, "*.json")))
+    names = {os.path.basename(p) for p in paths}
+    # the CI smoke scenario and the bench's gated scenario must exist
+    assert {"smoke-64.json", "scale-256.json"} <= names
+    for path in paths:
+        with open(path) as f:
+            text = f.read()
+        doc = json.loads(text)
+        sc = validate_scenario(doc)  # semantics
+        assert text == canonical_scenario_text(doc), (
+            f"{path} is not canonically formatted; regenerate with "
+            "canonical_scenario_text()"
+        )
+        # committed examples must be runnable as written
+        assert sc.nodes >= 1 and sc.actions
+
+
+def test_bench_gated_scenario_is_256_nodes():
+    """bench.py's extras key is pool256_convergence_s — the scenario it
+    runs must actually be 256 nodes, or the gated axis silently changes
+    meaning."""
+    sc = load_scenario(os.path.join(SCENARIO_DIR, "scale-256.json"))
+    assert sc.nodes == 256
+    faults = [a.params["fault"] for a in sc.actions
+              if a.kind == "fault"]
+    assert "watch_drop" in faults and "agent_crash" in faults
+
+
+# ------------------------------------------------------------- live runs
+def test_live_run_with_faults_converges(tmp_path):
+    """The harness end to end at suite scale: 16 live replicas, every
+    storefront fault kind, convergence reached and the artifact carries
+    the full metric surface (the acceptance shape of the 256-node
+    scenario, small)."""
+    from tpu_cc_manager.simlab.report import write_artifact
+    from tpu_cc_manager.simlab.runner import SimLab
+
+    doc = _minimal(
+        name="live-16", nodes=16, pools=2, workers=4,
+        watch_timeout_s=2, qps=50,
+        actions=[
+            {"at": 0.0, "action": "fault", "fault": "watch_drop",
+             "count": 2},
+            {"at": 0.05, "action": "fault", "fault": "agent_crash",
+             "count": 4, "restart_after_s": 0.8},
+            {"at": 0.2, "action": "set_mode", "mode": "on"},
+            {"at": 0.5, "action": "fault", "fault": "watch_410"},
+            {"at": 0.6, "action": "fault", "fault": "throttle_squeeze",
+             "qps": 5, "duration_s": 0.5},
+            {"at": 0.7, "action": "fault", "fault": "list_429",
+             "count": 1},
+        ],
+        converge={"mode": "on", "timeout_s": 60},
+    )
+    art = SimLab(validate_scenario(doc)).run()
+    assert art["ok"], art.get("notes")
+    m = art["metrics"]
+    assert m["pool16_convergence_s"] is not None
+    assert m["pool16_convergence_s"] < 30
+    # live churn was measured, not simulated
+    assert m["watch_pump"]["delivered"] >= 16
+    assert m["watch_pump"]["lag_samples"] >= 12
+    assert m["watch_pump"]["lag_p50_s"] is not None
+    assert m["reconciles"]["total"] >= 32  # init + storm
+    assert m["reconciles"]["crashed"] == 4
+    assert m["reconciles"]["restarted"] == 4
+    assert "reconcile" in m["phase_p50_s"]
+    assert m["throttle"]["histogram"]["count"] > 0
+    assert len(art["faults"]) == 6
+    # artifact writer round-trips
+    out = tmp_path / "artifact.json"
+    write_artifact(str(out), art)
+    assert json.loads(out.read_text())["ok"] is True
+
+
+def test_pump_relists_through_410_and_delivers(tmp_path):
+    """Deterministic 410 drill: compact the watch history UNDER the
+    pump while it is disconnected, change a label, and the pump must
+    410 -> full relist -> deliver (reference main.py:675-687 behavior
+    at fleet scale)."""
+    from tpu_cc_manager import labels as L
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+    from tpu_cc_manager.k8s.objects import make_node
+    from tpu_cc_manager.obs import watch_pump_lag_histogram
+    from tpu_cc_manager.simlab.pump import LagStamps, WatchPump
+
+    delivered = []
+
+    class PoolStub:
+        def submit(self, name, value):
+            delivered.append((name, value))
+
+    with FakeApiServer() as server:
+        store = server.store
+        for i in range(4):
+            store.add_node(make_node(f"p{i}", labels={
+                L.CC_MODE_LABEL: "off"}))
+        kube = HttpKubeClient(
+            KubeConfig("127.0.0.1", server.port, use_tls=False)
+        )
+        pump = WatchPump(
+            kube, {f"p{i}": object() for i in range(4)}, PoolStub(),
+            LagStamps(), watch_pump_lag_histogram(),
+            watch_timeout_s=1, backoff_s=0.05,
+        )
+        pump.prime()  # rv captured BEFORE the churn below
+        # churn + compaction while the pump is not connected: its
+        # resume rv is now below retained history
+        store.set_node_labels("p0", {L.CC_MODE_LABEL: "on"})
+        store.compact_watch_history()
+        store.set_node_labels("p1", {L.CC_MODE_LABEL: "on"})
+        pump.start()
+        try:
+            deadline = __import__("time").monotonic() + 10
+            while (len(delivered) < 2
+                   and __import__("time").monotonic() < deadline):
+                __import__("time").sleep(0.02)
+        finally:
+            pump.stop()
+        assert pump.gone_410_total >= 1
+        assert pump.relists_total >= 1
+        assert ("p0", "on") in delivered and ("p1", "on") in delivered
+
+
+def test_cli_validate_and_scaled_run(tmp_path):
+    """The __main__ surface: `simlab validate` on the committed files,
+    and a `simlab run` with --nodes/--workers overrides small enough
+    for the suite — the artifact lands at --out and rc says ok."""
+    from tpu_cc_manager.__main__ import main
+
+    committed = sorted(glob.glob(os.path.join(SCENARIO_DIR, "*.json")))
+    assert main(["simlab", "validate"] + committed) == 0
+
+    out = tmp_path / "art.json"
+    rc = main([
+        "simlab", "run",
+        os.path.join(SCENARIO_DIR, "smoke-64.json"),
+        "--nodes", "6", "--workers", "2", "--out", str(out),
+    ])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["ok"] is True
+    assert art["metrics"]["pool6_convergence_s"] is not None

@@ -121,11 +121,16 @@ def test_engine_emits_phase_spans():
     )
     assert engine.set_mode("on")
     names = [s["name"] for s in tr.recent()]
-    assert names == [
-        "enumerate", "plan", "taint_set", "evict", "holder_check",
-        "flip", "holder_check", "flip", "reschedule", "taint_clear",
-        "state_label",
-    ]
+    # spans land on COMPLETION, so a flip's sub-phases (stage ->
+    # holder_check -> reset -> wait_ready -> verify) precede their
+    # parent "flip" span
+    per_flip = ["stage", "holder_check", "reset", "wait_ready",
+                "verify", "flip"]
+    assert names == (
+        ["enumerate", "plan", "taint_set", "evict"]
+        + per_flip + per_flip
+        + ["reschedule", "taint_clear", "state_label"]
+    )
     plan_span = next(s for s in tr.recent() if s["name"] == "plan")
     assert plan_span["attrs"] == {"mode": "on", "devices": 2, "divergent": 2}
     flips = [s for s in tr.recent() if s["name"] == "flip"]

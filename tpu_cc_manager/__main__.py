@@ -21,7 +21,10 @@ Modes of operation (parity with both reference CLIs):
   tpu_cc_manager.webhook);
 - ``doctor``: node-local trust-surface diagnostic — statefile, gate,
   holders, labels, evidence cross-checked in one JSON report (see
-  tpu_cc_manager.doctor).
+  tpu_cc_manager.doctor);
+- ``simlab``: the fleet-scale scenario lab — hundreds of live
+  reconciling replicas, scripted faults, JSON artifacts (see
+  tpu_cc_manager.simlab, docs/simlab.md).
 """
 
 from __future__ import annotations
@@ -271,6 +274,11 @@ def main(argv=None) -> int:
         from tpu_cc_manager.doctor import main_from_args
 
         return main_from_args(cfg, args)
+
+    if args.command == "simlab":
+        from tpu_cc_manager.simlab import main_from_args
+
+        return main_from_args(args)
 
     if args.command == "webhook":
         from tpu_cc_manager.webhook import AdmissionServer

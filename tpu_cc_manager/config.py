@@ -78,6 +78,9 @@ flag                      env                            default
                                                         manifests set it via downward API)
 (none)                    OPERATOR_NAMESPACE             tpu-system (also where the
                                                         election Leases live)
+(none)                    TPU_CC_SIMLAB_WORKERS          0 = scenario's value (simlab:
+                                                        reconcile worker slots shared
+                                                        by all replicas)
 ========================  =============================  =======================
 """
 
@@ -364,6 +367,38 @@ def build_parser() -> argparse.ArgumentParser:
         "--key", default=os.environ.get("WEBHOOK_KEY"),
         help="TLS server key (env WEBHOOK_KEY; defaults to --cert)",
     )
+    sim = sub.add_parser(
+        "simlab",
+        help="fleet-scale scenario lab: run hundreds of live reconciling "
+             "agent replicas against the in-process wire-level API "
+             "server, execute a declarative scenario (mode storms, "
+             "policy rollouts, scripted faults), and emit a JSON "
+             "artifact (operator/CI-side; no NODE_NAME needed) — see "
+             "docs/simlab.md",
+    )
+    simsub = sim.add_subparsers(dest="simlab_command")
+    sim_run = simsub.add_parser(
+        "run", help="execute one scenario file and print the artifact"
+    )
+    sim_run.add_argument("scenario", help="path to a scenario JSON file")
+    sim_run.add_argument(
+        "--out", default=None,
+        help="also write the artifact JSON to this path",
+    )
+    sim_run.add_argument(
+        "--nodes", type=int, default=0,
+        help="override the scenario's node count (0 = as written)",
+    )
+    sim_run.add_argument(
+        "--workers", type=int, default=0,
+        help="override the scenario's worker-slot count (0 = as "
+             "written; env TPU_CC_SIMLAB_WORKERS also overrides)",
+    )
+    sim_val = simsub.add_parser(
+        "validate", help="validate scenario files against the schema"
+    )
+    sim_val.add_argument("scenarios", nargs="+",
+                         help="scenario JSON files to validate")
     doc = sub.add_parser(
         "doctor",
         help="cross-check every node-local trust surface (statefile, "
@@ -388,7 +423,7 @@ def parse_config(argv: Optional[List[str]] = None):
     args = build_parser().parse_args(argv)
     if not args.node_name and args.command not in (
         "get-cc-mode", "probe-devices", "rollout", "fleet-controller",
-        "policy-controller", "webhook", "doctor",
+        "policy-controller", "webhook", "doctor", "simlab",
     ):
         raise SystemExit(
             "NODE_NAME env or --node-name flag is required"

@@ -377,12 +377,18 @@ class ModeEngine:
                     # workload that could open the node observably cannot
                     if not dev.is_ici_switch():
                         self._gate.lock_for_flip(dev.path)
-                    dev.discard_staged()
-                    for domain, target in changes.items():
-                        if domain == "cc":
-                            dev.set_cc_mode(target)
-                        else:
-                            dev.set_ici_mode(target)
+                    # sub-phase spans: the flip's wall clock decomposes
+                    # into stage/reset/wait_ready/verify so a hardware
+                    # regression names its phase (the r05 real-chip
+                    # 1.87->4.43s jump arrived opaque because this
+                    # span was one block)
+                    with self._tracer.span("stage", device=dev.path):
+                        dev.discard_staged()
+                        for domain, target in changes.items():
+                            if domain == "cc":
+                                dev.set_cc_mode(target)
+                            else:
+                                dev.set_ici_mode(target)
                     # exclusive-hold guarantee (the reference's driver
                     # unbind makes this impossible by construction,
                     # scripts/cc-manager.sh:40-50): the gate above stops
@@ -391,43 +397,48 @@ class ModeEngine:
                     # restart hook if needed
                     with self._tracer.span("holder_check", device=dev.path):
                         self._holder_check.ensure_free(dev.path)
-                    dev.reset()
-                    dev.wait_ready(timeout_s=self._boot_timeout_s)
-                    for domain, target in changes.items():
-                        achieved = (
-                            dev.query_cc_mode() if domain == "cc"
-                            else dev.query_ici_mode()
-                        )
-                        if achieved != target:
-                            log.error(
-                                "%s: %s mode verify mismatch: wanted %r got %r",
-                                dev.path, domain, target, achieved,
+                    with self._tracer.span("reset", device=dev.path):
+                        dev.reset()
+                    with self._tracer.span("wait_ready", device=dev.path):
+                        dev.wait_ready(timeout_s=self._boot_timeout_s)
+                    with self._tracer.span(
+                        "verify", device=dev.path
+                    ) as verify_span:
+                        for domain, target in changes.items():
+                            achieved = (
+                                dev.query_cc_mode() if domain == "cc"
+                                else dev.query_ici_mode()
                             )
-                            flip_span.status = "error"
-                            flip_span.error = (
-                                f"verify mismatch: {domain} wanted "
-                                f"{target!r} got {achieved!r}"
-                            )
-                            return False
-                        # non-tautological verify: a reader that shares
-                        # nothing with the flip path but the bytes on
-                        # disk must agree too (reference main.py:291-296
-                        # re-queries hardware that can genuinely
-                        # disagree; our statefile-backed chips would
-                        # otherwise only re-read their own bookkeeping)
-                        independent = dev.verify_independent(domain)
-                        if independent is not None and independent != target:
-                            log.error(
-                                "%s: independent %s verify disagrees: "
-                                "wanted %r, independent reader saw %r",
-                                dev.path, domain, target, independent,
-                            )
-                            flip_span.status = "error"
-                            flip_span.error = (
-                                f"independent verify mismatch: {domain} "
-                                f"wanted {target!r} got {independent!r}"
-                            )
-                            return False
+                            if achieved != target:
+                                log.error(
+                                    "%s: %s mode verify mismatch: wanted %r got %r",
+                                    dev.path, domain, target, achieved,
+                                )
+                                verify_span.status = flip_span.status = "error"
+                                flip_span.error = verify_span.error = (
+                                    f"verify mismatch: {domain} wanted "
+                                    f"{target!r} got {achieved!r}"
+                                )
+                                return False
+                            # non-tautological verify: a reader that shares
+                            # nothing with the flip path but the bytes on
+                            # disk must agree too (reference main.py:291-296
+                            # re-queries hardware that can genuinely
+                            # disagree; our statefile-backed chips would
+                            # otherwise only re-read their own bookkeeping)
+                            independent = dev.verify_independent(domain)
+                            if independent is not None and independent != target:
+                                log.error(
+                                    "%s: independent %s verify disagrees: "
+                                    "wanted %r, independent reader saw %r",
+                                    dev.path, domain, target, independent,
+                                )
+                                verify_span.status = flip_span.status = "error"
+                                flip_span.error = verify_span.error = (
+                                    f"independent verify mismatch: {domain} "
+                                    f"wanted {target!r} got {independent!r}"
+                                )
+                                return False
                     if not dev.is_ici_switch():
                         final_cc = changes.get(
                             "cc",

@@ -469,12 +469,13 @@ EVIDENCE_ISSUE_KEYS = (
     "missing", "unsigned", "unverifiable", "stale_key", "invalid",
     "label_device_mismatch", "identity_missing", "identity_mismatch",
     "attestation_missing", "attestation_mismatch",
-    "attestation_unverifiable",
+    "attestation_unverifiable", "attestation_outage",
 )
 
 
 def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
-                   identity_seen_before: bool = False) -> dict:
+                   identity_seen_before: bool = False,
+                   attestation_seen_before: bool = False) -> dict:
     """Fleet-wide evidence-vs-label audit (run by the fleet controller):
     every node whose ``cc.mode.state`` label claims a successfully
     applied mode must carry evidence that (a) passes integrity
@@ -519,7 +520,16 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
     garbage token would let one bad document turn every later scan
     into noise until restart. (Pools whose tokens are merely
     ``unverifiable`` — no JWKS provisioned — don't arm the latch;
-    provision the JWKS, or set TPU_CC_REQUIRE_IDENTITY.)"""
+    provision the JWKS, or set TPU_CC_REQUIRE_IDENTITY.)
+
+    Attestation has its own cross-scan latch, scoped to the failure
+    identity cannot see: ``attestation_seen_before`` is True once any
+    scan verified a quote (the returned ``attestation_seen``), and a
+    later scan where NO quote verifies and some read ``unverifiable``
+    fills the ``attestation_outage`` bucket — the verifier lost its
+    trust root (TPU_CC_TPM_KEY / attestation JWKS), a loud problem, not
+    a metric fade. A fleet still mid-enablement (never verified) stays
+    quiet."""
     from tpu_cc_manager import labels as L
     from tpu_cc_manager.attest import (
         judge_attestation, require_attestation,
@@ -541,6 +551,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
     saw_identity = False
     saw_verified_identity = False
     saw_attestation = False
+    saw_verified_attestation = False
     for node in nodes:
         meta = node.get("metadata", {})
         name = meta.get("name", "?")
@@ -609,6 +620,11 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
             att_missing.append(name)
         else:
             saw_attestation = True
+            if averdict == "ok":
+                # only a VERIFIED quote arms the cross-scan outage
+                # latch (identity's rule: the annotation is hostile
+                # input; a forged quote must not weaponize the alarm)
+                saw_verified_attestation = True
             if averdict in ("mismatch", "invalid"):
                 att_mismatch.append(name)
             elif averdict == "expired":
@@ -627,13 +643,26 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         # finding — the platform simply mints no identities here
         ident_missing = []
     if not (require_attestation() or saw_attestation):
-        # mirror identity's mixed-pool rule (per-scan only; the
-        # cross-scan latch stays identity's — attestation enablement
-        # is operator-driven via TPU_CC_ATTESTATION, and the require
-        # knob is the decommission-proof posture)
+        # mirror identity's mixed-pool rule for the MISSING bucket
+        # (per-scan only — attestation enablement is operator-driven
+        # via TPU_CC_ATTESTATION, and the require knob is the
+        # decommission-proof posture)
         att_missing = []
+    attestation_outage: List[str] = []
+    if (attestation_seen_before and not saw_verified_attestation
+            and att_unverifiable):
+        # the cross-scan latch attestation previously declined, scoped
+        # to the failure identity cannot see: a fleet whose quotes once
+        # VERIFIED dropping wholesale to 'unverifiable' means the
+        # VERIFIER side lost its trust root (TPU_CC_TPM_KEY /
+        # attestation JWKS) — the nodes are still quoting; nobody can
+        # check them. Without the latch this is a metric-only fade
+        # (VERDICT r5 weak #5). Enablement-in-progress stays quiet:
+        # a fleet that never verified doesn't arm it.
+        attestation_outage = list(att_unverifiable)
     return {
         "identity_seen": saw_verified_identity,  # bool, not a bucket
+        "attestation_seen": saw_verified_attestation,  # latch feed
         "missing": sorted(missing),
         "unsigned": sorted(unsigned),
         "unverifiable": sorted(unverifiable),
@@ -645,6 +674,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         "attestation_missing": sorted(att_missing),
         "attestation_mismatch": sorted(att_mismatch),
         "attestation_unverifiable": sorted(att_unverifiable),
+        "attestation_outage": sorted(attestation_outage),
     }
 
 

@@ -32,6 +32,8 @@ Watch responses are newline-delimited JSON event streams, ending when the
 from __future__ import annotations
 
 import json
+import logging
+import sys
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -39,6 +41,8 @@ from typing import Optional
 
 from tpu_cc_manager.k8s.client import ApiException
 from tpu_cc_manager.k8s.fake import FakeKube
+
+log = logging.getLogger("tpu-cc-manager.fake-apiserver")
 
 
 def _list_obj(kind: str, items: list, cont: Optional[str]) -> dict:
@@ -336,6 +340,24 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
 
+class _ApiHTTPServer(ThreadingHTTPServer):
+    # a 32-node pool opening watch streams at once overflows the
+    # default listen(5) backlog -> connection resets
+    request_queue_size = 256
+
+    def handle_error(self, request, client_address):
+        """Client-gone at the accept/readline layer (before or between
+        requests) must not print socketserver's full traceback into a
+        green smoke log — the in-handler suppression in _stream_events
+        only covers disconnects DURING a response. Anything else still
+        gets one loud line."""
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return  # benign: a client hung up mid-handshake/idle
+        log.warning("request from %s failed: %s: %s", client_address,
+                    type(exc).__name__, exc)
+
+
 class FakeApiServer:
     """Owns a ThreadingHTTPServer bound to 127.0.0.1:<port> over a FakeKube."""
 
@@ -353,12 +375,7 @@ class FakeApiServer:
             (_Handler,),
             {"store": self.store, "required_token": required_token},
         )
-        # a 32-node pool opening watch streams at once overflows the
-        # default listen(5) backlog -> connection resets
-        server_cls = type(
-            "ApiHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 256}
-        )
-        self.httpd = server_cls(("127.0.0.1", port), handler)
+        self.httpd = _ApiHTTPServer(("127.0.0.1", port), handler)
         self.tls = bool(tls_cert)
         if tls_cert:
             # serve real HTTPS (the native agent's direct-TLS path is

@@ -574,10 +574,22 @@ class HttpKubeClient(KubeClient):
         both see the waits, not whoever registered last."""
         self._throttle_observers.append(fn)
 
+    def set_qps(self, qps: float, burst: Optional[int] = None) -> None:
+        """Retune client-side flow control at runtime (simlab's
+        throttle-squeeze fault; ops tooling reacting to API-server
+        pressure). ``qps <= 0`` removes the limiter. In-flight waiters
+        finish against the bucket they started on — only new requests
+        see the new rate."""
+        if qps and qps > 0:
+            self._bucket = _TokenBucket(qps, burst or int(2 * qps))
+        else:
+            self._bucket = None
+
     def _acquire_token(self) -> None:
-        if self._bucket is None:
+        bucket = self._bucket  # one read: set_qps may swap it mid-call
+        if bucket is None:
             return
-        waited = self._bucket.acquire()
+        waited = bucket.acquire()
         if waited > 0:
             self.throttle_waits += 1
             self.throttle_wait_s_total += waited

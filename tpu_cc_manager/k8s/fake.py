@@ -59,6 +59,9 @@ class FakeKube(KubeClient):
         # fault injection
         self.pdb_blocked: set = set()  # {(ns, name)} -> evict raises 429
         self.fail_next_watches = 0  # next N watch_nodes calls raise 500
+        #: next N node LISTs answer 429 (API-server overload storm, the
+        #: priority-and-fairness rejection clients must retry through)
+        self.fail_next_lists = 0
         self.patch_delay_s = 0.0  # simulated API latency
         #: when set, idle watches emit BOOKMARK events at this cadence
         #: (like a real API server with allowWatchBookmarks), letting
@@ -124,6 +127,9 @@ class FakeKube(KubeClient):
 
     def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
         with self._lock:
+            if self.fail_next_lists > 0:
+                self.fail_next_lists -= 1
+                raise ApiException(429, "injected list overload")
             return [
                 copy.deepcopy(n)
                 for n in self._nodes.values()

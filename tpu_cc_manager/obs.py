@@ -141,6 +141,24 @@ class Histogram:
     def count(self) -> int:
         return self._total
 
+    def snapshot(self) -> dict:
+        """Cumulative buckets + sum/count as plain data — for JSON
+        artifacts (simlab's throttle/lag deltas) where scraping the
+        text exposition back out of render() would be silly."""
+        with self._lock:
+            cum = 0
+            buckets = {}
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                buckets[_fmt(b)] = cum
+            cum += self._counts[-1]
+            buckets["+Inf"] = cum
+            return {
+                "buckets": buckets,
+                "sum": round(self._sum, 6),
+                "count": self._total,
+            }
+
     def render_series(self, name: str, label_prefix: str = "") -> List[str]:
         """Exposition series lines only (no HELP/TYPE). ``label_prefix`` is
         a ``key="value",``-style fragment prepended inside every brace set
@@ -178,6 +196,22 @@ def kube_throttle_wait_histogram() -> Histogram:
         "Client-side flow-control wait per API request (QPS token "
         "bucket; zero = no throttling)",
         buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+    )
+
+
+def watch_pump_lag_histogram() -> Histogram:
+    """The one definition of ``tpu_cc_watch_pump_lag_seconds`` — the
+    delay between a desired-label write landing on the API server and
+    a watch pump delivering it to the consumer's mailbox. simlab's
+    fleet-scale artifact reports this distribution; any future live
+    pump exposing it on /metrics must build the histogram here so the
+    name/buckets stay identical by construction (the
+    kube_throttle_wait_histogram rule)."""
+    return Histogram(
+        "tpu_cc_watch_pump_lag_seconds",
+        "Watch-pump delivery lag: desired-label commit to mailbox "
+        "delivery (one shared stream fanning out to N consumers)",
+        buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15),
     )
 
 

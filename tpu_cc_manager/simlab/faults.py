@@ -1,0 +1,159 @@
+"""Scripted fault injection for simlab scenarios.
+
+Each injector method executes one fault action from the timeline and
+returns a log entry for the artifact. Faults act on the same surfaces
+production faults would: the FakeKube store's injection knobs
+(watch/list failures — the wire clients observe them as real HTTP
+errors), the shared data-plane client's token bucket (throttle
+squeeze), replica liveness (crash/restart), and the coordination Lease
+(leader flap — stolen exactly as a rogue writer would steal it, via a
+CAS replace)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException, ConflictError
+
+log = logging.getLogger("tpu-cc-manager.simlab.faults")
+
+
+class FaultInjector:
+    def __init__(
+        self,
+        *,
+        store,
+        replicas: Dict[str, object],
+        pool,
+        data_kube,
+        ops_kube,
+        base_qps: float,
+        lease_names: List[str],
+        lease_namespace: str = "tpu-system",
+    ):
+        self.store = store
+        self.replicas = replicas
+        self.pool = pool
+        self.data_kube = data_kube
+        self.ops_kube = ops_kube
+        self.base_qps = base_qps
+        self.lease_names = lease_names
+        self.lease_namespace = lease_namespace
+        self._timers: List[threading.Timer] = []
+        self.crashed_total = 0
+        self.restarted_total = 0
+
+    # ------------------------------------------------------------ dispatch
+    def inject(self, fault: str, params: dict, rel_t: float) -> dict:
+        entry = {"at_s": round(rel_t, 3), "fault": fault}
+        entry.update(getattr(self, f"_{fault}")(params))
+        log.info("fault injected: %s", entry)
+        return entry
+
+    def _timer(self, delay_s: float, fn) -> None:
+        t = threading.Timer(delay_s, fn)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    # -------------------------------------------------------------- kinds
+    def _agent_crash(self, params: dict) -> dict:
+        count = min(int(params["count"]), len(self.replicas))
+        restart_after_s = float(params.get("restart_after_s", 1.0))
+        victims = [
+            name for name, r in sorted(self.replicas.items())
+            if r.alive
+        ][:count]
+        for name in victims:
+            self.replicas[name].crash()
+        self.crashed_total += len(victims)
+
+        def restart():
+            for name in victims:
+                replica = self.replicas[name]
+                replica.restart()
+                self.restarted_total += 1
+                # the restarted agent's prime read: desired comes from
+                # the cluster, not from anything the dead process held
+                try:
+                    node = self.ops_kube.get_node(name)
+                    desired = (node["metadata"].get("labels") or {}).get(
+                        L.CC_MODE_LABEL
+                    )
+                except ApiException:
+                    desired = None
+                if desired is not None:
+                    self.pool.submit(name, desired)
+                else:
+                    self.pool.requeue(name)  # drain anything it missed
+
+        self._timer(restart_after_s, restart)
+        return {"crashed": len(victims),
+                "restart_after_s": restart_after_s}
+
+    def _watch_drop(self, params: dict) -> dict:
+        count = int(params["count"])
+        with self.store._lock:
+            self.store.fail_next_watches += count
+        return {"count": count}
+
+    def _watch_410(self, params: dict) -> dict:
+        self.store.compact_watch_history()
+        return {}
+
+    def _list_429(self, params: dict) -> dict:
+        count = int(params["count"])
+        with self.store._lock:
+            self.store.fail_next_lists += count
+        return {"count": count}
+
+    def _throttle_squeeze(self, params: dict) -> dict:
+        qps = float(params["qps"])
+        duration_s = float(params["duration_s"])
+        self.data_kube.set_qps(qps)
+        self._timer(
+            duration_s, lambda: self.data_kube.set_qps(self.base_qps)
+        )
+        return {"qps": qps, "duration_s": duration_s}
+
+    def _leader_flap(self, params: dict) -> dict:
+        """Steal every election Lease for one term: the holder demotes
+        at its next renew, the thief never renews, and a live replica
+        re-acquires after staleness — adoption of any in-flight rollout
+        record included."""
+        from tpu_cc_manager.leader import _now_rfc3339
+
+        stolen = []
+        for name in self.lease_names:
+            for _ in range(5):  # CAS retry against a racing renew
+                try:
+                    lease = self.ops_kube.get_lease(
+                        self.lease_namespace, name
+                    )
+                except ApiException:
+                    break  # no lease yet: nothing to steal
+                spec = lease.setdefault("spec", {})
+                spec["holderIdentity"] = "simlab-flap"
+                spec["renewTime"] = _now_rfc3339()
+                try:
+                    self.ops_kube.replace_lease(
+                        self.lease_namespace, name, lease
+                    )
+                    stolen.append(name)
+                    break
+                except (ConflictError, ApiException):
+                    time.sleep(0.02)
+        return {"leases_stolen": stolen}
+
+    # ----------------------------------------------------------- teardown
+    def cancel(self) -> None:
+        """Cancel undelivered timers (teardown; restart timers have
+        either fired inside the convergence wait or the run already
+        failed)."""
+        for t in self._timers:
+            t.cancel()
+        self._timers.clear()

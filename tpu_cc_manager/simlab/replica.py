@@ -1,0 +1,251 @@
+"""Replica shell + worker pool — live agents without thread-per-node.
+
+Each :class:`ReplicaShell` is one simulated node's agent: a real
+:class:`~tpu_cc_manager.engine.ModeEngine` over its own fake device
+backend, publishing the observed-state label (and optionally evidence)
+through a SHARED flow-controlled HTTP client. What it deliberately does
+NOT own is threads: desired-mode changes land in a last-value mailbox
+(fed by the shared watch pump) and a bounded :class:`WorkerPool`
+executes the reconciles — the coalescing contract is the agent's
+(``SyncableModeConfig`` semantics: N rapid flips collapse to the newest
+value), the execution model is what lets 256 replicas fit a 1-core
+sandbox.
+
+Failure semantics mirror the real agent (agent.py reconcile): invalid
+modes reject cleanly with a ``failed`` state label; retryable failures
+re-enter the queue after a short delay (the self-repair analog) so a
+replica that lost a state-label write to a 429 storm still converges.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Dict, List, Optional
+
+from tpu_cc_manager.drain import set_cc_mode_state_label
+from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
+from tpu_cc_manager.modes import InvalidModeError
+
+log = logging.getLogger("tpu-cc-manager.simlab.replica")
+
+#: mailbox sentinel: "no pending desired value"
+_EMPTY = object()
+
+#: worker-queue sentinel telling a worker thread to exit
+_STOP = object()
+
+
+class ReplicaShell:
+    """One node's reconciling agent, mailbox-driven."""
+
+    #: retryable-failure requeue delay and lifetime retry budget (the
+    #: agent's REPAIR_INTERVAL_S analog, scaled to scenario time; the
+    #: convergence timeout is the real backstop)
+    REPAIR_DELAY_S = 0.5
+    MAX_REPAIRS = 50
+
+    def __init__(
+        self,
+        node_name: str,
+        kube,
+        backend,
+        tracer,
+        *,
+        evidence: bool = False,
+    ):
+        self.node_name = node_name
+        self.kube = kube
+        self.backend = backend
+        self.evidence = evidence
+        self.engine = ModeEngine(
+            set_state_label=lambda v: set_cc_mode_state_label(
+                kube, node_name, v
+            ),
+            drainer=NullDrainer(),
+            evict_components=False,
+            backend=backend,
+            tracer=tracer,
+        )
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._pending = _EMPTY
+        self._queued = False
+        self.alive = True
+        self.applied: Optional[str] = None
+        # counters (read single-threaded at report time)
+        self.reconciles = 0
+        self.outcomes: Dict[str, int] = {}
+        self.repairs = 0
+        self.coalesced = 0
+        self._resubmit: Optional[Callable[[str, str], None]] = None
+        self._timers: List[threading.Timer] = []
+
+    # ------------------------------------------------------------ mailbox
+    def offer(self, value: str) -> bool:
+        """Last-value-wins mailbox write. Returns True when the caller
+        should enqueue this replica on the worker queue (not already
+        queued, and alive — a crashed replica keeps the pending value
+        for its restart to pick up)."""
+        with self._lock:
+            if self._pending is not _EMPTY and self._pending != value:
+                self.coalesced += 1  # overwritten unread value
+            self._pending = value
+            if self._queued or not self.alive:
+                return False
+            self._queued = True
+            return True
+
+    def run_pending(self) -> None:
+        """Worker entry point: drain the mailbox, reconciling the newest
+        desired value each pass, until nothing is pending."""
+        while True:
+            with self._lock:
+                if self._pending is _EMPTY or not self.alive:
+                    self._queued = False
+                    return
+                value = self._pending
+                self._pending = _EMPTY
+            self._reconcile(value)
+
+    # ---------------------------------------------------------- reconcile
+    def _reconcile(self, mode: str) -> None:
+        outcome = "error"
+        ok = False
+        with self._tracer.span("reconcile", mode=mode) as root:
+            try:
+                ok = self.engine.set_mode(mode)
+                outcome = "success" if ok else "failure"
+            except InvalidModeError as e:
+                log.error("%s: rejecting desired mode: %s",
+                          self.node_name, e)
+                self._publish_failed()
+                outcome = "invalid"
+            except FatalModeError as e:
+                # the DaemonSet-restart analog: this replica is down
+                # until a scripted restart brings it back
+                log.error("%s: fatal: %s", self.node_name, e)
+                with self._lock:
+                    self.alive = False
+                outcome = "fatal"
+            except Exception:
+                log.exception("%s: reconcile crashed", self.node_name)
+                self._publish_failed()
+            root.attrs["outcome"] = outcome
+        self.reconciles += 1
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        if ok:
+            self.applied = mode
+            if self.evidence:
+                from tpu_cc_manager.evidence import publish_evidence
+
+                publish_evidence(self.kube, self.node_name,
+                                 backend=self.backend)
+        elif outcome in ("failure", "error"):
+            self._arm_repair(mode)
+
+    def _publish_failed(self) -> None:
+        try:
+            set_cc_mode_state_label(self.kube, self.node_name, "failed")
+        except Exception:
+            log.warning("%s: could not publish failed state",
+                        self.node_name)
+
+    def _arm_repair(self, mode: str) -> None:
+        """Requeue a retryable failure after a short delay, like the
+        agent's idle-tick self-repair — a label event will never come
+        to retry it (the desired label is already correct)."""
+        if self._resubmit is None or self.repairs >= self.MAX_REPAIRS:
+            return
+        self.repairs += 1
+
+        def fire():
+            with self._lock:
+                if not self.alive or self._pending is not _EMPTY:
+                    return  # newer work already queued
+            self._resubmit(self.node_name, mode)
+
+        t = threading.Timer(self.REPAIR_DELAY_S, fire)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    # ------------------------------------------------------------- faults
+    def crash(self) -> None:
+        with self._lock:
+            self.alive = False
+
+    def restart(self) -> None:
+        """Back alive; the caller re-reads the node's desired label and
+        resubmits (a restarted agent's prime-read analog)."""
+        with self._lock:
+            self.alive = True
+
+    def close(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        self._timers.clear()
+
+
+class WorkerPool:
+    """Bounded reconcile executor: N daemon workers over one queue of
+    replica names. ``submit`` is the only producer API — it routes
+    through the replica mailbox so concurrent producers (pump, fault
+    restarts, repair timers) keep last-value-wins semantics."""
+
+    def __init__(self, replicas: Dict[str, ReplicaShell], n_workers: int):
+        self.replicas = replicas
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self.n_workers = n_workers
+        for r in replicas.values():
+            r._resubmit = self.submit
+
+    def start(self) -> "WorkerPool":
+        for i in range(self.n_workers):
+            t = threading.Thread(
+                target=self._worker, name=f"simlab-worker-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def submit(self, name: str, value: str) -> None:
+        replica = self.replicas.get(name)
+        if replica is None:
+            return
+        if replica.offer(value):
+            self._q.put(name)
+
+    def requeue(self, name: str) -> None:
+        """Enqueue a replica whose mailbox already holds a pending value
+        (restart after crash)."""
+        replica = self.replicas.get(name)
+        if replica is None:
+            return
+        with replica._lock:
+            if (replica._pending is _EMPTY or replica._queued
+                    or not replica.alive):
+                return
+            replica._queued = True
+        self._q.put(name)
+
+    def _worker(self) -> None:
+        while True:
+            name = self._q.get()
+            if name is _STOP:
+                return
+            try:
+                self.replicas[name].run_pending()
+            except Exception:
+                log.exception("worker failed on %s", name)
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=5)
+        for r in self.replicas.values():
+            r.close()

@@ -1,0 +1,102 @@
+"""Scenario artifact: the JSON document one simlab run leaves behind.
+
+The artifact is the scenario's evidence — the convergence number the
+bench trend gate compares (``pool<N>_convergence_s``), the watch-pump
+lag distribution, the throttle-wait histogram delta, and the per-phase
+p50 attribution — stamped with enough context (scenario name, limits,
+fault log) that a regression reader can re-run the exact load."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+ARTIFACT_VERSION = 1
+
+
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    if not samples:
+        return None
+    s = sorted(samples)
+    return round(s[min(len(s) - 1, max(0, int(q * len(s))))], 5)
+
+
+def phase_percentiles(durations: Dict[str, List[float]],
+                      q: float) -> Dict[str, float]:
+    out = {}
+    for name, durs in sorted(durations.items()):
+        p = percentile(durs, q)
+        if p is not None:
+            out[name] = p
+    return out
+
+
+def convergence_key(nodes: int) -> str:
+    """The trend-gated metric name: ``pool256_convergence_s`` for a
+    256-node scenario (scripts/bench_trend.py compares it)."""
+    return f"pool{nodes}_convergence_s"
+
+
+def build_artifact(
+    scenario,
+    *,
+    ok: bool,
+    initial_convergence_s: Optional[float],
+    convergence_s: Optional[float],
+    pending: List[str],
+    pump_stats: dict,
+    throttle: dict,
+    phase_durations: Dict[str, List[float]],
+    replica_stats: dict,
+    faults: List[dict],
+    controllers: dict,
+    notes: Optional[str] = None,
+) -> dict:
+    metrics = {
+        convergence_key(scenario.nodes): (
+            round(convergence_s, 4) if convergence_s is not None else None
+        ),
+        "initial_convergence_s": (
+            round(initial_convergence_s, 4)
+            if initial_convergence_s is not None else None
+        ),
+        "watch_pump": pump_stats,
+        "throttle": throttle,
+        "phase_p50_s": phase_percentiles(phase_durations, 0.50),
+        "phase_p95_s": phase_percentiles(phase_durations, 0.95),
+        "reconciles": replica_stats,
+    }
+    artifact = {
+        "artifact_version": ARTIFACT_VERSION,
+        "scenario": scenario.name,
+        "nodes": scenario.nodes,
+        "ok": ok,
+        "metrics": metrics,
+        "faults": faults,
+        "controllers": controllers,
+        "limits": {
+            "workers": scenario.workers,
+            "qps": scenario.qps,
+            "pools": scenario.pools,
+            "chips_per_node": scenario.chips_per_node,
+            "evidence": scenario.evidence,
+        },
+    }
+    if pending:
+        # name the stragglers: a failed run's artifact must be a lead,
+        # not just a false
+        artifact["pending_nodes"] = sorted(pending)[:16]
+        artifact["pending_count"] = len(pending)
+    if notes:
+        artifact["notes"] = notes
+    return artifact
+
+
+def write_artifact(path: str, artifact: dict) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")

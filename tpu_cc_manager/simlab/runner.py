@@ -1,0 +1,455 @@
+"""SimLab — orchestrates one scenario run end to end.
+
+Assembly (all in one process, all over the real HTTP wire):
+
+- a :class:`~tpu_cc_manager.k8s.apiserver.FakeApiServer` holding the
+  scenario's node fleet;
+- N :class:`~tpu_cc_manager.simlab.replica.ReplicaShell` live agents
+  sharing one flow-controlled data-plane client, executed by a bounded
+  :class:`~tpu_cc_manager.simlab.replica.WorkerPool`;
+- ONE :class:`~tpu_cc_manager.simlab.pump.WatchPump` feeding every
+  replica's mailbox from a single fleet-wide watch stream;
+- optional fleet/policy controllers (with a leader-elected policy pair
+  when the scenario says so), so policy-driven rollouts and fleet
+  audits run concurrently with the agent churn;
+- a :class:`~tpu_cc_manager.simlab.faults.FaultInjector` executing the
+  scenario's scripted faults on schedule.
+
+The run is judged by convergence: every node's observed-state label
+reaching ``converge.mode`` within ``converge.timeout_s``, measured from
+the first action that initiates the change. The artifact
+(:mod:`~tpu_cc_manager.simlab.report`) carries the number the bench
+trend gate compares plus the full diagnostic surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.device.fake import fake_backend
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.obs import (
+    kube_throttle_wait_histogram, watch_pump_lag_histogram,
+)
+from tpu_cc_manager.simlab.faults import FaultInjector
+from tpu_cc_manager.simlab.pump import LagStamps, WatchPump
+from tpu_cc_manager.simlab.replica import (
+    _EMPTY as _REPLICA_EMPTY, ReplicaShell, WorkerPool,
+)
+from tpu_cc_manager.simlab.report import build_artifact
+from tpu_cc_manager.simlab.scenario import Scenario
+from tpu_cc_manager.trace import Tracer
+
+log = logging.getLogger("tpu-cc-manager.simlab")
+
+#: the policy controllers' election Lease (must match __main__'s)
+POLICY_LEASE = "tpu-cc-policy-controller"
+
+#: pool-membership label on simlab nodes (scenario actions scope by it)
+POOL_LABEL = "simlab.pool"
+
+
+def _env_int(name: str, default: int) -> int:
+    """Positive-int env override; unset, unparseable, or <= 0 (the
+    documented '0 = scenario's value') falls back to the default."""
+    try:
+        value = int(os.environ.get(name, "") or 0)
+    except ValueError:
+        return default
+    return value if value > 0 else default
+
+
+class SimLab:
+    def __init__(self, scenario: Scenario):
+        self.scenario = scenario
+        # TPU_CC_SIMLAB_WORKERS overrides the scenario's worker count
+        # (config.py table) — the sandbox knob for "this host has more
+        # cores than the scenario assumed"
+        self.workers = _env_int("TPU_CC_SIMLAB_WORKERS",
+                                scenario.workers)
+        self.server: Optional[FakeApiServer] = None
+        self.node_names: List[str] = []
+        self.replicas: Dict[str, ReplicaShell] = {}
+        self.pool: Optional[WorkerPool] = None
+        self.pump: Optional[WatchPump] = None
+        self.stamps = LagStamps()
+        self.injector: Optional[FaultInjector] = None
+        self._controller_threads: List[threading.Thread] = []
+        self._controllers: List[object] = []
+        self._phase_durations: Dict[str, List[float]] = {}
+        self._phase_lock = threading.Lock()
+        self.tracer = Tracer()
+        self.tracer.add_sink(self._phase_sink)
+        self.lag_hist = watch_pump_lag_histogram()
+        self.throttle_hist = kube_throttle_wait_histogram()
+        self._throttle_samples: List[float] = []
+        self._throttle_lock = threading.Lock()
+
+    # ------------------------------------------------------------ plumbing
+    def _phase_sink(self, span) -> None:
+        with self._phase_lock:
+            self._phase_durations.setdefault(span.name, []).append(
+                span.dur_s
+            )
+
+    def _observe_throttle(self, waited: float) -> None:
+        self.throttle_hist.observe(waited)
+        if waited > 0:
+            with self._throttle_lock:
+                self._throttle_samples.append(waited)
+
+    def _client(self, qps: float = 0.0) -> HttpKubeClient:
+        return HttpKubeClient(
+            KubeConfig("127.0.0.1", self.server.port, use_tls=False),
+            qps=qps,
+        )
+
+    def _pool_of(self, i: int) -> str:
+        return f"p{i % self.scenario.pools}"
+
+    # -------------------------------------------------------------- setup
+    def _build_fleet(self) -> None:
+        sc = self.scenario
+        store = self.server.store
+        self.node_names = [f"sim-{i:04d}" for i in range(sc.nodes)]
+        for i, name in enumerate(self.node_names):
+            store.add_node(make_node(name, labels={
+                L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+                POOL_LABEL: self._pool_of(i),
+                L.CC_MODE_LABEL: sc.initial_mode,
+            }))
+        for name in self.node_names:
+            self.replicas[name] = ReplicaShell(
+                name, self.data_kube,
+                fake_backend(n_chips=sc.chips_per_node),
+                self.tracer, evidence=sc.evidence,
+            )
+
+    def _start_controllers(self) -> None:
+        sc = self.scenario
+        if sc.controllers.fleet:
+            from tpu_cc_manager.fleet import FleetController
+
+            fleet = FleetController(
+                self._client(qps=sc.qps), interval_s=5.0, port=0,
+            )
+            self._controllers.append(fleet)
+            t = threading.Thread(target=fleet.run, daemon=True,
+                                 name="simlab-fleet")
+            t.start()
+            self._controller_threads.append(t)
+        if sc.controllers.policy:
+            from tpu_cc_manager.policy import PolicyController
+
+            n = 2 if sc.controllers.leader_elect else 1
+            for i in range(n):
+                elector = None
+                kube = self._client(qps=sc.qps)
+                if sc.controllers.leader_elect:
+                    from tpu_cc_manager.leader import LeaderElector
+
+                    # short terms so a flapped lease re-resolves inside
+                    # scenario time; elector traffic rides an unlimited
+                    # client like __main__._leader_elector does
+                    elector = LeaderElector(
+                        self._client(qps=0),
+                        name=POLICY_LEASE,
+                        identity=f"simlab-policy-{i}",
+                        namespace="tpu-system",
+                        lease_duration_s=2.0,
+                        renew_period_s=0.5,
+                        retry_period_s=0.25,
+                    )
+                ctrl = PolicyController(
+                    kube, interval_s=1.0, port=0, poll_s=0.05,
+                    verify_evidence=sc.evidence,
+                    leader_elector=elector,
+                    adopt_after_s=2.0,
+                )
+                self._controllers.append(ctrl)
+                t = threading.Thread(target=ctrl.run, daemon=True,
+                                     name=f"simlab-policy-{i}")
+                t.start()
+                self._controller_threads.append(t)
+
+    # ------------------------------------------------------------- actions
+    def _nodes_in_pool(self, pool: Optional[int]) -> List[str]:
+        if pool is None:
+            return self.node_names
+        tag = f"p{pool}"
+        return [
+            name for i, name in enumerate(self.node_names)
+            if self._pool_of(i) == tag
+        ]
+
+    def _act_set_mode(self, params: dict) -> dict:
+        mode = params["mode"]
+        names = self._nodes_in_pool(params.get("pool"))
+        for name in names:
+            self.stamps.record(name, mode, time.monotonic())
+            self.ops_kube.set_node_labels(name, {L.CC_MODE_LABEL: mode})
+        return {"mode": mode, "nodes": len(names)}
+
+    def _act_create_policy(self, params: dict) -> dict:
+        pool = params.get("pool")
+        selector = (f"{POOL_LABEL}=p{pool}" if pool is not None
+                    else L.TPU_ACCELERATOR_LABEL)
+        names = self._nodes_in_pool(pool)
+        max_unavailable = params.get("max_unavailable", len(names))
+        name = f"simlab-{self.scenario.name}-{pool if pool is not None else 'all'}"
+        self.server.store.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+            "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
+            "kind": L.POLICY_KIND,
+            "metadata": {"name": name},
+            "spec": {
+                "mode": params["mode"],
+                "nodeSelector": selector,
+                "strategy": {
+                    "maxUnavailable": max_unavailable,
+                    "groupTimeoutSeconds": params.get(
+                        "group_timeout_s", 120),
+                },
+            },
+        })
+        return {"policy": name, "mode": params["mode"],
+                "selector": selector}
+
+    # --------------------------------------------------------- convergence
+    def _wait_converged(self, target: str, timeout_s: float):
+        """(elapsed_s or None, pending names). Polls the store directly
+        — measurement must not add HTTP load to the system under
+        test."""
+        store = self.server.store
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        pending = set(self.node_names)
+        while pending and time.monotonic() < deadline:
+            pending = {
+                n for n in pending
+                if store.get_node(n)["metadata"]["labels"].get(
+                    L.CC_MODE_STATE_LABEL) != target
+            }
+            if pending:
+                time.sleep(0.05)
+        if pending:
+            return None, sorted(pending)
+        return time.monotonic() - t0, []
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> dict:
+        sc = self.scenario
+        # the CLI path has no conftest: keep platform identity and
+        # attestation probes out of a lab run unless explicitly set
+        os.environ.setdefault("TPU_CC_IDENTITY", "none")
+        os.environ.setdefault("TPU_CC_ATTESTATION", "none")
+        log.info("simlab: scenario %r — %d nodes / %d pools / "
+                 "%d workers / qps=%s", sc.name, sc.nodes, sc.pools,
+                 self.workers, sc.qps or "off")
+        self.server = FakeApiServer().start()
+        notes = None
+        faults: List[dict] = []
+        try:
+            self.data_kube = self._client(qps=sc.qps)
+            self.data_kube.add_throttle_observer(self._observe_throttle)
+            self.ops_kube = self._client(qps=0)
+            self._build_fleet()
+            self.pool = WorkerPool(self.replicas, self.workers).start()
+            self.pump = WatchPump(
+                self._client(qps=0), self.replicas, self.pool,
+                self.stamps, self.lag_hist,
+                watch_timeout_s=sc.watch_timeout_s,
+            )
+            self.pump.prime()
+            self.pump.start()
+            self.injector = FaultInjector(
+                store=self.server.store,
+                replicas=self.replicas,
+                pool=self.pool,
+                data_kube=self.data_kube,
+                ops_kube=self.ops_kube,
+                base_qps=sc.qps,
+                lease_names=(
+                    [POLICY_LEASE] if sc.controllers.leader_elect else []
+                ),
+            )
+
+            # initial reconcile: one deliberate storm to initial_mode,
+            # outside the measurement (the bench's wait_all("off") analog)
+            for name in self.node_names:
+                self.pool.submit(name, sc.initial_mode)
+            initial_s, pending = self._wait_converged(
+                sc.initial_mode, min(60.0, sc.converge.timeout_s)
+            )
+            if initial_s is None:
+                notes = (f"{len(pending)} replicas never initialized "
+                         f"to {sc.initial_mode!r}")
+                return self._finish(False, None, None, pending, faults,
+                                    notes)
+            self._start_controllers()
+
+            # ---- the timeline (actions are pre-sorted by `at`)
+            t0 = time.monotonic()
+            t_change: Optional[float] = None
+            for action in sc.actions:
+                delay = t0 + action.at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                rel_t = time.monotonic() - t0
+                if action.kind == "fault":
+                    params = dict(action.params)
+                    kind = params.pop("fault")
+                    faults.append(
+                        self.injector.inject(kind, params, rel_t)
+                    )
+                    continue
+                if action.kind == "set_mode":
+                    entry = self._act_set_mode(action.params)
+                else:
+                    entry = self._act_create_policy(action.params)
+                entry.update({"at_s": round(rel_t, 3),
+                              "action": action.kind})
+                faults.append(entry)
+                if (t_change is None
+                        and action.params["mode"] == sc.converge.mode):
+                    t_change = time.monotonic()
+
+            conv_s, pending = self._wait_converged(
+                sc.converge.mode, sc.converge.timeout_s
+            )
+            if conv_s is not None and t_change is not None:
+                # convergence is change-initiation -> last node, not
+                # wait-start -> last node (actions after the initiating
+                # one consumed timeline seconds the fleet was already
+                # converging through)
+                conv_s = time.monotonic() - t_change
+            ok = conv_s is not None
+            if ok:
+                # AFTER the measurement: settle time (straggler drain +
+                # the final fleet scan) must not inflate the trend-gated
+                # convergence number
+                self._settle()
+            if not ok:
+                notes = (f"{len(pending)} nodes never reached "
+                         f"{sc.converge.mode!r} within "
+                         f"{sc.converge.timeout_s}s")
+            return self._finish(ok, initial_s, conv_s, pending, faults,
+                                notes)
+        finally:
+            self._teardown()
+
+    def _settle(self) -> None:
+        """After convergence: drain straggler work (the state label
+        lands before that reconcile's evidence write), then run one
+        final fleet scan so the artifact's audit reflects the settled
+        fleet — mid-churn skew (evidence a throttled write behind its
+        label) is the scan racing the storm, not an end-state
+        finding."""
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            busy = any(
+                r._queued or r._pending is not _REPLICA_EMPTY
+                for r in self.replicas.values()
+            )
+            if not busy:
+                break
+            time.sleep(0.05)
+        for c in self._controllers:
+            from tpu_cc_manager.fleet import FleetController
+
+            if isinstance(c, FleetController):
+                try:
+                    c.scan_once()
+                except Exception:
+                    log.warning("final fleet scan failed",
+                                exc_info=True)
+
+    def _finish(self, ok, initial_s, conv_s, pending, faults, notes):
+        replica_stats = {"total": 0, "repairs": 0, "coalesced": 0}
+        for r in self.replicas.values():
+            replica_stats["total"] += r.reconciles
+            replica_stats["repairs"] += r.repairs
+            replica_stats["coalesced"] += r.coalesced
+            for outcome, n in r.outcomes.items():
+                replica_stats[outcome] = (
+                    replica_stats.get(outcome, 0) + n
+                )
+        from tpu_cc_manager.simlab.report import percentile
+
+        with self._throttle_lock:
+            waits = list(self._throttle_samples)
+        throttle = {
+            "waits": self.data_kube.throttle_waits,
+            "wait_s_total": round(
+                self.data_kube.throttle_wait_s_total, 4),
+            "wait_p50_s": percentile(waits, 0.50),
+            "wait_max_s": round(max(waits), 5) if waits else None,
+            "histogram": self.throttle_hist.snapshot(),
+        }
+        controllers = {"running": len(self._controllers)}
+        for c in self._controllers:
+            report = getattr(c, "last_report", None) or {}
+            # the policy controller's report keys policies by name; the
+            # fleet controller's carries a list of policy summaries
+            policies = report.get("policies")
+            if isinstance(policies, dict):
+                phases = {
+                    name: (st or {}).get("phase")
+                    for name, st in policies.items()
+                }
+                if phases:
+                    controllers.setdefault("policy_phases", {}).update(
+                        phases)
+            if "problems" in report:
+                # headline-capped: a fleet-wide finding enumerates every
+                # node and would dwarf the artifact
+                controllers["fleet_problems"] = [
+                    p if len(p) <= 160 else p[:160] + "..."
+                    for p in report["problems"][:5]
+                ]
+                controllers["fleet_problem_count"] = len(
+                    report["problems"])
+        if self.injector is not None:
+            replica_stats["crashed"] = self.injector.crashed_total
+            replica_stats["restarted"] = self.injector.restarted_total
+        with self._phase_lock:
+            phase_durations = {
+                k: list(v) for k, v in self._phase_durations.items()
+            }
+        return build_artifact(
+            self.scenario,
+            ok=ok,
+            initial_convergence_s=initial_s,
+            convergence_s=conv_s,
+            pending=pending,
+            pump_stats=(self.pump.stats() if self.pump else {}),
+            throttle=throttle,
+            phase_durations=phase_durations,
+            replica_stats=replica_stats,
+            faults=faults,
+            controllers=controllers,
+            notes=notes,
+        )
+
+    def _teardown(self) -> None:
+        if self.injector is not None:
+            self.injector.cancel()
+        for c in self._controllers:
+            try:
+                c.stop()
+            except Exception:
+                log.warning("controller stop failed", exc_info=True)
+        for t in self._controller_threads:
+            t.join(timeout=5)
+        if self.pump is not None:
+            self.pump.stop()
+        if self.pool is not None:
+            self.pool.stop()
+        if self.server is not None:
+            self.server.stop()

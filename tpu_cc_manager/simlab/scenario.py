@@ -1,0 +1,324 @@
+"""Scenario schema + strict validation for the simlab fleet lab.
+
+A scenario is one JSON document describing a fleet (node count, pools,
+chips per node), the lab's execution limits (worker slots, client-side
+QPS), an action timeline (mode storms, policy creation, scripted
+faults), and the convergence expectation the run is judged against.
+
+Validation is STRICT — unknown keys anywhere in the document are
+rejected. That strictness is what lets tests/test_simlab.py freshness-
+gate the committed ``scenarios/*.json`` examples the same way
+test_manifests.py gates the kustomize tree: a schema change that
+orphans an example fails CI instead of rotting silently. The committed
+files must also match :func:`canonical_scenario_text` byte for byte
+(``python -m tpu_cc_manager simlab validate`` checks parse/semantics;
+the test checks formatting freshness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from tpu_cc_manager.modes import InvalidModeError, parse_mode
+
+#: bumped on breaking schema changes; scenarios carry it explicitly so
+#: a future reader can refuse documents it does not understand
+SCENARIO_VERSION = 1
+
+#: fault kind -> {param: (required, type(s))}
+FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
+    # crash N replicas; they stop reconciling and restart (re-reading
+    # their node's desired label) after restart_after_s
+    "agent_crash": {"count": (True, int),
+                    "restart_after_s": (False, (int, float))},
+    # the next N watch (re)connects fail server-side (FakeKube
+    # fail_next_watches): the pump must absorb the storm and reconnect
+    "watch_drop": {"count": (True, int)},
+    # compact the watch history: the pump's next resume 410s and it
+    # must full-relist to resynchronize
+    "watch_410": {},
+    # the next N node LISTs answer 429 (apiserver overload storm):
+    # relists and controller scans must retry through it
+    "list_429": {"count": (True, int)},
+    # squeeze the shared data-plane client's token bucket to qps for
+    # duration_s, then restore the scenario's configured rate
+    "throttle_squeeze": {"qps": (True, (int, float)),
+                         "duration_s": (True, (int, float))},
+    # steal the policy controllers' election Lease for one lease term:
+    # the leader demotes mid-rollout and a replica must take over and
+    # adopt the unfinished record
+    "leader_flap": {},
+}
+
+#: action kind -> {param: (required, type(s))}; "fault" params are
+#: validated separately against FAULT_PARAMS
+ACTION_PARAMS: Dict[str, Dict[str, tuple]] = {
+    # patch the desired-mode label on every node (or one pool)
+    "set_mode": {"mode": (True, str), "pool": (False, int)},
+    # create a TPUCCPolicy covering every node (or one pool); requires
+    # controllers.policy
+    "create_policy": {"mode": (True, str), "pool": (False, int),
+                      "max_unavailable": (False, int),
+                      "group_timeout_s": (False, (int, float))},
+    "fault": {},  # validated per fault kind
+}
+
+
+class ScenarioError(ValueError):
+    """A scenario document failed validation."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    at: float
+    kind: str
+    params: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Controllers:
+    fleet: bool = False
+    policy: bool = False
+    leader_elect: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Converge:
+    mode: str
+    timeout_s: float = 120.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    nodes: int
+    converge: Converge
+    actions: List[Action]
+    pools: int = 1
+    chips_per_node: int = 1
+    initial_mode: str = "off"
+    workers: int = 8
+    qps: float = 0.0
+    evidence: bool = False
+    watch_timeout_s: float = 10.0
+    controllers: Controllers = Controllers()
+
+    def scaled_to(self, nodes: int) -> "Scenario":
+        """CLI --nodes override (fault counts are clamped at runtime)."""
+        if nodes < 1:
+            raise ScenarioError(f"nodes override must be >= 1, got {nodes}")
+        return dataclasses.replace(self, nodes=nodes)
+
+    def with_workers(self, workers: int) -> "Scenario":
+        if workers < 1:
+            raise ScenarioError(
+                f"workers override must be >= 1, got {workers}")
+        return dataclasses.replace(self, workers=workers)
+
+
+def _reject_unknown(doc: dict, allowed, where: str) -> None:
+    unknown = sorted(set(doc) - set(allowed))
+    if unknown:
+        raise ScenarioError(
+            f"{where}: unknown key(s) {unknown}; allowed: "
+            f"{sorted(allowed)}"
+        )
+
+
+def _mode(value, where: str) -> str:
+    if not isinstance(value, str):
+        raise ScenarioError(f"{where}: mode must be a string")
+    try:
+        parse_mode(value)
+    except InvalidModeError as e:
+        raise ScenarioError(f"{where}: {e}") from None
+    return value
+
+
+def _typed(doc: dict, spec: Dict[str, tuple], where: str) -> None:
+    for key, (required, types) in spec.items():
+        if key not in doc:
+            if required:
+                raise ScenarioError(f"{where}: missing required {key!r}")
+            continue
+        if isinstance(doc[key], bool) and types is not bool:
+            # bool is an int subclass; an accidental true where a count
+            # belongs must not validate
+            raise ScenarioError(f"{where}: {key!r} must be {types}")
+        if not isinstance(doc[key], types):
+            raise ScenarioError(f"{where}: {key!r} must be {types}, "
+                                f"got {type(doc[key]).__name__}")
+
+
+def _validate_action(raw: dict, idx: int, pools: int) -> Action:
+    where = f"actions[{idx}]"
+    if not isinstance(raw, dict):
+        raise ScenarioError(f"{where}: must be an object")
+    base_keys = {"at", "action"}
+    if "action" not in raw:
+        raise ScenarioError(f"{where}: missing required 'action'")
+    kind = raw["action"]
+    if kind not in ACTION_PARAMS:
+        raise ScenarioError(
+            f"{where}: unknown action {kind!r}; known: "
+            f"{sorted(ACTION_PARAMS)}"
+        )
+    at = raw.get("at", 0.0)
+    if isinstance(at, bool) or not isinstance(at, (int, float)) or at < 0:
+        raise ScenarioError(f"{where}: 'at' must be a number >= 0")
+    params = {k: v for k, v in raw.items() if k not in base_keys}
+    if kind == "fault":
+        fault = params.get("fault")
+        if fault not in FAULT_PARAMS:
+            raise ScenarioError(
+                f"{where}: unknown fault {fault!r}; known: "
+                f"{sorted(FAULT_PARAMS)}"
+            )
+        spec = FAULT_PARAMS[fault]
+        _reject_unknown({k: v for k, v in params.items() if k != "fault"},
+                        spec, f"{where} (fault {fault})")
+        _typed(params, spec, f"{where} (fault {fault})")
+        for key in ("count",):
+            if key in spec and params.get(key, 1) < 1:
+                raise ScenarioError(f"{where}: {key!r} must be >= 1")
+    else:
+        _reject_unknown(params, ACTION_PARAMS[kind], where)
+        _typed(params, ACTION_PARAMS[kind], where)
+        _mode(params["mode"], where)
+        pool = params.get("pool")
+        if pool is not None and not (0 <= pool < pools):
+            raise ScenarioError(
+                f"{where}: pool {pool} out of range [0, {pools})"
+            )
+    return Action(at=float(at), kind=kind, params=params)
+
+
+def validate_scenario(doc: dict) -> Scenario:
+    """Validate one parsed scenario document -> :class:`Scenario`.
+    Raises :class:`ScenarioError` with a precise message on the first
+    violation."""
+    if not isinstance(doc, dict):
+        raise ScenarioError("scenario must be a JSON object")
+    allowed = {
+        "version", "name", "nodes", "pools", "chips_per_node",
+        "initial_mode", "workers", "qps", "evidence",
+        "watch_timeout_s", "controllers", "actions", "converge",
+    }
+    _reject_unknown(doc, allowed, "scenario")
+    if doc.get("version") != SCENARIO_VERSION:
+        raise ScenarioError(
+            f"version must be {SCENARIO_VERSION}, got "
+            f"{doc.get('version')!r} (refusing a schema this reader "
+            "does not understand)"
+        )
+    _typed(doc, {
+        "name": (True, str),
+        "nodes": (True, int),
+        "pools": (False, int),
+        "chips_per_node": (False, int),
+        "initial_mode": (False, str),
+        "workers": (False, int),
+        "qps": (False, (int, float)),
+        "evidence": (False, bool),
+        "watch_timeout_s": (False, (int, float)),
+    }, "scenario")
+    nodes = doc["nodes"]
+    if not (1 <= nodes <= 4096):
+        raise ScenarioError(f"nodes must be in [1, 4096], got {nodes}")
+    pools = doc.get("pools", 1)
+    if not (1 <= pools <= nodes):
+        raise ScenarioError(
+            f"pools must be in [1, nodes={nodes}], got {pools}")
+    chips = doc.get("chips_per_node", 1)
+    if not (1 <= chips <= 8):
+        raise ScenarioError(
+            f"chips_per_node must be in [1, 8], got {chips}")
+    workers = doc.get("workers", 8)
+    if not (1 <= workers <= 64):
+        raise ScenarioError(f"workers must be in [1, 64], got {workers}")
+    qps = doc.get("qps", 0.0)
+    if qps < 0:
+        raise ScenarioError(f"qps must be >= 0 (0 = unthrottled), got {qps}")
+    watch_timeout_s = doc.get("watch_timeout_s", 10.0)
+    if watch_timeout_s <= 0:
+        raise ScenarioError("watch_timeout_s must be > 0")
+    initial_mode = _mode(doc.get("initial_mode", "off"), "initial_mode")
+
+    raw_ctl = doc.get("controllers", {})
+    if not isinstance(raw_ctl, dict):
+        raise ScenarioError("controllers must be an object")
+    _reject_unknown(raw_ctl, {"fleet", "policy", "leader_elect"},
+                    "controllers")
+    for key, value in raw_ctl.items():
+        if not isinstance(value, bool):
+            raise ScenarioError(f"controllers.{key} must be a bool")
+    controllers = Controllers(**raw_ctl)
+    if controllers.leader_elect and not controllers.policy:
+        raise ScenarioError(
+            "controllers.leader_elect requires controllers.policy "
+            "(the Lease being flapped belongs to the policy pair)"
+        )
+
+    raw_conv = doc.get("converge")
+    if not isinstance(raw_conv, dict):
+        raise ScenarioError("converge is required and must be an object")
+    _reject_unknown(raw_conv, {"mode", "timeout_s"}, "converge")
+    _typed(raw_conv, {"mode": (True, str),
+                      "timeout_s": (False, (int, float))}, "converge")
+    timeout_s = raw_conv.get("timeout_s", 120.0)
+    if timeout_s <= 0:
+        raise ScenarioError("converge.timeout_s must be > 0")
+    converge = Converge(mode=_mode(raw_conv["mode"], "converge"),
+                        timeout_s=float(timeout_s))
+
+    raw_actions = doc.get("actions")
+    if not isinstance(raw_actions, list) or not raw_actions:
+        raise ScenarioError("actions is required and must be a "
+                            "non-empty array")
+    actions = [
+        _validate_action(a, i, pools) for i, a in enumerate(raw_actions)
+    ]
+    for a in actions:
+        if a.kind == "create_policy" and not controllers.policy:
+            raise ScenarioError(
+                "create_policy action requires controllers.policy"
+            )
+        if (a.kind == "fault" and a.params["fault"] == "leader_flap"
+                and not controllers.leader_elect):
+            raise ScenarioError(
+                "leader_flap fault requires controllers.leader_elect"
+            )
+    return Scenario(
+        name=doc["name"],
+        nodes=nodes,
+        pools=pools,
+        chips_per_node=chips,
+        initial_mode=initial_mode,
+        workers=workers,
+        qps=float(qps),
+        evidence=doc.get("evidence", False),
+        watch_timeout_s=float(watch_timeout_s),
+        controllers=controllers,
+        actions=sorted(actions, key=lambda a: a.at),
+        converge=converge,
+    )
+
+
+def load_scenario(path: str) -> Scenario:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise ScenarioError(f"cannot read {path}: {e}") from e
+    except ValueError as e:
+        raise ScenarioError(f"{path}: not valid JSON: {e}") from e
+    return validate_scenario(doc)
+
+
+def canonical_scenario_text(doc: dict) -> str:
+    """The one true formatting for committed scenario files (2-space
+    indent, sorted keys, trailing newline) — tests/test_simlab.py
+    compares committed bytes against this, freshness-gate style."""
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"

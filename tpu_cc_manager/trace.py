@@ -47,6 +47,10 @@ PHASES = (
     "slice_wait",   # slice-coordination wait for quorum commit
     "evict",        # L2 drain
     "flip",         # one device: stage + reset + wait + verify
+    "stage",        # flip sub-phase: discard stale + stage domains
+    "reset",        # flip sub-phase: the device reset itself
+    "wait_ready",   # flip sub-phase: post-reset boot wait
+    "verify",       # flip sub-phase: query-back + independent verify
     "reschedule",   # L2 restore
     "state_label",  # observed-state label publish
 )
