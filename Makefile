@@ -30,9 +30,18 @@ bench:
 kind-smoke:
 	bash scripts/kind-smoke.sh
 
+# Three layers, weakest to strongest: compileall (syntax), ruff
+# (critical pyflakes classes, ruff.toml), ccaudit (project invariants:
+# lock discipline, blocking-under-lock, label hygiene, exception
+# discipline, metric names — docs/analysis.md). CI runs the same three
+# so local and CI agree; ruff is skipped with a notice when not
+# installed (pip install -r requirements-dev.txt).
 lint:
 	$(PYTHON) -m compileall -q tpu_cc_manager bench.py __graft_entry__.py scripts
 	bash -n scripts/tpu-cc-manager.sh scripts/kind-smoke.sh
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
+	else echo "lint: ruff not installed; skipping (pip install -r requirements-dev.txt)"; fi
+	$(PYTHON) -m tpu_cc_manager.analysis
 
 clean:
 	$(MAKE) -C native clean

@@ -314,6 +314,9 @@ def _run_pool_convergence(names, readiness_dir, prefix, *,
                             store.add_pod(component_pod(name))
                 except Exception:
                     pass  # racing a concurrent delete is fine
+                    # (baselined in analysis/baseline.json rather than
+                    # pragma'd: the bench harness predates ccaudit and
+                    # keeps one live entry exercising the ratchet)
             time.sleep(0.05)
 
     op_thread = None

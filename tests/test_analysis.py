@@ -1,0 +1,727 @@
+"""ccaudit: every rule's positive hit, negative pass, and pragma
+suppression; the ABBA-cycle detector on a synthetic two-lock inversion;
+the baseline ratchet (new findings fail, stale entries fail); and the
+committed-baseline freshness gate — the same staleness discipline the
+scenario and kustomize trees get (test_simlab.py / test_manifests.py).
+
+Fixtures are inline source snippets fed through ``analyze_source`` —
+no filesystem, no fixtures directory to drift.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+
+from tpu_cc_manager.analysis import (
+    BASELINE_PATH,
+    analyze_paths,
+    analyze_source,
+    diff_against_baseline,
+    load_baseline,
+    repo_root,
+    write_baseline,
+)
+
+
+def run(src: str, relpath: str = "tpu_cc_manager/snippet.py"):
+    return analyze_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------ raw-acquire
+
+
+def test_raw_acquire_flagged():
+    (f,) = run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f():
+            lock.acquire()
+        """
+    )
+    assert f.rule == "raw-acquire"
+    assert f.line == 5
+
+
+def test_acquire_with_try_finally_release_passes():
+    assert run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f():
+            lock.acquire()
+            try:
+                x = 1
+            finally:
+                lock.release()
+        """
+    ) == []
+
+
+def test_with_statement_passes():
+    assert run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f():
+            with lock:
+                x = 1
+        """
+    ) == []
+
+
+def test_raw_acquire_pragma_suppresses():
+    assert run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f():
+            lock.acquire()  # ccaudit: allow-raw-acquire(handed to a callback that releases)
+        """
+    ) == []
+
+
+def test_nonstandard_lock_name_caught_via_assignment():
+    # `gate = threading.Lock()` has no lock-ish name; the known-lock
+    # assignment tracker still sees it
+    (f,) = run(
+        """
+        import threading
+        gate = threading.Lock()
+        def f():
+            gate.acquire()
+        """
+    )
+    assert f.rule == "raw-acquire"
+
+
+# ----------------------------------------------------------- lock-order
+
+
+def test_abba_two_lock_inversion_detected():
+    findings = run(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def f(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def g(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """
+    )
+    assert rules_of(findings) == ["lock-order"]
+    assert "ABBA" in findings[0].message
+
+
+def test_consistent_lock_order_passes():
+    assert run(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def f(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def g(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+        """
+    ) == []
+
+
+def test_abba_through_one_call_hop():
+    # f holds A and calls take_b (which takes B); g nests A under B:
+    # the inversion is only visible through the call summary
+    findings = run(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def take_b(self):
+                with self._b_lock:
+                    pass
+
+            def f(self):
+                with self._a_lock:
+                    self.take_b()
+
+            def g(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """
+    )
+    assert rules_of(findings) == ["lock-order"]
+
+
+def test_abba_via_multi_item_with():
+    # `with a, b:` acquires left to right — same ordering constraint as
+    # the nested form, same inversion
+    findings = run(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def f(self):
+                with self._a_lock, self._b_lock:
+                    pass
+
+            def g(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """
+    )
+    assert rules_of(findings) == ["lock-order"]
+    assert "ABBA" in findings[0].message
+
+
+def test_blocking_call_in_later_with_item_flagged():
+    # item 2's context expression evaluates while item 1's lock is held
+    (f,) = run(
+        """
+        import threading, subprocess
+        lock = threading.Lock()
+        def f():
+            with lock, subprocess.Popen(["true"]) as p:
+                pass
+        """
+    )
+    assert f.rule == "blocking-under-lock"
+
+
+def test_abba_in_async_with():
+    findings = run(
+        """
+        import asyncio
+
+        class S:
+            def __init__(self):
+                self._a_lock = asyncio.Lock()
+                self._b_lock = asyncio.Lock()
+
+            async def f(self):
+                async with self._a_lock:
+                    async with self._b_lock:
+                        pass
+
+            async def g(self):
+                async with self._b_lock:
+                    async with self._a_lock:
+                        pass
+        """
+    )
+    assert rules_of(findings) == ["lock-order"]
+
+
+def test_nonreentrant_self_nesting_detected():
+    findings = run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f():
+            with lock:
+                with lock:
+                    pass
+        """
+    )
+    assert rules_of(findings) == ["lock-order"]
+    assert "re-acquired" in findings[0].message
+
+
+def test_rlock_self_nesting_is_legal():
+    assert run(
+        """
+        import threading
+        lock = threading.RLock()
+        def f():
+            with lock:
+                with lock:
+                    pass
+        """
+    ) == []
+
+
+def test_lock_order_pragma_suppresses():
+    assert run(
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def f(self):
+                with self._a_lock:
+                    # ccaudit: allow-lock-order(g only runs before threads start)
+                    with self._b_lock:
+                        pass
+
+            def g(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """
+    ) == []
+
+
+# -------------------------------------------------- blocking-under-lock
+
+
+def test_sleep_under_lock_flagged():
+    (f,) = run(
+        """
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                time.sleep(1)
+        """
+    )
+    assert f.rule == "blocking-under-lock"
+    assert "time.sleep" in f.message
+
+
+def test_blocking_prefixes_seen_through_import_aliases():
+    findings = run(
+        """
+        import threading
+        import subprocess as sp
+        from time import sleep
+        lock = threading.Lock()
+        def f():
+            with lock:
+                sleep(1)
+                sp.run(["true"])
+        """
+    )
+    assert rules_of(findings) == [
+        "blocking-under-lock", "blocking-under-lock"
+    ]
+
+
+def test_sleep_outside_lock_passes():
+    assert run(
+        """
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                x = 1
+            time.sleep(1)
+        """
+    ) == []
+
+
+def test_sleep_in_nested_def_under_lock_passes():
+    # the nested function body does not run while the lock is held
+    assert run(
+        """
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                def cb():
+                    time.sleep(1)
+                return cb
+        """
+    ) == []
+
+
+def test_blocking_under_lock_pragma_suppresses():
+    assert run(
+        """
+        import threading, time
+        lock = threading.Lock()
+        def f():
+            with lock:
+                time.sleep(1)  # ccaudit: allow-blocking-under-lock(test-only fake latency)
+        """
+    ) == []
+
+
+# --------------------------------------------------------- label-literal
+
+
+def test_label_literal_flagged():
+    (f,) = run('MODE = "tpu.google.com/cc.mode"\n')
+    assert f.rule == "label-literal"
+
+
+def test_label_literal_in_labels_py_passes():
+    assert run(
+        'MODE = "tpu.google.com/cc.mode"\n',
+        relpath="tpu_cc_manager/labels.py",
+    ) == []
+
+
+def test_label_literal_in_docstring_passes():
+    assert run(
+        '''
+        def f():
+            """Writes tpu.google.com/cc.mode on the node."""
+        '''
+    ) == []
+
+
+def test_label_literal_in_fstring_flagged():
+    (f,) = run('def f(m):\n    return f"tpu.google.com/{m}"\n')
+    assert f.rule == "label-literal"
+
+
+def test_label_literal_pragma_suppresses():
+    assert run(
+        'X = "tpu.google.com/cc.mode"  # ccaudit: allow-label-literal(CLI help text)\n'
+    ) == []
+
+
+# --------------------------------------------------------------- swallow
+
+
+def test_silent_broad_except_flagged():
+    (f,) = run(
+        """
+        try:
+            x = 1
+        except Exception:
+            pass
+        """
+    )
+    assert f.rule == "swallow"
+    assert f.line == 4
+
+
+def test_bare_except_flagged():
+    assert rules_of(run("try:\n    x = 1\nexcept:\n    pass\n")) == ["swallow"]
+
+
+def test_handler_that_logs_passes():
+    assert run(
+        """
+        import logging
+        log = logging.getLogger(__name__)
+        try:
+            x = 1
+        except Exception:
+            log.warning("failed", exc_info=True)
+        """
+    ) == []
+
+
+def test_handler_that_reraises_passes():
+    assert run(
+        """
+        try:
+            x = 1
+        except Exception:
+            raise RuntimeError("wrapped")
+        """
+    ) == []
+
+
+def test_handler_using_bound_exception_passes():
+    assert run(
+        """
+        def f():
+            try:
+                return 1
+            except Exception as e:
+                return f"failed: {e}"
+        """
+    ) == []
+
+
+def test_handler_binding_but_ignoring_exception_flagged():
+    assert rules_of(run(
+        """
+        try:
+            x = 1
+        except Exception as e:
+            y = 2
+        """
+    )) == ["swallow"]
+
+
+def test_swallow_pragma_on_except_line():
+    assert run(
+        """
+        try:
+            x = 1
+        except Exception:  # ccaudit: allow-swallow(best-effort cache warm)
+            pass
+        """
+    ) == []
+
+
+def test_swallow_pragma_on_first_body_line():
+    assert run(
+        """
+        try:
+            x = 1
+        except Exception:
+            pass  # ccaudit: allow-swallow(best-effort cache warm)
+        """
+    ) == []
+
+
+def test_pragma_requires_reason():
+    # an empty reason is not a suppression
+    assert rules_of(run(
+        """
+        try:
+            x = 1
+        except Exception:  # ccaudit: allow-swallow()
+            pass
+        """
+    )) == ["swallow"]
+
+
+def test_narrow_except_never_flagged():
+    assert run(
+        """
+        try:
+            x = 1
+        except (ValueError, OSError):
+            pass
+        """
+    ) == []
+
+
+# ----------------------------------------------------------- metric-name
+
+
+def test_undeclared_metric_use_flagged():
+    (f,) = run('NAME = "tpu_cc_bogus_total"\n')
+    assert f.rule == "metric-name"
+    assert "tpu_cc_bogus_total" in f.message
+
+
+def test_declared_metric_use_passes():
+    assert run(
+        """
+        from tpu_cc_manager.obs import Counter
+        c = Counter("tpu_cc_things_total", "things")
+        NAME = "tpu_cc_things_total"
+        """
+    ) == []
+
+
+def test_series_suffixes_resolve_to_declaration():
+    assert run(
+        """
+        from tpu_cc_manager.obs import Histogram
+        h = Histogram("tpu_cc_lat_seconds", "latency")
+        SERIES = "tpu_cc_lat_seconds_bucket"
+        """
+    ) == []
+
+
+def test_duplicate_metric_declaration_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager.obs import Counter
+        a = Counter("tpu_cc_things_total", "things")
+        b = Counter("tpu_cc_things_total", "things again")
+        """
+    )
+    assert f.rule == "metric-name"
+    assert "more than once" in f.message
+
+
+def test_metric_pragma_suppresses():
+    assert run(
+        'NAME = "tpu_cc_retired_total"  # ccaudit: allow-metric-name(grafana migration note)\n'
+    ) == []
+
+
+# ------------------------------------------------------ baseline ratchet
+
+
+def _findings_fixture():
+    return run(
+        """
+        try:
+            x = 1
+        except Exception:
+            pass
+        """
+    )
+
+
+def test_baseline_suppresses_known_finding(tmp_path):
+    findings = _findings_fixture()
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    new, suppressed, stale = diff_against_baseline(
+        findings, load_baseline(path)
+    )
+    assert new == [] and stale == [] and len(suppressed) == 1
+
+
+def test_new_finding_not_in_baseline_fails(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    write_baseline([], path)
+    new, _, stale = diff_against_baseline(
+        _findings_fixture(), load_baseline(path)
+    )
+    assert len(new) == 1 and stale == []
+
+
+def test_stale_baseline_entry_fails(tmp_path):
+    # entry points at a line whose text no longer matches: stale
+    findings = _findings_fixture()
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    entries = load_baseline(path)
+    entries[0]["text"] = "except Exception as e:"
+    new, _, stale = diff_against_baseline(findings, entries)
+    assert len(new) == 1 and len(stale) == 1
+
+
+def test_moved_finding_is_both_new_and_stale(tmp_path):
+    findings = _findings_fixture()
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    entries = load_baseline(path)
+    entries[0]["line"] += 10
+    new, _, stale = diff_against_baseline(findings, entries)
+    assert len(new) == 1 and len(stale) == 1
+
+
+def test_same_line_duplicates_are_multiset(tmp_path):
+    # two violations on one line share a (rule, file, line, text) key;
+    # one baseline entry must suppress exactly one of them
+    findings = run('PAIR = ("tpu.google.com/a", "tpu.google.com/b")\n')
+    assert len(findings) == 2
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings[:1], path)
+    new, suppressed, stale = diff_against_baseline(
+        findings, load_baseline(path)
+    )
+    assert len(new) == 1 and len(suppressed) == 1 and stale == []
+
+
+# ----------------------------------------- the repo itself, gated in CI
+
+
+def test_repo_is_clean_against_committed_baseline():
+    """The ccaudit CI gate, as a test: zero new findings and — the
+    freshness half — zero stale baseline entries. A baseline entry whose
+    file/line/text no longer matches a live finding fails here, so a
+    stale suppression can never mask a regression."""
+    findings = analyze_paths(repo_root())
+    new, _, stale = diff_against_baseline(findings, load_baseline())
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_committed_baseline_is_canonically_formatted(tmp_path):
+    """Byte-for-byte regeneration — the schema-example treatment
+    test_simlab.py gives scenarios/: hand-edits that drift from
+    --write-baseline output are errors."""
+    import os
+
+    committed = os.path.join(repo_root(), BASELINE_PATH)
+    with open(committed, "r", encoding="utf-8") as f:
+        committed_bytes = f.read()
+    entries = load_baseline(committed)
+    regen = str(tmp_path / "regen.json")
+    findings = analyze_paths(repo_root())
+    keep = {
+        (e["rule"], e["file"], int(e["line"]), e["text"]) for e in entries
+    }
+    write_baseline([f for f in findings if f.key() in keep], regen)
+    with open(regen, "r", encoding="utf-8") as f:
+        assert f.read() == committed_bytes
+
+
+def test_cli_exits_nonzero_on_new_finding(tmp_path):
+    """Acceptance check for the CLI contract: a fresh violation in the
+    scan surface flips the exit code."""
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "bad.py").write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "pkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "[swallow]" in proc.stdout
+
+    (root / "pkg" / "bad.py").write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "pkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+
+
+def test_cli_errors_on_target_matching_no_files(tmp_path):
+    """A typo'd or renamed scan target must fail loud, not pass vacuous."""
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "ok.py").write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "pkg", "no_such_dir"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2
+    assert "no_such_dir" in proc.stderr
+
+
+def test_cli_exits_nonzero_on_stale_baseline(tmp_path):
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "ok.py").write_text("x = 1\n")
+    baseline = root / "stale.json"
+    baseline.write_text(json.dumps({
+        "version": 1,
+        "findings": [{
+            "rule": "swallow", "file": "pkg/ok.py", "line": 1,
+            "text": "except Exception:",
+        }],
+    }))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "--baseline", str(baseline), "pkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "stale-baseline" in proc.stdout

@@ -1,7 +1,6 @@
 """L4 tests: flags-over-env config resolution, metrics rendering, and the
 health/metrics HTTP endpoints."""
 
-import json
 import urllib.request
 
 import pytest

@@ -10,7 +10,7 @@ import json
 import os
 
 from tpu_cc_manager import labels as L
-from tpu_cc_manager.device.statefile import ModeStateStore, device_key
+from tpu_cc_manager.device.statefile import ModeStateStore
 from tpu_cc_manager.device.tpu import SysfsTpuBackend
 from tpu_cc_manager.doctor import run_doctor
 from tpu_cc_manager.engine import ModeEngine

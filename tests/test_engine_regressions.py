@@ -2,7 +2,6 @@
 
 import pytest
 
-from tpu_cc_manager.device import base as device_base
 from tpu_cc_manager.device.base import set_backend
 from tpu_cc_manager.device.fake import fake_backend
 from tpu_cc_manager.device.tpu import SysfsTpuBackend

@@ -10,7 +10,6 @@
 
 import json
 
-import pytest
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.config import AgentConfig

@@ -1,7 +1,6 @@
 """Fleet-planner tests: encoding, divergence/failure detection, slice
 coherence auditing, and the sharded dry run."""
 
-import numpy as np
 import pytest
 
 from tpu_cc_manager import labels as L

@@ -18,7 +18,6 @@ import json
 import threading
 import time
 
-import pytest
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.apiserver import FakeApiServer

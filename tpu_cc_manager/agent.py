@@ -388,6 +388,9 @@ class CCManagerAgent:
                 float(exp) - float(iat), 0.0
             )
         except Exception:
+            log.debug("evidence republish deadline unparseable; "
+                      "relying on the repair-interval fallback",
+                      exc_info=True)
             return None
 
     def _on_fatal_watch(self, exc: Exception) -> None:

@@ -1,0 +1,65 @@
+"""ccaudit — project-specific static analysis for the threaded reconciler fleet.
+
+The reference repo's CI leaned on golangci-lint plus a vacuously green
+``go test ./...`` (SURVEY.md §4); our ``make lint`` was a bare
+``compileall``. Meanwhile simlab made this a genuinely concurrent system
+(shared watch pump, bounded worker pool, leader flaps), and the defect
+classes that fleet-scale scenarios hit first — ABBA deadlocks, silent
+exception swallows, blocking calls under a lock — are exactly the ones a
+compiler can't see but an AST walk can.
+
+ccaudit is that walk. The rules (docs/analysis.md has the full contract):
+
+``raw-acquire``
+    Locks are acquired via ``with``; a bare ``.acquire()`` is flagged
+    unless a ``try/finally`` in the same function releases the same lock.
+``lock-order``
+    A global lock-order graph is built from nested ``with`` blocks plus a
+    one-hop summary of same-module calls made while a lock is held;
+    any cycle (a potential ABBA deadlock) is reported.
+``blocking-under-lock``
+    ``time.sleep``, subprocess, and socket/HTTP calls lexically inside a
+    lock's ``with`` body are flagged — they turn a microsecond critical
+    section into a convoy.
+``label-literal``
+    Hard-coded ``tpu.google.com/...`` protocol strings belong in
+    ``labels.py`` only; everywhere else must import the constant.
+``swallow``
+    ``except Exception``/``BaseException``/bare ``except`` bodies must
+    re-raise, log, or use the bound exception — or carry an explicit
+    ``# ccaudit: allow-swallow(reason)`` pragma.
+``metric-name``
+    Every metric name has exactly one Counter/Gauge/Histogram/
+    HistogramVec declaration; ``tpu_cc_*`` string literals used anywhere
+    else must match a declared name (two differently-bucketed
+    expositions under one name would corrupt aggregation — obs.py's
+    ``kube_throttle_wait_histogram`` docstring is the founding charter).
+
+Findings are gated against ``analysis/baseline.json`` so CI fails only on
+*new* findings; stale baseline entries (the code they suppressed moved or
+was fixed) also fail, so the baseline can only burn down.
+
+Run it: ``python -m tpu_cc_manager.analysis`` (wired into ``make lint``).
+"""
+
+from tpu_cc_manager.analysis.core import (  # noqa: F401
+    Finding,
+    analyze_paths,
+    analyze_source,
+    repo_root,
+)
+from tpu_cc_manager.analysis.baseline import (  # noqa: F401
+    BASELINE_PATH,
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+RULES = (
+    "raw-acquire",
+    "lock-order",
+    "blocking-under-lock",
+    "label-literal",
+    "swallow",
+    "metric-name",
+)

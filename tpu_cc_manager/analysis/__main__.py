@@ -1,0 +1,112 @@
+"""ccaudit CLI: ``python -m tpu_cc_manager.analysis``.
+
+Exit 0 when the repo is clean against the committed baseline; exit 1 on
+any new finding *or* any stale baseline entry (the ratchet only turns one
+way — see baseline.py). ``make lint`` and the CI ``ccaudit`` job both run
+exactly this."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from tpu_cc_manager.analysis import baseline as baseline_mod
+from tpu_cc_manager.analysis.core import (
+    DEFAULT_TARGETS,
+    analyze_paths,
+    repo_root,
+)
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_cc_manager.analysis",
+        description="ccaudit: AST-based invariant analyzer "
+        "(lock discipline, blocking-under-lock, label hygiene, "
+        "exception discipline, metric-name consistency). "
+        "docs/analysis.md has the rule contract.",
+    )
+    parser.add_argument(
+        "targets", nargs="*", default=list(DEFAULT_TARGETS),
+        help="files/directories to scan, relative to --root "
+        f"(default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: auto-detected from the package location)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <root>/{baseline_mod.BASELINE_PATH})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report and fail on every finding",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.BASELINE_PATH
+    )
+
+    try:
+        findings = analyze_paths(root, args.targets)
+    except FileNotFoundError as e:
+        print(f"ccaudit: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        baseline_mod.write_baseline(findings, baseline_path)
+        print(
+            f"ccaudit: wrote {len(findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    entries = [] if args.no_baseline else baseline_mod.load_baseline(
+        baseline_path
+    )
+    new, suppressed, stale = baseline_mod.diff_against_baseline(
+        findings, entries
+    )
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "new": [f.to_json() for f in new],
+                "suppressed": [f.to_json() for f in suppressed],
+                "stale": stale,
+            },
+            indent=1, sort_keys=True,
+        ))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(
+                f"{e.get('file')}:{e.get('line')}: [stale-baseline] entry "
+                f"for rule {e.get('rule')!r} matches no current finding — "
+                "delete it (or --write-baseline)"
+            )
+        print(
+            f"ccaudit: {len(new)} new finding(s), {len(stale)} stale "
+            f"baseline entr{'y' if len(stale) == 1 else 'ies'}, "
+            f"{len(suppressed)} baselined",
+            file=sys.stderr,
+        )
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

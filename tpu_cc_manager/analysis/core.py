@@ -1,0 +1,177 @@
+"""ccaudit core: findings, pragma parsing, module scanning, orchestration.
+
+The per-module AST walk lives in ``rules.py``; the cross-module passes
+(lock-order cycles, metric-name registry) consume the per-module results
+here so a single ``analyze_paths()`` call yields one flat finding list.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: ``# ccaudit: allow-<rule>(<reason>)`` — the reason is mandatory; a
+#: suppression with no recorded why is just a finding wearing a disguise.
+PRAGMA_RE = re.compile(r"#\s*ccaudit:\s*allow-([a-z][a-z-]*)\s*\(\s*([^)]+?)\s*\)")
+
+#: What the analyzer scans by default, relative to the repo root — the
+#: same surface ``make lint`` compiles. Tests are deliberately excluded:
+#: fixtures legitimately hard-code wire-protocol strings to assert them.
+DEFAULT_TARGETS = ("tpu_cc_manager", "scripts", "bench.py", "__graft_entry__.py")
+
+_EXCLUDE_DIRS = {"__pycache__", "native", "tests", ".git"}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    file: str  #: repo-relative posix path
+    line: int
+    rule: str
+    message: str
+    text: str  #: stripped source line — the baseline's drift detector
+
+    def key(self) -> Tuple[str, str, int, str]:
+        return (self.rule, self.file, self.line, self.text)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "text": self.text,
+        }
+
+
+class Module:
+    """One parsed source file plus its pragma map and line cache."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=relpath)
+        self._lines = source.splitlines()
+        self.pragmas = _parse_pragmas(source)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self._lines):
+            return self._lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """A pragma suppresses its rule on its own line or the line below
+        (i.e. write the pragma on the flagged line or just above it)."""
+        for ln in (lineno, lineno - 1):
+            if rule in self.pragmas.get(ln, ()):
+                return True
+        return False
+
+
+def _parse_pragmas(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            for m in PRAGMA_RE.finditer(tok.string):
+                out.setdefault(tok.start[0], set()).add(m.group(1))
+    except tokenize.TokenError:
+        pass  # unparseable tail; ast.parse already vetted the file
+    return out
+
+
+def repo_root() -> str:
+    """The repo root is two levels above this package (…/tpu_cc_manager/
+    analysis/core.py); resolving from ``__file__`` keeps the CLI working
+    from any cwd."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def iter_python_files(root: str, targets: Sequence[str]) -> List[str]:
+    """Repo-relative posix paths of every .py file under ``targets``.
+
+    A target that matches no Python files (typo, renamed surface) is a
+    loud error — a gate that quietly stops scanning is worse than none.
+    """
+    out: List[str] = []
+    for target in targets:
+        found = []
+        full = os.path.join(root, target)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                found.append(target.replace(os.sep, "/"))
+        else:
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _EXCLUDE_DIRS
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), root
+                        )
+                        found.append(rel.replace(os.sep, "/"))
+        if not found:
+            raise FileNotFoundError(
+                f"ccaudit scan target {target!r} matched no Python files "
+                f"under {root}"
+            )
+        out.extend(found)
+    return sorted(set(out))
+
+
+def load_module(root: str, relpath: str) -> Optional[Module]:
+    with open(os.path.join(root, relpath), "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        return Module(relpath, src)
+    except SyntaxError:
+        # compileall (the other half of `make lint`) owns syntax errors;
+        # double-reporting them here would just be noise
+        return None
+
+
+# --------------------------------------------------------------------- runs
+
+
+def analyze_modules(modules: Sequence[Module]) -> List[Finding]:
+    """Run every rule over already-parsed modules (the seam the fixture
+    tests use: build Modules from inline snippets, skip the filesystem)."""
+    from tpu_cc_manager.analysis import lockgraph, rules
+
+    findings: List[Finding] = []
+    summaries = []
+    for mod in modules:
+        result = rules.audit_module(mod)
+        findings.extend(result.findings)
+        summaries.append(result)
+    findings.extend(lockgraph.order_findings(summaries))
+    findings.extend(rules.metric_findings(summaries))
+    return sorted(findings)
+
+
+def analyze_paths(
+    root: Optional[str] = None, targets: Sequence[str] = DEFAULT_TARGETS
+) -> List[Finding]:
+    root = root or repo_root()
+    modules = []
+    for rel in iter_python_files(root, targets):
+        mod = load_module(root, rel)
+        if mod is not None:
+            modules.append(mod)
+    return analyze_modules(modules)
+
+
+def analyze_source(source: str, relpath: str = "snippet.py") -> List[Finding]:
+    """Analyze one in-memory module — the unit-test entry point."""
+    return analyze_modules([Module(relpath, source)])

@@ -1,0 +1,556 @@
+"""ccaudit rules: one AST walk per module, plus the global metric pass.
+
+``audit_module`` produces per-module findings (raw-acquire,
+blocking-under-lock, label-literal, swallow) and the raw material the
+cross-module passes consume: lock-order edges (``lockgraph.py``) and
+metric declarations/uses (``metric_findings`` below).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tpu_cc_manager.analysis.core import Finding, Module
+
+# -- lock identification ----------------------------------------------------
+
+#: A name reads as a lock when its terminal component says so. This is the
+#: project's actual naming convention (``self._lock``, ``_stop_lock``,
+#: ``self._cond``); locks assigned from ``threading.Lock()`` under any
+#: other name are caught by the known-lock assignment tracker.
+_LOCKY_NAME = re.compile(
+    r"(?:^|_)(?:lock|rlock|cond|condition|mutex|sem|semaphore)s?$", re.I
+)
+
+_THREADING_LOCK_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"
+}
+
+#: Reentrant lock types: a self-edge in the order graph (the same lock
+#: taken while already held) is legal for these, a deadlock for Lock.
+_REENTRANT_CTORS = {"RLock", "Condition"}
+
+# -- blocking-call identification -------------------------------------------
+
+#: Dotted-path prefixes that block on I/O or the clock. Matching is done
+#: on the *resolved* path (import aliases folded in), so ``from time
+#: import sleep`` and ``import subprocess as sp`` are both seen through.
+_BLOCKING_PREFIXES = (
+    "time.sleep",
+    "subprocess.",
+    "socket.",
+    "urllib.",
+    "requests.",
+    "http.client.",
+    "select.",
+)
+
+# -- label hygiene ----------------------------------------------------------
+
+#: Built by concatenation so this module's own source doesn't trip the
+#: rule it implements.
+LABEL_PREFIX = "tpu.google" + ".com/"
+
+#: Files allowed to hold protocol literals: labels.py is the single
+#: source of truth; the analysis package needs the prefix to check for it.
+_LABEL_EXEMPT_BASENAMES = {"labels.py"}
+_LABEL_EXEMPT_DIRS = ("tpu_cc_manager/analysis/",)
+
+# -- exception discipline ---------------------------------------------------
+
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "fatal", "log",
+}
+
+# -- metric names -----------------------------------------------------------
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "HistogramVec"}
+_METRIC_NAME_RE = re.compile(r"^tpu_cc_[a-z0-9_]+$")
+_METRIC_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: Strings the metric regex matches that aren't metric names.
+_METRIC_IGNORE = {"tpu_cc_manager"}
+
+
+@dataclass
+class LockSite:
+    """One ``with <lock>:`` acquisition."""
+
+    qual: str  #: graph node id, e.g. ``agent.Agent._event_lock``
+    display: str  #: what the developer wrote, e.g. ``self._event_lock``
+    file: str
+    line: int
+    text: str
+    reentrant: bool = False
+
+
+@dataclass
+class ModuleAudit:
+    """Everything one module contributes to the global passes."""
+
+    module: Module
+    findings: List[Finding] = field(default_factory=list)
+    #: lock-order edges: (outer LockSite, inner LockSite) — inner was
+    #: acquired lexically while outer was held
+    lock_edges: List[Tuple[LockSite, LockSite]] = field(default_factory=list)
+    #: function terminal name -> locks it acquires at its top level
+    fn_locks: Dict[str, List[LockSite]] = field(default_factory=dict)
+    #: calls made while a lock was held: (held LockSite, callee terminal name)
+    calls_under_lock: List[Tuple[LockSite, str]] = field(default_factory=list)
+    #: metric declarations: name -> [(file, line, text)]
+    metric_decls: Dict[str, List[Tuple[str, int, str]]] = field(
+        default_factory=dict
+    )
+    #: tpu_cc_* string literals used outside a declaration
+    metric_uses: List[Tuple[str, str, int, str]] = field(default_factory=list)
+
+    def add(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self.module.suppressed(rule, line):
+            return
+        self.findings.append(
+            Finding(
+                file=self.module.relpath,
+                line=line,
+                rule=rule,
+                message=message,
+                text=self.module.line_text(line),
+            )
+        )
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _dotted(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_docstring_nodes(tree: ast.Module) -> Set[int]:
+    """id()s of Constant nodes that are docstrings — string literals, but
+    not protocol data."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, audit: ModuleAudit):
+        self.audit = audit
+        self.module = audit.module
+        modname = self.module.relpath.rsplit("/", 1)[-1]
+        self.modbase = modname[:-3] if modname.endswith(".py") else modname
+        self.docstrings = _collect_docstring_nodes(self.module.tree)
+        #: Constant nodes that are a metric declaration's name argument
+        self._decl_nodes: Set[int] = set()
+        #: local names known to be locks via `x = threading.Lock()` style
+        #: assignment, keyed by terminal name; value: reentrant?
+        self.known_locks: Dict[str, bool] = {}
+        #: import alias -> real dotted prefix (``sp`` -> ``subprocess``,
+        #: ``sleep`` -> ``time.sleep``)
+        self.imports: Dict[str, str] = {}
+        self.class_stack: List[str] = []
+        self.func_stack: List[str] = []
+        self.lock_stack: List[LockSite] = []
+        #: functions with try/finally releasing lock X (terminal names)
+        self._finally_released: Set[str] = set()
+        self.label_exempt = self._label_exempt(self.module.relpath)
+
+    @staticmethod
+    def _label_exempt(relpath: str) -> bool:
+        base = relpath.rsplit("/", 1)[-1]
+        if base in _LABEL_EXEMPT_BASENAMES:
+            return True
+        return any(relpath.startswith(d) for d in _LABEL_EXEMPT_DIRS)
+
+    # ---------------------------------------------------------- imports
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.asname:
+                self.imports[alias.asname] = alias.name
+            else:
+                # `import http.client` binds the local name `http`
+                top = alias.name.split(".")[0]
+                self.imports[top] = top
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            for alias in node.names:
+                self.imports[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+        self.generic_visit(node)
+
+    def _resolve(self, expr: ast.AST) -> Optional[str]:
+        """Dotted call path with import aliases folded in."""
+        dotted = _dotted(expr)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        real = self.imports.get(head)
+        if real:
+            return f"{real}.{rest}" if rest else real
+        return dotted
+
+    # ---------------------------------------------------- lock bookkeeping
+
+    def _lock_ctor(self, value: ast.AST) -> Optional[str]:
+        """Return the threading ctor name when ``value`` constructs a lock."""
+        if not isinstance(value, ast.Call):
+            return None
+        resolved = self._resolve(value.func) or ""
+        term = resolved.rsplit(".", 1)[-1]
+        if term in _THREADING_LOCK_CTORS and (
+            resolved.startswith("threading.") or resolved == term
+        ):
+            return term
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        ctor = self._lock_ctor(node.value)
+        if ctor:
+            for tgt in node.targets:
+                name = _terminal_name(tgt)
+                if name:
+                    self.known_locks[name] = ctor in _REENTRANT_CTORS
+        self.generic_visit(node)
+
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        name = _terminal_name(expr)
+        if name is None:
+            return False
+        return name in self.known_locks or bool(_LOCKY_NAME.search(name))
+
+    def _lock_site(self, expr: ast.AST, node: ast.AST) -> LockSite:
+        name = _terminal_name(expr) or "<lock>"
+        display = _dotted(expr) or name
+        # self.X inside class C -> modbase.C.X; everything else keeps its
+        # dotted path under the module, so distinct locks stay distinct
+        if display.startswith("self.") and self.class_stack:
+            qual = f"{self.modbase}.{self.class_stack[-1]}.{display[5:]}"
+        else:
+            qual = f"{self.modbase}.{display}"
+        return LockSite(
+            qual=qual,
+            display=display,
+            file=self.module.relpath,
+            line=node.lineno,
+            text=self.module.line_text(node.lineno),
+            reentrant=self.known_locks.get(name, False),
+        )
+
+    # ------------------------------------------------------------- with
+
+    def visit_With(self, node: ast.With) -> None:
+        # Python enters with-items left to right, so item N's context
+        # expression runs — and its lock is ordered — under every lock
+        # item 0..N-1 acquired: `with a, b:` is exactly `with a: with b:`
+        pushed = 0
+        for item in node.items:
+            expr = item.context_expr
+            self.visit(expr)
+            if item.optional_vars:
+                self.visit(item.optional_vars)
+            if not self._is_lock_expr(expr):
+                continue
+            site = self._lock_site(expr, node)
+            if self.lock_stack:
+                self.audit.lock_edges.append((self.lock_stack[-1], site))
+            elif self.func_stack:
+                self.audit.fn_locks.setdefault(self.func_stack[-1], []).append(
+                    site
+                )
+            self.lock_stack.append(site)
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.lock_stack[len(self.lock_stack) - pushed:]
+
+    # same shape (withitems + body); async lock types differ but the
+    # ordering/blocking invariants don't
+    visit_AsyncWith = visit_With
+
+    # ------------------------------------------------------- scope resets
+
+    def _visit_scope(self, node, name: str) -> None:
+        saved_stack, self.lock_stack = self.lock_stack, []
+        saved_released = self._finally_released
+        self._finally_released = self._collect_finally_releases(node)
+        self.func_stack.append(name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.lock_stack = saved_stack
+        self._finally_released = saved_released
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_scope(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_scope(node, node.name)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self.lock_stack = self.lock_stack, []
+        self.generic_visit(node)
+        self.lock_stack = saved
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        saved, self.lock_stack = self.lock_stack, []
+        self.generic_visit(node)
+        self.lock_stack = saved
+        self.class_stack.pop()
+
+    # ---------------------------------------------------------- raw acquire
+
+    def _collect_finally_releases(self, fn: ast.AST) -> Set[str]:
+        """Terminal lock names released inside any ``finally`` in ``fn``
+        (not descending into nested defs)."""
+        out: Set[str] = set()
+        stack = list(getattr(fn, "body", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "release"
+                        ):
+                            name = _terminal_name(sub.func.value)
+                            if name:
+                                out.add(name)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    # ------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        # raw-acquire: lock.acquire() outside with, without finally release
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            if self._is_lock_expr(func.value):
+                name = _terminal_name(func.value)
+                if name not in self._finally_released:
+                    self.audit.add(
+                        "raw-acquire",
+                        node,
+                        f"raw {_dotted(func) or 'acquire'}() — use `with "
+                        f"{_dotted(func.value) or name}:` or pair with "
+                        "try/finally release",
+                    )
+
+        # blocking-under-lock
+        if self.lock_stack:
+            resolved = self._resolve(func)
+            if resolved and any(
+                resolved == p or resolved.startswith(p)
+                for p in _BLOCKING_PREFIXES
+            ):
+                held = self.lock_stack[-1]
+                self.audit.add(
+                    "blocking-under-lock",
+                    node,
+                    f"{resolved} called while holding {held.display} "
+                    f"(acquired line {held.line}) — blocking inside a "
+                    "critical section convoys every other waiter",
+                )
+            # interprocedural hop for the lock-order graph: same-module
+            # callee summaries are resolved in lockgraph.order_findings
+            callee = _terminal_name(func)
+            if callee:
+                self.audit.calls_under_lock.append(
+                    (self.lock_stack[-1], callee)
+                )
+
+        # metric declarations
+        term = _terminal_name(func)
+        if (
+            term in _METRIC_CTORS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+            if _METRIC_NAME_RE.match(name):
+                self._decl_nodes.add(id(node.args[0]))
+                self.audit.metric_decls.setdefault(name, []).append(
+                    (
+                        self.module.relpath,
+                        node.lineno,
+                        self.module.line_text(node.lineno),
+                    )
+                )
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------ except
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        # the pragma may sit on the except line, the line above, or the
+        # first body line — wherever it reads best
+        body_pragma = bool(node.body) and self.module.suppressed(
+            "swallow", node.body[0].lineno
+        )
+        if (
+            self._is_broad_handler(node.type)
+            and not self._handler_ok(node)
+            and not body_pragma
+        ):
+            self.audit.add(
+                "swallow",
+                node,
+                "broad except swallows silently — re-raise, log, use the "
+                "bound exception, or annotate "
+                "`# ccaudit: allow-swallow(reason)`",
+            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_broad_handler(type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True  # bare except:
+        if isinstance(type_node, ast.Tuple):
+            names = [_terminal_name(e) for e in type_node.elts]
+        else:
+            names = [_terminal_name(type_node)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _handler_ok(node: ast.ExceptHandler) -> bool:
+        bound = node.name
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    return True
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _LOG_METHODS
+                ):
+                    return True
+                if (
+                    bound
+                    and isinstance(sub, ast.Name)
+                    and sub.id == bound
+                    and isinstance(sub.ctx, ast.Load)
+                ):
+                    return True
+        return False
+
+    # ---------------------------------------------------------- constants
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if not isinstance(node.value, str) or id(node) in self.docstrings:
+            return
+        if LABEL_PREFIX in node.value and not self.label_exempt:
+            self.audit.add(
+                "label-literal",
+                node,
+                f"hard-coded {LABEL_PREFIX}… literal — import the "
+                "constant from tpu_cc_manager.labels (the one protocol "
+                "surface)",
+            )
+        if (
+            _METRIC_NAME_RE.match(node.value)
+            and node.value not in _METRIC_IGNORE
+            and id(node) not in self._decl_nodes
+        ):
+            self.audit.metric_uses.append(
+                (
+                    node.value,
+                    self.module.relpath,
+                    node.lineno,
+                    self.module.line_text(node.lineno),
+                )
+            )
+
+
+def audit_module(module: Module) -> ModuleAudit:
+    audit = ModuleAudit(module=module)
+    _Walker(audit).visit(module.tree)
+    return audit
+
+
+# ------------------------------------------------------------------ metrics
+
+
+def metric_findings(audits: Sequence[ModuleAudit]) -> List[Finding]:
+    """Cross-module metric-name pass: exactly one declaration per name;
+    every non-declaration ``tpu_cc_*`` literal must match a declaration
+    (modulo the Prometheus _bucket/_sum/_count series suffixes)."""
+    decls: Dict[str, List[Tuple[str, int, str]]] = {}
+    by_relpath = {a.module.relpath: a.module for a in audits}
+    for a in audits:
+        for name, sites in a.metric_decls.items():
+            decls.setdefault(name, []).extend(sites)
+
+    findings: List[Finding] = []
+
+    def emit(rule, file, line, text, message):
+        mod = by_relpath.get(file)
+        if mod is not None and mod.suppressed(rule, line):
+            return
+        findings.append(
+            Finding(file=file, line=line, rule=rule, message=message, text=text)
+        )
+
+    for name, sites in sorted(decls.items()):
+        if len(sites) > 1:
+            first = sites[0]
+            for file, line, text in sites[1:]:
+                emit(
+                    "metric-name", file, line, text,
+                    f"metric {name!r} declared more than once (first at "
+                    f"{first[0]}:{first[1]}) — two expositions under one "
+                    "name corrupt aggregation",
+                )
+
+    for a in audits:
+        for name, file, line, text in a.metric_uses:
+            base = name
+            for suffix in _METRIC_SUFFIXES:
+                if name.endswith(suffix) and name[: -len(suffix)] in decls:
+                    base = name[: -len(suffix)]
+                    break
+            if base not in decls:
+                emit(
+                    "metric-name", file, line, text,
+                    f"metric name {name!r} matches no "
+                    "Counter/Gauge/Histogram/HistogramVec declaration — "
+                    "declare it once or fix the typo",
+                )
+    return findings

@@ -408,8 +408,8 @@ def _judge_cs_token(att: dict, expected_nonce: str) -> Tuple[str, str]:
         header_b64, payload_b64, sig_b64 = token.split(".")
         header = json.loads(_b64url_decode(header_b64))
         payload = json.loads(_b64url_decode(payload_b64))
-    except Exception:
-        return "invalid", "attestation token undecodable"
+    except Exception as e:
+        return "invalid", f"attestation token undecodable: {e}"
     try:
         keys = load_jwks(jwks_path)
     except Exception as e:
@@ -426,8 +426,8 @@ def _judge_cs_token(att: dict, expected_nonce: str) -> Tuple[str, str]:
         sig = _b64url_decode(sig_b64)
         if not _rsa_pkcs1_sha256_verify(n, e, signing_input, sig):
             return "mismatch", "token signature does not verify"
-    except Exception:
-        return "invalid", "token signature undecodable"
+    except Exception as e:
+        return "invalid", f"token signature undecodable: {e}"
     exp = payload.get("exp")
     if isinstance(exp, (int, float)) and exp < time.time():
         # staleness, not forgery: the platform DID attest, the token
@@ -526,7 +526,7 @@ def quote_refresh_deadline(doc: dict) -> Optional[float]:
         else:
             margin = 300.0
         return float(exp) - margin
-    except Exception:
+    except Exception:  # ccaudit: allow-swallow(undecodable quote has no expiry to extract; caller treats None as never)
         return None
 
 

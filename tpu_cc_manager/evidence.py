@@ -569,6 +569,8 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
             doc = json.loads(raw)
             verdict, attested = judge_evidence(doc, name, key=key)
         except Exception:
+            log.debug("evidence for %s unjudgeable; counting invalid",
+                      name, exc_info=True)
             invalid.append(name)
             continue
         if verdict not in ("ok", "unsigned", "no_key"):
@@ -589,6 +591,8 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         try:
             iverdict, _ = judge_identity(doc, name)
         except Exception:
+            log.debug("identity judge crashed for %s; counting invalid",
+                      name, exc_info=True)
             iverdict = "invalid"
         if iverdict == "missing":
             ident_missing.append(name)
@@ -615,6 +619,8 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         try:
             averdict, _ = judge_attestation(doc, name)
         except Exception:
+            log.debug("attestation judge crashed for %s; counting invalid",
+                      name, exc_info=True)
             averdict = "invalid"
         if averdict == "missing":
             att_missing.append(name)
@@ -768,8 +774,8 @@ def evidence_in_sync(current: Optional[dict], fresh: dict,
                 # not strip a still-valid token from the cluster
                 # (same guard as the in-process agent's refresh path)
                 return fresh_tok is None and time.time() < float(exp)
-    except Exception:
-        return False  # unparseable token on the cluster: replace it
+    except Exception:  # ccaudit: allow-swallow(unparseable token on the cluster: out-of-sync by definition, replace it)
+        return False
     # current token valid and not aging: in sync — including when the
     # fresh build LOST identity to a metadata blip (keep the better
     # document rather than stripping a still-valid token)

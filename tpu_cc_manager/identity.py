@@ -183,8 +183,8 @@ class _TokenCaching:
                 exp = float(payload["exp"])
             if isinstance(payload.get("iat"), (int, float)):
                 iat = float(payload["iat"])
-        except Exception:
-            pass  # opaque token: cache for the fallback path only
+        except Exception:  # ccaudit: allow-swallow(opaque token is still servable; decode only feeds the expiry cache)
+            pass
         self._cache[(node_name, aud)] = (tok, iat, exp)
         return tok
 
@@ -302,6 +302,8 @@ def get_identity_provider(refresh: bool = False):
             prov.probe()
             _auto_cache = prov
         except Exception:
+            log.debug("no ambient platform identity (metadata server "
+                      "probe failed)", exc_info=True)
             _auto_cache = False
     return _auto_cache or None
 
@@ -335,6 +337,8 @@ def load_jwks(path: str) -> dict:
             n = int.from_bytes(_b64url_decode(key["n"]), "big")
             e = int.from_bytes(_b64url_decode(key["e"]), "big")
         except Exception:
+            log.debug("skipping malformed JWKS key %r", key.get("kid"),
+                      exc_info=True)
             continue
         keys[key["kid"]] = (n, e)
     return keys
@@ -494,8 +498,8 @@ def verify_token(token: str, *, node_name: str,
             signing_input, _, sig_b64 = token.rpartition(".")
             try:
                 sig = _b64url_decode(sig_b64)
-            except Exception:
-                return "invalid", "malformed RS256 signature"
+            except Exception as e:
+                return "invalid", f"malformed RS256 signature: {e}"
             if not _rsa_pkcs1_sha256_verify(
                     pub[0], pub[1], signing_input.encode(), sig):
                 return "invalid", "bad RS256 signature"

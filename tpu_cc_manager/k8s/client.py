@@ -12,6 +12,7 @@ import abc
 import base64
 import datetime
 import json
+import logging
 import os
 import socket
 import ssl
@@ -27,6 +28,8 @@ from http.client import (
     HTTPSConnection,
 )
 from typing import Dict, Iterator, List, Optional, Tuple
+
+log = logging.getLogger("tpu-cc-manager.k8s")
 
 
 class ApiException(Exception):
@@ -597,7 +600,8 @@ class HttpKubeClient(KubeClient):
             try:
                 fn(waited)
             except Exception:
-                pass  # observability must never sink a request
+                # observability must never sink a request
+                log.debug("throttle observer failed", exc_info=True)
 
     # -- plumbing -------------------------------------------------------
     def _pooled(self, read_timeout: Optional[float]) -> Tuple[HTTPConnection, bool]:

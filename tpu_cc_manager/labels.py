@@ -62,6 +62,8 @@ SLICE_LEADER_ANNOTATION = "tpu.google.com/cc.slice.leader"
 SLICE_EPOCH_ANNOTATION = "tpu.google.com/cc.slice.epoch"
 SLICE_ACK_ANNOTATION = "tpu.google.com/cc.slice.ack"
 SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"
+SLICE_HB_ANNOTATION = "tpu.google.com/cc.slice.hb"
+SLICE_DONE_ANNOTATION = "tpu.google.com/cc.slice.done"
 
 #: Per-flip attestation evidence annotation (tpu_cc_manager.evidence):
 #: a hashed/HMAC'd document binding node, live device identities,

@@ -1521,6 +1521,8 @@ class PolicyController:
                     self._stop.wait(self.watch_backoff_s)
                     continue
                 except Exception:
+                    log.warning("policy CR watch failed; retrying",
+                                exc_info=True)
                     self._stop.wait(self.watch_backoff_s)
                     continue
                 crd_absent = False

@@ -1165,6 +1165,8 @@ class Rollout:
                     doc, m, key=self._evidence_key
                 )
             except Exception:
+                log.debug("evidence for %s unjudgeable; counting "
+                          "malformed", m, exc_info=True)
                 verdict, attested = "malformed", None
             if verdict == "unsigned":
                 # forensic outranks the deployment-gap runbook, same
@@ -1227,8 +1229,8 @@ class Rollout:
             # rollout must keep working on platforms that mint none
             try:
                 iverdict, idetail = judge_identity(doc, m)
-            except Exception:
-                iverdict, idetail = "invalid", "identity judge failed"
+            except Exception as e:
+                iverdict, idetail = "invalid", f"identity judge failed: {e}"
             if iverdict in ("mismatch", "invalid"):
                 self._suspect_reasons[m] = f"identity: {idetail}"
                 out.append(m)
@@ -1251,8 +1253,10 @@ class Rollout:
             # fleet audit would flag it a scan later.
             try:
                 averdict, adetail = judge_attestation(doc, m)
-            except Exception:
-                averdict, adetail = "invalid", "attestation judge failed"
+            except Exception as e:
+                averdict, adetail = (
+                    "invalid", f"attestation judge failed: {e}"
+                )
             if averdict in ("mismatch", "invalid"):
                 self._suspect_reasons[m] = f"attestation: {adetail}"
                 out.append(m)

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from tpu_cc_manager.modes import InvalidModeError, parse_mode
 
