@@ -6,7 +6,7 @@ include versions.mk
 
 PYTHON ?= python3
 
-.PHONY: all build native test test-fast bench lint clean image kind-smoke
+.PHONY: all build native test test-fast bench lint typecheck clean image kind-smoke
 
 all: build
 
@@ -42,6 +42,14 @@ lint:
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
 	else echo "lint: ruff not installed; skipping (pip install -r requirements-dev.txt)"; fi
 	$(PYTHON) -m tpu_cc_manager.analysis
+
+# Static types over the typed-core subset (mypy.ini `files`): the
+# protocol surface, planner, tracing, watch layer, and the analyzer
+# itself. Pinned in requirements-dev.txt; skipped with a notice when not
+# installed, same contract as ruff above. CI runs the same command.
+typecheck:
+	@if command -v mypy >/dev/null 2>&1; then mypy --config-file mypy.ini; \
+	else echo "typecheck: mypy not installed; skipping (pip install -r requirements-dev.txt)"; fi
 
 clean:
 	$(MAKE) -C native clean

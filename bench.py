@@ -33,6 +33,7 @@ import time
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.agent import CCManagerAgent
+from tpu_cc_manager.modes import Mode
 from tpu_cc_manager.config import AgentConfig
 from tpu_cc_manager.device.fake import fake_backend
 from tpu_cc_manager.k8s.apiserver import FakeApiServer
@@ -362,7 +363,7 @@ def _run_pool_convergence(names, readiness_dir, prefix, *,
             flip(store, server, names)
         else:
             for name in names:
-                store.set_node_labels(name, {L.CC_MODE_LABEL: "on"})
+                store.set_node_labels(name, {L.CC_MODE_LABEL: Mode.ON.value})
         convergence = _wait_pool(store, names, "on")
         if convergence is None:
             print(f"FATAL: {prefix} pool never converged", file=sys.stderr)

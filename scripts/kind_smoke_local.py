@@ -37,6 +37,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tpu_cc_manager import labels as L  # noqa: E402
+from tpu_cc_manager.modes import Mode  # noqa: E402
 from tpu_cc_manager.k8s.apiserver import FakeApiServer  # noqa: E402
 from tpu_cc_manager.k8s.objects import make_node  # noqa: E402
 
@@ -252,7 +253,7 @@ def main():
             # 6. round-3 enforcement surface: a good reconcile leaves a
             # verifiable evidence annotation, no leftover flip taint,
             # and mode-encoding device-node permissions
-            store.set_node_labels(NODE, {L.CC_MODE_LABEL: "on"})
+            store.set_node_labels(NODE, {L.CC_MODE_LABEL: Mode.ON.value})
             if not wait_state(store, "on"):
                 failures.append("final reconcile to on")
             import stat as _stat
@@ -573,7 +574,10 @@ def main():
                 state_dir=os.path.join(scratch, "tpm"),
             )._read_state()
             honest = measured_mode(tpm_events)
-            forged_mode = "on" if honest != "on" else "devtools"
+            forged_mode = (
+                Mode.ON.value if honest != Mode.ON.value
+                else Mode.DEVTOOLS.value
+            )
             for chip in be.find_tpus()[0]:
                 be.store.stage(chip.path, "cc", forged_mode)
                 be.store.commit(chip.path)

@@ -1,0 +1,871 @@
+"""ccaudit v2 — the flow-sensitive protocol rule families.
+
+Same fixture idiom as test_analysis.py: inline snippets through
+``analyze_source`` for the per-module rules, hand-built ``Module`` pairs
+through ``analyze_modules`` for the cross-module liveness pass, and a
+tmp-dir manifest tree for the code↔manifest drift pass (the ABBA-style
+fixture: a key the code does not export must fail, both through the
+library entry point and through the CLI gate itself).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.analysis import (
+    analyze_source,
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tpu_cc_manager.analysis.core import Module, analyze_modules
+from tpu_cc_manager.analysis.manifests import (
+    MANIFEST_GLOBS,
+    code_protocol_keys,
+    manifest_findings,
+)
+from tpu_cc_manager.modes import VALID_MODES
+
+
+def run(src: str, relpath: str = "tpu_cc_manager/snippet.py"):
+    return analyze_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------- protocol-literal
+
+
+def test_raw_failed_into_state_label_flagged():
+    (f,) = run(
+        """
+        class A:
+            def bad(self):
+                self._set_state_label("failed")
+        """
+    )
+    assert f.rule == "protocol-literal"
+    assert "'failed'" in f.message
+
+
+def test_state_failed_constant_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import STATE_FAILED
+
+        class A:
+            def good(self):
+                self._set_state_label(STATE_FAILED)
+        """
+    ) == []
+
+
+def test_mode_value_constant_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def good(kube, node):
+            set_cc_mode_state_label(kube, node, Mode.ON.value)
+        """
+    ) == []
+
+
+def test_raw_literal_through_local_assignment_flagged():
+    (f,) = run(
+        """
+        def bad(kube, node):
+            value = "failed"
+            set_cc_mode_state_label(kube, node, value)
+        """
+    )
+    assert f.rule == "protocol-literal"
+    assert f.line == 4
+
+
+def test_constant_through_local_assignment_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import STATE_FAILED
+
+        def good(kube, node):
+            value = STATE_FAILED
+            set_cc_mode_state_label(kube, node, value)
+        """
+    ) == []
+
+
+def test_unknowable_value_passes():
+    # the rules only fire on what they can prove — a parameter is UNKNOWN
+    assert run(
+        """
+        def publish(kube, node, value):
+            set_cc_mode_state_label(kube, node, value)
+        """
+    ) == []
+
+
+def test_one_hop_call_summary_flags_raw_argument():
+    # publish()'s parameter flows into the sink; the raw literal at the
+    # same-module call site is one interprocedural hop away
+    (f,) = run(
+        """
+        class A:
+            def publish(self, value):
+                set_cc_mode_state_label(self.kube, self.node, value)
+
+            def bad(self):
+                self.publish("failed")
+        """
+    )
+    assert f.rule == "protocol-literal"
+    assert "publish" in f.message
+
+
+def test_one_hop_call_summary_constant_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import STATE_FAILED
+
+        class A:
+            def publish(self, value):
+                set_cc_mode_state_label(self.kube, self.node, value)
+
+            def good(self):
+                self.publish(STATE_FAILED)
+        """
+    ) == []
+
+
+def test_raw_mode_in_label_dict_value_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager import labels as L
+
+        def bad(kube, node):
+            kube.set_node_labels(node, {L.CC_MODE_LABEL: "on"})
+        """
+    )
+    assert f.rule == "protocol-literal"
+
+
+def test_mode_constant_in_label_dict_value_passes():
+    assert run(
+        """
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.modes import Mode
+
+        def good(kube, node):
+            kube.set_node_labels(node, {L.CC_MODE_LABEL: Mode.ON.value})
+        """
+    ) == []
+
+
+def test_flowed_raw_key_in_label_dict_flagged():
+    # a raw key LITERAL is label-literal's finding; a key that FLOWED
+    # through a local is the dataflow rule's
+    findings = run(
+        """
+        def bad(kube, node, v):
+            key = "tpu.google.com/cc.mode"
+            kube.set_node_labels(node, {key: v})
+        """
+    )
+    assert rules_of(findings) == ["label-literal", "protocol-literal"]
+
+
+def test_branch_join_keeps_raw_from_either_branch():
+    # a clean else-branch must not launder the legacy branch's literal:
+    # branches are joined worst-class-wins
+    (f,) = run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def bad(kube, node, legacy):
+            if legacy:
+                mode = "on"
+            else:
+                mode = Mode.ON.value
+            set_cc_mode_state_label(kube, node, mode)
+        """
+    )
+    assert f.rule == "protocol-literal"
+
+
+def test_branch_join_both_branches_clean_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode, STATE_FAILED
+
+        def good(kube, node, ok):
+            if ok:
+                mode = Mode.ON.value
+            else:
+                mode = STATE_FAILED
+            set_cc_mode_state_label(kube, node, mode)
+        """
+    ) == []
+
+
+def test_branch_join_keeps_taint_from_either_branch():
+    (f,) = run(
+        """
+        import subprocess
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.modes import parse_mode
+
+        def bad(node, cond, x):
+            if cond:
+                mode = node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+            else:
+                mode = parse_mode(x)
+            subprocess.run(["cc-tool", mode])
+        """
+    )
+    assert f.rule == "unvalidated-mode"
+
+
+def test_protocol_literal_pragma_suppresses():
+    assert run(
+        """
+        class A:
+            def deliberate(self):
+                self._set_state_label("failed")  # ccaudit: allow-protocol-literal(failure-injection fixture)
+        """
+    ) == []
+
+
+def test_non_protocol_string_at_sink_passes():
+    assert run(
+        """
+        def good(kube, node):
+            set_cc_mode_state_label(kube, node, "true")
+        """
+    ) == []
+
+
+# ------------------------------------------------------- unvalidated-mode
+
+
+def test_label_read_into_subprocess_flagged():
+    (f,) = run(
+        """
+        import subprocess
+        from tpu_cc_manager import labels as L
+
+        def bad(node):
+            mode = node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+            subprocess.run(["cc-tool", mode])
+        """
+    )
+    assert f.rule == "unvalidated-mode"
+    assert "parse_mode" in f.message
+
+
+def test_label_read_into_device_call_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager import labels as L
+
+        def bad(node, dev):
+            mode = node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+            dev.set_cc_mode(mode)
+        """
+    )
+    assert f.rule == "unvalidated-mode"
+
+
+def test_parse_mode_sanitizes():
+    assert run(
+        """
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.modes import parse_mode
+
+        def good(node, dev):
+            mode = parse_mode(node["metadata"]["labels"].get(L.CC_MODE_LABEL))
+            dev.set_cc_mode(mode.value)
+        """
+    ) == []
+
+
+def test_reassignment_through_parse_mode_sanitizes():
+    assert run(
+        """
+        import subprocess
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.modes import parse_mode
+
+        def good(node):
+            mode = node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+            mode = parse_mode(mode)
+            subprocess.run(["cc-tool", str(mode)])
+        """
+    ) == []
+
+
+def test_tuple_reassignment_invalidates_stale_taint():
+    # `mode, ok = ...` rebinds mode through a tuple target: the stale
+    # TAINTED classification must not survive the rebinding
+    assert run(
+        """
+        import subprocess
+        from tpu_cc_manager import labels as L
+        from tpu_cc_manager.modes import parse_mode
+
+        def good(node):
+            mode = node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+            mode, ok = str(parse_mode(mode).value), True
+            subprocess.run(["cc-tool", mode])
+        """
+    ) == []
+
+
+def test_tainted_with_raw_default_still_tainted():
+    # `labels.get(K) or "off"` carries BOTH facts: the raw fallback must
+    # not launder the taint past a subprocess sink
+    (f,) = run(
+        """
+        import subprocess
+        from tpu_cc_manager import labels as L
+
+        def bad(node):
+            v = node["metadata"]["labels"].get(L.CC_MODE_LABEL) or "off"
+            subprocess.run(["cc-tool", v])
+        """
+    )
+    assert f.rule == "unvalidated-mode"
+
+
+def test_explicit_self_call_maps_args_unshifted():
+    # `A.publish(a, "failed")` passes self explicitly: the one-hop
+    # summary must still line the literal up with the sink parameter
+    findings = run(
+        """
+        class A:
+            def publish(self, value):
+                set_cc_mode_state_label(self.kube, self.node, value)
+
+        def bad(a):
+            A.publish(a, "failed")
+        """
+    )
+    assert "protocol-literal" in rules_of(findings)
+
+
+def test_non_label_value_into_subprocess_passes():
+    assert run(
+        """
+        import subprocess
+
+        def good(tool):
+            subprocess.run([tool, "--version"])
+        """
+    ) == []
+
+
+def test_unvalidated_mode_pragma_suppresses():
+    assert run(
+        """
+        import subprocess
+        from tpu_cc_manager import labels as L
+
+        def deliberate(node):
+            mode = node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+            subprocess.run(["echo", mode])  # ccaudit: allow-unvalidated-mode(diagnostic echo only)
+        """
+    ) == []
+
+
+# ------------------------------------------------------- mode-exhaustive
+
+
+def test_partial_if_elif_dispatch_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def dispatch(mode):
+            if mode is Mode.ON:
+                return 1
+            elif mode is Mode.OFF:
+                return 2
+            elif mode is Mode.DEVTOOLS:
+                return 3
+        """
+    )
+    assert f.rule == "mode-exhaustive"
+    assert "Mode.ICI" in f.message
+
+
+def test_full_if_elif_dispatch_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def dispatch(mode):
+            if mode is Mode.ON:
+                return 1
+            elif mode is Mode.OFF:
+                return 2
+            elif mode is Mode.DEVTOOLS:
+                return 3
+            elif mode is Mode.ICI:
+                return 4
+        """
+    ) == []
+
+
+def test_partial_dispatch_with_raising_else_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def dispatch(mode):
+            if mode is Mode.ON:
+                return 1
+            elif mode is Mode.OFF:
+                return 2
+            else:
+                raise ValueError(f"unhandled mode {mode}")
+        """
+    ) == []
+
+
+def test_partial_dispatch_with_silent_else_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def dispatch(mode):
+            if mode is Mode.ON:
+                return 1
+            elif mode is Mode.OFF:
+                return 2
+            else:
+                return 0
+        """
+    )
+    assert f.rule == "mode-exhaustive"
+
+
+def test_membership_test_counts_all_members():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def dispatch(mode):
+            if mode in (Mode.ON, Mode.DEVTOOLS):
+                return 1
+            elif mode in (Mode.OFF, Mode.ICI):
+                return 2
+        """
+    ) == []
+
+
+def test_single_guard_is_not_a_dispatch():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def guard(mode):
+            if mode is Mode.OFF:
+                return
+            arm(mode)
+        """
+    ) == []
+
+
+def test_partial_dict_dispatch_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        HANDLERS = {Mode.ON: 1, Mode.OFF: 2, Mode.DEVTOOLS: 3}
+        """
+    )
+    assert f.rule == "mode-exhaustive"
+    assert "Mode.ICI" in f.message
+
+
+def test_full_dict_dispatch_passes():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        HANDLERS = {Mode.ON: 1, Mode.OFF: 2, Mode.DEVTOOLS: 3, Mode.ICI: 4}
+        """
+    ) == []
+
+
+def test_single_mode_key_dict_is_not_a_dispatch():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        DEFAULT = {Mode.OFF: 0o666}
+        """
+    ) == []
+
+
+def test_mode_exhaustive_pragma_suppresses():
+    assert run(
+        """
+        from tpu_cc_manager.modes import Mode
+
+        def dispatch(mode):
+            # ccaudit: allow-mode-exhaustive(ici handled by the caller)
+            if mode is Mode.ON:
+                return 1
+            elif mode is Mode.OFF:
+                return 2
+        """
+    ) == []
+
+
+# ----------------------------------------------------- protocol-liveness
+
+
+_LABELS_FIXTURE = (
+    'X_LABEL = "tpu.google' + '.com/cc.x"\n'
+)
+
+
+def _liveness(user_src: str):
+    mods = [
+        Module("tpu_cc_manager/labels.py", _LABELS_FIXTURE),
+        Module("tpu_cc_manager/user.py", textwrap.dedent(user_src)),
+    ]
+    return [f for f in analyze_modules(mods) if f.rule == "protocol-liveness"]
+
+
+def test_liveness_written_and_read_passes():
+    assert _liveness(
+        """
+        from tpu_cc_manager import labels as L
+
+        def write(kube, node, v):
+            kube.set_node_labels(node, {L.X_LABEL: v})
+
+        def read(node):
+            return node["metadata"]["labels"].get(L.X_LABEL)
+        """
+    ) == []
+
+
+def test_liveness_dead_constant_flagged():
+    (f,) = _liveness("import tpu_cc_manager.labels\n")
+    assert f.rule == "protocol-liveness"
+    assert f.file == "tpu_cc_manager/labels.py"
+    assert "no reader or writer" in f.message
+
+
+def test_liveness_read_only_flagged():
+    (f,) = _liveness(
+        """
+        from tpu_cc_manager import labels as L
+
+        def read(node):
+            return node["metadata"]["labels"].get(L.X_LABEL)
+        """
+    )
+    assert "never written" in f.message
+
+
+def test_liveness_write_only_flagged():
+    (f,) = _liveness(
+        """
+        from tpu_cc_manager import labels as L
+
+        def write(kube, node, v):
+            kube.set_node_labels(node, {L.X_LABEL: v})
+        """
+    )
+    assert "never read" in f.message
+
+
+def test_liveness_subscript_store_counts_as_write():
+    assert _liveness(
+        """
+        from tpu_cc_manager import labels as L
+
+        def write(ann, v):
+            ann[L.X_LABEL] = v
+
+        def read(ann):
+            return ann[L.X_LABEL]
+        """
+    ) == []
+
+
+def test_liveness_other_context_counts_as_both():
+    # a constant handed to a helper could be either side — never flagged
+    assert _liveness(
+        """
+        from tpu_cc_manager import labels as L
+
+        def selector():
+            return make_selector(L.X_LABEL)
+        """
+    ) == []
+
+
+def test_liveness_pragma_on_declaration_suppresses():
+    mods = [
+        Module(
+            "tpu_cc_manager/labels.py",
+            'X_LABEL = "tpu.google' + '.com/cc.x"  '
+            "# ccaudit: allow-protocol-liveness(GKE writes it)\n",
+        ),
+        Module("tpu_cc_manager/user.py", "import tpu_cc_manager.labels\n"),
+    ]
+    assert [
+        f for f in analyze_modules(mods) if f.rule == "protocol-liveness"
+    ] == []
+
+
+def test_liveness_skipped_without_other_modules():
+    assert analyze_modules(
+        [Module("tpu_cc_manager/labels.py", _LABELS_FIXTURE)]
+    ) == []
+
+
+# ------------------------------------------------------- manifest-drift
+
+
+def _write(root, rel, content):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(textwrap.dedent(content))
+    return path
+
+
+def _manifest_tree(root, daemonset_key=None, scenario_mode="on",
+                   crd_enum=None):
+    """A minimal tree satisfying every MANIFEST_GLOBS pattern."""
+    key = daemonset_key or L.CC_MODE_LABEL
+    enum = list(VALID_MODES) if crd_enum is None else crd_enum
+    # 24 spaces: _write() dedents the surrounding doc by 8, leaving these
+    # nested under `enum:` at 16
+    enum_yaml = "".join(f"{' ' * 24}- '{v}'\n" for v in enum)
+    _write(root, "deployments/kustomize/resources.yaml", f"""\
+        apiVersion: apps/v1
+        kind: DaemonSet
+        spec:
+          template:
+            spec:
+              tolerations:
+              - key: {key}
+                operator: Exists
+        """)
+    _write(root, "deployments/manifests/crd.yaml", f"""\
+        apiVersion: apiextensions.k8s.io/v1
+        kind: CustomResourceDefinition
+        spec:
+          group: tpu.google{'.'}com
+          versions:
+          - name: v1alpha1
+            schema:
+              openAPIV3Schema:
+                properties:
+                  spec:
+                    properties:
+                      mode:
+                        type: string
+                        enum:
+{enum_yaml}""")
+    _write(root, "scenarios/smoke.json", json.dumps({
+        "name": "smoke", "nodes": 4, "initial_mode": "off",
+        "actions": [{"action": "set_mode", "at": 0.1,
+                     "mode": scenario_mode}],
+        "converge": {"mode": scenario_mode, "timeout_s": 60},
+    }, indent=2))
+
+
+def test_clean_manifest_tree_passes(tmp_path):
+    _manifest_tree(str(tmp_path))
+    assert manifest_findings(str(tmp_path)) == []
+
+
+def test_unknown_protocol_key_flagged(tmp_path):
+    # THE drift fixture: a key the code does not export fails the gate
+    _manifest_tree(
+        str(tmp_path),
+        daemonset_key="tpu.google" + ".com/does-not-exist",
+    )
+    (f,) = manifest_findings(str(tmp_path))
+    assert f.rule == "manifest-drift"
+    assert "does-not-exist" in f.message
+    assert f.file == "deployments/kustomize/resources.yaml"
+
+
+def test_renamed_code_constant_orphans_manifest_key(tmp_path):
+    # the other drift direction: labels.py loses/renames a constant and
+    # the manifest key it used to export goes stale
+    _manifest_tree(str(tmp_path))
+    findings = manifest_findings(
+        str(tmp_path),
+        known_keys=code_protocol_keys() - {L.CC_MODE_LABEL},
+    )
+    assert [f.rule for f in findings] == ["manifest-drift"]
+
+
+def test_unknown_scenario_mode_flagged(tmp_path):
+    _manifest_tree(str(tmp_path), scenario_mode="onn")
+    findings = manifest_findings(str(tmp_path))
+    assert findings and all(f.rule == "manifest-drift" for f in findings)
+    assert any("'onn'" in f.message for f in findings)
+    assert all(f.file == "scenarios/smoke.json" for f in findings)
+
+
+def test_crd_enum_missing_mode_flagged(tmp_path):
+    enum = [m for m in VALID_MODES if m != "ici"]
+    _manifest_tree(str(tmp_path), crd_enum=enum)
+    (f,) = manifest_findings(str(tmp_path))
+    assert f.rule == "manifest-drift"
+    assert "missing 'ici'" in f.message
+
+
+def test_crd_enum_extra_mode_flagged(tmp_path):
+    _manifest_tree(str(tmp_path), crd_enum=list(VALID_MODES) + ["bogus"])
+    (f,) = manifest_findings(str(tmp_path))
+    assert "'bogus'" in f.message
+
+
+def test_yaml_pragma_suppresses(tmp_path):
+    _manifest_tree(str(tmp_path))
+    _write(str(tmp_path), "deployments/manifests/extra.yaml", """\
+        metadata:
+          annotations:
+            # ccaudit: allow-manifest-drift(legacy key kept for the v0 fleet)
+            legacy: tpu.google""" + """.com/retired-key
+        """)
+    assert manifest_findings(str(tmp_path)) == []
+
+
+def test_multi_doc_enums_anchor_successively(tmp_path):
+    # two CRD docs in one file, second enum is the broken one: its
+    # finding must anchor past the first doc's enum line so the pragma
+    # and baseline point at the real defect site
+    _manifest_tree(str(tmp_path))
+    good = "".join(f"{' ' * 12}- '{v}'\n" for v in VALID_MODES)
+    bad = "".join(
+        f"{' ' * 12}- '{v}'\n" for v in VALID_MODES if v != "ici"
+    )
+    _write(str(tmp_path), "deployments/manifests/two-crds.yaml", f"""\
+        kind: CustomResourceDefinition
+        properties:
+          mode:
+            type: string
+            enum:
+{good}        ---
+        kind: CustomResourceDefinition
+        properties:
+          mode:
+            type: string
+            enum:
+{bad}""")
+    (f,) = manifest_findings(str(tmp_path))
+    assert "missing 'ici'" in f.message
+    first_enum = 5  # line of the first doc's `enum:` in the fixture
+    assert f.line > first_enum
+
+
+def test_unparseable_manifest_yaml_is_a_finding(tmp_path):
+    # a manifest the cluster would reject silently disables the enum
+    # cross-check unless the parse failure itself is drift
+    _manifest_tree(str(tmp_path))
+    _write(str(tmp_path), "deployments/manifests/broken.yaml", """\
+        kind: Deployment
+          badly: indented
+        """)
+    (f,) = manifest_findings(str(tmp_path))
+    assert f.rule == "manifest-drift"
+    assert "unparseable manifest YAML" in f.message
+    assert f.file == "deployments/manifests/broken.yaml"
+
+
+def test_empty_glob_fails_loud(tmp_path):
+    _manifest_tree(str(tmp_path))
+    os.remove(os.path.join(str(tmp_path), "scenarios/smoke.json"))
+    with pytest.raises(FileNotFoundError):
+        manifest_findings(str(tmp_path))
+
+
+def test_real_repo_manifest_tree_is_clean():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert manifest_findings(repo) == []
+
+
+def test_manifest_globs_cover_deploy_and_scenarios():
+    assert any("kustomize" in g for g in MANIFEST_GLOBS)
+    assert any(g.startswith("scenarios/") for g in MANIFEST_GLOBS)
+
+
+# --------------------------------------------- CLI + baseline integration
+
+
+def test_cli_gates_manifest_drift(tmp_path):
+    """Acceptance fixture: the ccaudit CLI itself exits nonzero when a
+    deployments/ key has no labels.py counterpart."""
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "ok.py").write_text("x = 1\n")
+    _manifest_tree(
+        str(root), daemonset_key="tpu.google" + ".com/drifted-key"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "--manifests", "pkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "[manifest-drift]" in proc.stdout
+    assert "drifted-key" in proc.stdout
+
+    # and the same tree passes once the key speaks the real protocol
+    _manifest_tree(str(root))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "--manifests", "pkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+
+
+def test_cli_no_manifests_skips_the_pass(tmp_path):
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "ok.py").write_text("x = 1\n")
+    # no manifest tree at all: only --no-manifests can pass here with
+    # default-surface semantics forced off
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis",
+         "--root", str(root), "--no-manifests", "pkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+
+
+def test_protocol_finding_flows_through_baseline(tmp_path):
+    findings = run(
+        """
+        class A:
+            def bad(self):
+                self._set_state_label("failed")
+        """
+    )
+    assert rules_of(findings) == ["protocol-literal"]
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    new, suppressed, stale = diff_against_baseline(
+        findings, load_baseline(path)
+    )
+    assert new == [] and stale == [] and len(suppressed) == 1

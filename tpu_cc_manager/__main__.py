@@ -300,7 +300,7 @@ def main(argv=None) -> int:
         from tpu_cc_manager.drain import (
             build_reconcile_event, post_event_best_effort,
         )
-        from tpu_cc_manager.modes import InvalidModeError
+        from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 
         kube = _kube_client(cfg)
         from tpu_cc_manager.drain import NodeFlipTaint
@@ -370,7 +370,7 @@ def main(argv=None) -> int:
             # clean rejection (CCModeInvalid), not a flip failure
             log.error("rejecting desired mode: %s", e)
             try:
-                set_cc_mode_state_label(kube, cfg.node_name, "failed")
+                set_cc_mode_state_label(kube, cfg.node_name, STATE_FAILED)
             except Exception as pub_err:
                 log.error(
                     "could not publish cc.mode.state=failed: %s", pub_err
@@ -386,7 +386,7 @@ def main(argv=None) -> int:
             # one-shot: there is no mailbox holding a newer mode.)
             log.error("slice coordination aborted: %s", e)
             try:
-                set_cc_mode_state_label(kube, cfg.node_name, "failed")
+                set_cc_mode_state_label(kube, cfg.node_name, STATE_FAILED)
             except Exception as pub_err:
                 log.error(
                     "could not publish cc.mode.state=failed: %s", pub_err
@@ -404,7 +404,7 @@ def main(argv=None) -> int:
             # itself may be what failed.
             log.exception("set-cc-mode failed unexpectedly")
             try:
-                set_cc_mode_state_label(kube, cfg.node_name, "failed")
+                set_cc_mode_state_label(kube, cfg.node_name, STATE_FAILED)
             except Exception as pub_err:
                 log.error(
                     "could not publish cc.mode.state=failed: %s", pub_err

@@ -32,7 +32,7 @@ from tpu_cc_manager.drain import (
 )
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
 from tpu_cc_manager.k8s.client import KubeClient
-from tpu_cc_manager.modes import InvalidModeError
+from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 from tpu_cc_manager.slice_coord import SliceAbortError
 from tpu_cc_manager.obs import HealthServer, Metrics, create_readiness_file
 from tpu_cc_manager.trace import JsonlSink, Tracer, get_tracer
@@ -442,7 +442,7 @@ class CCManagerAgent:
                 # fix it)
                 log.error("rejecting desired mode: %s", e)
                 try:
-                    self._set_state_label("failed")
+                    self._set_state_label(STATE_FAILED)
                 except Exception:
                     log.exception("failed to publish failed state")
                 outcome = "invalid"
@@ -463,7 +463,7 @@ class CCManagerAgent:
                     outcome = "superseded"
                     return False
                 try:
-                    self._set_state_label("failed")
+                    self._set_state_label(STATE_FAILED)
                 except Exception:
                     log.exception("failed to publish failed state")
                 outcome = "slice_abort"
@@ -474,7 +474,7 @@ class CCManagerAgent:
             except Exception:
                 log.exception("reconcile crashed")
                 try:
-                    self._set_state_label("failed")
+                    self._set_state_label(STATE_FAILED)
                 except Exception:
                     log.exception("failed to publish failed state")
                 return False

@@ -35,6 +35,30 @@ ccaudit is that walk. The rules (docs/analysis.md has the full contract):
     expositions under one name would corrupt aggregation — obs.py's
     ``kube_throttle_wait_histogram`` docstring is the founding charter).
 
+v2 grew the lexical walker into a flow-sensitive protocol analyzer
+(``dataflow.py`` is the reusable core, ``manifests.py`` the non-AST
+pass — docs/analysis.md §v2):
+
+``protocol-literal``
+    Raw mode/state strings (``"on"``/``"off"``/``"devtools"``/``"ici"``/
+    ``"failed"``) flowing into label/annotation write APIs must come from
+    ``modes.py``/``labels.py`` constants — tracked through local
+    assignment and one-hop same-module call summaries.
+``unvalidated-mode``
+    A mode-label value read off a k8s object dict must pass through
+    ``parse_mode`` before reaching engine/subprocess/device-call sinks.
+``mode-exhaustive``
+    ``if``/``elif`` chains and dict dispatches over ``Mode`` must cover
+    every member or end in an else that raises.
+``protocol-liveness``
+    Every key-shaped constant ``labels.py`` exports needs at least one
+    writer and one reader across the tree (externally-written keys are
+    pragma-annotated).
+``manifest-drift``
+    ``deployments/**`` and ``scenarios/*.json`` must speak exactly the
+    protocol ``labels.py``/``modes.py`` export — unknown keys, unknown
+    modes, and a CRD mode enum differing from ``VALID_MODES`` all fail.
+
 Findings are gated against ``analysis/baseline.json`` so CI fails only on
 *new* findings; stale baseline entries (the code they suppressed moved or
 was fixed) also fail, so the baseline can only burn down.
@@ -62,4 +86,10 @@ RULES = (
     "label-literal",
     "swallow",
     "metric-name",
+    # v2 — the flow-sensitive protocol families
+    "protocol-literal",
+    "unvalidated-mode",
+    "mode-exhaustive",
+    "protocol-liveness",
+    "manifest-drift",
 )

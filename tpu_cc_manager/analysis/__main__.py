@@ -11,7 +11,7 @@ import argparse
 import json
 import os
 import sys
-from typing import List
+from typing import List, Optional
 
 from tpu_cc_manager.analysis import baseline as baseline_mod
 from tpu_cc_manager.analysis.core import (
@@ -21,12 +21,14 @@ from tpu_cc_manager.analysis.core import (
 )
 
 
-def main(argv: List[str] = None) -> int:
+def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_cc_manager.analysis",
-        description="ccaudit: AST-based invariant analyzer "
+        description="ccaudit: AST + dataflow invariant analyzer "
         "(lock discipline, blocking-under-lock, label hygiene, "
-        "exception discipline, metric-name consistency). "
+        "exception discipline, metric-name consistency, protocol-literal "
+        "confinement, unvalidated-mode taint, Mode exhaustiveness, "
+        "protocol liveness, code<->manifest drift). "
         "docs/analysis.md has the rule contract.",
     )
     parser.add_argument(
@@ -54,7 +56,24 @@ def main(argv: List[str] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="emit findings as JSON instead of text",
     )
+    group = parser.add_mutually_exclusive_group()
+    group.add_argument(
+        "--manifests", action="store_true",
+        help="force the code<->manifest cross-check even with explicit "
+        "targets",
+    )
+    group.add_argument(
+        "--no-manifests", action="store_true",
+        help="skip the code<->manifest cross-check (it runs by default "
+        "on the default scan surface)",
+    )
     args = parser.parse_args(argv)
+
+    with_manifests: Optional[bool] = None
+    if args.manifests:
+        with_manifests = True
+    elif args.no_manifests:
+        with_manifests = False
 
     root = os.path.abspath(args.root) if args.root else repo_root()
     baseline_path = args.baseline or os.path.join(
@@ -62,7 +81,7 @@ def main(argv: List[str] = None) -> int:
     )
 
     try:
-        findings = analyze_paths(root, args.targets)
+        findings = analyze_paths(root, args.targets, with_manifests)
     except FileNotFoundError as e:
         print(f"ccaudit: {e}", file=sys.stderr)
         return 2

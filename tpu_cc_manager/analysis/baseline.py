@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import os
 from collections import Counter
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from tpu_cc_manager.analysis.core import Finding, repo_root
 
@@ -29,7 +29,7 @@ BASELINE_PATH = "tpu_cc_manager/analysis/baseline.json"
 _VERSION = 1
 
 
-def load_baseline(path: str = None) -> List[dict]:
+def load_baseline(path: Optional[str] = None) -> List[dict]:
     path = path or os.path.join(repo_root(), BASELINE_PATH)
     if not os.path.exists(path):
         return []
@@ -42,7 +42,9 @@ def load_baseline(path: str = None) -> List[dict]:
     return data.get("findings", [])
 
 
-def write_baseline(findings: Sequence[Finding], path: str = None) -> None:
+def write_baseline(
+    findings: Sequence[Finding], path: Optional[str] = None
+) -> None:
     path = path or os.path.join(repo_root(), BASELINE_PATH)
     payload = {
         "version": _VERSION,

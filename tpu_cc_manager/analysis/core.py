@@ -88,6 +88,54 @@ def _parse_pragmas(source: str) -> Dict[int, Set[str]]:
     return out
 
 
+def collect_imports(tree: ast.Module) -> Dict[str, str]:
+    """alias -> real dotted prefix for one module: ``sp`` →
+    ``subprocess``, ``sleep`` → ``time.sleep``, ``L`` →
+    ``tpu_cc_manager.labels``; ``import http.client`` binds the local
+    name ``http``. The ONE import fold every rule family shares."""
+    imports: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    imports[alias.asname] = alias.name
+                else:
+                    top = alias.name.split(".")[0]
+                    imports[top] = top
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                imports[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    return imports
+
+
+def dotted(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_dotted(expr: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Dotted path with import aliases folded in (``sp`` → ``subprocess``,
+    ``L`` → ``tpu_cc_manager.labels``) — the ONE resolution fold every
+    rule family shares, so they can never disagree on what a name means."""
+    path = dotted(expr)
+    if path is None:
+        return None
+    head, _, rest = path.partition(".")
+    real = imports.get(head)
+    if real:
+        return f"{real}.{rest}" if rest else real
+    return path
+
+
 def repo_root() -> str:
     """The repo root is two levels above this package (…/tpu_cc_manager/
     analysis/core.py); resolving from ``__file__`` keeps the CLI working
@@ -147,29 +195,43 @@ def load_module(root: str, relpath: str) -> Optional[Module]:
 def analyze_modules(modules: Sequence[Module]) -> List[Finding]:
     """Run every rule over already-parsed modules (the seam the fixture
     tests use: build Modules from inline snippets, skip the filesystem)."""
-    from tpu_cc_manager.analysis import lockgraph, rules
+    from tpu_cc_manager.analysis import dataflow, lockgraph, rules
 
     findings: List[Finding] = []
     summaries = []
     for mod in modules:
         result = rules.audit_module(mod)
         findings.extend(result.findings)
+        findings.extend(dataflow.protocol_findings(mod))
         summaries.append(result)
     findings.extend(lockgraph.order_findings(summaries))
     findings.extend(rules.metric_findings(summaries))
+    findings.extend(rules.liveness_findings(summaries))
     return sorted(findings)
 
 
 def analyze_paths(
-    root: Optional[str] = None, targets: Sequence[str] = DEFAULT_TARGETS
+    root: Optional[str] = None,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    with_manifests: Optional[bool] = None,
 ) -> List[Finding]:
+    """Full repo scan: the AST rules over ``targets`` plus — when scanning
+    the default surface (or when ``with_manifests`` forces it) — the
+    code↔manifest cross-check over the deploy/scenario trees."""
     root = root or repo_root()
+    if with_manifests is None:
+        with_manifests = tuple(targets) == DEFAULT_TARGETS
     modules = []
     for rel in iter_python_files(root, targets):
         mod = load_module(root, rel)
         if mod is not None:
             modules.append(mod)
-    return analyze_modules(modules)
+    findings = analyze_modules(modules)
+    if with_manifests:
+        from tpu_cc_manager.analysis import manifests
+
+        findings.extend(manifests.manifest_findings(root))
+    return sorted(findings)
 
 
 def analyze_source(source: str, relpath: str = "snippet.py") -> List[Finding]:

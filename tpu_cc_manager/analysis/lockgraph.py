@@ -23,19 +23,24 @@ lexical nesting of a lock known to be non-reentrant; re-entering an
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Set, Tuple
+from typing import TYPE_CHECKING, Dict, List, Sequence, Set, Tuple
 
 from tpu_cc_manager.analysis.core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover - typing only (no runtime cycle risk)
+    from tpu_cc_manager.analysis.rules import LockSite, ModuleAudit
 
 RULE = "lock-order"
 
 
-def _edges(audits) -> Dict[Tuple[str, str], "object"]:
+def _edges(
+    audits: Sequence["ModuleAudit"],
+) -> Dict[Tuple[str, str], "LockSite"]:
     """(outer_qual, inner_qual) -> evidence LockSite of the inner acquire,
     keeping the lexically-first evidence per edge for stable output."""
-    edges: Dict[Tuple[str, str], object] = {}
+    edges: Dict[Tuple[str, str], "LockSite"] = {}
 
-    def add(a: str, b: str, evidence) -> None:
+    def add(a: str, b: str, evidence: "LockSite") -> None:
         key = (a, b)
         cur = edges.get(key)
         if cur is None or (evidence.file, evidence.line) < (cur.file, cur.line):
@@ -101,13 +106,13 @@ def _sccs(nodes: Sequence[str], adj: Dict[str, Set[str]]) -> List[List[str]]:
     return out
 
 
-def order_findings(audits) -> List[Finding]:
+def order_findings(audits: Sequence["ModuleAudit"]) -> List[Finding]:
     by_relpath = {a.module.relpath: a.module for a in audits}
     edges = _edges(audits)
 
     findings: List[Finding] = []
 
-    def emit(evidence, message: str) -> None:
+    def emit(evidence: "LockSite", message: str) -> None:
         mod = by_relpath.get(evidence.file)
         if mod is not None and mod.suppressed(RULE, evidence.line):
             return

@@ -13,7 +13,20 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from tpu_cc_manager.analysis.core import Finding, Module
+from tpu_cc_manager.analysis.core import (
+    Finding,
+    Module,
+    collect_imports,
+    dotted as _dotted,
+    resolve_dotted,
+)
+from tpu_cc_manager.modes import Mode as _Mode
+
+# -- mode exhaustiveness ----------------------------------------------------
+
+#: Derived from the live enum so adding a Mode member instantly fails
+#: every dispatch that doesn't handle it.
+_MODE_MEMBERS = frozenset(_Mode.__members__)
 
 # -- lock identification ----------------------------------------------------
 
@@ -107,6 +120,10 @@ class ModuleAudit:
     )
     #: tpu_cc_* string literals used outside a declaration
     metric_uses: List[Tuple[str, str, int, str]] = field(default_factory=list)
+    #: labels.py constant references: (constant name, use context) where
+    #: context is "read" (.get/subscript/compare), "write" (dict key) or
+    #: "other" — raw material for the protocol-liveness pass
+    label_uses: List[Tuple[str, str]] = field(default_factory=list)
 
     def add(self, rule: str, node: ast.AST, message: str) -> None:
         line = getattr(node, "lineno", 1)
@@ -128,18 +145,6 @@ def _terminal_name(expr: ast.AST) -> Optional[str]:
         return expr.id
     if isinstance(expr, ast.Attribute):
         return expr.attr
-    return None
-
-
-def _dotted(expr: ast.AST) -> Optional[str]:
-    """``a.b.c`` for Name/Attribute chains, else None."""
-    parts: List[str] = []
-    while isinstance(expr, ast.Attribute):
-        parts.append(expr.attr)
-        expr = expr.value
-    if isinstance(expr, ast.Name):
-        parts.append(expr.id)
-        return ".".join(reversed(parts))
     return None
 
 
@@ -174,14 +179,16 @@ class _Walker(ast.NodeVisitor):
         #: local names known to be locks via `x = threading.Lock()` style
         #: assignment, keyed by terminal name; value: reentrant?
         self.known_locks: Dict[str, bool] = {}
-        #: import alias -> real dotted prefix (``sp`` -> ``subprocess``,
-        #: ``sleep`` -> ``time.sleep``)
-        self.imports: Dict[str, str] = {}
+        #: import alias -> real dotted prefix, pre-collected with the
+        #: package-shared fold (core.collect_imports)
+        self.imports: Dict[str, str] = collect_imports(self.module.tree)
         self.class_stack: List[str] = []
         self.func_stack: List[str] = []
         self.lock_stack: List[LockSite] = []
         #: functions with try/finally releasing lock X (terminal names)
         self._finally_released: Set[str] = set()
+        #: If nodes already consumed as an elif of an analyzed chain
+        self._elif_seen: Set[int] = set()
         self.label_exempt = self._label_exempt(self.module.relpath)
 
     @staticmethod
@@ -193,34 +200,9 @@ class _Walker(ast.NodeVisitor):
 
     # ---------------------------------------------------------- imports
 
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            if alias.asname:
-                self.imports[alias.asname] = alias.name
-            else:
-                # `import http.client` binds the local name `http`
-                top = alias.name.split(".")[0]
-                self.imports[top] = top
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module:
-            for alias in node.names:
-                self.imports[alias.asname or alias.name] = (
-                    f"{node.module}.{alias.name}"
-                )
-        self.generic_visit(node)
-
     def _resolve(self, expr: ast.AST) -> Optional[str]:
         """Dotted call path with import aliases folded in."""
-        dotted = _dotted(expr)
-        if dotted is None:
-            return None
-        head, _, rest = dotted.partition(".")
-        real = self.imports.get(head)
-        if real:
-            return f"{real}.{rest}" if rest else real
-        return dotted
+        return resolve_dotted(expr, self.imports)
 
     # ---------------------------------------------------- lock bookkeeping
 
@@ -302,7 +284,7 @@ class _Walker(ast.NodeVisitor):
 
     # ------------------------------------------------------- scope resets
 
-    def _visit_scope(self, node, name: str) -> None:
+    def _visit_scope(self, node: ast.AST, name: str) -> None:
         saved_stack, self.lock_stack = self.lock_stack, []
         saved_released = self._finally_released
         self._finally_released = self._collect_finally_releases(node)
@@ -417,6 +399,115 @@ class _Walker(ast.NodeVisitor):
                 )
         self.generic_visit(node)
 
+    # ------------------------------------------------- mode exhaustiveness
+
+    def _mode_member(self, expr: Optional[ast.AST]) -> Optional[str]:
+        """``Mode.ON`` / ``modes.Mode.ON`` / ``Mode.ON.value`` -> "ON"."""
+        if expr is None:
+            return None
+        resolved = self._resolve(expr)
+        if not resolved:
+            return None
+        if resolved.endswith(".value"):
+            resolved = resolved[: -len(".value")]
+        head, _, member = resolved.rpartition(".")
+        if member not in _MODE_MEMBERS:
+            return None
+        if head == "Mode" or head.endswith(".Mode"):
+            return member
+        return None
+
+    def _mode_compare(
+        self, test: ast.AST
+    ) -> Optional[Tuple[str, Set[str]]]:
+        """(subject, members) when ``test`` compares one expression against
+        Mode members (``x is Mode.ON``, ``x == Mode.ON``, ``x in
+        (Mode.ON, Mode.OFF)``), else None."""
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+            return None
+        op = test.ops[0]
+        left, right = test.left, test.comparators[0]
+        if isinstance(op, (ast.Eq, ast.Is)):
+            for subject, member_expr in ((left, right), (right, left)):
+                member = self._mode_member(member_expr)
+                if member is not None:
+                    key = _dotted(subject)
+                    if key is not None:
+                        return key, {member}
+            return None
+        if isinstance(op, ast.In) and isinstance(
+            right, (ast.Tuple, ast.List, ast.Set)
+        ):
+            members = {self._mode_member(e) for e in right.elts}
+            if None in members or not members:
+                return None
+            key = _dotted(left)
+            if key is None:
+                return None
+            return key, {m for m in members if m is not None}
+        return None
+
+    @staticmethod
+    def _else_raises(orelse: List[ast.stmt]) -> bool:
+        for stmt in orelse:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    return True
+        return False
+
+    def visit_If(self, node: ast.If) -> None:
+        if id(node) not in self._elif_seen:
+            tests: List[ast.AST] = []
+            cur = node
+            while True:
+                tests.append(cur.test)
+                if len(cur.orelse) == 1 and isinstance(cur.orelse[0], ast.If):
+                    cur = cur.orelse[0]
+                    self._elif_seen.add(id(cur))
+                else:
+                    break
+            parsed = [self._mode_compare(t) for t in tests]
+            # a dispatch = >= 2 branches, every test a Mode compare on one
+            # subject (single-member guards like `if mode is Mode.OFF:
+            # return` are not dispatches)
+            if len(parsed) >= 2 and all(p is not None for p in parsed):
+                subjects = {p[0] for p in parsed if p}
+                if len(subjects) == 1:
+                    covered: Set[str] = set()
+                    for p in parsed:
+                        if p:
+                            covered |= p[1]
+                    if not covered >= _MODE_MEMBERS and not self._else_raises(
+                        cur.orelse
+                    ):
+                        missing = ", ".join(
+                            f"Mode.{m}" for m in sorted(_MODE_MEMBERS - covered)
+                        )
+                        self.audit.add(
+                            "mode-exhaustive", node,
+                            f"if/elif dispatch over Mode does not handle "
+                            f"{missing} and has no else that raises — a new "
+                            "mode member must fail loudly, not fall through",
+                        )
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        members = {
+            m for m in (self._mode_member(k) for k in node.keys)
+            if m is not None
+        }
+        if len(members) >= 2 and not members >= _MODE_MEMBERS:
+            missing = ", ".join(
+                f"Mode.{m}" for m in sorted(_MODE_MEMBERS - members)
+            )
+            self.audit.add(
+                "mode-exhaustive", node,
+                f"dict dispatch keyed on Mode does not handle {missing} — "
+                "cover every member (a lookup miss on a new mode is a "
+                "silent KeyError/None at fleet scale)",
+            )
+        self.generic_visit(node)
+
     # ------------------------------------------------------------ except
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -501,8 +592,155 @@ class _Walker(ast.NodeVisitor):
 
 def audit_module(module: Module) -> ModuleAudit:
     audit = ModuleAudit(module=module)
-    _Walker(audit).visit(module.tree)
+    walker = _Walker(audit)
+    walker.visit(module.tree)
+    _collect_label_uses(module, walker.imports, audit)
     return audit
+
+
+# ----------------------------------------------------- protocol liveness
+
+#: Built by concatenation so this module's own source doesn't trip the
+#: label-literal rule; a labels.py constant participates in the liveness
+#: pass when its value carries one of these key markers.
+_LABEL_KEY_MARKERS = ("tpu.google" + ".com/", "cloud.google" + ".com/")
+
+_LABELS_MODULE_PREFIXES = ("tpu_cc_manager.labels.", "labels.")
+
+
+def _collect_label_uses(
+    module: Module, imports: Dict[str, str], audit: ModuleAudit
+) -> None:
+    """Record every reference to a labels.py constant with its syntactic
+    role: "write" (key of a dict display — how every label/annotation
+    patch is built), "read" (.get()/subscript key, comparison operand),
+    or "other" (selector strings, defaults, iteration — counts as both)."""
+    if module.relpath.rsplit("/", 1)[-1] == "labels.py":
+        return
+
+    def const_of(expr: ast.AST) -> Optional[str]:
+        resolved = resolve_dotted(expr, imports)
+        if not resolved:
+            return None
+        for prefix in _LABELS_MODULE_PREFIXES:
+            if resolved.startswith(prefix):
+                return resolved[len(prefix):].split(".")[0]
+        return None
+
+    parents: Dict[int, ast.AST] = {}
+    for parent in ast.walk(module.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        name = const_of(node)
+        if name is None:
+            continue
+        parent = parents.get(id(node))
+        # the inner part of `L.CONST.items` — the outer node reports it
+        if isinstance(parent, ast.Attribute) and const_of(parent):
+            continue
+        ctx = "other"
+        if isinstance(parent, ast.Dict) and any(
+            k is node for k in parent.keys
+        ):
+            ctx = "write"
+        elif (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Attribute)
+            and parent.func.attr in ("get", "pop")
+            and parent.args
+            and parent.args[0] is node
+        ):
+            ctx = "read"
+        elif isinstance(parent, ast.Subscript) and parent.slice is node:
+            # `ann[CONST] = v` publishes the key; `d[CONST]` consumes it
+            ctx = "write" if isinstance(parent.ctx, ast.Store) else "read"
+        elif isinstance(parent, ast.Compare):
+            ctx = "read"
+        audit.label_uses.append((name, ctx))
+
+
+def liveness_findings(audits: Sequence[ModuleAudit]) -> List[Finding]:
+    """Cross-module protocol-liveness pass: every key-shaped constant
+    labels.py exports must have at least one writer and one reader across
+    the scanned tree — a one-sided or unused constant is dead (or
+    silently drifted) protocol surface. Constants written by an external
+    party (GKE, pod authors) carry a
+    ``# ccaudit: allow-protocol-liveness(reason)`` pragma on their
+    declaration line."""
+    labels_mod: Optional[Module] = None
+    for a in audits:
+        if a.module.relpath.rsplit("/", 1)[-1] == "labels.py":
+            labels_mod = a.module
+            break
+    # liveness is a cross-module property: with nothing but labels.py in
+    # the scan there is no evidence either way
+    if labels_mod is None or len(audits) < 2:
+        return []
+
+    consts: Dict[str, int] = {}
+    for stmt in labels_mod.tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        strings = [
+            n.value for n in ast.walk(value)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        ]
+        if not any(m in s for s in strings for m in _LABEL_KEY_MARKERS):
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                consts[tgt.id] = stmt.lineno
+
+    uses: Dict[str, Set[str]] = {}
+    for a in audits:
+        for name, ctx in a.label_uses:
+            uses.setdefault(name, set()).add(ctx)
+
+    findings: List[Finding] = []
+    for name, line in sorted(consts.items(), key=lambda kv: kv[1]):
+        if labels_mod.suppressed("protocol-liveness", line):
+            continue
+        ctxs = uses.get(name, set())
+        if not ctxs:
+            message = (
+                f"{name} has no reader or writer anywhere in the scanned "
+                "tree — dead protocol surface (delete it, or pragma why "
+                "it must stay)"
+            )
+        elif ctxs == {"read"}:
+            message = (
+                f"{name} is read but never written by this codebase — "
+                "one-sided protocol surface; if an external party writes "
+                "it, say so in a pragma"
+            )
+        elif ctxs == {"write"}:
+            message = (
+                f"{name} is written but never read by this codebase — "
+                "one-sided protocol surface; if an external party reads "
+                "it, say so in a pragma"
+            )
+        else:
+            continue
+        findings.append(
+            Finding(
+                file=labels_mod.relpath,
+                line=line,
+                rule="protocol-liveness",
+                message=message,
+                text=labels_mod.line_text(line),
+            )
+        )
+    return findings
 
 
 # ------------------------------------------------------------------ metrics
@@ -520,7 +758,7 @@ def metric_findings(audits: Sequence[ModuleAudit]) -> List[Finding]:
 
     findings: List[Finding] = []
 
-    def emit(rule, file, line, text, message):
+    def emit(rule: str, file: str, line: int, text: str, message: str) -> None:
         mod = by_relpath.get(file)
         if mod is not None and mod.suppressed(rule, line):
             return

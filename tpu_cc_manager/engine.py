@@ -196,8 +196,8 @@ class ModeEngine:
 
         # desired end state of both domains — mutual exclusion by
         # construction (reference main.py:512-583)
-        desired_cc = mode.value if mode in CC_MODES else "off"
-        desired_ici = "on" if mode is Mode.ICI else "off"
+        desired_cc = mode.value if mode in CC_MODES else Mode.OFF.value
+        desired_ici = Mode.ON.value if mode is Mode.ICI else Mode.OFF.value
 
         with self._tracer.span("enumerate"):
             devices = self._all_devices()

@@ -53,12 +53,12 @@ TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 #: GKE labels giving slice identity/topology on multi-host TPU node pools.
 #: All nodes of one multi-host slice share the same topology value and
 #: belong to one node pool; per-slice coherence keys off these.
-TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"  # ccaudit: allow-protocol-liveness(GKE-written; documented for operators — slice identity keys off TPU_SLICE_LABEL)
 TPU_SLICE_LABEL = "tpu.google.com/cc.slice"
 
 #: Slice-coordination annotations (new vs the reference — SURVEY.md §7.2
 #: step 7). See tpu_cc_manager.slice_coord for the protocol.
-SLICE_LEADER_ANNOTATION = "tpu.google.com/cc.slice.leader"
+SLICE_LEADER_ANNOTATION = "tpu.google.com/cc.slice.leader"  # ccaudit: allow-protocol-liveness(operator-facing breadcrumb: leadership is recomputed from the member list, never read back)
 SLICE_EPOCH_ANNOTATION = "tpu.google.com/cc.slice.epoch"
 SLICE_ACK_ANNOTATION = "tpu.google.com/cc.slice.ack"
 SLICE_COMMIT_ANNOTATION = "tpu.google.com/cc.slice.commit"

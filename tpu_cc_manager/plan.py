@@ -106,7 +106,7 @@ def fleet_plan(
 
     # per-slice agreement via segment min/max: a slice agrees on a value
     # iff min == max over its members
-    def seg_minmax(x):
+    def seg_minmax(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         mn = jnp.full((num_slices,), jnp.iinfo(jnp.int32).max, jnp.int32)
         mx = jnp.full((num_slices,), jnp.iinfo(jnp.int32).min, jnp.int32)
         mn = mn.at[slice_ids].min(x)
@@ -214,7 +214,7 @@ def analyze_fleet(nodes: List[dict]) -> dict:
     }
 
 
-def main(argv=None) -> int:
+def main(argv: Optional[List[str]] = None) -> int:
     """CLI: ``python -m tpu_cc_manager.plan`` — fleet report from a live
     API server (or --from-file for an offline node dump)."""
     import argparse

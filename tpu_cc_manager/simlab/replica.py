@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 from tpu_cc_manager.drain import set_cc_mode_state_label
 from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
-from tpu_cc_manager.modes import InvalidModeError
+from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 
 log = logging.getLogger("tpu-cc-manager.simlab.replica")
 
@@ -147,7 +147,7 @@ class ReplicaShell:
 
     def _publish_failed(self) -> None:
         try:
-            set_cc_mode_state_label(self.kube, self.node_name, "failed")
+            set_cc_mode_state_label(self.kube, self.node_name, STATE_FAILED)
         except Exception:
             log.warning("%s: could not publish failed state",
                         self.node_name)

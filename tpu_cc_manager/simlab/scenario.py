@@ -21,7 +21,7 @@ import dataclasses
 import json
 from typing import Dict, List
 
-from tpu_cc_manager.modes import InvalidModeError, parse_mode
+from tpu_cc_manager.modes import InvalidModeError, Mode, parse_mode
 
 #: bumped on breaking schema changes; scenarios carry it explicitly so
 #: a future reader can refuse documents it does not understand
@@ -98,7 +98,7 @@ class Scenario:
     actions: List[Action]
     pools: int = 1
     chips_per_node: int = 1
-    initial_mode: str = "off"
+    initial_mode: str = Mode.OFF.value
     workers: int = 8
     qps: float = 0.0
     evidence: bool = False
@@ -244,7 +244,7 @@ def validate_scenario(doc: dict) -> Scenario:
     watch_timeout_s = doc.get("watch_timeout_s", 10.0)
     if watch_timeout_s <= 0:
         raise ScenarioError("watch_timeout_s must be > 0")
-    initial_mode = _mode(doc.get("initial_mode", "off"), "initial_mode")
+    initial_mode = _mode(doc.get("initial_mode", Mode.OFF.value), "initial_mode")
 
     raw_ctl = doc.get("controllers", {})
     if not isinstance(raw_ctl, dict):

@@ -35,7 +35,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 log = logging.getLogger("tpu-cc-manager.trace")
 
@@ -119,7 +119,7 @@ class Tracer:
 
     # --------------------------------------------------------------- spans
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: object) -> Iterator[Span]:
         """Time a phase. Exceptions mark the span failed and propagate."""
         stack = self._stack()
         parent = stack[-1] if stack else None

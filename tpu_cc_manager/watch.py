@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
@@ -62,7 +62,9 @@ class SyncableModeConfig:
             self._has_value = True
             self._cond.notify_all()
 
-    def get(self, timeout: Optional[float] = None):
+    def get(
+        self, timeout: Optional[float] = None
+    ) -> Tuple[bool, Optional[str]]:
         """Block until the current value differs from the last one read,
         then consume it (reference cmd/main.go:68-76).
 
@@ -81,7 +83,7 @@ class SyncableModeConfig:
             self._last_read = self._current
             return True, self._current
 
-    def peek_pending(self):
+    def peek_pending(self) -> Tuple[bool, Optional[str]]:
         """Non-consuming peek: ``(True, value)`` when a newer value is
         waiting that differs from the last one consumed, else
         ``(False, None)``. Lets a long in-flight reconcile (the
