@@ -630,6 +630,77 @@ def bench_real_chip(state_dir: str):
         return {}
 
 
+def run_multichip_flip_bench(n_chips=8, reset_latency_s=0.2, concurrency=4):
+    """Parallel flip pipeline extra (ISSUE 4): the SAME 8-device node
+    flipped twice — once with the serial per-device loop
+    (flip_concurrency=1, the pre-pipeline engine exactly) and once
+    through the bounded flip executor — and the wall-clock ratio
+    reported as flip_parallel_speedup. Simulated reset latency stands in
+    for the real post-reset boot wait (the dominant cost,
+    real_chip_phase_s in BENCH_NOTES r05), which overlaps perfectly
+    across chips. Gating/holder checks are disabled: they are node-
+    filesystem concerns a latency measurement must not touch on the
+    bench host."""
+    from tpu_cc_manager.device.gate import DeviceGate
+    from tpu_cc_manager.device.holders import HolderCheck
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.trace import Tracer
+
+    def one_flip(cap):
+        backend = fake_backend(
+            n_chips=n_chips, reset_latency_s=reset_latency_s
+        )
+        # sinks fire on the flip executor's WORKER threads: the count
+        # update must be locked or concurrent span completions lose
+        # increments (same pattern as run_bench's phase_sink)
+        phase_counts: dict = {}
+        count_lock = threading.Lock()
+
+        def count_sink(s):
+            with count_lock:
+                phase_counts[s.name] = phase_counts.get(s.name, 0) + 1
+
+        tracer = Tracer()
+        tracer.add_sink(count_sink)
+        engine = ModeEngine(
+            set_state_label=lambda v: None,
+            evict_components=False,
+            backend=backend,
+            tracer=tracer,
+            gate=DeviceGate(enabled=False),
+            holder_check=HolderCheck(enabled=False),
+            flip_concurrency=cap,
+        )
+        t0 = time.monotonic()
+        ok = engine.set_mode("on")
+        elapsed = time.monotonic() - t0
+        if not ok:
+            print("FATAL: multichip flip bench flip failed", file=sys.stderr)
+            sys.exit(1)
+        # per-device attribution must survive the thread fan-out: one
+        # stage/reset/wait_ready/verify span per chip either way
+        for phase in ("stage", "reset", "wait_ready", "verify"):
+            if phase_counts.get(phase) != n_chips:
+                print(
+                    f"FATAL: multichip flip bench lost spans: {phase} x "
+                    f"{phase_counts.get(phase)} != {n_chips}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+        return elapsed
+
+    serial_s = one_flip(1)
+    parallel_s = one_flip(concurrency)
+    return {
+        "multichip_flip_serial_s": round(serial_s, 4),
+        "multichip_flip_s": round(parallel_s, 4),
+        "flip_parallel_speedup": round(serial_s / parallel_s, 2),
+        "multichip_flip_topology": (
+            f"{n_chips}x{reset_latency_s}s-reset@c{concurrency}"
+        ),
+    }
+
+
 def run_simlab_bench():
     """Fleet-scale LIVE-agent scenario (round 6, VERDICT r5 weak #4):
     256 reconciling replicas + fleet/policy controllers + scripted
@@ -718,6 +789,10 @@ def main():
         # through one controller each, QPS=50 — must sit far inside
         # the 30s scan interval
         result["extras"]["scale256"] = run_scale_bench()
+        # the parallel flip pipeline (ISSUE 4): 8 fake chips with
+        # simulated reset latency, serial loop vs bounded executor —
+        # multichip_flip_s joins the trend-gated axes
+        result["extras"].update(run_multichip_flip_bench())
         # 256 LIVE agents (round 6): the simlab scale-256 scenario —
         # convergence under scripted faults joins the gated axes
         result["extras"].update(run_simlab_bench())

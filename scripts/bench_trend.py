@@ -26,8 +26,15 @@ REGRESSION_FACTOR = 2.0
 #: mixed-era histories stay green; once both rounds carry a number,
 #: an unnoted >2x regression fails CI. real_chip_flip_s joined after
 #: the r05 4.43s jump arrived unnoticed (VERDICT r5 weak #3);
-#: pool256_convergence_s is the simlab live-fleet scenario.
-GATED_EXTRA_AXES = ("real_chip_flip_s", "pool256_convergence_s")
+#: pool256_convergence_s is the simlab live-fleet scenario;
+#: multichip_flip_s is the 8-device parallel flip pipeline wall clock
+#: (BENCH_NOTES r06) — the axis that regresses if the executor ever
+#: quietly re-serializes.
+GATED_EXTRA_AXES = (
+    "real_chip_flip_s",
+    "pool256_convergence_s",
+    "multichip_flip_s",
+)
 
 
 def _round_num(path):

@@ -372,6 +372,72 @@ def test_blocking_under_lock_pragma_suppresses():
     ) == []
 
 
+# ------------------------------------- executor waits under a held lock
+
+
+def test_future_result_under_lock_flagged():
+    # the flip-executor pattern's one forbidden shape: Future.result()
+    # blocks on a worker thread that may need the held lock — deadlock
+    (f,) = run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f(futures):
+            with lock:
+                return [fut.result() for fut in futures]
+        """
+    )
+    assert f.rule == "blocking-under-lock"
+    assert "result" in f.message
+
+
+def test_concurrent_futures_wait_under_lock_flagged():
+    findings = run(
+        """
+        import threading
+        import concurrent.futures as cf
+        from concurrent.futures import wait
+        lock = threading.Lock()
+        def f(futures):
+            with lock:
+                wait(futures)
+                cf.as_completed(futures)
+        """
+    )
+    assert rules_of(findings) == [
+        "blocking-under-lock", "blocking-under-lock"
+    ]
+
+
+def test_future_result_outside_lock_passes():
+    # the engine/flipexec shape: collect under no lock
+    assert run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f(pool, items):
+            with lock:
+                todo = list(items)
+            futures = [pool.submit(work, i) for i in todo]
+            return [fut.result() for fut in futures]
+        def work(i):
+            return i
+        """
+    ) == []
+
+
+def test_future_result_under_lock_pragma_suppresses():
+    assert run(
+        """
+        import threading
+        lock = threading.Lock()
+        def f(fut):
+            with lock:
+                return fut.result()  # ccaudit: allow-blocking-under-lock(single-worker pool, lock never shared)
+        """
+    ) == []
+
+
 # --------------------------------------------------------- label-literal
 
 

@@ -137,6 +137,53 @@ def test_sysfs_backend_ici_switch_kind(tmp_path):
     assert not switches[0].is_cc_query_supported
 
 
+def test_wait_ready_backoff_detects_fast_reset_quickly(tmp_path):
+    """Adaptive wait_ready polling (ISSUE 4 satellite): a device that
+    becomes healthy ~150ms after reset is detected well under the old
+    mandatory 0.5s sleep floor — the saving the parallel flip pipeline
+    multiplies across every chip."""
+    import threading
+    import time
+
+    sysfs, dev = make_accel_tree(tmp_path, n=1)
+    health = tmp_path / "sys_class_accel" / "accel0" / "health"
+    health.write_text("bad\n")
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev,
+                         state_dir=str(tmp_path / "st"))
+    (chip,), _ = be.find_tpus()
+
+    def heal():
+        time.sleep(0.15)
+        health.write_text("ok\n")
+
+    t = threading.Thread(target=heal)
+    t.start()
+    t0 = time.monotonic()
+    chip.wait_ready(timeout_s=5)
+    elapsed = time.monotonic() - t0
+    t.join()
+    # 0.05+0.1+0.2+... backoff lands within ~0.35s of the heal; the old
+    # fixed poll couldn't return before 0.5s
+    assert elapsed < 0.5, elapsed
+
+
+def test_wait_ready_backoff_clamps_to_deadline(tmp_path):
+    """A never-ready device times out at ~timeout_s, not at the next
+    backoff multiple past it."""
+    import time
+
+    sysfs, dev = make_accel_tree(tmp_path, n=1)
+    (tmp_path / "sys_class_accel" / "accel0" / "health").write_text("bad\n")
+    be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev,
+                         state_dir=str(tmp_path / "st"))
+    (chip,), _ = be.find_tpus()
+    t0 = time.monotonic()
+    with pytest.raises(DeviceError):
+        chip.wait_ready(timeout_s=0.3)
+    elapsed = time.monotonic() - t0
+    assert 0.25 <= elapsed < 0.8, elapsed
+
+
 def test_sysfs_chip_full_mode_cycle(tmp_path):
     sysfs, dev = make_accel_tree(tmp_path, n=1)
     be = SysfsTpuBackend(sysfs_root=sysfs, dev_root=dev, state_dir=str(tmp_path / "st"))

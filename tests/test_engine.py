@@ -146,9 +146,13 @@ def test_get_modes_reports_all_domains():
     assert modes["/dev/ici-switch0"] == {"ici": "off"}
 
 
-def test_partial_failure_aborts_node_flip():
-    # first chip flips, second fails -> whole node reports failed, and the
-    # engine stops (no attempt to continue past the failure)
+def test_partial_failure_aborts_node_flip(monkeypatch):
+    # SERIAL loop (TPU_CC_FLIP_CONCURRENCY=1): first chip flips, second
+    # fails -> whole node reports failed, and the engine stops (no
+    # attempt to continue past the failure). The parallel executor's
+    # failure semantics — in-flight siblings complete, queued items are
+    # skipped untouched — are pinned in test_engine_parallel.py.
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
     h = Harness(fake_backend(n_chips=3))
     h.backend.chips[1].fail_reset = True
     assert h.engine.set_mode("on") is False

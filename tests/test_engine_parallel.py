@@ -1,0 +1,433 @@
+"""The parallel per-device flip pipeline (ISSUE 4 tentpole).
+
+Pins the flipexec/engine contract documented in docs/engine.md:
+
+- fail-secure under concurrency: one device's verify mismatch fails the
+  whole flip, leaves THAT device at FLIP_LOCK_PERMS, lets in-flight
+  siblings finish (and re-open on their own success), and skips
+  not-yet-started items untouched;
+- the concurrency cap is honored;
+- ``TPU_CC_FLIP_CONCURRENCY=1`` is byte-identical in trace-span order to
+  the historical serial loop;
+- cross-thread span parenting: every per-device span still nests under
+  the enclosing reconcile-side span, in one trace;
+- ICI switches flip strictly after all chips, serially;
+- the mode snapshot kills the duplicate device queries (one query per
+  domain per device per reconcile).
+"""
+
+import os
+import stat
+import threading
+
+import pytest
+
+from tpu_cc_manager.device.base import DeviceError, set_backend
+from tpu_cc_manager.device.fake import FakeBackend, FakeChip, fake_backend
+from tpu_cc_manager.device.gate import DeviceGate, FLIP_LOCK_PERMS, MODE_PERMS
+from tpu_cc_manager.engine import ModeEngine
+from tpu_cc_manager.flipexec import flip_concurrency
+from tpu_cc_manager.trace import Tracer
+
+
+def _dev_file(tmp_path, name, perms=0o666):
+    p = tmp_path / name
+    p.write_text("")
+    os.chmod(p, perms)
+    return str(p)
+
+
+def _perms(path):
+    return stat.S_IMODE(os.stat(path).st_mode)
+
+
+def _engine(backend, states=None, **kw):
+    states = states if states is not None else []
+    kw.setdefault("evict_components", False)
+    kw.setdefault("gate", DeviceGate(enabled=True))
+    return ModeEngine(set_state_label=states.append, backend=backend, **kw)
+
+
+# ------------------------------------------------------------ knob parsing
+
+
+def test_flip_concurrency_default_is_min_4_plan_size(monkeypatch):
+    monkeypatch.delenv("TPU_CC_FLIP_CONCURRENCY", raising=False)
+    assert flip_concurrency(1) == 1
+    assert flip_concurrency(3) == 3
+    assert flip_concurrency(8) == 4
+    assert flip_concurrency(0) == 1  # degenerate plan still a valid cap
+
+
+def test_flip_concurrency_env_and_override(monkeypatch):
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "2")
+    assert flip_concurrency(8) == 2
+    assert flip_concurrency(8, override=6) == 6  # constructor wins
+    assert flip_concurrency(4, override=16) == 4  # clamped to plan
+
+
+def test_flip_concurrency_invalid_env_fails_loudly(monkeypatch):
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "many")
+    with pytest.raises(DeviceError):
+        flip_concurrency(4)
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "0")
+    with pytest.raises(DeviceError):
+        flip_concurrency(4)
+
+
+# ------------------------------------------------- parallel failure modes
+
+
+class SlowResetChip(FakeChip):
+    """Reset blocks until ``release`` is set — the in-flight sibling."""
+
+    def __init__(self, path, release, **kw):
+        super().__init__(path=path, **kw)
+        self._release = release
+
+    def reset(self):
+        assert self._release.wait(timeout=30), "release event never set"
+        super().reset()
+
+
+def _mirror_abort_into(monkeypatch, release):
+    """Make flipexec's abort Event mirror into ``release`` when set.
+
+    Determinism glue: the in-flight sibling (SlowResetChip) stays
+    blocked until the executor's abort flag is ACTUALLY set, so by the
+    time it completes and a worker dequeues the queued item, the skip
+    is guaranteed — no race between the failing worker's abort.set()
+    and the sibling's worker reaching the queue."""
+    import types
+
+    from tpu_cc_manager import flipexec as flipexec_mod
+
+    class MirroringEvent(threading.Event):
+        def set(self):
+            super().set()
+            release.set()
+
+    monkeypatch.setattr(
+        flipexec_mod, "threading", types.SimpleNamespace(Event=MirroringEvent)
+    )
+
+
+def test_parallel_verify_failure_is_fail_secure(tmp_path, monkeypatch):
+    """One chip verify-fails mid-parallel-flip: set_mode is False, the
+    failed chip stays locked, the completed sibling is re-gated open,
+    the queued item is skipped untouched."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "2")
+    release = threading.Event()
+    _mirror_abort_into(monkeypatch, release)
+    slow = SlowResetChip(_dev_file(tmp_path, "accel0"), release)
+    failing = FakeChip(path=_dev_file(tmp_path, "accel1"))
+    failing.drop_staged_mode = True  # set "succeeds", never takes effect
+    queued = FakeChip(path=_dev_file(tmp_path, "accel2", perms=0o644))
+    states = []
+    engine = _engine(FakeBackend(chips=[slow, failing, queued]), states)
+
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+
+    # the failing device: fail-secure, left at the flip-lock perms
+    assert _perms(failing.path) == FLIP_LOCK_PERMS
+    # the in-flight sibling ran its own sequence to completion and
+    # re-opened with the verified mode's perms
+    assert slow.resets == 1
+    assert slow.query_cc_mode() == "on"
+    assert _perms(slow.path) == MODE_PERMS["on"]
+    # the not-yet-started item was skipped untouched: no stage, no
+    # reset, gate never locked it (original perms survive)
+    assert queued.sets == 0
+    assert queued.resets == 0
+    assert _perms(queued.path) == 0o644
+
+
+def test_parallel_device_error_semantics(tmp_path, monkeypatch):
+    """Same contract when the failure is a DeviceError (reset explodes)
+    rather than a verify mismatch."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "2")
+    release = threading.Event()
+    _mirror_abort_into(monkeypatch, release)
+
+    class ExplodingResetChip(FakeChip):
+        def reset(self):
+            raise DeviceError(f"{self.path}: reset failed (injected)")
+
+    slow = SlowResetChip(_dev_file(tmp_path, "accel0"), release)
+
+    failing = ExplodingResetChip(path=_dev_file(tmp_path, "accel1"))
+    queued = FakeChip(path=_dev_file(tmp_path, "accel2"))
+    states = []
+    engine = _engine(FakeBackend(chips=[slow, failing, queued]), states)
+
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+    assert _perms(failing.path) == FLIP_LOCK_PERMS
+    assert _perms(slow.path) == MODE_PERMS["on"]
+    assert queued.sets == 0 and queued.resets == 0
+
+
+def test_parallel_unexpected_exception_still_publishes_failed(monkeypatch):
+    """A non-DeviceError from a worker propagates (after siblings
+    complete) into _drain_wrapped's unexpected-failure handler — the
+    state label still reads failed, exactly like the serial path."""
+    monkeypatch.delenv("TPU_CC_FLIP_CONCURRENCY", raising=False)
+
+    class BuggyChip(FakeChip):
+        def reset(self):
+            raise RuntimeError("not a DeviceError")
+
+    chips = [BuggyChip(path=f"/dev/accel{i}") for i in range(3)]
+    states = []
+    engine = _engine(FakeBackend(chips=chips), states,
+                     gate=DeviceGate(enabled=False))
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+
+
+# ------------------------------------------------------- cap enforcement
+
+
+class GaugedChip(FakeChip):
+    """Tracks how many resets overlap across ALL GaugedChips."""
+
+    gauge_lock = threading.Lock()
+    active = 0
+    max_active = 0
+
+    @classmethod
+    def reset_gauge(cls):
+        with cls.gauge_lock:
+            cls.active = cls.max_active = 0
+
+    def reset(self):
+        cls = GaugedChip
+        with cls.gauge_lock:
+            cls.active += 1
+            cls.max_active = max(cls.max_active, cls.active)
+        try:
+            super().reset()
+        finally:
+            with cls.gauge_lock:
+                cls.active -= 1
+
+
+def test_concurrency_cap_is_honored(monkeypatch):
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "3")
+    GaugedChip.reset_gauge()
+    chips = [
+        GaugedChip(path=f"/dev/accel{i}", reset_latency_s=0.05)
+        for i in range(8)
+    ]
+    engine = _engine(FakeBackend(chips=chips),
+                     gate=DeviceGate(enabled=False))
+    assert engine.set_mode("on") is True
+    assert all(c.resets == 1 for c in chips)
+    assert GaugedChip.max_active <= 3
+    # with 8 x 50ms resets through 3 workers, overlap must actually
+    # have happened — otherwise the "pipeline" is a serial loop
+    assert GaugedChip.max_active >= 2
+
+
+# ---------------------------------------------- serial byte-identity
+
+
+def _span_sig(tracer):
+    """(name, device-attr) per completed span, in completion order."""
+    return [
+        (s["name"], (s.get("attrs") or {}).get("device"))
+        for s in tracer.recent()
+    ]
+
+
+def test_concurrency_1_is_byte_identical_serial_span_order(monkeypatch):
+    """The exact completion order the pre-pipeline serial loop emitted,
+    device by device, in plan order."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+    tr = Tracer()
+    backend = fake_backend(n_chips=3)
+    engine = ModeEngine(
+        set_state_label=lambda v: None, evict_components=False,
+        backend=backend, tracer=tr, gate=DeviceGate(enabled=False),
+    )
+    assert engine.set_mode("on") is True
+    expected = [("enumerate", None), ("plan", None), ("taint_set", None)]
+    for i in range(3):
+        d = f"/dev/accel{i}"
+        expected += [
+            ("stage", d), ("holder_check", d), ("reset", d),
+            ("wait_ready", d), ("verify", d), ("flip", d),
+        ]
+    expected += [("taint_clear", None), ("state_label", None)]
+    assert _span_sig(tr) == expected
+
+
+# ------------------------------------------- cross-thread span parenting
+
+
+def test_parallel_spans_stay_in_one_reconcile_trace(monkeypatch):
+    """Worker-thread spans adopt the submitting thread's current span:
+    one trace, flips parented under the enclosing span, sub-phases
+    parented under their own device's flip."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "4")
+    tr = Tracer()
+    backend = fake_backend(n_chips=4, reset_latency_s=0.01)
+    engine = ModeEngine(
+        set_state_label=lambda v: None, evict_components=False,
+        backend=backend, tracer=tr, gate=DeviceGate(enabled=False),
+    )
+    with tr.span("reconcile") as root:
+        assert engine.set_mode("on") is True
+    spans = tr.recent()
+    assert all(s["trace"] == root.trace_id for s in spans)
+    flips = {s["attrs"]["device"]: s for s in spans if s["name"] == "flip"}
+    assert len(flips) == 4
+    for s in spans:
+        if s["name"] in ("stage", "holder_check", "reset", "wait_ready",
+                         "verify"):
+            # each sub-phase hangs off ITS device's flip span, not some
+            # sibling thread's
+            assert s["parent"] == flips[s["attrs"]["device"]]["span"]
+    # flip spans parent under what the submitting thread had open: the
+    # taint/evict wrapper runs directly under our reconcile span
+    for f in flips.values():
+        assert f["parent"] == root.span_id
+    # per-phase attribution intact: one span of each sub-phase per chip
+    names = [s["name"] for s in spans]
+    for phase in ("stage", "reset", "wait_ready", "verify"):
+        assert names.count(phase) == 4
+
+
+def test_parallel_spans_without_enclosing_span_are_rooted(monkeypatch):
+    """No enclosing span (one-shot CLI shape): worker spans must still
+    record without error and each flip becomes its own root."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "2")
+    tr = Tracer()
+    backend = fake_backend(n_chips=2)
+    engine = ModeEngine(
+        set_state_label=lambda v: None, evict_components=False,
+        backend=backend, tracer=tr, gate=DeviceGate(enabled=False),
+    )
+    assert engine.set_mode("on") is True
+    flips = [s for s in tr.recent() if s["name"] == "flip"]
+    assert len(flips) == 2
+    assert all(s.get("parent") is None for s in flips)
+
+
+# -------------------------------------------------- switch serialization
+
+
+def test_switches_flip_after_all_chips_and_serially(monkeypatch):
+    """ICI switches are excluded from the parallel wave: they flip only
+    after every chip landed, one at a time."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "4")
+    chips_done = []
+    order_lock = threading.Lock()
+
+    class OrderChip(FakeChip):
+        def reset(self):
+            super().reset()
+            with order_lock:
+                chips_done.append(self.path)
+
+    chips = [
+        OrderChip(path=f"/dev/accel{i}", reset_latency_s=0.01)
+        for i in range(4)
+    ]
+    switches = [
+        OrderChip(path=f"/dev/ici-switch{i}", name="ici-switch",
+                  is_switch=True, cc_capable=False)
+        for i in range(2)
+    ]
+    engine = _engine(FakeBackend(chips=chips + switches),
+                     gate=DeviceGate(enabled=False))
+    assert engine.set_mode("ici") is True
+    # every chip reset strictly precedes every switch reset
+    switch_idx = [chips_done.index(s.path) for s in switches]
+    assert min(switch_idx) >= 4
+
+
+def test_chip_failure_leaves_switches_untouched(monkeypatch, caplog):
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "4")
+    chips = [FakeChip(path=f"/dev/accel{i}") for i in range(2)]
+    chips[1].fail_reset = True
+    switch = FakeChip(path="/dev/ici-switch0", name="ici-switch",
+                      is_switch=True, cc_capable=False)
+    engine = _engine(FakeBackend(chips=chips + [switch]),
+                     gate=DeviceGate(enabled=False))
+    with caplog.at_level("WARNING", logger="tpu-cc-manager.engine"):
+        assert engine.set_mode("ici") is False
+    assert switch.sets == 0 and switch.resets == 0
+    # uniform disposition reporting: the untouched switch gets an
+    # explicit skip line, same as a queued chip would
+    assert any(
+        "/dev/ici-switch0" in r.message and "skipped" in r.message
+        for r in caplog.records
+    )
+
+
+# ----------------------------------------------- snapshot query dedup
+
+
+def test_fast_path_queries_each_domain_once(monkeypatch):
+    """Satellite: the converged-subset gate reassert reads the plan's
+    snapshot instead of re-querying — ONE cc + ONE ici query per device
+    on the idempotent fast path (it used to be two cc queries)."""
+    monkeypatch.delenv("TPU_CC_FLIP_CONCURRENCY", raising=False)
+    backend = fake_backend(n_chips=4, cc_mode="on")
+    set_backend(backend)
+    engine = ModeEngine(
+        set_state_label=lambda v: None, evict_components=False,
+        backend=backend, gate=DeviceGate(enabled=True),
+    )
+    assert engine.set_mode("on") is True
+    for c in backend.chips:
+        assert c.cc_queries == 1
+        assert c.ici_queries == 1
+
+
+def test_flip_path_has_no_pre_flip_requery(monkeypatch):
+    """A divergent device is queried once per domain at plan time; the
+    only later reads are the verify-phase query-backs."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+    backend = fake_backend(n_chips=2)
+    engine = ModeEngine(
+        set_state_label=lambda v: None, evict_components=False,
+        backend=backend, gate=DeviceGate(enabled=False),
+    )
+    assert engine.set_mode("on") is True
+    for c in backend.chips:
+        # 1 snapshot read + 1 verify query-back per domain: cc flipped
+        # (verify re-reads it), ici already at target (no verify)
+        assert c.cc_queries == 2
+        assert c.ici_queries == 1
+
+
+def test_invalid_concurrency_fails_before_drain(monkeypatch):
+    """A typo'd TPU_CC_FLIP_CONCURRENCY must fail at plan time — before
+    the taint/evict cycle churns workloads (the agent's generic handler
+    still publishes cc.mode.state=failed)."""
+    from tpu_cc_manager.engine import Drainer
+
+    class RecordingDrainer(Drainer):
+        def __init__(self):
+            self.events = []
+
+        def evict(self):
+            self.events.append("evict")
+
+        def reschedule(self):
+            self.events.append("reschedule")
+
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "four")
+    drainer = RecordingDrainer()
+    states = []
+    engine = ModeEngine(
+        set_state_label=states.append, drainer=drainer,
+        evict_components=True, backend=fake_backend(n_chips=2),
+        gate=DeviceGate(enabled=False),
+    )
+    with pytest.raises(DeviceError):
+        engine.set_mode("on")
+    assert drainer.events == []  # no evict/reschedule round trip

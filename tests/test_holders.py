@@ -164,3 +164,39 @@ def test_holder_grace_period_allows_exiting_holder(tmp_path):
         assert time.monotonic() - t0 < 10
     finally:
         p.wait()
+
+
+def test_parallel_flips_run_restart_hook_once(tmp_path):
+    """ISSUE 4 thread-safety audit: the restart hook bounces ONE shared
+    node-wide runtime. Two parallel flip workers whose devices are held
+    by the same process must trigger one restart (serialized + deduped
+    by the hook lock's re-scan), not two racing ones."""
+    dev_a = _dev_file(tmp_path, "accel0")
+    dev_b = _dev_file(tmp_path, "accel1")
+    # one "runtime" process holding BOTH chips
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         f"import time\na=open({dev_a!r}); b=open({dev_b!r})\n"
+         "print('held', flush=True)\ntime.sleep(120)"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert p.stdout.readline().strip() == "held"
+    count = tmp_path / "hook-count"
+    # SIGKILL + teardown margin: by the time the second worker's re-scan
+    # runs (it waits on the hook lock for this command to finish), the
+    # holder is verifiably gone
+    hook = f"echo x >> {count} && kill -9 {p.pid} && sleep 0.3"
+    chips = [FakeChip(path=dev_a), FakeChip(path=dev_b)]
+    engine = _engine(
+        FakeBackend(chips=chips),
+        holder_check=HolderCheck(enabled=True, restart_cmd=hook,
+                                 wait_s=10, poll_s=0.1),
+        flip_concurrency=2,
+    )
+    try:
+        assert engine.set_mode("on") is True
+    finally:
+        p.kill()
+        p.wait()
+    assert count.read_text().count("x") == 1
+    assert all(c.query_cc_mode() == "on" for c in chips)

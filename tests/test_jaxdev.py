@@ -105,6 +105,51 @@ def test_one_teardown_per_multichip_plan(jax_backend, monkeypatch):
     assert all(c.query_cc_mode() == "on" for c in chips)
 
 
+def test_parallel_flip_pays_one_teardown(jax_backend, monkeypatch):
+    # Same invariant under the EXPLICIT parallel executor: N workers
+    # racing JaxTpuChip.reset serialize on the backend's teardown lock
+    # and exactly one of them restarts the runtime.
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "4")
+    set_backend(jax_backend)
+    calls = []
+    real = JaxTpuBackend.teardown_runtime
+
+    def counting(self):
+        calls.append(1)
+        real(self)
+
+    monkeypatch.setattr(JaxTpuBackend, "teardown_runtime", counting)
+    engine = ModeEngine(set_state_label=lambda v: None,
+                        evict_components=False)
+    assert engine.set_mode("on") is True
+    assert len(calls) == 1
+    chips, _ = jax_backend.find_tpus()
+    assert all(c.query_cc_mode() == "on" for c in chips)
+
+
+def test_jax_wait_ready_backoff(jax_backend, monkeypatch):
+    # Adaptive retry (ISSUE 4 satellite): two probe failures cost
+    # ~0.15s of backoff (0.05 + 0.1), not the old 2 x 0.5s floor.
+    import time
+
+    chips, _ = jax_backend.find_tpus()
+    chip = chips[0]
+    failures = {"left": 2}
+    real_probe = JaxTpuBackend.probe_device
+
+    def flaky(self, device_id):
+        if failures["left"] > 0:
+            failures["left"] -= 1
+            raise RuntimeError("runtime still rebooting")
+        return real_probe(self, device_id)
+
+    monkeypatch.setattr(JaxTpuBackend, "probe_device", flaky)
+    t0 = time.monotonic()
+    chip.wait_ready(timeout_s=5)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.5, elapsed
+
+
 def test_statefile_reads_have_no_side_effects(tmp_path):
     import os
 

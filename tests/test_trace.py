@@ -109,7 +109,12 @@ def test_broken_sink_does_not_break_spans():
     assert tr.recent()[0]["status"] == "ok"
 
 
-def test_engine_emits_phase_spans():
+def test_engine_emits_phase_spans(monkeypatch):
+    # concurrency 1 pins the HISTORICAL serial span order exactly; the
+    # parallel pipeline's span tree (same spans, same parenting, order
+    # interleaved across worker threads) is pinned in
+    # test_engine_parallel.py
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
     tr = Tracer()
     backend = fake_backend(n_chips=2)
     engine = ModeEngine(

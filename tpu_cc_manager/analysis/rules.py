@@ -51,6 +51,10 @@ _REENTRANT_CTORS = {"RLock", "Condition"}
 #: Dotted-path prefixes that block on I/O or the clock. Matching is done
 #: on the *resolved* path (import aliases folded in), so ``from time
 #: import sleep`` and ``import subprocess as sp`` are both seen through.
+#: ``concurrent.futures.*`` joined for the flip-executor pattern: module-
+#: level waits (``futures.wait``, ``as_completed``) block on OTHER
+#: threads' progress — under a lock those threads may need, that is a
+#: deadlock, not a convoy.
 _BLOCKING_PREFIXES = (
     "time.sleep",
     "subprocess.",
@@ -59,7 +63,15 @@ _BLOCKING_PREFIXES = (
     "requests.",
     "http.client.",
     "select.",
+    "concurrent.futures.",
 )
+
+#: Method names that wait on an executor/future regardless of how the
+#: receiver was imported (``fut.result()`` has no resolvable module
+#: path). ``result`` is deliberately the only entry: ``shutdown`` and
+#: ``wait`` collide with this project's agent/server vocabulary, and a
+#: future's ``exception()`` never appears outside test code here.
+_EXECUTOR_WAIT_METHODS = frozenset({"result"})
 
 # -- label hygiene ----------------------------------------------------------
 
@@ -370,6 +382,25 @@ class _Walker(ast.NodeVisitor):
                     f"{resolved} called while holding {held.display} "
                     f"(acquired line {held.line}) — blocking inside a "
                     "critical section convoys every other waiter",
+                )
+            # executor waits: Future.result() blocks until a WORKER
+            # thread finishes — if that worker (e.g. a flip-executor
+            # task) ever needs the held lock, this is a deadlock, not a
+            # convoy. Method-name matched because a bare future has no
+            # resolvable module path.
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in _EXECUTOR_WAIT_METHODS
+            ):
+                held = self.lock_stack[-1]
+                self.audit.add(
+                    "blocking-under-lock",
+                    node,
+                    f"{_dotted(func) or func.attr}() while holding "
+                    f"{held.display} (acquired line {held.line}) — a "
+                    "future/executor wait under a lock deadlocks against "
+                    "any worker that needs the same lock; collect results "
+                    "outside the critical section",
                 )
             # interprocedural hop for the lock-order graph: same-module
             # callee summaries are resolved in lockgraph.order_findings

@@ -5,11 +5,36 @@ from __future__ import annotations
 import abc
 import os
 import threading
-from typing import List, Optional, Tuple
+import time
+from typing import Iterator, List, Optional, Tuple
 
 
 class DeviceError(Exception):
     """Any device-layer failure (analog of GpuError, reference main.py:41)."""
+
+
+#: wait_ready poll cadence, shared by every backend: exponential backoff
+#: from 50 ms capped at 1 s, always clamped to the remaining deadline.
+#: The old fixed 0.5 s sleep put a mandatory half-second floor under
+#: EVERY reset — across an 8-chip plan that floor alone was 4 s of pure
+#: waiting, which the parallel flip pipeline would otherwise multiply.
+WAIT_READY_POLL_START_S = 0.05
+WAIT_READY_POLL_MAX_S = 1.0
+
+
+def backoff_intervals(deadline: float) -> Iterator[float]:
+    """Sleep durations for a ready-poll loop: exponential from
+    ``WAIT_READY_POLL_START_S``, capped at ``WAIT_READY_POLL_MAX_S``,
+    each clamped to the time left before ``deadline`` (a
+    ``time.monotonic()`` instant). Exhausts when the deadline passes —
+    callers treat exhaustion as the timeout."""
+    delay = WAIT_READY_POLL_START_S
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        yield min(delay, remaining)
+        delay = min(delay * 2, WAIT_READY_POLL_MAX_S)
 
 
 class TpuChip(abc.ABC):

@@ -53,6 +53,8 @@ class FakeChip(TpuChip):
         # counters for assertions
         self.resets = 0
         self.sets = 0
+        self.cc_queries = 0
+        self.ici_queries = 0
 
     # -- TpuChip interface ------------------------------------------------
     def is_ici_switch(self) -> bool:
@@ -64,6 +66,7 @@ class FakeChip(TpuChip):
         if not self.is_cc_query_supported:
             raise DeviceError(f"{self.path}: CC query not supported")
         with self._lock:
+            self.cc_queries += 1
             return self._cc_mode
 
     def set_cc_mode(self, mode: str) -> None:
@@ -81,6 +84,7 @@ class FakeChip(TpuChip):
         if not self.is_ici_query_supported:
             raise DeviceError(f"{self.path}: ICI query not supported")
         with self._lock:
+            self.ici_queries += 1
             return self._ici_mode
 
     def set_ici_mode(self, mode: str) -> None:

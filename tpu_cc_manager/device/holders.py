@@ -37,6 +37,7 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
+import threading
 import time
 from typing import List, NamedTuple, Sequence
 
@@ -114,6 +115,14 @@ class HolderCheck:
             if wait_s is None else wait_s
         )
         self.poll_s = poll_s
+        #: Serializes the restart hook across the engine's parallel flip
+        #: workers: the hook restarts ONE shared node-wide runtime, so N
+        #: workers whose devices are held by that runtime must run it
+        #: once, not N times racing each other (the serial loop's
+        #: effective behavior: the first device's restart freed every
+        #: sibling's holder too). Dedicated to the hook — never held
+        #: around the poll loop or any executor wait.
+        self._hook_lock = threading.Lock()
 
     def _run_restart_hook(self, path: str) -> None:
         log.warning(
@@ -147,7 +156,14 @@ class HolderCheck:
         if not holders:
             return
         if self.restart_cmd:
-            self._run_restart_hook(path)
+            with self._hook_lock:
+                # a sibling flip's restart may have already freed this
+                # device while we waited for the hook lock — re-scan
+                # before restarting the shared runtime AGAIN (which
+                # would kill the session a completed sibling was
+                # reacquiring through wait_ready)
+                if find_holders(path):
+                    self._run_restart_hook(path)
         deadline = time.monotonic() + self.wait_s
         while True:
             holders = find_holders(path)

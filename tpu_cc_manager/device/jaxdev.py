@@ -41,10 +41,16 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import List, Optional, Tuple
 
-from tpu_cc_manager.device.base import Backend, DeviceError, TpuChip
+from tpu_cc_manager.device.base import (
+    Backend,
+    DeviceError,
+    TpuChip,
+    backoff_intervals,
+)
 from tpu_cc_manager.device.statefile import ModeStateStore, independent_read
 
 log = logging.getLogger("tpu-cc-manager.jaxdev")
@@ -133,24 +139,38 @@ class JaxTpuChip(TpuChip):
         state is session-scoped, SURVEY.md §7.4), so a multi-chip plan
         pays exactly ONE physical teardown: chips created under the same
         runtime generation share it, and later chips in the engine's
-        per-device loop only commit their statefiles.
+        per-device loop only commit their statefiles. The gen check and
+        teardown run under the backend's teardown lock so PARALLEL flips
+        (engine flip executor) also pay exactly one teardown — without
+        it, N workers racing the unguarded check would each restart the
+        runtime, N-1 of them tearing down a session a sibling was
+        already reacquiring through wait_ready.
         """
-        if self._created_gen == self._backend.runtime_gen:
-            self._backend.teardown_runtime()
+        with self._backend.teardown_lock:
+            if self._created_gen == self._backend.runtime_gen:
+                self._backend.teardown_runtime()
         self._backend.store.commit(self.path)
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         """Reacquire the runtime and run a tiny computation ON this chip,
-        retrying until it answers (reference main.py:289 analog)."""
-        deadline = time.monotonic() + timeout_s
+        retrying until it answers (reference main.py:289 analog). Retry
+        cadence backs off exponentially from 50 ms (clamped to the
+        deadline; device.base.backoff_intervals, the same policy as the
+        sysfs backend): a runtime that reinitializes quickly is detected
+        in milliseconds instead of paying the old half-second floor per
+        device."""
         last_err: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        pauses = backoff_intervals(time.monotonic() + timeout_s)
+        while True:
             try:
                 self._backend.probe_device(self.device_id)
                 return
             except Exception as e:  # PJRT raises RuntimeError subclasses
                 last_err = e
-                time.sleep(0.5)
+                pause = next(pauses, None)
+                if pause is None:
+                    break
+                time.sleep(pause)
         raise DeviceError(
             f"{self.path}: not ready after {timeout_s}s: {last_err}"
         )
@@ -170,6 +190,10 @@ class JaxTpuBackend(Backend):
         #: Bumped by every teardown; chips record the generation they were
         #: enumerated under so one engine plan triggers one teardown.
         self.runtime_gen = 0
+        #: Serializes the gen-check + teardown pair in JaxTpuChip.reset:
+        #: parallel flips of same-generation chips must still pay exactly
+        #: ONE physical runtime restart.
+        self.teardown_lock = threading.Lock()
 
     # ------------------------------------------------------- runtime ops
     @staticmethod

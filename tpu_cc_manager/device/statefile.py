@@ -23,6 +23,17 @@ where ``<device-key>`` is the device path with '/' mapped to '_'
 rename) and serialized by an ``fcntl`` lock per device, because the
 Python agent, the bash engine, and the C++ agent may race on one host.
 Unknown/absent state reads as ``off`` (a fresh chip is unprotected).
+
+Thread-safety (audited for the parallel flip pipeline, docs/engine.md):
+the store holds no instance state beyond ``state_dir``; every operation
+opens its own lock file descriptor, and ``flock`` serializes distinct
+*open file descriptions*, so two threads of one process exclude each
+other exactly like two processes do. The engine's flip executor only
+parallelizes across devices — distinct ``<device-key>`` dirs, distinct
+locks — so sibling flips never even contend; same-device cross-process
+races (bash engine, C++ agent) keep the protection they always had.
+``os.makedirs(exist_ok=True)`` in ``_dev_dir`` is idempotent under
+concurrent callers by contract.
 """
 
 from __future__ import annotations

@@ -52,7 +52,12 @@ import subprocess
 import time
 from typing import List, Optional, Tuple
 
-from tpu_cc_manager.device.base import Backend, DeviceError, TpuChip
+from tpu_cc_manager.device.base import (
+    Backend,
+    DeviceError,
+    TpuChip,
+    backoff_intervals,
+)
 from tpu_cc_manager.device.statefile import ModeStateStore, independent_read
 
 
@@ -224,20 +229,28 @@ class SysfsTpuChip(TpuChip):
 
     def wait_ready(self, timeout_s: float = 60.0) -> None:
         """Poll device-node presence + optional sysfs health until ready
-        (wait_for_boot analog, reference main.py:289)."""
-        deadline = time.monotonic() + timeout_s
+        (wait_for_boot analog, reference main.py:289).
+
+        Polling backs off exponentially from 50 ms (clamped to the
+        deadline; device.base.backoff_intervals, shared with the jax
+        backend) instead of a fixed half-second sleep: a fast reset is
+        detected in milliseconds — which the parallel flip pipeline
+        multiplies across every chip on the host — while a genuinely
+        slow boot converges to ~1 s polls that cost nothing."""
         health_attr = os.path.join(
             self.sysfs_dir, os.environ.get("TPU_SYSFS_HEALTH_ATTR", "health")
         )
+        pauses = backoff_intervals(time.monotonic() + timeout_s)
         while True:
             node_ok = os.path.exists(self.path) or not self.path.startswith("/dev/")
             health = _read(health_attr)
             health_ok = health is None or health.lower() in ("ok", "healthy", "1")
             if node_ok and health_ok:
                 return
-            if time.monotonic() >= deadline:
+            pause = next(pauses, None)
+            if pause is None:
                 raise DeviceError(f"{self.path}: not ready after {timeout_s}s")
-            time.sleep(0.5)
+            time.sleep(pause)
 
 
 class SysfsTpuBackend(Backend):

@@ -33,6 +33,13 @@ from tpu_cc_manager import device as devlayer
 from tpu_cc_manager.device.base import DeviceError, TpuChip
 from tpu_cc_manager.device.gate import DeviceGate
 from tpu_cc_manager.device.holders import HolderCheck
+from tpu_cc_manager.flipexec import (
+    FAILED,
+    SKIPPED,
+    FlipOutcome,
+    flip_concurrency as resolve_flip_concurrency,
+    run_flips,
+)
 from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
 from tpu_cc_manager.trace import Tracer, get_tracer
 
@@ -106,6 +113,13 @@ class FlipTaint:
 #: it diverges on ({"cc": "on"} / {"ici": "off"} / both).
 PlanItem = Tuple[TpuChip, Dict[str, str]]
 
+#: Per-device mode snapshot: path -> {"cc": ..., "ici": ...} (domains the
+#: device supports). Taken ONCE per reconcile and threaded through
+#: planning, the converged-subset gate reassert, and the post-verify
+#: gate fallback — the idempotent fast path costs one query per domain
+#: per device instead of two.
+ModeSnapshot = Dict[str, Dict[str, str]]
+
 
 class ModeEngine:
     def __init__(
@@ -121,6 +135,7 @@ class ModeEngine:
         flip_taint: Optional[FlipTaint] = None,
         holder_check: Optional[HolderCheck] = None,
         notify_state_label: Optional[Callable[[str], None]] = None,
+        flip_concurrency: Optional[int] = None,
     ):
         self._set_state_label = set_state_label
         #: observation-only hook invoked when the state label's WIRE
@@ -140,6 +155,10 @@ class ModeEngine:
         self._flip_taint = flip_taint or FlipTaint()
         #: exclusive-hold guarantee before commit (TPU_CC_HOLDER_CHECK)
         self._holder_check = holder_check or HolderCheck()
+        #: per-device flip parallelism; None -> TPU_CC_FLIP_CONCURRENCY
+        #: env (default min(4, plan size)); 1 -> the serial loop exactly.
+        #: See flipexec.py and docs/engine.md for the contract.
+        self._flip_concurrency = flip_concurrency
 
     # ------------------------------------------------------------- queries
     def get_modes(self) -> dict:
@@ -204,7 +223,8 @@ class ModeEngine:
         self._check_capability(devices, mode)
 
         with self._tracer.span("plan", mode=mode.value) as plan_span:
-            plan = self._plan(devices, desired_cc, desired_ici)
+            snapshot = self._snapshot_modes(devices)
+            plan = self._plan(devices, desired_cc, desired_ici, snapshot)
             plan_span.attrs["devices"] = len(devices)
             plan_span.attrs["divergent"] = len(plan)
         # re-assert the workload-visible gate on every device that is
@@ -212,11 +232,13 @@ class ModeEngine:
         # fast path, the converged subset on a partial flip): an agent
         # restart after someone reset /dev perms must reconverge the
         # node-local consequence, not just the bookkeeping. In-plan
-        # devices are gated inside _apply_plan.
+        # devices are gated inside _apply_plan. The snapshot taken for
+        # planning answers the "what mode is it in?" question here too —
+        # no second round of device queries for the converged subset.
         in_plan = {dev.path for dev, _ in plan}
         for dev in devices:
             if dev.path not in in_plan and dev.is_cc_query_supported:
-                self._gate.apply_mode(dev.path, dev.query_cc_mode())
+                self._gate.apply_mode(dev.path, snapshot[dev.path]["cc"])
 
         if not plan:
             n = len(devices)
@@ -233,8 +255,16 @@ class ModeEngine:
             "mode plan: %s",
             [(d.path, changes) for d, changes in plan],
         )
+        # resolve the concurrency knob BEFORE the taint/evict cycle: a
+        # typo'd TPU_CC_FLIP_CONCURRENCY must fail here (the agent still
+        # publishes cc.mode.state=failed), not churn workloads through a
+        # drain/reschedule round trip on every reconcile first
+        cap = resolve_flip_concurrency(
+            sum(1 for d, _ in plan if not d.is_ici_switch()),
+            self._flip_concurrency,
+        )
         ok = self._drain_wrapped(
-            lambda: self._apply_plan(plan), mode.value
+            lambda: self._apply_plan(plan, snapshot, cap), mode.value
         )
         if ok:
             # measured flip history (tpu_cc_manager.attest): only REAL
@@ -274,17 +304,37 @@ class ModeEngine:
                 f"refusing mode {mode.value!r} on a mixed node"
             )
 
+    def _snapshot_modes(self, devices: Sequence[TpuChip]) -> ModeSnapshot:
+        """One mode query per supported domain per device, taken once per
+        reconcile. Planning, the converged-subset gate reassert, and the
+        post-verify gate fallback all read this snapshot instead of
+        re-querying — half the device I/O on the idempotent fast path."""
+        snap: ModeSnapshot = {}
+        for dev in devices:
+            entry: Dict[str, str] = {}
+            if dev.is_cc_query_supported:
+                entry["cc"] = dev.query_cc_mode()
+            if dev.is_ici_query_supported:
+                entry["ici"] = dev.query_ici_mode()
+            snap[dev.path] = entry
+        return snap
+
     def _plan(
-        self, devices: Sequence[TpuChip], desired_cc: str, desired_ici: str
+        self,
+        devices: Sequence[TpuChip],
+        desired_cc: str,
+        desired_ici: str,
+        snapshot: ModeSnapshot,
     ) -> List[PlanItem]:
         """Per-device divergence between current and desired domain modes.
         Empty plan == the idempotent fast path (reference main.py:227-230)."""
         plan: List[PlanItem] = []
         for dev in devices:
+            current = snapshot[dev.path]
             changes: Dict[str, str] = {}
-            if dev.is_cc_query_supported and dev.query_cc_mode() != desired_cc:
+            if "cc" in current and current["cc"] != desired_cc:
                 changes["cc"] = desired_cc
-            if dev.is_ici_query_supported and dev.query_ici_mode() != desired_ici:
+            if "ici" in current and current["ici"] != desired_ici:
                 changes["ici"] = desired_ici
             if changes:
                 plan.append((dev, changes))
@@ -360,93 +410,156 @@ class ModeEngine:
                 self._set_state_label(state)
         return ok
 
-    def _apply_plan(self, plan: Sequence[PlanItem]) -> bool:
-        """Per-device hot loop (reference main.py:258-311): lock the device
-        node, discard stale staged state, stage every divergent domain, ONE
-        reset, wait, verify every staged domain, then re-open the node with
-        the verified mode's permissions. Any failure aborts the whole node
-        flip — leaving already-locked devices locked (fail-secure; see
-        device.gate)."""
-        for dev, changes in plan:
-            try:
-                with self._tracer.span(
-                    "flip", device=dev.path, changes=dict(changes)
-                ) as flip_span:
-                    # access-revocation analog of the reference's driver
-                    # unbind (scripts/cc-manager.sh:40-50): mid-flip, a
-                    # workload that could open the node observably cannot
-                    if not dev.is_ici_switch():
-                        self._gate.lock_for_flip(dev.path)
-                    # sub-phase spans: the flip's wall clock decomposes
-                    # into stage/reset/wait_ready/verify so a hardware
-                    # regression names its phase (the r05 real-chip
-                    # 1.87->4.43s jump arrived opaque because this
-                    # span was one block)
-                    with self._tracer.span("stage", device=dev.path):
-                        dev.discard_staged()
-                        for domain, target in changes.items():
-                            if domain == "cc":
-                                dev.set_cc_mode(target)
-                            else:
-                                dev.set_ici_mode(target)
-                    # exclusive-hold guarantee (the reference's driver
-                    # unbind makes this impossible by construction,
-                    # scripts/cc-manager.sh:40-50): the gate above stops
-                    # NEW opens, this stops committing under fds that
-                    # were already open — running the configured runtime
-                    # restart hook if needed
-                    with self._tracer.span("holder_check", device=dev.path):
-                        self._holder_check.ensure_free(dev.path)
-                    with self._tracer.span("reset", device=dev.path):
-                        dev.reset()
-                    with self._tracer.span("wait_ready", device=dev.path):
-                        dev.wait_ready(timeout_s=self._boot_timeout_s)
-                    with self._tracer.span(
-                        "verify", device=dev.path
-                    ) as verify_span:
-                        for domain, target in changes.items():
-                            achieved = (
-                                dev.query_cc_mode() if domain == "cc"
-                                else dev.query_ici_mode()
-                            )
-                            if achieved != target:
-                                log.error(
-                                    "%s: %s mode verify mismatch: wanted %r got %r",
-                                    dev.path, domain, target, achieved,
-                                )
-                                verify_span.status = flip_span.status = "error"
-                                flip_span.error = verify_span.error = (
-                                    f"verify mismatch: {domain} wanted "
-                                    f"{target!r} got {achieved!r}"
-                                )
-                                return False
-                            # non-tautological verify: a reader that shares
-                            # nothing with the flip path but the bytes on
-                            # disk must agree too (reference main.py:291-296
-                            # re-queries hardware that can genuinely
-                            # disagree; our statefile-backed chips would
-                            # otherwise only re-read their own bookkeeping)
-                            independent = dev.verify_independent(domain)
-                            if independent is not None and independent != target:
-                                log.error(
-                                    "%s: independent %s verify disagrees: "
-                                    "wanted %r, independent reader saw %r",
-                                    dev.path, domain, target, independent,
-                                )
-                                verify_span.status = flip_span.status = "error"
-                                flip_span.error = verify_span.error = (
-                                    f"independent verify mismatch: {domain} "
-                                    f"wanted {target!r} got {independent!r}"
-                                )
-                                return False
-                    if not dev.is_ici_switch():
-                        final_cc = changes.get(
-                            "cc",
-                            dev.query_cc_mode()
-                            if dev.is_cc_query_supported else "off",
+    def _apply_plan(
+        self, plan: Sequence[PlanItem], snapshot: ModeSnapshot, cap: int
+    ) -> bool:
+        """Per-device flip pipeline (reference main.py:258-311, made
+        concurrent): every chip's lock-gate → stage → holder-check →
+        reset → wait_ready → verify → re-gate sequence runs through the
+        bounded flip executor (flipexec.py; TPU_CC_FLIP_CONCURRENCY,
+        default min(4, chips in plan), 1 = the historical serial loop).
+        Fail-secure under concurrency: any device failure fails the
+        whole flip, the failing device stays at FLIP_LOCK_PERMS,
+        in-flight siblings run their own sequence to completion (and
+        re-open on their own verified success), not-yet-started items
+        are skipped untouched. ICI switches flip strictly AFTER every
+        chip completed, serially — topology writes never race chip
+        resets. Full contract: docs/engine.md."""
+        chips = [item for item in plan if not item[0].is_ici_switch()]
+        switches = [item for item in plan if item[0].is_ici_switch()]
+
+        def flip_item(item: PlanItem) -> bool:
+            return self._flip_device(item[0], item[1], snapshot)
+
+        def path_of(item: PlanItem) -> str:
+            return item[0].path
+
+        if cap > 1:
+            log.info(
+                "flipping %d chip(s) with concurrency %d", len(chips), cap
+            )
+        outcomes = run_flips(
+            chips, flip_item,
+            concurrency=cap, tracer=self._tracer, label_of=path_of,
+        )
+        if switches:
+            if any(o.status == FAILED for o in outcomes):
+                # uniform per-device disposition reporting: untouched
+                # switches get an explicit skip, same as queued chips
+                outcomes += [
+                    FlipOutcome(path_of(item), SKIPPED) for item in switches
+                ]
+            else:
+                # conservative ordering: switches only after ALL chips
+                # landed, one at a time (the serial executor path)
+                outcomes += run_flips(
+                    switches, flip_item,
+                    concurrency=1, tracer=self._tracer, label_of=path_of,
+                )
+        ok = True
+        for o in outcomes:
+            if o.status == FAILED:
+                ok = False
+                if o.error:  # mismatches already logged in _flip_device
+                    log.error("%s: mode flip failed: %s", o.label, o.error)
+            elif o.status == SKIPPED:
+                log.warning(
+                    "%s: flip skipped, device untouched (a sibling device "
+                    "failed first)", o.label,
+                )
+        return ok
+
+    def _flip_device(
+        self, dev: TpuChip, changes: Dict[str, str], snapshot: ModeSnapshot
+    ) -> bool:
+        """ONE device's flip sequence: lock the device node, discard
+        stale staged state, stage every divergent domain, ONE reset,
+        wait, verify every staged domain, then re-open the node with the
+        verified mode's permissions. Returns False on a verify mismatch
+        (logged + marked on the span here), raises DeviceError on device
+        failure; either way the device stays at the flip-lock perms
+        (fail-secure; see device.gate). Runs on a flip-executor worker
+        thread when the plan is parallel — the gate's chmod, the
+        per-device statefile dir + fcntl lock, the /proc holder scan,
+        and the device itself are all device-local; the one shared
+        node-wide action, the holder check's runtime restart hook, is
+        serialized-and-deduped inside HolderCheck (device/holders.py),
+        so sibling flips never race on mutable state."""
+        with self._tracer.span(
+            "flip", device=dev.path, changes=dict(changes)
+        ) as flip_span:
+            # access-revocation analog of the reference's driver
+            # unbind (scripts/cc-manager.sh:40-50): mid-flip, a
+            # workload that could open the node observably cannot
+            if not dev.is_ici_switch():
+                self._gate.lock_for_flip(dev.path)
+            # sub-phase spans: the flip's wall clock decomposes
+            # into stage/reset/wait_ready/verify so a hardware
+            # regression names its phase (the r05 real-chip
+            # 1.87->4.43s jump arrived opaque because this
+            # span was one block)
+            with self._tracer.span("stage", device=dev.path):
+                dev.discard_staged()
+                for domain, target in changes.items():
+                    if domain == "cc":
+                        dev.set_cc_mode(target)
+                    else:
+                        dev.set_ici_mode(target)
+            # exclusive-hold guarantee (the reference's driver
+            # unbind makes this impossible by construction,
+            # scripts/cc-manager.sh:40-50): the gate above stops
+            # NEW opens, this stops committing under fds that
+            # were already open — running the configured runtime
+            # restart hook if needed
+            with self._tracer.span("holder_check", device=dev.path):
+                self._holder_check.ensure_free(dev.path)
+            with self._tracer.span("reset", device=dev.path):
+                dev.reset()
+            with self._tracer.span("wait_ready", device=dev.path):
+                dev.wait_ready(timeout_s=self._boot_timeout_s)
+            with self._tracer.span(
+                "verify", device=dev.path
+            ) as verify_span:
+                for domain, target in changes.items():
+                    achieved = (
+                        dev.query_cc_mode() if domain == "cc"
+                        else dev.query_ici_mode()
+                    )
+                    if achieved != target:
+                        log.error(
+                            "%s: %s mode verify mismatch: wanted %r got %r",
+                            dev.path, domain, target, achieved,
                         )
-                        self._gate.apply_mode(dev.path, final_cc)
-            except DeviceError as e:
-                log.error("%s: mode flip failed: %s", dev.path, e)
-                return False
+                        verify_span.status = flip_span.status = "error"
+                        flip_span.error = verify_span.error = (
+                            f"verify mismatch: {domain} wanted "
+                            f"{target!r} got {achieved!r}"
+                        )
+                        return False
+                    # non-tautological verify: a reader that shares
+                    # nothing with the flip path but the bytes on
+                    # disk must agree too (reference main.py:291-296
+                    # re-queries hardware that can genuinely
+                    # disagree; our statefile-backed chips would
+                    # otherwise only re-read their own bookkeeping)
+                    independent = dev.verify_independent(domain)
+                    if independent is not None and independent != target:
+                        log.error(
+                            "%s: independent %s verify disagrees: "
+                            "wanted %r, independent reader saw %r",
+                            dev.path, domain, target, independent,
+                        )
+                        verify_span.status = flip_span.status = "error"
+                        flip_span.error = verify_span.error = (
+                            f"independent verify mismatch: {domain} "
+                            f"wanted {target!r} got {independent!r}"
+                        )
+                        return False
+            if not dev.is_ici_switch():
+                # a chip whose cc domain didn't change keeps its
+                # snapshot mode — the flip can't have moved it
+                final_cc = changes.get(
+                    "cc", snapshot.get(dev.path, {}).get("cc", "off")
+                )
+                self._gate.apply_mode(dev.path, final_cc)
         return True

@@ -13,6 +13,11 @@ Design:
 
 - :class:`Tracer` keeps a thread-local span stack (nesting without
   explicit parent plumbing) and a bounded ring of completed spans.
+  Work handed to another thread keeps its place in the tree via
+  :meth:`Tracer.current_span` (capture on the submitting thread) +
+  :meth:`Tracer.adopt` (re-seat on the worker) — the parallel flip
+  pipeline's per-device spans nest under the reconcile exactly as the
+  serial loop's did.
 - Sinks observe every completed span: :class:`JsonlSink` appends one JSON
   line per span to ``CC_TRACE_FILE`` (the structured replacement for
   ``set -x``); the agent adds a metrics sink so ``/metrics`` exports a
@@ -116,6 +121,32 @@ class Tracer:
     def add_sink(self, sink: Callable[[Span], None]) -> "Tracer":
         self._sinks.append(sink)
         return self
+
+    def current_span(self) -> Optional[Span]:
+        """The innermost open span on THIS thread (None at top level).
+        Capture it before submitting work to another thread and hand it
+        to :meth:`adopt` there — cross-thread span parenting."""
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    @contextmanager
+    def adopt(self, parent: Optional[Span]) -> Iterator[None]:
+        """Make ``parent`` (captured via :meth:`current_span` on another
+        thread) the current span for this thread while the context is
+        active: spans opened inside nest under it — same trace id,
+        ``parent_id=parent.span_id`` — exactly as if they ran on the
+        submitting thread. The parent span object is only *read* here
+        (its ids), so adopting a still-open span owned by another thread
+        is safe. No-op when ``parent`` is None (untraced caller)."""
+        if parent is None:
+            yield
+            return
+        stack = self._stack()
+        stack.append(parent)
+        try:
+            yield
+        finally:
+            stack.pop()
 
     # --------------------------------------------------------------- spans
     @contextmanager
