@@ -14,13 +14,16 @@ ccaudit is that walk. The rules (docs/analysis.md has the full contract):
     Locks are acquired via ``with``; a bare ``.acquire()`` is flagged
     unless a ``try/finally`` in the same function releases the same lock.
 ``lock-order``
-    A global lock-order graph is built from nested ``with`` blocks plus a
-    one-hop summary of same-module calls made while a lock is held;
-    any cycle (a potential ABBA deadlock) is reported.
+    A global lock-order graph is built from nested ``with`` blocks plus
+    **transitive call summaries over the whole-program call graph**
+    (``callgraph.py``, v3): a call made while a lock is held orders that
+    lock ahead of every lock the callee's closure acquires, across
+    modules and up to the depth bound (``--call-depth`` overrides); any
+    cycle (a potential ABBA deadlock) is reported.
 ``blocking-under-lock``
-    ``time.sleep``, subprocess, and socket/HTTP calls lexically inside a
-    lock's ``with`` body are flagged — they turn a microsecond critical
-    section into a convoy.
+    ``time.sleep``, subprocess, socket/HTTP, and executor waits inside a
+    lock's ``with`` body are flagged — lexically, and (v3) transitively
+    at any call under the lock whose closure reaches a blocking site.
 ``label-literal``
     Hard-coded ``tpu.google.com/...`` protocol strings belong in
     ``labels.py`` only; everywhere else must import the constant.
@@ -43,7 +46,9 @@ pass — docs/analysis.md §v2):
     Raw mode/state strings (``"on"``/``"off"``/``"devtools"``/``"ici"``/
     ``"failed"``) flowing into label/annotation write APIs must come from
     ``modes.py``/``labels.py`` constants — tracked through local
-    assignment and one-hop same-module call summaries.
+    assignment and (v3) transitive cross-module sink summaries over the
+    call graph, with the old same-module terminal-name match kept as the
+    fallback for unresolvable receivers.
 ``unvalidated-mode``
     A mode-label value read off a k8s object dict must pass through
     ``parse_mode`` before reaching engine/subprocess/device-call sinks.
@@ -59,11 +64,32 @@ pass — docs/analysis.md §v2):
     protocol ``labels.py``/``modes.py`` export — unknown keys, unknown
     modes, and a CRD mode enum differing from ``VALID_MODES`` all fail.
 
+v3 made the analyzer whole-program: ``callgraph.py`` (nominal
+project-wide call graph — module attributes, ``self.``-methods, nested
+defs, typed locals; cycle-safe, depth-bounded by
+``callgraph.DEPTH_LIMIT`` with ``--call-depth`` as the escape hatch)
+replaces every "one hop, same module" summary, and two new passes ride
+on it (docs/analysis.md §v3):
+
+``race-lockset``
+    ``threads.py`` infers thread roots (``threading.Thread`` targets,
+    executor ``submit`` callables incl. the flipexec worker,
+    ``*RequestHandler`` ``do_*`` methods, parameter-linked callbacks);
+    ``lockset.py`` runs an Eraser-style lockset pass over
+    ``self.``-attributes and mutable module globals shared across
+    contexts — a shared location written with an empty or inconsistent
+    guarding lockset is a finding. Reads-only sharing,
+    init-before-spawn, and caller-held locks (the ``_locked`` suffix
+    convention) are recognized; deliberate benign races carry
+    ``# ccaudit: allow-race-lockset(reason)``.
+
 Findings are gated against ``analysis/baseline.json`` so CI fails only on
 *new* findings; stale baseline entries (the code they suppressed moved or
 was fixed) also fail, so the baseline can only burn down.
 
-Run it: ``python -m tpu_cc_manager.analysis`` (wired into ``make lint``).
+Run it: ``python -m tpu_cc_manager.analysis`` (wired into ``make lint``);
+``--sarif PATH`` writes a SARIF 2.1.0 log CI uploads for inline PR
+annotations.
 """
 
 from tpu_cc_manager.analysis.core import (  # noqa: F401
@@ -92,4 +118,6 @@ RULES = (
     "mode-exhaustive",
     "protocol-liveness",
     "manifest-drift",
+    # v3 — the whole-program concurrency pass
+    "race-lockset",
 )

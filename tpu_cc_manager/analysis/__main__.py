@@ -24,8 +24,10 @@ from tpu_cc_manager.analysis.core import (
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_cc_manager.analysis",
-        description="ccaudit: AST + dataflow invariant analyzer "
-        "(lock discipline, blocking-under-lock, label hygiene, "
+        description="ccaudit: whole-program concurrency + protocol "
+        "analyzer (lock discipline, transitive ABBA lock order, "
+        "blocking-under-lock through the call graph, Eraser-style "
+        "race-lockset over thread-shared state, label hygiene, "
         "exception discipline, metric-name consistency, protocol-literal "
         "confinement, unvalidated-mode taint, Mode exhaustiveness, "
         "protocol liveness, code<->manifest drift). "
@@ -56,6 +58,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="emit findings as JSON instead of text",
     )
+    parser.add_argument(
+        "--sarif", default=None, metavar="PATH",
+        help="also write the scan as SARIF 2.1.0 to PATH (new findings "
+        "level=error, baselined ones suppressed notes, stale entries "
+        "stale-baseline errors) — CI uploads this so findings annotate "
+        "PR diffs",
+    )
+    parser.add_argument(
+        "--call-depth", type=int, default=None, metavar="N",
+        help="transitive call-graph horizon in call edges beyond the "
+        "direct callee (default: callgraph.DEPTH_LIMIT; 0 restricts "
+        "summaries to the direct callee, the v2 one-hop horizon — the "
+        "escape hatch when a refactor needs a different bound)",
+    )
     group = parser.add_mutually_exclusive_group()
     group.add_argument(
         "--manifests", action="store_true",
@@ -81,7 +97,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
 
     try:
-        findings = analyze_paths(root, args.targets, with_manifests)
+        findings = analyze_paths(
+            root, args.targets, with_manifests, call_depth=args.call_depth
+        )
     except FileNotFoundError as e:
         print(f"ccaudit: {e}", file=sys.stderr)
         return 2
@@ -99,6 +117,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     new, suppressed, stale = baseline_mod.diff_against_baseline(
         findings, entries
     )
+
+    if args.sarif:
+        from tpu_cc_manager.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, new, suppressed, stale)
 
     if args.as_json:
         print(json.dumps(

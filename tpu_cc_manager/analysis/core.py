@@ -136,6 +136,18 @@ def resolve_dotted(expr: ast.AST, imports: Dict[str, str]) -> Optional[str]:
     return path
 
 
+def module_dotted(relpath: str) -> str:
+    """Repo-relative path → importable dotted module path
+    (``tpu_cc_manager/device/fake.py`` → ``tpu_cc_manager.device.fake``;
+    a package ``__init__.py`` maps to the package itself). The call
+    graph keys every function by this, so two ``fake.py`` files in
+    different packages can never collide."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
 def repo_root() -> str:
     """The repo root is two levels above this package (…/tpu_cc_manager/
     analysis/core.py); resolving from ``__file__`` keeps the CLI working
@@ -192,21 +204,44 @@ def load_module(root: str, relpath: str) -> Optional[Module]:
 # --------------------------------------------------------------------- runs
 
 
-def analyze_modules(modules: Sequence[Module]) -> List[Finding]:
+def analyze_modules(
+    modules: Sequence[Module], call_depth: Optional[int] = None
+) -> List[Finding]:
     """Run every rule over already-parsed modules (the seam the fixture
-    tests use: build Modules from inline snippets, skip the filesystem)."""
-    from tpu_cc_manager.analysis import dataflow, lockgraph, rules
+    tests use: build Modules from inline snippets, skip the filesystem).
+
+    v3 pipeline: parse → per-module rules → whole-program call graph →
+    thread roots → transitive lock-order/blocking + lockset race pass →
+    findings (the baseline gate is the caller's job).
+    """
+    from tpu_cc_manager.analysis import (
+        callgraph,
+        dataflow,
+        lockgraph,
+        lockset,
+        rules,
+        threads,
+    )
 
     findings: List[Finding] = []
-    summaries = []
+    audits = []
     for mod in modules:
         result = rules.audit_module(mod)
         findings.extend(result.findings)
-        findings.extend(dataflow.protocol_findings(mod))
-        summaries.append(result)
-    findings.extend(lockgraph.order_findings(summaries))
-    findings.extend(rules.metric_findings(summaries))
-    findings.extend(rules.liveness_findings(summaries))
+        audits.append(result)
+    depth = callgraph.DEPTH_LIMIT if call_depth is None else call_depth
+    graph = callgraph.build(audits, depth)
+    sink_summaries = dataflow.collect_sink_summaries(audits, graph)
+    for mod, audit in zip(modules, audits):
+        findings.extend(
+            dataflow.protocol_findings(mod, audit, graph, sink_summaries)
+        )
+    findings.extend(lockgraph.order_findings(audits, graph))
+    findings.extend(callgraph.blocking_findings(audits, graph))
+    roots = threads.infer_roots(audits, graph)
+    findings.extend(lockset.race_findings(audits, graph, roots))
+    findings.extend(rules.metric_findings(audits))
+    findings.extend(rules.liveness_findings(audits))
     return sorted(findings)
 
 
@@ -214,6 +249,7 @@ def analyze_paths(
     root: Optional[str] = None,
     targets: Sequence[str] = DEFAULT_TARGETS,
     with_manifests: Optional[bool] = None,
+    call_depth: Optional[int] = None,
 ) -> List[Finding]:
     """Full repo scan: the AST rules over ``targets`` plus — when scanning
     the default surface (or when ``with_manifests`` forces it) — the
@@ -226,7 +262,7 @@ def analyze_paths(
         mod = load_module(root, rel)
         if mod is not None:
             modules.append(mod)
-    findings = analyze_modules(modules)
+    findings = analyze_modules(modules, call_depth)
     if with_manifests:
         from tpu_cc_manager.analysis import manifests
 
@@ -234,6 +270,9 @@ def analyze_paths(
     return sorted(findings)
 
 
-def analyze_source(source: str, relpath: str = "snippet.py") -> List[Finding]:
+def analyze_source(
+    source: str, relpath: str = "snippet.py",
+    call_depth: Optional[int] = None,
+) -> List[Finding]:
     """Analyze one in-memory module — the unit-test entry point."""
-    return analyze_modules([Module(relpath, source)])
+    return analyze_modules([Module(relpath, source)], call_depth)

@@ -22,13 +22,18 @@ TAINTED and RAW together; an if/else join unions the branches' facts),
 and the empty set means "unknown" — the rules only fire on what they
 can *prove*, so unknown always passes.
 
-Tracking is deliberately bounded the same way the lockgraph's call
-summaries are (lockgraph.py): local assignments within one function, plus
-ONE interprocedural hop to same-module callees via per-function sink
-summaries — a function whose parameter flows into a label-write sink
-makes every same-module call with a RAW argument in that position a
-finding. Deeper resolution would need whole-program points-to analysis
-and its false positives would drown the signal.
+Tracking is bounded the same way the lockgraph's call summaries are
+(lockgraph.py, callgraph.py): local assignments within one function,
+plus **transitive interprocedural sink summaries over the whole-program
+call graph** (v3) — a function whose parameter flows into a label-write
+sink, directly or through any chain of resolvable calls (module
+functions, ``self.``-methods, nested defs) up to the shared depth bound
+(``callgraph.DEPTH_LIMIT``, ``--call-depth`` overrides), makes every
+call with a RAW argument in that position a finding. Calls the graph
+cannot resolve (attribute calls on unknown objects) fall back to the
+old same-module terminal-name summary, so v2's coverage is a strict
+floor. There is still no points-to analysis: unknown stays unknown and
+passes.
 
 Two rule families are built on the core:
 
@@ -36,7 +41,8 @@ Two rule families are built on the core:
     A RAW value reaching a label/annotation write API
     (``set_cc_mode_state_label``, ``_set_state_label``,
     ``set_node_labels``/``set_node_annotations`` dict values, and
-    one-hop summaries thereof) must come from ``modes.py``/``labels.py``.
+    transitive call-graph summaries thereof) must come from
+    ``modes.py``/``labels.py``.
 
 ``unvalidated-mode``
     A mode-label value read off a k8s object dict (TAINTED) must pass
@@ -51,7 +57,17 @@ from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
-from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Dict,
+    FrozenSet,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 from tpu_cc_manager.analysis.core import (
     Finding,
@@ -62,6 +78,10 @@ from tpu_cc_manager.analysis.core import (
 )
 from tpu_cc_manager.analysis.rules import LABEL_PREFIX, _terminal_name
 from tpu_cc_manager.modes import STATE_FAILED, VALID_MODES
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from tpu_cc_manager.analysis.callgraph import CallGraph
+    from tpu_cc_manager.analysis.rules import FnAudit, ModuleAudit
 
 # -- the value lattice ------------------------------------------------------
 
@@ -151,13 +171,165 @@ def _is_const_path(resolved: Optional[str]) -> bool:
 
 @dataclass
 class SinkSummary:
-    """One-hop summary of a same-module function: which of its parameters
-    flow into a protocol value sink (the lockgraph ``fn_locks`` analog)."""
+    """Summary of one function: which of its parameters flow into a
+    protocol value sink — directly, or (v3) transitively through the
+    call-graph fixpoint in :func:`collect_sink_summaries`."""
 
     name: str
     params: List[str]
     shifted: bool  #: first param is self/cls — attribute calls drop it
     sink_params: Set[str] = field(default_factory=set)
+    qual: str = ""  #: call-graph qual ("" for module-local summaries)
+
+
+@dataclass
+class _ParamPass:
+    """One caller-param-to-callee-arg handoff, the fixpoint's edge."""
+
+    callee: str  #: resolved callee qual
+    pos: int  #: positional index (-1 for keyword)
+    kw: Optional[str]
+    caller_param: str
+    attr_call: bool  #: ``x.f(...)`` form — shifted summaries drop self
+
+
+def _resolve_ast_call(
+    graph: "CallGraph",
+    audit: "ModuleAudit",
+    fn: "FnAudit",
+    call: ast.Call,
+    imports: Dict[str, str],
+) -> Optional[str]:
+    """Resolve an AST call in ``fn``'s context to a graph qual."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return graph.resolve_parts(
+            audit.dotted, fn.cls, bare=func.id, scope=fn.scope,
+            scope_kinds=fn.scope_kinds, fn_name=fn.name,
+        )
+    if isinstance(func, ast.Attribute):
+        if (
+            isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and fn.cls is not None
+        ):
+            return graph.resolve_parts(
+                audit.dotted, fn.cls, attr_self=func.attr
+            )
+        resolved = resolve_dotted(func, imports)
+        if resolved:
+            return graph.resolve_parts(audit.dotted, fn.cls, dotted=resolved)
+    return None
+
+
+def _aligned_params(summary: SinkSummary, p: _ParamPass) -> List[str]:
+    """Callee parameter names a pass lands on. Attribute calls on a
+    shifted (method) summary are tried under BOTH alignments, same as
+    the call-site check."""
+    if p.kw is not None:
+        return [p.kw] if p.kw in summary.params else []
+    offsets = {0}
+    if summary.shifted and p.attr_call:
+        offsets.add(1)
+    out = []
+    for off in offsets:
+        idx = p.pos + off
+        if idx < len(summary.params):
+            out.append(summary.params[idx])
+    return out
+
+
+def collect_sink_summaries(
+    audits: Sequence["ModuleAudit"], graph: "CallGraph"
+) -> Dict[str, SinkSummary]:
+    """Whole-program sink summaries: a parameter is a sink param when it
+    reaches a VALUE_SINK directly, or is handed to a sink param of any
+    resolvable callee — iterated to a fixpoint bounded by the call-graph
+    depth. Keys are call-graph quals."""
+    summaries: Dict[str, SinkSummary] = {}
+    passes: Dict[str, List[_ParamPass]] = {}
+    for audit in audits:
+        imports = collect_imports(audit.module.tree)
+        for fn in audit.functions:
+            if fn.node is None:
+                continue
+            summary = SinkSummary(
+                name=fn.name,
+                params=list(fn.params),
+                shifted=bool(fn.params) and fn.params[0] in ("self", "cls"),
+                qual=fn.qual,
+            )
+            plist: List[_ParamPass] = []
+
+            def on_call(
+                call: ast.Call,
+                flow: FunctionFlow,
+                _audit: "ModuleAudit" = audit,
+                _fn: "FnAudit" = fn,
+                _imports: Dict[str, str] = imports,
+                _summary: SinkSummary = summary,
+                _plist: List[_ParamPass] = plist,
+            ) -> None:
+                term = _terminal_name(call.func)
+                if term in VALUE_SINKS:
+                    pos, kw = VALUE_SINKS[term]
+                    arg = _call_arg(call, pos, kw)
+                    if isinstance(arg, ast.Name) and arg.id in flow.params:
+                        _summary.sink_params.add(arg.id)
+                callee = _resolve_ast_call(graph, _audit, _fn, call, _imports)
+                if callee is None:
+                    return
+                attr_call = isinstance(call.func, ast.Attribute)
+                for i, a in enumerate(call.args):
+                    if isinstance(a, ast.Name) and a.id in flow.params:
+                        _plist.append(
+                            _ParamPass(callee, i, None, a.id, attr_call)
+                        )
+                for k in call.keywords:
+                    if (
+                        k.arg is not None
+                        and isinstance(k.value, ast.Name)
+                        and k.value.id in flow.params
+                    ):
+                        _plist.append(
+                            _ParamPass(callee, -1, k.arg, k.value.id,
+                                       attr_call)
+                        )
+
+            flow = FunctionFlow(
+                audit.module, imports, on_call, params=fn.params
+            )
+            flow.walk(getattr(fn.node, "body", []))
+            summaries[fn.qual] = summary
+            passes[fn.qual] = plist
+    # propagate caller-param → callee-sink-param, depth-bounded fixpoint
+    for _ in range(graph.depth):
+        changed = False
+        for qual, plist in passes.items():
+            s = summaries[qual]
+            for p in plist:
+                callee = summaries.get(p.callee)
+                if callee is None or not callee.sink_params:
+                    continue
+                for name in _aligned_params(callee, p):
+                    if (
+                        name in callee.sink_params
+                        and p.caller_param not in s.sink_params
+                    ):
+                        s.sink_params.add(p.caller_param)
+                        changed = True
+        if not changed:
+            break
+    return {q: s for q, s in summaries.items() if s.sink_params}
+
+
+def _call_arg(call: ast.Call, pos: int, kw: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
 
 
 class FunctionFlow:
@@ -360,11 +532,22 @@ class FunctionFlow:
 class _ProtocolAuditor:
     """Runs both dataflow rule families over one module."""
 
-    def __init__(self, module: Module):
+    def __init__(
+        self,
+        module: Module,
+        audit: Optional["ModuleAudit"] = None,
+        graph: Optional["CallGraph"] = None,
+        global_summaries: Optional[Dict[str, SinkSummary]] = None,
+    ):
         self.module = module
         self.imports = collect_imports(module.tree)
         self.findings: Set[Finding] = set()
         self.summaries: Dict[str, SinkSummary] = {}
+        self.audit = audit
+        self.graph = graph
+        self.global_summaries = global_summaries or {}
+        #: resolution context while walking one function (v3)
+        self._current_fn: Optional["FnAudit"] = None
 
     # ------------------------------------------------------------ plumbing
     def _add(self, rule: str, node: ast.AST, message: str) -> None:
@@ -393,9 +576,10 @@ class _ProtocolAuditor:
 
     # ------------------------------------------------------ phase 1: summaries
     def collect_summaries(self) -> None:
-        """Which params of each module function reach a value sink —
-        the one-hop machinery lockgraph.py pioneered, retargeted from
-        locks to protocol values."""
+        """Which params of each module function reach a value sink
+        DIRECTLY — the same-module terminal-name fallback for calls the
+        whole-program graph cannot resolve (the transitive summaries
+        live in :func:`collect_sink_summaries`)."""
         for node in ast.walk(self.module.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -429,15 +613,28 @@ class _ProtocolAuditor:
     # ------------------------------------------------------- phase 2: rules
     def run(self) -> List[Finding]:
         self.collect_summaries()
+        if self.audit is not None:
+            self._current_fn = self.audit.functions[0]  # <module> record
         flow = FunctionFlow(self.module, self.imports, self._on_call)
         flow.walk(self.module.tree.body)
-        for node in ast.walk(self.module.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if self.audit is not None and self.graph is not None:
+            for fn in self.audit.functions:
+                if fn.node is None:
+                    continue
+                self._current_fn = fn
                 fn_flow = FunctionFlow(
                     self.module, self.imports, self._on_call,
-                    params=[a.arg for a in node.args.args],
+                    params=fn.params,
                 )
-                fn_flow.walk(node.body)
+                fn_flow.walk(getattr(fn.node, "body", []))
+        else:
+            for node in ast.walk(self.module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn_flow = FunctionFlow(
+                        self.module, self.imports, self._on_call,
+                        params=[a.arg for a in node.args.args],
+                    )
+                    fn_flow.walk(node.body)
         return sorted(self.findings)
 
     def _on_call(self, call: ast.Call, flow: FunctionFlow) -> None:
@@ -516,11 +713,28 @@ class _ProtocolAuditor:
     def _check_summary_call(
         self, call: ast.Call, flow: FunctionFlow, term: Optional[str]
     ) -> None:
-        summary = self.summaries.get(term or "")
-        if summary is None or term in VALUE_SINKS:
+        if term in VALUE_SINKS:
             return
-        # map call-site args back to parameter names (one hop, same
-        # module). A shifted (method) summary is tried under BOTH
+        # v3: the whole-program summary first (transitive, cross-module);
+        # the same-module terminal-name map remains the fallback for
+        # calls the graph cannot resolve (unknown receivers)
+        summary: Optional[SinkSummary] = None
+        if (
+            self.graph is not None
+            and self.audit is not None
+            and self._current_fn is not None
+        ):
+            qual = _resolve_ast_call(
+                self.graph, self.audit, self._current_fn, call, self.imports
+            )
+            if qual is not None:
+                summary = self.global_summaries.get(qual)
+        if summary is None:
+            summary = self.summaries.get(term or "")
+        if summary is None:
+            return
+        # map call-site args back to parameter names. A shifted
+        # (method) summary is tried under BOTH
         # alignments — `self.publish(x)` drops self at the call site,
         # `Cls.publish(obj, x)` passes it explicitly; a raw literal that
         # only lines up under the wrong alignment is still a raw mode
@@ -553,7 +767,15 @@ class _ProtocolAuditor:
                 )
 
 
-def protocol_findings(module: Module) -> List[Finding]:
+def protocol_findings(
+    module: Module,
+    audit: Optional["ModuleAudit"] = None,
+    graph: Optional["CallGraph"] = None,
+    summaries: Optional[Dict[str, SinkSummary]] = None,
+) -> List[Finding]:
     """Run the protocol-literal and unvalidated-mode rule families over
-    one module (the per-module entry analyze_modules drives)."""
-    return _ProtocolAuditor(module).run()
+    one module (the per-module entry analyze_modules drives). With
+    ``audit``/``graph``/``summaries`` the call-site check consults the
+    whole-program transitive sink summaries; without them it falls back
+    to the v2 same-module behavior (unit-test seam)."""
+    return _ProtocolAuditor(module, audit, graph, summaries).run()

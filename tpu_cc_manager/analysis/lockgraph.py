@@ -1,40 +1,44 @@
 """ccaudit lock-order graph: ABBA-cycle detection over ``with`` nesting.
 
 Nodes are module/class-qualified lock names (``agent.Agent._event_lock``).
-Edges come from two sources, both per-module:
+Edges come from two sources:
 
 - **lexical nesting** — ``with a:`` containing ``with b:`` adds a→b;
-- **a one-hop call summary** — a call made while ``a`` is held, to a
-  same-module function whose top level acquires ``b``, adds a→b. This is
-  deliberately one hop and same-module: deeper interprocedural resolution
-  would need whole-program points-to analysis and its false positives
-  would drown the signal.
+- **a transitive call summary** (v3) — a call made while ``a`` is held,
+  resolved through the whole-program call graph (``callgraph.py``:
+  module attributes, ``self.``-methods, nested defs, typed locals),
+  adds a→b for every lock ``b`` the callee's transitive closure
+  acquires while holding nothing. The closure is cycle-safe and
+  depth-bounded (``callgraph.DEPTH_LIMIT``, ``--call-depth`` on the
+  CLI is the escape hatch; ``--call-depth 0`` restricts summaries to
+  the direct callee — the old v2 one-hop horizon).
 
 All modules' edges land in one global graph, so an inversion between,
-say, ``engine`` and ``simlab`` helpers shows up as long as each edge is
-visible in some module. A cycle means two threads can acquire the same
-locks in opposite orders — the classic ABBA deadlock that only fires
-under fleet-scale contention.
+say, ``engine`` and ``simlab`` helpers shows up even when each side of
+the cycle lives behind two calls in different modules. A cycle means two
+threads can acquire the same locks in opposite orders — the classic ABBA
+deadlock that only fires under fleet-scale contention.
 
-A self-edge (a lock re-acquired while already held) is reported only for
-lexical nesting of a lock known to be non-reentrant; re-entering an
-``RLock``/``Condition`` is legal.
+A self-edge (a lock re-acquired while already held, lexically or through
+any resolved call chain) is reported unless the lock is known reentrant
+(``RLock``/``Condition``).
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, List, Sequence, Set, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Set, Tuple
 
 from tpu_cc_manager.analysis.core import Finding
 
 if TYPE_CHECKING:  # pragma: no cover - typing only (no runtime cycle risk)
+    from tpu_cc_manager.analysis.callgraph import CallGraph
     from tpu_cc_manager.analysis.rules import LockSite, ModuleAudit
 
 RULE = "lock-order"
 
 
 def _edges(
-    audits: Sequence["ModuleAudit"],
+    audits: Sequence["ModuleAudit"], graph: Optional["CallGraph"]
 ) -> Dict[Tuple[str, str], "LockSite"]:
     """(outer_qual, inner_qual) -> evidence LockSite of the inner acquire,
     keeping the lexically-first evidence per edge for stable output."""
@@ -49,10 +53,27 @@ def _edges(
     for audit in audits:
         for outer, inner in audit.lock_edges:
             add(outer.qual, inner.qual, inner)
-        fn_locks = audit.fn_locks
-        for held, callee in audit.calls_under_lock:
-            for site in fn_locks.get(callee, ()):
-                add(held.qual, site.qual, site)
+        if graph is None:
+            continue
+        # v2-parity fallback for receivers the graph cannot resolve:
+        # same-module functions matched by terminal name, direct entry
+        # locks only (one hop, no transitivity — the old horizon is a
+        # strict floor, same contract as dataflow's fallback)
+        by_name: Dict[str, List["LockSite"]] = {}
+        for fn in audit.functions:
+            if fn.entry_locks:
+                by_name.setdefault(fn.name, []).extend(fn.entry_locks)
+        for fn in audit.functions:
+            for call in fn.calls:
+                if call.held is None:
+                    continue
+                callee = graph.resolve_call(audit, fn, call)
+                if callee is not None:
+                    for site in graph.transitive_entry_locks(callee):
+                        add(call.held.qual, site.qual, site)
+                elif call.term is not None:
+                    for site in by_name.get(call.term, ()):
+                        add(call.held.qual, site.qual, site)
     return edges
 
 
@@ -106,9 +127,11 @@ def _sccs(nodes: Sequence[str], adj: Dict[str, Set[str]]) -> List[List[str]]:
     return out
 
 
-def order_findings(audits: Sequence["ModuleAudit"]) -> List[Finding]:
+def order_findings(
+    audits: Sequence["ModuleAudit"], graph: Optional["CallGraph"] = None
+) -> List[Finding]:
     by_relpath = {a.module.relpath: a.module for a in audits}
-    edges = _edges(audits)
+    edges = _edges(audits, graph)
 
     findings: List[Finding] = []
 

@@ -2,8 +2,11 @@
 
 ``audit_module`` produces per-module findings (raw-acquire,
 blocking-under-lock, label-literal, swallow) and the raw material the
-cross-module passes consume: lock-order edges (``lockgraph.py``) and
-metric declarations/uses (``metric_findings`` below).
+cross-module passes consume: lexical lock-order edges plus per-function
+records (``FnAudit``) — entry locks, call sites with their held lock,
+blocking sites, thread/callback references, and shared-state accesses —
+from which ``callgraph.py`` builds the whole-program call graph and
+``threads.py``/``lockset.py`` run the v3 concurrency passes.
 """
 
 from __future__ import annotations
@@ -11,13 +14,14 @@ from __future__ import annotations
 import ast
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 from tpu_cc_manager.analysis.core import (
     Finding,
     Module,
     collect_imports,
     dotted as _dotted,
+    module_dotted,
     resolve_dotted,
 )
 from tpu_cc_manager.modes import Mode as _Mode
@@ -114,18 +118,161 @@ class LockSite:
 
 
 @dataclass
+class BlockSite:
+    """One call that blocks on I/O, the clock, or another thread."""
+
+    what: str  #: display, e.g. ``time.sleep`` or ``fut.result()``
+    file: str
+    line: int
+    text: str
+    #: a ``blocking-under-lock`` pragma on the site sanctions it — the
+    #: transitive pass must not re-report a deliberately blessed wait
+    suppressed: bool
+
+
+@dataclass
+class ArgRef:
+    """A function-reference-shaped argument at a call site — the raw
+    material for parameter-callback linking (callgraph.py): if the
+    callee ever *calls* the parameter this lands on, the callback runs
+    in the calling site's thread context."""
+
+    pos: "int | str"  #: positional index or keyword name
+    attr_self: Optional[str]
+    cls: Optional[str]
+    bare: Optional[str]
+    dotted: Optional[str]
+
+
+@dataclass
+class CallRecord:
+    """One call site, with everything the resolver needs."""
+
+    #: method name when the call is ``self.m(...)`` (or on a ``self``
+    #: alias like the webhook's ``outer``)
+    attr_self: Optional[str]
+    #: class the ``self``/alias receiver belongs to (aliases may point
+    #: at an ENCLOSING class, not the caller's own)
+    cls: Optional[str]
+    #: bare ``name(...)`` — resolved against nested defs, then the module
+    bare: Optional[str]
+    #: import-folded dotted path (``tpu_cc_manager.modes.parse_mode``)
+    resolved: Optional[str]
+    #: terminal name (legacy same-module summary fallback in dataflow)
+    term: Optional[str]
+    #: full dotted candidate when the receiver is a typed local
+    #: (``fleet = FleetController(...)``; ``fleet.run()`` →
+    #: ``tpu_cc_manager.fleet.FleetController.run``)
+    recv_class: Optional[str]
+    line: int
+    #: innermost lock held lexically at the call site, if any
+    held: Optional[LockSite]
+    #: quals of EVERY lock held lexically at the site — the lockset
+    #: pass propagates these into the callee (the ``_locked``-suffix
+    #: convention: the guard lives at the caller)
+    held_locks: FrozenSet[str] = frozenset()
+    #: reference-shaped args (incl. values inside dict/list/tuple
+    #: literal args) for parameter-callback linking
+    arg_refs: List[ArgRef] = field(default_factory=list)
+
+
+@dataclass
+class RefSite:
+    """A function *reference* escaping into thread-spawn machinery: a
+    ``threading.Thread(target=…)`` or an executor ``submit`` callable.
+    (Callbacks handed to other components are NOT RefSites — they get
+    call-graph edges via ``ArgRef`` + parameter-callback linking.)"""
+
+    kind: str  #: "thread" | "submit"
+    attr_self: Optional[str]
+    #: class the ``self``/alias receiver belongs to (for ``attr_self``)
+    cls: Optional[str]
+    bare: Optional[str]
+    resolved: Optional[str]
+    #: full dotted candidate built from a typed local receiver
+    #: (``tpu_cc_manager.fleet.FleetController.run``)
+    recv_class: Optional[str]
+    line: int
+    #: spawned in a loop / executor / per-request handler — the root is
+    #: concurrent with ITSELF, so one context is already a race surface
+    self_concurrent: bool
+
+
+@dataclass
+class AccessSite:
+    """One read/write of shared-shaped state: a ``self.``-attribute or a
+    mutable module global."""
+
+    key: Tuple[str, ...]  #: ("attr", Class, name) | ("global", name)
+    kind: str  #: "read" | "write"
+    locks: FrozenSet[str]  #: quals of locks held lexically at the site
+    #: happens-before everything: ``__init__`` / module top level
+    init: bool
+    fn_qual: str
+    file: str
+    line: int
+    text: str
+    suppressed: bool  #: ``race-lockset`` pragma on the site
+    #: write lexically before the first ``.start()`` in a function that
+    #: spawns a thread — happens-before the SPAWNED thread, but NOT
+    #: before concurrent invocations of the spawning function itself
+    #: (lockset.py only honors this when the function's own context is
+    #: a single non-self-concurrent one)
+    prespawn: bool = False
+
+
+@dataclass
+class FnAudit:
+    """Everything one function/method contributes to the call graph and
+    the thread/lockset passes."""
+
+    name: str
+    qual: str  #: ``<module dotted>.<scopes…>.<name>``
+    #: enclosing scope names above this function (classes and functions)
+    scope: Tuple[str, ...]
+    #: parallel kinds ("class"/"fn") — bare-name resolution only looks
+    #: through *function* scopes (Python scoping skips class bodies)
+    scope_kinds: Tuple[str, ...]
+    #: innermost enclosing class name (None for plain functions)
+    cls: Optional[str]
+    #: scope prefix up to and including the innermost class — the key
+    #: ``self.m()`` resolution uses, so nested classes stay distinct
+    class_path: Optional[Tuple[str, ...]]
+    params: List[str]
+    line: int
+    #: the def's AST node (None only for the ``<module>`` pseudo record)
+    #: — dataflow.py re-walks it for the global sink-summary fixpoint
+    node: Optional[ast.AST] = None
+    #: locks acquired while holding nothing — the transitive summary's
+    #: raw material (locks nested under others produce lexical edges)
+    entry_locks: List[LockSite] = field(default_factory=list)
+    calls: List[CallRecord] = field(default_factory=list)
+    blocking: List[BlockSite] = field(default_factory=list)
+    refs: List[RefSite] = field(default_factory=list)
+    accesses: List[AccessSite] = field(default_factory=list)
+    #: parameters stored into ``self`` attributes (``self.A = p``,
+    #: ``self.A[k] = p``, ``self.A.put(p)/append(p)/add(p)``) — the
+    #: other half of parameter-callback linking
+    param_attr_stores: List[Tuple[str, str]] = field(default_factory=list)
+    #: ``do_*`` method of a ``*RequestHandler`` subclass — runs on a
+    #: per-request thread of a ThreadingHTTPServer
+    handler_root: bool = False
+
+
+@dataclass
 class ModuleAudit:
     """Everything one module contributes to the global passes."""
 
     module: Module
+    #: importable dotted path (``tpu_cc_manager.device.fake``)
+    dotted: str = ""
     findings: List[Finding] = field(default_factory=list)
     #: lock-order edges: (outer LockSite, inner LockSite) — inner was
     #: acquired lexically while outer was held
     lock_edges: List[Tuple[LockSite, LockSite]] = field(default_factory=list)
-    #: function terminal name -> locks it acquires at its top level
-    fn_locks: Dict[str, List[LockSite]] = field(default_factory=dict)
-    #: calls made while a lock was held: (held LockSite, callee terminal name)
-    calls_under_lock: List[Tuple[LockSite, str]] = field(default_factory=list)
+    #: per-function records, including the ``<module>`` top-level pseudo
+    #: record (index 0) for import-time thread spawns
+    functions: List[FnAudit] = field(default_factory=list)
     #: metric declarations: name -> [(file, line, text)]
     metric_decls: Dict[str, List[Tuple[str, int, str]]] = field(
         default_factory=dict
@@ -179,12 +326,41 @@ def _collect_docstring_nodes(tree: ast.Module) -> Set[int]:
     return out
 
 
+#: Attribute method names that mutate their receiver in place — a call
+#: like ``self.chips.append(x)`` is a WRITE to ``chips``. Queue verbs
+#: (put/get) are deliberately absent: queue.Queue is internally locked.
+#: ``update``/``clear``/``set`` are absent too — they collide with this
+#: project's method vocabulary (``metrics.update``) and with
+#: ``threading.Event`` (internally locked), and would swamp the signal.
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "extend", "insert",
+    "remove", "discard", "setdefault", "pop", "popitem",
+    "popleft", "sort",
+})
+
+#: Ctors whose result is shared-mutable module state when assigned at
+#: module top level (the race pass's module-global domain).
+_MUTABLE_GLOBAL_CTORS = frozenset({
+    "set", "dict", "list", "deque", "defaultdict", "Counter",
+    "OrderedDict",
+})
+
+#: container store/fetch verbs for parameter-callback linking through a
+#: queue/deque attribute (`self._q.put(task)` … `task = self._q.get()`)
+_CONTAINER_STORE_METHODS = frozenset({
+    "put", "put_nowait", "append", "appendleft", "add",
+})
+_CONTAINER_GET_METHODS = frozenset({"get", "get_nowait", "pop", "popleft"})
+
+
 class _Walker(ast.NodeVisitor):
     def __init__(self, audit: ModuleAudit):
         self.audit = audit
         self.module = audit.module
         modname = self.module.relpath.rsplit("/", 1)[-1]
         self.modbase = modname[:-3] if modname.endswith(".py") else modname
+        self.dotted_mod = module_dotted(self.module.relpath)
+        audit.dotted = self.dotted_mod
         self.docstrings = _collect_docstring_nodes(self.module.tree)
         #: Constant nodes that are a metric declaration's name argument
         self._decl_nodes: Set[int] = set()
@@ -202,6 +378,66 @@ class _Walker(ast.NodeVisitor):
         #: If nodes already consumed as an elif of an analyzed chain
         self._elif_seen: Set[int] = set()
         self.label_exempt = self._label_exempt(self.module.relpath)
+        # ---- v3 collection state -------------------------------------
+        #: full scope chain of (kind, name) above the current node
+        self.scope_stack: List[Tuple[str, str]] = []
+        #: ``x = self`` closure aliases (webhook's ``outer``): name →
+        #: class the aliased self belongs to; inherited by nested scopes
+        self.self_aliases: Dict[str, str] = {}
+        #: ``x = SomeClass(...)`` typed locals: name → ctor dotted path
+        self.var_types: Dict[str, str] = {}
+        #: base-class terminal names per class scope qual
+        self._class_bases: Dict[Tuple[str, ...], List[str]] = {}
+        self.loop_depth = 0
+        #: Attribute nodes that are a call's func (method access, not a
+        #: state read) — visit_Call marks them before children are walked
+        self._call_func_attrs: Set[int] = set()
+        #: receiver nodes of in-place mutator calls / subscript stores —
+        #: recorded as writes instead of reads
+        self._mutated_receivers: Set[int] = set()
+        #: local var → self-attr it was fetched from (`x = self._q.get()`)
+        self._attr_origin: Dict[str, str] = {}
+        #: names the current function binds locally (no `global` decl) —
+        #: they shadow same-named module globals (per-scope, like
+        #: _attr_origin)
+        self._local_shadows: Set[str] = set()
+        #: module-level names bound to mutable containers (prescanned)
+        self.mutable_globals: Set[str] = self._prescan_globals()
+        top = FnAudit(
+            name="<module>", qual=self.dotted_mod, scope=(),
+            scope_kinds=(), cls=None, class_path=None, params=[], line=1,
+        )
+        audit.functions.append(top)
+        self.fn_stack: List[FnAudit] = [top]
+
+    def _prescan_globals(self) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in self.module.tree.body:
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if value is None:
+                continue
+            mutable = isinstance(
+                value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                        ast.SetComp, ast.DictComp)
+            )
+            if not mutable and isinstance(value, ast.Call):
+                term = _terminal_name(value.func)
+                mutable = term in _MUTABLE_GLOBAL_CTORS
+            if not mutable:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        # names rebound via an explicit `global` declaration count too
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+        return out
 
     @staticmethod
     def _label_exempt(relpath: str) -> bool:
@@ -237,6 +473,60 @@ class _Walker(ast.NodeVisitor):
                 name = _terminal_name(tgt)
                 if name:
                     self.known_locks[name] = ctor in _REENTRANT_CTORS
+        # `outer = self` inside a class method: attribute accesses on
+        # `outer` (typically from a nested handler class) are accesses
+        # on THIS class's instance — the webhook/RouteServer idiom.
+        # ANY other assignment to a tracked name invalidates its alias/
+        # type so a later unrelated `outer = make_thing()` can't be
+        # misattributed (both maps are also saved/copied per scope).
+        is_self_alias = (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and bool(self.class_stack)
+        )
+        ctor_path: Optional[str] = None
+        if isinstance(node.value, ast.Call):
+            path = self._resolve(node.value.func)
+            term = path.rsplit(".", 1)[-1] if path else None
+            if path and term and term[:1].isupper():
+                ctor_path = path
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if is_self_alias:
+                self.self_aliases[tgt.id] = self.class_stack[-1]
+            else:
+                self.self_aliases.pop(tgt.id, None)
+            # `fleet = FleetController(...)`: remember the ctor path so
+            # a later `Thread(target=fleet.run)` can resolve the method
+            if ctor_path is not None:
+                self.var_types[tgt.id] = ctor_path
+            else:
+                self.var_types.pop(tgt.id, None)
+        # parameter-callback linking, store half: `self.A = p` /
+        # `self.A[k] = p` with p a parameter of the enclosing function
+        fn = self.fn_stack[-1]
+        if isinstance(node.value, ast.Name) and node.value.id in fn.params:
+            for tgt in node.targets:
+                attr_tgt = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                if isinstance(
+                    attr_tgt, ast.Attribute
+                ) and self._self_class_of(attr_tgt.value):
+                    fn.param_attr_stores.append(
+                        (node.value.id, attr_tgt.attr)
+                    )
+        # `event = self._queue.get()`: calls of `event` later in the
+        # function are calls through the queue attribute
+        if (
+            isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr in _CONTAINER_GET_METHODS
+            and isinstance(node.value.func.value, ast.Attribute)
+            and self._self_class_of(node.value.func.value.value)
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._attr_origin[tgt.id] = node.value.func.value.attr
         self.generic_visit(node)
 
     def _is_lock_expr(self, expr: ast.AST) -> bool:
@@ -280,10 +570,11 @@ class _Walker(ast.NodeVisitor):
             site = self._lock_site(expr, node)
             if self.lock_stack:
                 self.audit.lock_edges.append((self.lock_stack[-1], site))
-            elif self.func_stack:
-                self.audit.fn_locks.setdefault(self.func_stack[-1], []).append(
-                    site
-                )
+            else:
+                # acquired while holding nothing: this function's entry
+                # lock — what a caller holding X transitively orders
+                # X ahead of (callgraph.py consumes it)
+                self.fn_stack[-1].entry_locks.append(site)
             self.lock_stack.append(site)
             pushed += 1
         for stmt in node.body:
@@ -296,15 +587,124 @@ class _Walker(ast.NodeVisitor):
 
     # ------------------------------------------------------- scope resets
 
-    def _visit_scope(self, node: ast.AST, name: str) -> None:
+    def _class_path(self) -> Optional[Tuple[str, ...]]:
+        """Scope prefix up to and including the innermost class."""
+        if not self.class_stack:
+            return None
+        names = [n for _, n in self.scope_stack]
+        for i in range(len(self.scope_stack) - 1, -1, -1):
+            if self.scope_stack[i][0] == "class":
+                return tuple(names[: i + 1])
+        return None
+
+    def _visit_scope(
+        self, node: "ast.FunctionDef | ast.AsyncFunctionDef", name: str
+    ) -> None:
         saved_stack, self.lock_stack = self.lock_stack, []
         saved_released = self._finally_released
+        saved_loop, self.loop_depth = self.loop_depth, 0
+        saved_origin, self._attr_origin = self._attr_origin, {}
+        saved_shadows = self._local_shadows
+        self._local_shadows = self._collect_local_bindings(node)
+        # nested scopes SEE enclosing aliases/typed locals (closures:
+        # the Handler-in-__init__ idiom) but their own bindings must
+        # not leak back out
+        saved_aliases = self.self_aliases
+        self.self_aliases = dict(saved_aliases)
+        saved_types = self.var_types
+        self.var_types = dict(saved_types)
         self._finally_released = self._collect_finally_releases(node)
         self.func_stack.append(name)
+        scope = tuple(n for _, n in self.scope_stack)
+        fn = FnAudit(
+            name=name,
+            qual=".".join((self.dotted_mod,) + scope + (name,)),
+            scope=scope,
+            scope_kinds=tuple(k for k, _ in self.scope_stack),
+            cls=self.class_stack[-1] if self.class_stack else None,
+            class_path=self._class_path(),
+            params=[a.arg for a in node.args.args],
+            line=node.lineno,
+            node=node,
+            handler_root=self._is_handler_method(name),
+        )
+        self.audit.functions.append(fn)
+        self.fn_stack.append(fn)
+        self.scope_stack.append(("fn", name))
         self.generic_visit(node)
+        self.scope_stack.pop()
+        self.fn_stack.pop()
         self.func_stack.pop()
         self.lock_stack = saved_stack
         self._finally_released = saved_released
+        self.loop_depth = saved_loop
+        self._attr_origin = saved_origin
+        self._local_shadows = saved_shadows
+        self.self_aliases = saved_aliases
+        self.var_types = saved_types
+        self._finalize_prespawn(fn)
+
+    def _is_handler_method(self, name: str) -> bool:
+        """``do_*`` methods of ``*RequestHandler`` subclasses run on
+        per-request threads of a ThreadingHTTPServer — thread roots the
+        spawn site (stdlib internals) never shows."""
+        if not name.startswith("do_") or not self.class_stack:
+            return False
+        path = self._class_path()
+        bases = self._class_bases.get(path or (), [])
+        return any(b.endswith("RequestHandler") for b in bases)
+
+    @staticmethod
+    def _collect_local_bindings(
+        node: "ast.FunctionDef | ast.AsyncFunctionDef",
+    ) -> Set[str]:
+        """Names this function binds (Name stores, for/with/except
+        targets, params) minus its ``global`` declarations — they
+        shadow same-named module globals. Nested defs are separate
+        scopes and are not descended into."""
+        out: Set[str] = {a.arg for a in node.args.args}
+        out.update(a.arg for a in node.args.kwonlyargs)
+        if node.args.vararg:
+            out.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            out.add(node.args.kwarg.arg)
+        declared_global: Set[str] = set()
+        stack = list(node.body)
+        while stack:
+            stmt = stack.pop()
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                       ast.Lambda)
+            ):
+                continue
+            if isinstance(stmt, ast.Global):
+                declared_global.update(stmt.names)
+            elif isinstance(stmt, ast.Name) and isinstance(
+                stmt.ctx, ast.Store
+            ):
+                out.add(stmt.id)
+            stack.extend(ast.iter_child_nodes(stmt))
+        return out - declared_global
+
+    @staticmethod
+    def _finalize_prespawn(fn: FnAudit) -> None:
+        """Writes lexically before the first ``.start()`` in the
+        function that spawns a thread happen-before the spawn — the
+        init-before-spawn pattern. Marked ``prespawn`` (not ``init``):
+        the exemption only holds against the spawned thread, so the
+        race pass re-checks that the spawning function itself is not
+        invoked concurrently."""
+        if not any(r.kind == "thread" for r in fn.refs):
+            return
+        starts = [
+            c.line for c in fn.calls if c.term == "start" and c.bare is None
+        ]
+        if not starts:
+            return
+        first = min(starts)
+        for a in fn.accesses:
+            if a.kind == "write" and a.line < first:
+                a.prespawn = True
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._visit_scope(node, node.name)
@@ -319,10 +719,28 @@ class _Walker(ast.NodeVisitor):
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self.class_stack.append(node.name)
+        self.scope_stack.append(("class", node.name))
+        self._class_bases[tuple(n for _, n in self.scope_stack)] = [
+            t for t in (_terminal_name(b) for b in node.bases)
+            if t is not None
+        ]
         saved, self.lock_stack = self.lock_stack, []
         self.generic_visit(node)
         self.lock_stack = saved
+        self.scope_stack.pop()
         self.class_stack.pop()
+
+    def visit_For(self, node: ast.For) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
 
     # ---------------------------------------------------------- raw acquire
 
@@ -353,8 +771,180 @@ class _Walker(ast.NodeVisitor):
 
     # ------------------------------------------------------------- calls
 
+    # ---------------------------------------------- v3 site collection
+
+    def _self_class_of(self, expr: ast.AST) -> Optional[str]:
+        """Class whose instance ``expr`` denotes: ``self`` (innermost
+        class) or a recorded ``x = self`` alias."""
+        if not isinstance(expr, ast.Name):
+            return None
+        if expr.id == "self" and self.class_stack:
+            return self.class_stack[-1]
+        return self.self_aliases.get(expr.id)
+
+    def _maybe_ref(
+        self, expr: ast.AST, kind: str, self_concurrent: bool = False
+    ) -> None:
+        """Record ``expr`` as a thread-spawn target reference when it
+        is reference-shaped; the resolver (threads.py) drops anything
+        that doesn't name a real function."""
+        site: Optional[RefSite] = None
+        line = getattr(expr, "lineno", 1)
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            cls = self._self_class_of(expr.value)
+            if cls is not None:
+                site = RefSite(
+                    kind=kind, attr_self=expr.attr, cls=cls, bare=None,
+                    resolved=None, recv_class=None, line=line,
+                    self_concurrent=self_concurrent,
+                )
+            elif expr.value.id in self.var_types:
+                # typed-local receiver (`agent.run` with `agent =
+                # CCManagerAgent(...)`): a loop-spawn almost always
+                # constructs a FRESH instance per iteration (bench's
+                # per-node agents), so the root does not race itself
+                # on instance state
+                site = RefSite(
+                    kind=kind, attr_self=None, cls=None, bare=None,
+                    resolved=None,
+                    recv_class=(
+                        f"{self.var_types[expr.value.id]}.{expr.attr}"
+                    ),
+                    line=line, self_concurrent=False,
+                )
+        if site is None and isinstance(expr, (ast.Name, ast.Attribute)):
+            if isinstance(expr, ast.Name):
+                site = RefSite(
+                    kind=kind, attr_self=None, cls=None, bare=expr.id,
+                    resolved=None, recv_class=None, line=line,
+                    self_concurrent=self_concurrent,
+                )
+            else:
+                resolved = self._resolve(expr)
+                if resolved:
+                    site = RefSite(
+                        kind=kind, attr_self=None, cls=None, bare=None,
+                        resolved=resolved, recv_class=None, line=line,
+                        self_concurrent=self_concurrent,
+                    )
+        if site is not None:
+            self.fn_stack[-1].refs.append(site)
+
+    def _arg_ref(self, pos: "int | str", expr: ast.AST) -> Optional[ArgRef]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            cls = self._self_class_of(expr.value)
+            if cls is not None:
+                return ArgRef(
+                    pos=pos, attr_self=expr.attr, cls=cls, bare=None,
+                    dotted=None,
+                )
+            if expr.value.id in self.var_types:
+                return ArgRef(
+                    pos=pos, attr_self=None, cls=None, bare=None,
+                    dotted=f"{self.var_types[expr.value.id]}.{expr.attr}",
+                )
+            resolved = self._resolve(expr)
+            if resolved:
+                return ArgRef(
+                    pos=pos, attr_self=None, cls=None, bare=None,
+                    dotted=resolved,
+                )
+        elif isinstance(expr, ast.Name):
+            return ArgRef(
+                pos=pos, attr_self=None, cls=None, bare=expr.id, dotted=None,
+            )
+        return None
+
+    def _collect_arg_refs(self, node: ast.Call) -> List[ArgRef]:
+        """Reference-shaped args, looking through dict/list/tuple
+        literals (callback tables like RouteServer's ``routes``)."""
+        out: List[ArgRef] = []
+        args: List[Tuple["int | str", ast.AST]] = list(enumerate(node.args))
+        args += [(k.arg, k.value) for k in node.keywords if k.arg]
+        for pos, expr in args:
+            values: List[ast.AST] = [expr]
+            if isinstance(expr, ast.Dict):
+                values = [v for v in expr.values if v is not None]
+            elif isinstance(expr, (ast.List, ast.Tuple)):
+                values = list(expr.elts)
+            for v in values:
+                ref = self._arg_ref(pos, v)
+                if ref is not None:
+                    out.append(ref)
+        return out
+
+    def _record_access(
+        self, key: Tuple[str, ...], kind: str, node: ast.AST
+    ) -> None:
+        if len(self.fn_stack) < 2:
+            return  # module top level: import time is single-threaded
+        fn = self.fn_stack[-1]
+        line = getattr(node, "lineno", 1)
+        fn.accesses.append(
+            AccessSite(
+                key=key,
+                kind=kind,
+                locks=frozenset(s.qual for s in self.lock_stack),
+                init=fn.name == "__init__",
+                fn_qual=fn.qual,
+                file=self.module.relpath,
+                line=line,
+                text=self.module.line_text(line),
+                suppressed=self.module.suppressed("race-lockset", line),
+            )
+        )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        cls = self._self_class_of(node.value)
+        if (
+            cls is not None
+            and id(node) not in self._call_func_attrs
+            and node.attr not in self.known_locks
+            and not _LOCKY_NAME.search(node.attr)
+        ):
+            write = isinstance(node.ctx, (ast.Store, ast.Del)) or (
+                id(node) in self._mutated_receivers
+            )
+            self._record_access(
+                ("attr", cls, node.attr), "write" if write else "read", node
+            )
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if (
+            node.id in self.mutable_globals
+            and len(self.fn_stack) > 1
+            # Python scoping: a name ASSIGNED in the function without a
+            # `global` declaration is function-local — it shadows the
+            # module global and never touches shared state
+            and node.id not in self._local_shadows
+        ):
+            write = isinstance(node.ctx, (ast.Store, ast.Del)) or (
+                id(node) in self._mutated_receivers
+            )
+            self._record_access(
+                ("global", node.id), "write" if write else "read", node
+            )
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # `self.map[k] = v` / `G[k] = v`: the subscript store mutates
+        # the container the receiver names
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._mutated_receivers.add(id(node.value))
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
+        if isinstance(func, ast.Attribute):
+            self._call_func_attrs.add(id(func))
+        resolved = self._resolve(func)
+        term = _terminal_name(func)
+
         # raw-acquire: lock.acquire() outside with, without finally release
         if isinstance(func, ast.Attribute) and func.attr == "acquire":
             if self._is_lock_expr(func.value):
@@ -368,14 +958,36 @@ class _Walker(ast.NodeVisitor):
                         "try/finally release",
                     )
 
-        # blocking-under-lock
+        # blocking sites: recorded for every function (the transitive
+        # pass needs them), flagged directly only when a lock is held
+        is_blocking = bool(resolved) and any(
+            resolved == p or resolved.startswith(p)
+            for p in _BLOCKING_PREFIXES
+        )
+        is_executor_wait = (
+            not is_blocking
+            and isinstance(func, ast.Attribute)
+            and func.attr in _EXECUTOR_WAIT_METHODS
+        )
+        if is_blocking or is_executor_wait:
+            what = (
+                str(resolved) if is_blocking
+                else f"{_dotted(func) or func.attr}()"
+            )
+            self.fn_stack[-1].blocking.append(
+                BlockSite(
+                    what=what,
+                    file=self.module.relpath,
+                    line=node.lineno,
+                    text=self.module.line_text(node.lineno),
+                    suppressed=self.module.suppressed(
+                        "blocking-under-lock", node.lineno
+                    ),
+                )
+            )
         if self.lock_stack:
-            resolved = self._resolve(func)
-            if resolved and any(
-                resolved == p or resolved.startswith(p)
-                for p in _BLOCKING_PREFIXES
-            ):
-                held = self.lock_stack[-1]
+            held = self.lock_stack[-1]
+            if is_blocking:
                 self.audit.add(
                     "blocking-under-lock",
                     node,
@@ -388,11 +1000,7 @@ class _Walker(ast.NodeVisitor):
             # task) ever needs the held lock, this is a deadlock, not a
             # convoy. Method-name matched because a bare future has no
             # resolvable module path.
-            elif (
-                isinstance(func, ast.Attribute)
-                and func.attr in _EXECUTOR_WAIT_METHODS
-            ):
-                held = self.lock_stack[-1]
+            elif is_executor_wait:
                 self.audit.add(
                     "blocking-under-lock",
                     node,
@@ -402,16 +1010,96 @@ class _Walker(ast.NodeVisitor):
                     "any worker that needs the same lock; collect results "
                     "outside the critical section",
                 )
-            # interprocedural hop for the lock-order graph: same-module
-            # callee summaries are resolved in lockgraph.order_findings
-            callee = _terminal_name(func)
-            if callee:
-                self.audit.calls_under_lock.append(
-                    (self.lock_stack[-1], callee)
-                )
 
-        # metric declarations
-        term = _terminal_name(func)
+        # the call graph's raw material: one record per call site
+        attr_self: Optional[str] = None
+        call_cls: Optional[str] = None
+        bare: Optional[str] = None
+        recv_class: Optional[str] = None
+        if isinstance(func, ast.Name):
+            if func.id in self._attr_origin:
+                # `task = self._q.get(); task()` — a call through the
+                # queue attribute (parameter-callback linking)
+                attr_self = self._attr_origin[func.id]
+                call_cls = self.class_stack[-1] if self.class_stack else None
+            else:
+                bare = func.id
+        elif isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            recv_cls = self._self_class_of(func.value)
+            if recv_cls is not None:
+                # `self.m(...)` or `outer._bump(...)` through an alias
+                attr_self = func.attr
+                call_cls = recv_cls
+            elif func.value.id in self.var_types:
+                recv_class = f"{self.var_types[func.value.id]}.{func.attr}"
+        elif isinstance(func, ast.Subscript) and isinstance(
+            func.value, ast.Attribute
+        ):
+            table_cls = self._self_class_of(func.value.value)
+            if table_cls is not None:
+                # `self.routes[path](...)` — a call through a callback
+                # table
+                attr_self = func.value.attr
+                call_cls = table_cls
+        self.fn_stack[-1].calls.append(
+            CallRecord(
+                attr_self=attr_self,
+                cls=call_cls,
+                bare=bare,
+                resolved=resolved,
+                term=term,
+                recv_class=recv_class,
+                line=node.lineno,
+                held=self.lock_stack[-1] if self.lock_stack else None,
+                held_locks=frozenset(s.qual for s in self.lock_stack),
+                arg_refs=self._collect_arg_refs(node),
+            )
+        )
+
+        # thread roots (threads.py resolves)
+        if resolved == "threading.Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self._maybe_ref(
+                        kw.value, "thread",
+                        self_concurrent=self.loop_depth > 0,
+                    )
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr == "submit"
+            and node.args
+        ):
+            self._maybe_ref(node.args[0], "submit", self_concurrent=True)
+
+        # parameter-callback linking, container-store half:
+        # `self._q.put_nowait(task)` with task a parameter
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _CONTAINER_STORE_METHODS
+            and isinstance(func.value, ast.Attribute)
+            and self._self_class_of(func.value.value) is not None
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in self.fn_stack[-1].params
+        ):
+            self.fn_stack[-1].param_attr_stores.append(
+                (node.args[0].id, func.value.attr)
+            )
+
+        # in-place mutators: `self.chips.append(x)` writes `chips`
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _MUTATOR_METHODS
+        ):
+            recv = func.value
+            if isinstance(recv, ast.Attribute) or (
+                isinstance(recv, ast.Name) and recv.id in self.mutable_globals
+            ):
+                self._mutated_receivers.add(id(recv))
+
+        # metric declarations (`term` computed at the top of the visit)
         if (
             term in _METRIC_CTORS
             and node.args

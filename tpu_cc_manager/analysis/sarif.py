@@ -1,0 +1,246 @@
+"""SARIF 2.1.0 output for ccaudit (v3 satellite).
+
+``python -m tpu_cc_manager.analysis --sarif ccaudit.sarif`` writes the
+scan as a Static Analysis Results Interchange Format log alongside the
+normal text/JSON output, so the CI ``ccaudit`` job can upload it and
+findings annotate PR diffs inline (GitHub code scanning understands
+SARIF natively).
+
+The emitted subset is deliberately small and stable:
+
+- one ``run`` with the ``ccaudit`` tool driver and one ``rule`` entry
+  per rule id seen in the scan;
+- one ``result`` per finding — ``level`` is ``error`` for *new*
+  findings and ``note`` for baselined ones, which additionally carry a
+  ``suppressions`` entry (``kind: external``) so code-scanning UIs show
+  them as suppressed rather than open;
+- physical locations are repo-relative with ``uriBaseId: SRCROOT``.
+
+``validate_sarif`` is the structural contract the test suite enforces —
+the container has no jsonschema package, so the required-shape checks
+are spelled out by hand against the 2.1.0 spec.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from tpu_cc_manager.analysis.core import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+#: one-line help per rule id, mirrored from docs/analysis.md
+_RULE_HELP = {
+    "raw-acquire": "Locks are acquired via `with`, or paired with a "
+    "try/finally release.",
+    "lock-order": "Potential ABBA deadlock: locks acquired in opposite "
+    "orders across the transitive call graph.",
+    "blocking-under-lock": "Blocking call (sleep/subprocess/socket/"
+    "executor wait) reachable while a lock is held.",
+    "label-literal": "Hard-coded protocol label literal outside "
+    "labels.py.",
+    "swallow": "Broad except handler that neither re-raises, logs, nor "
+    "uses the bound exception.",
+    "metric-name": "Metric name without exactly one declaration.",
+    "protocol-literal": "Raw mode/state literal flowing into a "
+    "label-write API.",
+    "unvalidated-mode": "Mode label value reaching a device/subprocess "
+    "sink without parse_mode().",
+    "mode-exhaustive": "Mode dispatch that does not cover every enum "
+    "member.",
+    "protocol-liveness": "labels.py constant with no writer or no "
+    "reader in the scanned tree.",
+    "manifest-drift": "Deploy manifests / scenarios speaking a "
+    "different protocol than labels.py/modes.py.",
+    "race-lockset": "Shared location written with an empty or "
+    "inconsistent guarding lockset across thread contexts.",
+    "stale-baseline": "Baseline entry matching no current finding — "
+    "delete it (the ratchet only burns down).",
+}
+
+
+def _result(finding: Finding, suppressed: bool) -> dict:
+    out: dict = {
+        "ruleId": finding.rule,
+        "level": "note" if suppressed else "error",
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.file,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": max(1, finding.line),
+                        "snippet": {"text": finding.text},
+                    },
+                }
+            }
+        ],
+    }
+    if suppressed:
+        out["suppressions"] = [
+            {
+                "kind": "external",
+                "justification": "baselined in "
+                "tpu_cc_manager/analysis/baseline.json (the ratchet "
+                "only burns down)",
+            }
+        ]
+    return out
+
+
+def to_sarif(
+    new: Sequence[Finding],
+    suppressed: Sequence[Finding],
+    stale: Sequence[dict],
+) -> dict:
+    """Build the SARIF log dict for one ccaudit run. Stale baseline
+    entries are reported as ``stale-baseline`` results so the gate's
+    second failure mode annotates the PR too."""
+    results: List[dict] = []
+    rules_seen: Dict[str, None] = {}
+    for f in new:
+        results.append(_result(f, suppressed=False))
+        rules_seen.setdefault(f.rule)
+    for f in suppressed:
+        results.append(_result(f, suppressed=True))
+        rules_seen.setdefault(f.rule)
+    for e in stale:
+        rules_seen.setdefault("stale-baseline")
+        results.append(
+            {
+                "ruleId": "stale-baseline",
+                "level": "error",
+                "message": {
+                    "text": (
+                        f"baseline entry for rule {e.get('rule')!r} "
+                        "matches no current finding — delete it (or "
+                        "--write-baseline)"
+                    )
+                },
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": str(e.get("file", "")),
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {
+                                "startLine": max(1, int(e.get("line", 1)))
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "ccaudit",
+                        "informationUri": (
+                            "https://github.com/tpu-cc-manager/"
+                            "tpu-cc-manager/blob/main/docs/analysis.md"
+                        ),
+                        "rules": [
+                            {
+                                "id": rule,
+                                "shortDescription": {
+                                    "text": _RULE_HELP.get(rule, rule)
+                                },
+                            }
+                            for rule in sorted(rules_seen)
+                        ],
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+            }
+        ],
+    }
+
+
+def write_sarif(
+    path: str,
+    new: Sequence[Finding],
+    suppressed: Sequence[Finding],
+    stale: Sequence[dict],
+) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_sarif(new, suppressed, stale), f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def validate_sarif(doc: dict) -> List[str]:
+    """Structural validation against the SARIF 2.1.0 required shape
+    (the container has no jsonschema package — the spec's MUSTs for the
+    subset we emit are checked by hand). Returns a list of violations;
+    empty means valid."""
+    errors: List[str] = []
+
+    def need(cond: bool, msg: str) -> None:
+        if not cond:
+            errors.append(msg)
+
+    need(isinstance(doc, dict), "log must be an object")
+    if not isinstance(doc, dict):
+        return errors
+    need(doc.get("version") == SARIF_VERSION,
+         f"version must be {SARIF_VERSION!r}")
+    runs = doc.get("runs")
+    need(isinstance(runs, list) and len(runs) >= 1,
+         "runs must be a non-empty array")
+    for run in runs if isinstance(runs, list) else []:
+        need(isinstance(run, dict), "run must be an object")
+        if not isinstance(run, dict):
+            continue
+        driver = (run.get("tool") or {}).get("driver")
+        need(isinstance(driver, dict), "run.tool.driver is required")
+        if isinstance(driver, dict):
+            need(isinstance(driver.get("name"), str) and driver["name"],
+                 "driver.name must be a non-empty string")
+            for rule in driver.get("rules", []):
+                need(isinstance(rule.get("id"), str) and rule["id"],
+                     "rule.id must be a non-empty string")
+        rule_ids = {
+            r.get("id")
+            for r in (driver or {}).get("rules", [])
+            if isinstance(r, dict)
+        } if isinstance(driver, dict) else set()
+        results = run.get("results", [])
+        need(isinstance(results, list), "run.results must be an array")
+        for res in results if isinstance(results, list) else []:
+            need(isinstance(res.get("ruleId"), str),
+                 "result.ruleId must be a string")
+            need(res.get("level") in ("none", "note", "warning", "error"),
+                 f"result.level invalid: {res.get('level')!r}")
+            need(res.get("ruleId") in rule_ids,
+                 f"result.ruleId {res.get('ruleId')!r} not declared in "
+                 "driver.rules")
+            msg = res.get("message")
+            need(isinstance(msg, dict) and isinstance(msg.get("text"), str),
+                 "result.message.text is required")
+            for loc in res.get("locations", []):
+                phys = loc.get("physicalLocation", {})
+                art = phys.get("artifactLocation", {})
+                need(isinstance(art.get("uri"), str),
+                     "artifactLocation.uri must be a string")
+                region = phys.get("region", {})
+                start = region.get("startLine")
+                need(isinstance(start, int) and start >= 1,
+                     "region.startLine must be a positive integer")
+            for sup in res.get("suppressions", []):
+                need(sup.get("kind") in ("inSource", "external"),
+                     f"suppression.kind invalid: {sup.get('kind')!r}")
+    return errors

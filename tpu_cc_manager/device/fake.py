@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 from tpu_cc_manager.device.base import Backend, DeviceError, TpuChip
 
@@ -31,7 +31,7 @@ class FakeChip(TpuChip):
         cc_mode: str = "off",
         ici_mode: str = "off",
         reset_latency_s: float = 0.0,
-    ):
+    ) -> None:
         self.path = path
         self.name = name
         self.is_cc_query_supported = cc_capable
@@ -118,7 +118,11 @@ class FakeChip(TpuChip):
 
 
 class FakeBackend(Backend):
-    def __init__(self, chips: Optional[List[FakeChip]] = None, enum_error: Optional[str] = None):
+    def __init__(
+        self,
+        chips: Optional[List[FakeChip]] = None,
+        enum_error: Optional[str] = None,
+    ) -> None:
         self.chips: List[FakeChip] = chips if chips is not None else []
         self.enum_error = enum_error
 
@@ -129,7 +133,9 @@ class FakeBackend(Backend):
         return [c for c in self.chips if c.is_ici_switch()]
 
 
-def fake_backend(n_chips: int = 4, n_switches: int = 0, **chip_kwargs) -> FakeBackend:
+def fake_backend(
+    n_chips: int = 4, n_switches: int = 0, **chip_kwargs: Any
+) -> FakeBackend:
     """Convenience: a host with n uniform chips (+ optional ICI switches)."""
     chips = [
         FakeChip(path=f"/dev/accel{i}", **chip_kwargs) for i in range(n_chips)

@@ -163,6 +163,7 @@ class HolderCheck:
                 # would kill the session a completed sibling was
                 # reacquiring through wait_ready)
                 if find_holders(path):
+                    # ccaudit: allow-blocking-under-lock(the hook lock EXISTS to serialize this subprocess: parallel flip workers must restart the shared runtime once, not N times racing)
                     self._run_restart_hook(path)
         deadline = time.monotonic() + self.wait_s
         while True:

@@ -234,12 +234,14 @@ class ExecCredentialPlugin:
 
     def token(self) -> Optional[str]:
         with self._lock:
+            # ccaudit: allow-blocking-under-lock(single-flight credential fetch: the lock exists so N threads with an expired token exec the plugin once, not N times)
             self._ensure(datetime.datetime.now(datetime.timezone.utc))
             return self._token
 
     def client_cert_pair(self) -> Optional[Tuple[str, str]]:
         """(cert_file, key_file) when the plugin returned TLS credentials."""
         with self._lock:
+            # ccaudit: allow-blocking-under-lock(single-flight credential fetch, same contract as token() above)
             self._ensure(datetime.datetime.now(datetime.timezone.utc))
             return self._cert_files
 

@@ -930,6 +930,7 @@ class PolicyController:
         _, warned = post_event_best_effort(
             self.kube, event, self._event_warned
         )
+        # ccaudit: allow-race-lockset(monotonic warn latch written from scan loop and rollout workers; a lost update costs one duplicate warning log, never correctness)
         self._event_warned = self._event_warned or warned
 
     # ----------------------------------------------------------- rollouts
@@ -1580,6 +1581,7 @@ class PolicyController:
         the run loop sleeps the min scan gap before scanning, folding a
         rollout's per-flip label churn into one scan. CR-spec and
         internal wakes (rollout finished, adoption) stay immediate."""
+        # ccaudit: allow-race-lockset(deliberately lock-free coalescing hint: a lost True means one scan skips the gap (sooner, still correct); a lost False delays one scan by min_scan_gap_s)
         self._wake_gap_pending = True
         self._wake.set()
 
@@ -1635,12 +1637,14 @@ class PolicyController:
                         self.leader_elector.retry_period_s
                     )
                     self._wake.clear()
+                    # ccaudit: allow-race-lockset(coalescing hint, see _node_wake — either lost update is benign)
                     self._wake_gap_pending = False
                     continue
                 # the gap flag travels WITH the wake it annotated:
                 # clearing a consumed wake without resetting it would
                 # make a later internal wake pay a stale node-gap
                 self._wake.clear()
+                # ccaudit: allow-race-lockset(coalescing hint, see _node_wake — either lost update is benign)
                 self._wake_gap_pending = False
                 try:
                     # wait_rollout=False: the scan loop keeps serving
@@ -1666,6 +1670,7 @@ class PolicyController:
                 # wake is never delayed by an earlier node one
                 if self._wake.wait(self.interval_s):
                     needs_gap = self._wake_gap_pending
+                    # ccaudit: allow-race-lockset(coalescing hint, see _node_wake — either lost update is benign)
                     self._wake_gap_pending = False
                     if needs_gap:
                         # capped at the interval: a wake may only ever

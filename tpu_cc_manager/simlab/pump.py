@@ -116,10 +116,12 @@ class WatchPump:
         for n in nodes:
             name = n["metadata"]["name"]
             if name in self.replicas:
+                # ccaudit: allow-race-lockset(prime() runs before start() spawns the pump thread — happens-before, never concurrent with _deliver)
                 self._last[name] = (n["metadata"].get("labels") or {}).get(
                     L.CC_MODE_LABEL
                 )
             rv = max(rv, int(n["metadata"].get("resourceVersion") or 0))
+        # ccaudit: allow-race-lockset(prime() runs before start() — same happens-before as _last above)
         self._rv = str(rv) if rv else None
 
     def _relist(self) -> None:

@@ -165,6 +165,7 @@ class NodeWatcher:
         """Initial read; remembers the value so the watch only fires on
         change. Returns the initial label value."""
         value = self.read_node_label()
+        # ccaudit: allow-race-lockset(prime() runs before start() spawns the watch thread — happens-before, never concurrent with _push)
         self._last_value = value
         return value
 

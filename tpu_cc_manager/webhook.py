@@ -96,9 +96,13 @@ def _doctor_mode() -> str:
         return "enforce"
     if value not in ("", "0", "false", "no", "off"):
         # a typo ('warm', 'ture') must not silently disable a security
-        # knob the operator believes is on — warn once per value
-        if value not in _warned_doctor_values:
+        # knob the operator believes is on — warn once per value. The
+        # lock makes the check-then-add atomic: admission reviews run
+        # on per-request threads (ccaudit race-lockset)
+        with _warned_doctor_lock:
+            first = value not in _warned_doctor_values
             _warned_doctor_values.add(value)
+        if first:
             log.warning(
                 "TPU_CC_WEBHOOK_REQUIRE_DOCTOR=%r not recognised "
                 "(off|warn|true/enforce); treating as OFF", raw,
@@ -109,6 +113,7 @@ def _doctor_mode() -> str:
 #: unrecognised TPU_CC_WEBHOOK_REQUIRE_DOCTOR values already warned
 #: about (once per process, not per admission review)
 _warned_doctor_values: set = set()
+_warned_doctor_lock = threading.Lock()
 
 
 def _require_doctor() -> bool:
@@ -345,22 +350,26 @@ class AdmissionServer:
                 if self.path == "/healthz":
                     return self._send(200, b"ok", "text/plain")
                 if self.path == "/metrics":
+                    with outer._stats_lock:
+                        reviews = outer.reviews
+                        malformed = outer.rejected_malformed
+                        warned = outer.warned
                     body = (
                         "# HELP tpu_cc_webhook_reviews_total Admission "
                         "reviews served\n"
                         "# TYPE tpu_cc_webhook_reviews_total counter\n"
-                        f"tpu_cc_webhook_reviews_total {outer.reviews}\n"
+                        f"tpu_cc_webhook_reviews_total {reviews}\n"
                         "# HELP tpu_cc_webhook_malformed_total Malformed "
                         "review bodies rejected with 400\n"
                         "# TYPE tpu_cc_webhook_malformed_total counter\n"
                         f"tpu_cc_webhook_malformed_total "
-                        f"{outer.rejected_malformed}\n"
+                        f"{malformed}\n"
                         "# HELP tpu_cc_webhook_warned_total Review "
                         "responses carrying warnings (REQUIRE_DOCTOR "
                         "warn-mode rehearsal activity; enforce when "
                         "this stays flat)\n"
                         "# TYPE tpu_cc_webhook_warned_total counter\n"
-                        f"tpu_cc_webhook_warned_total {outer.warned}\n"
+                        f"tpu_cc_webhook_warned_total {warned}\n"
                     ).encode()
                     return self._send(
                         200, body, "text/plain; version=0.0.4"
@@ -376,13 +385,18 @@ class AdmissionServer:
                     review = json.loads(self.rfile.read(length))
                     out = review_response(review, kind)
                 except (ValueError, json.JSONDecodeError) as e:
-                    outer.rejected_malformed += 1
+                    # per-request threads: an unguarded += here loses
+                    # counts under concurrent reviews (ccaudit
+                    # race-lockset — the lost-update shape)
+                    with outer._stats_lock:
+                        outer.rejected_malformed += 1
                     return self._send(
                         400, json.dumps({"error": str(e)}).encode()
                     )
-                outer.reviews += 1
-                if out.get("response", {}).get("warnings"):
-                    outer.warned += 1
+                with outer._stats_lock:
+                    outer.reviews += 1
+                    if out.get("response", {}).get("warnings"):
+                        outer.warned += 1
                 return self._send(200, json.dumps(out).encode())
 
         server_cls = type(
@@ -408,6 +422,10 @@ class AdmissionServer:
         #: responses that carried warnings — the warn-mode rehearsal's
         #: fleet-visible signal: enforce once this stops moving
         self.warned = 0
+        #: guards the three review counters: ThreadingHTTPServer runs
+        #: each review on its own thread, and `outer.reviews += 1` from
+        #: two of them loses counts (found by ccaudit race-lockset)
+        self._stats_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._reload_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
