@@ -87,9 +87,10 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         threads.append(t)
 
     def state_of(name):
-        return (
-            store.get_node(name)["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL)
-        )
+        # peek, not get_node: the 100 Hz convergence poll must not
+        # deepcopy evidence-laden node objects inside the store lock —
+        # that was measurement load distorting the system under test
+        return store.peek_node_label(name, L.CC_MODE_STATE_LABEL)
 
     def wait_all(target, timeout=120.0):
         deadline = time.monotonic() + timeout
@@ -111,6 +112,16 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         print(f"FATAL: {len(pending)} agents never initialized", file=sys.stderr)
         sys.exit(1)
 
+    # let startup publications drain (each agent's first idle tick
+    # flushes its initial evidence + doctor verdict): steady-state
+    # write economics must not be polluted by one-time startup writes
+    time.sleep(1.6)
+    # node-write economics measured from here: the desired-label storm
+    # itself is out-of-band (set_node_labels_direct), so every counted
+    # write below is the AGENTS' — the number the coalescing layer is
+    # judged on (ISSUE 6: <= 2 round trips per successful flip)
+    writes_before = store.node_write_stats()
+
     latencies = []
     round_times = []
     #: steady-state measurement windows, one per round: [first flip
@@ -130,7 +141,10 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         t0 = time.monotonic()
         for name in node_names:
             starts[name] = time.monotonic()
-            store.set_node_labels(name, {L.CC_MODE_LABEL: target})
+            # out-of-band driver write: the desired-label storm is the
+            # bench's INPUT — routing it around the write accounting
+            # keeps node_writes_per_flip a pure agent-economics number
+            store.set_node_labels_direct(name, {L.CC_MODE_LABEL: target})
         completion, pending = wait_all(target)
         t1 = time.monotonic()
         if pending:
@@ -157,6 +171,7 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         # inside it
         windowed_flips += len(node_names) - 1
     elapsed = time.monotonic() - t_bench0
+    writes_after = store.node_write_stats()
 
     # rolling-update scenario (BASELINE config 3 shape at pool scale):
     # roll the whole pool back to "on" with a bounded disruption window
@@ -182,6 +197,19 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
     p50 = statistics.median(latencies)
     p95 = sorted(latencies)[int(0.95 * len(latencies))]
     pool_convergence = statistics.median(round_times)
+    # HTTP node-write round trips (and the logical mutations they
+    # carried) per successful flip across the measured rounds: the
+    # coalescing layer's acceptance number — historically ~5 writes per
+    # flip, now taint-set (carrying deferred evidence/doctor) plus
+    # taint-clear+state = 2, with a small tail from idle-tick flushes
+    node_writes_per_flip = round(
+        (writes_after["requests"] - writes_before["requests"])
+        / max(total_flips, 1), 3,
+    )
+    node_mutations_per_flip = round(
+        (writes_after["mutations"] - writes_before["mutations"])
+        / max(total_flips, 1), 3,
+    )
     flips_per_min = total_flips / elapsed * 60.0
     flips_per_min_windowed = (
         round(windowed_flips / sum(window_times) * 60.0, 1)
@@ -212,6 +240,12 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
             # un-windowed flips/min moves while this grows, the change
             # is measurement dilution, not a throughput regression
             "storm_overhead_s": storm_overhead_s,
+            # coalesced write economics (ISSUE 6): HTTP round trips and
+            # logical mutations per successful flip; the trend gate
+            # ceilings the former at 2.5 (the <= 2 design plus the
+            # idle-tick flush tail)
+            "node_writes_per_flip": node_writes_per_flip,
+            "node_mutations_per_flip": node_mutations_per_flip,
             "rollout_window8_s": round(rollout_s, 4),
             "nodes": n_nodes,
             "rounds": rounds,
@@ -232,8 +266,7 @@ def _wait_pool(store, names, target, timeout=240.0):
     while pending and time.monotonic() < deadline:
         pending = {
             n for n in pending
-            if store.get_node(n)["metadata"]["labels"].get(
-                L.CC_MODE_STATE_LABEL) != target
+            if store.peek_node_label(n, L.CC_MODE_STATE_LABEL) != target
         }
         if pending:
             time.sleep(0.02)
@@ -598,6 +631,12 @@ def bench_real_chip(state_dir: str):
         engine = ModeEngine(set_state_label=lambda v: None,
                             evict_components=False, tracer=tracer)
         try:
+            # contention sentinel (ROADMAP item 1 / ISSUE 6 satellite):
+            # probe the chip immediately BEFORE and AFTER the flip. A
+            # real_chip_flip_s move with both probes flat is a PHASE
+            # regression; a move with the probes also inflated is host
+            # contention — r07+ readings arrive attributable.
+            probe_pre_s = be.probe_device(chips[0].device_id)
             t0 = time.monotonic()
             ok = engine.set_mode("on")
             flip_s = time.monotonic() - t0
@@ -622,6 +661,10 @@ def bench_real_chip(state_dir: str):
             "real_chip_count": len(chips),
             "real_chip_flip_s": round(flip_s, 4),
             "real_chip_phase_s": phase_s,
+            # pre/post flip probes: the contention sentinel pair
+            # (real_chip_probe_s keeps its historical name/meaning —
+            # the post-flip probe — for r01-r06 continuity)
+            "real_chip_probe_pre_s": round(probe_pre_s, 4),
             "real_chip_probe_s": round(probe_s, 4),
             "real_chip_flip_ok": bool(ok and verified),
         }
@@ -742,6 +785,32 @@ def run_simlab_bench():
     }
 
 
+def bench_dep_versions():
+    """The benched jax/jaxlib/libtpu/numpy versions, stamped into the
+    bench output (ISSUE 6 satellite / ROADMAP item 1): the r02-r05
+    real_chip_flip_s drift was unattributable partly because nothing
+    recorded WHICH dep set each round ran — requirements-bench.txt pins
+    them and this stamp proves what actually loaded."""
+    import importlib
+
+    out = {}
+    for mod, attr in (("jax", "__version__"), ("jaxlib", "version"),
+                      ("numpy", "__version__")):
+        try:
+            m = importlib.import_module(mod)
+            v = getattr(m, attr, None)
+            out[mod] = getattr(v, "__version__", v) if v else "unknown"
+        except Exception:  # ccaudit: allow-swallow(an absent/broken dep is itself the datum: recorded as "absent")
+            out[mod] = "absent"
+    try:
+        from importlib import metadata
+
+        out["libtpu"] = metadata.version("libtpu")
+    except Exception:  # ccaudit: allow-swallow(an absent/broken dep is itself the datum: recorded as "absent")
+        out["libtpu"] = "absent"
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=32)
@@ -758,6 +827,9 @@ def main():
         real_chip = bench_real_chip(f"{d}/realchip-state")
         result = run_bench(args.nodes, args.rounds, d)
         result["extras"].update(real_chip)
+        # the pinned-and-proven dep set this round actually ran
+        # (requirements-bench.txt is the pin; this is the receipt)
+        result["extras"]["bench_deps"] = bench_dep_versions()
         # the wall-clock-dominating paths the headline number bypasses
         # (VERDICT r1 item 5): drain pod-wait and slice two-phase commit
         result["extras"]["drained_pool_convergence_s"] = run_drained_bench(

@@ -20,21 +20,43 @@ import sys
 
 REGRESSION_FACTOR = 2.0
 
-#: extras axes gated like the headline pair — seconds-valued, bigger
-#: is worse. Rounds where either side lacks the axis (older bench, a
-#: CPU-only host for real_chip) skip the comparison silently, so
-#: mixed-era histories stay green; once both rounds carry a number,
-#: an unnoted >2x regression fails CI. real_chip_flip_s joined after
-#: the r05 4.43s jump arrived unnoticed (VERDICT r5 weak #3);
-#: pool256_convergence_s is the simlab live-fleet scenario;
-#: multichip_flip_s is the 8-device parallel flip pipeline wall clock
-#: (BENCH_NOTES r06) — the axis that regresses if the executor ever
-#: quietly re-serializes.
-GATED_EXTRA_AXES = (
-    "real_chip_flip_s",
-    "pool256_convergence_s",
-    "multichip_flip_s",
-)
+#: extras axes gated like the headline pair — axis -> direction
+#: ("lower" = seconds-valued, bigger is worse; "higher" = throughput,
+#: smaller is worse). Rounds where either side lacks the axis (older
+#: bench, a CPU-only host for real_chip) skip the comparison silently,
+#: so mixed-era histories stay green; once both rounds carry a number,
+#: an unnoted >2x move in the bad direction fails CI.
+#: real_chip_flip_s joined after the r05 4.43s jump arrived unnoticed
+#: (VERDICT r5 weak #3); pool256_convergence_s is the simlab
+#: live-fleet scenario; multichip_flip_s is the 8-device parallel flip
+#: pipeline wall clock (BENCH_NOTES r06) — the axis that regresses if
+#: the executor ever quietly re-serializes;
+#: flips_per_min_windowed joined as a first-class gated axis in r07
+#: (the coalesced flip-path writes round, ISSUE 6) — the steady-state
+#: throughput the write-batching work is judged on.
+GATED_EXTRA_AXES = {
+    "real_chip_flip_s": "lower",
+    "pool256_convergence_s": "lower",
+    "multichip_flip_s": "lower",
+    "flips_per_min_windowed": "higher",
+}
+
+#: absolute bars on the newest round (ISSUE 6 acceptance): floors are
+#: minima for higher-is-better axes, ceilings are maxima. Skipped when
+#: the newest round lacks the axis; a miss is acknowledgeable through
+#: the same BENCH_NOTES/regression_note escape as a trend regression —
+#: a noted miss (e.g. a degraded sandbox host, see BENCH_NOTES r07's
+#: variance note) is a decision, an unnoted one is a bug.
+THROUGHPUT_FLOORS = {
+    "flips_per_min_windowed": 21000.0,
+}
+#: node_writes_per_flip: the coalescing contract is <= 2 writes per
+#: flip on the hot path; 2.5 allows the idle-tick flush tail without
+#: letting a silent un-batching regression (back toward the historical
+#: ~5) pass.
+WRITE_CEILINGS = {
+    "node_writes_per_flip": 2.5,
+}
 
 
 def _round_num(path):
@@ -81,33 +103,48 @@ def main(root: str = ".") -> int:
             f"p50 {p50_prev} -> {p50_cur} "
             f"({p50_cur / p50_prev:.1f}x slower)"
         )
-    # prefer the WINDOWED throughput when both rounds carry it (round
-    # 5+): flips/elapsed dilutes with setup/teardown time, so a mix
-    # change can look like a 40% regression while steady-state
-    # throughput is flat (the r03->r04 story). Mixed-era comparisons
-    # fall back to the old number.
+    # the un-windowed flips/min stays gated only as the mixed-era
+    # fallback (rounds before r05 lack the windowed number; since r07
+    # the windowed axis is gated first-class in GATED_EXTRA_AXES —
+    # flips/elapsed dilutes with setup/teardown time, the r03->r04
+    # story)
     prev_x, cur_x = prev.get("extras") or {}, cur.get("extras") or {}
-    key = ("flips_per_min_windowed"
-           if isinstance(prev_x.get("flips_per_min_windowed"),
-                         (int, float))
-           and isinstance(cur_x.get("flips_per_min_windowed"),
-                          (int, float))
-           else "flips_per_min")
-    fpm_prev, fpm_cur = prev_x.get(key), cur_x.get(key)
-    if (isinstance(fpm_prev, (int, float)) and fpm_prev > 0
-            and isinstance(fpm_cur, (int, float)) and fpm_cur > 0
-            and fpm_cur < fpm_prev / REGRESSION_FACTOR):
-        problems.append(
-            f"{key} {fpm_prev} -> {fpm_cur} "
-            f"({fpm_prev / fpm_cur:.1f}x fewer)"
-        )
-    for axis in GATED_EXTRA_AXES:
+    if not (isinstance(prev_x.get("flips_per_min_windowed"), (int, float))
+            and isinstance(cur_x.get("flips_per_min_windowed"),
+                           (int, float))):
+        fpm_prev = prev_x.get("flips_per_min")
+        fpm_cur = cur_x.get("flips_per_min")
+        if (isinstance(fpm_prev, (int, float)) and fpm_prev > 0
+                and isinstance(fpm_cur, (int, float)) and fpm_cur > 0
+                and fpm_cur < fpm_prev / REGRESSION_FACTOR):
+            problems.append(
+                f"flips_per_min {fpm_prev} -> {fpm_cur} "
+                f"({fpm_prev / fpm_cur:.1f}x fewer)"
+            )
+    for axis, direction in GATED_EXTRA_AXES.items():
         a, b = prev_x.get(axis), cur_x.get(axis)
-        if (isinstance(a, (int, float)) and a > 0
-                and isinstance(b, (int, float)) and b > 0
-                and b > a * REGRESSION_FACTOR):
+        if not (isinstance(a, (int, float)) and a > 0
+                and isinstance(b, (int, float)) and b > 0):
+            continue
+        if direction == "lower" and b > a * REGRESSION_FACTOR:
             problems.append(
                 f"{axis} {a} -> {b} ({b / a:.1f}x slower)"
+            )
+        elif direction == "higher" and b < a / REGRESSION_FACTOR:
+            problems.append(
+                f"{axis} {a} -> {b} ({a / b:.1f}x fewer)"
+            )
+    for axis, floor in THROUGHPUT_FLOORS.items():
+        b = cur_x.get(axis)
+        if isinstance(b, (int, float)) and 0 < b < floor:
+            problems.append(
+                f"{axis} {b} below the {floor:g} floor"
+            )
+    for axis, ceiling in WRITE_CEILINGS.items():
+        b = cur_x.get(axis)
+        if isinstance(b, (int, float)) and b > ceiling:
+            problems.append(
+                f"{axis} {b} above the {ceiling:g} ceiling"
             )
     if not problems:
         print(f"bench-trend: {os.path.basename(cur_path)} within "

@@ -549,3 +549,91 @@ def test_failed_reconcile_fast_tracks_doctor_verdict(tmp_path):
     finally:
         agent.shutdown()
         t.join(timeout=10)
+
+
+# ------------------------------------------- coalesced flip-path writes
+def test_flip_costs_at_most_two_node_writes(tmp_path):
+    """ISSUE 6 tentpole pin: a steady-state flip's node-write round
+    trips collapse to at most two (taint set carrying the previous
+    evidence, taint clear+state), down from the historical five — the
+    evidence annotation rides the carrier writes instead of paying its
+    own PATCH."""
+    backend = fake_backend(n_chips=2)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "off"}))
+    agent = _agent(kube, tmp_path, emit_events=False)
+    agent._backend = backend
+    agent.engine._backend = backend
+    assert agent.reconcile("on") is True  # warm-up: caches, evidence gen 1
+    w0 = kube.node_write_stats()
+    assert agent.reconcile("off") is True
+    w1 = kube.node_write_stats()
+    assert w1["requests"] - w0["requests"] <= 2, (w0, w1)
+    # the carrier transported the PREVIOUS reconcile's evidence: it is
+    # on the cluster without ever paying its own round trip
+    import json as _json
+
+    from tpu_cc_manager.evidence import evidence_mode
+
+    ann = kube.get_node("n1")["metadata"]["annotations"]
+    assert evidence_mode(_json.loads(ann[L.EVIDENCE_ANNOTATION])) == "on"
+    assert agent._evidence_published_gen == 1
+    assert agent._evidence_wanted_gen == 2  # "off"'s doc still pending
+    # the explicit flush delivers the newest generation
+    assert agent.flush_events()
+    ann = kube.get_node("n1")["metadata"]["annotations"]
+    assert evidence_mode(_json.loads(ann[L.EVIDENCE_ANNOTATION])) == "off"
+    assert agent._evidence_published_gen == agent._evidence_wanted_gen
+
+
+def test_coalesced_publications_counted_in_metrics(tmp_path):
+    """Loss accounting (ISSUE 6 acceptance): a publication superseded
+    before it was sent increments publications_coalesced_total — the
+    drop is by design and visible, never silent."""
+    backend = fake_backend(n_chips=1)
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "off"}))
+    agent = _agent(kube, tmp_path, emit_events=False)
+    agent._backend = backend
+    agent.engine._backend = backend
+    assert agent.reconcile("on") is True
+    # two builds with no carrier write in between: the second supersedes
+    # the first in the batcher
+    agent._publish_evidence()
+    agent._publish_evidence()
+    assert (
+        agent.metrics.publications_coalesced_total.value("evidence") >= 1
+    )
+    assert agent.flush_events()
+    assert agent._evidence_published_gen == agent._evidence_wanted_gen
+
+
+def test_failed_flip_publishes_failed_state_not_half_applied(tmp_path):
+    """Fail-secure ordering pin (ISSUE 6): a failed flip's batched
+    state write still lands cc.mode.state=failed synchronously, and a
+    pending evidence publication from the PREVIOUS success rides that
+    same write — there is no interleaving where the node shows a fresh
+    evidence document with a stale state label, and no half-applied
+    merge (the patch is atomic server-side)."""
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={L.CC_MODE_LABEL: "off"}))
+    chip = FakeChip(path=str(tmp_path / "accel0"))
+    agent = _agent(kube, tmp_path, emit_events=False)
+    agent._backend = FakeBackend(chips=[chip])
+    agent.engine._backend = agent._backend
+    assert agent.reconcile("on") is True  # evidence gen 1 deferred
+    chip.fail_reset = True
+    assert agent.reconcile("off") is False
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "failed"
+    # the failed reconcile produced NO new evidence; the previous
+    # success's document rode the failed flip's writes intact
+    import json as _json
+
+    from tpu_cc_manager.evidence import evidence_mode
+
+    ann = kube.get_node("n1")["metadata"]["annotations"]
+    assert evidence_mode(_json.loads(ann[L.EVIDENCE_ANNOTATION])) == "on"
+    assert agent._evidence_wanted_gen == 1
+    assert agent._evidence_published_gen == 1
+    assert not agent.batcher.has_pending()

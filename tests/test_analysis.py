@@ -791,3 +791,46 @@ def test_cli_exits_nonzero_on_stale_baseline(tmp_path):
     )
     assert proc.returncode == 1
     assert "stale-baseline" in proc.stdout
+
+
+# ------------------------------------------------------ direct-node-write
+def test_direct_node_write_flagged_in_reconcile_path_module():
+    """ISSUE 6: node-write verbs called directly from a reconcile-path
+    module bypass the coalescing batcher and silently re-inflate the
+    flip's write round trips."""
+    src = """
+    class A:
+        def publish(self):
+            self.kube.set_node_labels("n1", {"k": "v"})
+            self.kube.set_node_annotations("n1", {"a": "b"})
+            self.kube.patch_node("n1", {})
+            self.kube.replace_node("n1", {})
+    """
+    findings = run(src, relpath="tpu_cc_manager/agent.py")
+    hits = [f for f in findings if f.rule == "direct-node-write"]
+    assert len(hits) == 4
+    assert "NodePatchBatcher" in hits[0].message
+
+
+def test_direct_node_write_ignores_other_modules():
+    """The rule scopes to the reconcile path: controllers, rollout, and
+    test doubles write directly by design."""
+    src = """
+    class A:
+        def publish(self):
+            self.kube.set_node_labels("n1", {"k": "v"})
+    """
+    for relpath in ("tpu_cc_manager/rollout.py",
+                    "tpu_cc_manager/k8s/batch.py", "snippet.py"):
+        findings = run(src, relpath=relpath)
+        assert not [f for f in findings if f.rule == "direct-node-write"], relpath
+
+
+def test_direct_node_write_pragma_allows_ordered_writes():
+    src = """
+    class A:
+        def publish(self):
+            self.kube.set_node_labels("n1", {"k": "v"})  # ccaudit: allow-direct-node-write(fail-secure state write)
+    """
+    findings = run(src, relpath="tpu_cc_manager/engine.py")
+    assert not [f for f in findings if f.rule == "direct-node-write"]

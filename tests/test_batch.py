@@ -1,0 +1,173 @@
+"""NodePatchBatcher — the write-coalescing I/O layer (ISSUE 6).
+
+Pins the coalescing contract docs/io.md states: newest generation wins
+(superseded publications are counted, not silent), carrier folds retire
+exactly the generations they transported, the fail-secure ordered write
+is one atomic merge patch that drains the queue for free and leaves
+nothing half-applied on failure, and the bounded retry/backoff path
+accounts every retry and drop.
+"""
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.batch import NodePatchBatcher
+from tpu_cc_manager.k8s.client import ApiException
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+
+
+@pytest.fixture()
+def kube():
+    k = FakeKube()
+    k.add_node(make_node("n1"))
+    return k
+
+
+def test_defer_coalesces_to_newest_generation(kube):
+    seen = []
+    b = NodePatchBatcher(kube, "n1", on_coalesced=seen.append)
+    b.defer("evidence", annotations={L.EVIDENCE_ANNOTATION: "v1"})
+    b.defer("evidence", annotations={L.EVIDENCE_ANNOTATION: "v2"})
+    b.defer("evidence", annotations={L.EVIDENCE_ANNOTATION: "v3"})
+    assert b.stats()["coalesced"] == 2
+    assert seen == ["evidence", "evidence"]
+    assert b.flush() is True
+    ann = kube.get_node("n1")["metadata"]["annotations"]
+    assert ann[L.EVIDENCE_ANNOTATION] == "v3"  # only the newest landed
+    # exactly ONE write request carried it
+    assert kube.node_write_stats()["requests"] == 1
+
+
+def test_flush_fires_exact_generation_callbacks(kube):
+    b = NodePatchBatcher(kube, "n1")
+    published = []
+    g1 = b.defer("evidence", annotations={"a": "1"},
+                 on_published=published.append)
+    g2 = b.defer("evidence", annotations={"a": "2"},
+                 on_published=published.append)
+    assert g2 > g1
+    b.flush()
+    # the superseded g1 never claims publication; g2 does, once
+    assert published == [g2]
+
+
+def test_fold_into_node_rides_a_cas_replace(kube):
+    b = NodePatchBatcher(kube, "n1")
+    b.defer("evidence", annotations={L.EVIDENCE_ANNOTATION: "ev"})
+    b.defer("doctor", labels={L.DOCTOR_OK_LABEL: "true"},
+            annotations={L.DOCTOR_ANNOTATION: "doc"})
+    node = kube.get_node("n1")
+    token = b.fold_into_node(node)
+    assert len(token) == 2
+    kube.replace_node("n1", node)
+    b.mark_folded(token)
+    assert not b.has_pending()
+    assert b.stats()["folded"] == 2
+    got = kube.get_node("n1")["metadata"]
+    assert got["annotations"][L.EVIDENCE_ANNOTATION] == "ev"
+    assert got["labels"][L.DOCTOR_OK_LABEL] == "true"
+
+
+def test_mark_folded_keeps_newer_generation_pending(kube):
+    """A defer landing between fold and mark_folded must stay pending:
+    the carrier transported the OLD generation, not the new one."""
+    b = NodePatchBatcher(kube, "n1")
+    b.defer("evidence", annotations={"a": "old"})
+    node = kube.get_node("n1")
+    token = b.fold_into_node(node)
+    b.defer("evidence", annotations={"a": "new"})  # arrives mid-write
+    b.mark_folded(token)
+    assert b.has_pending()
+    b.flush()
+    # flush() used set_node_annotations (annotations-only payload)
+    assert kube.get_node("n1")["metadata"]["annotations"]["a"] == "new"
+
+
+def test_write_labels_now_is_one_patch_carrying_pending(kube):
+    b = NodePatchBatcher(kube, "n1")
+    b.defer("evidence", annotations={L.EVIDENCE_ANNOTATION: "ev"})
+    w0 = kube.node_write_stats()
+    b.write_labels_now({L.CC_MODE_STATE_LABEL: "on"})
+    w1 = kube.node_write_stats()
+    assert w1["requests"] - w0["requests"] == 1  # ONE round trip
+    assert w1["mutations"] - w0["mutations"] == 2  # carrying TWO mutations
+    meta = kube.get_node("n1")["metadata"]
+    assert meta["labels"][L.CC_MODE_STATE_LABEL] == "on"
+    assert meta["annotations"][L.EVIDENCE_ANNOTATION] == "ev"
+    assert not b.has_pending()
+
+
+def test_write_labels_now_caller_wins_over_pending(kube):
+    """An ordered write's payload is never overridden by a deferred
+    mutation under the same key."""
+    b = NodePatchBatcher(kube, "n1")
+    b.defer("doctor", labels={L.CC_MODE_STATE_LABEL: "stale"})
+    b.write_labels_now({L.CC_MODE_STATE_LABEL: "failed"})
+    labels = kube.get_node("n1")["metadata"]["labels"]
+    assert labels[L.CC_MODE_STATE_LABEL] == "failed"
+
+
+def test_failed_ordered_write_raises_and_retains_pending(kube):
+    """Fail-secure pin: when the combined patch fails, the error
+    propagates (the caller owns the failed-state contract), NOTHING
+    landed server-side (atomic merge patch), and pending publications
+    are retained for the next carrier — no half-applied merge."""
+    b = NodePatchBatcher(kube, "n1")
+    b.defer("evidence", annotations={L.EVIDENCE_ANNOTATION: "ev"})
+    kube.fail_next_node_writes = 1
+    with pytest.raises(ApiException) as ei:
+        b.write_labels_now({L.CC_MODE_STATE_LABEL: "failed"})
+    assert ei.value.status == 429
+    meta = kube.get_node("n1")["metadata"]
+    assert L.CC_MODE_STATE_LABEL not in (meta.get("labels") or {})
+    assert L.EVIDENCE_ANNOTATION not in (meta.get("annotations") or {})
+    assert b.has_pending()  # evidence still queued, not lost
+    # the retry path still lands the state write AND the evidence
+    b.write_labels_now({L.CC_MODE_STATE_LABEL: "failed"})
+    meta = kube.get_node("n1")["metadata"]
+    assert meta["labels"][L.CC_MODE_STATE_LABEL] == "failed"
+    assert meta["annotations"][L.EVIDENCE_ANNOTATION] == "ev"
+
+
+def test_flush_failure_backs_off_retries_and_accounts(kube):
+    retried, dropped = [], []
+    b = NodePatchBatcher(kube, "n1", on_retry=retried.append,
+                         on_drop=dropped.append)
+    b.defer("evidence", annotations={"a": "1"})
+    kube.fail_next_node_writes = 3
+    assert b.flush() is False
+    assert b.stats()["retries"] == 1
+    assert retried == ["evidence"]
+    # backoff armed: maybe_flush stays quiet until due
+    b.maybe_flush()
+    assert kube.fail_next_node_writes == 2  # no write attempt happened
+    # a forced flush retries through the storm and eventually lands
+    assert b.flush() is False
+    assert b.flush() is False
+    assert b.flush() is True
+    assert kube.get_node("n1")["metadata"]["annotations"]["a"] == "1"
+    assert b.stats()["retries"] == 3
+    assert not dropped
+
+
+def test_retry_budget_exhaustion_drops_loudly(kube):
+    dropped = []
+    b = NodePatchBatcher(kube, "n1", on_drop=dropped.append)
+    b.defer("evidence", annotations={"a": "1"})
+    kube.fail_next_node_writes = NodePatchBatcher.MAX_RETRIES + 1
+    for _ in range(NodePatchBatcher.MAX_RETRIES + 1):
+        b.flush()
+    assert dropped == ["evidence"]
+    assert b.stats()["dropped"] == 1
+    assert not b.has_pending()  # parked; the owner's gen bookkeeping re-defers
+
+
+def test_maybe_flush_respects_window_then_delivers(kube):
+    b = NodePatchBatcher(kube, "n1", flush_interval_s=0.0)
+    b.defer("doctor", annotations={"d": "1"})
+    b.maybe_flush()
+    assert kube.get_node("n1")["metadata"]["annotations"]["d"] == "1"
+    assert not b.has_pending()
+    b.maybe_flush()  # nothing pending: no write
+    assert kube.node_write_stats()["requests"] == 1

@@ -83,15 +83,42 @@ def test_gate_prefers_windowed_flips(tmp_path):
     setup/teardown dilution (the r03->r04 story) no longer trips it,
     and a real windowed drop does."""
     # un-windowed fell 3x (would trip the old gate) but windowed flat
+    # (values above the r07 absolute floor, which is tested separately)
     _write(tmp_path, 1, 0.1, 6000,
-           extras={"flips_per_min_windowed": 8000})
+           extras={"flips_per_min_windowed": 26000})
     _write(tmp_path, 2, 0.1, 2000,
-           extras={"flips_per_min_windowed": 7900})
+           extras={"flips_per_min_windowed": 25000})
     assert bench_trend.main(str(tmp_path)) == 0
     # windowed itself fell 3x: trips even though un-windowed is flat
     _write(tmp_path, 3, 0.1, 2000,
-           extras={"flips_per_min_windowed": 2500})
+           extras={"flips_per_min_windowed": 8200})
     assert bench_trend.main(str(tmp_path)) == 1
+
+
+def test_windowed_throughput_floor_gate(tmp_path):
+    """ISSUE 6 acceptance bar: the newest round's windowed throughput
+    must clear the absolute 21k floor (2x the r05 10.7k steady state),
+    regardless of trend — and a miss is acknowledgeable through the
+    same BENCH_NOTES escape as any regression."""
+    _write(tmp_path, 1, 0.1, 2000,
+           extras={"flips_per_min_windowed": 22000})
+    _write(tmp_path, 2, 0.1, 2000,
+           extras={"flips_per_min_windowed": 15000})
+    assert bench_trend.main(str(tmp_path)) == 1  # above prev/2, below floor
+    (tmp_path / "BENCH_NOTES.md").write_text(
+        "## r2\ndegraded sandbox host; see variance note\n")
+    assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_node_writes_per_flip_ceiling_gate(tmp_path):
+    """A silent un-batching regression (writes per flip drifting back
+    toward the historical ~5) fails the gate even when every trend
+    axis is flat."""
+    _write(tmp_path, 1, 0.1, 2000, extras={"node_writes_per_flip": 2.1})
+    _write(tmp_path, 2, 0.1, 2000, extras={"node_writes_per_flip": 4.8})
+    assert bench_trend.main(str(tmp_path)) == 1
+    _write(tmp_path, 2, 0.1, 2000, extras={"node_writes_per_flip": 2.2})
+    assert bench_trend.main(str(tmp_path)) == 0
 
 
 def test_gated_extra_axis_real_chip_regression_fails(tmp_path):

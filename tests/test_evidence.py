@@ -254,11 +254,19 @@ def test_fleet_controller_report_carries_audit(tmp_path, monkeypatch):
     ctrl = FleetController(kube, interval_s=60, port=0)
     ctrl._server.start()
     try:
+        # scan 1: the mismatch is TRANSIENT (the debounce tolerates the
+        # coalescing publish core's label-before-evidence skew window);
+        # scan 2 confirms it as the real lying-label finding
+        ctrl.scan_once()
+        first = ctrl.last_report["evidence_audit"]
+        assert first["label_device_mismatch"] == []
+        assert first["label_device_mismatch_transient"] == ["liar"]
         ctrl.scan_once()
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{ctrl.port}/report") as r:
             report = json.loads(r.read())
         assert report["evidence_audit"]["label_device_mismatch"] == ["liar"]
+        assert report["evidence_audit"]["label_device_mismatch_transient"] == []
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{ctrl.port}/metrics") as r:
             metrics = r.read().decode()

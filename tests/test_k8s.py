@@ -267,9 +267,103 @@ def test_keepalive_stale_connection_replayed(server, client):
     once on a fresh connection, invisibly to the caller."""
     server.store.add_node(make_node("n0"))
     client.get_node("n0")  # pool a connection
-    client._local.conn.sock.close()  # simulate server-side close
+    client._conns[0].sock.close()  # simulate server-side close
     node = client.get_node("n0")  # must not raise
     assert node["metadata"]["name"] == "n0"
+
+
+def test_shared_pool_replays_merge_patch_exactly_once(server, client):
+    """Satellite pin (ISSUE 6): a REUSED pooled connection the server
+    closed before sending any response bytes replays its merge patch
+    exactly once — the write lands one time, never twice, even though
+    the conn was checked out of the SHARED pool rather than being
+    thread-local."""
+    from http.client import RemoteDisconnected
+
+    server.store.add_node(make_node("n0"))
+    client.get_node("n0")  # pool a warm connection
+    assert len(client._conns) == 1
+    stale = client._conns[0]
+    real_request = stale.request
+    calls = {"n": 0}
+
+    def dying_request(*a, **kw):
+        # the server closed this idle keep-alive conn; the first reuse
+        # observes it only at response time (no bytes ever sent back)
+        calls["n"] += 1
+        raise RemoteDisconnected("closed by server while idle")
+
+    stale.request = dying_request
+    w0 = server.store.node_write_stats()
+    out = client.patch_node("n0", {"metadata": {"labels": {"k": "v"}}})
+    assert out["metadata"]["labels"]["k"] == "v"
+    assert calls["n"] == 1  # the stale conn was tried once, then dropped
+    w1 = server.store.node_write_stats()
+    # exactly ONE write landed server-side: the replay, not a double-apply
+    assert w1["requests"] - w0["requests"] == 1
+    stale.request = real_request
+
+
+def test_fresh_connection_failure_is_not_replayed(server, client, monkeypatch):
+    """A BadStatusLine on a FRESH connection may have executed
+    server-side; replaying a non-idempotent PATCH could double-apply it,
+    so the client surfaces the transport error instead."""
+    from http.client import HTTPConnection, RemoteDisconnected
+
+    server.store.add_node(make_node("n0"))
+    client.close()  # no pooled conns: the next request dials fresh
+    attempts = {"n": 0}
+    real_request = HTTPConnection.request
+
+    def dying_request(self, *a, **kw):
+        attempts["n"] += 1
+        raise RemoteDisconnected("mid-flight failure on a fresh conn")
+
+    monkeypatch.setattr(HTTPConnection, "request", dying_request)
+    with pytest.raises(ApiException) as ei:
+        client.patch_node("n0", {"metadata": {"labels": {"k": "v"}}})
+    assert ei.value.status == 0
+    assert attempts["n"] == 1  # no silent replay of a possible write
+    monkeypatch.setattr(HTTPConnection, "request", real_request)
+
+
+def test_shared_pool_bounded_and_reused_across_threads(server):
+    """N worker threads (the flip executor shape) share the pool: the
+    total number of dials stays at/below the pool bound across a burst
+    of concurrent requests, instead of one dial per thread."""
+    client = HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False), pool_maxsize=4
+    )
+    server.store.add_node(make_node("n0"))
+    dials = []
+    dial_lock = threading.Lock()
+    real_connect = HttpKubeClient._connect
+
+    def counting_connect(self, read_timeout):
+        with dial_lock:
+            dials.append(1)
+        return real_connect(self, read_timeout)
+
+    client._connect = counting_connect.__get__(client)
+    threads = [
+        threading.Thread(
+            target=lambda: [client.get_node("n0") for _ in range(5)]
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 8 threads x 5 requests rode at most "concurrency peak" dials, and
+    # the idle pool retains at most the configured bound
+    assert len(dials) <= 8  # far fewer than the 40 requests
+    assert len(client._conns) <= 4
+    # a follow-up burst from fresh threads reuses the warm pool: no dials
+    before = len(dials)
+    t = threading.Thread(target=lambda: [client.get_node("n0") for _ in range(3)])
+    t.start(); t.join()
+    assert len(dials) == before
 
 
 def test_list_pagination_follows_continue(server):
@@ -482,3 +576,35 @@ def test_handle_error_swallows_benign_logs_others(server, caplog):
             httpd.handle_error(None, ("127.0.0.1", 2))
     assert any("genuinely unexpected" in r.message
                for r in caplog.records)
+
+
+def test_replay_dials_fresh_even_when_whole_pool_is_stale(server):
+    """After a server restart EVERY pooled idle connection can be
+    stale. The replay attempt must dial fresh (pool bypass) — popping
+    another stale conn would turn the replayable keep-alive race into
+    a terminal error on a write that never executed."""
+    from http.client import RemoteDisconnected
+
+    client = HttpKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False), pool_maxsize=4
+    )
+    server.store.add_node(make_node("n0"))
+    # warm two pooled connections deterministically: check both out
+    # (forcing two dials), connect them, and return them to the pool
+    c1, _ = client._acquire_conn(5.0)
+    c2, _ = client._acquire_conn(5.0)
+    c1.connect()
+    c2.connect()
+    client._release_conn(c1)
+    client._release_conn(c2)
+    assert len(client._conns) == 2
+    # the server "restarted": every pooled conn dies on next use
+    for conn in client._conns:
+        real = conn.request
+
+        def dying(*a, **kw):
+            raise RemoteDisconnected("closed while idle")
+
+        conn.request = dying
+    out = client.patch_node("n0", {"metadata": {"labels": {"k": "v"}}})
+    assert out["metadata"]["labels"]["k"] == "v"

@@ -359,7 +359,14 @@ def test_full_control_plane_soak():
         ]
         assert problems == [], problems
         assert policy.healthy and fleet.healthy
+        # the status phase flips Rolling->Converged on the policy
+        # controller's NEXT scan after the last node lands (interval_s
+        # cadence): wait for that tick instead of racing it
+        deadline = time.monotonic() + 10
         st = kube.get_cluster_custom(G, V, P, "soak")["status"]
+        while st["phase"] != "Converged" and time.monotonic() < deadline:
+            time.sleep(0.1)
+            st = kube.get_cluster_custom(G, V, P, "soak")["status"]
         assert st["phase"] == "Converged"
     finally:
         stop.set()

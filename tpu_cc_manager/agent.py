@@ -25,12 +25,14 @@ import uuid
 import time
 from typing import Optional
 
+from tpu_cc_manager import labels as L
 from tpu_cc_manager.config import AgentConfig
 from tpu_cc_manager.drain import (
     EVENT_FOR_OUTCOME, NodeFlipTaint, build_drainer, build_node_event,
-    post_event_best_effort, set_cc_mode_state_label,
+    post_event_best_effort,
 )
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
+from tpu_cc_manager.k8s.batch import NodePatchBatcher
 from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 from tpu_cc_manager.slice_coord import SliceAbortError
@@ -110,16 +112,44 @@ class CCManagerAgent:
             slice_coordinator.should_abort = self._superseded_by_pending
 
         self._backend = backend
+        # the write-coalescing I/O layer (k8s.batch, ISSUE 6): evidence
+        # and doctor publications defer into it, the taint layer's CAS
+        # replaces carry them, the fail-secure state write drains it
+        # synchronously, and the idle tick flushes whatever found no
+        # carrier. Loss accounting lands in the metrics counters.
+        self.batcher = NodePatchBatcher(
+            kube, cfg.node_name,
+            tracer=self.tracer,
+            on_coalesced=(
+                lambda kind: self.metrics
+                .publications_coalesced_total.inc(kind)
+            ),
+            on_retry=(
+                lambda kind: self.metrics.publish_retries_total.inc()
+            ),
+            on_drop=(
+                lambda kind: self.metrics
+                .publications_dropped_total.inc(kind)
+            ),
+        )
         self.engine = ModeEngine(
             set_state_label=self._set_state_label,
             drainer=build_drainer(kube, cfg),
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
             backend=backend,
             tracer=self.tracer,
-            flip_taint=NodeFlipTaint(kube, cfg.node_name),
+            flip_taint=NodeFlipTaint(
+                kube, cfg.node_name,
+                batcher=self.batcher,
+                node_hint=self.watcher.latest_node,
+            ),
             # when the taint-clear replace carries the label, the
             # current-mode gauge still has to move
             notify_state_label=self.metrics.set_current_mode,
+            # the long-lived agent keeps the flip executor's worker
+            # pool (and through the shared client pool, its warm
+            # connections) across reconciles; shutdown() closes it
+            persistent_flip_pool=True,
         )
         self.health: Optional[HealthServer] = None
         self._fatal: Optional[Exception] = None
@@ -188,7 +218,12 @@ class CCManagerAgent:
 
     # ------------------------------------------------------------ plumbing
     def _set_state_label(self, value: str) -> None:
-        set_cc_mode_state_label(self.kube, self.cfg.node_name, value)
+        """Publish the observed-state label through the batcher: still
+        ONE synchronous, ordered write (fail-secure — a failure raises
+        to the reconcile error paths exactly as the plain patch did),
+        but the patch also carries any pending evidence/doctor
+        publications, so the ordered write doubles as their carrier."""
+        self.batcher.write_state_label(value)
         self.metrics.set_current_mode(value)
 
     def _superseded_by_pending(self, in_flight_mode: str) -> bool:
@@ -220,8 +255,6 @@ class CCManagerAgent:
                 # STARTUP reconcile runs before watcher.start()). Re-read
                 # the label directly: re-running the old mode against a
                 # changed label would supersede-abort forever.
-                from tpu_cc_manager import labels as L
-
                 try:
                     node = self.kube.get_node(self.cfg.node_name)
                     value = (node["metadata"].get("labels") or {}).get(
@@ -242,18 +275,21 @@ class CCManagerAgent:
         """Best-effort per-flip attestation evidence annotation (see
         tpu_cc_manager.evidence): published after every successful
         reconcile so the fleet controller can audit evidence-vs-label
-        consistency. Delivered ASYNCHRONOUSLY through the recorder
-        worker, like Events — an API-server hiccup or slow annotation
-        write must never stretch reconcile latency. A dropped publish
-        (bounded queue under API outage) is republished by the next
-        successful reconcile; staleness in between is visible, not
+        consistency. Delivered through the COALESCING publish core
+        (k8s.batch): the document defers into the batcher, rides the
+        next node write (usually the next flip's taint set) or the idle
+        tick's flush, and only the newest generation is ever sent — an
+        API-server hiccup or slow annotation write never stretches
+        reconcile latency, superseded generations are counted
+        (publications_coalesced_total), and a publish that exhausts the
+        flush retry budget is re-deferred from the idle tick because
+        published < wanted. Staleness in between is visible, not
         silent — the fleet audit flags it."""
         if not self.cfg.emit_evidence:
             return
         import json as _json
 
         from tpu_cc_manager import device as devlayer
-        from tpu_cc_manager import labels as L
         from tpu_cc_manager.evidence import build_evidence, evidence_key
 
         # this publication's generation: anything that keeps it from
@@ -299,42 +335,36 @@ class CCManagerAgent:
             log.warning("evidence build failed; will retry", exc_info=True)
             return
 
-        def task():
-            try:
-                # spanned so the phase histogram separates the deferred
-                # API write from the synchronous build — the write runs
-                # on the recorder thread, OFF the reconcile hot path
-                with self.tracer.span("evidence_publish"):
-                    self.kube.set_node_annotations(self.cfg.node_name, {
-                        L.EVIDENCE_ANNOTATION: payload,
-                    })
-                # advance published only to THIS task's generation — a
-                # stale queued task's success must not mask a newer miss
-                self._evidence_published_gen = max(
-                    self._evidence_published_gen, gen
+        def landed(published_gen: int) -> None:
+            # runs on whichever thread's write carried the document
+            # (taint CAS, state patch, or idle-tick flush). Advance
+            # published only to THIS publication's generation — a stale
+            # write's success must not mask a newer miss.
+            self._evidence_published_gen = max(
+                self._evidence_published_gen, published_gen
+            )
+            # rotation progress is fleet-visible only for documents
+            # that actually LANDED: compare signing posture against
+            # the last successfully published one, so the Event is
+            # truthful (never claims a failed publish) and fires on
+            # whichever path re-signed — the idle-tick posture
+            # check, the dropped-publish retry, or a plain flip
+            prev = self._evidence_published_key
+            self._evidence_published_key = key
+            if prev is not self._KEY_UNSET and key != prev:
+                self._emit_node_event(
+                    "CCEvidenceResigned",
+                    "evidence key posture changed (Secret "
+                    "appeared/rotated/removed); re-signed "
+                    "attestation evidence with the current key",
                 )
-                # rotation progress is fleet-visible only for documents
-                # that actually LANDED: compare signing posture against
-                # the last successfully published one, so the Event is
-                # truthful (never claims a failed publish) and fires on
-                # whichever path re-signed — the idle-tick posture
-                # check, the dropped-publish retry, or a plain flip
-                prev = self._evidence_published_key
-                self._evidence_published_key = key
-                if prev is not self._KEY_UNSET and key != prev:
-                    self._emit_node_event(
-                        "CCEvidenceResigned",
-                        "evidence key posture changed (Secret "
-                        "appeared/rotated/removed); re-signed "
-                        "attestation evidence with the current key",
-                    )
-            except Exception:
-                log.warning("evidence publish failed; will retry",
-                            exc_info=True)
 
-        if self._enqueue_recorder_item(task) == "full":
-            log.warning("evidence publish dropped (recorder queue full); "
-                        "retrying from the idle tick")
+        self.batcher.defer(
+            "evidence",
+            annotations={L.EVIDENCE_ANNOTATION: payload},
+            gen=gen,
+            on_published=landed,
+        )
 
     def _evidence_refresh_deadline(self, doc: dict) -> Optional[float]:
         """The earlier of the identity-token and attestation-token
@@ -496,12 +526,12 @@ class CCManagerAgent:
         published as the cc.doctor annotation for the fleet controller
         to aggregate. Runs on the idle tick, so it must never raise and
         never block the mailbox for long; the report build is local
-        reads plus one get_node, and the annotation write is deferred
-        to the recorder worker like Events and evidence."""
+        reads plus one get_node, and the verdict write defers into the
+        coalescing batcher like evidence — it rides the next node write
+        or flush, and only the newest verdict is ever sent."""
         import json as _json
 
         from tpu_cc_manager import device as devlayer
-        from tpu_cc_manager import labels as L
         from tpu_cc_manager.doctor import run_doctor
 
         try:
@@ -528,20 +558,13 @@ class CCManagerAgent:
             log.warning("doctor self-check failing: %s", summary["fail"])
 
         ok_label = "true" if report["ok"] else "false"
-
-        def task():
-            try:
-                # annotation = detail, label = selectable mirror
-                self.kube.patch_node(self.cfg.node_name, {"metadata": {
-                    "annotations": {L.DOCTOR_ANNOTATION: payload},
-                    "labels": {L.DOCTOR_OK_LABEL: ok_label},
-                }})
-            except Exception as e:
-                log.warning("doctor verdict publish failed: %s", e)
-
-        if self._enqueue_recorder_item(task) == "full":
-            log.warning("doctor verdict dropped (recorder queue full); "
-                        "next interval republishes")
+        # annotation = detail, label = selectable mirror; one deferred
+        # publication so both always land in the same write
+        self.batcher.defer(
+            "doctor",
+            labels={L.DOCTOR_OK_LABEL: ok_label},
+            annotations={L.DOCTOR_ANNOTATION: payload},
+        )
 
     def _emit_reconcile_event(self, mode: str, outcome: str, dur: float) -> None:
         """Best-effort core/v1 Event so `kubectl describe node` carries
@@ -627,7 +650,11 @@ class CCManagerAgent:
                 self._event_queue.task_done()
 
     def flush_events(self, timeout: float = 5.0) -> bool:
-        """Block until queued events are delivered (tests + shutdown)."""
+        """Block until queued events AND deferred publications are
+        delivered (tests + shutdown). The batcher flush is synchronous;
+        a failed flush stays pending (retry machinery owns it) and does
+        not fail this wait — same contract the recorder queue had."""
+        self.batcher.flush()
         if self._event_worker is None or not self._event_worker.is_alive():
             return True
         deadline = time.monotonic() + timeout
@@ -689,8 +716,14 @@ class CCManagerAgent:
         device faults heal the same way.
         """
         now = time.monotonic()
+        # deliver deferred publications that found no carrier write
+        # FIRST: the doctor check below reads the on-cluster evidence,
+        # and the retry branch must not mistake "awaiting its flush"
+        # for "failed"
+        self.batcher.maybe_flush()
         if (self.cfg.emit_evidence
                 and self._evidence_published_gen < self._evidence_wanted_gen
+                and not self.batcher.has_pending("evidence")
                 and now >= self._evidence_retry_due):
             # a dropped/failed evidence publish left stale on-cluster
             # evidence; republish from current device state (throttled —
@@ -839,6 +872,13 @@ class CCManagerAgent:
         if self.slice_coordinator is not None:
             self.slice_coordinator.stop()
         self.watcher.stop()
+        # best-effort final flush of deferred publications, then release
+        # the engine's persistent flip-executor threads
+        try:
+            self.batcher.close()
+        except Exception:
+            log.warning("final publish flush failed", exc_info=True)
+        self.engine.close()
         if self.health:
             self.health.live = False
             self.health.stop()

@@ -242,6 +242,7 @@ def analyze_modules(
     findings.extend(lockset.race_findings(audits, graph, roots))
     findings.extend(rules.metric_findings(audits))
     findings.extend(rules.liveness_findings(audits))
+    findings.extend(rules.direct_write_findings(modules))
     return sorted(findings)
 
 

@@ -134,6 +134,7 @@ VALUE_SINKS: Dict[str, Tuple[int, str]] = {
     "set_cc_mode_state_label": (2, "value"),
     "_set_state_label": (0, "value"),
     "set_state_label": (0, "value"),
+    "write_state_label": (0, "value"),
 }
 
 #: Label/annotation-write APIs taking a ``{key: value}`` dict:

@@ -1511,3 +1511,66 @@ def metric_findings(audits: Sequence[ModuleAudit]) -> List[Finding]:
                     "declare it once or fix the typo",
                 )
     return findings
+
+
+# ------------------------------------------------------- direct node writes
+
+
+#: Reconcile-path modules (ISSUE 6): node mutations issued from these
+#: must route through the write-coalescing batcher (k8s.batch) — or its
+#: carrier folds — not call the KubeClient write verbs directly. A
+#: direct call here silently re-inflates the flip's write round trips
+#: back toward the historical five. Legit exceptions (the fail-secure
+#: state write, the drain protocol's immediately-visible pause labels,
+#: the taint CAS that IS the batcher's carrier) carry an explicit
+#: ``# ccaudit: allow-direct-node-write(reason)`` pragma.
+RECONCILE_PATH_MODULES = frozenset({
+    "tpu_cc_manager/agent.py",
+    "tpu_cc_manager/engine.py",
+    "tpu_cc_manager/drain.py",
+    "tpu_cc_manager/flipexec.py",
+    "tpu_cc_manager/simlab/replica.py",
+})
+
+#: the KubeClient write verbs that mutate a node object
+_NODE_WRITE_VERBS = frozenset({
+    "set_node_labels", "set_node_annotations", "patch_node",
+    "replace_node",
+})
+
+
+def direct_write_findings(modules: Sequence[Module]) -> List[Finding]:
+    """Flag direct node-write verb calls inside the reconcile-path
+    module set (``direct-node-write``). Batcher internals (k8s/batch.py)
+    are exempt by construction — they are the sanctioned writer."""
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.relpath not in RECONCILE_PATH_MODULES:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in _NODE_WRITE_VERBS:
+                continue
+            if mod.suppressed("direct-node-write", node.lineno):
+                continue
+            findings.append(
+                Finding(
+                    file=mod.relpath,
+                    line=node.lineno,
+                    rule="direct-node-write",
+                    message=(
+                        f".{func.attr}() called directly from a "
+                        "reconcile-path module — route node mutations "
+                        "through the NodePatchBatcher (k8s.batch) or a "
+                        "carrier fold so flip-path writes stay "
+                        "coalesced; a deliberate ordered write needs "
+                        "an allow-direct-node-write pragma naming why"
+                    ),
+                    text=mod.line_text(node.lineno),
+                )
+            )
+    return findings

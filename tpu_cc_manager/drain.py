@@ -51,7 +51,7 @@ def set_cc_mode_state_label(kube: KubeClient, node_name: str, value: str) -> Non
     'failed' — the Python reference's convention, which we standardize on
     (the bash engine's success/failed variant was a wart, SURVEY.md §5.5)."""
     log.info("setting %s=%s on node %s", L.CC_MODE_STATE_LABEL, value, node_name)
-    kube.set_node_labels(node_name, {L.CC_MODE_STATE_LABEL: value})
+    kube.set_node_labels(node_name, {L.CC_MODE_STATE_LABEL: value})  # ccaudit: allow-direct-node-write(the fail-secure state write: synchronous and ordered by contract, used by one-shot CLIs without a batcher; the agent routes through NodePatchBatcher.write_labels_now)
 
 
 #: reconcile outcome -> (core/v1 Event reason, Event type); "shutdown"
@@ -148,25 +148,50 @@ class NodeFlipTaint(FlipTaint):
     #: object is churning so hard the taint is the least of its problems
     MAX_CAS_ATTEMPTS = 8
 
-    def __init__(self, kube: KubeClient, node_name: str):
+    def __init__(self, kube: KubeClient, node_name: str,
+                 batcher=None, node_hint=None):
         self.kube = kube
         self.node_name = node_name
+        #: optional NodePatchBatcher (k8s.batch): every CAS replace this
+        #: taint layer performs is a CARRIER for the batcher's pending
+        #: label/annotation publications — the node object is already in
+        #: hand, so evidence/doctor ride the taint write for free and
+        #: the flip's publication round trips collapse into the two
+        #: writes the flip makes anyway (ISSUE 6)
+        self.batcher = batcher
+        #: optional zero-cost seed source (the agent wires the node
+        #: watcher's latest_node): the desired-label event that triggers
+        #: a reconcile carries a node FRESHER than anything a GET would
+        #: return, so the opening taint write can skip its read entirely.
+        #: Historically a watcher hint measured slower because async
+        #: evidence/event writes landed between the event and the taint
+        #: write, dooming the seeded PUT — the batcher removed exactly
+        #: those interleaving writes, which is what makes this hint
+        #: profitable now.
+        self.node_hint = node_hint
         #: node returned by our own last successful replace — the
         #: freshest possible seed for the NEXT write of the same flip
         #: (set -> clear), making the steady-state clear a single round
         #: trip instead of GET+PUT (BENCH phase_p50_s: taint ops are
-        #: the flip hot path's dominant cost). Note a watcher-event
-        #: hint was tried and measured SLOWER: async evidence/event
-        #: writes land between the event and the taint write, so the
-        #: seeded CAS usually lost and paid a wasted PUT on top of the
-        #: fallback read. Our own replace return can't be stale that
-        #: way within one flip.
+        #: the flip hot path's dominant cost).
         self._cached: Optional[dict] = None
 
-    def _seed(self) -> Optional[dict]:
+    def _seed(self, hint_ok: bool = False) -> Optional[dict]:
         if self._cached is not None:
             node, self._cached = self._cached, None
             return node
+        if hint_ok and self.node_hint is not None:
+            # only the flip's OPENING write (set) may seed from the
+            # watcher snapshot: nothing writes the node between the
+            # triggering label event and the taint set. The CLOSING
+            # write may sit behind drain pause/restore patches the
+            # snapshot hasn't caught up with — a stale seed there costs
+            # a doomed PUT on top of the fallback read.
+            try:
+                return self.node_hint()
+            except Exception:
+                log.debug("taint seed hint failed; falling back to GET",
+                          exc_info=True)
         return None
 
     def invalidate_cache(self) -> None:
@@ -175,7 +200,8 @@ class NodeFlipTaint(FlipTaint):
         make the seeded clear pay a doomed PUT before its fallback)."""
         self._cached = None
 
-    def _cas_loop(self, mutate, cache_result: bool) -> bool:
+    def _cas_loop(self, mutate, cache_result: bool,
+                  hint_ok: bool = False) -> bool:
         """Read(or seed)-modify-replace with 409 retry. ``mutate(node)``
         edits in place and returns True to write, None for no-op. A
         no-op judged on a SEED is re-confirmed against a fresh read —
@@ -191,7 +217,7 @@ class NodeFlipTaint(FlipTaint):
         roughly doubled taint_set)."""
         from tpu_cc_manager.k8s.client import ConflictError
 
-        seed = self._seed()
+        seed = self._seed(hint_ok)
         for _ in range(self.MAX_CAS_ATTEMPTS):
             seeded = seed is not None
             node = seed if seeded else self.kube.get_node(self.node_name)
@@ -200,15 +226,24 @@ class NodeFlipTaint(FlipTaint):
                 if seeded:
                     continue  # confirm the no-op on a fresh read
                 return False
+            # carrier fold: this replace transports whatever the batcher
+            # holds (evidence/doctor publications); a conflicted attempt
+            # re-folds into the next read, and only a LANDED replace
+            # retires the folded generations
+            token = (self.batcher.fold_into_node(node)
+                     if self.batcher is not None else None)
             try:
-                result = self.kube.replace_node(self.node_name, node)
+                result = self.kube.replace_node(self.node_name, node)  # ccaudit: allow-direct-node-write(this CAS replace IS the batcher's carrier: the fold above transports every pending publication)
                 self._cached = result if cache_result else None
+                if token and self.batcher is not None:
+                    self.batcher.mark_folded(token)
                 return True
             except ConflictError:
                 continue
         raise ApiException(409, "taint update kept conflicting")
 
-    def _edit_taints(self, edit, cache_result: bool = False) -> None:
+    def _edit_taints(self, edit, cache_result: bool = False,
+                     hint_ok: bool = False) -> None:
         def mutate(node):
             taints = list(node.get("spec", {}).get("taints") or [])
             new = edit(taints)
@@ -217,7 +252,7 @@ class NodeFlipTaint(FlipTaint):
             node.setdefault("spec", {})["taints"] = new
             return True
 
-        self._cas_loop(mutate, cache_result)
+        self._cas_loop(mutate, cache_result, hint_ok)
 
     def set(self) -> None:
         def add(taints):
@@ -231,7 +266,7 @@ class NodeFlipTaint(FlipTaint):
 
         log.info("tainting %s %s=%s:%s for the flip", self.node_name,
                  L.FLIP_TAINT_KEY, L.FLIP_TAINT_VALUE, L.FLIP_TAINT_EFFECT)
-        self._edit_taints(add, cache_result=True)
+        self._edit_taints(add, cache_result=True, hint_ok=True)
 
     def clear(self) -> None:
         def remove(taints):
@@ -326,7 +361,7 @@ class ComponentDrainer(Drainer):
             return
         log.info("pausing components on %s: %s", self.node_name,
                  sorted(to_pause))
-        self.kube.set_node_labels(self.node_name, to_pause)
+        self.kube.set_node_labels(self.node_name, to_pause)  # ccaudit: allow-direct-node-write(drain protocol: the pause labels must be visible to the operator BEFORE the pod-wait poll below — deferring them would deadlock the wait)
         for label_key in to_pause:
             self._wait_component_gone(label_key)
 
@@ -369,7 +404,7 @@ class ComponentDrainer(Drainer):
         if restore:
             log.info("restoring components on %s: %s", self.node_name,
                      sorted(restore))
-            self.kube.set_node_labels(self.node_name, restore)
+            self.kube.set_node_labels(self.node_name, restore)  # ccaudit: allow-direct-node-write(drain protocol: restore must land even when the flip failed — it cannot wait behind a batcher flush that may be backing off)
             self.wrote_node = True
 
 
@@ -393,7 +428,7 @@ class NodeDrainer(Drainer):
         self.poll_s = poll_s
 
     def _cordon(self, value: bool) -> None:
-        self.kube.patch_node(self.node_name, {"spec": {"unschedulable": value}})
+        self.kube.patch_node(self.node_name, {"spec": {"unschedulable": value}})  # ccaudit: allow-direct-node-write(ordered drain step: cordon must precede the evictions issued right after it)
 
     def _tpu_pods(self):
         out = []

@@ -27,6 +27,7 @@ two, and each chip reboots once instead of twice.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tpu_cc_manager import device as devlayer
@@ -38,6 +39,7 @@ from tpu_cc_manager.flipexec import (
     SKIPPED,
     FlipOutcome,
     flip_concurrency as resolve_flip_concurrency,
+    flip_concurrency_knob,
     run_flips,
 )
 from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
@@ -136,6 +138,7 @@ class ModeEngine:
         holder_check: Optional[HolderCheck] = None,
         notify_state_label: Optional[Callable[[str], None]] = None,
         flip_concurrency: Optional[int] = None,
+        persistent_flip_pool: bool = False,
     ):
         self._set_state_label = set_state_label
         #: observation-only hook invoked when the state label's WIRE
@@ -159,6 +162,45 @@ class ModeEngine:
         #: env (default min(4, plan size)); 1 -> the serial loop exactly.
         #: See flipexec.py and docs/engine.md for the contract.
         self._flip_concurrency = flip_concurrency
+        #: when set, parallel flips reuse ONE lazily-created worker pool
+        #: across reconciles (sized to the unclamped concurrency knob)
+        #: instead of spawning/joining threads every flip — the
+        #: long-lived agent opts in and calls close(); one-shot CLIs,
+        #: tests, and simlab replicas keep the per-call pool so they
+        #: never strand idle threads (ISSUE 6 flip-path I/O)
+        self._persistent_flip_pool = persistent_flip_pool
+        self._flip_pool = None
+        self._flip_pool_lock = threading.Lock()
+
+    # ---------------------------------------------------------- lifecycle
+    def _flip_executor(self):
+        """The persistent flip worker pool (lazily created, sized to the
+        unclamped concurrency knob — which upper-bounds every per-plan
+        cap, so a pool-run plan never exceeds its requested
+        concurrency). None when persistence is off."""
+        if not self._persistent_flip_pool:
+            return None
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._flip_pool_lock:
+            if self._flip_pool is None:
+                # ccaudit: allow-blocking-under-lock(lazy singleton creation: the executor constructor only registers state — worker threads spawn on submit(), which happens outside this lock)
+                self._flip_pool = ThreadPoolExecutor(
+                    max_workers=flip_concurrency_knob(
+                        self._flip_concurrency
+                    ),
+                    thread_name_prefix="cc-flip",
+                )
+            return self._flip_pool
+
+    def close(self) -> None:
+        """Release the persistent flip worker pool (no-op otherwise).
+        The owning agent calls this on shutdown; a closed engine lazily
+        re-creates the pool if reused."""
+        with self._flip_pool_lock:
+            pool, self._flip_pool = self._flip_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # ------------------------------------------------------------- queries
     def get_modes(self) -> dict:
@@ -441,6 +483,7 @@ class ModeEngine:
         outcomes = run_flips(
             chips, flip_item,
             concurrency=cap, tracer=self._tracer, label_of=path_of,
+            executor=self._flip_executor() if cap > 1 else None,
         )
         if switches:
             if any(o.status == FAILED for o in outcomes):

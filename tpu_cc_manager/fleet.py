@@ -384,6 +384,15 @@ class FleetController:
         #: a verifier-trust-root outage (attestation_outage problem),
         #: not a metric-only fade. Same restart-to-acknowledge rule.
         self._attestation_ever_verified = False
+        #: label-vs-evidence mismatch debounce (ISSUE 6): evidence now
+        #: rides the coalescing publish core, so a scan racing a flip
+        #: can see the new state label before the (deferred) evidence
+        #: annotation lands — a transient, self-healing skew, not the
+        #: lying-label attack. A node must stay mismatched across TWO
+        #: consecutive scans to surface as a problem; first-scan hits
+        #: are reported separately (label_device_mismatch_transient)
+        #: so the skew stays visible without paging anyone.
+        self._prior_label_mismatch: set = set()
         #: watch-triggered scans: a node watch wakes the scan loop the
         #: moment report-relevant state changes, so mode divergence /
         #: failed flips / doctor verdicts surface in seconds instead of
@@ -429,6 +438,14 @@ class FleetController:
                 self._attestation_ever_verified
                 or audit.get("attestation_seen", False)
             )
+            cur_mismatch = set(audit.get("label_device_mismatch", []))
+            audit["label_device_mismatch"] = sorted(
+                cur_mismatch & self._prior_label_mismatch
+            )
+            audit["label_device_mismatch_transient"] = sorted(
+                cur_mismatch - self._prior_label_mismatch
+            )
+            self._prior_label_mismatch = cur_mismatch
             report["evidence_audit"] = audit
             report["doctor"] = self._aggregate_doctor(nodes)
             report["policies"] = self._policy_summaries()

@@ -46,6 +46,7 @@ import logging
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import ExitStack
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, TypeVar
 
@@ -70,15 +71,14 @@ FAILED = "failed"
 SKIPPED = "skipped"
 
 
-def flip_concurrency(n_items: int, override: Optional[int] = None) -> int:
-    """Resolve the effective flip concurrency for a plan of ``n_items``.
-
-    ``override`` (the engine's constructor knob) wins over the
+def flip_concurrency_knob(override: Optional[int] = None) -> int:
+    """Resolve the UNCLAMPED flip-concurrency knob (the worker-pool
+    ceiling a persistent executor should be sized to): ``override``
+    (the engine's constructor knob) wins over the
     ``TPU_CC_FLIP_CONCURRENCY`` environment knob; unset/empty means
-    ``min(DEFAULT_CAP, n_items)``. Invalid values raise DeviceError so a
-    typo'd DaemonSet env fails the flip loudly (state label ``failed``)
-    instead of silently picking some cap.
-    """
+    ``DEFAULT_CAP``. Invalid values raise DeviceError so a typo'd
+    DaemonSet env fails the flip loudly (state label ``failed``)
+    instead of silently picking some cap."""
     cap = override
     if cap is None:
         raw = os.environ.get(ENV_KNOB, "").strip()
@@ -97,7 +97,13 @@ def flip_concurrency(n_items: int, override: Optional[int] = None) -> int:
         raise DeviceError(
             f"invalid {source}={cap}: expected a positive integer"
         )
-    return max(1, min(cap, n_items))
+    return cap
+
+
+def flip_concurrency(n_items: int, override: Optional[int] = None) -> int:
+    """Effective flip concurrency for a plan of ``n_items``: the knob
+    (see :func:`flip_concurrency_knob`) clamped to the plan size."""
+    return max(1, min(flip_concurrency_knob(override), n_items))
 
 
 @dataclass
@@ -133,12 +139,22 @@ def run_flips(
     concurrency: int,
     tracer: Tracer,
     label_of: Callable[[T], str],
+    executor: Optional[ThreadPoolExecutor] = None,
 ) -> List[FlipOutcome]:
     """Run ``flip_one`` over ``items`` with bounded concurrency.
 
     ``flip_one`` returns True on success, False on a (already-logged)
     verify mismatch, and raises DeviceError on device failure. See the
     module docstring for the full serial/parallel contract.
+
+    ``executor``: an optional PERSISTENT worker pool owned by the
+    caller (the long-lived agent's engine): reusing it across
+    reconciles avoids paying thread spawn/teardown — and, with the
+    shared HTTP connection pool, connection churn — on every flip.
+    Must be sized to at least ``concurrency`` workers (the engine sizes
+    it to the unclamped knob, which upper-bounds every per-plan cap);
+    the caller owns its shutdown. When None, a pool is created and torn
+    down per call, the historical behavior.
     """
 
     def run_one(item: T) -> FlipOutcome:
@@ -186,9 +202,12 @@ def run_flips(
             abort.set()
         return out
 
-    with ThreadPoolExecutor(
-        max_workers=concurrency, thread_name_prefix="cc-flip"
-    ) as pool:
+    with ExitStack() as stack:
+        pool = executor if executor is not None else stack.enter_context(
+            ThreadPoolExecutor(
+                max_workers=concurrency, thread_name_prefix="cc-flip"
+            )
+        )
         futures = [pool.submit(worker, item) for item in items]
         # .result() outside any lock by design — see the module docstring
         outcomes = [f.result() for f in futures]

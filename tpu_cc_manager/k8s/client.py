@@ -526,17 +526,39 @@ class HttpKubeClient(KubeClient):
     #: required at fleet scale (client-go informers paginate the same way)
     LIST_PAGE_LIMIT = 500
 
+    #: default ceiling on pooled idle keep-alive connections
+    #: (``TPU_CC_KUBE_CONNS`` overrides): enough to overlap the flip
+    #: executor's default worker count plus the agent's recorder/watch
+    #: side traffic without hoarding sockets per client instance
+    POOL_MAXSIZE = 8
+
     def __init__(self, config: KubeConfig,
                  list_page_limit: Optional[int] = None,
                  qps: Optional[float] = None,
-                 burst: Optional[int] = None):
+                 burst: Optional[int] = None,
+                 pool_maxsize: Optional[int] = None):
         self.config = config
         self.list_page_limit = list_page_limit or self.LIST_PAGE_LIMIT
-        # one persistent keep-alive connection per thread: the agent
-        # heartbeats every 10 s, the rollout polls at 2 Hz, the slice wait
-        # at 1 Hz — dialing TCP(+TLS) fresh for each was hundreds of
-        # handshakes/minute at pool scale (r1 VERDICT weak #3)
-        self._local = threading.local()
+        # a small SHARED pool of persistent keep-alive connections: the
+        # historical one-connection-per-thread (threading.local) model
+        # meant every short-lived thread — the flip executor's workers,
+        # per-reconcile helpers — dialed TCP(+TLS) fresh and leaked the
+        # socket when the thread died. The shared pool survives thread
+        # churn: any thread checks a connection out for one request and
+        # returns it, so TPU_CC_FLIP_CONCURRENCY workers reuse the same
+        # few warm sockets instead of serializing on connection setup
+        # (r1 VERDICT weak #3; ISSUE 6 flip-path I/O)
+        if pool_maxsize is None:
+            try:
+                pool_maxsize = int(
+                    os.environ.get("TPU_CC_KUBE_CONNS", "") or 0
+                ) or None
+            except ValueError:
+                pool_maxsize = None
+        self.pool_maxsize = pool_maxsize or self.POOL_MAXSIZE
+        self._conns: List[HTTPConnection] = []  # idle, LIFO (warmest last)
+        self._conn_lock = threading.Lock()
+        self._pool_closed = False  # close() stops re-pooling at release
         # client-side flow control (TPU_CC_KUBE_QPS / TPU_CC_KUBE_BURST,
         # ctor args win): OFF by default — a per-node agent makes a
         # handful of writes per reconcile and must not trade flip
@@ -606,42 +628,62 @@ class HttpKubeClient(KubeClient):
                 log.debug("throttle observer failed", exc_info=True)
 
     # -- plumbing -------------------------------------------------------
-    def _pooled(self, read_timeout: Optional[float]) -> Tuple[HTTPConnection, bool]:
-        """(connection, is_fresh). Reuses this thread's connection when it
-        still has a live socket."""
-        conn = getattr(self._local, "conn", None)
-        if conn is not None and conn.sock is None:
-            # server sent Connection: close on the previous response
-            conn.close()
-            conn = None
-        if conn is None:
-            conn = self._connect(read_timeout)
-            self._local.conn = conn
-            return conn, True
-        if conn.sock is not None and read_timeout is not None:
-            try:
-                conn.sock.settimeout(read_timeout)
-            except OSError:
-                # socket died since last use: replace with a fresh dial
-                self._drop_pooled()
-                conn = self._connect(read_timeout)
-                self._local.conn = conn
-                return conn, True
-        return conn, False
+    def _acquire_conn(
+        self, read_timeout: Optional[float]
+    ) -> Tuple[HTTPConnection, bool]:
+        """Check a connection out of the shared pool — (connection,
+        is_fresh). A checked-out connection is owned by the calling
+        thread until ``_release_conn``/``_discard_conn``; dead pooled
+        sockets are dropped and replaced by a fresh dial."""
+        while True:
+            with self._conn_lock:
+                conn = self._conns.pop() if self._conns else None
+            if conn is None:
+                return self._connect(read_timeout), True
+            if conn.sock is None:
+                # server sent Connection: close on its previous response
+                conn.close()
+                continue
+            if read_timeout is not None:
+                try:
+                    conn.sock.settimeout(read_timeout)
+                except OSError:
+                    # socket died while idle: drop, try the next one
+                    self._discard_conn(conn)
+                    continue
+            return conn, False
 
-    def _drop_pooled(self) -> None:
-        conn = getattr(self._local, "conn", None)
+    def _release_conn(self, conn: HTTPConnection) -> None:
+        """Return a healthy connection to the pool (or close it when the
+        pool is full, the client was close()d, or the server asked to
+        close)."""
+        if conn.sock is None:
+            conn.close()
+            return
+        with self._conn_lock:
+            if not self._pool_closed and len(self._conns) < self.pool_maxsize:
+                self._conns.append(conn)
+                return
+        conn.close()
+
+    def _discard_conn(self, conn: Optional[HTTPConnection]) -> None:
         if conn is not None:
             try:
                 conn.close()
             except OSError:
                 pass
-            self._local.conn = None
 
     def close(self) -> None:
-        """Release this thread's pooled connection (other threads'
-        connections are reclaimed when their threads exit)."""
-        self._drop_pooled()
+        """Close every idle pooled connection and stop accepting
+        returns: a connection in flight during close() is closed by its
+        owning thread at release instead of being re-pooled. The client
+        remains usable (new requests dial fresh) — close() reclaims
+        sockets, it does not poison the instance."""
+        with self._conn_lock:
+            conns, self._conns = self._conns, []
+            self._pool_closed = True
+        for conn in conns:
+            self._discard_conn(conn)
 
     def _connect(self, read_timeout: Optional[float]) -> HTTPConnection:
         c = self.config
@@ -676,9 +718,19 @@ class HttpKubeClient(KubeClient):
     ) -> dict:
         self._acquire_token()
         resp = data = None
+        conn: Optional[HTTPConnection] = None
         for attempt in (0, 1):
             try:
-                conn, fresh = self._pooled(read_timeout)
+                if attempt == 0:
+                    conn, fresh = self._acquire_conn(read_timeout)
+                else:
+                    # replay attempt: dial FRESH, bypassing the pool —
+                    # after a server restart several idle pooled conns
+                    # may all be stale, and popping another one here
+                    # would turn a replayable race into a terminal
+                    # error (the one-conn-per-thread model always
+                    # replayed on a fresh dial; keep that guarantee)
+                    conn, fresh = self._connect(read_timeout), True
             except ExecCredentialError as e:
                 # surface credential-plugin failures through the module's
                 # error contract so callers' except-ApiException
@@ -697,6 +749,7 @@ class HttpKubeClient(KubeClient):
                 data = resp.read()  # drain fully so the conn is reusable
                 break
             except ExecCredentialError as e:
+                self._discard_conn(conn)
                 raise ApiException(0, f"exec credential failure: {e}") from e
             except (OSError, HTTPException) as e:
                 # Replay ONLY the stale keep-alive race: a reused
@@ -707,23 +760,35 @@ class HttpKubeClient(KubeClient):
                 # fresh connection — may have already executed server-side,
                 # so replaying a non-idempotent PATCH/DELETE would double-
                 # apply it; surface as an API error (status 0) and let the
-                # caller's retry/backoff own the decision.
-                self._drop_pooled()
+                # caller's retry/backoff own the decision. EXACTLY-ONCE
+                # under the shared pool: the replay dials fresh (never
+                # another possibly-stale pooled conn), and a failure on
+                # that fresh dial is terminal (not replayable).
+                self._discard_conn(conn)
                 replayable = isinstance(e, BadStatusLine) and not fresh
                 if not replayable or attempt == 1:
                     raise ApiException(0, f"transport error: {e}") from e
         if resp.status == 401 and _auth_retry and self.config.exec_plugin:
             # cached exec credential revoked server-side: refresh once
-            # (client-go invalidate-and-retry contract). Drop the pooled
+            # (client-go invalidate-and-retry contract). Drop this
             # connection too — a refreshed exec client *certificate* only
             # takes effect on a new TLS handshake, so retrying over the
-            # old session would 401 forever.
+            # old session would 401 forever. The same goes for every
+            # idle pooled connection (their sessions were handshaken
+            # with the revoked cert): drain the pool so the retry —
+            # and every later request — dials fresh instead of checking
+            # out another stale session and failing terminally.
             self.config.exec_plugin.invalidate()
-            self._drop_pooled()
+            self._discard_conn(conn)
+            with self._conn_lock:
+                stale, self._conns = self._conns, []
+            for c in stale:
+                self._discard_conn(c)
             return self._request(
                 method, path, body=body, content_type=content_type,
                 read_timeout=read_timeout, _auth_retry=False,
             )
+        self._release_conn(conn)
         if resp.status >= 400:
             if resp.status == 409:
                 raise ConflictError(data.decode("utf-8", "replace")[:200])

@@ -21,6 +21,7 @@ one instance).
 
 from __future__ import annotations
 
+import bisect
 import copy
 import threading
 import time
@@ -53,8 +54,10 @@ class FakeKube(KubeClient):
         self._nodes: Dict[str, dict] = {}
         self._pods: Dict[Tuple[str, str], dict] = {}
         self._rv = 0
-        # watch history: list of (rv, type, node_snapshot)
+        # watch history: list of (rv, type, node_snapshot), plus a
+        # parallel rv list so watchers bisect to their resume point
         self._events: List[Tuple[int, str, dict]] = []
+        self._event_rvs: List[int] = []
         self._history_limit = watch_history_limit
         # fault injection
         self.pdb_blocked: set = set()  # {(ns, name)} -> evict raises 429
@@ -62,7 +65,22 @@ class FakeKube(KubeClient):
         #: next N node LISTs answer 429 (API-server overload storm, the
         #: priority-and-fairness rejection clients must retry through)
         self.fail_next_lists = 0
+        #: next N node WRITES (patch/replace) answer 429 — the write-path
+        #: overload storm the coalescing publish core must absorb
+        #: without losing its newest generation (ISSUE 6)
+        self.fail_next_node_writes = 0
         self.patch_delay_s = 0.0  # simulated API latency
+        # Write accounting (ISSUE 6 satellite): batching merges several
+        # LOGICAL mutations into one HTTP round trip, so "requests" and
+        # "mutations" are now different numbers — counting only requests
+        # would let batching silently inflate the per-request economics
+        # bench.py reports. ``node_write_requests`` counts node-write API
+        # calls (patch/replace, incl. 429-rejected ones — the server
+        # still paid for them); ``node_write_mutations`` counts the
+        # logical units those calls carried (label keys, annotation
+        # keys, a taint-list change, a spec field).
+        self.node_write_requests = 0
+        self.node_write_mutations = 0
         #: when set, idle watches emit BOOKMARK events at this cadence
         #: (like a real API server with allowWatchBookmarks), letting
         #: clients keep their resourceVersion current through
@@ -89,9 +107,20 @@ class FakeKube(KubeClient):
 
     def _record(self, etype: str, node: dict) -> None:
         self._events.append((self._rv, etype, copy.deepcopy(node)))
+        self._event_rvs.append(self._rv)
         if len(self._events) > self._history_limit:
             self._events = self._events[-self._history_limit:]
+            self._event_rvs = self._event_rvs[-self._history_limit:]
         self._lock.notify_all()
+
+    def _events_after(self, rv: int) -> List[Tuple[int, str, dict]]:
+        """Retained node events with rv strictly greater than ``rv``
+        (caller holds _lock). Binary search over the parallel rv list:
+        a fleet of watchers rescanning the whole history linearly on
+        every wakeup was O(history x watchers x writes) — the fake API
+        server's own scaling wall at 256 live replicas."""
+        i = bisect.bisect_right(self._event_rvs, rv)
+        return self._events[i:]
 
     # ------------------------------------------------------- test surface
     def add_node(self, node: dict) -> dict:
@@ -111,11 +140,60 @@ class FakeKube(KubeClient):
         """Drop all retained events: any resume from an old rv now 410s."""
         with self._lock:
             self._events = []
+            self._event_rvs = []
 
     @property
     def latest_rv(self) -> str:
         with self._lock:
             return str(self._rv)
+
+    def node_write_stats(self) -> dict:
+        """Snapshot of the node-write accounting: HTTP-round-trip
+        ``requests`` vs the ``mutations`` (logical label/annotation/
+        taint/spec units) they carried. The gap between the two IS the
+        coalescing win — bench.py reports both."""
+        with self._lock:
+            return {
+                "requests": self.node_write_requests,
+                "mutations": self.node_write_mutations,
+            }
+
+    def peek_node_label(self, name: str, key: str):
+        """Measurement-only read of one node label WITHOUT the full-node
+        deepcopy ``get_node`` pays: bench/simlab convergence pollers call
+        this at tens of Hz per node, and deepcopying evidence-laden node
+        objects inside the store lock was measurement load distorting
+        the system under test."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiException(404, f"node {name} not found")
+            return (node["metadata"].get("labels") or {}).get(key)
+
+    def _check_node_write_fault(self) -> None:
+        """429 the next N node writes when armed (caller holds _lock)."""
+        self.node_write_requests += 1
+        if self.fail_next_node_writes > 0:
+            self.fail_next_node_writes -= 1
+            raise ApiException(429, "injected node-write overload")
+
+    @staticmethod
+    def _mutation_units(old: dict, new: dict) -> int:
+        """Logical mutation units between two node objects: changed/
+        removed label keys + annotation keys + 1 per changed spec
+        field. resourceVersion/managed metadata moves don't count."""
+        units = 0
+        for field in ("labels", "annotations"):
+            a = (old.get("metadata") or {}).get(field) or {}
+            b = (new.get("metadata") or {}).get(field) or {}
+            keys = set(a) | set(b)
+            units += sum(1 for k in keys if a.get(k) != b.get(k))
+        old_spec = old.get("spec") or {}
+        new_spec = new.get("spec") or {}
+        for k in set(old_spec) | set(new_spec):
+            if old_spec.get(k) != new_spec.get(k):
+                units += 1
+        return units
 
     # ------------------------------------------------------------- nodes
     def get_node(self, name: str) -> dict:
@@ -146,6 +224,25 @@ class FakeKube(KubeClient):
         real API server; the token encodes the resume position."""
         return _paginate(self.list_nodes(label_selector), limit, cont)
 
+    def set_node_labels_direct(self, name: str,
+                               labels: Dict[str, Optional[str]]) -> dict:
+        """Operator hand-of-god label write for scenario/bench drivers:
+        bypasses write-fault injection and the write accounting (it is
+        the scenario's INPUT, not system-under-test traffic) while
+        still bumping the resourceVersion and emitting a watch event
+        like any real write — a driver that wrote through the faulted
+        path would soak the very storm it scripted."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiException(404, f"node {name} not found")
+            merged = merge_patch(node, {"metadata": {"labels": labels}})
+            merged["metadata"]["name"] = name
+            self._nodes[name] = merged
+            self._bump(merged)
+            self._record("MODIFIED", merged)
+            return copy.deepcopy(merged)
+
     def patch_node(self, name: str, patch: dict) -> dict:
         if self.patch_delay_s:
             time.sleep(self.patch_delay_s)
@@ -153,8 +250,10 @@ class FakeKube(KubeClient):
             node = self._nodes.get(name)
             if node is None:
                 raise ApiException(404, f"node {name} not found")
+            self._check_node_write_fault()
             merged = merge_patch(node, patch)
             merged["metadata"]["name"] = name  # name is immutable
+            self.node_write_mutations += self._mutation_units(node, merged)
             self._nodes[name] = merged
             self._bump(merged)
             self._record("MODIFIED", merged)
@@ -165,6 +264,7 @@ class FakeKube(KubeClient):
             cur = self._nodes.get(name)
             if cur is None:
                 raise ApiException(404, f"node {name} not found")
+            self._check_node_write_fault()
             if node["metadata"].get("resourceVersion") != cur["metadata"]["resourceVersion"]:
                 raise ConflictError(
                     f"rv {node['metadata'].get('resourceVersion')} != "
@@ -172,6 +272,7 @@ class FakeKube(KubeClient):
                 )
             new = copy.deepcopy(node)
             new["metadata"]["name"] = name
+            self.node_write_mutations += self._mutation_units(cur, new)
             self._nodes[name] = new
             self._bump(new)
             self._record("MODIFIED", new)
@@ -451,9 +552,8 @@ class FakeKube(KubeClient):
                 establishing = False
                 pending = [
                     (rv, t, obj)
-                    for (rv, t, obj) in self._events
-                    if rv > last_rv
-                    and (name is None or obj["metadata"]["name"] == name)
+                    for (rv, t, obj) in self._events_after(last_rv)
+                    if name is None or obj["metadata"]["name"] == name
                 ]
                 if self._events:
                     # everything currently retained has now been examined

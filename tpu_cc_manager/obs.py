@@ -312,6 +312,27 @@ class Metrics:
             "Wall-clock duration of one reconcile phase (trace span)",
             "phase",
         )
+        # coalescing publish core (k8s.batch, ISSUE 6): the loss
+        # accounting that keeps "only the newest generation is sent"
+        # honest — every superseded, retried, and dropped publication
+        # is visible here, never silent
+        self.publications_coalesced_total = Counter(
+            "tpu_cc_publications_coalesced_total",
+            "Evidence/doctor publications superseded by a newer "
+            "generation before being sent (coalescing by design)",
+            ("kind",),
+        )
+        self.publish_retries_total = Counter(
+            "tpu_cc_publish_retries_total",
+            "Failed coalescing-publish flush attempts awaiting backoff "
+            "retry",
+        )
+        self.publications_dropped_total = Counter(
+            "tpu_cc_publications_dropped_total",
+            "Publications dropped after exhausting the flush retry "
+            "budget (the owner's generation bookkeeping republishes)",
+            ("kind",),
+        )
 
     def observe_span(self, span) -> None:
         """Trace sink: fold completed spans into the per-phase histogram."""
@@ -330,6 +351,11 @@ class Metrics:
             self.current_mode,
             self.coalesced_total,
             self.repairs_total,
+            self.events_emitted_total,
+            self.events_dropped_total,
+            self.publications_coalesced_total,
+            self.publish_retries_total,
+            self.publications_dropped_total,
             self.phase_duration,
         ):
             lines.extend(m.render())

@@ -111,6 +111,12 @@ class FaultInjector:
             self.store.fail_next_lists += count
         return {"count": count}
 
+    def _write_429(self, params: dict) -> dict:
+        count = int(params["count"])
+        with self.store._lock:
+            self.store.fail_next_node_writes += count
+        return {"count": count}
+
     def _throttle_squeeze(self, params: dict) -> dict:
         qps = float(params["qps"])
         duration_s = float(params["duration_s"])

@@ -24,8 +24,9 @@ import queue
 import threading
 from typing import Callable, Dict, List, Optional
 
-from tpu_cc_manager.drain import set_cc_mode_state_label
+from tpu_cc_manager import labels as L
 from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
+from tpu_cc_manager.k8s.batch import NodePatchBatcher
 from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 
 log = logging.getLogger("tpu-cc-manager.simlab.replica")
@@ -59,10 +60,13 @@ class ReplicaShell:
         self.kube = kube
         self.backend = backend
         self.evidence = evidence
+        # the write-coalescing layer (k8s.batch): the state-label write
+        # is the replica's carrier — it transports the PREVIOUS
+        # reconcile's deferred evidence, so a flip costs one write, not
+        # two. The runner's settle pass flushes stragglers.
+        self.batcher = NodePatchBatcher(kube, node_name)
         self.engine = ModeEngine(
-            set_state_label=lambda v: set_cc_mode_state_label(
-                kube, node_name, v
-            ),
+            set_state_label=self.batcher.write_state_label,
             drainer=NullDrainer(),
             evict_components=False,
             backend=backend,
@@ -81,6 +85,12 @@ class ReplicaShell:
         self.coalesced = 0
         self._resubmit: Optional[Callable[[str, str], None]] = None
         self._timers: List[threading.Timer] = []
+        #: evidence generation bookkeeping (the agent's
+        #: _evidence_published_gen analog, scaled down): wanted >
+        #: published means the newest document hasn't landed and the
+        #: next success or settle flush must deliver it
+        self.evidence_wanted_gen = 0
+        self.evidence_published_gen = 0
 
     # ------------------------------------------------------------ mailbox
     def offer(self, value: str) -> bool:
@@ -104,10 +114,14 @@ class ReplicaShell:
             with self._lock:
                 if self._pending is _EMPTY or not self.alive:
                     self._queued = False
-                    return
+                    break
                 value = self._pending
                 self._pending = _EMPTY
             self._reconcile(value)
+        # mailbox drained: flush any deferred publication that found no
+        # carrier write (respects the batcher's flush window/backoff) —
+        # the replica's idle-tick analog
+        self.batcher.maybe_flush()
 
     # ---------------------------------------------------------- reconcile
     def _reconcile(self, mode: str) -> None:
@@ -138,16 +152,44 @@ class ReplicaShell:
         if ok:
             self.applied = mode
             if self.evidence:
-                from tpu_cc_manager.evidence import publish_evidence
-
-                publish_evidence(self.kube, self.node_name,
-                                 backend=self.backend)
+                self._defer_evidence()
         elif outcome in ("failure", "error"):
             self._arm_repair(mode)
 
+    def _defer_evidence(self) -> None:
+        """Build this node's evidence document and hand it to the
+        coalescing batcher: it rides the NEXT reconcile's state write
+        (or the runner's settle flush); only the newest generation is
+        ever sent, superseded ones are counted by the batcher."""
+        import json as _json
+
+        from tpu_cc_manager.evidence import build_evidence
+
+        try:
+            doc = build_evidence(self.node_name, self.backend)
+            payload = _json.dumps(doc, sort_keys=True,
+                                  separators=(",", ":"))
+        except Exception:
+            log.warning("%s: evidence build failed", self.node_name,
+                        exc_info=True)
+            return
+        self.evidence_wanted_gen += 1
+
+        def landed(gen: int) -> None:
+            self.evidence_published_gen = max(
+                self.evidence_published_gen, gen
+            )
+
+        self.batcher.defer(
+            "evidence",
+            annotations={L.EVIDENCE_ANNOTATION: payload},
+            gen=self.evidence_wanted_gen,
+            on_published=landed,
+        )
+
     def _publish_failed(self) -> None:
         try:
-            set_cc_mode_state_label(self.kube, self.node_name, STATE_FAILED)
+            self.batcher.write_state_label(STATE_FAILED)
         except Exception:
             log.warning("%s: could not publish failed state",
                         self.node_name)

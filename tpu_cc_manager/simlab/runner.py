@@ -194,7 +194,11 @@ class SimLab:
         names = self._nodes_in_pool(params.get("pool"))
         for name in names:
             self.stamps.record(name, mode, time.monotonic())
-            self.ops_kube.set_node_labels(name, {L.CC_MODE_LABEL: mode})
+            # out-of-band store write (like _wait_converged's polling):
+            # the driver's input must neither add HTTP load to the
+            # system under test nor soak a scripted write_429 storm
+            self.server.store.set_node_labels_direct(
+                name, {L.CC_MODE_LABEL: mode})
         return {"mode": mode, "nodes": len(names)}
 
     def _act_create_policy(self, params: dict) -> dict:
@@ -233,8 +237,8 @@ class SimLab:
         while pending and time.monotonic() < deadline:
             pending = {
                 n for n in pending
-                if store.get_node(n)["metadata"]["labels"].get(
-                    L.CC_MODE_STATE_LABEL) != target
+                if store.peek_node_label(
+                    n, L.CC_MODE_STATE_LABEL) != target
             }
             if pending:
                 time.sleep(0.05)
@@ -360,6 +364,12 @@ class SimLab:
             if not busy:
                 break
             time.sleep(0.05)
+        # deliver deferred publications that found no carrier (the last
+        # reconcile's evidence has no next state write to ride): the
+        # final fleet scan below must audit the settled fleet, and the
+        # newest-generation-always-lands contract is judged here
+        for r in self.replicas.values():
+            r.batcher.flush()
         for c in self._controllers:
             from tpu_cc_manager.fleet import FleetController
 
@@ -372,6 +382,11 @@ class SimLab:
 
     def _finish(self, ok, initial_s, conv_s, pending, faults, notes):
         replica_stats = {"total": 0, "repairs": 0, "coalesced": 0}
+        # the coalescing publish core's loss accounting, fleet-wide
+        # (ISSUE 6): superseded/folded/flushed/retried/dropped
+        # publications across every replica batcher
+        publish_stats = {"coalesced": 0, "folded": 0, "flushed": 0,
+                         "retries": 0, "dropped": 0, "pending": 0}
         for r in self.replicas.values():
             replica_stats["total"] += r.reconciles
             replica_stats["repairs"] += r.repairs
@@ -380,6 +395,16 @@ class SimLab:
                 replica_stats[outcome] = (
                     replica_stats.get(outcome, 0) + n
                 )
+            for k, v in r.batcher.stats().items():
+                publish_stats[k] = publish_stats.get(k, 0) + v
+        replica_stats["publish"] = publish_stats
+        # HTTP round trips vs the logical mutations they carried: the
+        # gap is the batching win; per-request numbers without this
+        # split would silently inflate under coalescing
+        if self.server is not None:
+            replica_stats["api_writes"] = (
+                self.server.store.node_write_stats()
+            )
         from tpu_cc_manager.simlab.report import percentile
 
         with self._throttle_lock:

@@ -42,6 +42,11 @@ FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
     # the next N node LISTs answer 429 (apiserver overload storm):
     # relists and controller scans must retry through it
     "list_429": {"count": (True, int)},
+    # the next N node WRITES (patch/replace) answer 429: the write-path
+    # storm the coalescing publish core (k8s.batch) must absorb —
+    # state writes re-enter via replica repair, deferred evidence
+    # retries with backoff, and the newest generation still lands
+    "write_429": {"count": (True, int)},
     # squeeze the shared data-plane client's token bucket to qps for
     # duration_s, then restore the scenario's configured rate
     "throttle_squeeze": {"qps": (True, (int, float)),

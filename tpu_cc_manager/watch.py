@@ -143,6 +143,13 @@ class NodeWatcher:
         self._last_value: Optional[str] = None
         self.resource_version: Optional[str] = None
         self.consecutive_errors = 0
+        #: newest full node object seen (prime read or watch event),
+        #: guarded by its own lock — the taint layer seeds its CAS
+        #: replaces from this snapshot instead of paying a fresh GET
+        #: (ISSUE 6: the desired-label event that triggers a reconcile
+        #: carries a node fresher than anything a GET would return)
+        self._snapshot_lock = threading.Lock()
+        self._last_node: Optional[dict] = None
 
     # ------------------------------------------------------------ helpers
     def read_node_label(self) -> Optional[str]:
@@ -150,7 +157,22 @@ class NodeWatcher:
         main.py:585-600)."""
         node = self.kube.get_node(self.node_name)
         self.resource_version = node["metadata"]["resourceVersion"]
+        self._remember_node(node)
         return node["metadata"].get("labels", {}).get(self.label_key)
+
+    def _remember_node(self, node: dict) -> None:
+        with self._snapshot_lock:
+            self._last_node = node
+
+    def latest_node(self) -> Optional[dict]:
+        """A deep copy of the newest node object this watcher has seen
+        (None before the prime read). Callers may mutate it freely —
+        it's a seed for optimistic-concurrency writes, nothing more."""
+        import copy
+
+        with self._snapshot_lock:
+            # ccaudit: allow-blocking-under-lock(deepcopy of one node object, not I/O: the copy must happen inside the lock or the watch thread could swap the snapshot mid-copy)
+            return copy.deepcopy(self._last_node) if self._last_node else None
 
     def _push(self, value: Optional[str]) -> None:
         if value != self._last_value:
@@ -184,6 +206,10 @@ class NodeWatcher:
                     if rv is not None:
                         self.resource_version = rv  # main.py:648-649
                     if etype in ("ADDED", "MODIFIED"):
+                        # snapshot BEFORE pushing the label downstream:
+                        # a reconcile triggered by this event must find
+                        # a seed at least as fresh as its own trigger
+                        self._remember_node(node)
                         self._push(
                             node["metadata"].get("labels", {}).get(self.label_key)
                         )
