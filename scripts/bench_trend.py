@@ -34,11 +34,20 @@ REGRESSION_FACTOR = 2.0
 #: flips_per_min_windowed joined as a first-class gated axis in r07
 #: (the coalesced flip-path writes round, ISSUE 6) — the steady-state
 #: throughput the write-batching work is judged on.
+#: fleet_scan_warm_s / planner_tick_100k_s joined in r08 (the
+#: array-native planner round, ISSUE 7): the warm per-tick fleet scan
+#: (compile economics stripped out — the number a steady-state
+#: controller pays every interval) and the synthetic 100k-node planner
+#: tick (the ROADMAP item 3 scale proof). The COLD scan number stays
+#: visible as scale256.fleet_scan_s but ungated: with the persistent
+#: compile cache it measures cache priming, a one-per-deploy cost.
 GATED_EXTRA_AXES = {
     "real_chip_flip_s": "lower",
     "pool256_convergence_s": "lower",
     "multichip_flip_s": "lower",
     "flips_per_min_windowed": "higher",
+    "fleet_scan_warm_s": "lower",
+    "planner_tick_100k_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are
@@ -56,6 +65,16 @@ THROUGHPUT_FLOORS = {
 #: ~5) pass.
 WRITE_CEILINGS = {
     "node_writes_per_flip": 2.5,
+}
+#: absolute latency maxima on the newest round (ISSUE 7 acceptance):
+#: the warm fleet scan must sit far under the old ~8s cold number
+#: (0.5s allows the 256-node list round trips at QPS=50 plus the tick
+#: itself), and the 100k-node planner tick must finish in single-digit
+#: seconds on the 2-core sandbox. Same skip-if-absent and
+#: BENCH_NOTES/regression_note escape as every other bar.
+LATENCY_CEILINGS = {
+    "fleet_scan_warm_s": 0.5,
+    "planner_tick_100k_s": 9.0,
 }
 
 
@@ -140,12 +159,13 @@ def main(root: str = ".") -> int:
             problems.append(
                 f"{axis} {b} below the {floor:g} floor"
             )
-    for axis, ceiling in WRITE_CEILINGS.items():
-        b = cur_x.get(axis)
-        if isinstance(b, (int, float)) and b > ceiling:
-            problems.append(
-                f"{axis} {b} above the {ceiling:g} ceiling"
-            )
+    for ceilings in (WRITE_CEILINGS, LATENCY_CEILINGS):
+        for axis, ceiling in ceilings.items():
+            b = cur_x.get(axis)
+            if isinstance(b, (int, float)) and b > ceiling:
+                problems.append(
+                    f"{axis} {b} above the {ceiling:g} ceiling"
+                )
     if not problems:
         print(f"bench-trend: {os.path.basename(cur_path)} within "
               f"{REGRESSION_FACTOR}x of {os.path.basename(prev_path)}")

@@ -44,6 +44,29 @@ def _force_cpu_jax():
 _force_cpu_jax()
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _prewarm_planner():
+    """Compile the planner's smallest-bucket tick once per session.
+
+    _TICK_CACHE makes the compile once-per-process regardless; paying
+    it here (~1s) instead of inside the first controller test keeps
+    wall-clock-sensitive assertions (parallel-convergence < 1.0s,
+    demotion worker-stop < 5s) measuring what they claim to measure —
+    the same reason production runs plan.warmup() at controller start."""
+    import numpy as np
+
+    from tpu_cc_manager import plan
+
+    cols = {
+        k: np.zeros(plan.BUCKET_MIN_NODES, np.int32)
+        for k in ("desired", "observed", "slice_ids", "pool_ids",
+                  "taint", "doctor", "ev_ts", "valid")
+    }
+    plan._tick_fn(plan.BUCKET_MIN_NODES, plan.BUCKET_MIN_POOLS)(
+        cols, np.zeros(plan.BUCKET_MIN_POOLS, np.int32)
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_device_backend():
     device_base.set_backend(None)

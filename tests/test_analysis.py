@@ -834,3 +834,69 @@ def test_direct_node_write_pragma_allows_ordered_writes():
     """
     findings = run(src, relpath="tpu_cc_manager/engine.py")
     assert not [f for f in findings if f.rule == "direct-node-write"]
+
+
+# --------------------------------------------------------- planner-bypass
+def test_planner_bypass_flags_mode_loop_in_scan_controller():
+    """ISSUE 7: per-node mode-label reads inside a loop in fleet/policy
+    reintroduce exactly the Python scan loops the batched planner
+    kernel replaced — O(fleet) work back on the scan path."""
+    src = """
+    def derive(nodes):
+        converged = 0
+        for n in nodes:
+            if n["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL) == "on":
+                converged += 1
+        return converged
+    """
+    for relpath in ("tpu_cc_manager/fleet.py", "tpu_cc_manager/policy.py"):
+        findings = run(src, relpath=relpath)
+        hits = [f for f in findings if f.rule == "planner-bypass"]
+        assert len(hits) == 1, relpath
+        assert "analyze_pools" in hits[0].message
+
+
+def test_planner_bypass_scopes_to_scan_controllers_and_loops():
+    # rollout's per-node label touches are actuation, not analysis —
+    # out of scope by module; a loop-free read in fleet.py is fine too
+    loop_src = """
+    def derive(nodes):
+        for n in nodes:
+            x = n["metadata"]["labels"].get(L.CC_MODE_LABEL)
+    """
+    for relpath in ("tpu_cc_manager/rollout.py", "tpu_cc_manager/plan.py",
+                    "snippet.py"):
+        findings = run(loop_src, relpath=relpath)
+        assert not [f for f in findings if f.rule == "planner-bypass"], relpath
+    flat_src = """
+    def derive(node):
+        return node["metadata"]["labels"].get(L.CC_MODE_LABEL)
+    """
+    findings = run(flat_src, relpath="tpu_cc_manager/fleet.py")
+    assert not [f for f in findings if f.rule == "planner-bypass"]
+
+
+def test_planner_bypass_pragma_allows_deliberate_reads():
+    src = """
+    def derive(nodes):
+        for n in nodes:
+            x = n["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL)  # ccaudit: allow-planner-bypass(evidence audit cross-checks label text against attestation)
+    """
+    findings = run(src, relpath="tpu_cc_manager/fleet.py")
+    assert not [f for f in findings if f.rule == "planner-bypass"]
+
+
+def test_planner_bypass_nested_loop_reports_once():
+    # ast.walk visits a nested loop's body once per enclosing loop;
+    # the rule dedupes by position or one read double-reports into
+    # baselines and SARIF
+    src = """
+    def derive(pools):
+        for pool in pools:
+            for n in pool:
+                if n["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL) == "on":
+                    pass
+    """
+    findings = run(src, relpath="tpu_cc_manager/policy.py")
+    hits = [f for f in findings if f.rule == "planner-bypass"]
+    assert len(hits) == 1

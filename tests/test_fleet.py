@@ -421,3 +421,22 @@ def test_watchless_client_degrades_to_polling():
 
     ctrl = FleetController(Minimal(), port=0)
     ctrl._watch_loop()  # returns promptly instead of raising/looping
+
+
+def test_watch_feed_filters_foreign_nodes():
+    """The node watch streams EVERY cluster node; only fleet-selector
+    matches may enter the planner's feature block (a foreign failed
+    node must never surface in a report snapshotted before the next
+    list sync prunes it). DELETED always forwards."""
+    ctrl = FleetController(_mixed_fleet())
+    foreign = make_node("pet-vm", labels={
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "failed"})
+    ctrl._on_watch_event("ADDED", foreign)
+    assert len(ctrl._encoding) == 0
+    member = make_node("tpu-1", labels={
+        L.TPU_ACCELERATOR_LABEL: "v5p", L.CC_MODE_LABEL: "on",
+        L.CC_MODE_STATE_LABEL: "on"})
+    ctrl._on_watch_event("ADDED", member)
+    assert len(ctrl._encoding) == 1
+    ctrl._on_watch_event("DELETED", member)
+    assert len(ctrl._encoding) == 0

@@ -110,3 +110,120 @@ def test_graft_entry_multichip_dryrun():
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     m.dryrun_multichip(8)  # asserts sharded == unsharded internally
+
+
+def test_slice_relabel_churn_never_overflows_bucket():
+    """Regression: slice-id assignment is monotonic and the release-side
+    compaction is amortized, so a relabel churn on a mid-size fleet
+    could push live slice ids past the node bucket's slot count —
+    crashing analyze_encoding with an IndexError (and silently dropping
+    scatter rows before that). snapshot() now compacts whenever ids
+    would not fit."""
+    from tpu_cc_manager.plan import FleetEncoding, analyze_encoding, bucket_nodes
+
+    enc = FleetEncoding()
+    for i in range(50):
+        enc.apply(_node(f"base-{i}", desired="on", observed="on"))
+    churner = "churn-node"
+    for round_ in range(60):
+        enc.apply(make_node(churner, labels={
+            L.CC_MODE_LABEL: "on",
+            L.CC_MODE_STATE_LABEL: "off",
+            L.TPU_SLICE_LABEL: f"ephemeral-{round_}",
+        }))
+        report = analyze_encoding(enc)  # must never throw
+        assert report["nodes"] == 51
+        assert report["needs_flip"] == [churner]
+    snap = enc.snapshot()
+    nb = bucket_nodes(snap.n_nodes)
+    assert all(v < nb for v in snap.slice_index.values())
+
+
+def test_analyze_pools_counts_and_failed_stays_eligible():
+    """Per-pool kernel counts — and the recovery contract: FAILED nodes
+    stay rollout-eligible (re-driving desired labels is how a failed
+    flip recovers), while mid-flip taints and failing doctors hold."""
+    import json as _json
+
+    from tpu_cc_manager.plan import analyze_pools
+
+    def taint_node(name):
+        n = _node(name, desired="on", observed="off")
+        n.setdefault("spec", {})["taints"] = [
+            {"key": L.FLIP_TAINT_KEY, "effect": "NoSchedule"}
+        ]
+        return n
+
+    def doctor_node(name):
+        n = _node(name, desired="on", observed="off")
+        n["metadata"].setdefault("annotations", {})[
+            L.DOCTOR_ANNOTATION
+        ] = _json.dumps({"ok": False, "fail": ["iommu"], "at": None})
+        return n
+
+    stats = analyze_pools([
+        ("mixed", "on", [
+            _node("m-conv", desired="on", observed="on"),
+            _node("m-div", desired="off", observed="off"),
+            taint_node("m-flip"),
+            doctor_node("m-doc"),
+        ]),
+        ("all-failed", "on", [
+            _node(f"f-{i}", desired="off", observed="failed")
+            for i in range(3)
+        ]),
+    ])
+    mixed = stats["mixed"]
+    assert mixed == {
+        "nodes": 4, "converged": 1, "failed": 0, "divergent": 3,
+        # observed modes: on/off/off/off -> 1 off the dominant mode;
+        # of 3 divergent, the tainted and doctor-failing nodes hold
+        "skew": 1, "eligible": 1,
+    }
+    af = stats["all-failed"]
+    assert af["nodes"] == 3 and af["failed"] == 3 and af["divergent"] == 3
+    # the regression pin: an all-failed pool must NOT read eligible=0
+    # (that held its rollout launch forever)
+    assert af["eligible"] == 3
+
+
+def test_doctor_timestamp_only_republish_does_not_reencode():
+    """The feature block's O(changed) contract under periodic doctor
+    republishing: a verdict whose CONTENT is unchanged (only the
+    timestamp moved) must not dirty the fingerprint — the same stable
+    {ok, fail} reduction the watch wake-filter uses."""
+    import json as _json
+
+    from tpu_cc_manager.plan import FleetEncoding
+
+    def doctored(ok, fail, at):
+        n = _node("doc-n", desired="on", observed="on")
+        n["metadata"].setdefault("annotations", {})[
+            L.DOCTOR_ANNOTATION
+        ] = _json.dumps({"ok": ok, "fail": fail, "at": at})
+        return n
+
+    enc = FleetEncoding()
+    assert enc.apply(doctored(True, [], "2026-08-03T00:00:00Z"))
+    assert not enc.apply(doctored(True, [], "2026-08-03T00:01:00Z"))
+    # content change still re-encodes
+    assert enc.apply(doctored(False, ["iommu"], "2026-08-03T00:02:00Z"))
+    assert not enc.apply(doctored(False, ["iommu"], "2026-08-03T00:03:00Z"))
+
+
+def test_unchanged_slice_membership_keeps_its_id():
+    """Mode/taint/doctor updates must not release/re-acquire the row's
+    slice id — slot churn on every update would lean on compaction and
+    cost O(slices) per update."""
+    from tpu_cc_manager.plan import FleetEncoding
+
+    enc = FleetEncoding()
+    enc.apply(_node("churn", desired="on", observed="off",
+                    slice_id="s-stable"))
+    sid_before = dict(enc._slice_index)["s-stable"]
+    next_before = enc._next_slice
+    for observed in ("on", "off", "on"):
+        enc.apply(_node("churn", desired="on", observed=observed,
+                        slice_id="s-stable"))
+    assert dict(enc._slice_index)["s-stable"] == sid_before
+    assert enc._next_slice == next_before

@@ -1,0 +1,163 @@
+"""Compile economics of the array-native planner (ISSUE 7).
+
+Two contracts keep the planner's XLA cost off the scan path:
+
+- **Shape buckets**: node counts pad to power-of-two buckets
+  (plan.bucket_nodes), so fleet-geometry drift inside a bucket reuses
+  the compiled tick — pinned here by counting actual retraces
+  (plan.TRACE_COUNTS, incremented by a Python side effect inside the
+  traced body, so it moves ONLY when XLA re-traces).
+- **Persistent AOT cache**: plan.configure_cache + plan.warmup
+  serialize the bucket ladder's compiles to disk; a restarted process
+  deserializes instead of recompiling — pinned here with two real
+  subprocesses sharing one cache dir and jax.monitoring's
+  cache_hit/cache_miss events.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager import plan
+from tpu_cc_manager.plan import bucket_nodes, bucket_pools
+
+
+def _node(name, slice_id=None, desired="on", state="off"):
+    labels = {
+        L.CC_MODE_LABEL: desired,
+        L.CC_MODE_STATE_LABEL: state,
+    }
+    if slice_id:
+        labels[L.TPU_SLICE_LABEL] = slice_id
+    return {"metadata": {"name": name, "labels": labels}}
+
+
+# ----------------------------------------------------------- bucket shape
+def test_bucket_nodes_power_of_two_and_reserves_padding_slot():
+    for n in (0, 1, 5, 63, 64, 100, 1000, 100_000):
+        b = bucket_nodes(n)
+        assert b & (b - 1) == 0, f"bucket_nodes({n})={b} not a power of 2"
+        # every node may be a solo slice; +1 reserves the padding slot
+        assert b >= n + 1
+        assert b >= 64
+    assert bucket_nodes(63) == 64
+    assert bucket_nodes(64) == 128  # 64 nodes need 65 slice slots
+    assert bucket_nodes(100_000) == 131072
+
+
+def test_bucket_pools_power_of_two_with_padding():
+    assert bucket_pools(0) == 8
+    assert bucket_pools(7) == 8
+    assert bucket_pools(8) == 16  # 8 pools + the padding slot
+
+
+# ------------------------------------------------------- retrace counting
+def test_node_count_drift_within_bucket_never_recompiles():
+    """The no-recompile guarantee: every fleet size in [1, 63] shares
+    the 64-row bucket, so the tick traces at most once across all of
+    them — geometry drift costs a fingerprint-diffed re-encode, not an
+    XLA compile."""
+    plan.analyze_fleet([_node("seed-0")])  # ensure the bucket is traced
+    base = plan.TRACE_COUNTS.get("fleet_tick", 0)
+    for n in (1, 2, 17, 40, 63):
+        report = plan.analyze_fleet(
+            [_node(f"d{n}-{i}") for i in range(n)]
+        )
+        assert report["nodes"] == n
+    assert plan.TRACE_COUNTS.get("fleet_tick", 0) == base, (
+        "node-count drift inside one shape bucket re-traced the kernel"
+    )
+
+
+def test_bucket_step_recompiles_exactly_once():
+    plan.analyze_fleet([_node("seed-1")])
+    base = plan.TRACE_COUNTS.get("fleet_tick", 0)
+    # 100 nodes cross into the 128-row bucket: exactly one new trace,
+    # and further drift inside THAT bucket is free again
+    for n in (100, 80, 127):
+        plan.analyze_fleet([_node(f"s{n}-{i}") for i in range(n)])
+    grown = plan.TRACE_COUNTS.get("fleet_tick", 0) - base
+    assert grown <= 1, f"one bucket step cost {grown} traces"
+
+
+def test_pool_batch_shares_the_bucketed_kernel():
+    """analyze_pools rides the same (node-bucket, pool-bucket) compiled
+    tick as the fleet scan — policy-count drift inside the pool bucket
+    must not recompile either."""
+    pools = [
+        (f"pool-{p}", "on", [_node(f"p{p}-{i}") for i in range(4)])
+        for p in range(7)
+    ]
+    plan.analyze_pools(pools[:1])
+    base = plan.TRACE_COUNTS.get("fleet_tick", 0)
+    for n_pools in (1, 2, 3, 5, 7):
+        stats = plan.analyze_pools(pools[:n_pools])
+        assert len(stats) == n_pools
+    assert plan.TRACE_COUNTS.get("fleet_tick", 0) == base, (
+        "pool-count drift inside one pool bucket re-traced the kernel"
+    )
+
+
+# -------------------------------------------------- persistent AOT cache
+_CHILD = r"""
+import json, os, sys
+import jax, jax.monitoring
+
+events = []
+jax.monitoring.register_event_listener(lambda name, **kw: events.append(name))
+from tpu_cc_manager import plan
+
+assert plan.configure_cache(os.environ["TPU_CC_COMPILE_CACHE_DIR"])
+timings = plan.warmup(max_nodes=int(os.environ.get("WARM_NODES", "32")))
+print(json.dumps({
+    "timings": timings,
+    "hits": sum(1 for e in events if "cache_hit" in e),
+    "misses": sum(1 for e in events if "cache_miss" in e),
+}))
+"""
+
+
+def _run_child(cache_dir):
+    env = dict(
+        os.environ,
+        TPU_CC_COMPILE_CACHE_DIR=str(cache_dir),
+        WARM_NODES="32",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env, capture_output=True,
+        text=True, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_warmup_populates_cache_and_restart_is_compile_free(tmp_path):
+    """The restart contract (ISSUE 7 acceptance): process 1 warms up
+    cold (every bucket a cache miss, serialized to disk); process 2 —
+    same geometry, same cache dir — deserializes every bucket (all
+    hits, ZERO misses). The first scan after a controller restart pays
+    deserialization, not XLA."""
+    cache_dir = tmp_path / "xla-cache"
+    cold = _run_child(cache_dir)
+    assert cold["misses"] >= 1, cold
+    assert cold["hits"] == 0, cold
+    assert os.listdir(cache_dir), "warmup serialized nothing to disk"
+    warm = _run_child(cache_dir)
+    assert warm["misses"] == 0, (
+        f"restart recompiled {warm['misses']} bucket(s): {warm}"
+    )
+    assert warm["hits"] >= cold["misses"], warm
+    # the deserialize path must also be strictly cheaper than the
+    # compile it replaced, bucket for bucket
+    for key, cold_s in cold["timings"].items():
+        assert warm["timings"][key] < cold_s, (key, cold, warm)
+
+
+def test_configure_cache_unset_is_noop(monkeypatch):
+    monkeypatch.delenv("TPU_CC_COMPILE_CACHE_DIR", raising=False)
+    assert plan.configure_cache() is None

@@ -197,6 +197,12 @@ def main(argv=None) -> int:
     if args.command == "fleet-controller":
         from tpu_cc_manager.fleet import FleetController
 
+        # production default: warm the planner's AOT compile cache at
+        # start (the restarted-controller-in-milliseconds contract,
+        # docs/planner.md). --once audits and in-process embedders skip
+        # it; TPU_CC_PLANNER_WARMUP=0 opts a long-running controller out
+        if not args.once:
+            os.environ.setdefault("TPU_CC_PLANNER_WARMUP", "1")
         try:
             kube = _kube_client(cfg)
             controller = FleetController(
@@ -233,6 +239,9 @@ def main(argv=None) -> int:
     if args.command == "policy-controller":
         from tpu_cc_manager.policy import PolicyController
 
+        # same production default as fleet-controller: the policy scan
+        # dispatches the jitted planner kernel (plan.analyze_pools)
+        os.environ.setdefault("TPU_CC_PLANNER_WARMUP", "1")
         try:
             kube = _kube_client(cfg)
             controller = PolicyController(

@@ -243,6 +243,7 @@ def analyze_modules(
     findings.extend(rules.metric_findings(audits))
     findings.extend(rules.liveness_findings(audits))
     findings.extend(rules.direct_write_findings(modules))
+    findings.extend(rules.planner_bypass_findings(modules))
     return sorted(findings)
 
 

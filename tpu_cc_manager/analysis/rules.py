@@ -1574,3 +1574,71 @@ def direct_write_findings(modules: Sequence[Module]) -> List[Finding]:
                 )
             )
     return findings
+
+
+# ---------------------------------------------------------- planner bypass
+
+
+#: Scan-path controller modules (ISSUE 7): fleet and policy scans must
+#: read per-pool convergence/skew/divergence from the batched planner
+#: kernel (plan.analyze_encoding / plan.analyze_pools), not re-derive
+#: them with Python loops over node dicts — that is exactly the
+#: per-node code the array-native planner refactor removed, and it
+#: silently re-inflates scan cost from O(changed) back to O(fleet).
+#: rollout.py is deliberately out of scope: its per-node label touches
+#: are the actuation path (one write per node is the work itself), and
+#: its analysis preflight already rides plan.analyze_fleet.
+PLANNER_SCAN_MODULES = frozenset({
+    "tpu_cc_manager/fleet.py",
+    "tpu_cc_manager/policy.py",
+})
+
+#: mode-classification label constants: reading one of these per node
+#: inside a loop is the signature of a reintroduced Python mode loop
+_MODE_LABEL_ATTRS = frozenset({
+    "CC_MODE_LABEL", "CC_MODE_STATE_LABEL", "DOCTOR_ANNOTATION",
+})
+
+
+def planner_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
+    """Flag per-node mode-label reads inside ``for``/``while`` loops in
+    the scan-path controllers (``planner-bypass``). A deliberate
+    exception carries ``# ccaudit: allow-planner-bypass(reason)``."""
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.relpath not in PLANNER_SCAN_MODULES:
+            continue
+        # ast.walk visits a nested loop's body once per enclosing loop
+        # — dedupe by position or one read double-reports
+        seen: set = set()
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not (isinstance(node, ast.Attribute)
+                        and node.attr in _MODE_LABEL_ATTRS):
+                    continue
+                key = (node.lineno, node.col_offset, node.attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if mod.suppressed("planner-bypass", node.lineno):
+                    continue
+                findings.append(
+                    Finding(
+                        file=mod.relpath,
+                        line=node.lineno,
+                        rule="planner-bypass",
+                        message=(
+                            f"{node.attr} read inside a loop in a "
+                            "scan-path controller — per-node mode "
+                            "classification belongs in the batched "
+                            "planner kernel (plan.analyze_encoding / "
+                            "plan.analyze_pools), not a Python loop; "
+                            "a deliberate per-node read needs an "
+                            "allow-planner-bypass pragma naming why"
+                        ),
+                        text=mod.line_text(node.lineno),
+                    )
+                )
+    return findings

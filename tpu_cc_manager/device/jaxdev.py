@@ -194,13 +194,35 @@ class JaxTpuBackend(Backend):
         #: parallel flips of same-generation chips must still pay exactly
         #: ONE physical runtime restart.
         self.teardown_lock = threading.Lock()
+        #: PJRT device handles cached per runtime generation (ROADMAP
+        #: item 1): every flip phase — find_tpus, stage's query,
+        #: wait_ready's probe retries, verify — used to re-enter
+        #: ``jax.local_devices()``, each call paying the PJRT client
+        #: lookup (and, right after a teardown, a full client init).
+        #: One generation = one client = one enumeration; teardown
+        #: invalidates by bumping the gen.
+        self._devices: Optional[list] = None
+        self._devices_gen = -1
+        self._devices_lock = threading.Lock()
 
     # ------------------------------------------------------- runtime ops
-    @staticmethod
-    def _local_devices():
+    def _local_devices(self):
+        with self._devices_lock:
+            if (self._devices is not None
+                    and self._devices_gen == self.runtime_gen):
+                return self._devices
+            gen = self.runtime_gen
         import jax
 
-        return jax.local_devices()
+        # enumerate OUTSIDE the lock: reacquiring the runtime after a
+        # reset can block for seconds, and wait_ready probes must not
+        # serialize behind it
+        devices = jax.local_devices()
+        with self._devices_lock:
+            if gen == self.runtime_gen:
+                self._devices = devices
+                self._devices_gen = gen
+        return devices
 
     def teardown_runtime(self) -> None:
         """Tear down the PJRT client — compiled computations and the
@@ -212,7 +234,10 @@ class JaxTpuBackend(Backend):
 
         jax.clear_caches()
         jeb.clear_backends()
-        self.runtime_gen += 1
+        with self._devices_lock:
+            self.runtime_gen += 1
+            self._devices = None
+            self._devices_gen = -1
 
     def probe_device(self, device_id: int) -> float:
         """Place a tiny computation on device ``device_id`` and block on

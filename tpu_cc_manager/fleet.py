@@ -26,16 +26,24 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.evidence import audit_evidence
-from tpu_cc_manager.k8s.client import ApiException, KubeClient
+from tpu_cc_manager.k8s.client import KubeClient
+from tpu_cc_manager.k8s.objects import match_selector
 from tpu_cc_manager.obs import (
     OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
     kube_throttle_wait_histogram, wire_throttle_observer,
 )
-from tpu_cc_manager.plan import analyze_fleet
+from tpu_cc_manager.plan import FleetEncoding, analyze_encoding
+
+#: the shared node-watch pump and its wake filter moved to watch.py
+#: (the watch layer owns delta delivery now that the planner's feature
+#: block rides it); re-exported here for embedders and history
+from tpu_cc_manager.watch import (  # noqa: F401
+    node_report_fingerprint, run_node_watch,
+)
 
 log = logging.getLogger("tpu-cc-manager.fleet")
 
@@ -148,98 +156,6 @@ def fleet_problems(report: dict) -> List[str]:
             f"incoherent slices: {sorted(report['incoherent_slices'])}"
         )
     return problems
-
-
-def run_node_watch(kube, stop: threading.Event, wake,
-                   *, timeout_s: int, backoff_s: float,
-                   logger, who: str) -> None:
-    """Shared node-watch pump for both controllers: stream node events,
-    call ``wake()`` for report-relevant changes (fingerprint-filtered —
-    see :func:`node_report_fingerprint`), wake once per from-scratch
-    (re)connect to cover the unreplayable gap, back off and
-    re-establish on transient failures, and return — degrading the
-    caller to pure interval polling — when the client has no
-    node-watch support (501, or a clientset whose ``watch_nodes``
-    isn't a generator)."""
-    rv = None
-    prints: Dict[str, object] = {}
-    while not stop.is_set():
-        if rv is None:
-            # a fresh watch starts at "now" and cannot replay what
-            # happened before it: wake one scan to cover the gap
-            wake()
-        try:
-            # the no-watch probe is scoped to the CALL alone: a
-            # TypeError from event processing must hit the generic
-            # backoff-and-retry below, not masquerade as a clientset
-            # without watch support
-            try:
-                stream = iter(kube.watch_nodes(
-                    resource_version=rv, timeout_s=timeout_s,
-                ))
-            except TypeError:
-                logger.info("%s: client has no node-watch support; "
-                            "interval polling only", who)
-                return
-            for etype, obj in stream:
-                meta = obj.get("metadata", {})
-                rv = meta.get("resourceVersion", rv)
-                if etype == "BOOKMARK":
-                    continue
-                name = meta.get("name", "")
-                if etype == "DELETED":
-                    prints.pop(name, None)
-                    wake()
-                    continue
-                fp = node_report_fingerprint(obj)
-                if prints.get(name) != fp:
-                    prints[name] = fp
-                    wake()
-                if stop.is_set():
-                    return
-        except ApiException as e:
-            if e.status == 501:
-                logger.info("%s: client has no node-watch support; "
-                            "interval polling only", who)
-                return
-            rv = None
-            stop.wait(backoff_s)
-        except Exception:
-            logger.warning("%s: node watch failed; retrying", who,
-                           exc_info=True)
-            rv = None
-            stop.wait(backoff_s)
-
-
-def node_report_fingerprint(node: dict):
-    """Comparable digest of exactly the node state the controllers'
-    reports depend on: tpu labels (desired/state/slice/doctor-ok and
-    the accelerator selector), the evidence annotation, and the STABLE
-    part of the doctor verdict (ok + failing checks — not its
-    timestamp, or every periodic doctor publish would wake a scan that
-    finds nothing new). Shared by the fleet and policy controllers'
-    node-watch wake filters. Total over hostile node-writable
-    annotations: any parseable-but-odd shape reduces to a stable value
-    instead of throwing in a watch thread."""
-    meta = node.get("metadata", {})
-    labels = meta.get("labels") or {}
-    ann = meta.get("annotations") or {}
-    relevant = tuple(sorted(
-        (k, v) for k, v in labels.items()
-        if "tpu.google.com" in k or k == L.TPU_ACCELERATOR_LABEL
-    ))
-    doctor = ann.get(L.DOCTOR_ANNOTATION)
-    if doctor:
-        try:
-            d = json.loads(doctor)
-            if isinstance(d, dict):
-                doctor = json.dumps(
-                    {"ok": d.get("ok"), "fail": d.get("fail")},
-                    sort_keys=True,
-                )
-        except ValueError:
-            pass  # malformed stays raw — itself a stable value
-    return (relevant, ann.get(L.EVIDENCE_ANNOTATION), doctor)
 
 
 class FleetMetrics:
@@ -399,6 +315,11 @@ class FleetController:
         #: at the next interval tick; the interval remains the liveness
         #: fallback. Bursts coalesce through the min scan gap.
         self._wake = threading.Event()
+        #: the planner's per-node feature block (ISSUE 7): fed
+        #: incrementally by the node watch's delta stream and
+        #: fingerprint-diff-synced against each scan's list, so the
+        #: per-scan encode cost tracks what CHANGED, not fleet size
+        self._encoding = FleetEncoding()
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
         from tpu_cc_manager.config import _env_float
@@ -423,7 +344,12 @@ class FleetController:
             # degrades /healthz instead of crashing run() or — worse —
             # retrying forever with the error counter stuck at 0.
             nodes = self.kube.list_nodes(self.selector)
-            report = analyze_fleet(nodes)
+            # list truth reconciles the watch-fed feature block
+            # (unchanged nodes cost a fingerprint compare, not a
+            # re-encode), then ONE jitted planner tick answers the
+            # divergence/slice/doctor questions in a batch
+            self._encoding.sync(nodes)
+            report = analyze_encoding(self._encoding)
             # label-vs-device truth: the JAX planner trusts label text;
             # the evidence audit cross-checks it against what each
             # node's agent independently attested (VERDICT r2 item 7)
@@ -447,7 +373,11 @@ class FleetController:
             )
             self._prior_label_mismatch = cur_mismatch
             report["evidence_audit"] = audit
-            report["doctor"] = self._aggregate_doctor(nodes)
+            # report["doctor"] comes batched from the planner tick:
+            # which nodes report failing trust-surface checks
+            # (malformed verdicts count as failing), and which report
+            # NOTHING — ``unreported`` is the preflight for
+            # TPU_CC_WEBHOOK_REQUIRE_DOCTOR (enforce only at zero)
             report["policies"] = self._policy_summaries()
             report["leader_elections"] = self._election_summaries()
             # the actionable digest rides in the report itself, so the
@@ -464,45 +394,6 @@ class FleetController:
         self.consecutive_errors = 0
         self.metrics.scans_total.inc("success")
         return report
-
-    @staticmethod
-    def _aggregate_doctor(nodes: List[dict]) -> dict:
-        """Fleet view of published doctor verdicts (doctor --publish):
-        which nodes report failing trust-surface checks, and which
-        report NOTHING. A malformed annotation counts as failing — a
-        node that can't even publish a parseable verdict deserves a
-        look, not silence. ``unreported`` (no verdict at all: agent
-        predates the doctor, interval disabled, or publication broken)
-        is the preflight for TPU_CC_WEBHOOK_REQUIRE_DOCTOR — enforcing
-        the doctor pin while any node is unreported strands
-        confidential pods off those nodes; enable once this list is
-        empty (rehearse with the webhook's warn mode)."""
-        failing = []
-        unreported = []
-        reported = 0
-        for n in nodes:
-            name = n["metadata"].get("name", "?")
-            raw = (n["metadata"].get("annotations") or {}).get(
-                L.DOCTOR_ANNOTATION
-            )
-            if not raw:
-                unreported.append(name)
-                continue
-            reported += 1
-            try:
-                verdict = json.loads(raw)
-                if not verdict.get("ok"):
-                    failing.append(
-                        {"node": name,
-                         "fail": verdict.get("fail", []),
-                         "at": verdict.get("at")}
-                    )
-            except ValueError:
-                failing.append({"node": name, "fail": ["unparseable"],
-                                "at": None})
-        return {"reported": reported,
-                "unreported": sorted(unreported),
-                "failing": sorted(failing, key=lambda d: d["node"])}
 
     def _election_summaries(self) -> dict:
         """Election state of both controllers, so /report is the one
@@ -587,19 +478,40 @@ class FleetController:
     # -------------------------------------------------------------- watch
     _node_fingerprint = staticmethod(node_report_fingerprint)
 
+    def _on_watch_event(self, etype: str, node: dict) -> None:
+        """Feed the planner's feature block — FLEET nodes only. The
+        watch streams every cluster node (no server-side selector), but
+        the scan lists with ``self.selector``: an unfiltered feed would
+        ingest foreign nodes into the encoding (visible in any report
+        snapshotted before the next sync() prunes them, and permanently
+        sizing the bucket to cluster scale). DELETED always forwards —
+        removing an absent row is a no-op."""
+        if etype != "DELETED":
+            labels = (node.get("metadata") or {}).get("labels") or {}
+            if not match_selector(labels, self.selector):
+                return
+        self._encoding.apply_event(etype, node)
+
     def _watch_loop(self) -> None:
-        """Background node watch via :func:`run_node_watch`;
-        report-relevant changes wake the scan loop."""
+        """Background node watch via :func:`watch.run_node_watch`;
+        report-relevant changes wake the scan loop, and every delta
+        feeds the planner's feature block so the next scan encodes
+        only what moved."""
         run_node_watch(
             self.kube, self._stop, self._wake.set,
             timeout_s=self.watch_timeout_s,
             backoff_s=self.watch_backoff_s,
             logger=log, who="fleet",
+            on_event=self._on_watch_event,
         )
 
     # ---------------------------------------------------------------- run
     def run(self) -> int:
         self._server.start()
+        # planner compile warmup (ISSUE 7, env-gated — plan.maybe_warmup)
+        from tpu_cc_manager import plan
+
+        plan.maybe_warmup(log)
         log.info(
             "fleet controller serving on :%d (selector %r, every %.0fs "
             "+ watch-triggered)",

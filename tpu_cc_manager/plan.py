@@ -11,22 +11,45 @@ program instead of a Python loop over nodes:
   agree on desired and observed mode (half-flipped slice detection — the
   invariant tpu_cc_manager.slice_coord protects per-flip, audited here
   fleet-wide),
+- per-pool convergence, skew, and rollout-eligibility counts (the
+  questions the policy controller's scan used to answer with Python
+  loops over node dicts),
+- doctor-verdict and evidence-freshness buckets,
 - fleet aggregates (node counts per mode, divergence counts, failure
   counts) for dashboards.
 
-Encoding: modes are small ints (MODE_CODES); nodes are rows of three
-int32 arrays ``desired``, ``observed``, ``slice_ids``. All ops are
-fixed-shape, branch-free gather/scatter/segment reductions — XLA-friendly
-on CPU and TPU, and shardable over a device mesh with ``psum`` combines
-for fleets larger than one device's comfort (see __graft_entry__.py's
-``dryrun_multichip`` for the sharded path).
+Architecture (docs/planner.md states the full contract):
+
+- **Feature block** (:class:`FleetEncoding`): per-node int32 columns —
+  desired mode, observed mode, slice id, pool id, flip-taint flag,
+  doctor verdict code, evidence timestamp — maintained *incrementally*
+  from node watch deltas and fingerprint-diffed list syncs, never
+  re-encoded from scratch per scan.
+- **One kernel** (:func:`fleet_tick`): a single jitted ``shard_map``
+  computation over a device mesh (``psum``/``pmin``/``pmax`` combines)
+  that answers the fleet AND policy questions per tick; a 1-device CPU
+  mesh runs the same code as a multi-chip mesh.
+- **Shape buckets**: node counts pad to power-of-two buckets
+  (:func:`bucket_nodes`), so fleet-geometry drift within a bucket can
+  never recompile; slice slots ride the node bucket, pool slots their
+  own small bucket.
+- **Compile economics**: :func:`configure_cache` wires JAX's persistent
+  compilation cache to ``TPU_CC_COMPILE_CACHE_DIR``; :func:`warmup`
+  AOT-lowers and compiles the bucket ladder at controller start, so the
+  first scan after a restart deserializes from disk in milliseconds
+  instead of paying ~8 s of cold XLA compilation
+  (``fleet_scan_warm_s`` in the bench pins this).
 """
 
 from __future__ import annotations
 
+import calendar
 import json
+import logging
 import os
-from typing import Dict, List, Optional, Tuple
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +62,12 @@ from tpu_cc_manager import labels as L
 #: invalid label values; FAILED is the observed-state failure marker.
 from tpu_cc_manager.modes import STATE_FAILED, VALID_MODES
 
+#: the row fingerprint and the watch wake filter must agree on what the
+#: "stable" part of a doctor verdict is — one shared reduction
+from tpu_cc_manager.watch import stable_doctor_digest
+
+log = logging.getLogger("tpu-cc-manager.plan")
+
 MODE_CODES: Dict[str, int] = {"unknown": 0}
 for _m in VALID_MODES:
     MODE_CODES[_m] = len(MODE_CODES)
@@ -46,36 +75,432 @@ MODE_CODES[STATE_FAILED] = len(MODE_CODES)
 CODE_MODES = {v: k for k, v in MODE_CODES.items()}
 N_MODES = len(MODE_CODES)
 
+#: doctor verdict codes (FleetEncoding feature column)
+DOCTOR_UNREPORTED = 0
+DOCTOR_OK = 1
+DOCTOR_FAILING = 2
+
+#: smallest node bucket: fleets from 1 to 64 nodes share one compile
+BUCKET_MIN_NODES = 64
+#: smallest pool-slot bucket: up to 7 pools + the padding slot
+BUCKET_MIN_POOLS = 8
+
+#: evidence older than this (seconds) is reported stale; the planner
+#: flags, the evidence audit judges (fleet.py)
+EVIDENCE_STALE_S_DEFAULT = 3600.0
+
+
+def bucket_nodes(n: int) -> int:
+    """Power-of-two node bucket holding ``n`` rows AND ``n + 1`` slice
+    slots (every node may be a solo slice; +1 reserves the padding
+    slot). Geometry drift inside a bucket never recompiles."""
+    need = max(n + 1, BUCKET_MIN_NODES)
+    return 1 << (need - 1).bit_length()
+
+
+def bucket_pools(p: int) -> int:
+    """Power-of-two pool-slot bucket holding ``p`` pools + padding."""
+    need = max(p + 1, BUCKET_MIN_POOLS)
+    return 1 << (need - 1).bit_length()
+
 
 def encode_mode(value: Optional[str]) -> int:
     return MODE_CODES.get(value or "unknown", MODE_CODES["unknown"])
 
 
-def encode_fleet(nodes: List[dict]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[str], Dict[str, int]]:
-    """Turn a list of k8s node objects into planner arrays.
+def _parse_ts(stamp: Any) -> int:
+    """'%Y-%m-%dT%H:%M:%SZ' → epoch seconds, -1 when absent/unparseable.
 
-    Returns (desired, observed, slice_ids, node_names, slice_index) where
-    slice_ids[i] is a dense index into slice_index (nodes without a slice
-    label each get their own singleton id).
+    int32-safe until 2038; the kernel only ever subtracts it from now."""
+    if not isinstance(stamp, str):
+        return -1
+    try:
+        return int(calendar.timegm(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ")))
+    except ValueError:
+        return -1
+
+
+def _encode_doctor(raw: Optional[str]) -> Tuple[int, Optional[dict]]:
+    """Doctor annotation → (code, details-for-failing). Malformed counts
+    as failing — a node that can't publish a parseable verdict deserves
+    a look, not silence."""
+    if not raw:
+        return DOCTOR_UNREPORTED, None
+    try:
+        verdict = json.loads(raw)
+        if isinstance(verdict, dict) and verdict.get("ok"):
+            return DOCTOR_OK, None
+        fail = verdict.get("fail", []) if isinstance(verdict, dict) else []
+        at = verdict.get("at") if isinstance(verdict, dict) else None
+        return DOCTOR_FAILING, {"fail": fail, "at": at}
+    except ValueError:
+        return DOCTOR_FAILING, {"fail": ["unparseable"], "at": None}
+
+
+def _encode_evidence_ts(raw: Optional[str]) -> int:
+    """Evidence annotation → document timestamp (epoch s), -1 if none."""
+    if not raw:
+        return -1
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return -1
+    if not isinstance(doc, dict):
+        return -1
+    return _parse_ts(doc.get("timestamp"))
+
+
+def _has_flip_taint(node: dict) -> bool:
+    for taint in (node.get("spec") or {}).get("taints") or []:
+        if isinstance(taint, dict) and taint.get("key") == L.FLIP_TAINT_KEY:
+            return True
+    return False
+
+
+class FleetEncoding:
+    """The planner's per-node feature block: columnar int32 arrays kept
+    *incrementally* up to date from watch deltas (:meth:`apply_event`)
+    and fingerprint-diffed list syncs (:meth:`sync`) — the encode cost
+    per scan is proportional to what changed, not to fleet size.
+
+    Columns (row i = node i): desired, observed, slice id (dense),
+    flip-taint flag, doctor verdict code, evidence timestamp. Slice ids
+    are refcounted and compacted when dead slots outnumber live ones.
+    Thread-safe: the watch thread applies deltas while the scan thread
+    snapshots.
     """
-    names, desired, observed, slice_ids = [], [], [], []
-    slice_index: Dict[str, int] = {}
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._names: List[str] = []
+        self._row: Dict[str, int] = {}
+        self._fp: Dict[str, tuple] = {}
+        self._cap = 0
+        self._desired = np.zeros(0, np.int32)
+        self._observed = np.zeros(0, np.int32)
+        self._slice = np.zeros(0, np.int32)
+        self._taint = np.zeros(0, np.int32)
+        self._doctor = np.zeros(0, np.int32)
+        self._ev_ts = np.zeros(0, np.int32)
+        self._slice_index: Dict[str, int] = {}
+        #: reverse of _slice_index — release must be O(1), not a scan
+        self._slice_key_of: Dict[int, str] = {}
+        self._slice_refs: Dict[int, int] = {}
+        self._next_slice = 0
+        self._doctor_details: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------ internals
+    def _grow(self, need: int) -> None:
+        if need <= self._cap:
+            return
+        cap = bucket_nodes(need)
+        for attr, fill in (
+            ("_desired", 0), ("_observed", 0), ("_slice", 0),
+            ("_taint", 0), ("_doctor", 0), ("_ev_ts", -1),
+        ):
+            old = getattr(self, attr)
+            arr = np.full(cap, fill, np.int32)
+            arr[: len(old)] = old
+            setattr(self, attr, arr)
+        self._cap = cap
+
+    def _slice_id(self, key: str) -> int:
+        sid = self._slice_index.get(key)
+        if sid is None:
+            sid = self._next_slice
+            self._next_slice += 1
+            self._slice_index[key] = sid
+            self._slice_key_of[sid] = key
+        self._slice_refs[sid] = self._slice_refs.get(sid, 0) + 1
+        return sid
+
+    def _release_slice(self, sid: int) -> None:
+        n = self._slice_refs.get(sid, 0) - 1
+        if n <= 0:
+            self._slice_refs.pop(sid, None)
+            key = self._slice_key_of.pop(sid, None)
+            if key is not None:
+                self._slice_index.pop(key, None)
+        else:
+            self._slice_refs[sid] = n
+        # compact when dead slots dominate: dense ids keep the slice
+        # slot space (and thus the bucket) tracking LIVE slices, so a
+        # churn of ephemeral solo slices cannot grow it without bound
+        if (self._next_slice > 2 * len(self._slice_index)
+                and self._next_slice - len(self._slice_index) > 16):
+            self._compact_slices()
+
+    def _compact_slices(self) -> None:
+        """Renumber live slice ids dense from 0 (callers hold _lock)."""
+        remap = {}
+        for key in sorted(self._slice_index,
+                          key=lambda k: self._slice_index[k]):
+            remap[self._slice_index[key]] = len(remap)
+        n_rows = len(self._names)
+        if n_rows:
+            lut = np.zeros(self._next_slice, np.int32)
+            for old, new in remap.items():
+                lut[old] = new
+            self._slice[:n_rows] = lut[self._slice[:n_rows]]
+        self._slice_index = {
+            k: remap[v] for k, v in self._slice_index.items()
+        }
+        self._slice_key_of = {
+            v: k for k, v in self._slice_index.items()
+        }
+        self._slice_refs = {
+            remap[s]: c for s, c in self._slice_refs.items()
+        }
+        self._next_slice = len(self._slice_index)
+
+    @staticmethod
+    def _fingerprint(node: dict) -> tuple:
+        """Comparable digest of exactly the row-relevant node state.
+        The doctor element is the STABLE {ok, fail} reduction, not the
+        raw annotation — a periodic republish that only moves the
+        verdict timestamp must not re-encode the row (the same
+        deliberate omission as watch.node_report_fingerprint's)."""
+        meta = node.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        ann = meta.get("annotations") or {}
+        return (
+            labels.get(L.CC_MODE_LABEL),
+            labels.get(L.CC_MODE_STATE_LABEL),
+            labels.get(L.TPU_SLICE_LABEL),
+            _has_flip_taint(node),
+            stable_doctor_digest(ann.get(L.DOCTOR_ANNOTATION)),
+            ann.get(L.EVIDENCE_ANNOTATION),
+        )
+
+    def _write_row(self, i: int, name: str, fp: tuple,
+                   doctor_raw: Optional[str],
+                   slice_key: Optional[str]) -> None:
+        """Encode one row. ``slice_key=None`` keeps the row's current
+        slice id (caller determined the key didn't change — no
+        release/re-acquire churn). ``doctor_raw`` is the full
+        annotation: details (incl. the ``at`` timestamp) come from it,
+        so a report's ``at`` reflects when the verdict CONTENT last
+        changed — consistent with the fingerprint's stable reduction."""
+        desired, observed, _slice_raw, tainted, _doctor_stable, ev_raw = fp
+        self._desired[i] = encode_mode(desired)
+        self._observed[i] = encode_mode(observed)
+        if slice_key is not None:
+            self._slice[i] = self._slice_id(slice_key)
+        self._taint[i] = 1 if tainted else 0
+        code, details = _encode_doctor(doctor_raw)
+        self._doctor[i] = code
+        if details is not None:
+            self._doctor_details[name] = details
+        else:
+            self._doctor_details.pop(name, None)
+        self._ev_ts[i] = _encode_evidence_ts(ev_raw)
+
+    # -------------------------------------------------------------- updates
+    def apply(self, node: dict) -> bool:
+        """Insert or update one node; returns True when anything
+        report-relevant actually changed (fingerprint-diffed)."""
+        meta = node.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            raise KeyError("node without metadata.name")
+        fp = self._fingerprint(node)
+        doctor_raw = (meta.get("annotations") or {}).get(
+            L.DOCTOR_ANNOTATION)
+        with self._lock:
+            old_fp = self._fp.get(name)
+            if old_fp == fp:
+                return False
+            i = self._row.get(name)
+            slice_key = fp[2] if fp[2] else f"__solo__/{name}"
+            if i is None:
+                i = len(self._names)
+                self._grow(i + 1)
+                self._names.append(name)
+                self._row[name] = i
+            elif old_fp is not None and (
+                    old_fp[2] if old_fp[2] else f"__solo__/{name}"
+            ) == slice_key:
+                # unchanged slice membership keeps its id — mode/taint/
+                # doctor updates must not churn the slice slot space
+                slice_key = None  # type: ignore[assignment]
+            else:
+                self._release_slice(int(self._slice[i]))
+            self._fp[name] = fp
+            self._write_row(i, name, fp, doctor_raw, slice_key)
+            return True
+
+    def remove(self, name: str) -> bool:
+        """Drop a node (swap-with-last keeps the block dense)."""
+        with self._lock:
+            i = self._row.pop(name, None)
+            if i is None:
+                return False
+            self._fp.pop(name, None)
+            self._doctor_details.pop(name, None)
+            self._release_slice(int(self._slice[i]))
+            last = len(self._names) - 1
+            if i != last:
+                moved = self._names[last]
+                self._names[i] = moved
+                self._row[moved] = i
+                for arr in (self._desired, self._observed, self._slice,
+                            self._taint, self._doctor, self._ev_ts):
+                    arr[i] = arr[last]
+            self._names.pop()
+            for arr, fill in ((self._desired, 0), (self._observed, 0),
+                              (self._slice, 0), (self._taint, 0),
+                              (self._doctor, 0), (self._ev_ts, -1)):
+                arr[last] = fill
+            return True
+
+    def apply_event(self, etype: str, node: dict) -> None:
+        """Node-watch delta feed (watch.run_node_watch ``on_event``):
+        keeps the block fresh between list syncs. Total over hostile
+        shapes — a malformed event is dropped, never thrown in a watch
+        thread."""
+        try:
+            if etype == "DELETED":
+                name = (node.get("metadata") or {}).get("name")
+                if name:
+                    self.remove(name)
+            elif etype in ("ADDED", "MODIFIED"):
+                self.apply(node)
+        except Exception:
+            log.debug("unappliable node event dropped", exc_info=True)
+
+    def sync(self, nodes: List[dict]) -> int:
+        """Reconcile against full list truth: apply every listed node
+        (fingerprint skip makes unchanged ones O(compare)), drop the
+        vanished. Returns how many rows actually changed."""
+        changed = 0
+        seen = set()
+        for node in nodes:
+            seen.add(node["metadata"]["name"])
+            if self.apply(node):
+                changed += 1
+        with self._lock:
+            gone = [n for n in self._names if n not in seen]
+        for name in gone:
+            if self.remove(name):
+                changed += 1
+        return changed
+
+    # ------------------------------------------------------------ snapshots
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._names)
+
+    def snapshot(self) -> "FleetSnapshot":
+        """Bucket-padded copies for one tick (padding rows: unknown
+        modes, the reserved last slice slot, pool slot 0)."""
+        with self._lock:
+            n = len(self._names)
+            nb = bucket_nodes(n)
+            # the bucket reserves n+1 slice slots (live slices ≤ rows,
+            # plus the padding slot), but id ASSIGNMENT is monotonic and
+            # the release-side compaction is amortized — a relabel churn
+            # can push live ids past nb before its threshold trips. The
+            # kernel scatters by slot id, so every live id must be < nb:
+            # compact now if any isn't (cheap, and rare by construction)
+            if self._next_slice >= nb:
+                self._compact_slices()
+            cols = {}
+            for key, arr, pad in (
+                ("desired", self._desired, 0),
+                ("observed", self._observed, 0),
+                ("slice_ids", self._slice, nb - 1),
+                ("taint", self._taint, 0),
+                ("doctor", self._doctor, 0),
+                ("ev_ts", self._ev_ts, -1),
+            ):
+                out = np.full(nb, pad, np.int32)
+                out[:n] = arr[:n]
+                cols[key] = out
+            valid = np.zeros(nb, np.int32)
+            valid[:n] = 1
+            cols["valid"] = valid
+            cols["pool_ids"] = np.zeros(nb, np.int32)
+            return FleetSnapshot(
+                names=list(self._names),
+                slice_index=dict(self._slice_index),
+                doctor_details=dict(self._doctor_details),
+                columns=cols,
+                pool_names=[],
+            )
+
+
+class FleetSnapshot:
+    """Immutable bucket-padded view of one encoding instant."""
+
+    def __init__(self, names: List[str], slice_index: Dict[str, int],
+                 doctor_details: Dict[str, dict],
+                 columns: Dict[str, np.ndarray],
+                 pool_names: List[str]) -> None:
+        self.names = names
+        self.slice_index = slice_index
+        self.doctor_details = doctor_details
+        self.columns = columns
+        self.pool_names = pool_names
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.names)
+
+
+def encode_fleet(nodes: List[dict]) -> Tuple[
+        np.ndarray, np.ndarray, np.ndarray, List[str], Dict[str, int]]:
+    """Legacy tuple encoding (desired, observed, slice_ids, names,
+    slice_index) — unpadded. Kept for direct kernel users
+    (__graft_entry__, tests); controllers use :class:`FleetEncoding`."""
+    enc = FleetEncoding()
     for node in nodes:
-        meta = node["metadata"]
-        labels = meta.get("labels", {})
-        names.append(meta["name"])
-        desired.append(encode_mode(labels.get(L.CC_MODE_LABEL)))
-        observed.append(encode_mode(labels.get(L.CC_MODE_STATE_LABEL)))
-        raw_slice = labels.get(L.TPU_SLICE_LABEL)
-        key = raw_slice if raw_slice else f"__solo__/{meta['name']}"
-        slice_ids.append(slice_index.setdefault(key, len(slice_index)))
+        enc.apply(node)
+    snap = enc.snapshot()
+    n = snap.n_nodes
     return (
-        np.asarray(desired, dtype=np.int32),
-        np.asarray(observed, dtype=np.int32),
-        np.asarray(slice_ids, dtype=np.int32),
-        names,
-        slice_index,
+        snap.columns["desired"][:n].copy(),
+        snap.columns["observed"][:n].copy(),
+        snap.columns["slice_ids"][:n].copy(),
+        snap.names,
+        snap.slice_index,
     )
+
+
+# ----------------------------------------------------------------- kernel
+
+
+def _seg_minmax(x: jnp.ndarray, seg: jnp.ndarray,
+                num_slots: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-segment min/max via scatter: a segment agrees on a value iff
+    min == max over its members."""
+    mn = jnp.full((num_slots,), jnp.iinfo(jnp.int32).max, jnp.int32)
+    mx = jnp.full((num_slots,), jnp.iinfo(jnp.int32).min, jnp.int32)
+    return mn.at[seg].min(x), mx.at[seg].max(x)
+
+
+def _slice_outputs(desired: jnp.ndarray, observed: jnp.ndarray,
+                   slice_ids: jnp.ndarray, known: jnp.ndarray,
+                   num_slices: int,
+                   combine: Optional[str]) -> Dict[str, jnp.ndarray]:
+    """Slice coherence + half-flip detection, shared by the legacy
+    ``fleet_plan`` and the full tick so the two can never drift. With
+    ``combine`` set (a shard_map axis name), per-slot partials are
+    merged across the mesh before the boolean comparisons."""
+    d_mn, d_mx = _seg_minmax(desired, slice_ids, num_slices)
+    o_mn, o_mx = _seg_minmax(observed, slice_ids, num_slices)
+    at_target = ((observed == desired) & known).astype(jnp.int32)
+    at_mn = jnp.ones((num_slices,), jnp.int32).at[slice_ids].min(at_target)
+    at_mx = jnp.zeros((num_slices,), jnp.int32).at[slice_ids].max(at_target)
+    if combine is not None:
+        d_mn = jax.lax.pmin(d_mn, combine)
+        d_mx = jax.lax.pmax(d_mx, combine)
+        o_mn = jax.lax.pmin(o_mn, combine)
+        o_mx = jax.lax.pmax(o_mx, combine)
+        at_mn = jax.lax.pmin(at_mn, combine)
+        at_mx = jax.lax.pmax(at_mx, combine)
+    coherent = (d_mn == d_mx) & (o_mn == o_mx)
+    half_flipped = (d_mn == d_mx) & (at_mn == 0) & (at_mx == 1)
+    return {"slice_coherent": coherent, "slice_half_flipped": half_flipped}
 
 
 def fleet_plan(
@@ -84,7 +509,11 @@ def fleet_plan(
     slice_ids: jnp.ndarray,
     num_slices: int,
 ) -> Dict[str, jnp.ndarray]:
-    """The jittable core. All shapes static given (n_nodes, num_slices).
+    """The legacy jittable core (divergence + slice audit). All shapes
+    static given (n_nodes, num_slices). Kept as the stable surface the
+    driver's ``entry()`` compile check and the shard_map dry run build
+    on; :func:`fleet_tick` is its feature-block superset and shares the
+    slice math via :func:`_slice_outputs`.
 
     Returns a dict of arrays:
       needs_flip      [n]  bool   — desired != observed (and desired known)
@@ -100,102 +529,425 @@ def fleet_plan(
     known = desired != MODE_CODES["unknown"]
     needs_flip = (desired != observed) & known
     failed = observed == MODE_CODES["failed"]
-
     mode_counts = jnp.zeros((N_MODES,), jnp.int32).at[observed].add(1)
     desired_counts = jnp.zeros((N_MODES,), jnp.int32).at[desired].add(1)
-
-    # per-slice agreement via segment min/max: a slice agrees on a value
-    # iff min == max over its members
-    def seg_minmax(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        mn = jnp.full((num_slices,), jnp.iinfo(jnp.int32).max, jnp.int32)
-        mx = jnp.full((num_slices,), jnp.iinfo(jnp.int32).min, jnp.int32)
-        mn = mn.at[slice_ids].min(x)
-        mx = mx.at[slice_ids].max(x)
-        return mn, mx
-
-    d_mn, d_mx = seg_minmax(desired)
-    o_mn, o_mx = seg_minmax(observed)
-    slice_coherent = (d_mn == d_mx) & (o_mn == o_mx)
-
-    # half-flipped: some members observed==desired, others not, within one
-    # slice (only meaningful where desired is uniform)
-    at_target = (observed == desired) & known
-    at_mn = jnp.ones((num_slices,), jnp.int32).at[slice_ids].min(
-        at_target.astype(jnp.int32)
-    )
-    at_mx = jnp.zeros((num_slices,), jnp.int32).at[slice_ids].max(
-        at_target.astype(jnp.int32)
-    )
-    slice_half_flipped = (d_mn == d_mx) & (at_mn == 0) & (at_mx == 1)
-
-    return {
+    out = {
         "needs_flip": needs_flip,
         "failed": failed,
         "mode_counts": mode_counts,
         "desired_counts": desired_counts,
-        "slice_coherent": slice_coherent,
-        "slice_half_flipped": slice_half_flipped,
     }
+    out.update(_slice_outputs(desired, observed, slice_ids, known,
+                              num_slices, combine=None))
+    return out
 
 
-#: jitted entry with static slice count (recompiles per distinct fleet
-#: geometry, cached thereafter)
+#: jitted legacy entry with static slice count (recompiles per distinct
+#: fleet geometry — the bucketed fleet_tick is the drift-proof path)
 fleet_plan_jit = jax.jit(fleet_plan, static_argnames=("num_slices",))
 
 
-_backend_pinned = False
+#: traces per kernel name — a Python side effect inside the traced
+#: function body runs once per (re)trace, so tests can pin "node-count
+#: drift within a bucket compiles exactly once" (tests/test_plan_cache)
+TRACE_COUNTS: Dict[str, int] = {}
 
 
-def _ensure_backend() -> None:
-    """Pin the planner to CPU unless the operator opts into an accelerator
-    via TPU_CC_PLANNER_PLATFORM. The fleet controller must run anywhere —
-    on hosts with a registered-but-unreachable TPU plugin, jax.devices()
-    either raises or (worse) blocks for minutes dialing the device, so
-    'try the default platform first' is not a safe probe. Fleet-analysis
-    arrays are tiny; CPU is always adequate, and TPU users (e.g. the
-    driver's entry() compile check) call fleet_plan / fleet_plan_jit
-    directly without this pin."""
-    global _backend_pinned
-    if _backend_pinned:
-        return
+def _count_trace(name: str) -> None:
+    TRACE_COUNTS[name] = TRACE_COUNTS.get(name, 0) + 1
+
+
+def fleet_tick(
+    desired: jnp.ndarray,
+    observed: jnp.ndarray,
+    slice_ids: jnp.ndarray,
+    pool_ids: jnp.ndarray,
+    taint: jnp.ndarray,
+    doctor: jnp.ndarray,
+    ev_ts: jnp.ndarray,
+    valid: jnp.ndarray,
+    pool_target: jnp.ndarray,
+    now_s: jnp.ndarray,
+    stale_after_s: jnp.ndarray,
+    *,
+    num_pools: int,
+    num_slices: Optional[int] = None,
+    combine: Optional[str] = None,
+) -> Dict[str, jnp.ndarray]:
+    """THE batched planner kernel: one fused program answering the fleet
+    controller's audit questions AND the policy controller's per-pool
+    convergence/skew/eligibility questions. Slice slots == node bucket
+    (bucket_nodes reserves the padding slot); ``valid`` masks padding
+    rows out of every aggregate. Inside a shard_map, ``combine`` names
+    the mesh axis, per-slot aggregates merge with psum/pmin/pmax, and
+    ``num_slices`` must be the GLOBAL slot count (slice/pool ids are
+    global; each shard scatters into full-width slot arrays before the
+    combine) — the same math runs 1-device CPU and multi-chip.
+    """
+    _count_trace("fleet_tick")
+    if num_slices is None:
+        num_slices = desired.shape[0]
+    is_valid = valid > 0
+    vi = valid.astype(jnp.int32)
+    known = (desired != MODE_CODES["unknown"]) & is_valid
+    needs_flip = (desired != observed) & known
+    failed = (observed == MODE_CODES["failed"]) & is_valid
+    flipping = (taint > 0) & is_valid
+    doctor_failing = (doctor == DOCTOR_FAILING) & is_valid
+    doctor_unreported = (doctor == DOCTOR_UNREPORTED) & is_valid
+    has_evidence = ev_ts >= 0
+    stale_evidence = has_evidence & ((now_s - ev_ts) > stale_after_s) & is_valid
+
+    mode_counts = jnp.zeros((N_MODES,), jnp.int32).at[observed].add(vi)
+    desired_counts = jnp.zeros((N_MODES,), jnp.int32).at[desired].add(vi)
+
+    # ---- per-pool aggregates (the policy controller's scan questions)
+    target = pool_target[pool_ids]
+    converged = (observed == target) & (desired == target) & known
+    # a node a rollout may act on right now: off the pool's target (the
+    # rollout's notion of divergence — it patches desired labels, so
+    # per-node label agreement is irrelevant here), not mid-flip, and
+    # not under a failing doctor. FAILED nodes stay eligible: the
+    # rollout re-driving desired labels is exactly how a failed flip
+    # recovers — excluding them would hold an all-failed pool forever
+    eligible = ~converged & is_valid & ~flipping & ~doctor_failing
+    zeros_p = jnp.zeros((num_pools,), jnp.int32)
+    pool_nodes = zeros_p.at[pool_ids].add(vi)
+    pool_converged = zeros_p.at[pool_ids].add(converged.astype(jnp.int32))
+    pool_failed = zeros_p.at[pool_ids].add(failed.astype(jnp.int32))
+    pool_eligible = zeros_p.at[pool_ids].add(eligible.astype(jnp.int32))
+    # observed-mode histogram per pool; skew = members off the pool's
+    # dominant observed mode (how mixed the pool is mid-rollout)
+    pool_hist = jnp.zeros((num_pools, N_MODES), jnp.int32).at[
+        pool_ids, observed
+    ].add(vi)
+
+    out: Dict[str, jnp.ndarray] = {
+        "needs_flip": needs_flip,
+        "failed": failed,
+        "flipping": flipping,
+        "doctor_failing": doctor_failing,
+        "doctor_unreported": doctor_unreported,
+        "stale_evidence": stale_evidence,
+        "eligible": eligible,
+    }
+    if combine is not None:
+        mode_counts = jax.lax.psum(mode_counts, combine)
+        desired_counts = jax.lax.psum(desired_counts, combine)
+        pool_nodes = jax.lax.psum(pool_nodes, combine)
+        pool_converged = jax.lax.psum(pool_converged, combine)
+        pool_failed = jax.lax.psum(pool_failed, combine)
+        pool_eligible = jax.lax.psum(pool_eligible, combine)
+        pool_hist = jax.lax.psum(pool_hist, combine)
+    out.update({
+        "mode_counts": mode_counts,
+        "desired_counts": desired_counts,
+        "pool_nodes": pool_nodes,
+        "pool_converged": pool_converged,
+        "pool_failed": pool_failed,
+        "pool_eligible": pool_eligible,
+        "pool_skew": pool_nodes - pool_hist.max(axis=1),
+        "pool_divergent": pool_nodes - pool_converged,
+    })
+    out.update(_slice_outputs(desired, observed, slice_ids, known,
+                              num_slices, combine=combine))
+    return out
+
+
+# ------------------------------------------------------- backend + mesh
+
+
+def _planner_devices() -> List[Any]:
+    """The planner's device set, WITHOUT mutating process-global jax
+    config. ``jax.devices(platform)`` initializes only the named
+    backend, so the bench's real-chip probe and the planner can no
+    longer fight over ``jax_platforms`` (the old _ensure_backend did
+    exactly that). Default cpu: on hosts with a registered-but-
+    unreachable TPU plugin, probing the default platform can block for
+    minutes dialing the device, and fleet-analysis arrays are tiny —
+    CPU is always adequate. TPU_CC_PLANNER_PLATFORM opts into an
+    accelerator."""
     platform = os.environ.get("TPU_CC_PLANNER_PLATFORM", "cpu")
     try:
-        jax.config.update("jax_platforms", platform)
-        jax.devices()
+        devices = jax.devices(platform)
     except RuntimeError:
-        jax.config.update("jax_platforms", "cpu")
-        jax.devices()
-    _backend_pinned = True
+        devices = jax.devices("cpu")
+    try:
+        max_mesh = int(os.environ.get("TPU_CC_PLANNER_MESH", "0"))
+    except ValueError:
+        max_mesh = 0
+    if max_mesh > 0:
+        devices = devices[:max_mesh]
+    # power-of-two mesh so it divides every power-of-two node bucket,
+    # clamped to the smallest bucket's row count: a mesh wider than
+    # BUCKET_MIN_NODES could not shard the smallest tick (more
+    # participants than rows), and fleet analysis gains nothing past it
+    n = 1 << (max(len(devices), 1).bit_length() - 1)
+    return list(devices)[:min(n, BUCKET_MIN_NODES)]
 
 
-def analyze_fleet(nodes: List[dict]) -> dict:
-    """End-to-end host API: node objects in, JSON-ready report out."""
-    _ensure_backend()
-    desired, observed, slice_ids, names, slice_index = encode_fleet(nodes)
-    if len(names) == 0:
-        return {
-            "nodes": 0,
-            "needs_flip": [],
-            "failed": [],
-            "mode_counts": {},
-            "incoherent_slices": [],
-            "half_flipped_slices": [],
-        }
-    out = fleet_plan_jit(
-        jnp.asarray(desired),
-        jnp.asarray(observed),
-        jnp.asarray(slice_ids),
-        num_slices=len(slice_index),
+_TICK_CACHE: Dict[Tuple[int, int, int], Callable[..., Any]] = {}
+_TICK_LOCK = threading.Lock()
+
+#: ONE planner tick in flight at a time, process-wide. The sharded tick
+#: is a multi-participant collective program (psum/pmin/pmax across the
+#: mesh); XLA's cross-module all-reduce rendezvous is not safe to
+#: interleave from multiple host threads — concurrent dispatches (a
+#: policy scan racing rollout preflights) park each other's participants
+#: in 5 s rendezvous stalls. Ticks are ms-scale whole-fleet batch ops;
+#: serializing them costs nothing and there is no concurrency win to
+#: have.
+_DISPATCH_LOCK = threading.Lock()
+
+
+def _tick_fn(nb: int, pb: int) -> Callable[..., Any]:
+    """The jitted, mesh-sharded tick for one (node-bucket, pool-bucket)
+    geometry — built once, cached, reused by every scan in the bucket
+    (the reuse IS the no-recompile guarantee)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = _planner_devices()
+    key = (nb, pb, len(devices))
+    with _TICK_LOCK:
+        fn = _TICK_CACHE.get(key)
+        if fn is not None:
+            return fn
+        mesh = Mesh(np.array(devices), axis_names=("pool",))
+        row = P("pool")
+        rep = P()
+        node_keys = ("needs_flip", "failed", "flipping", "doctor_failing",
+                     "doctor_unreported", "stale_evidence", "eligible")
+        try:
+            from jax import shard_map as _shard_map  # jax >= 0.7
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+        import inspect
+
+        params = inspect.signature(_shard_map).parameters
+        check_kw = next(
+            (k for k in ("check_vma", "check_rep") if k in params), None
+        )
+        extra = {check_kw: False} if check_kw else {}
+
+        def tick(desired: jnp.ndarray, observed: jnp.ndarray,
+                 slice_ids: jnp.ndarray, pool_ids: jnp.ndarray,
+                 taint: jnp.ndarray, doctor: jnp.ndarray,
+                 ev_ts: jnp.ndarray, valid: jnp.ndarray,
+                 pool_target: jnp.ndarray, now_s: jnp.ndarray,
+                 stale_after_s: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+            return fleet_tick(
+                desired, observed, slice_ids, pool_ids, taint, doctor,
+                ev_ts, valid, pool_target, now_s, stale_after_s,
+                num_pools=pb, num_slices=nb, combine="pool",
+            )
+
+        out_specs = {k: row for k in node_keys}
+        out_specs.update({
+            k: rep for k in (
+                "mode_counts", "desired_counts", "pool_nodes",
+                "pool_converged", "pool_failed", "pool_eligible",
+                "pool_skew", "pool_divergent", "slice_coherent",
+                "slice_half_flipped",
+            )
+        })
+        sharded = _shard_map(
+            tick, mesh=mesh,
+            in_specs=(row,) * 8 + (rep, rep, rep),
+            out_specs=out_specs,
+            **extra,
+        )
+        jitted = jax.jit(sharded)
+        node_shard = NamedSharding(mesh, row)
+        rep_shard = NamedSharding(mesh, rep)
+
+        def run(columns: Dict[str, np.ndarray],
+                pool_target: np.ndarray) -> Dict[str, np.ndarray]:
+            with _DISPATCH_LOCK:
+                args = [
+                    jax.device_put(columns[k], node_shard)
+                    for k in ("desired", "observed", "slice_ids",
+                              "pool_ids", "taint", "doctor", "ev_ts",
+                              "valid")
+                ]
+                args.append(jax.device_put(
+                    np.asarray(pool_target, np.int32), rep_shard))
+                args.append(jax.device_put(
+                    np.int32(int(time.time())), rep_shard))
+                args.append(jax.device_put(
+                    np.int32(int(_stale_after_s())), rep_shard))
+                return jax.device_get(jitted(*args))
+
+        run.lower = lambda: jitted.lower(  # type: ignore[attr-defined]
+            *(
+                [jax.ShapeDtypeStruct((nb,), jnp.int32,
+                                      sharding=node_shard)] * 8
+                + [jax.ShapeDtypeStruct((pb,), jnp.int32,
+                                        sharding=rep_shard),
+                   jax.ShapeDtypeStruct((), jnp.int32,
+                                        sharding=rep_shard),
+                   jax.ShapeDtypeStruct((), jnp.int32,
+                                        sharding=rep_shard)]
+            )
+        )
+        _TICK_CACHE[key] = run
+        return run
+
+
+def _stale_after_s() -> float:
+    try:
+        return float(os.environ.get(
+            "TPU_CC_EVIDENCE_STALE_S", EVIDENCE_STALE_S_DEFAULT))
+    except ValueError:
+        return EVIDENCE_STALE_S_DEFAULT
+
+
+# ----------------------------------------------- compile cache + warmup
+
+
+def configure_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at an on-disk dir
+    (``TPU_CC_COMPILE_CACHE_DIR`` by default; no-op when unset), with
+    the thresholds dropped so the planner's small programs cache too.
+    Idempotent (jax.config.update with the same values is a no-op);
+    safe to call from every controller entry point."""
+    cache_dir = cache_dir or os.environ.get("TPU_CC_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return None
+    cache_dir = os.path.expanduser(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:
+        log.warning("persistent compile cache unavailable (%s): %s",
+                    cache_dir, e)
+        return None
+    return cache_dir
+
+
+def maybe_warmup(logger: logging.Logger) -> None:
+    """Controller-start warmup policy, shared by the fleet AND policy
+    controllers (both dispatch the jitted tick from their scans): with
+    ``TPU_CC_PLANNER_WARMUP`` truthy, wire the persistent compile cache
+    and AOT-compile the bucket ladder BEFORE the first scan — a
+    restarted controller with a populated ``TPU_CC_COMPILE_CACHE_DIR``
+    deserializes in milliseconds instead of paying cold XLA on its
+    first scan. Opt-in by env so in-process embedders (tests, simlab's
+    2-core scenarios) don't pay the ladder compile; the
+    ``fleet-controller``/``policy-controller`` entrypoints (__main__)
+    set the default for production."""
+    if os.environ.get("TPU_CC_PLANNER_WARMUP", "") in ("", "0", "false"):
+        return
+    configure_cache()
+    t0 = time.monotonic()
+    timings = warmup()
+    logger.info(
+        "planner warmup: %d bucket(s) in %.3fs (%s)",
+        len(timings), time.monotonic() - t0,
+        ", ".join(f"{k}={v}s" for k, v in sorted(timings.items())),
     )
-    out = jax.device_get(out)
-    slice_names = {v: k for k, v in slice_index.items()}
-    real_slice = {
-        v: not k.startswith("__solo__/") for k, v in slice_index.items()
-    }
+
+
+def warmup(max_nodes: Optional[int] = None,
+           pool_buckets: Optional[Sequence[int]] = None) -> Dict[str, float]:
+    """AOT lower + compile the tick for the whole bucket ladder up to
+    ``max_nodes`` (TPU_CC_WARMUP_NODES, default 1024) × the pool-bucket
+    ladder up to ``TPU_CC_WARMUP_POOLS`` pools (default 8 — covering
+    both the fleet tick's fixed minimum bucket and a policy scan over
+    up to 15 policies; a fleet running more raises the env). Invoked at
+    controller start: with :func:`configure_cache` wired, a cold
+    process serializes its compiles to disk and a restarted one
+    deserializes them — the first scan after restart is milliseconds,
+    not ~8 s of XLA (the fleet_scan_warm_s bench axis). Returns
+    per-bucket compile seconds."""
+    if max_nodes is None:
+        try:
+            max_nodes = int(os.environ.get("TPU_CC_WARMUP_NODES", "1024"))
+        except ValueError:
+            max_nodes = 1024
+    if pool_buckets is None:
+        try:
+            max_pools = int(os.environ.get("TPU_CC_WARMUP_POOLS", "8"))
+        except ValueError:
+            max_pools = 8
+        ladder = [BUCKET_MIN_POOLS]
+        while ladder[-1] < bucket_pools(max_pools):
+            ladder.append(ladder[-1] * 2)
+        pool_buckets = ladder
+    configure_cache()
+    timings: Dict[str, float] = {}
+    nb = BUCKET_MIN_NODES
+    while True:
+        for pb in pool_buckets:
+            t0 = time.monotonic()
+            _tick_fn(nb, pb).lower().compile()  # type: ignore[attr-defined]
+            timings[f"n{nb}p{pb}"] = round(time.monotonic() - t0, 4)
+        if nb >= bucket_nodes(max_nodes):
+            break
+        nb *= 2
+    return timings
+
+
+# ------------------------------------------------------------- host API
+
+
+def _mask_names(names: List[str], mask: np.ndarray) -> List[str]:
+    return [n for n, flag in zip(names, mask) if flag]
+
+
+def _empty_report() -> dict:
     return {
-        "nodes": len(names),
-        "needs_flip": [n for n, f in zip(names, out["needs_flip"]) if f],
-        "failed": [n for n, f in zip(names, out["failed"]) if f],
+        "nodes": 0,
+        "needs_flip": [],
+        "failed": [],
+        "flipping": [],
+        "stale_evidence": [],
+        "mode_counts": {},
+        "incoherent_slices": [],
+        "half_flipped_slices": [],
+        "doctor": {"reported": 0, "unreported": [], "failing": []},
+    }
+
+
+def analyze_encoding(enc: FleetEncoding) -> dict:
+    """One planner tick over a live feature block → JSON-ready report
+    (the fleet controller's scan body)."""
+    snap = enc.snapshot()
+    n = snap.n_nodes
+    if n == 0:
+        return _empty_report()
+    nb = len(snap.columns["desired"])
+    out = _tick_fn(nb, BUCKET_MIN_POOLS)(
+        snap.columns, np.zeros(BUCKET_MIN_POOLS, np.int32)
+    )
+    names = snap.names
+    slice_names = {v: k for k, v in snap.slice_index.items()}
+    real_slice = {
+        v: not k.startswith("__solo__/")
+        for k, v in snap.slice_index.items()
+    }
+    unreported = sorted(_mask_names(names, out["doctor_unreported"]))
+    failing_names = _mask_names(names, out["doctor_failing"])
+    failing = sorted(
+        (
+            {
+                "node": name,
+                "fail": snap.doctor_details.get(name, {}).get(
+                    "fail", ["unparseable"]),
+                "at": snap.doctor_details.get(name, {}).get("at"),
+            }
+            for name in failing_names
+        ),
+        key=lambda d: d["node"],
+    )
+    return {
+        "nodes": n,
+        "needs_flip": _mask_names(names, out["needs_flip"]),
+        "failed": _mask_names(names, out["failed"]),
+        "flipping": _mask_names(names, out["flipping"]),
+        "stale_evidence": _mask_names(names, out["stale_evidence"]),
         "mode_counts": {
             CODE_MODES[i]: int(c)
             for i, c in enumerate(out["mode_counts"])
@@ -203,15 +955,82 @@ def analyze_fleet(nodes: List[dict]) -> dict:
         },
         "incoherent_slices": [
             slice_names[i]
-            for i in range(len(slice_index))
+            for i in sorted(slice_names)
             if real_slice[i] and not out["slice_coherent"][i]
         ],
         "half_flipped_slices": [
             slice_names[i]
-            for i in range(len(slice_index))
+            for i in sorted(slice_names)
             if real_slice[i] and out["slice_half_flipped"][i]
         ],
+        "doctor": {
+            "reported": n - len(unreported),
+            "unreported": unreported,
+            "failing": failing,
+        },
     }
+
+
+def analyze_fleet(nodes: List[dict]) -> dict:
+    """End-to-end host API: node objects in, JSON-ready report out.
+    Builds a throwaway feature block; long-lived controllers keep a
+    :class:`FleetEncoding` and call :func:`analyze_encoding` so the
+    encode cost tracks deltas, not fleet size."""
+    enc = FleetEncoding()
+    for node in nodes:
+        enc.apply(node)
+    return analyze_encoding(enc)
+
+
+def analyze_pools(
+    pools: Sequence[Tuple[str, str, List[dict]]],
+) -> Dict[str, Dict[str, int]]:
+    """The policy controller's batched question: for each
+    ``(pool_name, target_mode, nodes)``, per-pool convergence, failure,
+    divergence, skew, and rollout-eligibility counts — one kernel call
+    for every policy in the scan, replacing the per-node Python loops
+    ``_derive_status`` used to run."""
+    enc = FleetEncoding()
+    pool_of: Dict[str, int] = {}
+    targets: List[int] = []
+    for pid, (pname, mode, nodes) in enumerate(pools):
+        targets.append(encode_mode(mode))
+        for node in nodes:
+            # pool membership is positional: a node listed under two
+            # pools belongs to the FIRST (the claims pass already
+            # resolves overlap before calling here)
+            name = node["metadata"]["name"]
+            if name not in pool_of:
+                pool_of[name] = pid
+            enc.apply(node)
+    snap = enc.snapshot()
+    n = snap.n_nodes
+    pb = bucket_pools(len(pools))
+    if n == 0:
+        return {
+            pname: {"nodes": 0, "converged": 0, "failed": 0,
+                    "divergent": 0, "skew": 0, "eligible": 0}
+            for pname, _, _ in pools
+        }
+    pool_ids = snap.columns["pool_ids"]
+    for i, name in enumerate(snap.names):
+        pool_ids[i] = pool_of[name]
+    pool_ids[n:] = pb - 1
+    pool_target = np.zeros(pb, np.int32)
+    pool_target[: len(targets)] = targets
+    nb = len(snap.columns["desired"])
+    out = _tick_fn(nb, pb)(snap.columns, pool_target)
+    result: Dict[str, Dict[str, int]] = {}
+    for pid, (pname, _, _) in enumerate(pools):
+        result[pname] = {
+            "nodes": int(out["pool_nodes"][pid]),
+            "converged": int(out["pool_converged"][pid]),
+            "failed": int(out["pool_failed"][pid]),
+            "divergent": int(out["pool_divergent"][pid]),
+            "skew": int(out["pool_skew"][pid]),
+            "eligible": int(out["pool_eligible"][pid]),
+        }
+    return result
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -70,6 +70,7 @@ from tpu_cc_manager.obs import (
     Counter, Gauge, Histogram, RouteServer, kube_throttle_wait_histogram,
     wire_throttle_observer,
 )
+from tpu_cc_manager.plan import analyze_pools
 from tpu_cc_manager.rollout import (
     HEARTBEAT_STALE_S, ROLLOUT_RECORD_VERSION, Rollout, RolloutError,
     load_rollout_records, record_node_names, rollout_record_version,
@@ -462,7 +463,11 @@ class PolicyController:
         actionable: List[Tuple[dict, dict, frozenset]] = []
         claims_incomplete = False
 
-        # ---- pass 1: validate, claim nodes, derive label-truth counts
+        # ---- pass 1: validate and claim nodes. Per-pool label-truth
+        # counts are NOT derived here: the claims loop only resolves
+        # selector overlap; the counting happens below in ONE batched
+        # planner-kernel call over every claimed pool (plan.analyze_pools)
+        derivable: List[Tuple[dict, dict, List[dict], List[str]]] = []
         for pol in policies:
             name = pol["metadata"]["name"]
             try:
@@ -496,7 +501,21 @@ class PolicyController:
                     paused_claims[n["metadata"]["name"]] = name
             for n in nodes:
                 seen_nodes[n["metadata"]["name"]] = n
-            st = self._derive_status(pol, spec, own, conflicted)
+            derivable.append((pol, spec, own, conflicted))
+
+        # ---- pass 1b: ONE planner tick answers every pool's
+        # convergence / failure / skew / eligibility question (the
+        # per-node Python loops this scan used to run per policy —
+        # ccaudit's planner-bypass rule keeps them from coming back)
+        pool_stats = analyze_pools([
+            (pol["metadata"]["name"], spec["mode"], own)
+            for pol, spec, own, _ in derivable
+        ]) if derivable else {}
+        for pol, spec, own, conflicted in derivable:
+            name = pol["metadata"]["name"]
+            st = self._derive_status(
+                pol, spec, own, conflicted, pool_stats.get(name)
+            )
             statuses[name] = st
             if (st["phase"] == "Conflicted"
                     and (pol.get("status") or {}).get("phase")
@@ -517,6 +536,15 @@ class PolicyController:
                     st["message"] += (
                         "; waiting for maintenance window "
                         f"{spec['window_raw']}"
+                    )
+                elif st["divergent"] and not st.get("eligible"):
+                    # the kernel's rollout-eligibility verdict: every
+                    # divergent node is mid-flip (taint) or under a
+                    # failing doctor — launching now would churn a pool
+                    # that cannot act; the next tick re-judges
+                    st["message"] += (
+                        "; holding launch — divergent node(s) are "
+                        "mid-flip or doctor-failing"
                     )
                 else:
                     actionable.append((pol, spec, frozenset(
@@ -841,21 +869,27 @@ class PolicyController:
 
     # --------------------------------------------------------- derivation
     def _derive_status(self, pol: dict, spec: dict, own: List[dict],
-                       conflicted: List[str]) -> dict:
-        converged = failed = 0
-        for n in own:
-            labels = n["metadata"].get("labels", {})
-            state = labels.get(L.CC_MODE_STATE_LABEL)
-            if state == "failed":
-                failed += 1
-            elif (labels.get(L.CC_MODE_LABEL) == spec["mode"]
-                  and state == spec["mode"]):
-                converged += 1
+                       conflicted: List[str],
+                       stats: Optional[Dict[str, int]] = None) -> dict:
+        """Phase + counts for one policy. The counts come from the
+        batched planner kernel (``plan.analyze_pools`` — ONE jitted
+        tick for every policy in the scan); this method only classifies
+        them. ``stats=None`` (an empty pool that never reached the
+        batch) means all-zero counts."""
+        stats = stats or {}
+        converged = stats.get("converged", 0)
+        failed = stats.get("failed", 0)
         divergent = len(own) - converged
         st = self._status(pol, "Converged", "")
         st.update({
             "nodes": len(own), "converged": converged, "failed": failed,
             "divergent": divergent, "conflicted": len(conflicted),
+            # kernel extras: how mixed the pool's observed modes are,
+            # and how many divergent nodes a rollout could act on NOW
+            # (not mid-flip, not doctor-failing; failed nodes count —
+            # re-driving them is how they recover)
+            "skew": stats.get("skew", 0),
+            "eligible": stats.get("eligible", 0),
         })
         if conflicted:
             st["phase"] = "Conflicted"
@@ -888,7 +922,7 @@ class PolicyController:
             "phase": phase,
             "message": message,
             "nodes": 0, "converged": 0, "failed": 0, "divergent": 0,
-            "conflicted": 0,
+            "conflicted": 0, "skew": 0, "eligible": 0,
             "lastScanTime": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),
@@ -1593,7 +1627,7 @@ class PolicyController:
         the statuses stale mid-flight. Fingerprint-filtered — periodic
         doctor republish timestamps don't wake. Degrades silently to
         interval polling when the client has no node watch."""
-        from tpu_cc_manager.fleet import run_node_watch
+        from tpu_cc_manager.watch import run_node_watch
 
         run_node_watch(
             self.kube, self._stop, self._node_wake,
@@ -1604,6 +1638,12 @@ class PolicyController:
 
     def run(self) -> int:
         self._server.start()
+        # planner compile warmup (ISSUE 7, env-gated): _scan dispatches
+        # the jitted tick via analyze_pools, so the policy controller
+        # deserves the same restart-in-milliseconds contract as fleet
+        from tpu_cc_manager import plan
+
+        plan.maybe_warmup(log)
         log.info(
             "policy controller serving on :%d (every %.0fs + "
             "watch-triggered)", self.port, self.interval_s,

@@ -17,9 +17,10 @@ Takes the union of the reference's two watcher implementations
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
-from typing import Callable, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
@@ -105,6 +106,118 @@ class SyncableModeConfig:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+
+def stable_doctor_digest(raw: Optional[str]) -> Optional[str]:
+    """Volatile-timestamp-free reduction of the doctor annotation: the
+    ``{ok, fail}`` digest only, so a periodic republish that merely
+    moves the verdict timestamp compares equal. Shared by the watch
+    wake filter below and the planner's row fingerprint
+    (plan.FleetEncoding) — the two MUST agree or watch wake-ups and
+    encoding re-encodes diverge. Total over hostile node-writable
+    annotations: malformed or non-dict shapes reduce to a stable value
+    (the raw text) instead of throwing in a watch thread."""
+    if not raw:
+        return None
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return raw
+    if not isinstance(d, dict):
+        return raw
+    return json.dumps({"ok": d.get("ok"), "fail": d.get("fail")},
+                      sort_keys=True)
+
+
+def node_report_fingerprint(node: dict) -> Tuple[Any, ...]:
+    """Comparable digest of exactly the node state the controllers'
+    reports depend on: tpu labels (desired/state/slice/doctor-ok and
+    the accelerator selector), the evidence annotation, and the STABLE
+    part of the doctor verdict (ok + failing checks — not its
+    timestamp, or every periodic doctor publish would wake a scan that
+    finds nothing new). Shared by the fleet and policy controllers'
+    node-watch wake filters."""
+    meta = node.get("metadata", {})
+    labels = meta.get("labels") or {}
+    ann = meta.get("annotations") or {}
+    relevant = tuple(sorted(
+        (k, v) for k, v in labels.items()
+        if "tpu.google.com" in k or k == L.TPU_ACCELERATOR_LABEL
+    ))
+    doctor = stable_doctor_digest(ann.get(L.DOCTOR_ANNOTATION))
+    return (relevant, ann.get(L.EVIDENCE_ANNOTATION), doctor)
+
+
+def run_node_watch(kube: Any, stop: threading.Event,
+                   wake: Callable[[], None],
+                   *, timeout_s: int, backoff_s: float,
+                   logger: logging.Logger, who: str,
+                   on_event: Optional[
+                       Callable[[str, dict], None]] = None) -> None:
+    """Shared node-watch pump for both controllers: stream node events,
+    call ``wake()`` for report-relevant changes (fingerprint-filtered —
+    see :func:`node_report_fingerprint`), wake once per from-scratch
+    (re)connect to cover the unreplayable gap, back off and
+    re-establish on transient failures, and return — degrading the
+    caller to pure interval polling — when the client has no
+    node-watch support (501, or a clientset whose ``watch_nodes``
+    isn't a generator).
+
+    ``on_event`` receives every non-bookmark ``(etype, node)`` delta
+    BEFORE the wake filter — the feed the fleet controller's
+    incremental :class:`~tpu_cc_manager.plan.FleetEncoding` rides, so
+    the planner's feature block tracks deltas instead of re-encoding
+    the fleet each scan. The callee dedups; this pump only delivers."""
+    rv = None
+    prints: Dict[str, object] = {}
+    while not stop.is_set():
+        if rv is None:
+            # a fresh watch starts at "now" and cannot replay what
+            # happened before it: wake one scan to cover the gap
+            wake()
+        try:
+            # the no-watch probe is scoped to the CALL alone: a
+            # TypeError from event processing must hit the generic
+            # backoff-and-retry below, not masquerade as a clientset
+            # without watch support
+            try:
+                stream = iter(kube.watch_nodes(
+                    resource_version=rv, timeout_s=timeout_s,
+                ))
+            except TypeError:
+                logger.info("%s: client has no node-watch support; "
+                            "interval polling only", who)
+                return
+            for etype, obj in stream:
+                meta = obj.get("metadata", {})
+                rv = meta.get("resourceVersion", rv)
+                if etype == "BOOKMARK":
+                    continue
+                if on_event is not None:
+                    on_event(etype, obj)
+                name = meta.get("name", "")
+                if etype == "DELETED":
+                    prints.pop(name, None)
+                    wake()
+                    continue
+                fp = node_report_fingerprint(obj)
+                if prints.get(name) != fp:
+                    prints[name] = fp
+                    wake()
+                if stop.is_set():
+                    return
+        except ApiException as e:
+            if e.status == 501:
+                logger.info("%s: client has no node-watch support; "
+                            "interval polling only", who)
+                return
+            rv = None
+            stop.wait(backoff_s)
+        except Exception:
+            logger.warning("%s: node watch failed; retrying", who,
+                           exc_info=True)
+            rv = None
+            stop.wait(backoff_s)
 
 
 class FatalWatchError(Exception):
