@@ -844,10 +844,30 @@ def run_simlab_bench():
               f"{art.get('notes')}", file=sys.stderr)
         sys.exit(1)
     m = art["metrics"]
+    stitch = m.get("trace_stitch") or {}
+    if m.get("e2e_convergence_p99_s") is None:
+        # a converged run with NO stitched e2e samples means trace
+        # propagation (or adoption) broke — the exact failure this
+        # axis exists to catch. A None would silently fall out of the
+        # bench_trend gate (axes skip when absent, by design for
+        # mixed-era histories), so fail HERE, loudly, at the source.
+        print("FATAL: simlab scale-256 converged but produced no "
+              f"stitched e2e samples (trace_stitch={stitch!r}); "
+              "cc.trace propagation is broken", file=sys.stderr)
+        sys.exit(1)
     return {
         "pool256_convergence_s": m["pool256_convergence_s"],
+        # label-commit -> state-published latency measured from the
+        # stitched cross-process traces (ISSUE 8): the causal number
+        # ROADMAP item 2 asks for, trend-gated in bench_trend.py next
+        # to the driver-poll convergence axis it explains
+        "e2e_convergence_p99_s": m.get("e2e_convergence_p99_s"),
         "simlab256": {
             "scenario": art["scenario"],
+            "stitched_traces": stitch.get("traces"),
+            "cross_process_traces": stitch.get("cross_process_traces"),
+            "e2e_samples": stitch.get("e2e_samples"),
+            "e2e_convergence_p50_s": stitch.get("e2e_convergence_p50_s"),
             "watch_pump_lag_p50_s": m["watch_pump"]["lag_p50_s"],
             "watch_pump_lag_p95_s": m["watch_pump"]["lag_p95_s"],
             "watch_errors_absorbed": m["watch_pump"]["watch_errors"],

@@ -41,6 +41,16 @@ REGRESSION_FACTOR = 2.0
 #: tick (the ROADMAP item 3 scale proof). The COLD scan number stays
 #: visible as scale256.fleet_scan_s but ungated: with the persistent
 #: compile cache it measures cache priming, a one-per-deploy cost.
+#: e2e_convergence_p99_s joined in r09 (the flight-recorder round,
+#: ISSUE 8): label-commit -> state-published latency per node in the
+#: pool256 scenario, measured from CROSS-PROCESS stitched traces
+#: (desired_write span start to the last adopted reconcile span end)
+#: rather than the driver's convergence poll — the causal tail-latency
+#: axis ROADMAP item 2 asks for, and the one that regresses if trace
+#: propagation (or the reconcile path under it) quietly breaks. A
+#: FULLY broken stitch (zero samples -> null axis) cannot hide in the
+#: skip-if-absent rule here: bench.py itself exits 1 when the scenario
+#: converges with no stitched e2e samples.
 GATED_EXTRA_AXES = {
     "real_chip_flip_s": "lower",
     "pool256_convergence_s": "lower",
@@ -48,6 +58,7 @@ GATED_EXTRA_AXES = {
     "flips_per_min_windowed": "higher",
     "fleet_scan_warm_s": "lower",
     "planner_tick_100k_s": "lower",
+    "e2e_convergence_p99_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are

@@ -214,6 +214,36 @@ def main():
                         f"http://127.0.0.1:{port}/{ep}", timeout=5
                     ).status
                     log(f"PASS /{ep} -> {code} (manifest probe path)")
+                # 2b. the LIVE /metrics surface must parse under the
+                # strict text-format validator (duplicate HELP/TYPE,
+                # label escaping, histogram bucket monotonicity) — the
+                # CI exposition gate, against the real agent, not a
+                # unit fixture
+                from tpu_cc_manager.obs import validate_exposition
+
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ).read().decode()
+                problems = validate_exposition(body)
+                if not problems:
+                    log("PASS /metrics parses as strict Prometheus "
+                        "text exposition")
+                else:
+                    failures.append(
+                        f"metrics exposition invalid: {problems[:3]}")
+                # 2c. the flight recorder's on-demand snapshot route
+                fr = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/flightrec",
+                    timeout=5,
+                ).read())
+                if ("spans" in fr and "host_samples" in fr
+                        and "events" in fr
+                        and fr.get("flightrec_version") == 1):
+                    log("PASS /debug/flightrec serves the live "
+                        "black-box snapshot")
+                else:
+                    failures.append(
+                        f"flightrec route shape: {sorted(fr)[:8]}")
 
             # 3. label -> state round trip (the core of config 1)
             for mode in ("devtools", "ici", "off"):

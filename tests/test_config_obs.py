@@ -169,3 +169,250 @@ def test_route_server_handler_exception_returns_500():
             assert r.read() == b"fine"
     finally:
         srv.stop()
+
+
+# --------------------------------------- metric registration drift guard
+def test_every_metric_attribute_is_rendered():
+    """ISSUE 8 satellite: render() used to be a hand-maintained list
+    and a forgotten metric vanished from /metrics silently. All three
+    metric sets now render by reflection (obs.registered_metrics);
+    this pins that every metric-primitive attribute appears."""
+    from tpu_cc_manager.fleet import FleetMetrics
+    from tpu_cc_manager.obs import registered_metrics
+    from tpu_cc_manager.policy import PolicyMetrics
+
+    for ms in (Metrics(), FleetMetrics(), PolicyMetrics()):
+        text = ms.render()
+        prims = registered_metrics(ms)
+        assert prims, type(ms).__name__
+        for p in prims:
+            assert f"# HELP {p.name} " in text, (
+                f"{type(ms).__name__}.{p.name} missing from render()"
+            )
+
+
+def test_drift_guard_is_structural_not_a_list():
+    """The regression the guard kills: ADD a metric attribute, touch
+    nothing else — it must show up in the exposition."""
+    m = Metrics()
+    m.zz_new_gauge = Gauge("tpu_cc_test_drift_guard", "added in a test")
+    assert "tpu_cc_test_drift_guard" in m.render()
+
+
+def test_counter_set_total_mirrors_external_totals():
+    c = Counter("tpu_cc_planner_retraces_total", "x", ("kernel",))
+    c.set_total(5, "fleet_tick")
+    c.set_total(7, "fleet_tick")
+    assert c.value("fleet_tick") == 7
+    assert 'tpu_cc_planner_retraces_total{kernel="fleet_tick"} 7' in (
+        "\n".join(c.render())
+    )
+
+
+def test_planner_compile_economics_scrapeable():
+    """ISSUE 8 satellite: the PR-7 'restart = zero cache misses' claim
+    as /metrics surface — plan.compile_stats() mirrored into the fleet
+    controller's metric set."""
+    from tpu_cc_manager import plan
+    from tpu_cc_manager.fleet import FleetMetrics
+
+    stats = plan.compile_stats()
+    assert set(stats) == {"retraces", "cache_hits", "cache_misses"}
+    assert isinstance(stats["retraces"], dict)
+    fm = FleetMetrics()
+    fm.planner_retraces.set_total(3, "fleet_tick")
+    fm.planner_cache_hits.set_total(2)
+    fm.planner_cache_misses.set_total(1)
+    text = fm.render()
+    assert 'tpu_cc_planner_retraces_total{kernel="fleet_tick"} 3' in text
+    assert "tpu_cc_planner_compile_cache_hits_total 2" in text
+    assert "tpu_cc_planner_compile_cache_misses_total 1" in text
+
+
+# --------------------------------------------- exposition-format validation
+def test_validate_exposition_accepts_every_live_metric_set():
+    from tpu_cc_manager.fleet import FleetMetrics
+    from tpu_cc_manager.obs import validate_exposition
+    from tpu_cc_manager.policy import PolicyMetrics
+
+    m = Metrics()
+    m.reconciles_total.inc("success")
+    m.reconcile_duration.observe(0.25)
+    m.phase_duration.observe("flip", 0.1)
+    m.set_current_mode("on")
+    fm = FleetMetrics()
+    fm.scan_duration.observe(0.5)
+    pm = PolicyMetrics()
+    pm.scans.inc()
+    for ms in (m, fm, pm):
+        assert validate_exposition(ms.render()) == [], type(ms).__name__
+
+
+def test_validate_exposition_catches_the_bug_classes():
+    from tpu_cc_manager.obs import validate_exposition
+
+    def problems(text):
+        return validate_exposition(text)
+
+    # duplicate HELP/TYPE (two sets declaring one family)
+    dup = (
+        "# HELP a_total x\n# TYPE a_total counter\na_total 1\n"
+        "# HELP a_total x\n# TYPE a_total counter\n"
+    )
+    assert any("duplicate HELP" in p for p in problems(dup))
+    assert any("duplicate TYPE" in p for p in problems(dup))
+    # duplicate series: same name+labels twice
+    two = ("# HELP a x\n# TYPE a gauge\n"
+           'a{k="v"} 1\na{k="v"} 2\n')
+    assert any("duplicate series" in p for p in problems(two))
+    # broken label escaping: raw backslash-quote mess
+    bad_label = ('# HELP a x\n# TYPE a gauge\n'
+                 'a{k="un"quoted"} 1\n')
+    assert any("label" in p or "unparseable" in p
+               for p in problems(bad_label))
+    # a sample with no TYPE declaration
+    naked = "orphan_metric 3\n"
+    assert any("TYPE" in p for p in problems(naked))
+    # non-numeric value
+    nan = "# HELP a x\n# TYPE a gauge\na NaNope\n"
+    assert any("non-numeric" in p for p in problems(nan))
+    # histogram: non-monotone cumulative buckets
+    h = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 5\nh_bucket{le="1"} 3\n'
+        'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n'
+    )
+    assert any("decrease" in p for p in problems(h))
+    # histogram: +Inf bucket must equal _count
+    h2 = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\n'
+        "h_sum 1\nh_count 3\n"
+    )
+    assert any("_count" in p for p in problems(h2))
+    # histogram: missing +Inf
+    h3 = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1\nh_sum 1\nh_count 1\n'
+    )
+    assert any("+Inf" in p for p in problems(h3))
+
+
+def test_validate_exposition_accepts_escaped_labels():
+    from tpu_cc_manager.obs import validate_exposition
+
+    ok = ('# HELP a x\n# TYPE a gauge\n'
+          'a{k="with \\"quotes\\" and \\\\"} 1\n')
+    assert validate_exposition(ok) == []
+
+
+# ----------------------------------------------- structured JSON logging
+def test_json_log_formatter_injects_trace_ids():
+    import json as _json
+    import logging
+
+    from tpu_cc_manager.obs import JsonLogFormatter
+    from tpu_cc_manager.trace import Tracer
+
+    fmt = JsonLogFormatter()
+
+    def record(msg="hello %s", args=("world",)):
+        return logging.LogRecord(
+            "tpu-cc-manager.test", logging.INFO, __file__, 1, msg,
+            args, None,
+        )
+
+    out = _json.loads(fmt.format(record()))
+    assert out["msg"] == "hello world"
+    assert out["level"] == "INFO"
+    assert out["logger"] == "tpu-cc-manager.test"
+    assert "trace_id" not in out  # outside any span
+    tr = Tracer()
+    with tr.span("reconcile") as root:
+        inside = _json.loads(fmt.format(record()))
+    assert inside["trace_id"] == root.trace_id
+    assert inside["span_id"] == root.span_id
+    # the adopted-remote case: logs join the CONTROLLER's trace id
+    with tr.adopt_remote("00-remotetrace-remotespan-01"):
+        with tr.span("reconcile"):
+            adopted = _json.loads(fmt.format(record()))
+    assert adopted["trace_id"] == "remotetrace"
+
+
+def test_json_log_formatter_carries_exceptions():
+    import json as _json
+    import logging
+    import sys
+
+    from tpu_cc_manager.obs import JsonLogFormatter
+
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        rec = logging.LogRecord(
+            "x", logging.ERROR, __file__, 1, "failed", (),
+            sys.exc_info(),
+        )
+    out = _json.loads(JsonLogFormatter().format(rec))
+    assert "ValueError: boom" in out["exc"]
+
+
+def test_setup_logging_json_opt_in():
+    import logging
+
+    from tpu_cc_manager.obs import JsonLogFormatter, setup_logging
+
+    root = logging.getLogger()
+    saved_handlers, saved_level = list(root.handlers), root.level
+    try:
+        setup_logging(False, fmt="json")
+        assert any(
+            isinstance(h.formatter, JsonLogFormatter)
+            for h in root.handlers
+        )
+    finally:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        for h in saved_handlers:
+            root.addHandler(h)
+        root.setLevel(saved_level)
+
+
+def test_log_format_config_knob(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "n1")
+    monkeypatch.setenv("TPU_CC_LOG_FORMAT", "json")
+    cfg, _ = parse_config([])
+    assert cfg.log_format == "json"
+    monkeypatch.delenv("TPU_CC_LOG_FORMAT")
+    cfg, _ = parse_config([])
+    assert cfg.log_format == "text"
+    with pytest.raises(ValueError):
+        AgentConfig(node_name="n1", log_format="xml")
+
+
+def test_flightrec_dir_config_knob(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "n1")
+    monkeypatch.setenv("TPU_CC_FLIGHTREC_DIR", "/var/run/flightrec")
+    cfg, _ = parse_config([])
+    assert cfg.flightrec_dir == "/var/run/flightrec"
+    monkeypatch.delenv("TPU_CC_FLIGHTREC_DIR")
+    cfg, _ = parse_config([])
+    assert cfg.flightrec_dir is None
+
+
+def test_validate_exposition_never_raises_on_hostile_numerics():
+    """The validator's contract is a problem LIST — malformed le labels
+    and non-numeric sample values are findings, not crashes (a broken
+    live /metrics must fail the smoke check, not traceback it)."""
+    from tpu_cc_manager.obs import validate_exposition
+
+    bad_le = ("# HELP h x\n# TYPE h histogram\n"
+              'h_bucket{le="abc"} 1\nh_bucket{le="+Inf"} 1\n'
+              "h_sum 1\nh_count 1\n")
+    probs = validate_exposition(bad_le)
+    assert any("non-numeric le" in p for p in probs)
+    bad_val = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="0.1"} oops\nh_bucket{le="+Inf"} 1\n'
+               "h_sum 1\nh_count 1\n")
+    probs = validate_exposition(bad_val)
+    assert any("non-numeric value" in p for p in probs)

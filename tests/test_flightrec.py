@@ -1,0 +1,333 @@
+"""Flight recorder (ISSUE 8): the bounded per-process black box.
+
+Pins the dump schema (spans + events + host-contention samples +
+metrics snapshot), the three dump triggers (reconcile failure, SIGTERM,
+on-demand GET), the dump throttle, and the fleet-wide stitch-by-trace
+primitive simlab's timeline artifact builds on.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+
+from tpu_cc_manager.device.fake import fake_backend
+from tpu_cc_manager.flightrec import (
+    FlightRecorder, get_recorder, install_sigterm_dump, sample_host,
+    set_recorder, stitch_by_trace,
+)
+from tpu_cc_manager.trace import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the pinned dump/snapshot schema — a breaking change here must bump
+#: flightrec.SCHEMA_VERSION and update docs/observability.md
+SCHEMA_KEYS = {
+    "flightrec_version", "reason", "at", "name",
+    "spans", "events", "host_samples", "metrics",
+}
+
+
+def test_sample_host_reads_proc():
+    s = sample_host()
+    assert s["at"] > 0
+    if not s.get("unavailable"):  # Linux CI/sandbox
+        assert s["load1"] >= 0.0
+        assert s["cpu_total_jiffies"] >= s["cpu_idle_jiffies"] >= 0
+        assert s["self_utime_jiffies"] >= 0
+        assert s["mem_available_kb"] > 0
+
+
+def test_rings_bounded_and_snapshot_schema():
+    rec = FlightRecorder(name="n1", span_ring=4, event_ring=3,
+                         sample_ring=2)
+    tr = Tracer()
+    tr.add_sink(rec.observe_span)
+    for i in range(10):
+        with tr.span("reconcile", i=i):
+            pass
+        rec.note("tick", i=i)
+        rec.sample("idle")
+    doc = rec.snapshot("inspect")
+    assert set(doc) == SCHEMA_KEYS
+    assert doc["flightrec_version"] == 1
+    assert doc["name"] == "n1"
+    assert len(doc["spans"]) == 4  # ring, not archive
+    assert doc["spans"][-1]["attrs"]["i"] == 9  # newest retained
+    assert len(doc["events"]) == 3
+    assert len(doc["host_samples"]) == 2
+    assert doc["metrics"] is None  # none wired
+    # snapshot is JSON-able as-is (the dump body contract)
+    json.dumps(doc)
+
+
+def test_bracket_takes_pre_and_post_samples():
+    rec = FlightRecorder()
+    with rec.bracket("flip:/dev/accel0"):
+        pass
+    tags = [s["tag"] for s in rec.snapshot()["host_samples"]]
+    assert tags == ["flip:/dev/accel0:pre", "flip:/dev/accel0:post"]
+
+
+def test_dump_writes_whole_artifact_and_throttles(tmp_path):
+    rec = FlightRecorder(name="n1", dump_dir=str(tmp_path),
+                         min_dump_interval_s=3600.0,
+                         metrics=lambda: {"k": 1})
+    rec.note("boom", why="test")
+    path = rec.maybe_dump("reconcile_failure")
+    assert path is not None and os.path.exists(path)
+    assert "reconcile_failure" in os.path.basename(path)
+    doc = json.loads(open(path).read())
+    assert set(doc) == SCHEMA_KEYS
+    assert doc["reason"] == "reconcile_failure"
+    assert doc["metrics"] == {"k": 1}
+    assert doc["events"][-1]["kind"] == "boom"
+    # no torn half-dump left behind
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    # second failure inside the throttle window: no second dump
+    assert rec.maybe_dump("reconcile_failure") is None
+    assert rec.dumps_total == 1
+    # an explicit dump (SIGTERM, operator) bypasses the throttle
+    assert rec.dump("sigterm") is not None
+    assert rec.dumps_total == 2
+
+
+def test_dump_without_dir_is_a_noop():
+    rec = FlightRecorder(name="n1")  # no dump_dir, no env
+    assert rec.dump_dir is None or isinstance(rec.dump_dir, str)
+    rec.dump_dir = None
+    assert rec.dump("sigterm") is None
+
+
+def test_metrics_snapshot_uses_render():
+    class Ms:
+        def render(self):
+            return "# HELP x y\n"
+
+    rec = FlightRecorder(metrics=Ms())
+    assert rec.snapshot()["metrics"] == {"exposition": "# HELP x y\n"}
+
+
+def test_sigterm_dump_chains_previous_handler(tmp_path):
+    rec = FlightRecorder(name="n1", dump_dir=str(tmp_path))
+    rec.note("alive")
+    called = []
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: called.append(s))
+        handler = install_sigterm_dump(rec)
+        assert handler is not None
+        handler(signal.SIGTERM, None)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    dumps = [f for f in os.listdir(tmp_path) if "sigterm" in f]
+    assert len(dumps) == 1
+    doc = json.loads(open(os.path.join(tmp_path, dumps[0])).read())
+    assert doc["reason"] == "sigterm"
+    # the clean-shutdown handler installed before still ran
+    assert called == [signal.SIGTERM]
+
+
+def test_sigterm_default_action_still_kills(tmp_path):
+    """With no previous handler the process must still DIE of SIGTERM
+    (exit status honest for the kubelet) — after the dump lands."""
+    code = (
+        "import os, signal, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from tpu_cc_manager.flightrec import (FlightRecorder,"
+        " install_sigterm_dump)\n"
+        f"rec = FlightRecorder(name='sub', dump_dir={str(tmp_path)!r})\n"
+        "rec.note('boot')\n"
+        "install_sigterm_dump(rec)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "print('UNREACHABLE')\n"
+    )
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == -signal.SIGTERM, (p.returncode, p.stderr)
+    assert "UNREACHABLE" not in p.stdout
+    assert any("sigterm" in f for f in os.listdir(tmp_path))
+
+
+def test_process_recorder_swap():
+    original = get_recorder()
+    try:
+        mine = FlightRecorder(name="mine")
+        set_recorder(mine)
+        assert get_recorder() is mine
+    finally:
+        set_recorder(original)
+
+
+def test_stitch_by_trace_joins_across_recordings():
+    a = {"name": "controller", "spans": [
+        {"name": "desired_write", "trace": "t1", "span": "c1",
+         "start_ts": 1.0, "dur_s": 0.1},
+    ]}
+    b = {"name": "node-1", "spans": [
+        {"name": "reconcile", "trace": "t1", "span": "r1",
+         "parent": "c1", "start_ts": 1.5, "dur_s": 0.2},
+        {"name": "reconcile", "trace": "local", "span": "r2",
+         "start_ts": 0.5, "dur_s": 0.1},
+        {"name": "junk"},  # no trace id: dropped, not crashed
+    ]}
+    out = stitch_by_trace([a, b])
+    assert set(out) == {"t1", "local"}
+    t1 = out["t1"]
+    assert [s["name"] for s in t1] == ["desired_write", "reconcile"]
+    assert [s["recorder"] for s in t1] == ["controller", "node-1"]
+
+
+def test_engine_brackets_flips_with_host_samples():
+    rec = FlightRecorder()
+    from tpu_cc_manager.engine import ModeEngine, NullDrainer
+
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        drainer=NullDrainer(),
+        evict_components=False,
+        backend=fake_backend(n_chips=2),
+        recorder=rec,
+    )
+    assert engine.set_mode("on")
+    tags = [s["tag"] for s in rec.snapshot()["host_samples"]]
+    pres = [t for t in tags if t.endswith(":pre")]
+    posts = [t for t in tags if t.endswith(":post")]
+    assert len(pres) == 2 and len(posts) == 2  # one bracket per chip
+
+
+def test_failed_flip_items_noted():
+    rec = FlightRecorder()
+    backend = fake_backend(n_chips=2)
+    backend.chips[0].fail_reset = True
+    from tpu_cc_manager.engine import ModeEngine, NullDrainer
+
+    engine = ModeEngine(
+        set_state_label=lambda v: None,
+        drainer=NullDrainer(),
+        evict_components=False,
+        backend=backend,
+        recorder=rec,
+        flip_concurrency=1,  # serial: deterministic fail-stop skips
+    )
+    assert engine.set_mode("on") is False
+    flips = [e for e in rec.snapshot()["events"]
+             if e["kind"] == "flip_item"]
+    assert {e["status"] for e in flips} == {"failed", "skipped"}
+    failed = next(e for e in flips if e["status"] == "failed")
+    assert failed["device"] == "/dev/accel0"
+    assert "reset failed" in failed["error"]
+
+
+def _agent(tmp_path, backend, annotations=None, labels=None):
+    from tpu_cc_manager.agent import CCManagerAgent
+    from tpu_cc_manager.config import AgentConfig
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.k8s.objects import make_node
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels=labels, annotations=annotations))
+    cfg = AgentConfig(
+        node_name="n1", drain_strategy="none", health_port=0,
+        readiness_file=str(tmp_path / "ready"),
+        flightrec_dir=str(tmp_path / "flightrec"),
+    )
+    return CCManagerAgent(kube, cfg, backend=backend)
+
+
+def test_reconcile_failure_dumps_black_box(tmp_path):
+    backend = fake_backend(n_chips=1)
+    backend.chips[0].fail_reset = True
+    agent = _agent(tmp_path, backend)
+    assert agent.reconcile("on") is False
+    dumps = os.listdir(tmp_path / "flightrec")
+    assert len(dumps) == 1 and "reconcile_failure" in dumps[0]
+    doc = json.loads(open(tmp_path / "flightrec" / dumps[0]).read())
+    assert set(doc) == SCHEMA_KEYS
+    assert doc["name"] == "n1"
+    # spans: the failed flip is in the ring with its error, and —
+    # because the dump runs AFTER the span context closes — so is the
+    # root reconcile span of the very failure being documented
+    flip = next(s for s in doc["spans"] if s["name"] == "flip")
+    assert flip["status"] == "error"
+    root = next(s for s in doc["spans"] if s["name"] == "reconcile")
+    assert root["attrs"]["outcome"] == "failure"
+    assert root["dur_s"] > 0
+    # host samples bracket the flip window (ROADMAP item 1's sensor)
+    tags = [s["tag"] for s in doc["host_samples"]]
+    assert any(t.endswith(":pre") for t in tags)
+    assert any(t.endswith(":post") for t in tags)
+    # events: the reconcile outcome landed before the dump
+    rec_events = [e for e in doc["events"] if e["kind"] == "reconcile"]
+    assert rec_events and rec_events[-1]["outcome"] == "failure"
+    # metrics snapshot is the agent's full exposition
+    assert "tpu_cc_reconciles_total" in doc["metrics"]["exposition"]
+
+
+def test_successful_reconcile_does_not_dump(tmp_path):
+    agent = _agent(tmp_path, fake_backend(n_chips=1))
+    assert agent.reconcile("on")
+    assert not os.path.exists(tmp_path / "flightrec")
+
+
+def test_health_server_serves_flightrec_snapshot(tmp_path):
+    agent = _agent(tmp_path, fake_backend(n_chips=1))
+    assert agent.reconcile("on")
+    from tpu_cc_manager.obs import HealthServer
+
+    srv = HealthServer(agent.metrics, port=0, tracer=agent.tracer,
+                       flightrec=agent.flightrec).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/flightrec"
+        ) as resp:
+            doc = json.load(resp)
+    finally:
+        srv.stop()
+    assert set(doc) == SCHEMA_KEYS
+    assert doc["reason"] == "debug_get"
+    assert any(s["name"] == "reconcile" for s in doc["spans"])
+    # the GET wrote no file — it's the live snapshot, not a dump
+    assert not os.path.exists(tmp_path / "flightrec")
+
+
+def test_agent_adopts_desired_write_trace(tmp_path):
+    """Cross-process propagation end to end at the agent: the cc.trace
+    annotation stamped by a controller rides the watched node; the
+    reconcile root adopts its trace id and parents the remote span."""
+    from tpu_cc_manager import labels as L
+
+    # the restart-rejoin shape: desired label AND the writer's
+    # annotation both already on the node at prime time
+    agent = _agent(
+        tmp_path, fake_backend(n_chips=1),
+        labels={L.CC_MODE_LABEL: "on"},
+        annotations={L.CC_TRACE_ANNOTATION: "00-cafe1-feed2-01"},
+    )
+    agent.watcher.prime()  # reads the node (and its annotation)
+    assert agent.watcher.latest_trace_context() == "00-cafe1-feed2-01"
+    assert agent.reconcile("on")
+    root = next(s for s in agent.tracer.recent()
+                if s["name"] == "reconcile")
+    assert root["trace"] == "cafe1"
+    assert root["parent"] == "feed2"
+    # children keep nesting under the adopted root as usual
+    flip = next(s for s in agent.tracer.recent() if s["name"] == "flip")
+    assert flip["trace"] == "cafe1"
+
+
+def test_agent_garbled_annotation_degrades_to_local_root(tmp_path):
+    from tpu_cc_manager import labels as L
+
+    agent = _agent(
+        tmp_path, fake_backend(n_chips=1),
+        labels={L.CC_MODE_LABEL: "on"},
+        annotations={L.CC_TRACE_ANNOTATION: "not-a-traceparent"},
+    )
+    agent.watcher.prime()
+    assert agent.reconcile("on")
+    root = next(s for s in agent.tracer.recent()
+                if s["name"] == "reconcile")
+    assert root.get("parent") is None

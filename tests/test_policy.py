@@ -199,14 +199,17 @@ def test_canary_serializes_then_widens_window():
         kube.add_node(_node(n, desired="off", state="off"))
     concurrency = []
 
-    orig_set = kube.set_node_labels
+    orig_patch = kube.patch_node
 
-    def recording_set(name, labels):
-        if L.CC_MODE_LABEL in labels:
+    # desired writes are ONE patch_node carrying the label plus the
+    # cc.trace annotation (ISSUE 8) — hook the patch verb
+    def recording_patch(name, patch):
+        if L.CC_MODE_LABEL in (
+                (patch.get("metadata") or {}).get("labels") or {}):
             concurrency.append(name)
-        return orig_set(name, labels)
+        return orig_patch(name, patch)
 
-    kube.set_node_labels = recording_set
+    kube.patch_node = recording_patch
     agents = _ReactiveAgents(kube, names, delay_s=0.1)
     agents.start()
     try:

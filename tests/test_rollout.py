@@ -148,14 +148,17 @@ def test_rolling_window_serializes_groups():
         _node("b2", desired="off", state="off", slice_id="s-b"),
     )
     patch_times = {}
-    orig = kube.set_node_labels
+    orig = kube.patch_node
 
-    def recording_set(name, labels):
-        if L.CC_MODE_LABEL in labels:
+    # desired writes are ONE patch_node carrying the label plus the
+    # cc.trace annotation (ISSUE 8) — hook the patch verb
+    def recording_patch(name, patch):
+        if L.CC_MODE_LABEL in (
+                (patch.get("metadata") or {}).get("labels") or {}):
             patch_times[name] = time.monotonic()
-        return orig(name, labels)
+        return orig(name, patch)
 
-    kube.set_node_labels = recording_set
+    kube.patch_node = recording_patch
     agents = _ReactiveAgents(kube, ["a1", "a2", "b1", "b2"])
     agents.start()
     try:
@@ -179,14 +182,15 @@ def test_window_2_runs_groups_concurrently():
         *[_node(f"n{i}", desired="off", state="off") for i in range(4)],
     )
     patch_times = {}
-    orig = kube.set_node_labels
+    orig = kube.patch_node
 
-    def recording_set(name, labels):
-        if L.CC_MODE_LABEL in labels:
+    def recording_patch(name, patch):
+        if L.CC_MODE_LABEL in (
+                (patch.get("metadata") or {}).get("labels") or {}):
             patch_times[name] = time.monotonic()
-        return orig(name, labels)
+        return orig(name, patch)
 
-    kube.set_node_labels = recording_set
+    kube.patch_node = recording_patch
     agents = _ReactiveAgents(kube, [f"n{i}" for i in range(4)], delay_s=0.2)
     agents.start()
     try:
@@ -263,20 +267,24 @@ def test_partial_launch_rolls_back_slice():
     )
     from tpu_cc_manager.k8s.client import ApiException
 
-    orig = kube.set_node_labels
+    orig = kube.patch_node
 
-    def failing_set(name, labels):
+    def failing_patch(name, patch):
+        labels = (patch.get("metadata") or {}).get("labels") or {}
         if name == "s2" and labels.get(L.CC_MODE_LABEL) == "on":
             raise ApiException(500, "injected patch failure")
-        return orig(name, labels)
+        return orig(name, patch)
 
-    kube.set_node_labels = failing_set
+    kube.patch_node = failing_patch
     report = Rollout(kube, "on", poll_s=0.02, group_timeout_s=5).run()
     assert report.failed == ["slice/s-x"]
     # s1 was patched first, then rolled back to 'off'
-    assert (
-        kube.get_node("s1")["metadata"]["labels"][L.CC_MODE_LABEL] == "off"
-    )
+    meta = kube.get_node("s1")["metadata"]
+    assert meta["labels"][L.CC_MODE_LABEL] == "off"
+    # the aborted launch's trace annotation was cleared by the same
+    # rollback write — later reconciles must not stitch under the dead
+    # rollout's trace id
+    assert L.CC_TRACE_ANNOTATION not in (meta.get("annotations") or {})
 
 
 def test_dry_run_allowed_on_broken_fleet():
@@ -441,6 +449,13 @@ def test_vanished_node_fails_group_fast():
             self._inner.set_node_labels(name, labels)
             self.patched = True
 
+        def patch_node(self, name, patch):
+            # the desired-write verb since ISSUE 8 (label + cc.trace
+            # annotation in one write)
+            result = self._inner.patch_node(name, patch)
+            self.patched = True
+            return result
+
         def __getattr__(self, item):
             return getattr(self._inner, item)
 
@@ -482,6 +497,13 @@ def test_vanished_node_in_pending_group_fails_at_launch():
         def set_node_labels(self, name, labels):
             self._inner.set_node_labels(name, labels)
             self.patched = True
+
+        def patch_node(self, name, patch):
+            # the desired-write verb since ISSUE 8 (label + cc.trace
+            # annotation in one write)
+            result = self._inner.patch_node(name, patch)
+            self.patched = True
+            return result
 
         def __getattr__(self, item):
             return getattr(self._inner, item)
@@ -1260,3 +1282,48 @@ def test_explicit_selector_with_no_record_refuses():
     })
     with pytest.raises(RolloutError, match="no unfinished rollout"):
         Rollout.resume(kube, selector="pool=typo", poll_s=0.05)
+
+
+def test_launch_stamps_trace_context_in_the_same_write():
+    """ISSUE 8 propagation contract at the controller: the desired-mode
+    label and the cc.trace annotation land in ONE patch_node write
+    (zero extra round trips), every member of a group shares one
+    desired_write span's context, and the annotation parses back to
+    that span's ids."""
+    from tpu_cc_manager.trace import parse_traceparent
+
+    kube = FakeKube()
+    _pool(
+        kube,
+        _node("s1", desired="off", state="on", slice_id="s-x"),
+        _node("s2", desired="off", state="on", slice_id="s-x"),
+    )
+    writes = []
+    orig = kube.patch_node
+
+    def recording_patch(name, patch):
+        writes.append((name, patch))
+        return orig(name, patch)
+
+    kube.patch_node = recording_patch
+    # state starts converged to "on" so the group completes instantly
+    report = Rollout(kube, "on", poll_s=0.02, group_timeout_s=5).run()
+    assert report.ok
+    desired_writes = [
+        (n, p) for n, p in writes
+        if L.CC_MODE_LABEL in ((p.get("metadata") or {}).get("labels")
+                               or {})
+    ]
+    assert {n for n, _ in desired_writes} == {"s1", "s2"}
+    contexts = set()
+    for name, patch in desired_writes:
+        meta = patch["metadata"]
+        assert meta["labels"][L.CC_MODE_LABEL] == "on"
+        ctx = meta["annotations"][L.CC_TRACE_ANNOTATION]
+        assert parse_traceparent(ctx) is not None
+        contexts.add(ctx)
+    # one desired_write span per group: both members share its context
+    assert len(contexts) == 1
+    # the annotation landed on the node object itself
+    ann = kube.get_node("s1")["metadata"]["annotations"]
+    assert ann[L.CC_TRACE_ANNOTATION] in contexts

@@ -177,6 +177,30 @@ def test_live_run_with_faults_converges(tmp_path):
     assert "reconcile" in m["phase_p50_s"]
     assert m["throttle"]["histogram"]["count"] > 0
     assert len(art["faults"]) == 6
+    # ---- fleet-timeline stitch (ISSUE 8): ONE trace demonstrably
+    # spans the driver's desired-write and replica reconciles — trace
+    # id equality ACROSS replica boundaries, pinned here
+    st = m["trace_stitch"]
+    assert st["cross_process_traces"] >= 1
+    assert st["e2e_samples"] >= 16  # one per node for the set_mode
+    assert m["e2e_convergence_p99_s"] is not None
+    assert 0 < m["e2e_convergence_p99_s"] < 60
+    tl = st["timeline_example"]
+    assert len({s["trace"] for s in tl}) == 1  # one stitched trace
+    recorders = {s.get("recorder") for s in tl}
+    assert "driver" in recorders and len(recorders) >= 2
+    desired = next(s for s in tl if s["name"] == "desired_write")
+    reconciles = [s for s in tl if s["name"] == "reconcile"]
+    assert reconciles
+    for r in reconciles:
+        assert r["trace"] == desired["trace"]
+        assert r["parent"] == desired["span"]
+        assert r["attrs"]["node"] == r["recorder"]  # replica-side span
+    # the pump-lag measurement lands on pump-delivered reconciles
+    # (repair/restart resubmissions legitimately carry no lag, and may
+    # share the trace — don't require it on every span)
+    lagged = [r for r in reconciles if "pump_lag_s" in r["attrs"]]
+    assert lagged and all(r["attrs"]["pump_lag_s"] >= 0 for r in lagged)
     # artifact writer round-trips
     out = tmp_path / "artifact.json"
     write_artifact(str(out), art)
@@ -198,7 +222,7 @@ def test_pump_relists_through_410_and_delivers(tmp_path):
     delivered = []
 
     class PoolStub:
-        def submit(self, name, value):
+        def submit(self, name, value, trace=None, lag=None):
             delivered.append((name, value))
 
     with FakeApiServer() as server:

@@ -241,3 +241,166 @@ def test_agent_wires_reconcile_spans():
         assert s["trace"] == root["trace"], s
     assert agent.metrics.phase_duration.labels("reconcile").count == 1
     assert agent.metrics.phase_duration.labels("flip").count == 1
+
+
+# ------------------------------------------- cross-process propagation
+
+
+def test_traceparent_roundtrip_across_tracers():
+    """The ISSUE 8 propagation contract: a controller-side span
+    formatted as the cc.trace annotation value re-seats on a DIFFERENT
+    tracer (different process in production), so the consuming
+    reconcile tree carries the producer's trace id."""
+    from tpu_cc_manager.trace import format_traceparent, parse_traceparent
+
+    controller, agent_tr = Tracer(), Tracer()
+    with controller.span("desired_write", mode="on") as dw:
+        context = format_traceparent(dw)  # safe while OPEN
+    assert context == f"00-{dw.trace_id}-{dw.span_id}-01"
+    parsed = parse_traceparent(context)
+    assert (parsed.trace_id, parsed.span_id) == (dw.trace_id, dw.span_id)
+    with agent_tr.adopt_remote(context):
+        with agent_tr.span("reconcile", mode="on") as root:
+            with agent_tr.span("flip") as child:
+                pass
+    assert root.trace_id == dw.trace_id
+    assert root.parent_id == dw.span_id
+    assert child.trace_id == dw.trace_id
+    assert child.parent_id == root.span_id
+
+
+def test_adopt_remote_degrades_on_garbage():
+    """A node annotation is hostile surface: every malformed context
+    yields a LOCAL root, never an exception."""
+    tr = Tracer()
+    for bad in (None, "", "garbage", "00-a-b", "01-a-b-01", "00--b-01",
+                "00-a--01", "00-a-b-01-extra", 42, {"trace": "x"}):
+        with tr.adopt_remote(bad):
+            with tr.span("reconcile") as root:
+                pass
+        assert root.parent_id is None, bad
+        assert root.trace_id == root.span_id
+
+
+def test_tracer_id_prefixes_prevent_cross_process_collisions():
+    """Two tracers (two processes, in production) both mint span #1;
+    a fleet-wide stitch by trace id must not conflate them."""
+    ids = set()
+    for tr in (Tracer(), Tracer(), Tracer()):
+        with tr.span("reconcile") as s:
+            pass
+        ids.add(s.trace_id)
+    assert len(ids) == 3
+
+
+def test_current_trace_ids_join_key_for_logs():
+    from tpu_cc_manager.trace import current_trace_ids
+
+    tr = Tracer()
+    assert current_trace_ids() == (None, None)
+    with tr.span("reconcile") as root:
+        assert current_trace_ids() == (root.trace_id, root.span_id)
+        with tr.span("flip") as child:
+            assert current_trace_ids() == (child.trace_id, child.span_id)
+        assert current_trace_ids() == (root.trace_id, root.span_id)
+    assert current_trace_ids() == (None, None)
+
+
+def test_current_trace_ids_sees_adopted_remote_context():
+    """obs.JsonLogFormatter's key: inside an adopted remote context the
+    active span carries the REMOTE trace id."""
+    from tpu_cc_manager.trace import current_trace_ids
+
+    tr = Tracer()
+    with tr.adopt_remote("00-remotetrace-remotespan-01"):
+        with tr.span("reconcile"):
+            trace_id, _ = current_trace_ids()
+            assert trace_id == "remotetrace"
+
+
+# --------------------------------------------------- JSONL sink bounds
+
+
+def test_jsonl_sink_rotates_at_cap_exactly_one_line_per_span(tmp_path):
+    path = tmp_path / "t.jsonl"
+    tr = Tracer()
+    sink = JsonlSink(str(path), max_bytes=2000)
+    tr.add_sink(sink)
+    for i in range(120):
+        with tr.span("plan", i=i):
+            pass
+    assert sink.rotations >= 1
+    rotated = tmp_path / "t.jsonl.1"
+    assert rotated.exists()
+    # the live file honors the cap (a span line is never split)
+    assert path.stat().st_size <= 2000
+    assert rotated.stat().st_size <= 2000
+    seen = []
+    for f in (rotated, path):
+        for line in f.read_text().splitlines():
+            seen.append(json.loads(line)["attrs"]["i"])  # every line whole
+    # exactly-one-line-per-span within retention: no dup, no tear, the
+    # newest span present, retained window contiguous
+    assert len(seen) == len(set(seen))
+    assert seen[-1] == 119
+    assert seen == list(range(seen[0], 120))
+
+
+def test_jsonl_sink_unbounded_without_cap(tmp_path):
+    path = tmp_path / "t.jsonl"
+    tr = Tracer()
+    tr.add_sink(JsonlSink(str(path), max_bytes=0))
+    for i in range(50):
+        with tr.span("plan", i=i):
+            pass
+    assert len(path.read_text().splitlines()) == 50
+    assert not (tmp_path / "t.jsonl.1").exists()
+
+
+def test_jsonl_cap_env_knob(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_CC_TRACE_JSONL_MAX_MB", "2")
+    assert JsonlSink(str(tmp_path / "a.jsonl")).max_bytes == 2 * 1024 * 1024
+    monkeypatch.setenv("TPU_CC_TRACE_JSONL_MAX_MB", "0.5")
+    assert JsonlSink(str(tmp_path / "b.jsonl")).max_bytes == 512 * 1024
+    # a typo degrades to unbounded (historical behavior), not a crash
+    monkeypatch.setenv("TPU_CC_TRACE_JSONL_MAX_MB", "lots")
+    assert JsonlSink(str(tmp_path / "c.jsonl")).max_bytes == 0
+    monkeypatch.delenv("TPU_CC_TRACE_JSONL_MAX_MB")
+    assert JsonlSink(str(tmp_path / "d.jsonl")).max_bytes == 0
+
+
+def test_jsonl_sink_failed_rotation_does_not_reset_accounting(tmp_path):
+    """A failed os.replace must NOT convince the sink the file is
+    empty — otherwise the file grows by max_bytes per failed attempt
+    while the sink believes it's under the cap."""
+    import os
+
+    path = tmp_path / "t.jsonl"
+    os.mkdir(str(path) + ".1")  # rotation target blocked: replace fails
+    tr = Tracer()
+    sink = JsonlSink(str(path), max_bytes=600)
+    tr.add_sink(sink)
+    for i in range(40):
+        with tr.span("plan", i=i):
+            pass
+    assert sink.rotations == 0  # every attempt failed
+    # no span lost, every line whole (degraded mode keeps appending)
+    lines = path.read_text().splitlines()
+    assert [json.loads(l)["attrs"]["i"] for l in lines] == list(range(40))
+    # the tracked size stayed honest: once over the cap, EVERY further
+    # write re-attempts rotation (it never thinks it reset to zero)
+    assert sink._size >= path.stat().st_size
+
+
+def test_remove_sink_detaches():
+    tr = Tracer()
+    seen = []
+    sink = seen.append
+    tr.add_sink(sink)
+    with tr.span("plan"):
+        pass
+    tr.remove_sink(sink)
+    tr.remove_sink(sink)  # absent: no-op, no raise
+    with tr.span("plan"):
+        pass
+    assert len(seen) == 1

@@ -160,3 +160,40 @@ def test_watcher_fatal_raises_without_handler():
     w.backoff_s = 0.01
     with pytest.raises(FatalWatchError):
         w.run()
+
+
+def test_watcher_latest_trace_context(monkeypatch):
+    """The cc.trace annotation (ISSUE 8) surfaces off the SAME watch
+    event as the desired-label change; missing or non-string values
+    degrade to None."""
+    kube, m, w = _watch_env(label="off")
+    assert w.latest_trace_context() is None  # before the prime read
+    w.prime()
+    assert w.latest_trace_context() is None  # no writer stamped one
+    w.start()
+    try:
+        kube.patch_node("n1", {"metadata": {
+            "labels": {L.CC_MODE_LABEL: "on"},
+            "annotations": {L.CC_TRACE_ANNOTATION: "00-t1-s1-01"},
+        }})
+        got, val = m.get(timeout=5)
+        assert got and val == "on"
+        assert w.latest_trace_context() == "00-t1-s1-01"
+        # newest desired write's context wins (mailbox coalescing)
+        kube.patch_node("n1", {"metadata": {
+            "labels": {L.CC_MODE_LABEL: "off"},
+            "annotations": {L.CC_TRACE_ANNOTATION: "00-t2-s2-01"},
+        }})
+        got, val = m.get(timeout=5)
+        assert got and val == "off"
+        assert w.latest_trace_context() == "00-t2-s2-01"
+        # an UNSTAMPED desired write (operator kubectl): the node still
+        # carries t2's annotation, but this write didn't stamp a fresh
+        # one — adopting it would attribute the new reconcile to the
+        # finished t2 trace. Must degrade to a local root.
+        kube.set_node_labels("n1", {L.CC_MODE_LABEL: "on"})
+        got, val = m.get(timeout=5)
+        assert got and val == "on"
+        assert w.latest_trace_context() is None
+    finally:
+        w.stop()

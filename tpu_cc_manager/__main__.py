@@ -108,7 +108,7 @@ def _stop_on_sigterm(stop_fn) -> None:
 
 def main(argv=None) -> int:
     cfg, args = parse_config(argv)
-    setup_logging(cfg.debug)
+    setup_logging(cfg.debug, fmt=cfg.log_format)
 
     if args.command == "probe-devices":
         # Device inventory; --backend jax (default) asks the live TPU
@@ -433,6 +433,11 @@ def main(argv=None) -> int:
         )
     agent = CCManagerAgent(kube, cfg, slice_coordinator=slice_coordinator)
     _stop_on_sigterm(agent.shutdown)
+    # the black box survives the kill: the SIGTERM dump runs first,
+    # then chains into the clean-shutdown handler installed above
+    from tpu_cc_manager.flightrec import install_sigterm_dump
+
+    install_sigterm_dump(agent.flightrec)
     return agent.run()
 
 

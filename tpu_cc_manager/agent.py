@@ -23,7 +23,8 @@ import queue
 import threading
 import uuid
 import time
-from typing import Optional
+from contextlib import contextmanager
+from typing import Iterator, Optional
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.config import AgentConfig
@@ -32,6 +33,7 @@ from tpu_cc_manager.drain import (
     post_event_best_effort,
 )
 from tpu_cc_manager.engine import FatalModeError, ModeEngine
+from tpu_cc_manager.flightrec import FlightRecorder, set_recorder
 from tpu_cc_manager.k8s.batch import NodePatchBatcher
 from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
@@ -83,6 +85,18 @@ class CCManagerAgent:
         self.tracer.add_sink(self.metrics.observe_span)
         if cfg.trace_file:
             self.tracer.add_sink(JsonlSink(cfg.trace_file))
+        # the per-process black box (flightrec.py, ISSUE 8): recent
+        # spans + structured events + host-contention samples, dumped
+        # on reconcile failure / SIGTERM / GET /debug/flightrec
+        self.flightrec = FlightRecorder(
+            name=cfg.node_name, metrics=self.metrics,
+            dump_dir=cfg.flightrec_dir,
+        )
+        self.tracer.add_sink(self.flightrec.observe_span)
+        # modules that can't take an injected recorder (the batcher's
+        # publish-loss accounting) note into the process-wide one:
+        # point it at this agent's black box
+        set_recorder(self.flightrec)
         self.config_mailbox = SyncableModeConfig(
             on_coalesced=lambda: self.metrics.coalesced_total.inc()
         )
@@ -120,6 +134,7 @@ class CCManagerAgent:
         self.batcher = NodePatchBatcher(
             kube, cfg.node_name,
             tracer=self.tracer,
+            recorder=self.flightrec,
             on_coalesced=(
                 lambda kind: self.metrics
                 .publications_coalesced_total.inc(kind)
@@ -150,6 +165,8 @@ class CCManagerAgent:
             # pool (and through the shared client pool, its warm
             # connections) across reconciles; shutdown() closes it
             persistent_flip_pool=True,
+            # host-contention samples bracket every device flip
+            recorder=self.flightrec,
         )
         self.health: Optional[HealthServer] = None
         self._fatal: Optional[Exception] = None
@@ -452,12 +469,46 @@ class CCManagerAgent:
                 time.sleep(self.watcher.backoff_s)
 
     # ----------------------------------------------------------- reconcile
+    @contextmanager
+    def _reconcile_span(self, raw_mode: str) -> Iterator[object]:
+        """The reconcile root span, seated under the desired-writer's
+        cross-process trace context when the watched node carries one
+        (the cc.trace annotation rides the same write — and therefore
+        the same watch event — as the desired label): ONE trace then
+        spans controller desired-write → watch delivery → drain →
+        flip phases → state publish. A missing/garbled annotation
+        degrades to the historical local root."""
+        with self.tracer.adopt_remote(self.watcher.latest_trace_context()):
+            with self.tracer.span("reconcile", mode=raw_mode) as root:
+                yield root
+
     def reconcile(self, raw_mode: str) -> bool:
         """One mode application, instrumented. Never raises except
         FatalModeError."""
         start = time.monotonic()
         outcome = "error"
-        with self.tracer.span("reconcile", mode=raw_mode) as root_span:
+        try:
+            return self._reconcile_traced(raw_mode, start)
+        finally:
+            # OUTSIDE the span context: the root reconcile span has hit
+            # the sinks (flightrec's ring included) by now, so a
+            # failure dump contains the very reconcile it documents —
+            # outcome attr, duration, and adopted cross-process parent
+            outcome = self.last_outcome or outcome
+            self.flightrec.note(
+                "reconcile", mode=raw_mode, outcome=outcome,
+                dur_s=round(time.monotonic() - start, 4),
+            )
+            if outcome in ("failure", "error", "slice_abort", "fatal"):
+                # the black box leaves the scene of the crash: recent
+                # spans, events, host samples, and a metrics snapshot
+                # land in one JSON artifact (throttled — a flapping
+                # device can't fill the disk)
+                self.flightrec.maybe_dump(f"reconcile_{outcome}")
+
+    def _reconcile_traced(self, raw_mode: str, start: float) -> bool:
+        outcome = "error"
+        with self._reconcile_span(raw_mode) as root_span:
             try:
                 if self.slice_coordinator is not None:
                     ok = self.slice_coordinator.apply_slice_coherent(
@@ -794,7 +845,8 @@ class CCManagerAgent:
         if cfg.health_port:  # 0 disables (SURVEY.md §5.6 table)
             try:
                 self.health = HealthServer(
-                    self.metrics, port=cfg.health_port, tracer=self.tracer
+                    self.metrics, port=cfg.health_port,
+                    tracer=self.tracer, flightrec=self.flightrec,
                 ).start()
             except OSError as e:
                 log.warning("health server disabled: %s", e)

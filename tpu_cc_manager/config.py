@@ -21,6 +21,16 @@ flag                      env                            default
 (none)                    TPU_CC_SLICE_COMMIT_TIMEOUT_S  600 (quorum wait before abort)
 (none)                    REPAIR_INTERVAL_S              30 (0 disables self-repair)
 (none)                    CC_TRACE_FILE                  "" (JSONL span sink off)
+(none)                    TPU_CC_TRACE_JSONL_MAX_MB      0 (size cap on the JSONL span
+                                                        sink; rotates to <path>.1 —
+                                                        0/unset = unbounded)
+(none)                    TPU_CC_LOG_FORMAT              "text" | "json" (JSON records
+                                                        carry the active trace_id/span_id
+                                                        so logs and traces join)
+(none)                    TPU_CC_FLIGHTREC_DIR           "" (flight-recorder dump dir;
+                                                        unset = no dumps written, the
+                                                        /debug/flightrec route still
+                                                        serves the live snapshot)
 (none)                    EMIT_EVENTS                    true (reconcile Events)
 (none)                    TPU_CC_DEVICE_GATING           "chmod" | "none" (device-node gating)
 (none)                    TPU_CC_HOLDER_CHECK            "proc" | "none" (exclusive-hold scan)
@@ -135,6 +145,15 @@ class AgentConfig:
     #: 0 disables.
     repair_interval_s: float = 30.0
     trace_file: Optional[str] = None
+    #: Log record format: "text" (historical) or "json" — JSON records
+    #: carry the active trace_id/span_id (obs.JsonLogFormatter), so
+    #: logs and traces join on one key. TPU_CC_LOG_FORMAT.
+    log_format: str = "text"
+    #: Directory the flight recorder (tpu_cc_manager.flightrec) dumps
+    #: its black-box JSON artifacts into on reconcile failure and
+    #: SIGTERM. None = dumps disabled; the /debug/flightrec route
+    #: serves the live snapshot either way. TPU_CC_FLIGHTREC_DIR.
+    flightrec_dir: Optional[str] = None
     #: Emit core/v1 Events on reconcile outcomes so `kubectl describe
     #: node` shows the mode-flip history (the reference surfaces outcomes
     #: only in labels + pod logs). Best-effort; EMIT_EVENTS=false disables.
@@ -154,6 +173,11 @@ class AgentConfig:
     slice_commit_timeout_s: float = 600.0
 
     def __post_init__(self):
+        if self.log_format not in ("text", "json"):
+            raise ValueError(
+                f"invalid TPU_CC_LOG_FORMAT {self.log_format!r}: "
+                "must be text|json"
+            )
         if self.drain_strategy not in ("components", "node", "none"):
             raise ValueError(
                 f"invalid DRAIN_STRATEGY {self.drain_strategy!r}: "
@@ -441,6 +465,8 @@ def parse_config(argv: Optional[List[str]] = None):
         slice_coordination=_env_bool("SLICE_COORDINATION", False),
         repair_interval_s=float(os.environ.get("REPAIR_INTERVAL_S", "30")),
         trace_file=os.environ.get("CC_TRACE_FILE") or None,
+        log_format=os.environ.get("TPU_CC_LOG_FORMAT", "text") or "text",
+        flightrec_dir=os.environ.get("TPU_CC_FLIGHTREC_DIR") or None,
         emit_events=_env_bool("EMIT_EVENTS", True),
         emit_evidence=_env_bool("TPU_CC_EVIDENCE", True),
         doctor_interval_s=float(

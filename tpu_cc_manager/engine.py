@@ -31,6 +31,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tpu_cc_manager import device as devlayer
+from tpu_cc_manager import flightrec
 from tpu_cc_manager.device.base import DeviceError, TpuChip
 from tpu_cc_manager.device.gate import DeviceGate
 from tpu_cc_manager.device.holders import HolderCheck
@@ -139,6 +140,7 @@ class ModeEngine:
         notify_state_label: Optional[Callable[[str], None]] = None,
         flip_concurrency: Optional[int] = None,
         persistent_flip_pool: bool = False,
+        recorder=None,
     ):
         self._set_state_label = set_state_label
         #: observation-only hook invoked when the state label's WIRE
@@ -171,6 +173,16 @@ class ModeEngine:
         self._persistent_flip_pool = persistent_flip_pool
         self._flip_pool = None
         self._flip_pool_lock = threading.Lock()
+        #: flight recorder whose host-contention sampler brackets every
+        #: device flip (flightrec.py, ISSUE 8 — the sensor ROADMAP item
+        #: 1 needs: was the slow real-chip flip the chip, or the
+        #: host?); None = the process-wide recorder at flip time
+        self._recorder = recorder
+
+    def _flip_recorder(self):
+        """The injected recorder, or the process-wide one — resolved
+        per flip (not cached) so flightrec.set_recorder swaps apply."""
+        return self._recorder or flightrec.get_recorder()
 
     # ---------------------------------------------------------- lifecycle
     def _flip_executor(self):
@@ -484,6 +496,7 @@ class ModeEngine:
             chips, flip_item,
             concurrency=cap, tracer=self._tracer, label_of=path_of,
             executor=self._flip_executor() if cap > 1 else None,
+            recorder=self._flip_recorder(),
         )
         if switches:
             if any(o.status == FAILED for o in outcomes):
@@ -498,6 +511,7 @@ class ModeEngine:
                 outcomes += run_flips(
                     switches, flip_item,
                     concurrency=1, tracer=self._tracer, label_of=path_of,
+                    recorder=self._flip_recorder(),
                 )
         ok = True
         for o in outcomes:
@@ -528,7 +542,9 @@ class ModeEngine:
         node-wide action, the holder check's runtime restart hook, is
         serialized-and-deduped inside HolderCheck (device/holders.py),
         so sibling flips never race on mutable state."""
-        with self._tracer.span(
+        with self._flip_recorder().bracket(
+            f"flip:{dev.path}"
+        ), self._tracer.span(
             "flip", device=dev.path, changes=dict(changes)
         ) as flip_span:
             # access-revocation analog of the reference's driver

@@ -34,9 +34,12 @@ from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.k8s.objects import match_selector
 from tpu_cc_manager.obs import (
     OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
-    kube_throttle_wait_histogram, wire_throttle_observer,
+    kube_throttle_wait_histogram, render_metric_set,
+    wire_throttle_observer,
 )
-from tpu_cc_manager.plan import FleetEncoding, analyze_encoding
+from tpu_cc_manager.plan import (
+    FleetEncoding, analyze_encoding, compile_stats,
+)
 
 #: the shared node-watch pump and its wake filter moved to watch.py
 #: (the watch layer owns delta delivery now that the planner's feature
@@ -203,6 +206,26 @@ class FleetMetrics:
             "Wall-clock duration of one fleet scan",
         )
         self.kube_throttle_wait = kube_throttle_wait_histogram()
+        # planner compile economics (ISSUE 8 satellite): mirrors of
+        # plan.py's monotonic trace/compile-cache counters, refreshed
+        # every scan — the PR-7 "restart = zero cache misses" claim
+        # becomes scrapeable instead of only test-pinned
+        self.planner_retraces = Counter(
+            "tpu_cc_planner_retraces_total",
+            "XLA (re)traces of planner kernels since process start, "
+            "per kernel (steady state: one per shape bucket, ever)",
+            ("kernel",),
+        )
+        self.planner_cache_hits = Counter(
+            "tpu_cc_planner_compile_cache_hits_total",
+            "Planner compiles served from the persistent compile "
+            "cache (TPU_CC_COMPILE_CACHE_DIR)",
+        )
+        self.planner_cache_misses = Counter(
+            "tpu_cc_planner_compile_cache_misses_total",
+            "Planner compiles that missed the persistent compile "
+            "cache (cold XLA paid; a warmed restart should add zero)",
+        )
 
     def update(self, report: dict) -> None:
         self.nodes.set(report["nodes"])
@@ -229,18 +252,16 @@ class FleetMetrics:
         self.doctor_unreported.set(
             len(report.get("doctor", {}).get("unreported", []))
         )
+        stats = compile_stats()
+        for kernel, n in stats["retraces"].items():
+            self.planner_retraces.set_total(n, kernel)
+        self.planner_cache_hits.set_total(stats["cache_hits"])
+        self.planner_cache_misses.set_total(stats["cache_misses"])
 
     def render(self) -> str:
-        lines: List[str] = []
-        for m in (
-            self.nodes, self.nodes_by_mode, self.needs_flip, self.failed,
-            self.incoherent_slices, self.half_flipped_slices,
-            self.evidence_issues, self.doctor_failing,
-            self.doctor_unreported, self.scans_total,
-            self.scan_duration, self.kube_throttle_wait,
-        ):
-            lines.extend(m.render())
-        return "\n".join(lines) + "\n"
+        # reflection over every metric attribute (obs.registered_metrics):
+        # adding a gauge above can no longer silently miss exposition
+        return render_metric_set(self)
 
 
 class FleetController:

@@ -48,7 +48,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import ExitStack
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Sequence, TypeVar
+from typing import Any, Callable, List, Optional, Sequence, TypeVar
 
 from tpu_cc_manager.device.base import DeviceError
 from tpu_cc_manager.trace import Tracer
@@ -119,6 +119,23 @@ class FlipOutcome:
     exception: Optional[BaseException] = None
 
 
+def _note_failures(outcomes: Sequence[FlipOutcome],
+                   recorder: Optional[Any]) -> None:
+    """Record every non-OK item disposition in the flight recorder
+    (flightrec.py, ISSUE 8): after a multi-chip failure the black box
+    answers "which device failed, and which siblings were skipped vs
+    ran to completion" without correlating log lines. OK items stay
+    out of the ring — failures are the signal."""
+    if recorder is None:
+        return
+    for o in outcomes:
+        if o.status != OK:
+            recorder.note(
+                "flip_item", device=o.label, status=o.status,
+                error=o.error,
+            )
+
+
 def _reraise_unexpected(outcomes: Sequence[FlipOutcome]) -> None:
     """Re-raise the first (in item order) non-DeviceError exception.
 
@@ -140,6 +157,7 @@ def run_flips(
     tracer: Tracer,
     label_of: Callable[[T], str],
     executor: Optional[ThreadPoolExecutor] = None,
+    recorder: Optional[Any] = None,
 ) -> List[FlipOutcome]:
     """Run ``flip_one`` over ``items`` with bounded concurrency.
 
@@ -183,6 +201,7 @@ def run_flips(
             outcomes.append(out)
             if out.status != OK:
                 aborted = True
+        _note_failures(outcomes, recorder)
         _reraise_unexpected(outcomes)
         return outcomes
 
@@ -211,5 +230,6 @@ def run_flips(
         futures = [pool.submit(worker, item) for item in items]
         # .result() outside any lock by design — see the module docstring
         outcomes = [f.result() for f in futures]
+    _note_failures(outcomes, recorder)
     _reraise_unexpected(outcomes)
     return outcomes

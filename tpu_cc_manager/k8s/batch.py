@@ -55,6 +55,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_cc_manager import labels as L
+from tpu_cc_manager.flightrec import get_recorder
 from tpu_cc_manager.trace import Tracer, get_tracer
 
 log = logging.getLogger("tpu-cc-manager.k8s.batch")
@@ -103,6 +104,7 @@ class NodePatchBatcher:
         on_coalesced: Optional[Callable[[str], None]] = None,
         on_retry: Optional[Callable[[str], None]] = None,
         on_drop: Optional[Callable[[str], None]] = None,
+        recorder=None,
     ):
         self.kube = kube
         self.node_name = node_name
@@ -111,6 +113,12 @@ class NodePatchBatcher:
         self._on_coalesced = on_coalesced
         self._on_retry = on_retry
         self._on_drop = on_drop
+        #: flight recorder the publish-loss events note into; None =
+        #: the process-wide one at event time (the agent points that at
+        #: its own black box via flightrec.set_recorder; simlab
+        #: replicas inject theirs — a per-replica batcher noting into
+        #: the process default would be invisible to the fleet stitch)
+        self._recorder = recorder
         self._lock = threading.Lock()
         self._pending: Dict[str, _Pending] = {}
         self._gen_seq: Dict[str, int] = {}
@@ -384,6 +392,14 @@ class NodePatchBatcher:
             "publish flush for %s failed (%s); retrying %s in %.1fs%s",
             self.node_name, exc, retried, backoff,
             f"; DROPPED after retry budget: {dropped}" if dropped else "",
+        )
+        # the black box keeps the loss accounting next to the spans it
+        # explains: a dump after a publish storm shows WHICH keys were
+        # retried/dropped, not just the counters' totals
+        (self._recorder or get_recorder()).note(
+            "publish_flush_failed", node=self.node_name,
+            error=f"{type(exc).__name__}: {exc}", retried=retried,
+            dropped=dropped, backoff_s=round(backoff, 2),
         )
         for key in retried:
             if self._on_retry is not None:

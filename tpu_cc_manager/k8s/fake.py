@@ -224,19 +224,28 @@ class FakeKube(KubeClient):
         real API server; the token encodes the resume position."""
         return _paginate(self.list_nodes(label_selector), limit, cont)
 
-    def set_node_labels_direct(self, name: str,
-                               labels: Dict[str, Optional[str]]) -> dict:
+    def set_node_labels_direct(
+        self, name: str,
+        labels: Dict[str, Optional[str]],
+        annotations: Optional[Dict[str, Optional[str]]] = None,
+    ) -> dict:
         """Operator hand-of-god label write for scenario/bench drivers:
         bypasses write-fault injection and the write accounting (it is
         the scenario's INPUT, not system-under-test traffic) while
         still bumping the resourceVersion and emitting a watch event
         like any real write — a driver that wrote through the faulted
-        path would soak the very storm it scripted."""
+        path would soak the very storm it scripted. ``annotations``
+        ride the same write (the simlab driver stamps its cc.trace
+        context exactly like a real controller: in ONE write with the
+        desired label)."""
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
                 raise ApiException(404, f"node {name} not found")
-            merged = merge_patch(node, {"metadata": {"labels": labels}})
+            meta: Dict[str, object] = {"labels": labels}
+            if annotations:
+                meta["annotations"] = annotations
+            merged = merge_patch(node, {"metadata": meta})
             merged["metadata"]["name"] = name
             self._nodes[name] = merged
             self._bump(merged)

@@ -92,6 +92,17 @@ DOCTOR_OK_LABEL = "tpu.google.com/cc.doctor.ok"
 #: state alone.
 ROLLOUT_ANNOTATION = "tpu.google.com/cc.rollout"
 
+#: Cross-process trace context (tpu_cc_manager.trace, ISSUE 8): a
+#: W3C-traceparent-style string ("00-<trace>-<span>-01") stamped by
+#: whoever WRITES the desired-mode label — the rollout driver, the
+#: policy controller, or the simlab driver — in the SAME node write as
+#: the label itself (zero extra round trips). The agent's watch
+#: surfaces it and the reconcile adopts it, so one trace id spans
+#: desired-write → watch delivery → flip → state publish across
+#: process boundaries. Observability only: never parsed for control
+#: decisions, and a missing/garbled value degrades to a local trace.
+CC_TRACE_ANNOTATION = "tpu.google.com/cc.trace"
+
 #: Node taint held for the duration of a mode flip so the *scheduler* —
 #: not just the component pause labels — keeps new TPU work off a node
 #: whose devices are gated mid-flip. Cleared when the flip cycle ends

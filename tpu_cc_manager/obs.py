@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import sys
 import threading
 import time
@@ -30,9 +31,54 @@ from tpu_cc_manager.modes import STATE_FAILED, VALID_MODES
 OBSERVED_MODE_VALUES = VALID_MODES + (STATE_FAILED, "unknown")
 
 
-def setup_logging(debug: bool = False) -> None:
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per log record, carrying the ACTIVE trace/span
+    ids (trace.current_trace_ids) so logs and traces join on one key —
+    a reconcile's log lines and its span tree share a trace_id whether
+    the trace was minted locally or adopted from a controller's
+    desired-write annotation."""
+
+    # the "Z" suffix below claims UTC — render in UTC (the Formatter
+    # default is localtime, which would lie by the host's TZ offset)
+    converter = time.gmtime
+
+    def format(self, record: logging.LogRecord) -> str:
+        from tpu_cc_manager import trace as _trace
+
+        out: Dict[str, object] = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S")
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        trace_id, span_id = _trace.current_trace_ids()
+        if trace_id is not None:
+            out["trace_id"] = trace_id
+            out["span_id"] = span_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def setup_logging(debug: bool = False, fmt: Optional[str] = None) -> None:
     """Timestamped structured-ish logs (reference main.py:54-59 format,
-    --debug escalation main.py:726-734)."""
+    --debug escalation main.py:726-734). ``fmt="json"``
+    (``TPU_CC_LOG_FORMAT=json``) switches every record to one JSON
+    object carrying the current trace_id/span_id — the opt-in that
+    makes logs greppable by the same key the trace sinks and the
+    flight recorder index on."""
+    if fmt is None:
+        fmt = os.environ.get("TPU_CC_LOG_FORMAT", "text")
+    if fmt == "json":
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(JsonLogFormatter())
+        root = logging.getLogger()
+        for old in list(root.handlers):
+            root.removeHandler(old)
+        root.addHandler(handler)
+        root.setLevel(logging.DEBUG if debug else logging.INFO)
+        return
     logging.basicConfig(
         level=logging.DEBUG if debug else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -57,6 +103,15 @@ class Counter:
         key = tuple(label_values)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
+
+    def set_total(self, value: float, *label_values: str) -> None:
+        """Mirror an EXTERNAL monotonic total into this counter (the
+        planner's retrace/compile-cache counts are owned by plan.py's
+        module counters; the scrape-side Counter just republishes
+        them). The source must be monotonic — that is what keeps the
+        exposition honest as TYPE counter."""
+        with self._lock:
+            self._values[tuple(label_values)] = float(value)
 
     def value(self, *label_values: str) -> float:
         return self._values.get(tuple(label_values), 0.0)
@@ -222,6 +277,29 @@ def wire_throttle_observer(kube, hist: Histogram) -> None:
         kube.add_throttle_observer(hist.observe)
 
 
+def registered_metrics(obj: object) -> List[object]:
+    """Every metric-primitive attribute of a metric-set object, in
+    definition (``__init__`` assignment) order — the registry
+    :func:`render_metric_set` renders. Reflection, not a hand list:
+    a metric you can construct is a metric you expose; forgetting to
+    add it to a render list is no longer a possible bug
+    (tests/test_config_obs.py pins this for every metric set)."""
+    return [
+        v for v in vars(obj).values()
+        if isinstance(v, (Counter, Gauge, Histogram, HistogramVec))
+    ]
+
+
+def render_metric_set(obj: object) -> str:
+    """Full Prometheus text exposition of every metric attribute of
+    ``obj`` — the one render path shared by the agent's Metrics and
+    both controllers' metric sets."""
+    lines: List[str] = []
+    for m in registered_metrics(obj):
+        lines.extend(m.render())  # type: ignore[attr-defined]
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -343,23 +421,154 @@ class Metrics:
             self.current_mode.set(1.0 if m == mode else 0.0, m)
 
     def render(self) -> str:
-        lines: List[str] = []
-        for m in (
-            self.reconciles_total,
-            self.reconcile_duration,
-            self.watch_errors_total,
-            self.current_mode,
-            self.coalesced_total,
-            self.repairs_total,
-            self.events_emitted_total,
-            self.events_dropped_total,
-            self.publications_coalesced_total,
-            self.publish_retries_total,
-            self.publications_dropped_total,
-            self.phase_duration,
-        ):
-            lines.extend(m.render())
-        return "\n".join(lines) + "\n"
+        # reflection over every metric attribute (registered_metrics):
+        # a forgotten hand-list entry used to make a metric vanish
+        # silently from /metrics
+        return render_metric_set(self)
+
+
+# --------------------------------------------------------------------------
+# exposition-format validation
+# --------------------------------------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\["\\n])*)"'
+)
+
+
+def _base_name(name: str) -> str:
+    """Histogram series collapse onto their family name."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Strict structural check of a Prometheus text-format exposition;
+    returns the list of problems (empty = valid). The bug classes it
+    exists for: duplicate HELP/TYPE (two metric sets both declaring a
+    shared family), broken label escaping (a raw quote/newline in a
+    label value splits the line), duplicate series (same name+labels
+    twice — undefined scrape behavior), and non-monotone histogram
+    buckets (cumulative counts must never decrease with rising ``le``
+    and ``+Inf`` must equal ``_count``). CI runs this against every
+    live /metrics surface in the process smoke; the unit tests run it
+    against each metric set's render."""
+    problems: List[str] = []
+    helps: Dict[str, int] = {}
+    types: Dict[str, str] = {}
+    series_seen: Dict[Tuple[str, str], int] = {}
+    # (family, non-le labelset) -> [(le, cumulative)]
+    buckets: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
+    counts: Dict[Tuple[str, str], float] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind = line[2:6]
+            rest = line[7:]
+            name = rest.split(" ", 1)[0]
+            if not _METRIC_NAME_RE.fullmatch(name):
+                problems.append(f"line {i}: bad metric name {name!r}")
+                continue
+            if kind == "HELP":
+                if name in helps:
+                    problems.append(
+                        f"line {i}: duplicate HELP for {name} "
+                        f"(first at line {helps[name]})"
+                    )
+                helps[name] = i
+            else:
+                mtype = rest.split(" ", 1)[1] if " " in rest else ""
+                if name in types:
+                    problems.append(f"line {i}: duplicate TYPE for {name}")
+                if mtype not in ("counter", "gauge", "histogram",
+                                 "summary", "untyped"):
+                    problems.append(
+                        f"line {i}: unknown TYPE {mtype!r} for {name}")
+                types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue  # plain comment
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name, raw_labels = m.group("name"), m.group("labels")
+        try:
+            value_f: Optional[float] = float(m.group("value"))
+        except ValueError:
+            value_f = None
+            problems.append(f"line {i}: non-numeric value in {line!r}")
+        labels: Dict[str, str] = {}
+        if raw_labels:
+            for lm in _LABEL_RE.finditer(raw_labels):
+                labels[lm.group("key")] = lm.group("value")
+            # whatever the pair regex didn't consume must be separators
+            leftover = _LABEL_RE.sub(
+                "", raw_labels).replace(",", "").strip()
+            if leftover or (not labels and raw_labels):
+                problems.append(
+                    f"line {i}: malformed/unescaped labels {raw_labels!r}"
+                )
+        family = _base_name(name)
+        declared = types.get(family) or types.get(name)
+        if declared is None:
+            problems.append(
+                f"line {i}: sample {name} precedes/lacks its TYPE")
+        non_le = ",".join(
+            f"{k}={v}" for k, v in sorted(labels.items()) if k != "le"
+        )
+        key = (name, ",".join(
+            f"{k}={v}" for k, v in sorted(labels.items())))
+        if key in series_seen:
+            problems.append(
+                f"line {i}: duplicate series {name}{{{key[1]}}} "
+                f"(first at line {series_seen[key]})"
+            )
+        series_seen[key] = i
+        if value_f is None:
+            continue  # already reported; nothing numeric to account
+        if name.endswith("_bucket") and "le" in labels:
+            # hostile input by definition here — a bad le is a problem
+            # entry, never a crash (the validator's whole contract)
+            if labels["le"] == "+Inf":
+                le = float("inf")
+            else:
+                try:
+                    le = float(labels["le"])
+                except ValueError:
+                    problems.append(
+                        f"line {i}: non-numeric le {labels['le']!r}")
+                    continue
+            buckets.setdefault((family, non_le), []).append((le, value_f))
+        elif name.endswith("_count") and types.get(family) == "histogram":
+            counts[(family, non_le)] = value_f
+    for (family, labelset), seq in buckets.items():
+        cum = None
+        for le, value in seq:  # render order == le order by contract
+            if cum is not None and value < cum:
+                problems.append(
+                    f"{family}{{{labelset}}}: bucket counts decrease "
+                    f"at le={le} ({value} < {cum})"
+                )
+            cum = value
+        if seq and seq[-1][0] != float("inf"):
+            problems.append(f"{family}{{{labelset}}}: no +Inf bucket")
+        total = counts.get((family, labelset))
+        if seq and total is not None and seq[-1][1] != total:
+            problems.append(
+                f"{family}{{{labelset}}}: +Inf bucket {seq[-1][1]} != "
+                f"_count {total}"
+            )
+    return problems
 
 
 # --------------------------------------------------------------------------
@@ -443,16 +652,19 @@ class RouteServer:
 
 
 class HealthServer(RouteServer):
-    def __init__(self, metrics: Metrics, port: int = 0, tracer=None):
+    def __init__(self, metrics: Metrics, port: int = 0, tracer=None,
+                 flightrec=None):
         super().__init__(port, name="health-server")
         self.metrics = metrics
         self.tracer = tracer
+        self.flightrec = flightrec
         self.live = True
         self.ready = False
         self.add_route("/healthz", self._healthz)
         self.add_route("/readyz", self._readyz)
         self.add_route("/metrics", self._metrics)
         self.add_route("/debug/traces", self._traces)
+        self.add_route("/debug/flightrec", self._flightrec)
 
     def _healthz(self):
         return ((200, b"ok", "text/plain") if self.live
@@ -469,6 +681,18 @@ class HealthServer(RouteServer):
         if self.tracer is None:
             return 404, b"tracing not wired", "text/plain"
         body = json.dumps(self.tracer.recent(), indent=1).encode()
+        return 200, body, "application/json"
+
+    def _flightrec(self):
+        """On-demand black-box snapshot (no file written): the live
+        equivalent of the failure/SIGTERM dump, for a stuck-but-alive
+        agent an operator is staring at."""
+        if self.flightrec is None:
+            return 404, b"flight recorder not wired", "text/plain"
+        body = json.dumps(
+            self.flightrec.snapshot("debug_get"), indent=1,
+            sort_keys=True,
+        ).encode()
         return 200, body, "application/json"
 
 

@@ -68,7 +68,7 @@ from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.modes import InvalidModeError, parse_mode
 from tpu_cc_manager.obs import (
     Counter, Gauge, Histogram, RouteServer, kube_throttle_wait_histogram,
-    wire_throttle_observer,
+    render_metric_set, wire_throttle_observer,
 )
 from tpu_cc_manager.plan import analyze_pools
 from tpu_cc_manager.rollout import (
@@ -257,12 +257,9 @@ class PolicyMetrics:
             self.by_phase.set(counts.get(phase, 0), phase)
 
     def render(self) -> str:
-        lines: List[str] = []
-        for m in (self.policies, self.by_phase, self.rollouts,
-                  self.active_rollouts, self.scans, self.scan_duration,
-                  self.kube_throttle_wait):
-            lines.extend(m.render())
-        return "\n".join(lines) + "\n"
+        # reflection over every metric attribute (obs.registered_metrics):
+        # the hand-maintained list is gone from all three metric sets
+        return render_metric_set(self)
 
 
 class PolicyController:

@@ -65,6 +65,7 @@ from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.modes import parse_mode
 from tpu_cc_manager.plan import analyze_fleet
+from tpu_cc_manager.trace import format_traceparent, get_tracer
 
 log = logging.getLogger("tpu-cc-manager.rollout")
 
@@ -1010,25 +1011,47 @@ class Rollout:
         """
         log.info("launching group %s (%s) -> %r", gname, members, self.mode)
         patched: List[str] = []
-        for m in members:
-            try:
-                self.kube.set_node_labels(m, {L.CC_MODE_LABEL: self.mode})
-                patched.append(m)
-            except ApiException as e:
-                log.error("could not label %s: %s", m, e)
-                for p in patched:
-                    prev = by_name[p]["metadata"].get("labels", {}).get(
-                        L.CC_MODE_LABEL
-                    )
-                    try:
-                        self.kube.set_node_labels(
-                            p, {L.CC_MODE_LABEL: prev}
+        # ONE desired-write span per group: its traceparent rides the
+        # cc.trace annotation in the SAME patch as the desired label
+        # (zero extra round trips), so every member agent's reconcile
+        # adopts this trace and the group's whole desired-write →
+        # state-publish story stitches under one trace id (ISSUE 8)
+        with get_tracer().span(
+            "desired_write", group=gname, mode=self.mode,
+            nodes=len(members),
+        ) as span:
+            context = format_traceparent(span)
+            for m in members:
+                try:
+                    self.kube.patch_node(m, {"metadata": {
+                        "labels": {L.CC_MODE_LABEL: self.mode},
+                        "annotations": {L.CC_TRACE_ANNOTATION: context},
+                    }})
+                    patched.append(m)
+                except ApiException as e:
+                    log.error("could not label %s: %s", m, e)
+                    for p in patched:
+                        prev = by_name[p]["metadata"].get("labels", {}).get(
+                            L.CC_MODE_LABEL
                         )
-                    except ApiException as e2:  # best effort; keep going
-                        log.error(
-                            "rollback of %s to %r failed: %s", p, prev, e2
-                        )
-                return False
+                        try:
+                            # revert the label AND clear the aborted
+                            # launch's trace annotation in one write —
+                            # the rollback's own reconcile (and later
+                            # self-repairs) must not keep stitching
+                            # under the dead rollout's trace id
+                            self.kube.patch_node(p, {"metadata": {
+                                "labels": {L.CC_MODE_LABEL: prev},
+                                "annotations": {
+                                    L.CC_TRACE_ANNOTATION: None,
+                                },
+                            }})
+                        except ApiException as e2:  # best effort
+                            log.error(
+                                "rollback of %s to %r failed: %s",
+                                p, prev, e2,
+                            )
+                    return False
         return True
 
     def _judge_group(

@@ -78,16 +78,26 @@ class FaultInjector:
                 replica.restart()
                 self.restarted_total += 1
                 # the restarted agent's prime read: desired comes from
-                # the cluster, not from anything the dead process held
+                # the cluster, not from anything the dead process held.
+                # The cc.trace annotation rides the same node object
+                # (ISSUE 8), so a post-crash reconcile still joins the
+                # desired write's fleet-wide trace — exactly what the
+                # real agent's NodeWatcher.prime + latest_trace_context
+                # does after a DaemonSet restart.
                 try:
                     node = self.ops_kube.get_node(name)
-                    desired = (node["metadata"].get("labels") or {}).get(
+                    meta = node["metadata"]
+                    desired = (meta.get("labels") or {}).get(
                         L.CC_MODE_LABEL
+                    )
+                    trace = (meta.get("annotations") or {}).get(
+                        L.CC_TRACE_ANNOTATION
                     )
                 except ApiException:
                     desired = None
+                    trace = None
                 if desired is not None:
-                    self.pool.submit(name, desired)
+                    self.pool.submit(name, desired, trace=trace)
                 else:
                     self.pool.requeue(name)  # drain anything it missed
 

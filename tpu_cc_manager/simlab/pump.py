@@ -74,6 +74,12 @@ class WatchPump:
         #: last desired value delivered downstream per node (the
         #: NodeWatcher._last_value dedup, fleet-wide)
         self._last: Dict[str, Optional[str]] = {}
+        #: cc.trace annotation seen at each node's last desired CHANGE
+        #: (the NodeWatcher freshness rule, fleet-wide): a new desired
+        #: write only carries a trace if its writer stamped a FRESH
+        #: context — an unstamped write must not inherit a finished
+        #: write's annotation
+        self._last_ctx: Dict[str, Optional[str]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # counters (monotonic; read for the artifact)
@@ -87,25 +93,34 @@ class WatchPump:
         self._lag_lock = threading.Lock()
 
     # ------------------------------------------------------------ plumbing
-    def _observe_lag(self, node: str, value) -> None:
+    def _observe_lag(self, node: str, value) -> Optional[float]:
         t = self.stamps.take(node, value)
         if t is None:
-            return
+            return None
         lag = time.monotonic() - t
         self.lag_hist.observe(lag)
         with self._lag_lock:
             self.lag_samples.append(lag)
+        return lag
 
-    def _deliver(self, node: str, value) -> None:
+    def _deliver(self, node: str, value, trace: Optional[str] = None) -> None:
         if value == self._last.get(node):
             self.echo_filtered_total += 1
             return
         self._last[node] = value
-        self._observe_lag(node, value)
+        fresh = trace if trace != self._last_ctx.get(node) else None
+        # ccaudit: allow-race-lockset(_deliver runs only on the pump thread after start(); prime() writes happen-before — same single-writer contract as _last)
+        self._last_ctx[node] = trace
+        lag = self._observe_lag(node, value)
         if value is None:
             return  # label removed: nothing to reconcile (no default)
         self.delivered_total += 1
-        self.pool.submit(node, value)
+        # the desired-writer's cc.trace context and this delivery's
+        # measured pump lag travel WITH the value: the replica adopts
+        # the trace and stamps the lag as a span attribute, so the
+        # fleet-wide lag distribution also lands on the right spans.
+        # Only a FRESHLY-stamped context rides (see _last_ctx)
+        self.pool.submit(node, value, trace=fresh, lag=lag)
 
     def prime(self) -> None:
         """Initial LIST: seed per-node last values + the resume rv
@@ -120,6 +135,13 @@ class WatchPump:
                 self._last[name] = (n["metadata"].get("labels") or {}).get(
                     L.CC_MODE_LABEL
                 )
+                # seed the freshness baseline too: an annotation already
+                # on the node at prime must not look freshly stamped
+                # when the first unstamped desired change arrives
+                # ccaudit: allow-race-lockset(prime() runs before start() — same happens-before as _last above)
+                self._last_ctx[name] = (
+                    n["metadata"].get("annotations") or {}
+                ).get(L.CC_TRACE_ANNOTATION)
             rv = max(rv, int(n["metadata"].get("resourceVersion") or 0))
         # ccaudit: allow-race-lockset(prime() runs before start() — same happens-before as _last above)
         self._rv = str(rv) if rv else None
@@ -149,6 +171,8 @@ class WatchPump:
                     name,
                     (n["metadata"].get("labels") or {}).get(
                         L.CC_MODE_LABEL),
+                    trace=(n["metadata"].get("annotations") or {}).get(
+                        L.CC_TRACE_ANNOTATION),
                 )
         self._rv = str(rv) if rv else None
 
@@ -178,6 +202,8 @@ class WatchPump:
                     self._deliver(
                         name,
                         (meta.get("labels") or {}).get(L.CC_MODE_LABEL),
+                        trace=(meta.get("annotations") or {}).get(
+                            L.CC_TRACE_ANNOTATION),
                     )
                     if self._stop.is_set():
                         return

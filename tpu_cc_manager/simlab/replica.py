@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
+from tpu_cc_manager.flightrec import FlightRecorder
 from tpu_cc_manager.k8s.batch import NodePatchBatcher
 from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 
@@ -62,19 +63,34 @@ class ReplicaShell:
         self.evidence = evidence
         # the write-coalescing layer (k8s.batch): the state-label write
         # is the replica's carrier — it transports the PREVIOUS
+        # this replica's flight recording (ISSUE 8): small rings — the
+        # runner collects every replica's snapshot after the run and
+        # stitches them fleet-wide by trace id. The shared tracer can't
+        # be sinked per replica, so the reconcile root spans are
+        # recorded explicitly in _reconcile.
+        self.recorder = FlightRecorder(
+            name=node_name, span_ring=64, event_ring=64, sample_ring=32,
+        )
         # reconcile's deferred evidence, so a flip costs one write, not
-        # two. The runner's settle pass flushes stragglers.
-        self.batcher = NodePatchBatcher(kube, node_name)
+        # two. The runner's settle pass flushes stragglers. Publish-loss
+        # events note into THIS replica's recorder (not the process
+        # default), so a write-storm's retried/dropped keys reach the
+        # collected recordings.
+        self.batcher = NodePatchBatcher(kube, node_name,
+                                        recorder=self.recorder)
         self.engine = ModeEngine(
             set_state_label=self.batcher.write_state_label,
             drainer=NullDrainer(),
             evict_components=False,
             backend=backend,
             tracer=tracer,
+            recorder=self.recorder,
         )
         self._tracer = tracer
         self._lock = threading.Lock()
         self._pending = _EMPTY
+        self._pending_trace: Optional[str] = None
+        self._pending_lag: Optional[float] = None
         self._queued = False
         self.alive = True
         self.applied: Optional[str] = None
@@ -83,7 +99,8 @@ class ReplicaShell:
         self.outcomes: Dict[str, int] = {}
         self.repairs = 0
         self.coalesced = 0
-        self._resubmit: Optional[Callable[[str, str], None]] = None
+        self._resubmit: Optional[
+            Callable[[str, str, Optional[str]], None]] = None
         self._timers: List[threading.Timer] = []
         #: evidence generation bookkeeping (the agent's
         #: _evidence_published_gen analog, scaled down): wanted >
@@ -93,15 +110,20 @@ class ReplicaShell:
         self.evidence_published_gen = 0
 
     # ------------------------------------------------------------ mailbox
-    def offer(self, value: str) -> bool:
+    def offer(self, value: str, trace: Optional[str] = None,
+              lag: Optional[float] = None) -> bool:
         """Last-value-wins mailbox write. Returns True when the caller
         should enqueue this replica on the worker queue (not already
         queued, and alive — a crashed replica keeps the pending value
-        for its restart to pick up)."""
+        for its restart to pick up). ``trace``/``lag`` ride the value
+        (and coalesce with it — the newest desired write's trace owns
+        the reconcile, exactly the real agent's contract)."""
         with self._lock:
             if self._pending is not _EMPTY and self._pending != value:
                 self.coalesced += 1  # overwritten unread value
             self._pending = value
+            self._pending_trace = trace
+            self._pending_lag = lag
             if self._queued or not self.alive:
                 return False
             self._queued = True
@@ -116,37 +138,54 @@ class ReplicaShell:
                     self._queued = False
                     break
                 value = self._pending
+                trace, lag = self._pending_trace, self._pending_lag
                 self._pending = _EMPTY
-            self._reconcile(value)
+                self._pending_trace = self._pending_lag = None
+            self._reconcile(value, trace, lag)
         # mailbox drained: flush any deferred publication that found no
         # carrier write (respects the batcher's flush window/backoff) —
         # the replica's idle-tick analog
         self.batcher.maybe_flush()
 
     # ---------------------------------------------------------- reconcile
-    def _reconcile(self, mode: str) -> None:
+    def _reconcile(self, mode: str, trace: Optional[str] = None,
+                   lag: Optional[float] = None) -> None:
         outcome = "error"
         ok = False
-        with self._tracer.span("reconcile", mode=mode) as root:
-            try:
-                ok = self.engine.set_mode(mode)
-                outcome = "success" if ok else "failure"
-            except InvalidModeError as e:
-                log.error("%s: rejecting desired mode: %s",
-                          self.node_name, e)
-                self._publish_failed()
-                outcome = "invalid"
-            except FatalModeError as e:
-                # the DaemonSet-restart analog: this replica is down
-                # until a scripted restart brings it back
-                log.error("%s: fatal: %s", self.node_name, e)
-                with self._lock:
-                    self.alive = False
-                outcome = "fatal"
-            except Exception:
-                log.exception("%s: reconcile crashed", self.node_name)
-                self._publish_failed()
-            root.attrs["outcome"] = outcome
+        # adopt the desired-writer's trace context (simlab driver or
+        # policy-driven rollout): this replica's reconcile tree joins
+        # the fleet-wide trace the runner stitches by trace id
+        with self._tracer.adopt_remote(trace):
+            with self._tracer.span(
+                "reconcile", mode=mode, node=self.node_name
+            ) as root:
+                if lag is not None:
+                    # the pump-lag measurement lands on the span it
+                    # belongs to, not only a disembodied histogram
+                    root.attrs["pump_lag_s"] = round(lag, 6)
+                try:
+                    ok = self.engine.set_mode(mode)
+                    outcome = "success" if ok else "failure"
+                except InvalidModeError as e:
+                    log.error("%s: rejecting desired mode: %s",
+                              self.node_name, e)
+                    self._publish_failed()
+                    outcome = "invalid"
+                except FatalModeError as e:
+                    # the DaemonSet-restart analog: this replica is down
+                    # until a scripted restart brings it back
+                    log.error("%s: fatal: %s", self.node_name, e)
+                    with self._lock:
+                        self.alive = False
+                    outcome = "fatal"
+                except Exception:
+                    log.exception("%s: reconcile crashed", self.node_name)
+                    self._publish_failed()
+                root.attrs["outcome"] = outcome
+        # the root span is closed (dur_s final) — record it in this
+        # replica's black box for the runner's fleet-timeline stitch
+        self.recorder.observe_span(root)
+        self.recorder.note("reconcile", mode=mode, outcome=outcome)
         self.reconciles += 1
         self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
         if ok:
@@ -154,7 +193,7 @@ class ReplicaShell:
             if self.evidence:
                 self._defer_evidence()
         elif outcome in ("failure", "error"):
-            self._arm_repair(mode)
+            self._arm_repair(mode, trace)
 
     def _defer_evidence(self) -> None:
         """Build this node's evidence document and hand it to the
@@ -194,10 +233,12 @@ class ReplicaShell:
             log.warning("%s: could not publish failed state",
                         self.node_name)
 
-    def _arm_repair(self, mode: str) -> None:
+    def _arm_repair(self, mode: str, trace: Optional[str] = None) -> None:
         """Requeue a retryable failure after a short delay, like the
         agent's idle-tick self-repair — a label event will never come
-        to retry it (the desired label is already correct)."""
+        to retry it (the desired label is already correct). The failed
+        round's trace context rides the retry: the repair is still
+        part of the same desired-write's story."""
         if self._resubmit is None or self.repairs >= self.MAX_REPAIRS:
             return
         self.repairs += 1
@@ -206,7 +247,7 @@ class ReplicaShell:
             with self._lock:
                 if not self.alive or self._pending is not _EMPTY:
                     return  # newer work already queued
-            self._resubmit(self.node_name, mode)
+            self._resubmit(self.node_name, mode, trace)
 
         t = threading.Timer(self.REPAIR_DELAY_S, fire)
         t.daemon = True
@@ -254,11 +295,13 @@ class WorkerPool:
             self._threads.append(t)
         return self
 
-    def submit(self, name: str, value: str) -> None:
+    def submit(self, name: str, value: str,
+               trace: Optional[str] = None,
+               lag: Optional[float] = None) -> None:
         replica = self.replicas.get(name)
         if replica is None:
             return
-        if replica.offer(value):
+        if replica.offer(value, trace, lag):
             self._q.put(name)
 
     def requeue(self, name: str) -> None:

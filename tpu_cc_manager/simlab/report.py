@@ -51,6 +51,7 @@ def build_artifact(
     replica_stats: dict,
     faults: List[dict],
     controllers: dict,
+    trace_stitch: Optional[dict] = None,
     notes: Optional[str] = None,
 ) -> dict:
     metrics = {
@@ -67,6 +68,13 @@ def build_artifact(
         "phase_p95_s": phase_percentiles(phase_durations, 0.95),
         "reconciles": replica_stats,
     }
+    if trace_stitch is not None:
+        # the fleet-timeline stitch (runner._stitch_traces, ISSUE 8):
+        # cross-process causal traces joined by trace id, and the
+        # trend-gated end-to-end convergence latency derived from them
+        metrics["trace_stitch"] = trace_stitch
+        metrics["e2e_convergence_p99_s"] = trace_stitch.get(
+            "e2e_convergence_p99_s")
     artifact = {
         "artifact_version": ARTIFACT_VERSION,
         "scenario": scenario.name,

@@ -38,6 +38,7 @@ from tpu_cc_manager.k8s.objects import make_node
 from tpu_cc_manager.obs import (
     kube_throttle_wait_histogram, watch_pump_lag_histogram,
 )
+from tpu_cc_manager.flightrec import FlightRecorder, stitch_by_trace
 from tpu_cc_manager.simlab.faults import FaultInjector
 from tpu_cc_manager.simlab.pump import LagStamps, WatchPump
 from tpu_cc_manager.simlab.replica import (
@@ -45,7 +46,7 @@ from tpu_cc_manager.simlab.replica import (
 )
 from tpu_cc_manager.simlab.report import build_artifact
 from tpu_cc_manager.simlab.scenario import Scenario
-from tpu_cc_manager.trace import Tracer
+from tpu_cc_manager.trace import Tracer, format_traceparent, get_tracer
 
 log = logging.getLogger("tpu-cc-manager.simlab")
 
@@ -87,6 +88,27 @@ class SimLab:
         self._phase_lock = threading.Lock()
         self.tracer = Tracer()
         self.tracer.add_sink(self._phase_sink)
+        # the driver's own black box: its desired_write spans are the
+        # controller half of every stitched trace (ISSUE 8) — one per
+        # set_mode action, so a small ring is plenty
+        self.driver_rec = FlightRecorder(
+            name="driver", span_ring=256, event_ring=128, sample_ring=8,
+        )
+        # policy-driven rollouts stamp their desired_write spans on the
+        # PROCESS-default tracer (rollout.py get_tracer()), whose ring
+        # the replica batchers' publish spans also churn through — a
+        # post-run ring read would race eviction at 256 replicas. A
+        # filtered sink captures exactly the controller spans as they
+        # close; attached in run(), detached in _teardown.
+        self.ctrl_rec = FlightRecorder(
+            name="controller", span_ring=256, event_ring=8, sample_ring=8,
+        )
+
+        def _ctrl_sink(span) -> None:
+            if span.name == "desired_write":
+                self.ctrl_rec.observe_span(span)
+
+        self._ctrl_sink = _ctrl_sink
         self.lag_hist = watch_pump_lag_histogram()
         self.throttle_hist = kube_throttle_wait_histogram()
         self._throttle_samples: List[float] = []
@@ -192,14 +214,30 @@ class SimLab:
     def _act_set_mode(self, params: dict) -> dict:
         mode = params["mode"]
         names = self._nodes_in_pool(params.get("pool"))
-        for name in names:
-            self.stamps.record(name, mode, time.monotonic())
-            # out-of-band store write (like _wait_converged's polling):
-            # the driver's input must neither add HTTP load to the
-            # system under test nor soak a scripted write_429 storm
-            self.server.store.set_node_labels_direct(
-                name, {L.CC_MODE_LABEL: mode})
-        return {"mode": mode, "nodes": len(names)}
+        # ONE desired_write span per action, stamped as the cc.trace
+        # annotation in the SAME store write as the desired label —
+        # exactly the real controller contract (rollout.launch_group).
+        # Every replica reconcile triggered by this action adopts the
+        # context, so the fleet-wide stitch joins driver and replicas
+        # on this span's trace id.
+        with self.tracer.span(
+            "desired_write", mode=mode, nodes=len(names),
+            pool=params.get("pool"),
+        ) as span:
+            context = format_traceparent(span)
+            for name in names:
+                self.stamps.record(name, mode, time.monotonic())
+                # out-of-band store write (like _wait_converged's
+                # polling): the driver's input must neither add HTTP
+                # load to the system under test nor soak a scripted
+                # write_429 storm
+                self.server.store.set_node_labels_direct(
+                    name, {L.CC_MODE_LABEL: mode},
+                    annotations={L.CC_TRACE_ANNOTATION: context},
+                )
+        self.driver_rec.observe_span(span)
+        return {"mode": mode, "nodes": len(names),
+                "trace_id": span.trace_id}
 
     def _act_create_policy(self, params: dict) -> dict:
         pool = params.get("pool")
@@ -257,6 +295,7 @@ class SimLab:
                  "%d workers / qps=%s", sc.name, sc.nodes, sc.pools,
                  self.workers, sc.qps or "off")
         self.server = FakeApiServer().start()
+        get_tracer().add_sink(self._ctrl_sink)
         notes = None
         faults: List[dict] = []
         try:
@@ -380,6 +419,60 @@ class SimLab:
                     log.warning("final fleet scan failed",
                                 exc_info=True)
 
+    # ------------------------------------------------------ trace stitch
+    def _stitch_traces(self) -> dict:
+        """Collect every process-local flight recording (driver +
+        controllers + all replicas), stitch spans fleet-wide by trace
+        id, and derive the end-to-end convergence distribution: for
+        each desired-write trace, per node, label-commit
+        (``desired_write`` span start) → that node's LAST adopted
+        ``reconcile`` span end (the state publish happens inside it).
+        This is the cross-process latency ROADMAP item 2 asks for —
+        measured from causal traces, not from the driver's poll."""
+        recordings = [self.driver_rec.snapshot("run_end"),
+                      self.ctrl_rec.snapshot("run_end")]
+        for r in self.replicas.values():
+            recordings.append(r.recorder.snapshot("run_end"))
+        stitched = stitch_by_trace(recordings)
+        from tpu_cc_manager.simlab.report import percentile
+
+        samples: List[float] = []
+        cross = 0
+        example: List[dict] = []
+        for spans in stitched.values():
+            recorders = {s.get("recorder") for s in spans
+                         if s.get("recorder")}
+            desired = [s for s in spans if s["name"] == "desired_write"]
+            if len(recorders) > 1 and desired:
+                cross += 1
+                if len(spans) > len(example):
+                    example = spans
+            if not desired:
+                continue
+            t0 = min(s["start_ts"] for s in desired)
+            ends: Dict[str, float] = {}
+            for s in spans:
+                if s["name"] != "reconcile":
+                    continue
+                node = ((s.get("attrs") or {}).get("node")
+                        or s.get("recorder"))
+                end = s["start_ts"] + s["dur_s"]
+                if node and end > ends.get(node, 0.0):
+                    ends[node] = end
+            samples.extend(
+                max(0.0, end - t0) for end in ends.values()
+            )
+        return {
+            "traces": len(stitched),
+            "cross_process_traces": cross,
+            "e2e_samples": len(samples),
+            "e2e_convergence_p50_s": percentile(samples, 0.50),
+            "e2e_convergence_p99_s": percentile(samples, 0.99),
+            # one stitched fleet timeline as evidence the propagation
+            # works end to end (capped: the artifact must stay small)
+            "timeline_example": example[:12],
+        }
+
     def _finish(self, ok, initial_s, conv_s, pending, faults, notes):
         replica_stats = {"total": 0, "repairs": 0, "coalesced": 0}
         # the coalescing publish core's loss accounting, fleet-wide
@@ -459,10 +552,12 @@ class SimLab:
             replica_stats=replica_stats,
             faults=faults,
             controllers=controllers,
+            trace_stitch=self._stitch_traces(),
             notes=notes,
         )
 
     def _teardown(self) -> None:
+        get_tracer().remove_sink(self._ctrl_sink)
         if self.injector is not None:
             self.injector.cancel()
         for c in self._controllers:

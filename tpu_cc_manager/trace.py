@@ -20,11 +20,22 @@ Design:
   serial loop's did.
 - Sinks observe every completed span: :class:`JsonlSink` appends one JSON
   line per span to ``CC_TRACE_FILE`` (the structured replacement for
-  ``set -x``); the agent adds a metrics sink so ``/metrics`` exports a
-  per-phase duration histogram; ``/debug/traces`` on the health server
-  serves the ring for live inspection.
+  ``set -x``; size-capped via ``TPU_CC_TRACE_JSONL_MAX_MB``); the agent
+  adds a metrics sink so ``/metrics`` exports a per-phase duration
+  histogram; ``/debug/traces`` on the health server serves the ring for
+  live inspection.
 - Tracing is always on (it is microseconds of overhead per reconcile);
   sinks are what you opt into.
+- **Cross-process propagation** (ISSUE 8): :func:`format_traceparent`
+  renders an open span as a W3C-traceparent-style string
+  (``00-<trace>-<span>-01``) that rides the
+  ``tpu.google.com/cc.trace`` node annotation in the SAME write as the
+  desired-mode label; :meth:`Tracer.adopt_remote` re-seats the parsed
+  context on the consuming process's thread, so the agent's reconcile
+  tree carries the controller's trace id. Span ids carry a per-tracer
+  random prefix so independently-minted traces from different
+  processes (or different tracers in one process) never collide when a
+  collector stitches them by trace id.
 
 The span vocabulary (``PHASES``) is intentionally closed: the per-phase
 histogram's label cardinality stays bounded no matter what attrs
@@ -36,16 +47,85 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import os
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Callable, Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 log = logging.getLogger("tpu-cc-manager.trace")
 
+#: fixed version field of the traceparent-style context string
+TRACEPARENT_VERSION = "00"
+
+# Innermost OPEN span per thread across ALL tracer instances — the
+# join key structured logging needs (obs.JsonLogFormatter): the agent,
+# simlab replicas, and controllers each run their own Tracer, and a
+# log record must find "the span I am inside" without knowing which
+# tracer opened it. Maintained by Tracer.span/adopt/adopt_remote.
+_active = threading.local()
+
+
+def _active_stack() -> List["Span"]:
+    stack = getattr(_active, "stack", None)
+    if stack is None:
+        stack = _active.stack = []
+    return stack
+
+
+def active_span() -> Optional["Span"]:
+    """The innermost open span on THIS thread, whichever tracer opened
+    it (None at top level)."""
+    stack = getattr(_active, "stack", None)
+    return stack[-1] if stack else None
+
+
+def current_trace_ids() -> Tuple[Optional[str], Optional[str]]:
+    """(trace_id, span_id) of the active span — (None, None) outside
+    any span. The one key logs and traces join on."""
+    span = active_span()
+    if span is None:
+        return None, None
+    return span.trace_id, span.span_id
+
+
+class RemoteContext:
+    """A parsed cross-process trace context: just the two ids
+    :meth:`Tracer.adopt` needs to re-seat a remote parent."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+def format_traceparent(span: "Span") -> str:
+    """Render ``span`` as the cc.trace annotation value:
+    ``00-<trace>-<span>-01`` (W3C traceparent shape with this build's
+    counter-style ids). Safe on an OPEN span — ids are assigned at
+    creation."""
+    return f"{TRACEPARENT_VERSION}-{span.trace_id}-{span.span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[RemoteContext]:
+    """Parse an annotation value back into a context; None for
+    missing/garbled input (a node-writable annotation is hostile
+    surface — bad context degrades to a local trace, never throws)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) != 4 or parts[0] != TRACEPARENT_VERSION:
+        return None
+    _, trace_id, span_id, _ = parts
+    if not trace_id or not span_id:
+        return None
+    return RemoteContext(trace_id, span_id)
+
 #: Closed span-name vocabulary (metrics label values).
 PHASES = (
+    "desired_write",  # controller/driver root: desired-mode label commit
     "reconcile",    # root: one desired-mode application end to end
     "enumerate",    # device discovery
     "plan",         # divergence computation
@@ -106,6 +186,11 @@ class Tracer:
         self._sinks: List[Callable[[Span], None]] = []
         self._local = threading.local()
         self._ids = itertools.count(1)
+        # random per-tracer prefix: ids minted by DIFFERENT tracers
+        # (two processes, or the agent's tracer vs a controller's in
+        # one simlab process) must never collide once a collector
+        # stitches spans fleet-wide by trace id
+        self._id_prefix = os.urandom(4).hex()
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- plumbing
@@ -116,11 +201,21 @@ class Tracer:
 
     def _next_id(self) -> str:
         with self._lock:
-            return format(next(self._ids), "x")
+            return f"{self._id_prefix}{next(self._ids):x}"
 
     def add_sink(self, sink: Callable[[Span], None]) -> "Tracer":
         self._sinks.append(sink)
         return self
+
+    def remove_sink(self, sink: Callable[[Span], None]) -> None:
+        """Detach a sink added with :meth:`add_sink` (no-op when
+        absent). Scoped consumers of the PROCESS tracer — simlab's
+        per-run controller-span collector — must detach on teardown or
+        every past run's sink keeps firing."""
+        try:
+            self._sinks.remove(sink)
+        except ValueError:
+            pass
 
     def current_span(self) -> Optional[Span]:
         """The innermost open span on THIS thread (None at top level).
@@ -143,10 +238,33 @@ class Tracer:
             return
         stack = self._stack()
         stack.append(parent)
+        active = _active_stack()
+        active.append(parent)
         try:
             yield
         finally:
             stack.pop()
+            active.pop()
+
+    @contextmanager
+    def adopt_remote(
+        self, context: "Optional[RemoteContext | str]"
+    ) -> Iterator[None]:
+        """Adopt a CROSS-PROCESS parent: ``context`` is a
+        :class:`RemoteContext` or a raw traceparent annotation value.
+        Spans opened inside carry the remote trace id and parent the
+        remote span id — the agent's reconcile tree continues the
+        controller's desired-write trace. No-op (a local root as
+        before) on None or a garbled value."""
+        if isinstance(context, str):
+            context = parse_traceparent(context)
+        if not isinstance(context, RemoteContext):
+            # None, or any non-context garbage off a node annotation:
+            # degrade to a local root, never throw
+            yield
+            return
+        with self.adopt(context):  # type: ignore[arg-type]
+            yield
 
     # --------------------------------------------------------------- spans
     @contextmanager
@@ -164,6 +282,8 @@ class Tracer:
         )
         t0 = time.monotonic()
         stack.append(s)
+        active = _active_stack()
+        active.append(s)
         try:
             yield s
         except BaseException as e:
@@ -173,6 +293,7 @@ class Tracer:
         finally:
             s.dur_s = time.monotonic() - t0
             stack.pop()
+            active.pop()
             self._record(s)
 
     def _record(self, s: Span) -> None:
@@ -201,19 +322,68 @@ class Tracer:
         return list(by_trace.values())[-limit:]
 
 
+def _jsonl_cap_from_env() -> int:
+    """``TPU_CC_TRACE_JSONL_MAX_MB`` -> byte cap (0 = unbounded; a
+    typo degrades to unbounded — the historical behavior — rather
+    than crashing an agent at startup)."""
+    try:
+        mb = float(os.environ.get("TPU_CC_TRACE_JSONL_MAX_MB", "") or 0)
+    except ValueError:
+        return 0
+    return int(mb * 1024 * 1024) if mb > 0 else 0
+
+
 class JsonlSink:
     """Append one JSON line per completed span to a file — the structured
     successor of the bash engine's ``set -x`` log. Enable with
-    ``CC_TRACE_FILE=/var/log/tpu-cc-trace.jsonl``."""
+    ``CC_TRACE_FILE=/var/log/tpu-cc-trace.jsonl``.
 
-    def __init__(self, path: str):
+    Size-capped (``TPU_CC_TRACE_JSONL_MAX_MB``, or ``max_bytes``): when
+    appending a span would push the file past the cap, the file rotates
+    to ``<path>.1`` (replacing the previous rotation) and the span
+    starts the fresh file — a long-running agent holds at most ~2x the
+    cap on disk instead of filling it. Every span is still EXACTLY one
+    complete line in exactly one of the two files: the size check and
+    the write happen under one lock, and a line is never split across
+    the rotation boundary."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = (
+            _jsonl_cap_from_env() if max_bytes is None else max_bytes
+        )
+        self.rotations = 0
         self._lock = threading.Lock()
+        self._size: Optional[int] = None  # lazily stat'ed
+
+    def _current_size(self) -> int:
+        if self._size is None:
+            try:
+                self._size = os.path.getsize(self.path)
+            except OSError:
+                self._size = 0
+        return self._size
 
     def __call__(self, span: Span) -> None:
-        line = json.dumps(span.to_dict(), sort_keys=True)
-        with self._lock, open(self.path, "a") as f:
-            f.write(line + "\n")
+        line = json.dumps(span.to_dict(), sort_keys=True) + "\n"
+        data = len(line.encode("utf-8"))
+        with self._lock:
+            if (self.max_bytes
+                    and self._current_size() + data > self.max_bytes
+                    and self._current_size() > 0):
+                try:
+                    os.replace(self.path, self.path + ".1")
+                    self.rotations += 1
+                    # reset ONLY on success: a failed rotation leaves
+                    # the full file in place, and believing it empty
+                    # would let it grow by max_bytes per failed attempt
+                    self._size = 0
+                except OSError:
+                    log.warning("trace jsonl rotation failed",
+                                exc_info=True)
+            with open(self.path, "a") as f:
+                f.write(line)
+            self._size = self._current_size() + data
 
 
 _default = Tracer()

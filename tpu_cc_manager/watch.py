@@ -263,6 +263,15 @@ class NodeWatcher:
         #: carries a node fresher than anything a GET would return)
         self._snapshot_lock = threading.Lock()
         self._last_node: Optional[dict] = None
+        #: the adoptable cc.trace context (ISSUE 8), and the annotation
+        #: value observed at the last desired-label CHANGE. A new
+        #: desired write only carries a trace when its writer stamped a
+        #: FRESH context — an unstamped write (operator kubectl) must
+        #: not inherit a finished rollout's annotation, or every later
+        #: reconcile stitches under a dead trace. Guarded by
+        #: _snapshot_lock like the node snapshot it derives from.
+        self._trace_ctx: Optional[str] = None
+        self._ctx_at_last_change: Optional[str] = None
 
     # ------------------------------------------------------------ helpers
     def read_node_label(self) -> Optional[str]:
@@ -274,8 +283,22 @@ class NodeWatcher:
         return node["metadata"].get("labels", {}).get(self.label_key)
 
     def _remember_node(self, node: dict) -> None:
+        meta = node.get("metadata") or {}
+        label = (meta.get("labels") or {}).get(self.label_key)
+        ann = (meta.get("annotations") or {}).get(L.CC_TRACE_ANNOTATION)
+        if not isinstance(ann, str):
+            ann = None
         with self._snapshot_lock:
             self._last_node = node
+            # runs BEFORE _push updates _last_value, so a differing
+            # label here means THIS node object is a new desired write:
+            # adopt its annotation only if the writer stamped a fresh
+            # one (prime counts as a change — the restart-rejoin case)
+            if label != self._last_value:
+                self._trace_ctx = (
+                    ann if ann != self._ctx_at_last_change else None
+                )
+                self._ctx_at_last_change = ann
 
     def latest_node(self) -> Optional[dict]:
         """A deep copy of the newest node object this watcher has seen
@@ -286,6 +309,19 @@ class NodeWatcher:
         with self._snapshot_lock:
             # ccaudit: allow-blocking-under-lock(deepcopy of one node object, not I/O: the copy must happen inside the lock or the watch thread could swap the snapshot mid-copy)
             return copy.deepcopy(self._last_node) if self._last_node else None
+
+    def latest_trace_context(self) -> Optional[str]:
+        """The desired-writer's cross-process trace context, delivered
+        by the same watch event as the desired-label change that
+        triggers the reconcile. Last-writer-wins matches the mailbox's
+        coalescing contract: the newest desired write's trace owns
+        whatever reconcile runs next. None before the prime read, when
+        no writer stamps contexts, or when the newest desired write
+        did NOT stamp a fresh one (the node merely still carries a
+        previous write's annotation — adopting that would attribute
+        this reconcile to a finished trace)."""
+        with self._snapshot_lock:
+            return self._trace_ctx
 
     def _push(self, value: Optional[str]) -> None:
         if value != self._last_value:
