@@ -845,6 +845,7 @@ def run_simlab_bench():
         sys.exit(1)
     m = art["metrics"]
     stitch = m.get("trace_stitch") or {}
+    slo = m.get("slo") or {}
     if m.get("e2e_convergence_p99_s") is None:
         # a converged run with NO stitched e2e samples means trace
         # propagation (or adoption) broke — the exact failure this
@@ -879,6 +880,11 @@ def run_simlab_bench():
             "faults_injected": sum(
                 1 for f in art["faults"] if "fault" in f
             ),
+            # the observatory's verdict on the faulted run (ISSUE 9):
+            # scripted 429/crash storms MAY legitimately burn budget —
+            # recorded here as signal, gated only by the slo-smoke job
+            "slo_alerts": len(slo.get("alerts") or []),
+            "slo_skipped": slo.get("skipped"),
         },
     }
 

@@ -1,0 +1,299 @@
+#!/usr/bin/env python3
+"""Automated bench-regression attribution (ISSUE 9 part 3).
+
+The r05 ``real_chip_flip_s`` 1.87 -> 4.43 s regression sat
+*unattributed* because attributing it meant a human diffing
+BENCH_r*.json extras by hand. Everything needed to attribute is
+already stamped into the bench output — the per-phase sub-spans
+(``real_chip_phase_s``, ``phase_p50_s``), the pre/post probe
+contention sentinel (``real_chip_probe_pre_s`` / ``real_chip_probe_s``),
+and the dep pins receipt (``bench_deps``). This tool closes ROADMAP
+item 1's loop: given two rounds and the axes that regressed, it diffs
+the relevant sub-surface, ranks the contributors, reads the sentinels,
+and prints a verdict like::
+
+    real_chip_flip_s 1.87 -> 4.43 (2.4x): wait_ready +2.31s, probe
+    flat, deps unchanged -> chip-side (wait_ready)
+
+``scripts/bench_trend.py`` calls :func:`attribute` automatically on
+ANY gated-axis failure, so the next regression arrives with its
+attribution attached instead of as a mystery. Standalone::
+
+    python scripts/bench_attr.py [repo_root] [--axis AXIS]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: regressed axis -> the extras sub-dicts whose numeric entries are
+#: that axis's attribution surface, most-specific first. Axes not
+#: listed fall back to ``phase_p50_s`` (the per-phase budget every
+#: round carries).
+AXIS_SOURCES = {
+    "real_chip_flip_s": ("real_chip_phase_s",),
+    "pool256_convergence_s": ("simlab256",),
+    "e2e_convergence_p99_s": ("simlab256",),
+    "multichip_flip_s": ("phase_p50_s",),
+    "flips_per_min_windowed": ("phase_p50_s",),
+    "flips_per_min": ("phase_p50_s",),
+    "node_writes_per_flip": ("phase_p50_s",),
+    "fleet_scan_warm_s": ("scale256",),
+    "planner_tick_100k_s": (),
+    "p50": ("phase_p50_s",),
+}
+
+#: probe pair: the real-chip host-contention sentinel (r07+)
+PROBE_KEYS = ("real_chip_probe_pre_s", "real_chip_probe_s")
+
+#: a probe move beyond this ratio reads as host contention
+PROBE_INFLATED_RATIO = 1.5
+
+
+def _round_num(path):
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def load_bench(path):
+    """Accept both the bare bench JSON line and the driver's
+    {"cmd","rc","tail"} envelope (same contract as bench_trend)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "value" in doc:
+        return doc
+    for line in reversed((doc.get("tail") or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None
+    return None
+
+
+def _numeric_items(d, prefix=""):
+    """Flatten one level of nesting into {dotted_key: number}."""
+    out = {}
+    for k, v in (d or {}).items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[prefix + k] = float(v)
+        elif isinstance(v, dict) and not prefix:
+            out.update(_numeric_items(v, prefix=f"{k}."))
+    return out
+
+
+def rank_deltas(prev_d, cur_d):
+    """Ranked contributor list: every key present in either side, by
+    descending absolute delta. Entries: {phase, prev, cur, delta}."""
+    ranked = []
+    for key in sorted(set(prev_d) | set(cur_d)):
+        prev_v = prev_d.get(key)
+        cur_v = cur_d.get(key)
+        delta = (cur_v or 0.0) - (prev_v or 0.0)
+        ranked.append({
+            "phase": key, "prev": prev_v, "cur": cur_v,
+            "delta": round(delta, 4),
+        })
+    ranked.sort(key=lambda e: abs(e["delta"]), reverse=True)
+    return ranked
+
+
+def _fmt_num(v):
+    return "absent" if v is None else f"{v:.4g}"
+
+
+def _dep_changes(prev_x, cur_x):
+    """Changed pins between rounds ({dep: "old -> new"})."""
+    prev_deps = prev_x.get("bench_deps") or {}
+    cur_deps = cur_x.get("bench_deps") or {}
+    out = {}
+    for dep in sorted(set(prev_deps) | set(cur_deps)):
+        a, b = prev_deps.get(dep, "absent"), cur_deps.get(dep, "absent")
+        if a != b:
+            out[dep] = f"{a} -> {b}"
+    return out
+
+
+def _probe_status(prev_x, cur_x):
+    """'flat' | 'inflated' | 'missing' from the contention-sentinel
+    probe pair; inflated means host contention is the lead suspect."""
+    seen = False
+    for key in PROBE_KEYS:
+        a, b = prev_x.get(key), cur_x.get(key)
+        if not (isinstance(a, (int, float)) and a > 0
+                and isinstance(b, (int, float))):
+            continue
+        seen = True
+        if b > a * PROBE_INFLATED_RATIO:
+            return "inflated"
+    return "flat" if seen else "missing"
+
+
+def attribute_axis(axis, prev, cur):
+    """One axis's attribution report:
+    {axis, prev, cur, ranked, probe, dep_changes, verdict}."""
+    prev_x = prev.get("extras") or {}
+    cur_x = cur.get("extras") or {}
+    if axis == "p50":
+        prev_v, cur_v = prev.get("value"), cur.get("value")
+    else:
+        prev_v, cur_v = prev_x.get(axis), cur_x.get(axis)
+    sources = AXIS_SOURCES.get(axis, ("phase_p50_s",))
+    ranked = []
+    missing = []
+    for source in sources:
+        prev_d = _numeric_items(prev_x.get(source))
+        cur_d = _numeric_items(cur_x.get(source))
+        if not prev_d and not cur_d:
+            missing.append(source)
+            continue
+        if not prev_d or not cur_d:
+            missing.append(
+                f"{source} ({'previous' if not prev_d else 'current'} "
+                "round lacks it)"
+            )
+        ranked.extend(rank_deltas(prev_d, cur_d))
+    ranked.sort(key=lambda e: abs(e["delta"]), reverse=True)
+    dep_changes = _dep_changes(prev_x, cur_x)
+    probe = (_probe_status(prev_x, cur_x)
+             if axis.startswith("real_chip") else None)
+
+    # verdict synthesis: deps first (a toolchain change taints every
+    # number), then the contention sentinel, then the ranked phases
+    parts = []
+    top = next((e for e in ranked if e["delta"] and e["prev"] is not None
+                and e["cur"] is not None), None)
+    if top is not None:
+        parts.append(
+            f"{top['phase']} {top['delta']:+.4g}"
+            + ("s" if top["phase"].endswith("_s")
+               or axis.endswith("_s") else "")
+        )
+    if probe == "inflated":
+        parts.append("probe inflated")
+    elif probe == "flat":
+        parts.append("probe flat")
+    if dep_changes:
+        parts.append(
+            "deps changed ("
+            + ", ".join(f"{k} {v}" for k, v in dep_changes.items())
+            + ")"
+        )
+    elif prev_x.get("bench_deps") or cur_x.get("bench_deps"):
+        parts.append("deps unchanged")
+    if dep_changes:
+        conclusion = "suspect toolchain change"
+    elif probe == "inflated":
+        conclusion = "host contention"
+    elif top is not None:
+        where = ("chip-side" if axis.startswith("real_chip")
+                 else "phase")
+        conclusion = f"{where} ({top['phase']})"
+    else:
+        srcs = ", ".join(missing) or ", ".join(sources) or axis
+        conclusion = f"cannot attribute — data missing ({srcs})"
+    verdict = (", ".join(parts) + " -> " if parts else "") + conclusion
+    return {
+        "axis": axis,
+        "prev": prev_v,
+        "cur": cur_v,
+        "ranked": ranked[:8],
+        "probe": probe,
+        "dep_changes": dep_changes,
+        "missing": missing,
+        "verdict": verdict,
+    }
+
+
+def axes_from_problems(problems):
+    """Map bench_trend problem strings back to axis names (each
+    problem line leads with the axis)."""
+    axes = []
+    for p in problems:
+        head = p.split(" ", 1)[0]
+        axis = "p50" if head == "p50" else head
+        if axis not in axes:
+            axes.append(axis)
+    return axes
+
+
+def attribute(prev, cur, axes):
+    """Attribution reports for every named axis, in order."""
+    return [attribute_axis(axis, prev, cur) for axis in axes]
+
+
+def format_report(reports):
+    """Human lines, one block per axis (what bench_trend prints under
+    a failing gate)."""
+    lines = []
+    for r in reports:
+        ratio = ""
+        if (isinstance(r["prev"], (int, float)) and r["prev"]
+                and isinstance(r["cur"], (int, float))):
+            ratio = f" ({r['cur'] / r['prev']:.1f}x)"
+        lines.append(
+            f"attribution: {r['axis']} {_fmt_num(r['prev'])} -> "
+            f"{_fmt_num(r['cur'])}{ratio}: {r['verdict']}"
+        )
+        for e in r["ranked"][:4]:
+            if not e["delta"]:
+                continue
+            lines.append(
+                f"    {e['phase']}: {_fmt_num(e['prev'])} -> "
+                f"{_fmt_num(e['cur'])} ({e['delta']:+.4g})"
+            )
+        for m in r["missing"]:
+            lines.append(f"    missing: {m}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Attribute bench-axis regressions between the two "
+        "newest BENCH_r*.json rounds (ranked per-phase diff + "
+        "contention/deps sentinels)."
+    )
+    ap.add_argument("root", nargs="?", default=".")
+    ap.add_argument(
+        "--axis", action="append", default=None,
+        help="axis to attribute (repeatable; default: every axis "
+        "bench_attr knows a source for that both rounds carry)",
+    )
+    args = ap.parse_args(argv)
+    files = sorted(
+        glob.glob(os.path.join(args.root, "BENCH_r*.json")),
+        key=_round_num,
+    )
+    if len(files) < 2:
+        print("bench-attr: <2 BENCH_r*.json files; nothing to compare")
+        return 0
+    prev, cur = load_bench(files[-2]), load_bench(files[-1])
+    if prev is None or cur is None:
+        print("bench-attr: could not parse bench result(s)",
+              file=sys.stderr)
+        return 2
+    axes = args.axis
+    if not axes:
+        cur_x, prev_x = cur.get("extras") or {}, prev.get("extras") or {}
+        axes = [
+            a for a in AXIS_SOURCES
+            if a != "p50" and (a in cur_x or a in prev_x)
+        ] or ["p50"]
+    print(f"bench-attr: {os.path.basename(files[-2])} -> "
+          f"{os.path.basename(files[-1])}")
+    for line in format_report(attribute(prev, cur, axes)):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `bench_attr.py | head` is a normal use
+        sys.exit(0)

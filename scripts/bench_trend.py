@@ -94,6 +94,29 @@ def _round_num(path):
     return int(m.group(1)) if m else -1
 
 
+def _print_attribution(prev, cur, problems, out):
+    """Any gated-axis failure triggers the automatic attribution pass
+    (scripts/bench_attr.py, ISSUE 9): ranked per-phase deltas + the
+    probe/deps sentinels, printed next to the gate verdict so a
+    regression arrives attributed instead of as an r05-style mystery.
+    Best-effort by contract — attribution must never mask the gate."""
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_attr",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_attr.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        axes = mod.axes_from_problems(problems)
+        for line in mod.format_report(mod.attribute(prev, cur, axes)):
+            print(line, file=out)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench-trend: attribution pass failed: {e}", file=out)
+
+
 def _load_bench(path):
     """The driver's BENCH_r*.json wraps the bench's one-line JSON
     inside a {"cmd", "rc", "tail"} envelope; accept both shapes."""
@@ -182,7 +205,12 @@ def main(root: str = ".") -> int:
               f"{REGRESSION_FACTOR}x of {os.path.basename(prev_path)}")
         return 0
 
-    # regression found: is it acknowledged?
+    # regression found: attribute it automatically (ranked phase diff
+    # + contention/deps sentinels) whether or not it is acknowledged —
+    # an acknowledged regression still deserves its named cause
+    _print_attribution(prev, cur, problems, sys.stderr)
+
+    # is it acknowledged?
     note = (cur.get("extras") or {}).get("regression_note")
     if note:
         print(f"bench-trend: regression noted in bench extras: {note}")

@@ -244,6 +244,61 @@ def main():
                 else:
                     failures.append(
                         f"flightrec route shape: {sorted(fr)[:8]}")
+                # 2d. the time-series ring route (tsring.py, ISSUE 9)
+                ts = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/timeseries",
+                    timeout=5,
+                ).read())
+                if (ts.get("tsring_version") == 1
+                        and "samples" in ts and "window_s" in ts):
+                    log("PASS /debug/timeseries serves the live "
+                        "metric history ring")
+                else:
+                    failures.append(
+                        f"timeseries route shape: {sorted(ts)[:8]}")
+                # 2e. the fleet observatory over HTTP (fleetobs.py,
+                # ISSUE 9): scrape the agent's live /metrics as a real
+                # HTTP target, merge (fleet of one), and re-validate
+                # the AGGREGATED exposition — the kind-smoke half of
+                # the scrape contract (simlab covers in-process)
+                try:
+                    from tpu_cc_manager import fleetobs
+                except ImportError:
+                    fleetobs = None
+                    log("SKIP fleetobs HTTP scrape (pyyaml not "
+                        "installed)")
+                if fleetobs is not None:
+                    try:
+                        objectives = fleetobs.load_slo(
+                            fleetobs.default_slo_path())
+                    except ImportError:
+                        objectives = None
+                        log("SKIP fleetobs HTTP scrape (pyyaml not "
+                            "installed)")
+                    except fleetobs.SloError as e:
+                        # a broken committed slo.yaml is a smoke
+                        # FAILURE like any other check, never an
+                        # uncaught traceback that aborts the rest
+                        objectives = None
+                        failures.append(
+                            f"fleetobs slo.yaml invalid: {e}")
+                    if objectives is not None:
+                        observer = fleetobs.FleetObserver(objectives)
+                        observer.observe(
+                            [f"http://127.0.0.1:{port}/metrics"] * 2
+                        )
+                        if (not observer.aggregation_problems
+                                and observer.metrics.scrapes_total
+                                .value("ok") == 2
+                                and not observer.problems()):
+                            log("PASS fleetobs scrapes /metrics over "
+                                "HTTP, merged exposition validates, "
+                                "no SLO burns")
+                        else:
+                            failures.append(
+                                "fleetobs HTTP scrape: "
+                                f"agg={observer.aggregation_problems[:2]} "
+                                f"problems={observer.problems()[:2]}")
 
             # 3. label -> state round trip (the core of config 1)
             for mode in ("devtools", "ici", "off"):

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""SLO-engine smoke (ISSUE 9, the slo-smoke CI job): prove the
+burn-rate machinery end to end on live replicas, both directions —
+
+1. ``scenarios/slo-fault-24.json`` (a write_429 storm under a mode
+   storm) must FIRE the multi-window burn alert: the burn-rate gauge
+   rises past the threshold, the budget burns, and the ``slo_burn``
+   event lands in the observer's flight-recorder black box.
+2. ``scenarios/slo-clean-16.json`` (the same shape, no fault) must
+   burn NOTHING: no alerts, every error-ratio budget intact.
+
+An alerting layer that can't demonstrate both halves is worse than
+none — silent on real faults or crying on clean runs. Exit 0 only
+when both hold.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# responsive scrape cadence for the short smoke scenarios (the lab
+# default is 1 s; the fault window is a few seconds wide)
+os.environ.setdefault("TPU_CC_FLEETOBS_INTERVAL_S", "0.25")
+
+from tpu_cc_manager.simlab.runner import SimLab  # noqa: E402
+from tpu_cc_manager.simlab.scenario import load_scenario  # noqa: E402
+
+SCENARIO_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scenarios")
+
+checks = []
+
+
+def check(name, ok, detail=""):
+    checks.append(ok)
+    print(f"{'PASS' if ok else 'FAIL'} {name}" + (f": {detail}" if detail else ""))
+
+
+def run(scenario):
+    lab = SimLab(load_scenario(os.path.join(SCENARIO_DIR, scenario)))
+    art = lab.run()
+    return lab, art
+
+
+def main():
+    # ---- the burn half
+    lab, art = run("slo-fault-24.json")
+    slo = art["metrics"]["slo"]
+    check("fault scenario converged", art["ok"], art.get("notes") or "")
+    check("slo engine ran", "objectives" in slo,
+          slo.get("skipped", ""))
+    alerts = slo.get("alerts") or []
+    fired = [a for a in alerts if a["objective"] == "flip-success"]
+    check("flip-success burn alert fired", bool(fired),
+          json.dumps(alerts))
+    if fired:
+        check(
+            "burn rate rose past the threshold",
+            fired[0]["fast_burn"] >= 2.0 and fired[0]["slow_burn"] >= 2.0,
+            f"fast {fired[0]['fast_burn']}x / slow {fired[0]['slow_burn']}x",
+        )
+        check("budget burned", fired[0]["budget_remaining"] < 1.0)
+    events = [e for e in lab.obs_rec.snapshot()["events"]
+              if e["kind"] == "slo_burn"]
+    check("slo_burn event landed in the flight recorder", bool(events))
+    check("aggregated exposition stayed valid under the storm",
+          not slo.get("aggregation_problems"),
+          str(slo.get("aggregation_problems"))[:160])
+
+    # ---- the quiet half
+    _, art = run("slo-clean-16.json")
+    slo = art["metrics"]["slo"]
+    check("clean scenario converged", art["ok"], art.get("notes") or "")
+    check("clean run fired no alerts", not slo.get("alerts"),
+          json.dumps(slo.get("alerts"))[:200])
+    objectives = slo.get("objectives") or {}
+    for name in ("flip-success", "publish-loss"):
+        o = objectives.get(name) or {}
+        check(f"clean run left the {name} budget untouched",
+              o.get("budget_remaining") == 1.0,
+              str(o.get("budget_remaining")))
+    check("clean aggregation valid",
+          not slo.get("aggregation_problems"))
+
+    print(f"\nslo-smoke: {sum(checks)}/{len(checks)} checks passed")
+    return 0 if all(checks) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

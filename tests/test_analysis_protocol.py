@@ -687,6 +687,22 @@ def _manifest_tree(root, daemonset_key=None, scenario_mode="on",
                      "mode": scenario_mode}],
         "converge": {"mode": scenario_mode, "timeout_s": 60},
     }, indent=2))
+    # the slo cross-check (analysis/slo.py) scans the same surface
+    # with the same loud-missing contract; pragma'd because this
+    # minimal tree declares no Python metrics at all
+    _write(root, "deployments/slo.yaml", """\
+        version: 1
+        objectives:
+          - name: smoke
+            kind: error_ratio
+            # ccaudit: allow-metric-name(fixture tree declares no metrics)
+            metric: tpu_cc_reconciles_total
+            bad_labels:
+              outcome: [failure]
+            target: 0.99
+            windows: {fast_s: 2, slow_s: 10}
+            burn_threshold: 2.0
+        """)
 
 
 def test_clean_manifest_tree_passes(tmp_path):

@@ -1,0 +1,134 @@
+"""ccaudit slo cross-check (ISSUE 9 satellite): slo.yaml schema gating
+plus the metric-liveness extension of the one-declaration-per-name
+rule, with the pragma escape hatch."""
+
+import os
+import textwrap
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from tpu_cc_manager.analysis.slo import slo_findings  # noqa: E402
+
+DECLARED = {
+    "tpu_cc_reconciles_total",
+    "tpu_cc_reconcile_duration_seconds",
+    "tpu_cc_publications_dropped_total",
+}
+
+GOOD = """\
+version: 1
+objectives:
+  - name: flip-success
+    kind: error_ratio
+    metric: tpu_cc_reconciles_total
+    bad_labels:
+      outcome: [failure]
+    target: 0.99
+    windows: {fast_s: 2, slow_s: 10}
+    burn_threshold: 2.0
+"""
+
+
+def _write(tmp_path, text):
+    d = tmp_path / "deployments"
+    d.mkdir(exist_ok=True)
+    (d / "slo.yaml").write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def test_clean_file_yields_no_findings(tmp_path):
+    root = _write(tmp_path, GOOD)
+    assert slo_findings(root, DECLARED) == []
+
+
+def test_missing_file_is_loud(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        slo_findings(str(tmp_path), DECLARED)
+
+
+def test_schema_violation_is_a_manifest_drift_finding(tmp_path):
+    root = _write(tmp_path, GOOD.replace("target: 0.99", "target: 1.5"))
+    (f,) = slo_findings(root, DECLARED)
+    assert f.rule == "manifest-drift"
+    assert "schema violation" in f.message
+    assert "flip-success" in f.message
+
+
+def test_unparseable_yaml_is_a_finding_not_a_crash(tmp_path):
+    root = _write(tmp_path, "version: 1\nobjectives: [\n")
+    (f,) = slo_findings(root, DECLARED)
+    assert f.rule == "manifest-drift"
+    assert "unparseable" in f.message
+
+
+def test_undeclared_metric_fails_liveness(tmp_path):
+    """The extended one-declaration-per-metric-name rule: an objective
+    over a metric nobody declares (and so nobody renders) can never
+    fire — that must fail the lint tier."""
+    root = _write(tmp_path, GOOD.replace(
+        "tpu_cc_reconciles_total", "tpu_cc_reconciles_typo_total"))
+    (f,) = slo_findings(root, DECLARED)
+    assert f.rule == "metric-name"
+    assert "tpu_cc_reconciles_typo_total" in f.message
+    assert "never fire" in f.message
+    # the finding anchors on the referencing line
+    assert "tpu_cc_reconciles_typo_total" in f.text
+
+
+def test_total_metric_is_liveness_checked_too(tmp_path):
+    root = _write(tmp_path, """\
+        version: 1
+        objectives:
+          - name: publish-loss
+            kind: error_ratio
+            metric: tpu_cc_publications_dropped_total
+            total_metric: tpu_cc_nope_total
+            target: 0.999
+            windows: {fast_s: 2, slow_s: 10}
+            burn_threshold: 2.0
+        """)
+    (f,) = slo_findings(root, DECLARED)
+    assert f.rule == "metric-name"
+    assert "tpu_cc_nope_total" in f.message
+
+
+def test_pragma_escape_hatch_suppresses_liveness(tmp_path):
+    """Externally-scraped series are legitimate objectives; the pragma
+    (with a mandatory reason, on or above the line) sanctions them."""
+    root = _write(tmp_path, """\
+        version: 1
+        objectives:
+          - name: external
+            kind: error_ratio
+            # ccaudit: allow-metric-name(scraped from kube-state-metrics)
+            metric: tpu_cc_external_errors_total
+            bad_labels:
+              outcome: [failure]
+            target: 0.99
+            windows: {fast_s: 2, slow_s: 10}
+            burn_threshold: 2.0
+        """)
+    assert slo_findings(root, DECLARED) == []
+
+
+def test_committed_slo_yaml_is_clean_against_the_live_registry():
+    """The repo's own deployments/slo.yaml must reference only metrics
+    the code declares — the in-repo half of the CI gate."""
+    from tpu_cc_manager.analysis.core import (
+        iter_python_files, load_module, repo_root,
+    )
+    from tpu_cc_manager.analysis.rules import audit_module
+
+    root = repo_root()
+    declared = set()
+    for rel in iter_python_files(root, ["tpu_cc_manager/obs.py",
+                                        "tpu_cc_manager/fleetobs.py"]):
+        mod = load_module(root, rel)
+        if mod is not None:
+            declared.update(audit_module(mod).metric_decls)
+    assert slo_findings(root, declared) == []
+    assert os.path.exists(os.path.join(root, "deployments", "slo.yaml"))
+    # the registry subset above genuinely declares the referenced names
+    assert "tpu_cc_reconciles_total" in declared

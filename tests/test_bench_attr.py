@@ -1,0 +1,144 @@
+"""bench_attr (ISSUE 9): automated regression attribution — the
+synthetic-regression fixture the acceptance pins (phase A inflated in
+round N must be named, ranked first), the sentinel readings, and the
+automatic invocation from bench_trend on a gated-axis failure."""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_attr = _load("bench_attr")
+bench_trend = _load("bench_trend")
+
+
+def _bench(value, extras):
+    return {"metric": "pool32_reconcile_p50_s", "value": value,
+            "unit": "s", "extras": extras}
+
+
+def _real_chip_round(flip_s, phases, probe_pre=0.21, probe=0.23,
+                     deps=None):
+    return _bench(0.09, {
+        "real_chip_flip_s": flip_s,
+        "real_chip_phase_s": dict(phases),
+        "real_chip_probe_pre_s": probe_pre,
+        "real_chip_probe_s": probe,
+        "bench_deps": deps or {"jax": "0.4.37", "libtpu": "0.0.6"},
+    })
+
+
+BASE_PHASES = {"stage": 0.31, "reset": 0.52, "wait_ready": 0.41,
+               "verify": 0.33}
+
+
+def test_synthetic_regression_names_the_inflated_phase():
+    """The acceptance fixture: phase A (wait_ready) inflated in round
+    N; the attribution must rank it first and conclude chip-side."""
+    prev = _real_chip_round(1.87, BASE_PHASES)
+    inflated = dict(BASE_PHASES, wait_ready=2.71)
+    cur = _real_chip_round(4.43, inflated)
+    (report,) = bench_attr.attribute(prev, cur, ["real_chip_flip_s"])
+    assert report["ranked"][0]["phase"] == "wait_ready"
+    assert report["ranked"][0]["delta"] == 2.3
+    assert report["probe"] == "flat"
+    assert report["dep_changes"] == {}
+    assert "wait_ready" in report["verdict"]
+    assert "chip-side" in report["verdict"]
+    assert "probe flat" in report["verdict"]
+    assert "deps unchanged" in report["verdict"]
+
+
+def test_inflated_probe_reads_as_host_contention():
+    prev = _real_chip_round(1.87, BASE_PHASES)
+    cur = _real_chip_round(
+        4.43, {k: v * 2.3 for k, v in BASE_PHASES.items()},
+        probe_pre=0.9, probe=1.1,
+    )
+    (report,) = bench_attr.attribute(prev, cur, ["real_chip_flip_s"])
+    assert report["probe"] == "inflated"
+    assert "host contention" in report["verdict"]
+
+
+def test_changed_deps_lead_the_verdict():
+    prev = _real_chip_round(1.87, BASE_PHASES)
+    cur = _real_chip_round(
+        4.43, dict(BASE_PHASES, wait_ready=2.7),
+        deps={"jax": "0.4.38", "libtpu": "0.0.7"},
+    )
+    (report,) = bench_attr.attribute(prev, cur, ["real_chip_flip_s"])
+    assert report["dep_changes"] == {
+        "jax": "0.4.37 -> 0.4.38", "libtpu": "0.0.6 -> 0.0.7",
+    }
+    assert "toolchain" in report["verdict"]
+
+
+def test_missing_phase_data_is_stated_not_invented():
+    """The honest r05 case: the previous round predates the per-phase
+    sub-spans — the verdict must say the data is missing."""
+    prev = _bench(0.09, {"real_chip_flip_s": 1.87})
+    cur = _bench(0.09, {"real_chip_flip_s": 4.43,
+                        "real_chip_phase_s": {}})
+    (report,) = bench_attr.attribute(prev, cur, ["real_chip_flip_s"])
+    assert "missing" in report["verdict"]
+
+
+def test_axes_from_problems_maps_problem_lines_back():
+    problems = [
+        "real_chip_flip_s 1.87 -> 4.43 (2.4x slower)",
+        "p50 0.04 -> 0.18 (4.8x slower)",
+        "flips_per_min_windowed 21000 -> 5000 (4.2x fewer)",
+    ]
+    assert bench_attr.axes_from_problems(problems) == [
+        "real_chip_flip_s", "p50", "flips_per_min_windowed",
+    ]
+
+
+def _write_rounds(tmp_path, prev, cur):
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps(prev))
+    (tmp_path / "BENCH_r08.json").write_text(json.dumps(cur))
+
+
+def test_bench_trend_runs_attribution_on_gated_failure(
+        tmp_path, capsys):
+    """The integration pin: an unexplained gated-axis regression makes
+    bench_trend print the ranked attribution next to its verdict."""
+    prev = _real_chip_round(1.87, BASE_PHASES)
+    cur = _real_chip_round(4.43, dict(BASE_PHASES, wait_ready=2.71))
+    _write_rounds(tmp_path, prev, cur)
+    rc = bench_trend.main(str(tmp_path))
+    assert rc == 1  # unexplained regression still fails the gate
+    err = capsys.readouterr().err
+    assert "attribution: real_chip_flip_s" in err
+    assert "wait_ready" in err
+    assert "chip-side" in err
+
+
+def test_bench_trend_attribution_does_not_unfail_the_gate(tmp_path):
+    """Attribution is commentary; an acknowledged regression still
+    passes and an unexplained one still fails."""
+    prev = _real_chip_round(1.87, BASE_PHASES)
+    cur = _real_chip_round(4.43, dict(BASE_PHASES, wait_ready=2.71))
+    cur["extras"]["regression_note"] = "known slow chip day"
+    _write_rounds(tmp_path, prev, cur)
+    assert bench_trend.main(str(tmp_path)) == 0
+
+
+def test_bench_attr_cli_runs_on_committed_history(capsys):
+    """The standalone CLI never crashes on the real BENCH_r*.json
+    history (whatever mixed-era extras it carries)."""
+    rc = bench_attr.main([REPO])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "bench-attr:" in out

@@ -1,0 +1,394 @@
+"""fleetobs (ISSUE 9): exposition parse/merge/re-render validity, the
+SLO schema, burn-rate math, multi-window alerting, and the
+flight-recorder alert event."""
+
+import time
+
+import pytest
+
+from tpu_cc_manager.flightrec import FlightRecorder
+from tpu_cc_manager.obs import Metrics, validate_exposition
+from tpu_cc_manager.fleetobs import (
+    FleetObserver,
+    SloError,
+    SloObjective,
+    load_slo,
+    merge_snapshots,
+    parse_exposition,
+    render_snapshot,
+    validate_slo_doc,
+)
+
+
+def _objective(**kw):
+    base = dict(
+        name="flip-success", kind="error_ratio",
+        metric="tpu_cc_reconciles_total",
+        bad_labels=(("outcome", ("failure", "error")),),
+        target=0.99, fast_window_s=2.0, slow_window_s=10.0,
+        burn_threshold=2.0,
+    )
+    base.update(kw)
+    return SloObjective(**base)
+
+
+def _metrics(success=0, failure=0, durations=()):
+    m = Metrics()
+    for _ in range(success):
+        m.reconciles_total.inc("success")
+    for _ in range(failure):
+        m.reconciles_total.inc("failure")
+    for d in durations:
+        m.reconcile_duration.observe(d)
+    return m
+
+
+# ------------------------------------------------------- parse and merge
+def test_parse_roundtrips_a_real_metric_set():
+    m = _metrics(success=3, failure=1, durations=(0.2, 0.4))
+    snap, helps = parse_exposition(m.render())
+    assert snap["tpu_cc_reconciles_total"]["series"][
+        'outcome="success"'] == 3
+    hist = snap["tpu_cc_reconcile_duration_seconds"]["hist"][""]
+    assert hist["count"] == 2
+    assert hist["buckets"]["+Inf"] == 2
+    assert "tpu_cc_reconciles_total" in helps
+
+
+def test_merged_fleet_exposition_validates_at_scale():
+    """ISSUE 9 satellite: merging MANY replicas must yield an
+    exposition with no duplicate series and monotone buckets — checked
+    by the same strict validator every live /metrics passes."""
+    sources = [
+        _metrics(success=i % 5, failure=i % 3,
+                 durations=(0.01 * i, 0.5))
+        for i in range(64)
+    ]
+    snaps = [parse_exposition(m.render())[0] for m in sources]
+    merged = merge_snapshots(snaps)
+    text = render_snapshot(merged)
+    assert validate_exposition(text) == []
+    # counters summed fleet-wide
+    total = sum(i % 5 for i in range(64))
+    assert merged["tpu_cc_reconciles_total"]["series"][
+        'outcome="success"'] == total
+    hist = merged["tpu_cc_reconcile_duration_seconds"]["hist"][""]
+    assert hist["count"] == 128
+    assert hist["buckets"]["+Inf"] == 128
+
+
+def test_merge_survives_bucket_layout_drift():
+    """Replicas from two code versions may expose different bucket
+    ladders; the carry-forward merge must stay monotone (and
+    therefore valid) across the union of bounds."""
+    a = parse_exposition(
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 2\nh_bucket{le="1"} 5\n'
+        'h_bucket{le="+Inf"} 6\nh_sum 3.0\nh_count 6\n'
+    )[0]
+    b = parse_exposition(
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="0.5"} 3\nh_bucket{le="+Inf"} 4\n'
+        "h_sum 1.5\nh_count 4\n"
+    )[0]
+    merged = merge_snapshots([a, b])
+    assert validate_exposition(render_snapshot(merged)) == []
+    assert merged["h"]["hist"][""]["buckets"]["+Inf"] == 10
+
+
+def test_merge_survives_type_drift_under_one_name():
+    """A counter and a histogram under one family name (two code
+    versions in one fleet): first seen wins, the drifted input is
+    skipped — never a crash, and the merge still validates."""
+    a = parse_exposition("# HELP h x\n# TYPE h counter\nh 3\n")[0]
+    b = parse_exposition(
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="+Inf"} 4\nh_sum 1.5\nh_count 4\n'
+    )[0]
+    for order in ([a, b], [b, a]):
+        merged = merge_snapshots(order)
+        assert validate_exposition(render_snapshot(merged)) == []
+
+
+def test_observer_skips_invalid_scrape_and_counts_it():
+    good = _metrics(success=2)
+    bad = "# HELP a x\n# TYPE a gauge\na 1\na 2\n"  # duplicate series
+    obs = FleetObserver([_objective()])
+    merged = obs.observe([good.render, lambda: bad, lambda: 1 / 0])
+    assert merged["tpu_cc_reconciles_total"]["series"][
+        'outcome="success"'] == 2
+    assert obs.metrics.scrapes_total.value("ok") == 1
+    assert obs.metrics.scrapes_total.value("invalid") == 1
+    assert obs.metrics.scrapes_total.value("unreachable") == 1
+    assert obs.aggregation_problems == []
+
+
+# ------------------------------------------------------------ slo schema
+def test_slo_schema_accepts_the_committed_file():
+    yaml = pytest.importorskip("yaml")
+    from tpu_cc_manager.fleetobs import default_slo_path
+
+    objectives = load_slo(default_slo_path())
+    names = {o.name for o in objectives}
+    assert {"flip-success", "reconcile-latency", "publish-loss"} <= names
+    for o in objectives:
+        assert 0 < o.target < 1
+        assert o.fast_window_s < o.slow_window_s
+
+
+def test_slo_schema_rejections():
+    def errs(doc):
+        return validate_slo_doc(doc)[1]
+
+    assert errs([])  # not a mapping
+    assert errs({"version": 2, "objectives": []})
+    base = {
+        "name": "x", "kind": "error_ratio",
+        "metric": "tpu_cc_reconciles_total",
+        "bad_labels": {"outcome": ["failure"]},
+        "target": 0.99, "windows": {"fast_s": 2, "slow_s": 10},
+        "burn_threshold": 2.0,
+    }
+    ok_doc = {"version": 1, "objectives": [base]}
+    objectives, errors = validate_slo_doc(ok_doc)
+    assert errors == [] and len(objectives) == 1
+
+    def variant(**kw):
+        o = dict(base)
+        o.update(kw)
+        return {"version": 1, "objectives": [o]}
+
+    assert any("unknown key" in e for e in errs(variant(bogus=1)))
+    assert errs(variant(target=1.5))
+    assert errs(variant(windows={"fast_s": 10, "slow_s": 2}))
+    assert errs(variant(burn_threshold=0.5))
+    # booleans are int subclasses; `fast_s: true` must not validate
+    # as a 1-second window (same stance the scenario schema takes)
+    assert errs(variant(windows={"fast_s": True, "slow_s": 10}))
+    assert errs(variant(burn_threshold=True))
+    assert errs(variant(target=True))
+    assert errs(variant(kind="latency"))  # latency needs threshold_s
+    assert errs(variant(threshold_s=1.0))  # only for latency
+    assert errs(variant(kind="nope"))
+    # error_ratio with NEITHER bad_labels nor total_metric
+    o = dict(base)
+    del o["bad_labels"]
+    assert errs({"version": 1, "objectives": [o]})
+    # duplicate names
+    assert any("duplicate" in e
+               for e in errs({"version": 1, "objectives": [base, base]}))
+
+
+def test_load_slo_raises_on_bad_file(tmp_path):
+    pytest.importorskip("yaml")
+    p = tmp_path / "slo.yaml"
+    p.write_text("version: 1\nobjectives:\n  - name: x\n")
+    with pytest.raises(SloError):
+        load_slo(str(p))
+
+
+# ------------------------------------------------------------- burn math
+def test_burn_rate_rises_under_failures_and_alert_fires():
+    rec = FlightRecorder(name="obs-test")
+    obs = FleetObserver(
+        [_objective(burn_threshold=2.0)], recorder=rec,
+    )
+    m = _metrics(success=10)
+    t0 = time.time()
+    obs.observe([m.render], now=t0)
+    # a clean second sample: no burn
+    for _ in range(5):
+        m.reconciles_total.inc("success")
+    obs.observe([m.render], now=t0 + 1)
+    assert obs.metrics.burn_rate.value("flip-success", "fast") == 0.0
+    assert obs.alerts == []
+    # failure storm: 50% bad over the window -> burn 50/1% = 50x
+    for _ in range(10):
+        m.reconciles_total.inc("failure")
+        m.reconciles_total.inc("success")
+    obs.observe([m.render], now=t0 + 2)
+    fast = obs.metrics.burn_rate.value("flip-success", "fast")
+    assert fast > 2.0
+    assert len(obs.alerts) == 1
+    alert = obs.alerts[0]
+    assert alert["objective"] == "flip-success"
+    # the alert event landed in the flight recorder's black box
+    events = rec.snapshot("test")["events"]
+    assert any(e["kind"] == "slo_burn"
+               and e["objective"] == "flip-success" for e in events)
+    # budget burned below 1.0
+    assert obs.metrics.budget_remaining.value("flip-success") < 1.0
+    # problems line while firing
+    assert any("flip-success" in p for p in obs.problems())
+    # still firing: no duplicate alert entry
+    for _ in range(4):
+        m.reconciles_total.inc("failure")
+    obs.observe([m.render], now=t0 + 3)
+    assert len(obs.alerts) == 1
+    # recovery: clean traffic drives the fast window under threshold
+    for _ in range(400):
+        m.reconciles_total.inc("success")
+    obs.observe([m.render], now=t0 + 30)
+    assert not obs._firing["flip-success"]
+    assert obs.problems() == []
+
+
+def test_clean_run_burns_no_budget():
+    """The acceptance pin's unit half: all-success traffic leaves every
+    budget untouched and fires nothing."""
+    obs = FleetObserver([
+        _objective(),
+        _objective(name="latency", kind="latency",
+                   bad_labels=(), threshold_s=2.5, target=0.9,
+                   metric="tpu_cc_reconcile_duration_seconds"),
+    ])
+    m = _metrics(success=5, durations=(0.1, 0.2))
+    t0 = time.time()
+    for i in range(4):
+        m.reconciles_total.inc("success")
+        m.reconcile_duration.observe(0.05)
+        obs.observe([m.render], now=t0 + i)
+    assert obs.alerts == []
+    assert obs.metrics.budget_remaining.value("flip-success") == 1.0
+    assert obs.metrics.budget_remaining.value("latency") == 1.0
+    assert obs.problems() == []
+
+
+def test_budget_judges_the_retained_span_not_process_lifetime():
+    """Counters are cumulative; the budget must be charged only for
+    events INSIDE the observer's retained span — failures from before
+    it started watching (simlab's initial-convergence traffic, an
+    incident before a restart of the observer) never depress the
+    gauge."""
+    obs = FleetObserver([_objective()])
+    # 5 failures happened BEFORE the first observation
+    m = _metrics(success=10, failure=5)
+    t0 = time.time()
+    obs.observe([m.render], now=t0)
+    for _ in range(10):
+        m.reconciles_total.inc("success")
+    obs.observe([m.render], now=t0 + 1)
+    assert obs.metrics.budget_remaining.value("flip-success") == 1.0
+    assert obs.alerts == []
+    # failures inside the span DO charge it
+    for _ in range(10):
+        m.reconciles_total.inc("failure")
+    obs.observe([m.render], now=t0 + 2)
+    assert obs.metrics.budget_remaining.value("flip-success") < 1.0
+
+
+def test_latency_objective_counts_observations_over_threshold():
+    obs = FleetObserver([
+        _objective(name="lat", kind="latency", bad_labels=(),
+                   threshold_s=2.5, target=0.5,
+                   metric="tpu_cc_reconcile_duration_seconds",
+                   burn_threshold=1.5),
+    ])
+    m = _metrics(durations=(0.1,))
+    t0 = time.time()
+    obs.observe([m.render], now=t0)
+    for _ in range(10):
+        m.reconcile_duration.observe(30.0)  # way over threshold
+    obs.observe([m.render], now=t0 + 1)
+    fast = obs.metrics.burn_rate.value("lat", "fast")
+    # 10/10 bad over the window against a 50% budget -> 2x
+    assert fast == pytest.approx(2.0)
+    assert len(obs.alerts) == 1
+
+
+def test_error_ratio_with_separate_total_metric():
+    obs = FleetObserver([
+        _objective(name="publish-loss", bad_labels=(),
+                   metric="tpu_cc_publications_dropped_total",
+                   total_metric="tpu_cc_reconciles_total",
+                   target=0.9, burn_threshold=1.5),
+    ])
+    m = _metrics(success=10)
+    t0 = time.time()
+    obs.observe([m.render], now=t0)
+    for _ in range(10):
+        m.reconciles_total.inc("success")
+    m.publications_dropped_total.inc("evidence", amount=5.0)
+    obs.observe([m.render], now=t0 + 1)
+    # 5 drops / 10 reconciles in window = 50% bad vs 10% budget -> 5x
+    assert obs.metrics.burn_rate.value(
+        "publish-loss", "fast") == pytest.approx(5.0)
+
+
+def test_kind_metric_type_mismatch_is_a_dead_objective_problem():
+    """Schema-valid but type-wrong (error_ratio over a histogram
+    family): the objective evaluates to a permanent 0 — the
+    alert-that-can-never-fire class. The first evaluation must record
+    it and surface a problems line, never stay silent."""
+    obs = FleetObserver([
+        _objective(name="dead", bad_labels=(),
+                   metric="tpu_cc_reconcile_duration_seconds",
+                   total_metric="tpu_cc_reconciles_total"),
+    ])
+    m = _metrics(success=3, durations=(0.2,))
+    t0 = time.time()
+    obs.observe([m.render], now=t0)
+    obs.observe([m.render], now=t0 + 1)
+    assert any("dead" in p and "can never fire" in p
+               for p in obs.problems())
+    assert "dead" in obs.summary()["misconfigured"]
+    # and the inverse: latency over a counter family
+    obs2 = FleetObserver([
+        _objective(name="dead2", kind="latency", bad_labels=(),
+                   threshold_s=1.0, target=0.9,
+                   metric="tpu_cc_reconciles_total"),
+    ])
+    obs2.observe([m.render], now=t0)
+    assert any("dead2" in p for p in obs2.problems())
+
+
+def test_fleet_controller_surfaces_observer():
+    """Wiring half (fleet.py): a burning SLO joins the report's
+    problems digest + /report gains the slo status block, and the
+    rollup serves on /fleet/metrics (a separate route — concatenating
+    it with the controller's own set would duplicate agent families)."""
+    import urllib.request
+
+    from tpu_cc_manager import labels as L
+    from tpu_cc_manager.fleet import FleetController
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.k8s.objects import make_node
+
+    obs = FleetObserver([_objective(burn_threshold=1.5)])
+    m = _metrics(success=10)
+    t0 = time.time()
+    obs.observe([m.render], now=t0)
+    for _ in range(10):
+        m.reconciles_total.inc("failure")
+    obs.observe([m.render], now=t0 + 1)
+    assert obs.problems()
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={
+        L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on",
+    }))
+    ctrl = FleetController(kube, port=0, observer=obs)
+    report = ctrl.scan_once()
+    assert any("SLO flip-success burning" in p
+               for p in report["problems"])
+    assert report["slo"]["flip-success"]["burning"] is True
+    ctrl._server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ctrl.port}/fleet/metrics", timeout=5
+        ).read().decode()
+    finally:
+        ctrl._server.stop()
+    assert validate_exposition(body) == []
+    assert "tpu_cc_slo_burn_rate" in body
+    assert "tpu_cc_reconciles_total" in body  # the merged rollup
+
+
+def test_observer_render_is_a_valid_exposition():
+    obs = FleetObserver([_objective()])
+    sources = [_metrics(success=3, durations=(0.2,)).render
+               for _ in range(8)]
+    obs.observe(sources)
+    assert validate_exposition(obs.render()) == []
+    assert "tpu_cc_slo_budget_remaining" in obs.render()

@@ -29,6 +29,12 @@ SCHEMA_KEYS = {
     "spans", "events", "host_samples", "metrics",
 }
 
+#: a recorder wired with a time-series ring (the agent's, ISSUE 9)
+#: additionally embeds the windowed metric history — an optional
+#: section, so v1 readers keep working and bare recorders keep the
+#: historical shape
+AGENT_SCHEMA_KEYS = SCHEMA_KEYS | {"timeseries"}
+
 
 def test_sample_host_reads_proc():
     s = sample_host()
@@ -245,7 +251,7 @@ def test_reconcile_failure_dumps_black_box(tmp_path):
     dumps = os.listdir(tmp_path / "flightrec")
     assert len(dumps) == 1 and "reconcile_failure" in dumps[0]
     doc = json.loads(open(tmp_path / "flightrec" / dumps[0]).read())
-    assert set(doc) == SCHEMA_KEYS
+    assert set(doc) == AGENT_SCHEMA_KEYS
     assert doc["name"] == "n1"
     # spans: the failed flip is in the ring with its error, and —
     # because the dump runs AFTER the span context closes — so is the
@@ -286,7 +292,7 @@ def test_health_server_serves_flightrec_snapshot(tmp_path):
             doc = json.load(resp)
     finally:
         srv.stop()
-    assert set(doc) == SCHEMA_KEYS
+    assert set(doc) == AGENT_SCHEMA_KEYS
     assert doc["reason"] == "debug_get"
     assert any(s["name"] == "reconcile" for s in doc["spans"])
     # the GET wrote no file — it's the live snapshot, not a dump

@@ -1,0 +1,65 @@
+"""ISSUE 9 acceptance pins, live-replica half: a simlab fault scenario
+drives a measurable SLO burn (burn-rate gauge rises, alert event lands
+in the flight recorder, artifact carries the verdict) and a clean run
+burns no budget. Unit-level burn math lives in test_fleetobs.py."""
+
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from tpu_cc_manager.simlab.runner import SimLab  # noqa: E402
+from tpu_cc_manager.simlab.scenario import load_scenario  # noqa: E402
+
+SCENARIO_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scenarios",
+)
+
+
+@pytest.fixture(autouse=True)
+def _fast_scrapes(monkeypatch):
+    # the smoke scenarios' fault window is a few seconds wide; scrape
+    # responsively so the windows see it
+    monkeypatch.setenv("TPU_CC_FLEETOBS_INTERVAL_S", "0.25")
+
+
+def _run(name):
+    lab = SimLab(load_scenario(os.path.join(SCENARIO_DIR, name)))
+    return lab, lab.run()
+
+
+def test_write_429_storm_burns_the_flip_success_budget():
+    lab, art = _run("slo-fault-24.json")
+    assert art["ok"], art.get("notes")
+    slo = art["metrics"]["slo"]
+    assert "objectives" in slo, slo
+    # the storm fired the multi-window alert and burned real budget
+    fired = [a for a in slo["alerts"]
+             if a["objective"] == "flip-success"]
+    assert fired, slo["alerts"]
+    assert fired[0]["fast_burn"] >= 2.0
+    assert fired[0]["slow_burn"] >= 2.0
+    assert fired[0]["budget_remaining"] < 1.0
+    assert slo["objectives"]["flip-success"]["budget_remaining"] < 1.0
+    # the alert event is IN the black box (the dump surface)
+    events = [e for e in lab.obs_rec.snapshot("test")["events"]
+              if e["kind"] == "slo_burn"
+              and e["objective"] == "flip-success"]
+    assert events
+    # merging every replica's exposition stayed strictly valid
+    assert slo["aggregation_problems"] == []
+    assert slo["scrapes"]["ok"] > 0
+    assert slo["scrapes"]["invalid"] == 0
+
+
+def test_clean_run_burns_no_budget():
+    _, art = _run("slo-clean-16.json")
+    assert art["ok"], art.get("notes")
+    slo = art["metrics"]["slo"]
+    assert slo["alerts"] == []
+    for name in ("flip-success", "publish-loss"):
+        assert slo["objectives"][name]["budget_remaining"] == 1.0, name
+        assert not slo["objectives"][name]["burning"]
+    assert slo["aggregation_problems"] == []

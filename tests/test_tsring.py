@@ -1,0 +1,224 @@
+"""tsring (ISSUE 9): snapshot reflection, windowed rates, histogram
+quantile estimation edge cases, and the /debug/timeseries + flight-
+recorder surfaces."""
+
+import json
+import math
+import urllib.request
+
+from tpu_cc_manager.obs import HealthServer, Histogram, Metrics
+from tpu_cc_manager.tsring import (
+    TimeSeriesRing,
+    bucket_deltas,
+    counter_delta,
+    derive_window,
+    quantile_from_buckets,
+    snapshot_metric_set,
+    window_pair,
+)
+
+
+# ------------------------------------------------------------- snapshots
+def test_snapshot_reflects_every_metric_primitive():
+    """The ring samples by the same reflection the render uses: add a
+    metric attribute, touch nothing else, and it is sampled."""
+    m = Metrics()
+    m.reconciles_total.inc("success")
+    m.reconcile_duration.observe(0.2)
+    m.phase_duration.observe("flip", 0.1)
+    m.set_current_mode("on")
+    snap = snapshot_metric_set(m)
+    assert snap["tpu_cc_reconciles_total"]["type"] == "counter"
+    assert snap["tpu_cc_reconciles_total"]["series"][
+        'outcome="success"'] == 1.0
+    assert snap["tpu_cc_mode_info"]["series"]['mode="on"'] == 1.0
+    hist = snap["tpu_cc_reconcile_duration_seconds"]["hist"][""]
+    assert hist["count"] == 1
+    assert hist["buckets"]["+Inf"] == 1
+    # HistogramVec children keyed by their family label
+    vec = snap["tpu_cc_phase_duration_seconds"]["hist"]
+    assert 'phase="flip"' in vec
+    from tpu_cc_manager.obs import Gauge
+
+    m.zz_added = Gauge("tpu_cc_tsring_drift_probe", "added in a test")
+    m.zz_added.set(7.0)
+    snap2 = snapshot_metric_set(m)
+    assert snap2["tpu_cc_tsring_drift_probe"]["series"][""] == 7.0
+
+
+# ----------------------------------------------------------- window math
+def test_counter_rate_clamps_to_zero_on_reset():
+    """ISSUE 9 satellite: a counter reset (process restart inside the
+    window) must read as rate 0, never negative."""
+    assert counter_delta(100.0, 5.0) == 0.0
+    assert counter_delta(5.0, 100.0) == 95.0
+    assert counter_delta(None, 3.0) == 3.0
+
+
+def test_histogram_quantile_empty_window_is_none():
+    h = Histogram("h", "t", buckets=(0.1, 1.0))
+    snap1 = h.snapshot()
+    h_deltas = bucket_deltas(snap1, h.snapshot())
+    assert quantile_from_buckets(h_deltas, 0.5) is None
+    assert quantile_from_buckets([], 0.99) is None
+
+
+def test_histogram_quantile_single_bucket_interpolates():
+    # every windowed observation landed in the (0.1, 1.0] bucket:
+    # the estimate interpolates between the bounds
+    deltas = [(0.1, 0.0), (1.0, 10.0), (math.inf, 0.0)]
+    q50 = quantile_from_buckets(deltas, 0.5)
+    assert 0.1 < q50 <= 1.0
+    # single FIRST bucket: lower bound is 0
+    deltas = [(0.1, 4.0), (1.0, 0.0), (math.inf, 0.0)]
+    q = quantile_from_buckets(deltas, 0.5)
+    assert 0.0 < q <= 0.1
+
+
+def test_histogram_quantile_all_inf_saturates_at_highest_finite():
+    """Observations beyond every finite bucket: the estimate saturates
+    at the largest finite bound (never invents an unbounded number);
+    with no finite bucket at all it degrades to None."""
+    deltas = [(0.1, 0.0), (1.0, 0.0), (math.inf, 7.0)]
+    assert quantile_from_buckets(deltas, 0.99) == 1.0
+    assert quantile_from_buckets([(math.inf, 3.0)], 0.5) is None
+
+
+def test_histogram_window_counter_reset_clamps():
+    """A restarted process's histogram (smaller cumulative counts)
+    must yield a zero-observation window, not negative buckets."""
+    old = {"buckets": {"0.1": 50, "1": 80, "+Inf": 100},
+           "sum": 10.0, "count": 100}
+    new = {"buckets": {"0.1": 1, "1": 2, "+Inf": 3},
+           "sum": 0.5, "count": 3}
+    deltas = bucket_deltas(old, new)
+    assert all(n >= 0 for _, n in deltas)
+    assert sum(n for _, n in deltas) == 0
+    assert quantile_from_buckets(deltas, 0.99) is None
+
+
+def test_derive_window_rates_and_quantiles():
+    m = Metrics()
+    m.reconciles_total.inc("success")
+    m.reconcile_duration.observe(0.3)
+    old = (100.0, snapshot_metric_set(m))
+    for _ in range(10):
+        m.reconciles_total.inc("success")
+        m.reconcile_duration.observe(0.3)
+    new = (160.0, snapshot_metric_set(m))
+    doc = derive_window(old, new)
+    assert doc["window_s"] == 60.0
+    entry = doc["counters"]["tpu_cc_reconciles_total"][
+        'outcome="success"']
+    assert entry["value"] == 11
+    assert entry["window_delta"] == 10
+    assert entry["per_min"] == 10.0  # 10 flips in 60s
+    hist = doc["histograms"]["tpu_cc_reconcile_duration_seconds"][""]
+    assert hist["window_count"] == 10
+    # 0.3 lands in the (0.1, 0.5] bucket; the estimate must too
+    assert 0.1 < hist["p50"] <= 0.5
+    assert 0.1 < hist["p99"] <= 0.5
+
+
+def test_window_pair_spans_requested_window():
+    samples = [(float(t), {}) for t in range(0, 100, 10)]
+    old, new = window_pair(samples, 30.0)
+    assert new[0] == 90.0
+    assert old[0] == 60.0  # latest sample at-or-before the cutoff
+    # ring younger than the window: the whole ring answers
+    old, new = window_pair(samples, 1000.0)
+    assert old[0] == 0.0
+    assert window_pair(samples[:1], 30.0) is None
+
+
+# ------------------------------------------------------------- the ring
+def test_ring_tick_and_doc():
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=10.0, name="t")
+    m.reconciles_total.inc("success")
+    ring.tick(now=100.0)
+    for _ in range(5):
+        m.reconciles_total.inc("success")
+    ring.tick(now=130.0)
+    doc = ring.to_doc()
+    assert doc["tsring_version"] == 1
+    assert doc["samples"] == 2
+    assert doc["span_s"] == 30.0
+    rate = doc["derived"]["counters"]["tpu_cc_reconciles_total"][
+        'outcome="success"']
+    assert rate["per_min"] == 10.0
+    # raw points present on the route doc, elided for dumps
+    assert "points" in doc
+    pts = doc["points"]["tpu_cc_reconciles_total"]['outcome="success"']
+    assert pts == [[100.0, 1], [130.0, 6]]
+    assert "points" not in ring.to_doc(include_points=False)
+
+
+def test_ring_tick_never_raises():
+    ring = TimeSeriesRing(lambda: 1 / 0, name="broken")
+    assert ring.tick() is None
+    assert ring.samples() == []
+
+
+def test_ring_bounded_capacity():
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=1.0, capacity=4)
+    for t in range(10):
+        ring.tick(now=float(t))
+    samples = ring.samples()
+    assert len(samples) == 4
+    assert samples[0][0] == 6.0
+
+
+# ------------------------------------------------------------- surfaces
+def test_health_server_serves_debug_timeseries():
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=10.0, name="agent")
+    m.reconciles_total.inc("success")
+    ring.tick(now=1.0)
+    ring.tick(now=11.0)
+    srv = HealthServer(m, port=0, tsring=ring).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/timeseries", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["tsring_version"] == 1
+        assert doc["samples"] == 2
+    finally:
+        srv.stop()
+
+
+def test_health_server_404_when_tsring_unwired():
+    m = Metrics()
+    srv = HealthServer(m, port=0).start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/timeseries",
+                timeout=5,
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_flightrec_embeds_timeseries():
+    from tpu_cc_manager.flightrec import FlightRecorder
+
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=10.0, name="agent")
+    ring.tick(now=1.0)
+    ring.tick(now=11.0)
+    rec = FlightRecorder(name="n1", tsring=ring)
+    snap = rec.snapshot("test")
+    ts = snap["timeseries"]
+    assert ts["tsring_version"] == 1
+    assert ts["samples"] == 2
+    # dumps stay small: the embed carries the derivation, not the
+    # raw ring points
+    assert "points" not in ts
+    # unwired recorders keep the historical shape
+    assert "timeseries" not in FlightRecorder(name="n2").snapshot("t")

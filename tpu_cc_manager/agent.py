@@ -40,6 +40,7 @@ from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 from tpu_cc_manager.slice_coord import SliceAbortError
 from tpu_cc_manager.obs import HealthServer, Metrics, create_readiness_file
 from tpu_cc_manager.trace import JsonlSink, Tracer, get_tracer
+from tpu_cc_manager.tsring import TimeSeriesRing
 from tpu_cc_manager.watch import FatalWatchError, NodeWatcher, SyncableModeConfig
 
 log = logging.getLogger("tpu-cc-manager.agent")
@@ -85,12 +86,17 @@ class CCManagerAgent:
         self.tracer.add_sink(self.metrics.observe_span)
         if cfg.trace_file:
             self.tracer.add_sink(JsonlSink(cfg.trace_file))
+        # the in-process time-series ring (tsring.py, ISSUE 9):
+        # periodic snapshots of every registered metric, windowed into
+        # rates and quantile estimates on /debug/timeseries and inside
+        # flight-recorder dumps
+        self.tsring = TimeSeriesRing(self.metrics, name=cfg.node_name)
         # the per-process black box (flightrec.py, ISSUE 8): recent
         # spans + structured events + host-contention samples, dumped
         # on reconcile failure / SIGTERM / GET /debug/flightrec
         self.flightrec = FlightRecorder(
             name=cfg.node_name, metrics=self.metrics,
-            dump_dir=cfg.flightrec_dir,
+            dump_dir=cfg.flightrec_dir, tsring=self.tsring,
         )
         self.tracer.add_sink(self.flightrec.observe_span)
         # modules that can't take an injected recorder (the batcher's
@@ -847,9 +853,11 @@ class CCManagerAgent:
                 self.health = HealthServer(
                     self.metrics, port=cfg.health_port,
                     tracer=self.tracer, flightrec=self.flightrec,
+                    tsring=self.tsring,
                 ).start()
             except OSError as e:
                 log.warning("health server disabled: %s", e)
+        self.tsring.start()
 
         try:
             # initial read + reconcile (reference cmd/main.go:131-149,
@@ -923,6 +931,7 @@ class CCManagerAgent:
                 pass
         if self.slice_coordinator is not None:
             self.slice_coordinator.stop()
+        self.tsring.stop()
         self.watcher.stop()
         # best-effort final flush of deferred publications, then release
         # the engine's persistent flip-executor threads

@@ -214,6 +214,16 @@ def analyze_modules(
     thread roots → transitive lock-order/blocking + lockset race pass →
     findings (the baseline gate is the caller's job).
     """
+    findings, _ = _analyze_modules(modules, call_depth)
+    return findings
+
+
+def _analyze_modules(
+    modules: Sequence[Module], call_depth: Optional[int] = None
+) -> Tuple[List[Finding], list]:
+    """analyze_modules plus the per-module audits — analyze_paths
+    feeds the audits' metric-declaration registry to the slo
+    cross-check (analysis/slo.py)."""
     from tpu_cc_manager.analysis import (
         callgraph,
         dataflow,
@@ -244,7 +254,7 @@ def analyze_modules(
     findings.extend(rules.liveness_findings(audits))
     findings.extend(rules.direct_write_findings(modules))
     findings.extend(rules.planner_bypass_findings(modules))
-    return sorted(findings)
+    return sorted(findings), audits
 
 
 def analyze_paths(
@@ -264,11 +274,18 @@ def analyze_paths(
         mod = load_module(root, rel)
         if mod is not None:
             modules.append(mod)
-    findings = analyze_modules(modules, call_depth)
+    findings, audits = _analyze_modules(modules, call_depth)
     if with_manifests:
-        from tpu_cc_manager.analysis import manifests
+        from tpu_cc_manager.analysis import manifests, slo
 
         findings.extend(manifests.manifest_findings(root))
+        # the slo cross-check rides the manifest surface: schema
+        # (manifest-drift) + metric liveness against the scan's
+        # declaration registry (the metric-name rule, extended)
+        declared = {
+            name for a in audits for name in a.metric_decls
+        }
+        findings.extend(slo.slo_findings(root, declared))
     return sorted(findings)
 
 

@@ -40,6 +40,7 @@ from tpu_cc_manager.obs import (
 from tpu_cc_manager.plan import (
     FleetEncoding, analyze_encoding, compile_stats,
 )
+from tpu_cc_manager.tsring import TimeSeriesRing
 
 #: the shared node-watch pump and its wake filter moved to watch.py
 #: (the watch layer owns delta delivery now that the planner's feature
@@ -274,9 +275,16 @@ class FleetController:
         port: int = 8090,
         max_consecutive_errors: int = 10,
         leader_elector=None,
+        observer=None,
     ):
         self.kube = kube
         self.selector = selector
+        #: optional fleetobs.FleetObserver (ISSUE 9): when set, its
+        #: burning-SLO lines join every report's problems digest and
+        #: the fleet rollup exposition serves on /fleet/metrics. The
+        #: observer's scrape loop belongs to whoever constructed it —
+        #: this controller only *reads* it.
+        self.observer = observer
         #: optional tpu_cc_manager.leader.LeaderElector: when set, run()
         #: scans only while holding the Lease (standby replicas stay
         #: hot but quiet — see policy.py's identical gating)
@@ -349,11 +357,15 @@ class FleetController:
             "TPU_CC_FLEET_MIN_SCAN_GAP_S", 5.0
         )
         self._stop = threading.Event()
+        #: the controller's own metric history (tsring.py, ISSUE 9)
+        self.tsring = TimeSeriesRing(self.metrics, name="fleet")
         self._server = RouteServer(port, name="fleet-http")
         self._server.add_route("/healthz", self._healthz)
         self._server.add_route("/readyz", self._readyz)
         self._server.add_route("/metrics", self._metrics_route)
         self._server.add_route("/report", self._report_route)
+        self._server.add_route("/debug/timeseries", self._timeseries_route)
+        self._server.add_route("/fleet/metrics", self._fleet_metrics_route)
 
     # -------------------------------------------------------------- scans
     def scan_once(self) -> dict:
@@ -405,6 +417,12 @@ class FleetController:
             # live /report and `--once` stdout agree — an operator (or
             # alert rule) reads one field either way
             report["problems"] = fleet_problems(report)
+            if self.observer is not None:
+                # burning SLOs are fleet problems: the objective layer
+                # degrades GRADUALLY (budget burn) before any binary
+                # gate fails — surface it in the same digest
+                report["problems"].extend(self.observer.problems())
+                report["slo"] = self.observer.status()
             self.metrics.scan_duration.observe(time.monotonic() - t0)
             self.metrics.update(report)
             self.last_report = report
@@ -490,6 +508,21 @@ class FleetController:
     def _metrics_route(self):
         return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
 
+    def _timeseries_route(self):
+        return self.tsring.route()
+
+    def _fleet_metrics_route(self):
+        """The fleet ROLLUP exposition (fleetobs.py): replica series
+        merged fleet-wide plus the SLO burn/budget gauges. A separate
+        route from /metrics because the rollup re-exposes the agents'
+        family names — concatenating it with this controller's own set
+        would be exactly the duplicate-declaration bug the validator
+        exists to catch."""
+        if self.observer is None:
+            return 404, b"fleet observer not wired", "text/plain"
+        return (200, self.observer.render().encode(),
+                "text/plain; version=0.0.4")
+
     def _report_route(self):
         if self.last_report is None:
             return 503, b"no scan completed yet", "text/plain"
@@ -529,6 +562,7 @@ class FleetController:
     # ---------------------------------------------------------------- run
     def run(self) -> int:
         self._server.start()
+        self.tsring.start()
         # planner compile warmup (ISSUE 7, env-gated — plan.maybe_warmup)
         from tpu_cc_manager import plan
 
@@ -587,4 +621,5 @@ class FleetController:
         self._wake.set()  # unblock a wake-aware sleep immediately
         if self.leader_elector is not None:
             self.leader_elector.stop()  # release: standby takes over now
+        self.tsring.stop()
         self._server.stop()

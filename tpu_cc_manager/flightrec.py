@@ -101,6 +101,7 @@ class FlightRecorder:
         span_ring: int = SPAN_RING,
         event_ring: int = EVENT_RING,
         sample_ring: int = SAMPLE_RING,
+        tsring: Optional[Any] = None,
     ):
         #: identity stamped into every dump (node name for agents,
         #: replica name in simlab)
@@ -109,6 +110,10 @@ class FlightRecorder:
         #: controller metric sets) or a callable returning a dict;
         #: snapshotted at dump time, never continuously
         self._metrics = metrics
+        #: optional tsring.TimeSeriesRing (ISSUE 9): dumps then carry
+        #: the windowed rate/quantile history LEADING UP TO the crash,
+        #: not just the instant of it (points elided — dumps stay small)
+        self.tsring = tsring
         self.dump_dir = dump_dir or os.environ.get(
             "TPU_CC_FLIGHTREC_DIR") or None
         self.min_dump_interval_s = min_dump_interval_s
@@ -179,7 +184,7 @@ class FlightRecorder:
             spans = list(self._spans)
             events = list(self._events)
             samples = list(self._samples)
-        return {
+        doc = {
             "flightrec_version": SCHEMA_VERSION,
             "reason": reason,
             "at": round(time.time(), 3),
@@ -189,6 +194,14 @@ class FlightRecorder:
             "host_samples": samples,
             "metrics": self._metrics_snapshot(),
         }
+        if self.tsring is not None:
+            try:
+                doc["timeseries"] = self.tsring.to_doc(
+                    include_points=False)
+            except Exception:  # ccaudit: allow-swallow(black-box contract: a broken time-series ring must cost the dump one section, never the dump itself — the warning names the loss)
+                log.warning("flightrec timeseries embed failed",
+                            exc_info=True)
+        return doc
 
     # ------------------------------------------------------------ dumping
     def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:

@@ -653,11 +653,12 @@ class RouteServer:
 
 class HealthServer(RouteServer):
     def __init__(self, metrics: Metrics, port: int = 0, tracer=None,
-                 flightrec=None):
+                 flightrec=None, tsring=None):
         super().__init__(port, name="health-server")
         self.metrics = metrics
         self.tracer = tracer
         self.flightrec = flightrec
+        self.tsring = tsring
         self.live = True
         self.ready = False
         self.add_route("/healthz", self._healthz)
@@ -665,6 +666,7 @@ class HealthServer(RouteServer):
         self.add_route("/metrics", self._metrics)
         self.add_route("/debug/traces", self._traces)
         self.add_route("/debug/flightrec", self._flightrec)
+        self.add_route("/debug/timeseries", self._timeseries)
 
     def _healthz(self):
         return ((200, b"ok", "text/plain") if self.live
@@ -694,6 +696,14 @@ class HealthServer(RouteServer):
             sort_keys=True,
         ).encode()
         return 200, body, "application/json"
+
+    def _timeseries(self):
+        """The in-process time-series ring (tsring.py, ISSUE 9): the
+        windowed rates/quantiles plus the raw ring points — what two
+        hand-diffed /metrics scrapes used to approximate."""
+        if self.tsring is None:
+            return 404, b"timeseries ring not wired", "text/plain"
+        return self.tsring.route()
 
 
 def create_readiness_file(path: str) -> None:

@@ -391,11 +391,16 @@ class PolicyController:
             "TPU_CC_POLICY_MIN_SCAN_GAP_S", 2.0
         )
         self._wake_gap_pending = False
+        # the controller's own metric history (tsring.py, ISSUE 9)
+        from tpu_cc_manager.tsring import TimeSeriesRing
+
+        self.tsring = TimeSeriesRing(self.metrics, name="policy")
         self._server = RouteServer(port, name="policy-http")
         self._server.add_route("/healthz", self._healthz)
         self._server.add_route("/readyz", self._readyz)
         self._server.add_route("/metrics", self._metrics_route)
         self._server.add_route("/report", self._report_route)
+        self._server.add_route("/debug/timeseries", self._timeseries_route)
 
     # ------------------------------------------------------------- scans
     def scan_once(self, wait_rollout: bool = True) -> dict:
@@ -1516,6 +1521,9 @@ class PolicyController:
     def _metrics_route(self):
         return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
 
+    def _timeseries_route(self):
+        return self.tsring.route()
+
     def _report_route(self):
         if self.last_report is None:
             return 503, b"no scan completed yet", "text/plain"
@@ -1635,6 +1643,7 @@ class PolicyController:
 
     def run(self) -> int:
         self._server.start()
+        self.tsring.start()
         # planner compile warmup (ISSUE 7, env-gated): _scan dispatches
         # the jitted tick via analyze_pools, so the policy controller
         # deserves the same restart-in-milliseconds contract as fleet
@@ -1725,4 +1734,5 @@ class PolicyController:
         if self.leader_elector is not None:
             # releases the Lease so the standby takes over immediately
             self.leader_elector.stop()
+        self.tsring.stop()
         self._server.stop()

@@ -56,11 +56,18 @@ class ReplicaShell:
         tracer,
         *,
         evidence: bool = False,
+        metrics=None,
     ):
         self.node_name = node_name
         self.kube = kube
         self.backend = backend
         self.evidence = evidence
+        #: optional obs.Metrics — the SAME metric set a real agent
+        #: exposes, so this replica is a genuine scrape target for the
+        #: fleet observatory (fleetobs.py, ISSUE 9): outcomes, the
+        #: reconcile-duration histogram, and the batcher's publish-loss
+        #: counters all land here exactly as agent.py wires them
+        self.metrics = metrics
         # the write-coalescing layer (k8s.batch): the state-label write
         # is the replica's carrier — it transports the PREVIOUS
         # this replica's flight recording (ISSUE 8): small rings — the
@@ -76,8 +83,22 @@ class ReplicaShell:
         # events note into THIS replica's recorder (not the process
         # default), so a write-storm's retried/dropped keys reach the
         # collected recordings.
-        self.batcher = NodePatchBatcher(kube, node_name,
-                                        recorder=self.recorder)
+        if metrics is not None:
+            self.batcher = NodePatchBatcher(
+                kube, node_name, recorder=self.recorder,
+                on_coalesced=(
+                    lambda kind: metrics
+                    .publications_coalesced_total.inc(kind)
+                ),
+                on_retry=lambda kind: metrics.publish_retries_total.inc(),
+                on_drop=(
+                    lambda kind: metrics
+                    .publications_dropped_total.inc(kind)
+                ),
+            )
+        else:
+            self.batcher = NodePatchBatcher(kube, node_name,
+                                            recorder=self.recorder)
         self.engine = ModeEngine(
             set_state_label=self.batcher.write_state_label,
             drainer=NullDrainer(),
@@ -188,6 +209,9 @@ class ReplicaShell:
         self.recorder.note("reconcile", mode=mode, outcome=outcome)
         self.reconciles += 1
         self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        if self.metrics is not None:
+            self.metrics.reconciles_total.inc(outcome)
+            self.metrics.reconcile_duration.observe(root.dur_s)
         if ok:
             self.applied = mode
             if self.evidence:

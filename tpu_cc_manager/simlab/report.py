@@ -52,6 +52,7 @@ def build_artifact(
     faults: List[dict],
     controllers: dict,
     trace_stitch: Optional[dict] = None,
+    slo: Optional[dict] = None,
     notes: Optional[str] = None,
 ) -> dict:
     metrics = {
@@ -75,6 +76,12 @@ def build_artifact(
         metrics["trace_stitch"] = trace_stitch
         metrics["e2e_convergence_p99_s"] = trace_stitch.get(
             "e2e_convergence_p99_s")
+    if slo is not None:
+        # the fleet observatory's verdict (fleetobs.py, ISSUE 9):
+        # per-objective burn rates + budget remaining, the alert log,
+        # and the scrape/aggregation-validity accounting — or an
+        # honest {"skipped": reason} when the engine couldn't run
+        metrics["slo"] = slo
     artifact = {
         "artifact_version": ARTIFACT_VERSION,
         "scenario": scenario.name,

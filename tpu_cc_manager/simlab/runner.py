@@ -36,7 +36,7 @@ from tpu_cc_manager.k8s.apiserver import FakeApiServer
 from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
 from tpu_cc_manager.k8s.objects import make_node
 from tpu_cc_manager.obs import (
-    kube_throttle_wait_histogram, watch_pump_lag_histogram,
+    Metrics, kube_throttle_wait_histogram, watch_pump_lag_histogram,
 )
 from tpu_cc_manager.flightrec import FlightRecorder, stitch_by_trace
 from tpu_cc_manager.simlab.faults import FaultInjector
@@ -109,6 +109,16 @@ class SimLab:
                 self.ctrl_rec.observe_span(span)
 
         self._ctrl_sink = _ctrl_sink
+        # the fleet observatory (fleetobs.py, ISSUE 9): scrapes every
+        # replica's metric set in-process on an interval, merges the
+        # fleet exposition (validated), and burns SLO budgets from
+        # deployments/slo.yaml. Its alert events note into a dedicated
+        # black box collected with the rest of the recordings.
+        self.observer = None
+        self.slo_skipped: Optional[str] = None
+        self.obs_rec = FlightRecorder(
+            name="fleetobs", span_ring=8, event_ring=64, sample_ring=8,
+        )
         self.lag_hist = watch_pump_lag_histogram()
         self.throttle_hist = kube_throttle_wait_histogram()
         self._throttle_samples: List[float] = []
@@ -152,7 +162,33 @@ class SimLab:
                 name, self.data_kube,
                 fake_backend(n_chips=sc.chips_per_node),
                 self.tracer, evidence=sc.evidence,
+                metrics=Metrics(),
             )
+
+    def _start_observer(self) -> None:
+        """Build + start the SLO observer over every replica's metric
+        render (in-process scrape — zero HTTP load on the system under
+        test, and zero node writes by construction). Degrades loudly
+        to a skipped block when pyyaml or slo.yaml is unavailable —
+        observability must never fail a scenario on its own."""
+        from tpu_cc_manager import fleetobs
+
+        try:
+            objectives = fleetobs.load_slo(fleetobs.default_slo_path())
+        except ImportError:
+            self.slo_skipped = "pyyaml not installed"
+            log.warning("slo engine skipped: pyyaml not installed")
+            return
+        except fleetobs.SloError as e:
+            self.slo_skipped = f"slo.yaml invalid: {e}"
+            log.warning("slo engine skipped: %s", e)
+            return
+        self.observer = fleetobs.FleetObserver(
+            objectives, name=self.scenario.name, recorder=self.obs_rec,
+        )
+        self.observer.start(
+            [r.metrics.render for r in self.replicas.values()]
+        )
 
     def _start_controllers(self) -> None:
         sc = self.scenario
@@ -161,6 +197,7 @@ class SimLab:
 
             fleet = FleetController(
                 self._client(qps=sc.qps), interval_s=5.0, port=0,
+                observer=self.observer,
             )
             self._controllers.append(fleet)
             t = threading.Thread(target=fleet.run, daemon=True,
@@ -335,6 +372,10 @@ class SimLab:
                          f"to {sc.initial_mode!r}")
                 return self._finish(False, None, None, pending, faults,
                                     notes)
+            # observer starts AFTER the initial convergence storm: the
+            # SLO budgets judge the scenario timeline, not the lab's
+            # own setup traffic
+            self._start_observer()
             self._start_controllers()
 
             # ---- the timeline (actions are pre-sorted by `at`)
@@ -430,7 +471,8 @@ class SimLab:
         This is the cross-process latency ROADMAP item 2 asks for —
         measured from causal traces, not from the driver's poll."""
         recordings = [self.driver_rec.snapshot("run_end"),
-                      self.ctrl_rec.snapshot("run_end")]
+                      self.ctrl_rec.snapshot("run_end"),
+                      self.obs_rec.snapshot("run_end")]
         for r in self.replicas.values():
             recordings.append(r.recorder.snapshot("run_end"))
         stitched = stitch_by_trace(recordings)
@@ -536,6 +578,19 @@ class SimLab:
         if self.injector is not None:
             replica_stats["crashed"] = self.injector.crashed_total
             replica_stats["restarted"] = self.injector.restarted_total
+        # final SLO state: one closing observe() so the artifact's
+        # budget/alert story includes everything through settle, then
+        # the engine's summary (or the honest skip reason)
+        if self.observer is not None:
+            try:
+                self.observer.observe(
+                    [r.metrics.render for r in self.replicas.values()]
+                )
+            except Exception:
+                log.warning("closing slo observe failed", exc_info=True)
+            slo = self.observer.summary()
+        else:
+            slo = {"skipped": self.slo_skipped or "observer not started"}
         with self._phase_lock:
             phase_durations = {
                 k: list(v) for k, v in self._phase_durations.items()
@@ -553,11 +608,14 @@ class SimLab:
             faults=faults,
             controllers=controllers,
             trace_stitch=self._stitch_traces(),
+            slo=slo,
             notes=notes,
         )
 
     def _teardown(self) -> None:
         get_tracer().remove_sink(self._ctrl_sink)
+        if self.observer is not None:
+            self.observer.stop()
         if self.injector is not None:
             self.injector.cancel()
         for c in self._controllers:
