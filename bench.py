@@ -889,6 +889,64 @@ def run_simlab_bench():
     }
 
 
+def run_shard_bench():
+    """Sharded control plane at 1,024 LIVE replicas (ISSUE 11 /
+    ROADMAP item 2): the scale-1024 scenario runs four consistent-hash
+    controller shards over one shared node informer, kills one shard
+    host mid-rollout (and a second, un-restarted, for the repartition
+    storm), and must converge anyway. Two gated axes come out:
+    ``pool1024_convergence_s`` (the live-agent scale proof, bounded
+    relative to pool256 by bench_trend's 3x relative ceiling) and
+    ``shard_failover_convergence_s`` (shard kill -> fleet converged AND
+    the orphaned partition re-held by a survivor)."""
+    import os as _os
+
+    from tpu_cc_manager.simlab.runner import SimLab
+    from tpu_cc_manager.simlab.scenario import load_scenario
+
+    path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "scenarios", "scale-1024.json",
+    )
+    art = SimLab(load_scenario(path)).run()
+    if not art["ok"]:
+        print(f"FATAL: simlab scale-1024 failed: "
+              f"{art.get('notes')}", file=sys.stderr)
+        sys.exit(1)
+    m = art["metrics"]
+    shards = m.get("shards") or {}
+    stats = shards.get("stats") or {}
+    if m.get("shard_failover_convergence_s") is None:
+        # the scenario scripts two shard kills: a converged run with no
+        # failover number means the fault never fired or the monitor
+        # broke — the axis would silently fall out of the trend gate
+        print("FATAL: simlab scale-1024 converged but produced no "
+              f"shard failover number (shards={shards!r})",
+              file=sys.stderr)
+        sys.exit(1)
+    if shards.get("merged_exposition_problems"):
+        print("FATAL: merged per-shard /fleet/metrics exposition "
+              f"invalid ({shards['merged_exposition_problems']} "
+              "problem(s))", file=sys.stderr)
+        sys.exit(1)
+    return {
+        "pool1024_convergence_s": m["pool1024_convergence_s"],
+        "shard_failover_convergence_s": m["shard_failover_convergence_s"],
+        "simlab1024": {
+            "scenario": art["scenario"],
+            "shards": stats.get("shards"),
+            "hosts_live": stats.get("hosts_live"),
+            "failovers": stats.get("failovers"),
+            "merged_exposition_problems": shards.get(
+                "merged_exposition_problems"),
+            "watch_pump_lag_p50_s": m["watch_pump"]["lag_p50_s"],
+            "watch_pump_lag_p95_s": m["watch_pump"]["lag_p95_s"],
+            "reconciles": m["reconciles"]["total"],
+            "crashed": m["reconciles"].get("crashed", 0),
+        },
+    }
+
+
 def bench_dep_versions():
     """The benched jax/jaxlib/libtpu/numpy versions, stamped into the
     bench output (ISSUE 6 satellite / ROADMAP item 1): the r02-r05
@@ -988,6 +1046,11 @@ def main():
         # 256 LIVE agents (round 6): the simlab scale-256 scenario —
         # convergence under scripted faults joins the gated axes
         result["extras"].update(run_simlab_bench())
+        # 1024 LIVE agents through the sharded control plane (ISSUE 11):
+        # consistent-hash shards + shared informer + shard-kill
+        # failover; pool1024_convergence_s is bounded at 3x pool256 by
+        # bench_trend's relative ceiling
+        result["extras"].update(run_shard_bench())
     print(json.dumps(result))
 
 

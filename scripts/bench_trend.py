@@ -51,6 +51,15 @@ REGRESSION_FACTOR = 2.0
 #: FULLY broken stitch (zero samples -> null axis) cannot hide in the
 #: skip-if-absent rule here: bench.py itself exits 1 when the scenario
 #: converges with no stitched e2e samples.
+#: pool1024_convergence_s / shard_failover_convergence_s joined in r11
+#: (the sharded-control-plane round, ISSUE 11): 1,024 live replicas
+#: through N consistent-hash controller shards over one shared node
+#: informer — the axis that regresses if the shard layer (or the
+#: informer read path under it) quietly re-serializes, and the
+#: shard-kill -> reconverged latency that regresses if lease handoff
+#: or partition re-acquisition breaks. pool1024 is additionally bound
+#: RELATIVE to pool256 (RELATIVE_CEILINGS below): 4x the fleet must
+#: stay within 3x the convergence wall clock.
 GATED_EXTRA_AXES = {
     "real_chip_flip_s": "lower",
     "pool256_convergence_s": "lower",
@@ -59,6 +68,8 @@ GATED_EXTRA_AXES = {
     "fleet_scan_warm_s": "lower",
     "planner_tick_100k_s": "lower",
     "e2e_convergence_p99_s": "lower",
+    "pool1024_convergence_s": "lower",
+    "shard_failover_convergence_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are
@@ -86,6 +97,13 @@ WRITE_CEILINGS = {
 LATENCY_CEILINGS = {
     "fleet_scan_warm_s": 0.5,
     "planner_tick_100k_s": 9.0,
+}
+#: relative bars WITHIN the newest round (ISSUE 11 acceptance):
+#: numerator axis must stay <= factor x denominator axis. Skipped when
+#: either side is absent; a miss takes the same BENCH_NOTES/
+#: regression_note escape as every other bar.
+RELATIVE_CEILINGS = {
+    ("pool1024_convergence_s", "pool256_convergence_s"): 3.0,
 }
 
 
@@ -200,6 +218,15 @@ def main(root: str = ".") -> int:
                 problems.append(
                     f"{axis} {b} above the {ceiling:g} ceiling"
                 )
+    for (num_axis, den_axis), factor in RELATIVE_CEILINGS.items():
+        num, den = cur_x.get(num_axis), cur_x.get(den_axis)
+        if (isinstance(num, (int, float)) and num > 0
+                and isinstance(den, (int, float)) and den > 0
+                and num > den * factor):
+            problems.append(
+                f"{num_axis} {num} above {factor:g}x "
+                f"{den_axis} ({den})"
+            )
     if not problems:
         print(f"bench-trend: {os.path.basename(cur_path)} within "
               f"{REGRESSION_FACTOR}x of {os.path.basename(prev_path)}")

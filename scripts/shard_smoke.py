@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""shard-smoke: the sharded control plane's CI gate (ISSUE 11).
+
+Runs the reduced scale-512 scenario — 512 live replicas, 8 pools,
+3 consistent-hash controller shards over one shared node informer,
+one scripted shard kill mid-storm — and asserts the contract the
+full scale-1024 bench axis rides on:
+
+1. the fleet converges despite losing a controller shard;
+2. the orphaned partition is re-acquired by a survivor (the lease
+   handoff is stamped, and full coverage is restored);
+3. the kill -> recovered failover number exists and is sane;
+4. the merged per-shard /fleet/metrics exposition is VALID (one fleet
+   view, strict text-format rules — duplicate series or non-monotone
+   buckets fail here, not in a dashboard).
+
+Exit 0 = all checks pass. Prints one CHECK line per assertion so a red
+run names the broken contract, kind_smoke_local style.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_FAILED = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"CHECK {'ok  ' if ok else 'FAIL'} {name}"
+          + (f" — {detail}" if detail else ""))
+    if not ok:
+        _FAILED.append(name)
+
+
+def main() -> int:
+    from tpu_cc_manager.simlab.runner import SimLab
+    from tpu_cc_manager.simlab.scenario import load_scenario
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scenarios", "scale-512.json",
+    )
+    scenario = load_scenario(path)
+    check("scenario is sharded", scenario.controllers.shards >= 2,
+          f"shards={scenario.controllers.shards}")
+    art = SimLab(scenario).run()
+
+    check("fleet converged through the shard kill", art["ok"],
+          str(art.get("notes")))
+    m = art["metrics"]
+    conv = m.get("pool512_convergence_s")
+    check("convergence number present", conv is not None)
+
+    shards = m.get("shards") or {}
+    stats = shards.get("stats") or {}
+    failovers = stats.get("failovers") or []
+    check("the shard kill was recorded", len(failovers) == 1,
+          f"failovers={failovers!r}")
+    handoff = failovers[0].get("handoff_s") if failovers else None
+    check("orphaned partition re-acquired (handoff stamped)",
+          handoff is not None, f"failovers={failovers!r}")
+    coverage = stats.get("coverage") or {}
+    check("every partition covered by a live host",
+          bool(coverage) and all(coverage.values()),
+          f"coverage={coverage!r}")
+
+    fo = m.get("shard_failover_convergence_s")
+    check("shard_failover_convergence_s present", fo is not None)
+    if fo is not None and handoff is not None:
+        check("failover axis covers the lease handoff",
+              fo >= handoff - 0.05, f"fo={fo} handoff={handoff}")
+
+    check("merged /fleet/metrics exposition valid",
+          shards.get("merged_exposition_problems") == 0,
+          f"problems={shards.get('merged_exposition_problems')!r}")
+
+    out = os.environ.get("SHARD_SMOKE_ARTIFACT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(art, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"artifact written to {out}")
+
+    if _FAILED:
+        print(f"shard-smoke: {len(_FAILED)} check(s) FAILED: "
+              f"{_FAILED}", file=sys.stderr)
+        return 1
+    print("shard-smoke: all checks passed "
+          f"(pool512_convergence_s={conv}, "
+          f"shard_failover_convergence_s={fo}, handoff_s={handoff})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

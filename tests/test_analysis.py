@@ -900,3 +900,75 @@ def test_planner_bypass_nested_loop_reports_once():
     findings = run(src, relpath="tpu_cc_manager/policy.py")
     hits = [f for f in findings if f.rule == "planner-bypass"]
     assert len(hits) == 1
+
+
+# ----------------------------------------------------------- shard-bypass
+def test_shard_bypass_flags_partition_subscript_without_ring():
+    """ISSUE 11: indexing a shard partition table with anything but a
+    hash-ring lookup couples a shard to a partition it does not own —
+    the cross-shard double-writer the ring exists to prevent."""
+    src = """
+    class M:
+        def steal(self, other):
+            return self._partition[other]
+
+        def hardcode(self):
+            return self.mgr.pools_of("shard-2")
+    """
+    findings = run(src, relpath="tpu_cc_manager/shard.py")
+    hits = [f for f in findings if f.rule == "shard-bypass"]
+    assert len(hits) == 2
+    assert "owner_of" in hits[0].message
+    assert "hard-coded" in hits[1].message
+
+
+def test_shard_bypass_ring_lookup_and_other_modules_pass():
+    ring_src = """
+    class M:
+        def route(self, pool):
+            return self._partition[self.ring.owner_of(pool)]
+
+        def scoped(self, pool):
+            return self.mgr.pools_of(self.shard_of_pool(pool))
+    """
+    findings = run(ring_src, relpath="tpu_cc_manager/shard.py")
+    assert not [f for f in findings if f.rule == "shard-bypass"]
+    # the rule scopes to shard-aware modules: a dict named _partition
+    # elsewhere is someone else's business
+    naked = """
+    def f(d):
+        return d["_partition"] or _partition["x"]
+    """
+    for relpath in ("tpu_cc_manager/plan.py", "snippet.py"):
+        findings = run(naked, relpath=relpath)
+        assert not [f for f in findings if f.rule == "shard-bypass"], relpath
+
+
+def test_shard_bypass_pragma_allows_deliberate_access():
+    src = """
+    class M:
+        def debug_dump(self):
+            return self._partition["shard-0"]  # ccaudit: allow-shard-bypass(read-only debug surface enumerates every partition)
+    """
+    findings = run(src, relpath="tpu_cc_manager/shard.py")
+    assert not [f for f in findings if f.rule == "shard-bypass"]
+
+
+def test_shard_module_joins_write_and_planner_rule_scopes():
+    """ISSUE 11 satellite: shard.py is covered by the direct-node-write
+    and planner-bypass module sets — the shard layer hosts controllers,
+    it must never write nodes or re-grow Python mode loops itself."""
+    write_src = """
+    class S:
+        def bad(self):
+            self.kube.patch_node("n1", {})
+    """
+    findings = run(write_src, relpath="tpu_cc_manager/shard.py")
+    assert [f for f in findings if f.rule == "direct-node-write"]
+    loop_src = """
+    def derive(nodes):
+        for n in nodes:
+            x = n["metadata"]["labels"].get(L.CC_MODE_STATE_LABEL)
+    """
+    findings = run(loop_src, relpath="tpu_cc_manager/shard.py")
+    assert [f for f in findings if f.rule == "planner-bypass"]

@@ -313,6 +313,144 @@ def test_readyz_is_leader_aware():
     assert c2._readyz()[0] == 200
 
 
+# ------------------------------------------------- lease handoff drills
+def test_expiry_race_deposed_holder_demotes_on_cas_loss():
+    """The expiry race (ISSUE 11 satellite): the holder's renew and a
+    candidate's staleness takeover land on the same lease rv — exactly
+    one CAS wins. When the CANDIDATE wins, the old holder's next renew
+    must come back False (deposed), never retry into a double-leader."""
+    kube = FakeKube()
+    a, b = _elector(kube, "a"), _elector(kube, "b")
+    assert a.try_acquire_or_renew()
+    assert b.try_acquire_or_renew() is False  # b begins observing
+    time.sleep(0.45)  # a stops renewing; its lease goes stale on b's clock
+    assert b.try_acquire_or_renew() is True  # staleness takeover lands
+    # the deposed holder races its renew against b's fresh hold: the
+    # CAS rejects it and a must believe the deposition
+    assert a.try_acquire_or_renew() is False
+    lease = kube.get_lease("tpu-system", "test-lease")
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert lease["spec"]["leaseTransitions"] == 1
+    # and b keeps renewing unharmed
+    assert b.try_acquire_or_renew() is True
+
+
+def test_renew_under_429_holds_then_demotes_after_lease_duration():
+    """Renew-under-429 (API-server overload storm): a leader whose
+    renewals ERROR (not CAS-lose) stays leader only while its last
+    good renew is younger than the lease duration — beyond that it
+    must self-demote, because a peer may legitimately have taken
+    over."""
+
+    class StormKube(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.storm = False
+
+        def get_lease(self, ns, name):
+            if self.storm:
+                raise ApiException(429, "injected lease overload")
+            return super().get_lease(ns, name)
+
+        def replace_lease(self, ns, name, lease):
+            if self.storm:
+                raise ApiException(429, "injected lease overload")
+            return super().replace_lease(ns, name, lease)
+
+    kube = StormKube()
+    e = _elector(kube, "a").start()
+    try:
+        assert _wait(lambda: e.is_leader)
+        kube.storm = True
+        # within the lease duration: benefit of the doubt (flapping on
+        # every transient 429 would thrash the controllers)
+        time.sleep(0.15)
+        assert e.is_leader
+        # past the lease duration with no successful renew: demote —
+        # acting while unable to prove leadership is the double-writer
+        assert _wait(lambda: not e.is_leader, timeout=3), \
+            "leader failed to self-demote under a sustained 429 storm"
+        # storm ends: the same elector re-acquires (its own stale lease)
+        kube.storm = False
+        assert _wait(lambda: e.is_leader, timeout=3)
+    finally:
+        e.stop()
+
+
+def test_two_candidates_one_lease_exactly_one_takeover():
+    """Two candidates watch the same dead holder ripen; both fire the
+    takeover CAS in the same window — exactly one must win and the
+    loser must return to observing (never claim leadership)."""
+    kube = FakeKube()
+    holder = _elector(kube, "dead")
+    assert holder.try_acquire_or_renew()
+    a, b = _elector(kube, "a"), _elector(kube, "b")
+    # both start observing the same renewTime
+    assert a.try_acquire_or_renew() is False
+    assert b.try_acquire_or_renew() is False
+    time.sleep(0.45)  # the holder never renews again: staleness ripens
+
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def race(ident, elector):
+        barrier.wait()
+        results[ident] = elector.try_acquire_or_renew()
+
+    ts = [threading.Thread(target=race, args=(i, e))
+          for i, e in (("a", a), ("b", b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(results.values()) == [False, True], results
+    lease = kube.get_lease("tpu-system", "test-lease")
+    assert lease["spec"]["holderIdentity"] in ("a", "b")
+    assert lease["spec"]["leaseTransitions"] == 1
+    # the loser observed the move and re-observes: no takeover until
+    # the NEW holder goes stale for a full duration on its clock
+    loser = a if results["a"] is False else b
+    assert loser.try_acquire_or_renew() is False
+
+
+def test_abandon_keeps_lease_for_staleness_takeover():
+    """abandon() is the crash simulation (shard-kill drills): the
+    lease is NOT released, so a successor pays the full observed-
+    staleness wait — unlike stop(), whose release hands off
+    immediately."""
+    kube = FakeKube()
+    a = _elector(kube, "a").start()
+    assert _wait(lambda: a.is_leader)
+    a.abandon()
+    assert not a.is_leader
+    lease = kube.get_lease("tpu-system", "test-lease")
+    assert lease["spec"]["holderIdentity"] == "a"  # never released
+    b = _elector(kube, "b")
+    assert b.try_acquire_or_renew() is False  # must observe first
+    t0 = time.monotonic()
+    assert _wait(lambda: b.try_acquire_or_renew(), timeout=3)
+    assert time.monotonic() - t0 >= 0.3  # waited out the staleness
+
+
+def test_initial_delay_yields_the_create_race():
+    """initial_delay_s (shard placement): the handicapped candidate
+    does not contest the initial create — the preferred owner wins
+    placement — but competes normally afterwards."""
+    kube = FakeKube()
+    standby = _elector(kube, "standby", initial_delay_s=0.3).start()
+    preferred = _elector(kube, "preferred").start()
+    try:
+        assert _wait(lambda: preferred.is_leader)
+        time.sleep(0.5)  # past the standby's handicap
+        assert preferred.is_leader
+        assert not standby.is_leader
+        lease = kube.get_lease("tpu-system", "test-lease")
+        assert lease["spec"]["holderIdentity"] == "preferred"
+    finally:
+        preferred.stop()
+        standby.stop()
+
+
 def test_elector_client_is_never_flow_controlled(monkeypatch):
     """The elector gets its OWN unlimited client when the controller's
     client carries TPU_CC_KUBE_QPS flow control: a lease renewal that

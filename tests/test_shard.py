@@ -1,0 +1,442 @@
+"""Sharded control plane (tpu_cc_manager.shard, ISSUE 11): the
+consistent-hash ring's stability contract, the shared NodeInformer's
+zero-read scan path, partition-scoped clients, lease-per-shard
+placement and kill->survivor failover, and the merged fleet view.
+Plus the FakeKube watch-history compaction + pre-encoded fan-out the
+1,024-replica scenario leans on."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.k8s.client import ApiException
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.shard import (
+    HashRing, ShardManager, ShardScopedClient,
+)
+from tpu_cc_manager.watch import InformerKubeClient, NodeInformer
+
+POOL_LABEL = "simlab.pool"
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _fleet_kube(n=8, pools=4):
+    kube = FakeKube()
+    for i in range(n):
+        kube.add_node(make_node(f"n{i:03d}", labels={
+            L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+            POOL_LABEL: f"p{i % pools}",
+            L.CC_MODE_LABEL: "off",
+            L.CC_MODE_STATE_LABEL: "off",
+        }))
+    return kube
+
+
+# --------------------------------------------------------------- hash ring
+def test_ring_is_deterministic_and_total():
+    a = HashRing(["shard-0", "shard-1", "shard-2"])
+    b = HashRing(["shard-0", "shard-1", "shard-2"])
+    pools = [f"p{i}" for i in range(64)]
+    assert [a.owner_of(p) for p in pools] == [b.owner_of(p) for p in pools]
+    part = a.partition(pools)
+    assert sorted(sum(part.values(), [])) == sorted(pools)
+    # every shard gets work at 64 pools / 3 shards with vnodes
+    assert all(part[s] for s in a.members)
+
+
+def test_ring_without_moves_only_the_removed_members_keys():
+    """THE consistent-hash property: dropping one shard reassigns only
+    that shard's pools — everything else stays put (the repartition
+    storm's movement bound)."""
+    ring = HashRing(["shard-0", "shard-1", "shard-2", "shard-3"])
+    pools = [f"p{i}" for i in range(128)]
+    before = {p: ring.owner_of(p) for p in pools}
+    smaller = ring.without("shard-2")
+    after = {p: smaller.owner_of(p) for p in pools}
+    for p in pools:
+        if before[p] != "shard-2":
+            assert after[p] == before[p], p
+        else:
+            assert after[p] != "shard-2"
+
+
+def test_ring_rejects_empty_and_duplicates():
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing(["a", "a"])
+
+
+# ---------------------------------------------------------- node informer
+def test_informer_serves_reads_from_watch_fed_cache():
+    kube = _fleet_kube(n=4)
+    inf = NodeInformer(kube, name="t")
+    inf.prime()
+    inf.start()
+    try:
+        assert len(inf.list_nodes()) == 4
+        assert len(inf.list_nodes(f"{POOL_LABEL}=p0")) == 1
+        assert inf.get_node("n000")["metadata"]["name"] == "n000"
+        with pytest.raises(ApiException) as ei:
+            inf.get_node("ghost")
+        assert ei.value.status == 404
+        # a write lands in the cache via the watch, no reads needed
+        kube.set_node_labels("n000", {L.CC_MODE_LABEL: "on"})
+        assert _wait(lambda: inf.get_node("n000")["metadata"]["labels"]
+                     [L.CC_MODE_LABEL] == "on")
+        kube.add_node(make_node("n999", labels={POOL_LABEL: "p0"}))
+        assert _wait(lambda: len(inf.list_nodes()) == 5)
+    finally:
+        inf.stop()
+
+
+def test_informer_resumes_from_list_rv_no_gap():
+    """The informer's LIST-then-WATCH-from-rv contract: a write landing
+    between the priming list and the watch establishment is replayed,
+    never missed — a read cache cannot tolerate run_node_watch's
+    wake-covered gap."""
+    kube = _fleet_kube(n=2)
+    inf = NodeInformer(kube, name="gap")
+    inf.prime()
+    # the gap write: after the list, before the watch
+    kube.set_node_labels("n000", {L.CC_MODE_LABEL: "devtools"})
+    inf.start()
+    try:
+        assert _wait(lambda: inf.get_node("n000")["metadata"]["labels"]
+                     [L.CC_MODE_LABEL] == "devtools")
+    finally:
+        inf.stop()
+
+
+def test_informer_relists_through_410():
+    kube = _fleet_kube(n=3)
+    inf = NodeInformer(kube, name="g410", backoff_s=0.05)
+    inf.prime()
+    inf.start()
+    try:
+        kube.set_node_labels("n001", {L.CC_MODE_LABEL: "on"})
+        assert _wait(lambda: inf.get_node("n001")["metadata"]["labels"]
+                     [L.CC_MODE_LABEL] == "on")
+        # compact under the informer, then churn: the resume 410s and
+        # the informer must relist back to truth
+        kube.compact_watch_history()
+        kube.set_node_labels("n002", {L.CC_MODE_LABEL: "on"})
+        assert _wait(lambda: inf.get_node("n002")["metadata"]["labels"]
+                     [L.CC_MODE_LABEL] == "on")
+    finally:
+        inf.stop()
+
+
+def test_informer_wake_fires_on_relist_and_events_fan_out():
+    kube = _fleet_kube(n=2)
+    inf = NodeInformer(kube, name="subs")
+    events, wakes = [], []
+    token = inf.subscribe(
+        on_event=lambda e, n: events.append(
+            (e, n["metadata"]["name"])),
+        on_wake=lambda: wakes.append(1),
+    )
+    inf.prime()
+    assert wakes  # relist covers the gap -> wake
+    inf.start()
+    try:
+        kube.set_node_labels("n000", {L.CC_MODE_LABEL: "on"})
+        assert _wait(lambda: ("MODIFIED", "n000") in events)
+        inf.unsubscribe(token)
+        n = len(events)
+        kube.set_node_labels("n001", {L.CC_MODE_LABEL: "on"})
+        time.sleep(0.2)
+        assert len(events) == n  # unsubscribed: no more deliveries
+    finally:
+        inf.stop()
+
+
+def test_steady_state_scan_does_zero_node_reads():
+    """THE ISSUE 11 pin: an informer-fed FleetController's scans
+    perform 0 node read round trips — the priming LIST is the last
+    node read the control plane ever pays."""
+    from tpu_cc_manager.fleet import FleetController
+
+    kube = _fleet_kube(n=6)
+    inf = NodeInformer(kube, name="zero")
+    inf.prime()
+    ctrl = FleetController(
+        InformerKubeClient(inf, kube), port=0, informer=inf,
+    )
+    reads_after_prime = kube.node_read_requests
+    for _ in range(3):
+        report = ctrl.scan_once()
+    assert report["nodes"] == 6
+    assert kube.node_read_requests == reads_after_prime, (
+        "steady-state scans must be informer-fed: 0 node GET/LIST "
+        "round trips"
+    )
+
+
+# ------------------------------------------------------------ scoped client
+def test_scoped_client_filters_nodes_and_customs_writes_pass_through():
+    kube = _fleet_kube(n=8, pools=4)
+    kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+        "metadata": {"name": "pol-a"}, "spec": {}})
+    kube.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
+        "metadata": {"name": "pol-b"}, "spec": {}})
+
+    def own(node):
+        labels = node["metadata"].get("labels") or {}
+        return labels.get(POOL_LABEL) in ("p0", "p1")
+
+    scoped = ShardScopedClient(
+        kube, node_filter=own,
+        custom_filter=lambda name: name == "pol-a",
+    )
+    assert len(scoped.list_nodes()) == 4
+    assert {o["metadata"]["name"] for o in scoped.list_cluster_custom(
+        L.POLICY_GROUP, L.POLICY_VERSION, L.POLICY_PLURAL)} == {"pol-a"}
+    # writes and unscoped verbs delegate untouched
+    scoped.set_node_labels("n002", {L.CC_MODE_LABEL: "on"})
+    assert kube.get_node("n002")["metadata"]["labels"][
+        L.CC_MODE_LABEL] == "on"
+    assert scoped.get_node("n002")["metadata"]["name"] == "n002"
+
+
+# ------------------------------------------------------------ shard manager
+def _manager(kube, **kw):
+    kw.setdefault("shards", 2)
+    kw.setdefault("pools", ["p0", "p1", "p2", "p3"])
+    kw.setdefault("pool_label", POOL_LABEL)
+    kw.setdefault("fleet_interval_s", 0.2)
+    kw.setdefault("lease_duration_s", 0.4)
+    kw.setdefault("renew_period_s", 0.1)
+    kw.setdefault("retry_period_s", 0.05)
+    return ShardManager(lambda: kube, **kw)
+
+
+def test_shards_settle_one_per_host_and_scope_their_partition():
+    kube = _fleet_kube(n=8, pools=4)
+    mgr = _manager(kube)
+    mgr.start()
+    try:
+        assert mgr.wait_covered(timeout_s=10)
+        coverage = mgr.coverage()
+        # the initial-delay handicap: each preferred host wins its own
+        # shard's create race
+        assert coverage == {"shard-0": "host-0", "shard-1": "host-1"}
+        bundles = {b.shard_id: b for b in mgr.bundles()}
+        assert set(bundles) == {"shard-0", "shard-1"}
+        # each shard's fleet controller sees EXACTLY its partition
+        for sid, bundle in bundles.items():
+            report = bundle.fleet.scan_once()
+            want = sum(
+                2 for p in mgr.pools_of(sid)  # 8 nodes / 4 pools
+            )
+            assert report["nodes"] == want, (sid, report["nodes"])
+        # partition tables and the ring agree
+        for pool in ("p0", "p1", "p2", "p3"):
+            sid = mgr.shard_of_pool(pool)
+            assert pool in mgr.pools_of(sid)
+    finally:
+        mgr.stop()
+
+
+def test_shard_kill_survivor_reacquires_partition():
+    """The failover contract: crash one host (no lease release), the
+    survivor waits out staleness, takes the orphaned lease, and its
+    fresh ControllerShard covers the dead shard's pools."""
+    kube = _fleet_kube(n=8, pools=4)
+    mgr = _manager(kube)
+    mgr.start()
+    try:
+        assert mgr.wait_covered(timeout_s=10)
+        entry = mgr.kill_host(0)
+        assert entry["orphaned_shards"] == ["shard-0"]
+        assert mgr.wait_failovers(timeout_s=10)
+        stats = mgr.stats()
+        (failover,) = stats["failovers"]
+        assert failover["handoff_s"] is not None
+        # staleness, not instant theft: the takeover waited out at
+        # least one lease duration
+        assert failover["handoff_s"] >= 0.3
+        assert stats["coverage"] == {
+            "shard-0": "host-1", "shard-1": "host-1",
+        }
+        # the survivor runs BOTH partitions' controller bundles now
+        held = {b.shard_id for b in mgr.bundles()}
+        assert held == {"shard-0", "shard-1"}
+        # and the whole fleet is still scanned: union of shard scans
+        total = sum(
+            b.fleet.scan_once()["nodes"] for b in mgr.bundles()
+        )
+        assert total == 8
+    finally:
+        mgr.stop()
+
+
+def test_shard_restart_rejoins_as_standby_without_preemption():
+    kube = _fleet_kube(n=4, pools=4)
+    # a roomy lease: the no-preemption check below reads coverage
+    # INSIDE the staleness window, where takeover is impossible by
+    # construction — a loaded CI box must not turn renew starvation
+    # into a false preemption
+    mgr = _manager(kube, lease_duration_s=2.0, renew_period_s=0.1)
+    mgr.start()
+    try:
+        assert mgr.wait_covered(timeout_s=10)
+        mgr.kill_host(0)
+        assert mgr.wait_failovers(timeout_s=15)
+        out = mgr.restart_host(0)
+        assert out["restarted"] is True
+        assert mgr.hosts[0].alive
+        # no preemption: the survivor keeps renewing; the restarted
+        # host observes a live holder and stays standby (read well
+        # inside the lease duration — instant theft would show here)
+        time.sleep(0.5)
+        assert mgr.coverage()["shard-0"] == "host-1"
+    finally:
+        mgr.stop()
+
+
+def test_merged_fleet_metrics_is_one_valid_exposition():
+    from tpu_cc_manager.obs import validate_exposition
+
+    kube = _fleet_kube(n=8, pools=4)
+    mgr = _manager(kube)
+    mgr.start()
+    try:
+        assert mgr.wait_covered(timeout_s=10)
+        for b in mgr.bundles():
+            b.fleet.scan_once()
+        text = mgr.merged_fleet_metrics()
+        assert validate_exposition(text) == []
+        # the merge really aggregates: fleet-wide node count is the sum
+        # of the partitions, on one series
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("tpu_cc_fleet_nodes ")
+        )
+        assert float(line.split()[1]) == 8.0
+        assert "tpu_cc_shard_partitions_covered 2" in text
+    finally:
+        mgr.stop()
+
+
+# ------------------------------------------- fake watch history / fan-out
+def test_watch_history_compaction_is_chunked_and_bounded():
+    kube = FakeKube(watch_history_limit=10)
+    for i in range(200):
+        kube.add_node(make_node(f"c{i}"))
+    # bounded: never beyond limit + chunk; compacted back to limit
+    assert len(kube._events) <= 10 + kube._compact_chunk
+    assert len(kube._events) == len(kube._event_rvs)
+    # 410 contract intact: resuming below the retained window fails
+    with pytest.raises(ApiException) as ei:
+        list(kube.watch_nodes(resource_version="1", timeout_s=0.05))
+    assert ei.value.status == 410
+
+
+def test_cluster_events_are_bounded():
+    kube = FakeKube(watch_history_limit=10)
+    for i in range(200):
+        kube.create_event("default", {
+            "metadata": {"name": f"e{i}"}, "reason": "R",
+        })
+    assert len(kube.cluster_events) <= 10 + kube._compact_chunk
+    # newest retained
+    assert kube.cluster_events[-1]["metadata"]["name"] == "e199"
+
+
+def test_wire_watch_matches_clientset_watch_and_caches_encoding():
+    kube = FakeKube()
+    kube.add_node(make_node("w0", labels={L.CC_MODE_LABEL: "off"}))
+    kube.set_node_labels("w0", {L.CC_MODE_LABEL: "on"})
+    plain = list(kube.watch_nodes(resource_version="1", timeout_s=0.05))
+    wire = list(kube.watch_nodes_wire(resource_version="1",
+                                      timeout_s=0.05))
+    assert len(plain) == len(wire) == 1
+    decoded = json.loads(wire[0])
+    assert decoded["type"] == plain[0][0]
+    assert decoded["object"] == plain[0][1]
+    # the encode is cached: every watcher gets the same bytes object
+    wire2 = list(kube.watch_nodes_wire(resource_version="1",
+                                       timeout_s=0.05))
+    assert wire[0] is wire2[0]
+
+
+def test_wire_watch_fans_out_over_http():
+    """The apiserver's node-watch route rides the pre-encoded path:
+    same NDJSON the clientset sees, one encode fleet-wide."""
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+
+    with FakeApiServer() as srv:
+        srv.store.add_node(make_node("h0", labels={
+            L.CC_MODE_LABEL: "off"}))
+        kube = HttpKubeClient(
+            KubeConfig("127.0.0.1", srv.port, use_tls=False)
+        )
+        got = []
+        done = threading.Event()
+
+        def watch():
+            for etype, node in kube.watch_nodes(
+                    resource_version=srv.store.latest_rv, timeout_s=3):
+                got.append((etype, node["metadata"]["labels"]
+                            [L.CC_MODE_LABEL]))
+                done.set()
+                return
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        srv.store.set_node_labels("h0", {L.CC_MODE_LABEL: "on"})
+        assert done.wait(5)
+        assert got == [("MODIFIED", "on")]
+
+
+def test_informer_fed_controller_wakes_fingerprint_filtered():
+    """The informer feed must preserve run_node_watch's wake filter:
+    a report-relevant label change wakes the scan loop; a
+    doctor-republish that only moves its timestamp does not."""
+    from tpu_cc_manager.fleet import FleetController
+
+    kube = _fleet_kube(n=2)
+    kube.set_node_labels("n000", {L.DOCTOR_ANNOTATION: None})
+    inf = NodeInformer(kube, name="wake")
+    inf.prime()
+    ctrl = FleetController(
+        InformerKubeClient(inf, kube), port=0, informer=inf,
+    )
+    # wire the subscription exactly as run() does, without the loop
+    ctrl._informer_token = inf.subscribe(
+        on_event=ctrl._on_informer_event, on_wake=ctrl._wake.set,
+    )
+    inf.start()
+    try:
+        doc = {"ok": False, "fail": ["hbm"], "ts": 1}
+        kube.set_node_annotations(
+            "n000", {L.DOCTOR_ANNOTATION: json.dumps(doc)})
+        assert _wait(ctrl._wake.is_set)
+        ctrl._wake.clear()
+        # timestamp-only republish: same stable digest, no wake
+        doc2 = {"ok": False, "fail": ["hbm"], "ts": 2}
+        kube.set_node_annotations(
+            "n000", {L.DOCTOR_ANNOTATION: json.dumps(doc2)})
+        time.sleep(0.3)
+        assert not ctrl._wake.is_set()
+        # but the ENCODING still saw the delta (list truth aside)
+        kube.set_node_labels("n001", {L.CC_MODE_LABEL: "on"})
+        assert _wait(ctrl._wake.is_set)
+    finally:
+        ctrl.stop()
+        inf.stop()

@@ -337,3 +337,81 @@ def test_write_429_storm_coalesces_and_newest_generation_lands():
     assert writes["requests"] > writes["mutations"] or (
         writes["requests"] > 0 and writes["mutations"] > 0
     )
+
+
+# -------------------------------------------------------- sharded plane
+def test_shard_schema_cross_field_requirements():
+    # shards ride the fleet plane
+    with pytest.raises(ScenarioError, match="controllers.fleet"):
+        validate_scenario(_minimal(controllers={"shards": 2}))
+    # the policy-pair Lease does not exist in sharded mode
+    with pytest.raises(ScenarioError, match="mutually exclusive"):
+        validate_scenario(_minimal(controllers={
+            "fleet": True, "policy": True, "leader_elect": True,
+            "shards": 2}))
+    # shard_kill needs a shard plane, and the host must exist
+    with pytest.raises(ScenarioError, match="controllers.shards"):
+        validate_scenario(_minimal(
+            actions=[{"at": 0, "action": "fault",
+                      "fault": "shard_kill"}]))
+    with pytest.raises(ScenarioError, match="out of range"):
+        validate_scenario(_minimal(
+            controllers={"fleet": True, "shards": 2},
+            actions=[{"at": 0, "action": "fault", "fault": "shard_kill",
+                      "host": 5}]))
+    with pytest.raises(ScenarioError, match="must be an int"):
+        validate_scenario(_minimal(controllers={
+            "fleet": True, "shards": True}))
+    sc = validate_scenario(_minimal(
+        controllers={"fleet": True, "shards": 3}))
+    assert sc.controllers.shards == 3
+
+
+def test_bench_gated_shard_scenario_is_1024_nodes_with_kills():
+    """bench.py's pool1024_convergence_s / shard_failover_convergence_s
+    come from scale-1024.json: it must actually be 1024 nodes through a
+    sharded plane with a mid-rollout shard kill, or the gated axes
+    silently change meaning."""
+    sc = load_scenario(os.path.join(SCENARIO_DIR, "scale-1024.json"))
+    assert sc.nodes == 1024
+    assert sc.controllers.shards >= 2
+    kills = [a for a in sc.actions
+             if a.kind == "fault" and a.params["fault"] == "shard_kill"]
+    assert kills, "scale-1024 must script a shard-kill failover"
+    # the shard-smoke scenario is the reduced CI twin
+    sc512 = load_scenario(os.path.join(SCENARIO_DIR, "scale-512.json"))
+    assert sc512.nodes == 512 and sc512.controllers.shards >= 2
+
+
+def test_live_sharded_run_survives_shard_kill(tmp_path):
+    """The sharded plane end to end at suite scale: consistent-hash
+    shards over one shared informer, a mid-storm shard kill, fleet
+    convergence anyway, and an artifact carrying the failover number
+    and a VALID merged fleet exposition."""
+    from tpu_cc_manager.simlab.runner import SimLab
+
+    doc = _minimal(
+        name="shard-16", nodes=16, pools=4, workers=4,
+        watch_timeout_s=2, qps=50,
+        controllers={"fleet": True, "shards": 2},
+        actions=[
+            {"at": 0.2, "action": "set_mode", "mode": "on"},
+            {"at": 0.5, "action": "fault", "fault": "shard_kill",
+             "host": 0},
+        ],
+        converge={"mode": "on", "timeout_s": 60},
+    )
+    art = SimLab(validate_scenario(doc)).run()
+    assert art["ok"], art.get("notes")
+    shards = art["metrics"]["shards"]
+    assert shards["merged_exposition_problems"] == 0
+    stats = shards["stats"]
+    assert stats["shards"] == 2 and stats["hosts_live"] == 1
+    (failover,) = stats["failovers"]
+    assert failover["handoff_s"] is not None, (
+        "the orphaned partition was never re-acquired")
+    # the gated axis: kill -> converged AND coverage restored
+    fo = art["metrics"]["shard_failover_convergence_s"]
+    assert fo is not None and fo >= failover["handoff_s"] - 0.05
+    # every partition is covered by the surviving host
+    assert all(h == "host-1" for h in stats["coverage"].values()), stats

@@ -254,6 +254,7 @@ def _analyze_modules(
     findings.extend(rules.liveness_findings(audits))
     findings.extend(rules.direct_write_findings(modules))
     findings.extend(rules.planner_bypass_findings(modules))
+    findings.extend(rules.shard_bypass_findings(modules))
     return sorted(findings), audits
 
 

@@ -1530,6 +1530,9 @@ RECONCILE_PATH_MODULES = frozenset({
     "tpu_cc_manager/drain.py",
     "tpu_cc_manager/flipexec.py",
     "tpu_cc_manager/simlab/replica.py",
+    # the shard layer hosts controllers; it must never write nodes
+    # itself (ISSUE 11 — writes stay on the controllers' batched paths)
+    "tpu_cc_manager/shard.py",
 })
 
 #: the KubeClient write verbs that mutate a node object
@@ -1591,6 +1594,9 @@ def direct_write_findings(modules: Sequence[Module]) -> List[Finding]:
 PLANNER_SCAN_MODULES = frozenset({
     "tpu_cc_manager/fleet.py",
     "tpu_cc_manager/policy.py",
+    # shard.py scopes and hosts the scan controllers; a per-node mode
+    # loop creeping in there is the same reintroduced Python scan
+    "tpu_cc_manager/shard.py",
 })
 
 #: mode-classification label constants: reading one of these per node
@@ -1641,4 +1647,105 @@ def planner_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
                         text=mod.line_text(node.lineno),
                     )
                 )
+    return findings
+
+
+# ------------------------------------------------------------ shard bypass
+
+
+#: Modules that may hold shard-partition state (ISSUE 11): shard.py
+#: itself plus the scan controllers and the simlab runner that embed
+#: it. Pool->shard resolution must go through the consistent-hash
+#: ring (``HashRing.owner_of``); reaching into a partition table with
+#: any other key silently couples a shard to a partition it does not
+#: own — exactly the cross-shard double-writer the ring exists to
+#: prevent. A deliberate exception carries
+#: ``# ccaudit: allow-shard-bypass(reason)``.
+SHARD_AWARE_MODULES = frozenset({
+    "tpu_cc_manager/shard.py",
+    "tpu_cc_manager/fleet.py",
+    "tpu_cc_manager/policy.py",
+    "tpu_cc_manager/simlab/runner.py",
+})
+
+#: attribute names that hold a ring-derived pool partition table
+_PARTITION_TABLES = frozenset({
+    "_partition", "shard_pools", "owned_pools",
+})
+
+#: the sanctioned partition accessors; calling one with a hard-coded
+#: shard id is definitionally a ring bypass
+_PARTITION_ACCESSORS = frozenset({"pools_of"})
+
+#: the hash-ring lookup names whose presence in a subscript key makes
+#: the access sanctioned
+_RING_LOOKUPS = frozenset({"owner_of", "shard_of_pool"})
+
+
+def _uses_ring_lookup(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute) and func.attr in _RING_LOOKUPS:
+            return True
+        if isinstance(func, ast.Name) and func.id in _RING_LOOKUPS:
+            return True
+    return False
+
+
+def shard_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
+    """Flag cross-shard pool access outside the hash-ring lookup
+    (``shard-bypass``): subscripting a partition table with a key that
+    is not derived from ``owner_of()`` on the same expression, or
+    calling a partition accessor with a hard-coded shard id."""
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.relpath not in SHARD_AWARE_MODULES:
+            continue
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Subscript):
+                val = node.value
+                name = None
+                if isinstance(val, ast.Attribute):
+                    name = val.attr
+                elif isinstance(val, ast.Name):
+                    name = val.id
+                if (name in _PARTITION_TABLES
+                        and not _uses_ring_lookup(node.slice)):
+                    hit = (
+                        f"partition table {name!r} subscripted without "
+                        "a hash-ring lookup — resolve the owner with "
+                        "HashRing.owner_of(pool) (or pragma a "
+                        "deliberate cross-shard read with "
+                        "allow-shard-bypass naming why)"
+                    )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _PARTITION_ACCESSORS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and not _uses_ring_lookup(node)):
+                    hit = (
+                        f".{func.attr}() called with a hard-coded "
+                        "shard id — the pool->shard mapping belongs to "
+                        "the consistent-hash ring, not a literal; a "
+                        "deliberate exception needs an "
+                        "allow-shard-bypass pragma naming why"
+                    )
+            if hit is None:
+                continue
+            if mod.suppressed("shard-bypass", node.lineno):
+                continue
+            findings.append(
+                Finding(
+                    file=mod.relpath,
+                    line=node.lineno,
+                    rule="shard-bypass",
+                    message=hit,
+                    text=mod.line_text(node.lineno),
+                )
+            )
     return findings

@@ -46,7 +46,7 @@ from tpu_cc_manager.tsring import TimeSeriesRing
 #: (the watch layer owns delta delivery now that the planner's feature
 #: block rides it); re-exported here for embedders and history
 from tpu_cc_manager.watch import (  # noqa: F401
-    node_report_fingerprint, run_node_watch,
+    FingerprintWakeFilter, node_report_fingerprint, run_node_watch,
 )
 
 log = logging.getLogger("tpu-cc-manager.fleet")
@@ -276,9 +276,28 @@ class FleetController:
         max_consecutive_errors: int = 10,
         leader_elector=None,
         observer=None,
+        informer=None,
+        node_filter=None,
     ):
         self.kube = kube
         self.selector = selector
+        #: optional watch.NodeInformer (ISSUE 11): when set, this
+        #: controller does NOT open its own node watch — it subscribes
+        #: to the shared informer's delta/wake feed instead, and the
+        #: caller typically hands an informer-backed ``kube`` so scans
+        #: read fleet state from local memory (0 node read round trips
+        #: in steady state, pinned by tests/test_shard.py)
+        self.informer = informer
+        self._informer_token = None
+        #: the shared report-relevance wake filter for the informer
+        #: feed (run_node_watch keeps its own instance internally);
+        #: informer-delivery-thread-only after run() subscribes
+        self._informer_wake_filter = FingerprintWakeFilter(self._wake_scan)
+        #: optional partition predicate (shard.py): nodes failing it
+        #: are invisible to this controller — the watch feed applies it
+        #: exactly like the selector, so a shard's encoding never
+        #: ingests a foreign partition's nodes
+        self.node_filter = node_filter
         #: optional fleetobs.FleetObserver (ISSUE 9): when set, its
         #: burning-SLO lines join every report's problems digest and
         #: the fleet rollup exposition serves on /fleet/metrics. The
@@ -377,6 +396,11 @@ class FleetController:
             # degrades /healthz instead of crashing run() or — worse —
             # retrying forever with the error counter stuck at 0.
             nodes = self.kube.list_nodes(self.selector)
+            if self.node_filter is not None:
+                # shard partition scope: the scan sees exactly the
+                # nodes the watch feed admits (filter symmetry keeps
+                # encoding and list truth in agreement)
+                nodes = [n for n in nodes if self.node_filter(n)]
             # list truth reconciles the watch-fed feature block
             # (unchanged nodes cost a fingerprint compare, not a
             # re-encode), then ONE jitted planner tick answers the
@@ -544,7 +568,29 @@ class FleetController:
             labels = (node.get("metadata") or {}).get("labels") or {}
             if not match_selector(labels, self.selector):
                 return
+            if self.node_filter is not None and not self.node_filter(node):
+                return
         self._encoding.apply_event(etype, node)
+
+    def _wake_scan(self) -> None:
+        self._wake.set()
+
+    def _on_informer_event(self, etype: str, node: dict) -> None:
+        """Shared-informer delta: feed the encoding exactly like the
+        private watch did, and wake the scan loop on report-relevant
+        changes through the shared fingerprint filter
+        (watch.FingerprintWakeFilter). The selector/partition gate
+        applies to the wake too — a shared informer delivers the
+        WHOLE cluster's events, and at N shards an unscoped wake
+        would rescan every shard on every foreign-partition change."""
+        self._on_watch_event(etype, node)
+        if etype != "DELETED":
+            labels = (node.get("metadata") or {}).get("labels") or {}
+            if not match_selector(labels, self.selector):
+                return
+            if self.node_filter is not None and not self.node_filter(node):
+                return
+        self._informer_wake_filter(etype, node)
 
     def _watch_loop(self) -> None:
         """Background node watch via :func:`watch.run_node_watch`;
@@ -572,10 +618,17 @@ class FleetController:
             "+ watch-triggered)",
             self.port, self.selector, self.interval_s,
         )
-        watcher = threading.Thread(
-            target=self._watch_loop, name="fleet-watch", daemon=True
-        )
-        watcher.start()
+        if self.informer is not None:
+            # shared informer (ISSUE 11): its single watch stream feeds
+            # this controller's encoding and wake — no private watch
+            self._informer_token = self.informer.subscribe(
+                on_event=self._on_informer_event, on_wake=self._wake.set,
+            )
+        else:
+            watcher = threading.Thread(
+                target=self._watch_loop, name="fleet-watch", daemon=True
+            )
+            watcher.start()
         if self.leader_elector is not None:
             self.leader_elector.start()
         try:
@@ -619,6 +672,11 @@ class FleetController:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()  # unblock a wake-aware sleep immediately
+        if self.informer is not None and self._informer_token is not None:
+            # a stopped controller must not keep consuming the shared
+            # feed (shard demotion constructs a fresh one on re-promote)
+            self.informer.unsubscribe(self._informer_token)
+            self._informer_token = None
         if self.leader_elector is not None:
             self.leader_elector.stop()  # release: standby takes over now
         self.tsring.stop()

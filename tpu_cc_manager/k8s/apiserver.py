@@ -295,18 +295,25 @@ class _Handler(BaseHTTPRequestHandler):
         fs = q.get("fieldSelector", "")
         if fs.startswith("metadata.name="):
             name = fs.split("=", 1)[1]
+        # node watches ride the store's pre-encoded fan-out path: each
+        # event is serialized once fleet-wide (_WatchEvent.wire), not
+        # once per watcher — the O(history x watchers) encode cost was
+        # the fake apiserver's wall at four-digit replica counts
         self._stream_events(
-            lambda: self.store.watch_nodes(
+            lambda: self.store.watch_nodes_wire(
                 name=name,
                 resource_version=q.get("resourceVersion"),
                 timeout_s=float(q.get("timeoutSeconds", "300")),
                 allow_bookmarks=q.get("allowWatchBookmarks") == "true",
-            )
+            ),
+            wire=True,
         )
 
-    def _stream_events(self, iter_factory) -> None:
+    def _stream_events(self, iter_factory, wire: bool = False) -> None:
         """Serve one watch stream (chunked NDJSON, ERROR event on
-        ApiException, clean EOF at timeout) from any event iterator."""
+        ApiException, clean EOF at timeout) from any event iterator.
+        ``wire=True`` means the iterator already yields encoded NDJSON
+        lines (the shared-encode fan-out path)."""
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -318,11 +325,15 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             try:
-                for etype, obj in iter_factory():
-                    _chunk(
-                        json.dumps({"type": etype, "object": obj}).encode()
-                        + b"\n"
-                    )
+                if wire:
+                    for line in iter_factory():
+                        _chunk(line)
+                else:
+                    for etype, obj in iter_factory():
+                        _chunk(
+                            json.dumps({"type": etype, "object": obj}).encode()
+                            + b"\n"
+                        )
             except ApiException as e:
                 err = {
                     "type": "ERROR",

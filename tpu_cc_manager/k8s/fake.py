@@ -23,12 +23,39 @@ from __future__ import annotations
 
 import bisect
 import copy
+import json
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from tpu_cc_manager.k8s.client import ApiException, ConflictError, KubeClient
 from tpu_cc_manager.k8s.objects import match_selector, merge_patch
+
+
+class _WatchEvent:
+    """One retained watch event. The snapshot is deep-copied ONCE at
+    record time and never mutated; ``wire()`` caches the serialized
+    NDJSON line so N watchers fanning one event out over HTTP pay ONE
+    json.dumps instead of N (ISSUE 11: the apiserver's fan-out cost
+    used to scale O(history x watchers) in encoding alone)."""
+
+    __slots__ = ("rv", "etype", "obj", "_wire")
+
+    def __init__(self, rv: int, etype: str, obj: dict):
+        self.rv = rv
+        self.etype = etype
+        self.obj = obj
+        self._wire: Optional[bytes] = None
+
+    def wire(self) -> bytes:
+        # benign last-writer-wins: two watchers racing this encode the
+        # same immutable snapshot to identical bytes
+        # ccaudit: allow-race-lockset(idempotent memoization of an immutable snapshot: concurrent writers produce byte-identical values, a lost update costs one redundant json.dumps)
+        if self._wire is None:
+            self._wire = json.dumps(
+                {"type": self.etype, "object": self.obj}
+            ).encode() + b"\n"
+        return self._wire
 
 
 def _paginate(
@@ -54,9 +81,15 @@ class FakeKube(KubeClient):
         self._nodes: Dict[str, dict] = {}
         self._pods: Dict[Tuple[str, str], dict] = {}
         self._rv = 0
-        # watch history: list of (rv, type, node_snapshot), plus a
-        # parallel rv list so watchers bisect to their resume point
-        self._events: List[Tuple[int, str, dict]] = []
+        # watch history: _WatchEvent records plus a parallel rv list so
+        # watchers bisect to their resume point. Compaction is CHUNKED
+        # (trim only past limit + chunk, back down to limit): the old
+        # trim-on-every-write sliced a full limit-sized list per write
+        # once the ring filled — O(limit) per write, the quiet half of
+        # the fan-out wall long simlab runs hit (ISSUE 11 satellite).
+        # The 410 contract is unchanged: a resume below the oldest
+        # retained rv still fails at establishment.
+        self._events: List[_WatchEvent] = []
         self._event_rvs: List[int] = []
         self._history_limit = watch_history_limit
         # fault injection
@@ -81,6 +114,11 @@ class FakeKube(KubeClient):
         # keys, a taint-list change, a spec field).
         self.node_write_requests = 0
         self.node_write_mutations = 0
+        #: node READ round trips (get_node + list_nodes): the number
+        #: the informer refactor (ISSUE 11) drives to zero on the
+        #: steady-state scan path — tests/test_shard.py pins it.
+        #: peek_node_label is measurement surface and stays uncounted.
+        self.node_read_requests = 0
         #: when set, idle watches emit BOOKMARK events at this cadence
         #: (like a real API server with allowWatchBookmarks), letting
         #: clients keep their resourceVersion current through
@@ -101,19 +139,32 @@ class FakeKube(KubeClient):
         self._leases: Dict[Tuple[str, str], dict] = {}
 
     # ------------------------------------------------------------ helpers
+    @property
+    def _compact_chunk(self) -> int:
+        """Compaction slack: histories trim only once they exceed
+        limit + chunk (then back down to limit), amortizing the slice
+        over a quarter-limit of writes instead of paying O(limit) per
+        write. Derived from the LIVE limit so tests that shrink
+        ``_history_limit`` get proportionally tight compaction."""
+        return max(1, self._history_limit // 4)
+
     def _bump(self, obj: dict) -> None:
         self._rv += 1
         obj["metadata"]["resourceVersion"] = str(self._rv)
 
     def _record(self, etype: str, node: dict) -> None:
-        self._events.append((self._rv, etype, copy.deepcopy(node)))
+        self._events.append(
+            _WatchEvent(self._rv, etype, copy.deepcopy(node))
+        )
         self._event_rvs.append(self._rv)
-        if len(self._events) > self._history_limit:
+        if len(self._events) > self._history_limit + self._compact_chunk:
+            # chunked resourceVersion-window compaction: pay one slice
+            # per chunk of writes, not per write
             self._events = self._events[-self._history_limit:]
             self._event_rvs = self._event_rvs[-self._history_limit:]
         self._lock.notify_all()
 
-    def _events_after(self, rv: int) -> List[Tuple[int, str, dict]]:
+    def _events_after(self, rv: int) -> List[_WatchEvent]:
         """Retained node events with rv strictly greater than ``rv``
         (caller holds _lock). Binary search over the parallel rv list:
         a fleet of watchers rescanning the whole history linearly on
@@ -198,6 +249,7 @@ class FakeKube(KubeClient):
     # ------------------------------------------------------------- nodes
     def get_node(self, name: str) -> dict:
         with self._lock:
+            self.node_read_requests += 1
             node = self._nodes.get(name)
             if node is None:
                 raise ApiException(404, f"node {name} not found")
@@ -205,6 +257,7 @@ class FakeKube(KubeClient):
 
     def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
         with self._lock:
+            self.node_read_requests += 1
             if self.fail_next_lists > 0:
                 self.fail_next_lists -= 1
                 raise ApiException(429, "injected list overload")
@@ -397,6 +450,14 @@ class FakeKube(KubeClient):
             self._rv += 1
             stored["metadata"]["resourceVersion"] = str(self._rv)
             self.cluster_events.append(stored)
+            if len(self.cluster_events) > (self._history_limit
+                                           + self._compact_chunk):
+                # same chunked bound as the watch history: a long
+                # simlab run's Event stream must not grow memory
+                # forever (ISSUE 11 satellite)
+                self.cluster_events = (
+                    self.cluster_events[-self._history_limit:]
+                )
             return copy.deepcopy(stored)
 
     def list_events(self, namespace: str) -> List[dict]:
@@ -488,7 +549,8 @@ class FakeKube(KubeClient):
         self._custom_events.append(
             (self._rv, etype, group, plural, copy.deepcopy(obj))
         )
-        if len(self._custom_events) > self._history_limit:
+        if len(self._custom_events) > (self._history_limit
+                                       + self._compact_chunk):
             self._custom_events = self._custom_events[-self._history_limit:]
         self._lock.notify_all()
 
@@ -527,13 +589,18 @@ class FakeKube(KubeClient):
                 yield etype, copy.deepcopy(obj)
 
     # ------------------------------------------------------------- watch
-    def watch_nodes(
+    def _watch_stream(
         self,
-        name: Optional[str] = None,
-        resource_version: Optional[str] = None,
-        timeout_s: int = 300,
-        allow_bookmarks: bool = True,
-    ) -> Iterator[Tuple[str, dict]]:
+        name: Optional[str],
+        resource_version: Optional[str],
+        timeout_s: float,
+        allow_bookmarks: bool,
+    ) -> Iterator[Tuple[str, object]]:
+        """Shared watch core: yields ``("EVENT", _WatchEvent)`` and
+        ``("BOOKMARK", node_dict)`` — :meth:`watch_nodes` (clientset
+        shape) and :meth:`watch_nodes_wire` (pre-encoded apiserver fan
+        out) are thin views over it, so the rv/410/timeout semantics
+        cannot drift between the two."""
         with self._lock:
             if self.fail_next_watches > 0:
                 self.fail_next_watches -= 1
@@ -554,19 +621,19 @@ class FakeKube(KubeClient):
                     # streaming, this generator examines every event (even
                     # ones the name filter drops), so later compaction of
                     # already-examined history must not kill a live stream
-                    oldest_retained = self._events[0][0] if self._events else self._rv + 1
+                    oldest_retained = self._events[0].rv if self._events else self._rv + 1
                     if last_rv + 1 < oldest_retained and last_rv < self._rv:
                         # requested window fell out of history
                         raise ApiException(410, "too old resource version")
                 establishing = False
                 pending = [
-                    (rv, t, obj)
-                    for (rv, t, obj) in self._events_after(last_rv)
-                    if name is None or obj["metadata"]["name"] == name
+                    ev
+                    for ev in self._events_after(last_rv)
+                    if name is None or ev.obj["metadata"]["name"] == name
                 ]
                 if self._events:
                     # everything currently retained has now been examined
-                    last_rv = max(last_rv, self._events[-1][0])
+                    last_rv = max(last_rv, self._events[-1].rv)
                 if not pending:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -596,6 +663,42 @@ class FakeKube(KubeClient):
             if bookmark is not None:
                 yield "BOOKMARK", bookmark
                 continue
-            for rv, etype, obj in pending:
-                last_rv = max(last_rv, rv)
-                yield etype, copy.deepcopy(obj)
+            for ev in pending:
+                last_rv = max(last_rv, ev.rv)
+                yield "EVENT", ev
+
+    def watch_nodes(
+        self,
+        name: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_s: int = 300,
+        allow_bookmarks: bool = True,
+    ) -> Iterator[Tuple[str, dict]]:
+        for kind, item in self._watch_stream(
+            name, resource_version, timeout_s, allow_bookmarks
+        ):
+            if kind == "BOOKMARK":
+                yield "BOOKMARK", item  # type: ignore[misc]
+            else:
+                yield item.etype, copy.deepcopy(item.obj)  # type: ignore[union-attr]
+
+    def watch_nodes_wire(
+        self,
+        name: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_s: float = 300,
+        allow_bookmarks: bool = True,
+    ) -> Iterator[bytes]:
+        """The apiserver's fan-out path: NDJSON watch lines with the
+        per-event encode paid ONCE fleet-wide (``_WatchEvent.wire``),
+        instead of once per watcher per event. Bookmarks are per-stream
+        (they carry the stream's name) and stay encoded ad hoc."""
+        for kind, item in self._watch_stream(
+            name, resource_version, timeout_s, allow_bookmarks
+        ):
+            if kind == "BOOKMARK":
+                yield json.dumps(
+                    {"type": "BOOKMARK", "object": item}
+                ).encode() + b"\n"
+            else:
+                yield item.wire()  # type: ignore[union-attr]

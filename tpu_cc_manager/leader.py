@@ -70,6 +70,7 @@ class LeaderElector:
         lease_duration_s: float = LEASE_DURATION_S,
         renew_period_s: float = RENEW_PERIOD_S,
         retry_period_s: float = RETRY_PERIOD_S,
+        initial_delay_s: float = 0.0,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
     ):
@@ -86,6 +87,12 @@ class LeaderElector:
         self.lease_duration_s = lease_duration_s
         self.renew_period_s = renew_period_s
         self.retry_period_s = retry_period_s
+        #: handicap before the FIRST election attempt: a standby
+        #: candidate (shard.py's non-preferred hosts) yields the
+        #: initial create race to the preferred owner, then competes
+        #: normally — takeover still requires observed staleness, so
+        #: the delay only shapes placement, never safety
+        self.initial_delay_s = initial_delay_s
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._is_leader = False
@@ -219,6 +226,8 @@ class LeaderElector:
             return False
 
     def _loop(self) -> None:
+        if self.initial_delay_s > 0:
+            self._stop.wait(self.initial_delay_s)
         while not self._stop.is_set():
             try:
                 leading = self.try_acquire_or_renew()
@@ -246,6 +255,18 @@ class LeaderElector:
         )
         self._thread.start()
         return self
+
+    def abandon(self) -> None:
+        """Stop electing WITHOUT releasing the lease — the crash
+        simulation (shard-kill drills): the holder just vanishes, so a
+        peer takes over only after observing a full lease duration of
+        staleness, exactly like a real process death. Fires
+        ``on_stopped_leading`` (a crashing shard host must still tear
+        its controllers down in-process)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._set_leader(False)
 
     def stop(self) -> None:
         """Stop electing; if leading, release the lease (zero the

@@ -279,6 +279,7 @@ class PolicyController:
         utcnow_minutes_fn=None,
         leader_elector=None,
         max_rollouts: Optional[int] = None,
+        informer=None,
     ):
         if interval_s <= 0:
             raise ValueError(
@@ -377,6 +378,23 @@ class PolicyController:
             # (unfinished, heartbeat stops) and the new leader adopts it
             leader_elector.on_stopped_leading = self._on_demoted
             leader_elector.on_started_leading = self._on_promoted
+        #: optional watch.NodeInformer (ISSUE 11): when set, the node
+        #: watch sibling is NOT started — node wakes ride the shared
+        #: informer's feed (one watch stream per process, however many
+        #: controller shards run in it); the CR watch stays private
+        #: (policies are few and slow-moving). Callers typically also
+        #: hand an informer-backed kube so per-policy node lists read
+        #: from local cache.
+        self.informer = informer
+        self._informer_token = None
+        #: the shared report-relevance wake filter for the informer
+        #: feed (watch.FingerprintWakeFilter — run_node_watch keeps
+        #: its own); informer-delivery-thread-only after run()
+        from tpu_cc_manager.watch import FingerprintWakeFilter
+
+        self._informer_wake_filter = FingerprintWakeFilter(
+            self._node_wake
+        )
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
         #: coalescing gap applied after a NODE-event wake before the
@@ -1658,11 +1676,20 @@ class PolicyController:
             target=self._watch_loop, name="policy-watch", daemon=True
         )
         watcher.start()
-        node_watcher = threading.Thread(
-            target=self._node_watch_loop, name="policy-node-watch",
-            daemon=True,
-        )
-        node_watcher.start()
+        if self.informer is not None:
+            # shared informer (ISSUE 11): its delta feed supplies the
+            # node wakes the private watch sibling used to — same
+            # fingerprint filter, same coalescing-gap marking
+            self._informer_token = self.informer.subscribe(
+                on_event=self._informer_wake_filter,
+                on_wake=self._node_wake,
+            )
+        else:
+            node_watcher = threading.Thread(
+                target=self._node_watch_loop, name="policy-node-watch",
+                daemon=True,
+            )
+            node_watcher.start()
         if self.leader_elector is not None:
             self.leader_elector.start()
         try:
@@ -1731,6 +1758,9 @@ class PolicyController:
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()  # unblock the run loop promptly
+        if self.informer is not None and self._informer_token is not None:
+            self.informer.unsubscribe(self._informer_token)
+            self._informer_token = None
         if self.leader_elector is not None:
             # releases the Lease so the standby takes over immediately
             self.leader_elector.stop()

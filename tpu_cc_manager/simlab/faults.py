@@ -34,6 +34,7 @@ class FaultInjector:
         base_qps: float,
         lease_names: List[str],
         lease_namespace: str = "tpu-system",
+        shard_manager=None,
     ):
         self.store = store
         self.replicas = replicas
@@ -43,9 +44,16 @@ class FaultInjector:
         self.base_qps = base_qps
         self.lease_names = lease_names
         self.lease_namespace = lease_namespace
+        #: tpu_cc_manager.shard.ShardManager when the scenario runs a
+        #: sharded control plane (controllers.shards > 0)
+        self.shard_manager = shard_manager
         self._timers: List[threading.Timer] = []
         self.crashed_total = 0
         self.restarted_total = 0
+        #: monotonic stamp of the most recent shard_kill — the runner
+        #: derives shard_failover_convergence_s (kill -> fleet
+        #: converged) from it
+        self.last_shard_kill_t: float = 0.0
 
     # ------------------------------------------------------------ dispatch
     def inject(self, fault: str, params: dict, rel_t: float) -> dict:
@@ -164,6 +172,25 @@ class FaultInjector:
                 except (ConflictError, ApiException):
                     time.sleep(0.02)
         return {"leases_stolen": stolen}
+
+    def _shard_kill(self, params: dict) -> dict:
+        """Crash one controller shard host mid-run: its partition's
+        lease goes stale (no release) and a surviving host must
+        re-acquire it and resume the partition's controllers — the
+        failover drill the shard_failover_convergence_s axis times."""
+        if self.shard_manager is None:
+            return {"skipped": "no shard manager"}
+        host = int(params.get("host", 0))
+        self.last_shard_kill_t = time.monotonic()
+        entry = self.shard_manager.kill_host(host)
+        restart_after_s = params.get("restart_after_s")
+        if restart_after_s is not None:
+            self._timer(
+                float(restart_after_s),
+                lambda: self.shard_manager.restart_host(host),
+            )
+            entry["restart_after_s"] = float(restart_after_s)
+        return entry
 
     # ----------------------------------------------------------- teardown
     def cancel(self) -> None:

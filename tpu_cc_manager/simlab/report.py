@@ -53,6 +53,7 @@ def build_artifact(
     controllers: dict,
     trace_stitch: Optional[dict] = None,
     slo: Optional[dict] = None,
+    shards: Optional[dict] = None,
     notes: Optional[str] = None,
 ) -> dict:
     metrics = {
@@ -76,6 +77,15 @@ def build_artifact(
         metrics["trace_stitch"] = trace_stitch
         metrics["e2e_convergence_p99_s"] = trace_stitch.get(
             "e2e_convergence_p99_s")
+    if shards is not None:
+        # the sharded control plane's block (shard.py, ISSUE 11): ring
+        # partition + live coverage, the lease handoff log, merged
+        # fleet-view validity, and — when a shard_kill fault fired —
+        # the kill -> fleet-converged latency the
+        # shard_failover_convergence_s bench axis gates
+        metrics["shards"] = shards
+        metrics["shard_failover_convergence_s"] = shards.get(
+            "failover_convergence_s")
     if slo is not None:
         # the fleet observatory's verdict (fleetobs.py, ISSUE 9):
         # per-objective burn rates + budget remaining, the alert log,

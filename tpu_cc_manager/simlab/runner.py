@@ -84,6 +84,11 @@ class SimLab:
         self.injector: Optional[FaultInjector] = None
         self._controller_threads: List[threading.Thread] = []
         self._controllers: List[object] = []
+        #: tpu_cc_manager.shard.ShardManager when controllers.shards>0
+        self.shard_manager = None
+        #: monotonic stamp of measured-convergence completion (the
+        #: shard failover axis is kill -> this)
+        self._conv_end_t: Optional[float] = None
         self._phase_durations: Dict[str, List[float]] = {}
         self._phase_lock = threading.Lock()
         self.tracer = Tracer()
@@ -192,6 +197,32 @@ class SimLab:
 
     def _start_controllers(self) -> None:
         sc = self.scenario
+        if sc.controllers.shards:
+            # sharded control plane (ISSUE 11): N consistent-hash
+            # controller shards over ONE shared node informer — each
+            # shard a per-lease FleetController (and PolicyController
+            # when the scenario runs the policy plane) scoped to its
+            # pool partition; /fleet/metrics merges shard expositions
+            from tpu_cc_manager.shard import ShardManager
+
+            self.shard_manager = ShardManager(
+                lambda: self._client(qps=0),
+                shards=sc.controllers.shards,
+                pools=[f"p{i}" for i in range(sc.pools)],
+                pool_label=POOL_LABEL,
+                policy=sc.controllers.policy,
+                fleet_interval_s=5.0,
+                policy_interval_s=1.0,
+                verify_evidence=sc.evidence,
+            )
+            self.shard_manager.start()
+            if not self.shard_manager.wait_covered(timeout_s=15.0):
+                log.warning(
+                    "shard plane did not reach full partition coverage "
+                    "before the timeline; continuing (coverage: %s)",
+                    self.shard_manager.coverage(),
+                )
+            return
         if sc.controllers.fleet:
             from tpu_cc_manager.fleet import FleetController
 
@@ -377,6 +408,8 @@ class SimLab:
             # own setup traffic
             self._start_observer()
             self._start_controllers()
+            if self.shard_manager is not None:
+                self.injector.shard_manager = self.shard_manager
 
             # ---- the timeline (actions are pre-sorted by `at`)
             t0 = time.monotonic()
@@ -407,6 +440,8 @@ class SimLab:
             conv_s, pending = self._wait_converged(
                 sc.converge.mode, sc.converge.timeout_s
             )
+            if conv_s is not None:
+                self._conv_end_t = time.monotonic()
             if conv_s is not None and t_change is not None:
                 # convergence is change-initiation -> last node, not
                 # wait-start -> last node (actions after the initiating
@@ -458,6 +493,13 @@ class SimLab:
                     c.scan_once()
                 except Exception:
                     log.warning("final fleet scan failed",
+                                exc_info=True)
+        if self.shard_manager is not None:
+            for bundle in self.shard_manager.bundles():
+                try:
+                    bundle.fleet.scan_once()
+                except Exception:
+                    log.warning("final shard fleet scan failed",
                                 exc_info=True)
 
     # ------------------------------------------------------ trace stitch
@@ -578,6 +620,56 @@ class SimLab:
         if self.injector is not None:
             replica_stats["crashed"] = self.injector.crashed_total
             replica_stats["restarted"] = self.injector.restarted_total
+        shards = None
+        if self.shard_manager is not None:
+            from tpu_cc_manager.obs import validate_exposition
+
+            killed = bool(self.injector is not None
+                          and self.injector.last_shard_kill_t)
+            handoffs_done = False
+            if killed:
+                # the fleet may converge before the dead shard's lease
+                # ripens: the failover axis judges control-plane
+                # recovery too, so wait (bounded) for the coverage
+                # monitor to stamp every handoff
+                handoffs_done = self.shard_manager.wait_failovers(
+                    timeout_s=30.0
+                )
+            merged = self.shard_manager.merged_fleet_metrics()
+            stats = self.shard_manager.stats()
+            shards = {
+                "stats": stats,
+                # the one-fleet-view contract: the merged per-shard
+                # /fleet/metrics must itself be a valid exposition
+                "merged_exposition_problems": len(
+                    validate_exposition(merged)
+                ),
+            }
+            if killed and self._conv_end_t is not None:
+                # the ISSUE 11 failover axis: shard kill -> BOTH every
+                # node at the target mode AND the orphaned partition
+                # re-held by a survivor (whichever lands later). A
+                # handoff that never completed must leave the axis
+                # ABSENT (None downstream) — agents converge
+                # autonomously, so stamping convergence alone would
+                # let a broken lease takeover pass as a small, green
+                # number on the exact axis that gates it (bench.py and
+                # shard_smoke both fail loudly on None).
+                handoffs = [
+                    f["handoff_s"] for f in stats["failovers"]
+                    if f["handoff_s"] is not None
+                ]
+                if handoffs_done and handoffs:
+                    shards["failover_convergence_s"] = round(max(
+                        max(0.0, self._conv_end_t
+                            - self.injector.last_shard_kill_t),
+                        max(handoffs),
+                    ), 4)
+                else:
+                    log.error(
+                        "shard failover never completed: %s",
+                        stats["failovers"],
+                    )
         # final SLO state: one closing observe() so the artifact's
         # budget/alert story includes everything through settle, then
         # the engine's summary (or the honest skip reason)
@@ -609,6 +701,7 @@ class SimLab:
             controllers=controllers,
             trace_stitch=self._stitch_traces(),
             slo=slo,
+            shards=shards,
             notes=notes,
         )
 
@@ -623,6 +716,11 @@ class SimLab:
                 c.stop()
             except Exception:
                 log.warning("controller stop failed", exc_info=True)
+        if self.shard_manager is not None:
+            try:
+                self.shard_manager.stop()
+            except Exception:
+                log.warning("shard manager stop failed", exc_info=True)
         for t in self._controller_threads:
             t.join(timeout=5)
         if self.pump is not None:

@@ -55,6 +55,13 @@ FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
     # the leader demotes mid-rollout and a replica must take over and
     # adopt the unfinished record
     "leader_flap": {},
+    # crash one controller shard host (no lease release — survivors
+    # must wait out shard-lease staleness, then re-acquire its
+    # partition); optional restart brings it back as a standby. The
+    # repartition storm is several of these in sequence. Requires
+    # controllers.shards > 0.
+    "shard_kill": {"host": (False, int),
+                   "restart_after_s": (False, (int, float))},
 }
 
 #: action kind -> {param: (required, type(s))}; "fault" params are
@@ -87,6 +94,11 @@ class Controllers:
     fleet: bool = False
     policy: bool = False
     leader_elect: bool = False
+    #: 0 = the single fleet/policy controller pair; N > 0 = N
+    #: consistent-hash controller shards (tpu_cc_manager.shard), each
+    #: holding a per-shard lease and running partition-scoped
+    #: controllers over ONE shared node informer
+    shards: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -254,10 +266,17 @@ def validate_scenario(doc: dict) -> Scenario:
     raw_ctl = doc.get("controllers", {})
     if not isinstance(raw_ctl, dict):
         raise ScenarioError("controllers must be an object")
-    _reject_unknown(raw_ctl, {"fleet", "policy", "leader_elect"},
-                    "controllers")
+    _reject_unknown(raw_ctl, {"fleet", "policy", "leader_elect",
+                              "shards"}, "controllers")
     for key, value in raw_ctl.items():
-        if not isinstance(value, bool):
+        if key == "shards":
+            if isinstance(value, bool) or not isinstance(value, int):
+                raise ScenarioError("controllers.shards must be an int")
+            if not (0 <= value <= 64):
+                raise ScenarioError(
+                    f"controllers.shards must be in [0, 64], got {value}"
+                )
+        elif not isinstance(value, bool):
             raise ScenarioError(f"controllers.{key} must be a bool")
     controllers = Controllers(**raw_ctl)
     if controllers.leader_elect and not controllers.policy:
@@ -265,6 +284,20 @@ def validate_scenario(doc: dict) -> Scenario:
             "controllers.leader_elect requires controllers.policy "
             "(the Lease being flapped belongs to the policy pair)"
         )
+    if controllers.shards:
+        if not controllers.fleet:
+            raise ScenarioError(
+                "controllers.shards requires controllers.fleet (the "
+                "sharded plane is the fleet/policy controllers; with "
+                "neither there is nothing to shard)"
+            )
+        if controllers.leader_elect:
+            raise ScenarioError(
+                "controllers.shards and controllers.leader_elect are "
+                "mutually exclusive (shard leases are their own "
+                "election; the flapped policy-pair Lease does not "
+                "exist in sharded mode)"
+            )
 
     raw_conv = doc.get("converge")
     if not isinstance(raw_conv, dict):
@@ -295,6 +328,17 @@ def validate_scenario(doc: dict) -> Scenario:
             raise ScenarioError(
                 "leader_flap fault requires controllers.leader_elect"
             )
+        if a.kind == "fault" and a.params["fault"] == "shard_kill":
+            if not controllers.shards:
+                raise ScenarioError(
+                    "shard_kill fault requires controllers.shards > 0"
+                )
+            host = a.params.get("host", 0)
+            if not (0 <= host < controllers.shards):
+                raise ScenarioError(
+                    f"shard_kill host {host} out of range "
+                    f"[0, {controllers.shards})"
+                )
     return Scenario(
         name=doc["name"],
         nodes=nodes,

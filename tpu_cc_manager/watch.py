@@ -17,10 +17,11 @@ Takes the union of the reference's two watcher implementations
 
 from __future__ import annotations
 
+import copy
 import json
 import logging
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
@@ -148,6 +149,31 @@ def node_report_fingerprint(node: dict) -> Tuple[Any, ...]:
     return (relevant, ann.get(L.EVIDENCE_ANNOTATION), doctor)
 
 
+class FingerprintWakeFilter:
+    """The one report-relevance wake filter (shared by
+    :func:`run_node_watch` and the informer subscriptions in
+    fleet.py/policy.py): wake on DELETED or whenever a node's
+    :func:`node_report_fingerprint` changes — a periodic
+    doctor-republish that only moves its timestamp must not wake a
+    scan that finds nothing new. Single-threaded by contract: one
+    filter instance belongs to one watch/informer delivery thread."""
+
+    def __init__(self, wake: Callable[[], None]) -> None:
+        self.wake = wake
+        self._prints: Dict[str, object] = {}
+
+    def __call__(self, etype: str, node: dict) -> None:
+        name = (node.get("metadata") or {}).get("name", "")
+        if etype == "DELETED":
+            self._prints.pop(name, None)
+            self.wake()
+            return
+        fp = node_report_fingerprint(node)
+        if self._prints.get(name) != fp:
+            self._prints[name] = fp
+            self.wake()
+
+
 def run_node_watch(kube: Any, stop: threading.Event,
                    wake: Callable[[], None],
                    *, timeout_s: int, backoff_s: float,
@@ -169,7 +195,7 @@ def run_node_watch(kube: Any, stop: threading.Event,
     the planner's feature block tracks deltas instead of re-encoding
     the fleet each scan. The callee dedups; this pump only delivers."""
     rv = None
-    prints: Dict[str, object] = {}
+    relevant = FingerprintWakeFilter(wake)
     while not stop.is_set():
         if rv is None:
             # a fresh watch starts at "now" and cannot replay what
@@ -195,15 +221,7 @@ def run_node_watch(kube: Any, stop: threading.Event,
                     continue
                 if on_event is not None:
                     on_event(etype, obj)
-                name = meta.get("name", "")
-                if etype == "DELETED":
-                    prints.pop(name, None)
-                    wake()
-                    continue
-                fp = node_report_fingerprint(obj)
-                if prints.get(name) != fp:
-                    prints[name] = fp
-                    wake()
+                relevant(etype, obj)
                 if stop.is_set():
                     return
         except ApiException as e:
@@ -218,6 +236,295 @@ def run_node_watch(kube: Any, stop: threading.Event,
                            exc_info=True)
             rv = None
             stop.wait(backoff_s)
+
+
+class NodeInformer:
+    """Watch-fed shared node read cache (ISSUE 11) — the informer-style
+    layer that lets every controller read fleet state from local memory
+    instead of paying per-scan LIST/GET round trips (BENCH_NOTES r03:
+    the hot path is API round trips, not device work).
+
+    Grown out of this module's existing primitives: the delta feed is
+    :func:`run_node_watch`'s ``on_event`` hook shape, and the cache is
+    :class:`NodeWatcher`'s ``latest_node`` snapshot generalized to the
+    whole fleet. One informer serves N consumers (all controller
+    shards in a process share it), so the fleet pays ONE watch stream
+    and ONE priming LIST regardless of controller count.
+
+    Resume contract (the gap :func:`run_node_watch` tolerates but a
+    read cache cannot): LIST, remember the highest resourceVersion,
+    then WATCH **from that rv** — a write landing between the list and
+    the watch establishment is replayed, never missed. On 410 (history
+    compacted under us) or any transport failure the informer re-lists
+    and re-arms; consumers' ``on_wake`` fires once per relist to cover
+    the unreplayable gap exactly like the pump's fresh-connect wake.
+    When the client has no node-watch support at all, the informer
+    degrades to interval re-listing every ``resync_s`` so reads stay
+    bounded-stale instead of frozen."""
+
+    def __init__(
+        self,
+        kube: Any,
+        *,
+        watch_timeout_s: int = WATCH_TIMEOUT_S,
+        backoff_s: float = RECONNECT_BACKOFF_S,
+        resync_s: float = 30.0,
+        name: str = "informer",
+    ) -> None:
+        self.kube = kube
+        self.watch_timeout_s = watch_timeout_s
+        self.backoff_s = backoff_s
+        self.resync_s = resync_s
+        self.name = name
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, dict] = {}
+        self._rv: Optional[str] = None
+        self._primed = False
+        #: token -> (on_event, on_wake); mutated under _lock, iterated
+        #: on a snapshot so callbacks never run while it is held
+        self._subs: Dict[int, Tuple[Optional[Callable[[str, dict], None]],
+                                    Optional[Callable[[], None]]]] = {}
+        self._sub_seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # read/health accounting (exposed via stats())
+        self._lists_total = 0
+        self._events_total = 0
+        self._watch_supported = True
+
+    # ------------------------------------------------------------ consumers
+    def subscribe(
+        self,
+        on_event: Optional[Callable[[str, dict], None]] = None,
+        on_wake: Optional[Callable[[], None]] = None,
+    ) -> int:
+        """Register a delta/wake consumer; returns an unsubscribe
+        token. ``on_event`` receives every non-bookmark ``(etype,
+        node)`` delta (the :func:`run_node_watch` ``on_event`` shape);
+        ``on_wake`` fires once per relist — the consumer must treat it
+        as "anything may have changed" and re-read."""
+        with self._lock:
+            self._sub_seq += 1
+            token = self._sub_seq
+            self._subs[token] = (on_event, on_wake)
+        return token
+
+    def unsubscribe(self, token: int) -> None:
+        with self._lock:
+            self._subs.pop(token, None)
+
+    # -------------------------------------------------------------- reading
+    def list_nodes(
+        self,
+        label_selector: Optional[str] = None,
+        node_filter: Optional[Callable[[dict], bool]] = None,
+    ) -> List[dict]:
+        """Cache-served LIST: zero API round trips. Same shape and
+        copy semantics as ``KubeClient.list_nodes`` — callers may
+        mutate the returned objects freely. ``node_filter`` (the shard
+        partition predicate) runs BEFORE the deepcopy: at N shards a
+        post-copy filter would deepcopy the whole fleet per shard per
+        scan and throw (N-1)/N of it away, all under the shared
+        lock."""
+        from tpu_cc_manager.k8s.objects import match_selector
+
+        with self._lock:
+            # ccaudit: allow-blocking-under-lock(deepcopy of cached node objects, not I/O: copying inside the lock is what keeps readers consistent with the watch thread's swaps)
+            return [
+                copy.deepcopy(n) for n in self._nodes.values()
+                if match_selector(
+                    (n.get("metadata") or {}).get("labels") or {},
+                    label_selector,
+                ) and (node_filter is None or node_filter(n))
+            ]
+
+    def get_node(self, name: str) -> dict:
+        """Cache-served GET; raises ApiException(404) like the client
+        would so informer-backed reads stay drop-in."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise ApiException(404, f"node {name} not found")
+            # ccaudit: allow-blocking-under-lock(deepcopy of one cached node object, not I/O — same contract as NodeWatcher.latest_node)
+            return copy.deepcopy(node)
+
+    @property
+    def primed(self) -> bool:
+        with self._lock:
+            return self._primed
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": len(self._nodes),
+                "lists": self._lists_total,
+                "events": self._events_total,
+                "watch_supported": self._watch_supported,
+            }
+
+    # ------------------------------------------------------------- plumbing
+    def _snapshot_subs(self) -> List[Tuple[
+            Optional[Callable[[str, dict], None]],
+            Optional[Callable[[], None]]]]:
+        with self._lock:
+            return list(self._subs.values())
+
+    def _apply(self, etype: str, node: dict) -> None:
+        meta = node.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            return
+        with self._lock:
+            self._events_total += 1
+            rv = meta.get("resourceVersion")
+            if rv is not None:
+                self._rv = rv
+            if etype == "DELETED":
+                self._nodes.pop(name, None)
+            else:
+                self._nodes[name] = copy.deepcopy(node)
+        for on_event, _ in self._snapshot_subs():
+            if on_event is not None:
+                on_event(etype, node)
+
+    def prime(self) -> None:
+        """Synchronous initial LIST: fills the cache and captures the
+        resume rv before :meth:`start` arms the watch — callers that
+        hand the informer to a controller get a warm cache first."""
+        self._relist()
+
+    def _relist(self) -> None:
+        nodes = self.kube.list_nodes(None)
+        rv = 0
+        fresh: Dict[str, dict] = {}
+        for n in nodes:
+            meta = n.get("metadata") or {}
+            name = meta.get("name")
+            if not name:
+                continue
+            fresh[name] = n
+            try:
+                rv = max(rv, int(meta.get("resourceVersion") or 0))
+            except ValueError:
+                pass
+        with self._lock:
+            self._nodes = fresh
+            self._rv = str(rv) if rv else None
+            self._primed = True
+            self._lists_total += 1
+        for _, on_wake in self._snapshot_subs():
+            if on_wake is not None:
+                on_wake()
+
+    # ------------------------------------------------------------ main loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.primed:
+                    self._relist()
+                with self._lock:
+                    rv = self._rv
+                try:
+                    stream = iter(self.kube.watch_nodes(
+                        resource_version=rv,
+                        timeout_s=self.watch_timeout_s,
+                    ))
+                except TypeError:
+                    # clientset without watch support: degrade to
+                    # interval re-listing so reads stay bounded-stale
+                    with self._lock:
+                        self._watch_supported = False
+                    log.info("%s: client has no node-watch support; "
+                             "re-listing every %.0fs", self.name,
+                             self.resync_s)
+                    while not self._stop.wait(self.resync_s):
+                        self._relist()
+                    return
+                for etype, node in stream:
+                    if etype == "BOOKMARK":
+                        meta = node.get("metadata") or {}
+                        rv2 = meta.get("resourceVersion")
+                        if rv2 is not None:
+                            with self._lock:
+                                self._rv = rv2
+                        continue
+                    self._apply(etype, node)
+                    if self._stop.is_set():
+                        return
+                # clean server-side timeout: reconnect from current rv
+            except ApiException as e:
+                if e.status == 501:
+                    with self._lock:
+                        self._watch_supported = False
+                    log.info("%s: node watch unsupported (501); "
+                             "re-listing every %.0fs", self.name,
+                             self.resync_s)
+                    while not self._stop.wait(self.resync_s):
+                        self._relist()
+                    return
+                if e.status == 410:
+                    log.warning("%s: watch history expired (410); "
+                                "re-listing", self.name)
+                else:
+                    log.warning("%s: watch failed (%s); re-listing in "
+                                "%.1fs", self.name, e, self.backoff_s)
+                    self._stop.wait(self.backoff_s)
+                with self._lock:
+                    self._primed = False  # next loop turn re-lists
+            except Exception:
+                log.warning("%s: unexpected informer error; re-listing",
+                            self.name, exc_info=True)
+                self._stop.wait(self.backoff_s)
+                with self._lock:
+                    self._primed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "NodeInformer":
+        self._thread = threading.Thread(
+            target=self._run, name=f"node-informer-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def client(
+        self, base: Any,
+        node_filter: Optional[Callable[[dict], bool]] = None,
+    ) -> "InformerKubeClient":
+        """An informer-backed client view over ``base``: node reads
+        come from this cache (optionally partition-scoped by
+        ``node_filter``, applied pre-copy), everything else (writes,
+        leases, CRs, watches) passes through."""
+        return InformerKubeClient(self, base, node_filter=node_filter)
+
+
+class InformerKubeClient:
+    """KubeClient facade serving ``list_nodes``/``get_node`` from a
+    :class:`NodeInformer` cache and delegating every other verb to the
+    wrapped client. Hand this to a controller and its steady-state
+    scans perform ZERO node read round trips (pinned by
+    tests/test_shard.py) while writes keep their real path."""
+
+    def __init__(self, informer: NodeInformer, base: Any,
+                 node_filter: Optional[Callable[[dict], bool]] = None,
+                 ) -> None:
+        self.informer = informer
+        self.base = base
+        self.node_filter = node_filter
+
+    def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
+        return self.informer.list_nodes(label_selector, self.node_filter)
+
+    def get_node(self, name: str) -> dict:
+        return self.informer.get_node(name)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.base, name)
 
 
 class FatalWatchError(Exception):
