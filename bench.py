@@ -947,6 +947,51 @@ def run_shard_bench():
     }
 
 
+def run_lifecycle_bench():
+    """Lifecycle chaos at fleet scale (ISSUE 12 / ROADMAP item 5): the
+    upgrade-256 named scenario rolls the AGENTS THEMSELVES — four
+    cohorts restart with a new code version mid-double-wave, so two
+    versions reconcile one pool — and the run is judged by the
+    convergence-and-invariants oracle, not just the convergence poll.
+    ``lifecycle_convergence_s`` (wave -> every node converged THROUGH
+    the rolling upgrade) joins the trend-gated axes: it regresses if
+    upgrade churn ever starts fighting the reconcile path."""
+    import os as _os
+
+    from tpu_cc_manager.simlab.invariants import check_run
+    from tpu_cc_manager.simlab.runner import SimLab
+    from tpu_cc_manager.simlab.scenario import load_scenario
+
+    path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "scenarios", "upgrade-256.json",
+    )
+    lab = SimLab(load_scenario(path))
+    art = lab.run()
+    violations = check_run(lab, art)
+    if violations:
+        # the oracle IS the acceptance surface here: a converged run
+        # that violated an invariant (half-flip, write budget, lost
+        # upgrade) must fail the bench loudly, not ship a green number
+        for v in violations:
+            print(f"FATAL: upgrade-256 invariant violated: "
+                  f"{v.invariant}: {v.detail}", file=sys.stderr)
+        sys.exit(1)
+    m = art["metrics"]
+    lc = m.get("lifecycle") or {}
+    return {
+        "lifecycle_convergence_s": m["pool256_convergence_s"],
+        "lifecycle256": {
+            "scenario": art["scenario"],
+            "versions": lc.get("versions"),
+            "upgraded": lc.get("upgraded"),
+            "reconciles": m["reconciles"]["total"],
+            "restarted": m["reconciles"].get("restarted", 0),
+            "invariants_checked": True,
+        },
+    }
+
+
 def bench_dep_versions():
     """The benched jax/jaxlib/libtpu/numpy versions, stamped into the
     bench output (ISSUE 6 satellite / ROADMAP item 1): the r02-r05
@@ -1051,6 +1096,10 @@ def main():
         # failover; pool1024_convergence_s is bounded at 3x pool256 by
         # bench_trend's relative ceiling
         result["extras"].update(run_shard_bench())
+        # rolling agent upgrade at 256 live replicas (ISSUE 12): the
+        # lifecycle scenario runs through the invariants oracle and
+        # lifecycle_convergence_s joins the gated axes
+        result["extras"].update(run_lifecycle_bench())
     print(json.dumps(result))
 
 

@@ -51,6 +51,12 @@ REGRESSION_FACTOR = 2.0
 #: FULLY broken stitch (zero samples -> null axis) cannot hide in the
 #: skip-if-absent rule here: bench.py itself exits 1 when the scenario
 #: converges with no stitched e2e samples.
+#: lifecycle_convergence_s joined in r12 (the lifecycle-chaos round,
+#: ISSUE 12): the upgrade-256 scenario's convergence THROUGH a rolling
+#: agent upgrade (four cohorts restarting with a new code version
+#: mid-double-wave), judged green by the simlab invariants oracle
+#: before the number is even exported — the axis that regresses if
+#: upgrade churn starts fighting the reconcile path.
 #: pool1024_convergence_s / shard_failover_convergence_s joined in r11
 #: (the sharded-control-plane round, ISSUE 11): 1,024 live replicas
 #: through N consistent-hash controller shards over one shared node
@@ -70,6 +76,7 @@ GATED_EXTRA_AXES = {
     "e2e_convergence_p99_s": "lower",
     "pool1024_convergence_s": "lower",
     "shard_failover_convergence_s": "lower",
+    "lifecycle_convergence_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are

@@ -741,6 +741,69 @@ def test_unknown_scenario_mode_flagged(tmp_path):
     assert all(f.file == "scenarios/smoke.json" for f in findings)
 
 
+def test_unknown_scenario_fault_kind_flagged(tmp_path):
+    """ISSUE 12: a fault kind the simlab schema does not know is
+    manifest drift — the scenario would be rejected at load, so the
+    lint tier fails first with a named finding."""
+    _manifest_tree(str(tmp_path))
+    _write(str(tmp_path), "scenarios/smoke.json", json.dumps({
+        "name": "smoke", "nodes": 4, "initial_mode": "off",
+        "actions": [
+            {"action": "set_mode", "at": 0.1, "mode": "on"},
+            {"action": "fault", "at": 0.2, "fault": "meteor_strike"},
+        ],
+        "converge": {"mode": "on", "timeout_s": 60},
+    }, indent=2))
+    (f,) = manifest_findings(str(tmp_path))
+    assert f.rule == "manifest-drift"
+    assert "'meteor_strike'" in f.message
+    assert "FAULT_PARAMS" in f.message
+    assert f.file == "scenarios/smoke.json"
+
+
+def test_scenario_fault_kinds_track_live_schema(tmp_path):
+    """The fault vocabulary is pulled from the LIVE schema — the
+    lifecycle kinds added in ISSUE 12 must be known, and injecting a
+    reduced set flags a scenario using the removed kind."""
+    from tpu_cc_manager.analysis.manifests import scenario_fault_kinds
+
+    kinds = scenario_fault_kinds()
+    assert {"agent_upgrade", "key_rotation", "root_revoked",
+            "policy_conflict", "evacuation_drain"} <= kinds
+    _manifest_tree(str(tmp_path))
+    _write(str(tmp_path), "scenarios/smoke.json", json.dumps({
+        "name": "smoke", "nodes": 4, "initial_mode": "off",
+        "actions": [
+            {"action": "fault", "at": 0.1, "fault": "watch_410"},
+            {"action": "set_mode", "at": 0.2, "mode": "on"},
+        ],
+        "converge": {"mode": "on", "timeout_s": 60},
+    }, indent=2))
+    assert manifest_findings(str(tmp_path)) == []
+    findings = manifest_findings(
+        str(tmp_path), known_faults=kinds - {"watch_410"},
+    )
+    assert [f.rule for f in findings] == ["manifest-drift"]
+    assert "'watch_410'" in findings[0].message
+
+
+def test_rival_mode_checked_as_mode_field(tmp_path):
+    """policy_conflict's rival_mode is a mode-valued field: a typo'd
+    mode there fails the lint tier, not a user's scenario load."""
+    _manifest_tree(str(tmp_path))
+    _write(str(tmp_path), "scenarios/smoke.json", json.dumps({
+        "name": "smoke", "nodes": 4, "initial_mode": "off",
+        "actions": [
+            {"action": "fault", "at": 0.1, "fault": "policy_conflict",
+             "mode": "on", "rival_mode": "devtoolz"},
+        ],
+        "converge": {"mode": "on", "timeout_s": 60},
+    }, indent=2))
+    findings = manifest_findings(str(tmp_path))
+    assert any("'devtoolz'" in f.message
+               and "VALID_MODES" in f.message for f in findings)
+
+
 def test_crd_enum_missing_mode_flagged(tmp_path):
     enum = [m for m in VALID_MODES if m != "ici"]
     _manifest_tree(str(tmp_path), crd_enum=enum)

@@ -79,6 +79,48 @@ def test_fake_tpm_state_survives_reopen(tmp_path):
     assert verdict == "ok"
 
 
+def test_key_rotation_tail_accepts_old_quotes(tmp_path, monkeypatch):
+    """ISSUE 12: TPU_CC_TPM_OLD_KEYS[_FILE] is a verify-only rotation
+    tail behind the TPU_CC_TPM_KEY[_FILE] primary (the evidence pool
+    key's posture, evidence_keys). Mid-rotation, still-old quotes must
+    verify instead of reading as forgery; the primary keeps its legacy
+    whole-value semantics; quotes under a never-provisioned key still
+    fail."""
+    from tpu_cc_manager.attest import tpm_key, tpm_keys
+
+    monkeypatch.delenv("TPU_CC_TPM_KEY_FILE", raising=False)
+    monkeypatch.delenv("TPU_CC_TPM_OLD_KEYS_FILE", raising=False)
+    tpm = FakeTpm(state_dir=str(tmp_path / "t"), key=b"old-key")
+    tpm.extend("mode:on")
+    nonce = "ab" * 32
+    old_quote = tpm.quote(nonce)
+    # rotated posture: new primary + old key in the verify-only tail
+    monkeypatch.setenv("TPU_CC_TPM_KEY", "new-key")
+    monkeypatch.setenv("TPU_CC_TPM_OLD_KEYS", "old-key")
+    assert tpm_keys() == (b"new-key", b"old-key")
+    assert tpm_key() == b"new-key"  # the PRIMARY signs
+    assert verify_quote(old_quote, nonce)[0] == "ok"
+    # the node re-quotes under the rotated key (set_key = the drill)
+    tpm.set_key(b"new-key")
+    assert verify_quote(tpm.quote(nonce), nonce)[0] == "ok"
+    # tail dropped after the fleet re-quoted: old quotes now fail
+    monkeypatch.delenv("TPU_CC_TPM_OLD_KEYS")
+    assert verify_quote(old_quote, nonce)[0] == "mismatch"
+    # a quote under a key that was NEVER provisioned fails either way
+    stranger = FakeTpm(state_dir=str(tmp_path / "s"), key=b"rogue")
+    monkeypatch.setenv("TPU_CC_TPM_OLD_KEYS", "old-key")
+    assert verify_quote(stranger.quote(nonce), nonce)[0] == "mismatch"
+    # legacy whole-value semantics: a primary containing a newline is
+    # ONE key, never silently split into two
+    monkeypatch.setenv("TPU_CC_TPM_KEY", "raw\nbinary-ish")
+    monkeypatch.delenv("TPU_CC_TPM_OLD_KEYS")
+    assert tpm_keys() == (b"raw\nbinary-ish",)
+    # retired keys alone must not make this a keyed verifier
+    monkeypatch.delenv("TPU_CC_TPM_KEY")
+    monkeypatch.setenv("TPU_CC_TPM_OLD_KEYS", "old-key")
+    assert tpm_keys() == ()
+
+
 def test_quote_verification_catches_each_tamper(tmp_path):
     tpm = FakeTpm(state_dir=str(tmp_path / "t"), key=KEY)
     tpm.extend("mode:on")

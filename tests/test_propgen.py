@@ -1,0 +1,492 @@
+"""Property-based lifecycle scenario generation + the invariants
+oracle (ISSUE 12, tpu_cc_manager/simlab/propgen.py + invariants.py).
+
+Four surfaces under test:
+
+1. the GENERATOR — deterministic by seed, only emits schema-valid
+   docs, covers all lifecycle fault families over a seed range;
+2. the SHRINKER — demonstrably reduces a synthetic multi-fault
+   counterexample to the minimal reproducing pair (ddmin
+   1-minimality), deterministically, never proposing invalid docs
+   (the ISSUE 12 acceptance pin);
+3. the FIND loop — a violated invariant produces a replayable
+   canonical ``gen-*.json`` that reproduces the violation when
+   reloaded (the second acceptance pin);
+4. the ORACLE — unit-level detection with crafted lab stubs (the
+   live-green paths run in test_simlab.py and the propgen-smoke CI
+   job; here we prove the checks can FIRE), plus the lifecycle
+   drills end to end through LIVE replicas: the revoked-root path
+   latching ``attestation_outage`` with a fleet problems line (the
+   satellite), key rotation re-verifying, and the policy-conflict
+   parking rule.
+"""
+
+import json
+
+import pytest
+
+from tpu_cc_manager.simlab.invariants import (
+    INVARIANTS, Violation, check_run,
+)
+from tpu_cc_manager.simlab.propgen import (
+    FAMILIES, dump_find, generate_episode, run_episode, shrink,
+)
+from tpu_cc_manager.simlab.scenario import (
+    canonical_scenario_text, load_scenario, validate_scenario,
+)
+
+
+# ------------------------------------------------------------ generator
+def test_generator_deterministic_and_valid():
+    for seed in range(30):
+        a = generate_episode(seed)
+        b = generate_episode(seed)
+        assert a == b, f"seed {seed} not deterministic"
+        validate_scenario(a)  # only schema-valid docs, ever
+        assert a["name"] == f"gen-{seed}"
+
+
+def test_generator_covers_every_family():
+    seen = set()
+    for seed in range(60):
+        doc = generate_episode(seed)
+        kinds = {a.get("fault") for a in doc["actions"]
+                 if a["action"] == "fault"}
+        if kinds & {"key_rotation", "root_revoked"}:
+            seen.add("attestation")
+        if "agent_upgrade" in kinds:
+            seen.add("upgrade")
+        if "policy_conflict" in kinds:
+            seen.add("policy")
+        if "evacuation_drain" in kinds:
+            seen.add("evacuation")
+        if "shard_kill" in kinds:
+            seen.add("shards")
+    assert seen == set(FAMILIES)
+
+
+def test_generator_family_override():
+    doc = generate_episode(7, families=["policy"])
+    kinds = {a.get("fault") for a in doc["actions"]}
+    assert "policy_conflict" in kinds
+    assert doc["controllers"]["policy"] is True
+    with pytest.raises(ValueError, match="unknown families"):
+        generate_episode(7, families=["chaos-monkey"])
+
+
+def test_attestation_episodes_carry_the_whole_posture():
+    """An attestation episode must be self-sufficient: evidence on,
+    per-node TPMs on, and a fleet audit plane to read the verdicts."""
+    doc = generate_episode(3, families=["attestation"])
+    assert doc["evidence"] is True and doc["attestation"] is True
+    assert doc["controllers"].get("fleet") or \
+        doc["controllers"].get("shards")
+
+
+# ------------------------------------------------------------- shrinker
+def _padded_counterexample():
+    base = generate_episode(1, families=["upgrade"])
+    doc = dict(base)
+    doc["actions"] = sorted(base["actions"] + [
+        {"at": 0.05, "action": "fault", "fault": "write_429",
+         "count": 5},
+        {"at": 0.1, "action": "fault", "fault": "agent_crash",
+         "count": 2, "restart_after_s": 0.5},
+        {"at": 0.15, "action": "fault", "fault": "watch_410"},
+        {"at": 0.35, "action": "fault", "fault": "list_429",
+         "count": 1},
+    ], key=lambda a: a["at"])
+    return doc
+
+
+def test_shrinker_reduces_synthetic_multifault_counterexample():
+    """THE acceptance pin: the violation 'needs write_429 AND
+    agent_crash together' must shrink from a 7-action episode to
+    exactly that pair — and every candidate the shrinker proposes must
+    be schema-valid."""
+    doc = _padded_counterexample()
+    proposed = []
+
+    def repro(cand):
+        validate_scenario(cand)  # invalid candidates must never reach us
+        proposed.append(cand)
+        kinds = [a.get("fault") for a in cand["actions"]]
+        return "write_429" in kinds and "agent_crash" in kinds
+
+    shrunk, runs = shrink(doc, repro, seed=7, max_runs=64)
+    kinds = sorted(a.get("fault") for a in shrunk["actions"]
+                   if a["action"] == "fault")
+    assert kinds == ["agent_crash", "write_429"]
+    # 1-minimal modulo the structural rule: the converge-driving wave
+    # is never dropped (see test_shrinker_never_drops_the_converge_driver)
+    others = [a for a in shrunk["actions"] if a["action"] != "fault"]
+    assert len(others) == 1 and others[0]["action"] == "set_mode"
+    assert len(shrunk["actions"]) == 3
+    assert 0 < runs <= 64 and len(proposed) == runs
+    validate_scenario(shrunk)
+
+
+def test_shrinker_deterministic_by_seed():
+    doc = _padded_counterexample()
+
+    def repro(cand):
+        kinds = [a.get("fault") for a in cand["actions"]]
+        return "write_429" in kinds and "agent_crash" in kinds
+
+    a, runs_a = shrink(doc, repro, seed=7, max_runs=64)
+    b, runs_b = shrink(doc, repro, seed=7, max_runs=64)
+    assert a == b and runs_a == runs_b
+
+
+def test_shrinker_respects_run_budget():
+    doc = _padded_counterexample()
+    calls = []
+
+    def repro(cand):
+        calls.append(1)
+        return False  # nothing reproduces: every attempt is spent
+
+    shrunk, runs = shrink(doc, repro, seed=1, max_runs=5)
+    assert runs == 5 and len(calls) == 5
+    assert shrunk == doc  # nothing reproduced -> nothing changed
+
+
+def test_shrinker_never_drops_the_converge_driver():
+    """A convergence-violation shrink must not degenerate: dropping
+    the action that initiates converge.mode makes ANY candidate
+    trivially non-convergent, so the rule keeps one converge driver
+    in every candidate — even against an always-True predicate."""
+    doc = {
+        "version": 1, "name": "gen-driver", "nodes": 4, "pools": 1,
+        "chips_per_node": 1, "initial_mode": "off", "workers": 2,
+        "qps": 0, "evidence": False, "watch_timeout_s": 2,
+        "actions": [
+            {"at": 0.1, "action": "set_mode", "mode": "on"},
+            {"at": 0.2, "action": "fault", "fault": "watch_410"},
+            {"at": 0.3, "action": "fault", "fault": "list_429",
+             "count": 1},
+        ],
+        "converge": {"mode": "on", "timeout_s": 5},
+    }
+    shrunk, _runs = shrink(doc, lambda cand: True, seed=11,
+                           max_runs=64)
+    (kept,) = shrunk["actions"]  # only the driver survives
+    assert kept["action"] == "set_mode" and kept["mode"] == "on"
+
+
+def test_shrinker_reorder_pass_pulls_faults_earlier():
+    """A violation that only reproduces when the fault is FIRST in the
+    timeline is found by the reorder pass, not the drop pass."""
+    doc = _padded_counterexample()
+
+    def repro(cand):
+        acts = cand["actions"]
+        return (acts[0].get("fault") == "watch_410"
+                and len(acts) == len(doc["actions"]))
+
+    shrunk, _runs = shrink(doc, repro, seed=3, max_runs=64)
+    assert shrunk["actions"][0].get("fault") == "watch_410"
+    assert shrunk["actions"][0]["at"] == 0.0
+
+
+# ------------------------------------------------------ replayable finds
+def test_violation_dumps_replayable_find(tmp_path):
+    """THE other acceptance pin, live: a violated invariant produces a
+    canonical scenarios/gen-*.json that reproduces the violation when
+    reloaded and re-run."""
+    broken = {
+        "version": 1, "name": "gen-4242", "nodes": 4, "pools": 1,
+        "chips_per_node": 1, "initial_mode": "off", "workers": 2,
+        "qps": 0, "evidence": False, "watch_timeout_s": 2,
+        "actions": [
+            {"at": 0.1, "action": "set_mode", "mode": "devtools"},
+        ],
+        "converge": {"mode": "on", "timeout_s": 2},
+    }
+    result = run_episode(broken)
+    assert any(v.invariant == "convergence" for v in result.violations)
+    spath, rpath = dump_find(
+        broken, result.violations, result.artifact,
+        scenario_dir=str(tmp_path / "scenarios"),
+        report_dir=str(tmp_path / "finds"),
+    )
+    # the find is a first-class canonical scenario file
+    text = open(spath).read()
+    assert text == canonical_scenario_text(json.loads(text))
+    sc = load_scenario(spath)
+    assert sc.name == "gen-4242"
+    # ... and it REPRODUCES under re-run
+    replay = run_episode(json.loads(text))
+    assert any(v.invariant == "convergence" for v in replay.violations)
+    report = json.load(open(rpath))
+    assert report["violations"][0]["invariant"] == "convergence"
+    assert "timeline" in report  # the stitched flight-recorder story
+    assert report["scenario_path"] == spath
+
+
+def test_dump_find_enforces_gen_prefix(tmp_path):
+    doc = {
+        "version": 1, "name": "oops", "nodes": 2,
+        "actions": [{"at": 0, "action": "set_mode", "mode": "on"}],
+        "converge": {"mode": "on", "timeout_s": 5},
+    }
+    spath, _ = dump_find(
+        doc, [Violation("convergence", "x")],
+        scenario_dir=str(tmp_path / "s"), report_dir=str(tmp_path / "r"),
+    )
+    assert spath.endswith("gen-oops.json")
+
+
+# ------------------------------------------------------ oracle (units)
+class _StubChip:
+    def __init__(self, path, mode):
+        self.path = path
+        self.is_cc_query_supported = True
+        self._mode = mode
+
+    def query_cc_mode(self):
+        return self._mode
+
+
+class _StubBackend:
+    def __init__(self, modes):
+        self.chips = [_StubChip(f"/dev/accel{i}", m)
+                      for i, m in enumerate(modes)]
+
+
+class _StubGate:
+    def __init__(self, perms):
+        self._perms = perms
+
+    def perms_snapshot(self):
+        return dict(self._perms)
+
+
+class _StubReplica:
+    def __init__(self, modes=("on",), perms=None, version="v1",
+                 alive=True, outcomes=None):
+        self.backend = _StubBackend(modes)
+        self.gate = _StubGate(perms or {})
+        self.version = version
+        self.alive = alive
+        self.outcomes = outcomes or {"success": 1}
+        self.attestor = None
+
+
+class _StubStore:
+    def __init__(self, labels=None, mutations=0):
+        self._labels = labels or {}
+        self._mutations = mutations
+
+    def peek_node_label(self, name, key):
+        return self._labels.get(name)
+
+    def get_node(self, name):
+        return {"metadata": {"name": name,
+                             "labels": {}, "annotations": {}},
+                "spec": {}}
+
+    def node_write_stats(self):
+        return {"requests": self._mutations,
+                "mutations": self._mutations}
+
+
+class _StubServer:
+    def __init__(self, store):
+        self.store = store
+
+
+class _StubScenario:
+    def __init__(self, nodes, evidence=False):
+        self.nodes = nodes
+        self.evidence = evidence
+
+
+class _StubLab:
+    def __init__(self, replicas, store=None, nodes=None,
+                 evidence=False):
+        self.replicas = replicas
+        self.server = _StubServer(store or _StubStore())
+        self.scenario = _StubScenario(nodes or len(replicas), evidence)
+        self.injector = None
+        self.shard_manager = None
+        self.attest_lab = None
+
+    def final_fleet_reports(self):
+        return []
+
+
+_GREEN_ARTIFACT = {"ok": True, "metrics": {}, "faults": [],
+                   "controllers": {}}
+
+
+def test_oracle_detects_half_flipped_node():
+    lab = _StubLab({"n1": _StubReplica(modes=("on", "off"))})
+    (v,) = check_run(lab, _GREEN_ARTIFACT)
+    assert v.invariant == "half_flipped" and v.nodes == ("n1",)
+
+
+def test_oracle_detects_fail_secure_breach():
+    """A node whose label claims success while a device is still at
+    FLIP_LOCK_PERMS handed workloads a gated chip."""
+    lab = _StubLab(
+        {"n1": _StubReplica(perms={"/dev/accel0": 0o000})},
+        store=_StubStore(labels={"n1": "on"}),
+    )
+    (v,) = check_run(lab, _GREEN_ARTIFACT)
+    assert v.invariant == "fail_secure"
+    # ... but a FAILED node keeping its device locked is the contract
+    # working, not a violation
+    lab2 = _StubLab(
+        {"n1": _StubReplica(perms={"/dev/accel0": 0o000})},
+        store=_StubStore(labels={"n1": "failed"}),
+    )
+    assert check_run(lab2, _GREEN_ARTIFACT) == []
+
+
+def test_oracle_detects_write_budget_blowout():
+    # 1 flip, no evidence, 40 mutation units: the historical ~5
+    # writes/flip world would look like this
+    lab = _StubLab(
+        {"n1": _StubReplica()},
+        store=_StubStore(labels={"n1": "on"}, mutations=40),
+    )
+    violations = check_run(lab, _GREEN_ARTIFACT)
+    assert [v.invariant for v in violations] == ["writes_per_flip"]
+
+
+def test_oracle_orders_and_catalogs_violations():
+    assert set(INVARIANTS) >= {
+        "convergence", "half_flipped", "fail_secure",
+        "writes_per_flip", "leader_uniqueness", "forged_evidence",
+        "attestation_outage", "attestation_rotation",
+        "policy_conflict", "upgrade_completeness",
+        "evacuation_restored", "exposition_valid",
+    }
+    lab = _StubLab(
+        {"n1": _StubReplica(modes=("on", "off"))},
+    )
+    art = dict(_GREEN_ARTIFACT)
+    art["ok"] = False
+    violations = check_run(lab, art)
+    # catalog order: convergence before half_flipped
+    assert [v.invariant for v in violations] == [
+        "convergence", "half_flipped"]
+
+
+# ----------------------------------------------- lifecycle drills, LIVE
+def test_root_revoked_latches_outage_through_live_replicas():
+    """The satellite pin: attest.py's revoked-root path driven END TO
+    END through live simlab replicas — per-node TPMs quote real
+    measured histories, a fleet scan VERIFIES (arming the latch), the
+    trust root is revoked, and the final audit must latch
+    ``attestation_outage`` with a fleet problems line; the planted
+    node-root forgery must land in ``attestation_mismatch`` and never
+    flip a chip."""
+    doc = {
+        "version": 1, "name": "gen-revoked-live", "nodes": 6,
+        "pools": 2, "chips_per_node": 1, "initial_mode": "off",
+        "workers": 4, "qps": 0, "evidence": True, "attestation": True,
+        "watch_timeout_s": 2, "controllers": {"fleet": True},
+        "actions": [
+            {"at": 0.2, "action": "set_mode", "mode": "devtools"},
+            {"at": 1.5, "action": "fault", "fault": "root_revoked",
+             "forge": True},
+        ],
+        "converge": {"mode": "devtools", "timeout_s": 60},
+    }
+    result = run_episode(doc)
+    assert result.ok, [v.to_dict() for v in result.violations]
+    # the oracle said green — now assert the DRILL ITSELF happened
+    (revoke,) = [f for f in result.artifact["faults"]
+                 if f.get("fault") == "root_revoked"]
+    assert revoke["armed_before_revoke"] is True
+    assert revoke["revoked"] is True
+    forged_node = revoke["forged"]
+    assert forged_node is not None
+    (report,) = result.lab.final_fleet_reports()
+    audit = report["evidence_audit"]
+    assert audit["attestation_outage"], "outage latch never filled"
+    assert forged_node in audit["attestation_mismatch"]
+    assert audit["attestation_seen"] is False
+    assert any("attestation went unverifiable" in p
+               for p in report["problems"])
+    assert any("attestation mismatch" in p for p in report["problems"])
+    # the forged claim never reached the silicon
+    claim = revoke["forged_claim"]
+    victim = result.lab.replicas[forged_node]
+    assert all(c.query_cc_mode() != claim
+               for c in victim.backend.chips)
+    # lifecycle block reached the artifact
+    att = result.artifact["metrics"]["lifecycle"]["attestation"]
+    assert att["revoked"] is True
+    assert att["forged_nodes"] == [forged_node]
+
+
+def test_key_rotation_reverifies_through_live_replicas():
+    """Rotated signing key mid-scan: the verifier keeps the old key in
+    its rotation tail (attest.tpm_keys), the next wave re-quotes, and
+    the oracle requires every settled document to verify under the NEW
+    primary alone."""
+    doc = {
+        "version": 1, "name": "gen-rotation-live", "nodes": 6,
+        "pools": 2, "chips_per_node": 1, "initial_mode": "off",
+        "workers": 4, "qps": 0, "evidence": True, "attestation": True,
+        "watch_timeout_s": 2, "controllers": {"fleet": True},
+        "actions": [
+            {"at": 0.2, "action": "set_mode", "mode": "on"},
+            {"at": 1.0, "action": "fault", "fault": "key_rotation"},
+            {"at": 1.3, "action": "set_mode", "mode": "devtools"},
+        ],
+        "converge": {"mode": "devtools", "timeout_s": 60},
+    }
+    result = run_episode(doc)
+    assert result.ok, [v.to_dict() for v in result.violations]
+    assert result.lab.attest_lab.rotations == 1
+    # no mismatch tail: rotation is routine, not attack-shaped
+    (report,) = result.lab.final_fleet_reports()
+    audit = report["evidence_audit"]
+    assert audit["attestation_mismatch"] == []
+    assert audit["attestation_seen"] is True
+
+
+def test_policy_conflict_parks_rival_through_live_replicas():
+    doc = generate_episode(2, families=["policy"])
+    result = run_episode(doc)
+    assert result.ok, [v.to_dict() for v in result.violations]
+    phases = result.artifact["controllers"]["policy_phases"]
+    assert phases["zz-conflict-rival"] == "Conflicted"
+    assert phases["aa-conflict-owner"] != "Conflicted"
+
+
+def test_upgrade_and_evacuation_live_episode():
+    """Rolling upgrade racing an evacuation drain: two code versions
+    reconcile one pool, cordons race flips, and at quiescence every
+    replica runs v2, advertises it, and no node is left cordoned."""
+    doc = {
+        "version": 1, "name": "gen-upgrade-live", "nodes": 8,
+        "pools": 2, "chips_per_node": 2, "initial_mode": "off",
+        "workers": 4, "qps": 0, "evidence": False,
+        "watch_timeout_s": 2,
+        "actions": [
+            {"at": 0.2, "action": "set_mode", "mode": "on"},
+            {"at": 0.3, "action": "fault", "fault": "agent_upgrade",
+             "cohorts": 2, "stagger_s": 0.2},
+            {"at": 0.4, "action": "fault", "fault": "evacuation_drain",
+             "count": 3, "duration_s": 0.8},
+        ],
+        "converge": {"mode": "on", "timeout_s": 60},
+    }
+    result = run_episode(doc)
+    assert result.ok, [v.to_dict() for v in result.violations]
+    lc = result.artifact["metrics"]["lifecycle"]
+    assert lc["versions"] == {"v2": 8}
+    assert lc["upgraded"] == 8 and lc["evacuated"] == 3
+    from tpu_cc_manager import labels as L
+
+    store = result.lab.server.store
+    for name in result.lab.replicas:
+        node = store.get_node(name)
+        ann = node["metadata"].get("annotations") or {}
+        assert ann.get(L.AGENT_VERSION_ANNOTATION) == "v2", name
+        assert not (node.get("spec") or {}).get("unschedulable"), name

@@ -136,6 +136,140 @@ def test_bench_gated_scenario_is_256_nodes():
     assert "watch_drop" in faults and "agent_crash" in faults
 
 
+def test_named_lifecycle_scenarios_exercise_their_families():
+    """ISSUE 12 satellite: the four promoted lifecycle interleavings
+    must exist, stay schema-valid (canonical formatting is enforced by
+    test_committed_scenarios_validate_and_are_fresh above), and keep
+    exercising the fault family their name promises — a refactor that
+    quietly dropped the upgrade fault from upgrade-256 would silently
+    change what the gated lifecycle_convergence_s axis measures."""
+    def faults_of(name):
+        sc = load_scenario(os.path.join(SCENARIO_DIR, name))
+        return sc, [a.params["fault"] for a in sc.actions
+                    if a.kind == "fault"]
+
+    sc, faults = faults_of("upgrade-256.json")
+    assert sc.nodes == 256
+    assert "agent_upgrade" in faults
+    # the upgrade must land MID-rollout: a set_mode wave on each side
+    upgrade_at = next(a.at for a in sc.actions
+                      if a.kind == "fault"
+                      and a.params["fault"] == "agent_upgrade")
+    waves = [a.at for a in sc.actions if a.kind == "set_mode"]
+    assert min(waves) < upgrade_at < max(waves)
+
+    sc, faults = faults_of("keyrot-64.json")
+    assert sc.nodes == 64
+    assert sc.attestation and sc.evidence and sc.controllers.fleet
+    assert "key_rotation" in faults
+    # rotation must be followed by a wave, so the fleet re-quotes
+    rot_at = next(a.at for a in sc.actions
+                  if a.kind == "fault"
+                  and a.params["fault"] == "key_rotation")
+    assert any(a.at > rot_at for a in sc.actions
+               if a.kind == "set_mode")
+
+    sc, faults = faults_of("policy-conflict-32.json")
+    assert sc.nodes == 32
+    assert sc.controllers.policy
+    assert "policy_conflict" in faults
+    conflict = next(a for a in sc.actions
+                    if a.kind == "fault"
+                    and a.params["fault"] == "policy_conflict")
+    assert conflict.params["mode"] == sc.converge.mode
+
+    sc, faults = faults_of("evac-race-96.json")
+    assert sc.nodes == 96
+    assert faults.count("evacuation_drain") >= 2
+    # the drain must RACE a flip wave, not follow it
+    wave_at = min(a.at for a in sc.actions if a.kind == "set_mode")
+    assert any(a.at <= wave_at + 0.5 for a in sc.actions
+               if a.kind == "fault"
+               and a.params["fault"] == "evacuation_drain")
+
+
+# ---------------------------------------------------- fault injector race
+def test_fault_injector_cancel_vs_inflight_timer():
+    """ISSUE 12 satellite: a timer callback that fires AFTER cancel()
+    must be a no-op — before the fix it would restart (mutate) a
+    replica the teardown already owned. Pinned deterministically by
+    invoking the armed Timer's callback by hand after cancel, i.e. the
+    exact interleaving where Timer.cancel() came too late."""
+    from tpu_cc_manager.simlab.faults import FaultInjector
+
+    class StubReplica:
+        def __init__(self):
+            self.alive = True
+            self.restarts = 0
+
+        def crash(self):
+            self.alive = False
+
+        def restart(self):
+            self.alive = True
+            self.restarts += 1
+
+    class StubPool:
+        def submit(self, *a, **k):
+            raise AssertionError("submit after cancel")
+
+        def requeue(self, *a, **k):
+            raise AssertionError("requeue after cancel")
+
+    replica = StubReplica()
+    inj = FaultInjector(
+        store=None, replicas={"n1": replica}, pool=StubPool(),
+        data_kube=None, ops_kube=None, base_qps=0.0, lease_names=[],
+    )
+    entry = inj.inject("agent_crash",
+                       {"count": 1, "restart_after_s": 60.0}, 0.0)
+    assert entry["crashed"] == 1 and not replica.alive
+    (timer,) = inj._timers
+    inj.cancel()
+    # the race: the timer already fired past cancel() — run its
+    # callback directly. The guarded wrapper must bail out.
+    timer.function(*timer.args, **timer.kwargs)
+    assert not replica.alive
+    assert replica.restarts == 0
+    assert inj.restarted_total == 0
+    # and a timer armed AFTER cancel never starts at all
+    inj._timer(0.01, lambda: replica.restart())
+    import time as _time
+
+    _time.sleep(0.1)
+    assert replica.restarts == 0
+
+
+def test_fault_injector_settle_runs_and_waits_restores():
+    """settle() must run unclaimed restorative callbacks AND wait out
+    ones already executing on a timer thread — the oracle judges the
+    restored fleet, never a mid-restore snapshot."""
+    import threading as _threading
+    import time as _time
+
+    from tpu_cc_manager.simlab.faults import FaultInjector
+
+    inj = FaultInjector(
+        store=None, replicas={}, pool=None, data_kube=None,
+        ops_kube=None, base_qps=0.0, lease_names=[],
+    )
+    done = []
+    started = _threading.Event()
+
+    def slow_restore():
+        started.set()
+        _time.sleep(0.3)
+        done.append("slow")
+
+    inj._timer(0.01, slow_restore, restore=True)   # fires, runs slow
+    inj._timer(60.0, lambda: done.append("late"), restore=True)
+    assert started.wait(2.0)
+    inj.settle()  # must run "late" early AND wait "slow" out
+    assert sorted(done) == ["late", "slow"]
+    # exactly-once: the late timer eventually firing is a no-op
+    assert inj._restores == {}
+
+
 # ------------------------------------------------------------- live runs
 def test_live_run_with_faults_converges(tmp_path):
     """The harness end to end at suite scale: 16 live replicas, every

@@ -58,7 +58,9 @@ _KEY_RE = re.compile(
 )
 
 #: JSON/YAML object keys whose string value is a desired mode.
-_MODE_FIELDS = ("mode", "initial_mode")
+#: ``rival_mode`` is the policy_conflict fault's second claim (ISSUE
+#: 12) — a typo'd mode there would otherwise only fail at load time.
+_MODE_FIELDS = ("mode", "initial_mode", "rival_mode")
 
 
 def code_protocol_keys() -> Set[str]:
@@ -132,23 +134,50 @@ def _scan_keys(
                 yield f
 
 
+def _walk_string_fields(
+    doc: object, keys: Sequence[str], path: str = "$"
+) -> Iterable[Tuple[str, str]]:
+    """Yield (json-path, value) for every string field named in
+    ``keys`` anywhere in a parsed document — the one traversal behind
+    both the mode-field and fault-kind scans."""
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if k in keys and isinstance(v, str):
+                yield f"{path}.{k}", v
+            yield from _walk_string_fields(v, keys, f"{path}.{k}")
+    elif isinstance(doc, list):
+        for idx, v in enumerate(doc):
+            yield from _walk_string_fields(v, keys, f"{path}[{idx}]")
+
+
 def _walk_mode_fields(
     doc: object, path: str = "$"
 ) -> Iterable[Tuple[str, str]]:
     """Yield (json-path, value) for every mode-valued field in a parsed
     document."""
-    if isinstance(doc, dict):
-        for k, v in doc.items():
-            if k in _MODE_FIELDS and isinstance(v, str):
-                yield f"{path}.{k}", v
-            yield from _walk_mode_fields(v, f"{path}.{k}")
-    elif isinstance(doc, list):
-        for idx, v in enumerate(doc):
-            yield from _walk_mode_fields(v, f"{path}[{idx}]")
+    return _walk_string_fields(doc, _MODE_FIELDS, path)
+
+
+def scenario_fault_kinds() -> Set[str]:
+    """The live simlab fault vocabulary — pulled from the scenario
+    schema itself so this check can never drift from the validator it
+    fronts for (the labels.py treatment, applied to fault kinds)."""
+    from tpu_cc_manager.simlab.scenario import FAULT_PARAMS
+
+    return set(FAULT_PARAMS)
+
+
+def _walk_fault_kinds(
+    doc: object, path: str = "$"
+) -> Iterable[Tuple[str, str]]:
+    """Yield (json-path, value) for every ``"fault": "<kind>"`` field
+    in a parsed scenario document."""
+    return _walk_string_fields(doc, ("fault",), path)
 
 
 def _scan_scenario(
-    relpath: str, raw: str, lines: Sequence[str], valid: Set[str]
+    relpath: str, raw: str, lines: Sequence[str], valid: Set[str],
+    faults: Optional[Set[str]] = None,
 ) -> Iterable[Finding]:
     try:
         doc = json.loads(raw)
@@ -157,6 +186,25 @@ def _scan_scenario(
         if f is not None:
             yield f
         return
+    if faults is None:
+        faults = scenario_fault_kinds()
+    for path, value in _walk_fault_kinds(doc):
+        if value in faults:
+            continue
+        lineno = (
+            _find_line(lines, f'"fault": "{value}"')
+            or _find_line(lines, f'"{value}"')
+            or 1
+        )
+        f = _finding(
+            relpath, lines, lineno,
+            f"{path} = {value!r} is not a simlab FAULT_PARAMS kind — "
+            "the scenario would be rejected at load; fix the literal "
+            "or teach scenario.FAULT_PARAMS (and faults.FaultInjector) "
+            "the new fault first",
+        )
+        if f is not None:
+            yield f
     for path, value in _walk_mode_fields(doc):
         if value in valid:
             continue
@@ -267,12 +315,16 @@ def manifest_findings(
     globs: Sequence[str] = MANIFEST_GLOBS,
     known_keys: Optional[Set[str]] = None,
     valid_modes: Optional[Set[str]] = None,
+    known_faults: Optional[Set[str]] = None,
 ) -> List[Finding]:
-    """Run the cross-check over ``root``. ``known_keys``/``valid_modes``
-    default to the live labels.py/modes.py exports; tests inject their
-    own to build drift fixtures."""
+    """Run the cross-check over ``root``. ``known_keys`` /
+    ``valid_modes`` / ``known_faults`` default to the live labels.py /
+    modes.py / simlab schema exports; tests inject their own to build
+    drift fixtures."""
     known = code_protocol_keys() if known_keys is None else set(known_keys)
     valid = set(VALID_MODES) if valid_modes is None else set(valid_modes)
+    faults = (scenario_fault_kinds() if known_faults is None
+              else set(known_faults))
 
     findings: List[Finding] = []
     for pattern in globs:
@@ -289,7 +341,9 @@ def manifest_findings(
             lines = raw.splitlines()
             findings.extend(_scan_keys(relpath, lines, known))
             if relpath.endswith(".json"):
-                findings.extend(_scan_scenario(relpath, raw, lines, valid))
+                findings.extend(
+                    _scan_scenario(relpath, raw, lines, valid, faults)
+                )
             else:
                 findings.extend(_scan_yaml(relpath, raw, lines, valid))
     return sorted(set(findings))

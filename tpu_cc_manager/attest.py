@@ -92,10 +92,48 @@ CS_SOCKET_DEFAULT = "/run/container_launcher/teeserver.sock"
 
 
 # ------------------------------------------------------------ key/env
+def tpm_keys() -> Tuple[bytes, ...]:
+    """All accepted attestation keys, SIGNING (primary) key first.
+
+    The PRIMARY — TPU_CC_TPM_KEY (the WHOLE inline value) or the whole
+    stripped content of TPU_CC_TPM_KEY_FILE — signs every new quote;
+    its legacy whole-value semantics are untouched, so a raw-random
+    key containing a newline neither changes meaning on upgrade nor
+    silently truncates. TPU_CC_TPM_OLD_KEYS (inline) or
+    TPU_CC_TPM_OLD_KEYS_FILE lists RETIRED keys one per line, accepted
+    for verification only — the rotation-tail posture mirrored from
+    the evidence pool key (evidence.evidence_keys). Without the tail,
+    rotating the attestation key mid-scan would make every verifier
+    read the fleet's still-old quotes as ``mismatch`` — an
+    attack-shaped verdict for a routine operation. Retired keys must
+    therefore be newline-free (base64/hex keys are; raw-binary retired
+    keys should be re-cut). A missing key/file is silent
+    (optional-Secret posture); retired keys alone keep this verifier
+    keyless, exactly like evidence's rule."""
+    primary = tpm_key()
+    if primary is None:
+        return ()
+    keys: Tuple[bytes, ...] = (primary,)
+    raw = os.environ.get("TPU_CC_TPM_OLD_KEYS", "").encode()
+    if not raw:
+        old_path = os.environ.get("TPU_CC_TPM_OLD_KEYS_FILE", "")
+        if old_path:
+            try:
+                with open(old_path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                raw = b""
+    for line in raw.splitlines():
+        line = line.strip()
+        if line and line not in keys:
+            keys = keys + (line,)
+    return keys
+
+
 def tpm_key() -> Optional[bytes]:
-    """FakeTpm quote key: TPU_CC_TPM_KEY inline or TPU_CC_TPM_KEY_FILE
-    path; missing file is silent (optional-Secret posture, like the
-    evidence key)."""
+    """The PRIMARY (signing) FakeTpm quote key, or None. Verifiers
+    should resolve :func:`tpm_keys` instead so rotation-tail keys stay
+    accepted."""
     inline = os.environ.get("TPU_CC_TPM_KEY", "")
     if inline:
         return inline.encode()
@@ -169,6 +207,15 @@ class FakeTpm:
 
     def _key_bytes(self) -> Optional[bytes]:
         return self._key if self._key is not None else tpm_key()
+
+    def set_key(self, key: Optional[bytes]) -> None:
+        """Swap the quote-signing key (the key-rotation drill: the node
+        re-quotes under the new key on its next evidence build; the
+        verifier keeps the old key in its rotation tail until the fleet
+        has re-quoted). The measured log is untouched — rotation
+        changes who vouches, not what happened."""
+        with self._lock:
+            self._key = key
 
     def _log_path(self) -> str:
         return os.path.join(self.state_dir, "log")
@@ -374,18 +421,23 @@ def verify_quote(att: dict, expected_nonce: str, *,
         )
     if replay_log([str(e) for e in events]) != pcr:
         return "mismatch", "event log does not replay to the quoted PCR"
-    if key is None:
-        key = tpm_key()
-    if key is None:
+    # key=None resolves the env posture INCLUDING the rotation tail
+    # (tpm_keys): during a key rotation the fleet's still-old quotes
+    # must verify under a retired key instead of reading as forgery
+    keys: Tuple[bytes, ...] = tpm_keys() if key is None else (key,)
+    if not keys:
         return "unverifiable", (
             "no attestation key provisioned (TPU_CC_TPM_KEY[_FILE]) — "
             "quote cannot be authenticated"
         )
     body = {k: v for k, v in att.items() if k != "sig"}
-    want = hmac_mod.new(key, _canonical(body), hashlib.sha256).hexdigest()
-    if not hmac_mod.compare_digest(want, str(att.get("sig") or "")):
-        return "mismatch", "quote signature does not verify"
-    return "ok", "quote verifies"
+    sig = str(att.get("sig") or "")
+    payload = _canonical(body)
+    for k in keys:
+        want = hmac_mod.new(k, payload, hashlib.sha256).hexdigest()
+        if hmac_mod.compare_digest(want, sig):
+            return "ok", "quote verifies"
+    return "mismatch", "quote signature does not verify"
 
 
 def _judge_cs_token(att: dict, expected_nonce: str) -> Tuple[str, str]:

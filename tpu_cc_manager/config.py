@@ -423,6 +423,42 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sim_val.add_argument("scenarios", nargs="+",
                          help="scenario JSON files to validate")
+    sim_pg = simsub.add_parser(
+        "propgen",
+        help="property-based lifecycle scenario generation: run seeded "
+             "random fault/lifecycle interleavings through the live "
+             "harness and the convergence-and-invariants oracle; "
+             "violations shrink and persist as replayable "
+             "scenarios/gen-*.json (docs/simlab.md)",
+    )
+    sim_pg.add_argument(
+        "--seeds", default="1,2,3,4",
+        help="comma-separated episode seeds (default 1,2,3,4)",
+    )
+    sim_pg.add_argument(
+        "--families", default="",
+        help="restrict episodes to these fault families (comma-"
+             "separated: upgrade,attestation,policy,evacuation,shards; "
+             "default: seeded choice)",
+    )
+    sim_pg.add_argument(
+        "--no-shrink", action="store_true",
+        help="persist finds without the shrink pass",
+    )
+    sim_pg.add_argument(
+        "--max-shrink-runs", type=int, default=8,
+        help="reproduction-run budget per shrink (default 8)",
+    )
+    sim_pg.add_argument(
+        "--scenario-dir", default="scenarios",
+        help="where replayable gen-*.json finds land (default "
+             "scenarios/)",
+    )
+    sim_pg.add_argument(
+        "--report-dir", default="propgen-finds",
+        help="where find reports (violations + stitched timeline) "
+             "land (default propgen-finds/)",
+    )
     doc = sub.add_parser(
         "doctor",
         help="cross-check every node-local trust surface (statefile, "

@@ -141,6 +141,7 @@ class ModeEngine:
         flip_concurrency: Optional[int] = None,
         persistent_flip_pool: bool = False,
         recorder=None,
+        attestor=None,
     ):
         self._set_state_label = set_state_label
         #: observation-only hook invoked when the state label's WIRE
@@ -173,6 +174,11 @@ class ModeEngine:
         self._persistent_flip_pool = persistent_flip_pool
         self._flip_pool = None
         self._flip_pool_lock = threading.Lock()
+        #: per-engine measured-history sink (an attest.FakeTpm-shaped
+        #: object with .extend); None = the process-global provider
+        #: (attest.note_mode_applied). simlab injects one per replica
+        #: so a single process carries a fleet of independent PCRs.
+        self._attestor = attestor
         #: flight recorder whose host-contention sampler brackets every
         #: device flip (flightrec.py, ISSUE 8 — the sensor ROADMAP item
         #: 1 needs: was the slow real-chip flip the chip, or the
@@ -324,11 +330,20 @@ class ModeEngine:
             # measured flip history (tpu_cc_manager.attest): only REAL
             # transitions extend the PCR — the idempotent fast path
             # returned above, so the log records flips, not reconciles.
-            # Best-effort inside note_mode_applied; a TPM hiccup must
-            # not fail a flip that already landed.
-            from tpu_cc_manager.attest import note_mode_applied
+            # Best-effort either way; a TPM hiccup must not fail a
+            # flip that already landed.
+            if self._attestor is not None:
+                try:
+                    self._attestor.extend(f"mode:{mode.value}")
+                except Exception:
+                    log.warning(
+                        "attestation extend failed; measured flip "
+                        "history will lag", exc_info=True,
+                    )
+            else:
+                from tpu_cc_manager.attest import note_mode_applied
 
-            note_mode_applied(mode.value)
+                note_mode_applied(mode.value)
         return ok
 
     # ------------------------------------------------------------- planning

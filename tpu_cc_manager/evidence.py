@@ -215,10 +215,18 @@ _warned_attestation = False
 
 
 def build_evidence(node_name: str, backend,
-                   key=_RESOLVE_KEY, identity_provider="auto") -> dict:
+                   key=_RESOLVE_KEY, identity_provider="auto",
+                   attestor="auto") -> dict:
     """Evidence document for the node's current device state. ``key``
     defaults to :func:`evidence_key`; pass ``None`` explicitly for a
     deliberately unsigned document.
+
+    ``attestor``: ``"auto"`` resolves via
+    :func:`tpu_cc_manager.attest.get_attestor` (the env-configured
+    process-wide provider); ``None`` attaches no quote; otherwise a
+    provider instance — simlab replicas inject one software TPM per
+    simulated node, so one process can carry a whole fleet of
+    independent measured flip histories.
 
     ``identity_provider``: ``"auto"`` resolves via
     :func:`tpu_cc_manager.identity.get_identity_provider` (GCE metadata
@@ -282,7 +290,8 @@ def build_evidence(node_name: str, backend,
     try:
         from tpu_cc_manager.attest import attestation_nonce, get_attestor
 
-        attestor = get_attestor()
+        if attestor == "auto":
+            attestor = get_attestor()
         if attestor is not None:
             doc["attestation"] = attestor.quote(attestation_nonce(doc))
     except Exception:
@@ -291,6 +300,36 @@ def build_evidence(node_name: str, backend,
             log.warning("attestation quote failed; evidence will carry "
                         "no attestation", exc_info=True)
     doc["digest"] = _digest(_canonical(doc), key)
+    return doc
+
+
+def forge_evidence_claim(node_name: str, backend, claim_mode: str,
+                         attestor=None, key=_RESOLVE_KEY) -> dict:
+    """The node-root forgery drill as a reusable fixture (simlab's
+    ``root_revoked`` fault and the kind-smoke drill): build this node's
+    honest evidence, rewrite every per-device cc claim to
+    ``claim_mode`` (the statefile-rewrite analog — root edits the
+    bookkeeping, not the silicon), then do everything root CAN do:
+    re-quote the forged body (the TPM will happily commit its nonce to
+    any document) and re-digest it (root holds the node's mounted pool
+    key, or the plain hash needs no key at all). What root CANNOT do is
+    rewrite the extend-only measured flip history inside the quote —
+    ``judge_attestation`` reads the contradiction without any verifier
+    key. Test/drill surface only; never called by a reconcile path."""
+    keys = _resolve_keys(key)
+    k = keys[0] if keys else None
+    doc = build_evidence(node_name, backend, key=key,
+                         identity_provider=None, attestor=None)
+    doc = {f: v for f, v in doc.items()
+           if f not in ("digest", "attestation")}
+    for dev in doc.get("devices") or []:
+        if dev.get("cc") is not None:
+            dev["cc"] = claim_mode
+    if attestor is not None:
+        from tpu_cc_manager.attest import attestation_nonce
+
+        doc["attestation"] = attestor.quote(attestation_nonce(doc))
+    doc["digest"] = _digest(_canonical(doc), k)
     return doc
 
 

@@ -386,6 +386,16 @@ class FleetController:
         self._server.add_route("/debug/timeseries", self._timeseries_route)
         self._server.add_route("/fleet/metrics", self._fleet_metrics_route)
 
+    @property
+    def attestation_ever_verified(self) -> bool:
+        """Has any scan of this controller process verified a TEE
+        quote? This is the armed state of the ``attestation_outage``
+        latch — simlab's revoked-root drill reads it so the revocation
+        fires only AFTER the latch is armed (a fleet that never
+        verified stays quiet by design, so revoking earlier would test
+        nothing)."""
+        return self._attestation_ever_verified
+
     # -------------------------------------------------------------- scans
     def scan_once(self) -> dict:
         t0 = time.monotonic()

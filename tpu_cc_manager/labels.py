@@ -120,6 +120,14 @@ FLIP_TAINT_EFFECT = "NoSchedule"
 #: flip taint, which would let the pod land mid-flip).
 REQUIRES_CC_LABEL = "tpu.google.com/requires-cc-mode"
 
+#: Agent code-version breadcrumb (simlab's rolling-upgrade drill, and
+#: any future agent that wants to advertise its build): written by the
+#: reconcile path as a deferred publication riding a carrier write
+#: (zero extra round trips), read by operators and by simlab's
+#: lifecycle invariants oracle to prove a rolling agent upgrade
+#: completed on every cohort.
+AGENT_VERSION_ANNOTATION = "tpu.google.com/cc.agent-version"
+
 #: TPUCCPolicy custom resource (tpu_cc_manager.policy): the declarative,
 #: level-triggered replacement for hand-run rollouts. Cluster-scoped —
 #: a policy selects node pools by label selector, so namespacing it

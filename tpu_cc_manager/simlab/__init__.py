@@ -76,6 +76,11 @@ def main_from_args(args) -> int:
         print(json.dumps(artifact, sort_keys=True))
         return 0 if artifact["ok"] else 1
 
-    print("usage: simlab {run,validate} ... (see --help)",
+    if args.simlab_command == "propgen":
+        from tpu_cc_manager.simlab.propgen import main_from_args as _pg
+
+        return _pg(args)
+
+    print("usage: simlab {run,validate,propgen} ... (see --help)",
           file=sys.stderr)
     return 2

@@ -25,6 +25,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from tpu_cc_manager import labels as L
+from tpu_cc_manager.device.gate import DeviceGate
 from tpu_cc_manager.engine import FatalModeError, ModeEngine, NullDrainer
 from tpu_cc_manager.flightrec import FlightRecorder
 from tpu_cc_manager.k8s.batch import NodePatchBatcher
@@ -37,6 +38,34 @@ _EMPTY = object()
 
 #: worker-queue sentinel telling a worker thread to exit
 _STOP = object()
+
+
+class SimGate(DeviceGate):
+    """In-memory device gate: records the permission bits chmod WOULD
+    set on each device path instead of touching a devfs that fake
+    chips don't have. This makes the engine's fail-secure contract —
+    a device locked for a flip stays at FLIP_LOCK_PERMS until a later
+    successful verify reopens it — OBSERVABLE per replica, which is
+    exactly what the lifecycle invariants oracle
+    (simlab.invariants) checks at quiescence."""
+
+    def __init__(self) -> None:
+        super().__init__(enabled=True)
+        self._perms_lock = threading.Lock()
+        self._perms: Dict[str, int] = {}
+
+    def _chmod(self, path: str, perms: int, *, must_succeed: bool) -> bool:
+        with self._perms_lock:
+            self._perms[path] = perms
+        return True
+
+    def current_perms(self, path: str):
+        with self._perms_lock:
+            return self._perms.get(path)
+
+    def perms_snapshot(self) -> Dict[str, int]:
+        with self._perms_lock:
+            return dict(self._perms)
 
 
 class ReplicaShell:
@@ -57,11 +86,20 @@ class ReplicaShell:
         *,
         evidence: bool = False,
         metrics=None,
+        attestor=None,
     ):
         self.node_name = node_name
         self.kube = kube
         self.backend = backend
         self.evidence = evidence
+        #: optional per-replica attest.FakeTpm (scenario.attestation):
+        #: the engine extends ITS measured flip history and evidence
+        #: quotes come from IT, so one process carries a fleet of
+        #: independent PCRs (runner.AttestationLab owns the state dirs
+        #: and the verifier-side trust root)
+        self.attestor = attestor
+        #: recording device gate: the oracle's fail-secure probe
+        self.gate = SimGate()
         #: optional obs.Metrics — the SAME metric set a real agent
         #: exposes, so this replica is a genuine scrape target for the
         #: fleet observatory (fleetobs.py, ISSUE 9): outcomes, the
@@ -106,6 +144,8 @@ class ReplicaShell:
             backend=backend,
             tracer=tracer,
             recorder=self.recorder,
+            gate=self.gate,
+            attestor=attestor,
         )
         self._tracer = tracer
         self._lock = threading.Lock()
@@ -115,6 +155,15 @@ class ReplicaShell:
         self._queued = False
         self.alive = True
         self.applied: Optional[str] = None
+        #: code-version behavior tag (the rolling-upgrade drill):
+        #: "v1" is the baseline; an upgraded replica advertises its
+        #: version as the cc.agent-version annotation, deferred
+        #: through the batcher so it rides the next carrier write —
+        #: the observable behavior difference between the two code
+        #: versions reconciling one pool mid-rollout. Written under
+        #: _lock (upgrade()), read on the worker thread.
+        self.version = "v1"
+        self._version_published = "v1"
         # counters (read single-threaded at report time)
         self.reconciles = 0
         self.outcomes: Dict[str, int] = {}
@@ -216,6 +265,11 @@ class ReplicaShell:
             self.applied = mode
             if self.evidence:
                 self._defer_evidence()
+            with self._lock:
+                version = self.version
+                publish_version = version != self._version_published
+            if publish_version:
+                self._defer_version(version)
         elif outcome in ("failure", "error"):
             self._arm_repair(mode, trace)
 
@@ -229,7 +283,11 @@ class ReplicaShell:
         from tpu_cc_manager.evidence import build_evidence
 
         try:
-            doc = build_evidence(self.node_name, self.backend)
+            doc = build_evidence(
+                self.node_name, self.backend,
+                attestor=(self.attestor if self.attestor is not None
+                          else "auto"),
+            )
             payload = _json.dumps(doc, sort_keys=True,
                                   separators=(",", ":"))
         except Exception:
@@ -247,6 +305,22 @@ class ReplicaShell:
             "evidence",
             annotations={L.EVIDENCE_ANNOTATION: payload},
             gen=self.evidence_wanted_gen,
+            on_published=landed,
+        )
+
+    def _defer_version(self, version: str) -> None:
+        """Advertise the running code version (upgrade drill): a
+        coalescing publication riding the next carrier write — an
+        upgrade costs zero extra round trips, pinned by the oracle's
+        writes-per-flip budget."""
+
+        def landed(gen: int) -> None:
+            with self._lock:
+                self._version_published = version
+
+        self.batcher.defer(
+            "agent_version",
+            annotations={L.AGENT_VERSION_ANNOTATION: version},
             on_published=landed,
         )
 
@@ -288,6 +362,15 @@ class ReplicaShell:
         resubmits (a restarted agent's prime-read analog)."""
         with self._lock:
             self.alive = True
+
+    def upgrade(self, version: str) -> None:
+        """Process-replacement half of a rolling agent upgrade: down,
+        new code version swapped in. The injector restarts it with the
+        same prime-read the crash fault uses; the first successful
+        reconcile after restart advertises the new version."""
+        with self._lock:
+            self.alive = False
+            self.version = version
 
     def close(self) -> None:
         for t in self._timers:

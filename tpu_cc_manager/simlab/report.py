@@ -54,6 +54,7 @@ def build_artifact(
     trace_stitch: Optional[dict] = None,
     slo: Optional[dict] = None,
     shards: Optional[dict] = None,
+    lifecycle: Optional[dict] = None,
     notes: Optional[str] = None,
 ) -> dict:
     metrics = {
@@ -86,6 +87,13 @@ def build_artifact(
         metrics["shards"] = shards
         metrics["shard_failover_convergence_s"] = shards.get(
             "failover_convergence_s")
+    if lifecycle is not None:
+        # the lifecycle-fault surface (ISSUE 12): code versions running
+        # at quiescence, upgrade/evacuation accounting, and the
+        # attestation lab's rotation/revocation/forgery record — what
+        # the propgen invariants oracle judged, preserved for a
+        # regression reader
+        metrics["lifecycle"] = lifecycle
     if slo is not None:
         # the fleet observatory's verdict (fleetobs.py, ISSUE 9):
         # per-objective burn rates + budget remaining, the alert log,

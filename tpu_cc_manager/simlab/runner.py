@@ -57,6 +57,95 @@ POLICY_LEASE = "tpu-cc-policy-controller"
 POOL_LABEL = "simlab.pool"
 
 
+class AttestationLab:
+    """Live attestation state for one scenario run: a software TPM per
+    simulated node (own state dir, own measured flip history) plus the
+    lab-provisioned VERIFIER trust root (TPU_CC_TPM_KEY for the run
+    only — saved and restored), with key rotation and root revocation
+    as first-class operations for the lifecycle faults.
+
+    The split mirrors production exactly: the per-node TPMs are the
+    node side (root can ask them to quote anything, cannot rewrite
+    their history); the env key is the verifier side (the fleet
+    audit's trust root). ``rotate`` moves both in the rotation posture
+    (new primary + verify-only tail); ``revoke`` removes only the
+    verifier side — the nodes keep quoting into the void, which is
+    precisely the attestation_outage drill."""
+
+    def __init__(self, node_names: List[str],
+                 key_seed: str = "simlab-tpm-key"):
+        import tempfile
+
+        from tpu_cc_manager.attest import FakeTpm
+
+        self._tmp = tempfile.TemporaryDirectory(prefix="simlab-tpm-")
+        self._key_seed = key_seed
+        self._seq = 0
+        self._retired: List[str] = []
+        self.key = f"{key_seed}-0"
+        self.rotations = 0
+        self.revoked = False
+        #: (node, claim, doc) per planted node-root forgery
+        self.forged: List[dict] = []
+        self.tpms = {
+            name: FakeTpm(
+                state_dir=os.path.join(self._tmp.name, name),
+                key=self.key.encode(),
+            )
+            for name in node_names
+        }
+        # ALL four sources attest.tpm_key()/tpm_keys() read are owned
+        # for the run — an ambient TPU_CC_TPM_KEY_FILE on the host
+        # would otherwise keep the verifier silently keyed straight
+        # through a "revocation" (and pollute rotation tails)
+        self._prior_env = {
+            name: os.environ.get(name)
+            for name in ("TPU_CC_TPM_KEY", "TPU_CC_TPM_OLD_KEYS",
+                         "TPU_CC_TPM_KEY_FILE",
+                         "TPU_CC_TPM_OLD_KEYS_FILE")
+        }
+        os.environ["TPU_CC_TPM_KEY"] = self.key
+        for name in ("TPU_CC_TPM_OLD_KEYS", "TPU_CC_TPM_KEY_FILE",
+                     "TPU_CC_TPM_OLD_KEYS_FILE"):
+            os.environ.pop(name, None)
+
+    def rotate(self) -> dict:
+        self._seq += 1
+        self._retired.insert(0, self.key)
+        self.key = f"{self._key_seed}-{self._seq}"
+        # verifier first — retired keys into the verify-only rotation
+        # tail (TPU_CC_TPM_OLD_KEYS, attest.tpm_keys), new primary in —
+        # then the signers: no ordering window where a fresh quote is
+        # unverifiable
+        os.environ["TPU_CC_TPM_OLD_KEYS"] = "\n".join(self._retired)
+        os.environ["TPU_CC_TPM_KEY"] = self.key
+        for tpm in self.tpms.values():
+            tpm.set_key(self.key.encode())
+        self.rotations += 1
+        return {"rotation": self._seq, "tail_keys": len(self._retired)}
+
+    def revoke(self) -> dict:
+        # losing the PRIMARY is the whole outage: retired keys alone
+        # keep a verifier keyless by attest.tpm_keys' rule. Every
+        # source goes, including the file fallbacks cleared at
+        # construction — belt and braces against a mid-run setter.
+        for name in self._prior_env:
+            os.environ.pop(name, None)
+        self.revoked = True
+        return {"revoked": True}
+
+    def note_forged(self, node: str, claim: str, doc: dict) -> None:
+        self.forged.append({"node": node, "claim": claim, "doc": doc})
+
+    def close(self) -> None:
+        for name, prior in self._prior_env.items():
+            if prior is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prior
+        self._tmp.cleanup()
+
+
 def _env_int(name: str, default: int) -> int:
     """Positive-int env override; unset, unparseable, or <= 0 (the
     documented '0 = scenario's value') falls back to the default."""
@@ -82,6 +171,8 @@ class SimLab:
         self.pump: Optional[WatchPump] = None
         self.stamps = LagStamps()
         self.injector: Optional[FaultInjector] = None
+        #: per-node TPMs + verifier trust root (scenario.attestation)
+        self.attest_lab: Optional[AttestationLab] = None
         self._controller_threads: List[threading.Thread] = []
         self._controllers: List[object] = []
         #: tpu_cc_manager.shard.ShardManager when controllers.shards>0
@@ -162,12 +253,16 @@ class SimLab:
                 POOL_LABEL: self._pool_of(i),
                 L.CC_MODE_LABEL: sc.initial_mode,
             }))
+        if sc.attestation:
+            self.attest_lab = AttestationLab(self.node_names)
         for name in self.node_names:
             self.replicas[name] = ReplicaShell(
                 name, self.data_kube,
                 fake_backend(n_chips=sc.chips_per_node),
                 self.tracer, evidence=sc.evidence,
                 metrics=Metrics(),
+                attestor=(self.attest_lab.tpms[name]
+                          if self.attest_lab is not None else None),
             )
 
     def _start_observer(self) -> None:
@@ -307,29 +402,68 @@ class SimLab:
         return {"mode": mode, "nodes": len(names),
                 "trace_id": span.trace_id}
 
-    def _act_create_policy(self, params: dict) -> dict:
-        pool = params.get("pool")
+    def _create_policy(self, *, mode: str, pool: Optional[int],
+                       name: Optional[str] = None,
+                       max_unavailable: Optional[int] = None,
+                       group_timeout_s: float = 120) -> dict:
+        """Create one TPUCCPolicy CR in the store (shared by the
+        create_policy action and the policy_conflict fault)."""
         selector = (f"{POOL_LABEL}=p{pool}" if pool is not None
                     else L.TPU_ACCELERATOR_LABEL)
         names = self._nodes_in_pool(pool)
-        max_unavailable = params.get("max_unavailable", len(names))
-        name = f"simlab-{self.scenario.name}-{pool if pool is not None else 'all'}"
+        if max_unavailable is None:
+            max_unavailable = len(names)
+        if name is None:
+            name = (f"simlab-{self.scenario.name}-"
+                    f"{pool if pool is not None else 'all'}")
         self.server.store.add_custom(L.POLICY_GROUP, L.POLICY_PLURAL, {
             "apiVersion": f"{L.POLICY_GROUP}/{L.POLICY_VERSION}",
             "kind": L.POLICY_KIND,
             "metadata": {"name": name},
             "spec": {
-                "mode": params["mode"],
+                "mode": mode,
                 "nodeSelector": selector,
                 "strategy": {
                     "maxUnavailable": max_unavailable,
-                    "groupTimeoutSeconds": params.get(
-                        "group_timeout_s", 120),
+                    "groupTimeoutSeconds": group_timeout_s,
                 },
             },
         })
-        return {"policy": name, "mode": params["mode"],
-                "selector": selector}
+        return {"policy": name, "mode": mode, "selector": selector}
+
+    def _act_create_policy(self, params: dict) -> dict:
+        return self._create_policy(
+            mode=params["mode"],
+            pool=params.get("pool"),
+            max_unavailable=params.get("max_unavailable"),
+            group_timeout_s=params.get("group_timeout_s", 120),
+        )
+
+    # --------------------------------------------------- fleet plane taps
+    def _fleet_controllers(self) -> List[object]:
+        from tpu_cc_manager.fleet import FleetController
+
+        ctls = [c for c in self._controllers
+                if isinstance(c, FleetController)]
+        if self.shard_manager is not None:
+            ctls.extend(b.fleet for b in self.shard_manager.bundles())
+        return ctls
+
+    def _attestation_armed(self) -> bool:
+        """Has any fleet scan verified a TEE quote yet? (The
+        root_revoked fault waits for this — the outage latch only
+        fires on a once-verified fleet.)"""
+        return any(
+            getattr(c, "attestation_ever_verified", False)
+            for c in self._fleet_controllers()
+        )
+
+    def final_fleet_reports(self) -> List[dict]:
+        """Every fleet controller's last report (after the settle
+        scan) — the invariants oracle judges audit buckets and
+        problems lines from these."""
+        return [c.last_report for c in self._fleet_controllers()
+                if getattr(c, "last_report", None)]
 
     # --------------------------------------------------------- convergence
     def _wait_converged(self, target: str, timeout_s: float):
@@ -389,6 +523,11 @@ class SimLab:
                 lease_names=(
                     [POLICY_LEASE] if sc.controllers.leader_elect else []
                 ),
+                nodes_in_pool=self._nodes_in_pool,
+                attest_lab=self.attest_lab,
+                create_policy=self._create_policy,
+                attestation_armed=self._attestation_armed,
+                converge_mode=sc.converge.mode,
             )
 
             # initial reconcile: one deliberate storm to initial_mode,
@@ -470,6 +609,11 @@ class SimLab:
         fleet — mid-churn skew (evidence a throttled write behind its
         label) is the scan racing the storm, not an end-state
         finding."""
+        if self.injector is not None:
+            # restorative fault callbacks (uncordon, throttle restore)
+            # run early: the settled fleet the oracle judges must be
+            # the restored one even when convergence beat the delay
+            self.injector.settle()
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
             busy = any(
@@ -620,6 +764,24 @@ class SimLab:
         if self.injector is not None:
             replica_stats["crashed"] = self.injector.crashed_total
             replica_stats["restarted"] = self.injector.restarted_total
+        # lifecycle surface (ISSUE 12): versions running at quiescence,
+        # upgrade/evacuation accounting, and the attestation lab state
+        # — the invariants oracle reads the live lab, but the artifact
+        # must carry enough for a regression reader too
+        versions: Dict[str, int] = {}
+        for r in self.replicas.values():
+            versions[r.version] = versions.get(r.version, 0) + 1
+        lifecycle = {"versions": versions}
+        if self.injector is not None:
+            lifecycle["upgraded"] = self.injector.upgraded_total
+            lifecycle["evacuated"] = len(self.injector.evacuated_nodes)
+        if self.attest_lab is not None:
+            lifecycle["attestation"] = {
+                "rotations": self.attest_lab.rotations,
+                "revoked": self.attest_lab.revoked,
+                "forged_nodes": [f["node"]
+                                 for f in self.attest_lab.forged],
+            }
         shards = None
         if self.shard_manager is not None:
             from tpu_cc_manager.obs import validate_exposition
@@ -702,6 +864,7 @@ class SimLab:
             trace_stitch=self._stitch_traces(),
             slo=slo,
             shards=shards,
+            lifecycle=lifecycle,
             notes=notes,
         )
 
@@ -729,3 +892,7 @@ class SimLab:
             self.pool.stop()
         if self.server is not None:
             self.server.stop()
+        if self.attest_lab is not None:
+            # restores the process's prior TPU_CC_TPM_KEY posture and
+            # removes the per-node TPM state dirs
+            self.attest_lab.close()

@@ -62,6 +62,44 @@ FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
     # controllers.shards > 0.
     "shard_kill": {"host": (False, int),
                    "restart_after_s": (False, (int, float))},
+    # ---- lifecycle fault families (ISSUE 12) -------------------------
+    # rolling agent upgrade: the pool's replicas restart cohort by
+    # cohort with a new code-version behavior, so two versions
+    # reconcile one pool mid-rollout; upgraded replicas advertise
+    # their version via the cc.agent-version annotation riding a
+    # carrier write (zero extra round trips)
+    "agent_upgrade": {"pool": (False, int),
+                      "cohorts": (False, int),
+                      "stagger_s": (False, (int, float)),
+                      "version": (False, str)},
+    # rotate the attestation signing key fleet-wide mid-scan: every
+    # node's TPM signs with the new key, the verifier keeps the old
+    # key in its rotation tail, and the next wave's evidence must
+    # re-verify cleanly (requires `attestation`)
+    "key_rotation": {},
+    # revoke the VERIFIER's attestation trust root: nodes keep
+    # quoting, nobody can check them — the fleet's attestation_outage
+    # latch must fire and the fleet must never read as verified again.
+    # `forge` additionally plants a node-root forged evidence document
+    # (statefile-rewrite analog) on one already-converged node, which
+    # must land in attestation_mismatch, never be accepted, and never
+    # flip a chip (requires `attestation` + a fleet audit plane)
+    "root_revoked": {"forge": (False, bool)},
+    # two policies claiming overlapping pools: an owner policy (first
+    # in name order) selecting the whole fleet and a rival selecting
+    # one pool. The name-ordered conflict rule must park the rival in
+    # phase Conflicted while the owner converges the fleet (requires
+    # controllers.policy)
+    "policy_conflict": {"mode": (True, str),
+                        "rival_mode": (True, str),
+                        "pool": (False, int)},
+    # region-evacuation drain racing in-flight flips: cordon N nodes
+    # (spec.unschedulable, a real API write) while a mode storm is in
+    # flight, uncordon after duration_s — the cordon must neither stop
+    # reconciliation nor survive the run
+    "evacuation_drain": {"count": (True, int),
+                         "pool": (False, int),
+                         "duration_s": (False, (int, float))},
 }
 
 #: action kind -> {param: (required, type(s))}; "fault" params are
@@ -119,6 +157,12 @@ class Scenario:
     workers: int = 8
     qps: float = 0.0
     evidence: bool = False
+    #: per-replica software TPMs + a lab-provisioned verifier trust
+    #: root (TPU_CC_TPM_KEY for the run only): evidence carries real
+    #: quotes over real measured flip histories, so the key_rotation /
+    #: root_revoked lifecycle faults act on live attestation state.
+    #: Requires `evidence` (quotes ride evidence documents).
+    attestation: bool = False
     watch_timeout_s: float = 10.0
     controllers: Controllers = Controllers()
 
@@ -197,9 +241,23 @@ def _validate_action(raw: dict, idx: int, pools: int) -> Action:
         _reject_unknown({k: v for k, v in params.items() if k != "fault"},
                         spec, f"{where} (fault {fault})")
         _typed(params, spec, f"{where} (fault {fault})")
-        for key in ("count",):
+        for key in ("count", "cohorts"):
             if key in spec and params.get(key, 1) < 1:
                 raise ScenarioError(f"{where}: {key!r} must be >= 1")
+        for key in ("mode", "rival_mode"):
+            if key in params:
+                _mode(params[key], f"{where} (fault {fault} {key})")
+        if fault == "policy_conflict" and \
+                params["mode"] == params["rival_mode"]:
+            raise ScenarioError(
+                f"{where}: policy_conflict mode and rival_mode must "
+                "differ (identical claims are not a conflict)"
+            )
+        pool = params.get("pool")
+        if pool is not None and not (0 <= pool < pools):
+            raise ScenarioError(
+                f"{where}: pool {pool} out of range [0, {pools})"
+            )
     else:
         _reject_unknown(params, ACTION_PARAMS[kind], where)
         _typed(params, ACTION_PARAMS[kind], where)
@@ -220,7 +278,7 @@ def validate_scenario(doc: dict) -> Scenario:
         raise ScenarioError("scenario must be a JSON object")
     allowed = {
         "version", "name", "nodes", "pools", "chips_per_node",
-        "initial_mode", "workers", "qps", "evidence",
+        "initial_mode", "workers", "qps", "evidence", "attestation",
         "watch_timeout_s", "controllers", "actions", "converge",
     }
     _reject_unknown(doc, allowed, "scenario")
@@ -239,6 +297,7 @@ def validate_scenario(doc: dict) -> Scenario:
         "workers": (False, int),
         "qps": (False, (int, float)),
         "evidence": (False, bool),
+        "attestation": (False, bool),
         "watch_timeout_s": (False, (int, float)),
     }, "scenario")
     nodes = doc["nodes"]
@@ -318,10 +377,36 @@ def validate_scenario(doc: dict) -> Scenario:
     actions = [
         _validate_action(a, i, pools) for i, a in enumerate(raw_actions)
     ]
+    attestation = doc.get("attestation", False)
+    if attestation and not doc.get("evidence", False):
+        raise ScenarioError(
+            "attestation requires evidence (quotes ride evidence "
+            "documents; without evidence there is nothing to attest)"
+        )
     for a in actions:
         if a.kind == "create_policy" and not controllers.policy:
             raise ScenarioError(
                 "create_policy action requires controllers.policy"
+            )
+        if a.kind == "fault" and a.params["fault"] in (
+                "key_rotation", "root_revoked"):
+            if not attestation:
+                raise ScenarioError(
+                    f"{a.params['fault']} fault requires attestation "
+                    "(there is no signing key to rotate or trust root "
+                    "to revoke otherwise)"
+                )
+            if not (controllers.fleet or controllers.shards):
+                raise ScenarioError(
+                    f"{a.params['fault']} fault requires a fleet audit "
+                    "plane (controllers.fleet or controllers.shards) — "
+                    "the attestation verdicts and the outage latch "
+                    "live in the fleet scan"
+                )
+        if (a.kind == "fault" and a.params["fault"] == "policy_conflict"
+                and not controllers.policy):
+            raise ScenarioError(
+                "policy_conflict fault requires controllers.policy"
             )
         if (a.kind == "fault" and a.params["fault"] == "leader_flap"
                 and not controllers.leader_elect):
@@ -348,6 +433,7 @@ def validate_scenario(doc: dict) -> Scenario:
         workers=workers,
         qps=float(qps),
         evidence=doc.get("evidence", False),
+        attestation=attestation,
         watch_timeout_s=float(watch_timeout_s),
         controllers=controllers,
         actions=sorted(actions, key=lambda a: a.at),
