@@ -56,6 +56,45 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
             )
         )
 
+    # the async I/O core is the bench default (ISSUE 13): every
+    # agent's reads/writes multiplex ONE event loop's pipelined
+    # connection pool through sync façades — the structural change the
+    # flips_per_min_windowed floor raise is judged on.
+    # TPU_CC_BENCH_KUBE=threaded restores the per-agent HttpKubeClient
+    # for A/B attribution.
+    import os as _os
+
+    use_aio = _os.environ.get("TPU_CC_BENCH_KUBE", "aio") != "threaded"
+    #: node-WRITE round trips (PATCH/PUT on /api/v1/nodes) under the
+    #: offered load of the measured rounds: enqueue -> response,
+    #: queueing included — the flip_write_rtt_p50_s axis (gated by
+    #: scripts/bench_trend.py)
+    write_rtts: list = []
+    rtt_lock = threading.Lock()
+    rtt_armed = [False]
+    shared_aio = None
+    if use_aio:
+        from tpu_cc_manager.k8s.aio import AsyncKubeClient
+        from tpu_cc_manager.k8s.aio_bridge import SyncKubeFacade
+
+        shared_aio = AsyncKubeClient(
+            KubeConfig("127.0.0.1", server.port, use_tls=False)
+        )
+
+        def _on_rtt(method, path, rtt):
+            if (rtt_armed[0] and method in ("PATCH", "PUT")
+                    and path.startswith("/api/v1/nodes/")):
+                with rtt_lock:
+                    write_rtts.append(rtt)
+
+        shared_aio.add_rtt_observer(_on_rtt)
+
+    def make_kube():
+        config = KubeConfig("127.0.0.1", server.port, use_tls=False)
+        if shared_aio is not None:
+            return SyncKubeFacade(config, aio=shared_aio)
+        return HttpKubeClient(config)
+
     # per-phase span durations across every agent (trace-sink fed):
     # the perf budget the hot path is judged against — a regression in
     # the headline p50 must be attributable to a PHASE, not a mystery
@@ -69,7 +108,7 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
     agents = []
     threads = []
     for name in node_names:
-        kube = HttpKubeClient(KubeConfig("127.0.0.1", server.port, use_tls=False))
+        kube = make_kube()
         cfg = AgentConfig(
             node_name=name,
             default_mode="off",
@@ -121,6 +160,7 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
     # write below is the AGENTS' — the number the coalescing layer is
     # judged on (ISSUE 6: <= 2 round trips per successful flip)
     writes_before = store.node_write_stats()
+    rtt_armed[0] = True  # per-write RTT collected over the same window
 
     latencies = []
     round_times = []
@@ -172,6 +212,7 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         windowed_flips += len(node_names) - 1
     elapsed = time.monotonic() - t_bench0
     writes_after = store.node_write_stats()
+    rtt_armed[0] = False
 
     # rolling-update scenario (BASELINE config 3 shape at pool scale):
     # roll the whole pool back to "on" with a bounded disruption window
@@ -210,6 +251,14 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
         (writes_after["mutations"] - writes_before["mutations"])
         / max(total_flips, 1), 3,
     )
+    with rtt_lock:
+        rtts = sorted(write_rtts)
+    flip_write_rtt_p50 = (
+        round(statistics.median(rtts), 5) if rtts else None
+    )
+    flip_write_rtt_p95 = (
+        round(rtts[int(0.95 * len(rtts))], 5) if rtts else None
+    )
     flips_per_min = total_flips / elapsed * 60.0
     flips_per_min_windowed = (
         round(windowed_flips / sum(window_times) * 60.0, 1)
@@ -246,6 +295,22 @@ def run_bench(n_nodes: int, rounds: int, readiness_dir: str):
             # idle-tick flush tail)
             "node_writes_per_flip": node_writes_per_flip,
             "node_mutations_per_flip": node_mutations_per_flip,
+            # per-write round trip under offered load (ISSUE 13): the
+            # latency a flip's PATCH/PUT actually experiences across
+            # the measured rounds, queueing included — enqueue on the
+            # async core's pipeline to response. Gated (lower is
+            # better) by scripts/bench_trend.py next to the throughput
+            # floor it explains: if multiplexing regresses, this rises
+            # before flips/min falls.
+            "flip_write_rtt_p50_s": flip_write_rtt_p50,
+            "flip_write_rtt_p95_s": flip_write_rtt_p95,
+            # which I/O core served the agents, with its accounting
+            # (dials << requests is the multiplexing; replays prove
+            # the exactly-once path stayed exercised)
+            "kube_io": (
+                dict(shared_aio.stats(), core="aio")
+                if shared_aio is not None else {"core": "threaded"}
+            ),
             "rollout_window8_s": round(rollout_s, 4),
             "nodes": n_nodes,
             "rounds": rounds,
@@ -675,16 +740,76 @@ def run_planner_tick_bench(n_nodes=100_000, n_pools=8, slice_hosts=16):
     }
 
 
+def _phase_fallback_cycle(state_dir: str):
+    """CPU-PJRT phase decomposition (ISSUE 13 satellite): BENCH_NOTES
+    r10 records that the r06-r08 real-chip phase data was NEVER
+    COMMITTED — on CPU-only hosts the extra returned {} and the round
+    file carried no ``real_chip_phase_s`` at all, so bench_attr's
+    verdict degraded to "data missing" forever. Every round now runs
+    the SAME engine stage→reset→wait_ready→verify cycle through the
+    JAX backend on the CPU PJRT device and persists the per-phase
+    sub-spans. ``real_chip_phase_source`` says which substrate they
+    came from; the TPU-only axes (real_chip_flip_s, the probe
+    sentinel) stay absent on fallback rounds — a CPU number must
+    never masquerade as the gated hardware axis."""
+    import os as _os
+
+    from tpu_cc_manager.device.gate import DeviceGate
+    from tpu_cc_manager.device.holders import HolderCheck
+    from tpu_cc_manager.device.jaxdev import JaxTpuBackend
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.trace import Tracer
+
+    prior = _os.environ.get("TPU_CC_JAX_ALLOW_CPU")
+    _os.environ["TPU_CC_JAX_ALLOW_CPU"] = "1"
+    try:
+        be = JaxTpuBackend(state_dir=state_dir)
+        chips, err = be.find_tpus()
+        if err or not chips:
+            return {}
+        phase_durs: dict = {}
+        tracer = Tracer()
+        tracer.add_sink(
+            lambda s: phase_durs.setdefault(s.name, []).append(s.dur_s)
+        )
+        engine = ModeEngine(
+            set_state_label=lambda v: None, evict_components=False,
+            backend=be, tracer=tracer,
+            gate=DeviceGate(enabled=False),
+            holder_check=HolderCheck(enabled=False),
+        )
+        if not engine.set_mode("on"):
+            return {}
+        phase_s = {
+            name: round(sum(durs), 4)
+            for name, durs in sorted(phase_durs.items())
+            if name in ("enumerate", "plan", "stage", "reset",
+                        "wait_ready", "verify")
+        }
+        return {
+            "real_chip_phase_s": phase_s,
+            "real_chip_phase_source": "cpu-pjrt-fallback",
+        }
+    finally:
+        if prior is None:
+            _os.environ.pop("TPU_CC_JAX_ALLOW_CPU", None)
+        else:
+            _os.environ["TPU_CC_JAX_ALLOW_CPU"] = prior
+
+
 def bench_real_chip(state_dir: str):
     """Real-hardware L0 extra: when the host exposes a live TPU through
     PJRT, drive one full stage→reset→wait→verify flip cycle on the real
-    chip via the JAX backend (device/jaxdev.py) and time it. Returns {}
-    on CPU-only hosts — the headline metric never depends on hardware."""
+    chip via the JAX backend (device/jaxdev.py) and time it. On
+    CPU-only hosts the gated hardware axes are absent, but the
+    per-phase decomposition is ALWAYS persisted (CPU-PJRT fallback,
+    see _phase_fallback_cycle) so a committed round is never "data
+    missing" to scripts/bench_attr.py."""
     try:
         import jax
 
         if not any(d.platform == "tpu" for d in jax.local_devices()):
-            return {}
+            return _phase_fallback_cycle(state_dir)
         from tpu_cc_manager.device.base import set_backend
         from tpu_cc_manager.device.jaxdev import JaxTpuBackend
         from tpu_cc_manager.engine import ModeEngine
@@ -739,6 +864,7 @@ def bench_real_chip(state_dir: str):
             "real_chip_count": len(chips),
             "real_chip_flip_s": round(flip_s, 4),
             "real_chip_phase_s": phase_s,
+            "real_chip_phase_source": "tpu",
             # pre/post flip probes: the contention sentinel pair
             # (real_chip_probe_s keeps its historical name/meaning —
             # the post-flip probe — for r01-r06 continuity)

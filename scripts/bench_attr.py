@@ -43,8 +43,14 @@ AXIS_SOURCES = {
     "node_writes_per_flip": ("phase_p50_s",),
     "fleet_scan_warm_s": ("scale256",),
     "planner_tick_100k_s": (),
+    "flip_write_rtt_p50_s": ("kube_io", "phase_p50_s"),
     "p50": ("phase_p50_s",),
 }
+
+#: extras key naming the substrate real_chip_phase_s came from
+#: ("tpu" | "cpu-pjrt-fallback"); a cross-substrate comparison is
+#: flagged in the verdict rather than silently ranked as a phase move
+PHASE_SOURCE_KEY = "real_chip_phase_source"
 
 #: probe pair: the real-chip host-contention sentinel (r07+)
 PROBE_KEYS = ("real_chip_probe_pre_s", "real_chip_probe_s")
@@ -198,6 +204,16 @@ def attribute_axis(axis, prev, cur):
     else:
         srcs = ", ".join(missing) or ", ".join(sources) or axis
         conclusion = f"cannot attribute — data missing ({srcs})"
+    if axis.startswith("real_chip"):
+        src_prev = prev_x.get(PHASE_SOURCE_KEY)
+        src_cur = cur_x.get(PHASE_SOURCE_KEY)
+        if src_prev and src_cur and src_prev != src_cur:
+            # a TPU round next to a CPU-fallback round: the phase
+            # deltas compare different substrates and prove nothing
+            conclusion += (
+                f" [caveat: phase sources differ — {src_prev} vs "
+                f"{src_cur}; cross-substrate deltas are not evidence]"
+            )
     verdict = (", ".join(parts) + " -> " if parts else "") + conclusion
     return {
         "axis": axis,

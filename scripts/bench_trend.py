@@ -66,6 +66,15 @@ REGRESSION_FACTOR = 2.0
 #: or partition re-acquisition breaks. pool1024 is additionally bound
 #: RELATIVE to pool256 (RELATIVE_CEILINGS below): 4x the fleet must
 #: stay within 3x the convergence wall clock.
+#: flip_write_rtt_p50_s joined in r13 (the async-reconcile-core round,
+#: ISSUE 13): per-node-write round trip (PATCH/PUT, queueing included)
+#: under the pool bench's offered load, measured on the async I/O
+#: core's pipeline — the axis that rises FIRST if multiplexing quietly
+#: re-serializes, before flips_per_min_windowed falls. The same round
+#: raised the flips_per_min_windowed floor 21k -> 25k (the async core
+#: measures ~1.3x the threaded client's windowed throughput on the
+#: same host; BENCH_NOTES ## r13 carries the host-variance
+#: acknowledgment forward).
 GATED_EXTRA_AXES = {
     "real_chip_flip_s": "lower",
     "pool256_convergence_s": "lower",
@@ -77,6 +86,7 @@ GATED_EXTRA_AXES = {
     "pool1024_convergence_s": "lower",
     "shard_failover_convergence_s": "lower",
     "lifecycle_convergence_s": "lower",
+    "flip_write_rtt_p50_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are
@@ -86,7 +96,12 @@ GATED_EXTRA_AXES = {
 #: a noted miss (e.g. a degraded sandbox host, see BENCH_NOTES r07's
 #: variance note) is a decision, an unnoted one is a bug.
 THROUGHPUT_FLOORS = {
-    "flips_per_min_windowed": 21000.0,
+    # raised from 21000 in r13: the async reconcile core (ISSUE 13)
+    # multiplexes the flip path's writes over pipelined connections,
+    # measured ~1.3x the threaded client's windowed steady state on
+    # the same sandbox (BENCH_NOTES ## r13 pre-explains the step and
+    # carries the r07 degraded-host acknowledgment convention forward)
+    "flips_per_min_windowed": 25000.0,
 }
 #: node_writes_per_flip: the coalescing contract is <= 2 writes per
 #: flip on the hot path; 2.5 allows the idle-tick flush tail without
@@ -104,6 +119,10 @@ WRITE_CEILINGS = {
 LATENCY_CEILINGS = {
     "fleet_scan_warm_s": 0.5,
     "planner_tick_100k_s": 9.0,
+    # a flip write under offered load must stay well inside the flush
+    # window (measured 0.027-0.034 s on the 2-core sandbox; the
+    # ceiling allows a loaded CI host, not a re-serialized pipeline)
+    "flip_write_rtt_p50_s": 0.25,
 }
 #: relative bars WITHIN the newest round (ISSUE 11 acceptance):
 #: numerator axis must stay <= factor x denominator axis. Skipped when

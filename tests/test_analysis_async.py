@@ -1,0 +1,131 @@
+"""ccaudit blocking-in-async rule (ISSUE 13 satellite): blocking call
+shapes inside ``async def`` bodies in the async kube core fail lint —
+positive/negative/pragma, scoped to the async-core module set."""
+
+from tpu_cc_manager.analysis.core import analyze_source
+
+AIO = "tpu_cc_manager/k8s/aio.py"
+BRIDGE = "tpu_cc_manager/k8s/aio_bridge.py"
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _async_findings(src, relpath=AIO):
+    return [f for f in analyze_source(src, relpath)
+            if f.rule == "blocking-in-async"]
+
+
+def test_time_sleep_in_async_def_flagged():
+    src = (
+        "import asyncio\n"
+        "import time\n"
+        "async def pump():\n"
+        "    time.sleep(1)\n"
+    )
+    hits = _async_findings(src)
+    assert len(hits) == 1
+    assert hits[0].line == 4
+    assert "time.sleep" in hits[0].message
+
+
+def test_sleep_alias_seen_through_import_fold():
+    src = (
+        "from time import sleep\n"
+        "async def pump():\n"
+        "    sleep(0.5)\n"
+    )
+    assert len(_async_findings(src)) == 1
+
+
+def test_sync_socket_and_http_client_flagged():
+    src = (
+        "import socket\n"
+        "import http.client\n"
+        "async def dial():\n"
+        "    s = socket.create_connection(('h', 1))\n"
+        "    c = http.client.HTTPConnection('h')\n"
+    )
+    hits = _async_findings(src)
+    assert len(hits) == 2
+
+
+def test_future_result_in_async_def_flagged():
+    src = (
+        "async def wait(fut):\n"
+        "    return fut.result()\n"
+    )
+    hits = _async_findings(src)
+    assert len(hits) == 1
+    assert ".result()" in hits[0].message
+
+
+def test_asyncio_sleep_and_sync_defs_not_flagged():
+    src = (
+        "import asyncio\n"
+        "import time\n"
+        "async def pump():\n"
+        "    await asyncio.sleep(1)\n"
+        "def sync_helper():\n"
+        "    time.sleep(1)\n"  # not loop code
+    )
+    assert _async_findings(src) == []
+
+
+def test_nested_sync_def_inside_async_not_flagged():
+    # a nested sync def is executor-bound (run_in_executor target),
+    # not loop code — flagging it would force pragmas on the exact
+    # pattern the rule wants to encourage
+    src = (
+        "import time\n"
+        "async def pump(loop):\n"
+        "    def blocking():\n"
+        "        time.sleep(1)\n"
+        "    await loop.run_in_executor(None, blocking)\n"
+    )
+    assert _async_findings(src) == []
+
+
+def test_pragma_suppresses_with_reason():
+    src = (
+        "import time\n"
+        "async def pump():\n"
+        "    time.sleep(0.001)  # ccaudit: allow-blocking-in-async(sub-ms jitter by design)\n"
+    )
+    assert _async_findings(src) == []
+
+
+def test_rule_scoped_to_async_core_modules():
+    src = (
+        "import time\n"
+        "async def pump():\n"
+        "    time.sleep(1)\n"
+    )
+    # same code outside the async-core module set: other rules own it
+    assert _async_findings(src, relpath="tpu_cc_manager/agent.py") == []
+    # and the bridge module is in scope
+    assert len(_async_findings(src, relpath=BRIDGE)) == 1
+
+
+def test_live_async_core_is_clean():
+    # the shipped aio modules must pass their own rule (anything
+    # deliberate carries a pragma)
+    import os
+
+    from tpu_cc_manager.analysis.core import load_module, repo_root
+    from tpu_cc_manager.analysis.rules import blocking_in_async_findings
+
+    root = repo_root()
+    mods = []
+    for rel in sorted(
+        {AIO, BRIDGE} & {
+            p for p in (AIO, BRIDGE)
+            if os.path.exists(os.path.join(root, p))
+        }
+    ):
+        mod = load_module(root, rel)
+        assert mod is not None
+        mods.append(mod)
+    assert mods
+    assert blocking_in_async_findings(mods) == []

@@ -142,3 +142,55 @@ def test_bench_attr_cli_runs_on_committed_history(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "bench-attr:" in out
+
+
+def test_committed_round_with_phase_data_is_never_data_missing():
+    """ISSUE 13 satellite: bench.py now ALWAYS persists
+    extras.real_chip_phase_s (CPU-PJRT fallback on TPU-less hosts), so
+    any pair of committed rounds carries a phase surface on both sides
+    — the attribution must produce a verdict, never the "data missing"
+    degradation BENCH_NOTES r10 documented for the uncommitted era."""
+    prev = _real_chip_round(1.87, BASE_PHASES)
+    cur = _real_chip_round(2.05, dict(BASE_PHASES, reset=0.70))
+    for rnd in (prev, cur):
+        rnd["extras"]["real_chip_phase_source"] = "tpu"
+    (report,) = bench_attr.attribute(prev, cur, ["real_chip_flip_s"])
+    assert "data missing" not in report["verdict"]
+    assert report["missing"] == []
+    assert report["ranked"][0]["phase"] == "reset"
+
+
+def test_cross_substrate_phase_comparison_carries_caveat():
+    """A TPU round next to a CPU-fallback round must not pass its
+    phase deltas off as evidence — the verdict names the substrate
+    mismatch."""
+    prev = _bench(0.09, {
+        "real_chip_phase_s": {"wait_ready": 0.04, "reset": 0.002},
+        "real_chip_phase_source": "cpu-pjrt-fallback",
+    })
+    cur = _real_chip_round(4.43, BASE_PHASES)
+    cur["extras"]["real_chip_phase_source"] = "tpu"
+    (report,) = bench_attr.attribute(prev, cur, ["real_chip_flip_s"])
+    assert "phase sources differ" in report["verdict"]
+    assert "cpu-pjrt-fallback" in report["verdict"]
+
+
+def test_flip_write_rtt_axis_attributes_from_kube_io():
+    """The new r13 axis: a flip_write_rtt_p50_s regression diffs the
+    async core's own accounting (dials/requests) plus the phase
+    budget."""
+    prev = _bench(0.09, {
+        "flip_write_rtt_p50_s": 0.027,
+        "kube_io": {"dials": 8, "requests": 700, "replays": 0},
+        "phase_p50_s": {"taint_set": 0.02, "taint_clear": 0.02},
+    })
+    cur = _bench(0.11, {
+        "flip_write_rtt_p50_s": 0.09,
+        "kube_io": {"dials": 300, "requests": 700, "replays": 0},
+        "phase_p50_s": {"taint_set": 0.06, "taint_clear": 0.05},
+    })
+    (report,) = bench_attr.attribute(prev, cur,
+                                     ["flip_write_rtt_p50_s"])
+    assert "data missing" not in report["verdict"]
+    # the dial explosion (multiplexing loss) ranks at the top
+    assert report["ranked"][0]["phase"] == "dials"

@@ -431,3 +431,193 @@ def test_invalid_concurrency_fails_before_drain(monkeypatch):
     with pytest.raises(DeviceError):
         engine.set_mode("on")
     assert drainer.events == []  # no evict/reschedule round trip
+
+
+# ---------------------------------------- async-core serial equivalence
+
+
+def test_aio_window1_span_order_byte_identical_to_threaded(monkeypatch):
+    """ISSUE 13 acceptance: with the async core at window=1 serving
+    the engine's state/taint writes through the sync façade, flip
+    trace-span order is byte-identical to the threaded-client path —
+    the façade blocks the calling thread per call, so submit order ==
+    completion order and nothing about the span tree moves."""
+    from tpu_cc_manager.drain import NodeFlipTaint
+    from tpu_cc_manager.k8s.aio_bridge import SyncKubeFacade
+    from tpu_cc_manager.k8s.apiserver import FakeApiServer
+    from tpu_cc_manager.k8s.batch import NodePatchBatcher
+    from tpu_cc_manager.k8s.client import HttpKubeClient, KubeConfig
+    from tpu_cc_manager.k8s.objects import make_node
+
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+
+    def flip_spans(make_kube):
+        with FakeApiServer() as srv:
+            srv.store.add_node(make_node("n0"))
+            kube = make_kube(srv)
+            tr = Tracer()
+            batcher = NodePatchBatcher(kube, "n0", tracer=tr)
+            engine = ModeEngine(
+                set_state_label=batcher.write_state_label,
+                evict_components=False,
+                backend=fake_backend(n_chips=3),
+                tracer=tr,
+                gate=DeviceGate(enabled=False),
+                flip_taint=NodeFlipTaint(kube, "n0", batcher=batcher),
+            )
+            assert engine.set_mode("on") is True
+            if hasattr(kube, "close"):
+                kube.close()
+            return _span_sig(tr)
+
+    threaded = flip_spans(lambda srv: HttpKubeClient(
+        KubeConfig("127.0.0.1", srv.port, use_tls=False)
+    ))
+    aio = flip_spans(lambda srv: SyncKubeFacade(
+        KubeConfig("127.0.0.1", srv.port, use_tls=False),
+        max_conns=1, window=1,
+    ))
+    assert aio == threaded
+    # and the sequence really is the full serial flip shape, wire
+    # writes included
+    names = [n for n, _ in aio]
+    assert names[:3] == ["enumerate", "plan", "taint_set"]
+    assert names[-2:] == ["taint_clear", "state_label"] or (
+        "taint_clear" in names
+    )
+
+
+# --------------------------------------- stage/holder-scan overlap
+
+
+class _RecordingHolder:
+    """HolderCheck stand-in: records scan start/end stamps."""
+
+    enabled = True
+
+    def __init__(self, scan_s=0.0, fail=False):
+        self.scan_s = scan_s
+        self.fail = fail
+        self.calls = []
+        self.done = []
+        self._lock = threading.Lock()
+
+    def ensure_free(self, path):
+        import time as _time
+
+        with self._lock:
+            self.calls.append((path, _time.monotonic()))
+        if self.scan_s:
+            _time.sleep(self.scan_s)
+        with self._lock:
+            self.done.append((path, _time.monotonic()))
+        if self.fail:
+            raise DeviceError(f"{path}: held by pid 4242 (injected)")
+
+
+class _SlowStageChip(FakeChip):
+    """set_cc_mode (the stage body) takes ``stage_s``."""
+
+    def __init__(self, path, stage_s=0.0, fail_stage=False, **kw):
+        super().__init__(path=path, **kw)
+        self.stage_s = stage_s
+        self.fail_stage = fail_stage
+
+    def set_cc_mode(self, mode):
+        import time as _time
+
+        if self.stage_s:
+            _time.sleep(self.stage_s)
+        if self.fail_stage:
+            raise DeviceError(f"{self.path}: stage failed (injected)")
+        super().set_cc_mode(mode)
+
+
+def test_holder_scan_overlaps_stage(tmp_path, monkeypatch):
+    """The scan runs CONCURRENTLY with the stage (disjoint resources):
+    it starts before the stage finishes, and the flip pays
+    ~max(stage, scan), not their sum."""
+    import time as _time
+
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+    holder = _RecordingHolder(scan_s=0.25)
+    chip = _SlowStageChip(_dev_file(tmp_path, "accel0"), stage_s=0.25)
+    engine = _engine(FakeBackend(chips=[chip]),
+                     gate=DeviceGate(enabled=False),
+                     holder_check=holder)
+    t0 = _time.monotonic()
+    assert engine.set_mode("on") is True
+    elapsed = _time.monotonic() - t0
+    assert holder.calls and holder.done
+    # overlapped: 0.25s stage + 0.25s scan took well under their sum
+    assert elapsed < 0.45, elapsed
+    # ordering contract: the scan completed before the reset ran
+    assert chip.resets == 1
+
+
+def test_stage_failure_during_overlapped_scan_is_fail_secure(
+    tmp_path, monkeypatch
+):
+    """ISSUE 13 acceptance: a stage failure while the holder scan is
+    in flight leaves the device at FLIP_LOCK_PERMS and NEVER resets —
+    the scan is joined (not abandoned), the stage's error owns the
+    outcome, and gate-lock-before-reset ordering holds."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+    holder = _RecordingHolder(scan_s=0.3)
+    chip = _SlowStageChip(
+        _dev_file(tmp_path, "accel0"), fail_stage=True
+    )
+    states = []
+    engine = _engine(FakeBackend(chips=[chip]), states,
+                     holder_check=holder)
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+    # fail-secure: locked, never reset
+    assert _perms(chip.path) == FLIP_LOCK_PERMS
+    assert chip.resets == 0
+    # the overlapped scan was started AND joined, not abandoned
+    assert len(holder.calls) == 1
+    assert len(holder.done) == 1
+
+
+def test_holder_failure_with_clean_stage_still_fails_secure(
+    tmp_path, monkeypatch
+):
+    """The symmetric case: the stage lands, the overlapped scan finds
+    a holder — the device stays locked and un-reset, exactly the
+    pre-overlap semantics."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+    holder = _RecordingHolder(fail=True)
+    chip = FakeChip(path=_dev_file(tmp_path, "accel0"))
+    states = []
+    engine = _engine(FakeBackend(chips=[chip]), states,
+                     holder_check=holder)
+    assert engine.set_mode("on") is False
+    assert states == ["failed"]
+    assert _perms(chip.path) == FLIP_LOCK_PERMS
+    assert chip.resets == 0
+
+
+def test_overlap_keeps_serial_span_order(monkeypatch):
+    """The holder_check span keeps its historical position between
+    stage and reset (byte-identical serial trace), and carries the
+    overlapped attr so phase attribution knows the number is the
+    residual wait."""
+    monkeypatch.setenv("TPU_CC_FLIP_CONCURRENCY", "1")
+    tr = Tracer()
+    holder = _RecordingHolder()
+    engine = ModeEngine(
+        set_state_label=lambda v: None, evict_components=False,
+        backend=fake_backend(n_chips=2), tracer=tr,
+        gate=DeviceGate(enabled=False), holder_check=holder,
+    )
+    assert engine.set_mode("on") is True
+    sig = _span_sig(tr)
+    for i in range(2):
+        d = f"/dev/accel{i}"
+        idx = sig.index(("stage", d))
+        assert sig[idx + 1] == ("holder_check", d)
+        assert sig[idx + 2] == ("reset", d)
+    holder_spans = [s for s in tr.recent()
+                    if s["name"] == "holder_check"]
+    assert all(s["attrs"].get("overlapped") for s in holder_spans)

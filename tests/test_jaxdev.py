@@ -209,3 +209,56 @@ def test_probe_devices_cli_backend_flag_and_error_containment(
     out = json.loads(capsys.readouterr().out)
     assert out["backend"] == "jax"
     assert "runtime gone" in out["error"]
+
+
+def test_wait_ready_early_exits_on_generation_bump(jax_backend, monkeypatch):
+    """ISSUE 13 satellite: a teardown landing MID-WAIT bumps the
+    runtime generation; the backoff poll must fail fast (naming the
+    supersession) instead of busy-holding its whole deadline slice
+    probing a dead session."""
+    import threading
+    import time
+
+    chips, _ = jax_backend.find_tpus()
+    chip = chips[0]
+
+    def failing_probe(device_id):
+        raise RuntimeError("runtime not up (injected)")
+
+    monkeypatch.setattr(jax_backend, "probe_device", failing_probe)
+
+    def bump():
+        time.sleep(0.15)
+        with jax_backend._devices_lock:
+            jax_backend.runtime_gen += 1
+
+    t = threading.Thread(target=bump)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(DeviceError) as ei:
+        chip.wait_ready(timeout_s=30.0)
+    elapsed = time.monotonic() - t0
+    t.join()
+    # failed on the bump (~0.15s), nowhere near the 30s deadline
+    assert elapsed < 5.0, elapsed
+    assert "generation advanced" in str(ei.value)
+
+
+def test_wait_ready_still_times_out_without_a_bump(jax_backend, monkeypatch):
+    """Control: with the generation stable, the loop keeps its
+    historical timeout semantics."""
+    import time
+
+    chips, _ = jax_backend.find_tpus()
+    chip = chips[0]
+    monkeypatch.setattr(
+        jax_backend, "probe_device",
+        lambda device_id: (_ for _ in ()).throw(
+            RuntimeError("still down")
+        ),
+    )
+    t0 = time.monotonic()
+    with pytest.raises(DeviceError) as ei:
+        chip.wait_ready(timeout_s=0.4)
+    assert time.monotonic() - t0 >= 0.35
+    assert "not ready after" in str(ei.value)

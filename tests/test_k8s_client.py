@@ -1,0 +1,394 @@
+"""The asyncio kube I/O core (k8s/aio.py + aio_bridge.py, ISSUE 13):
+multiplexing, bounded connection budget, exactly-once replay under
+pipelining, and the sync façade — all over the real wire against
+FakeApiServer."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.k8s.aio import AsyncKubeClient
+from tpu_cc_manager.k8s.aio_bridge import SyncKubeFacade, get_bridge
+from tpu_cc_manager.k8s.apiserver import FakeApiServer
+from tpu_cc_manager.k8s.client import ApiException, ConflictError, KubeConfig
+from tpu_cc_manager.k8s.objects import make_node
+
+
+@pytest.fixture()
+def server():
+    with FakeApiServer() as s:
+        yield s
+
+
+def _facade(server, **kw):
+    return SyncKubeFacade(
+        KubeConfig("127.0.0.1", server.port, use_tls=False), **kw
+    )
+
+
+def _arm_kill_next_patch(server, n=1):
+    """Make the server abruptly close the connection (zero response
+    bytes, request body unread — the write never executes) for the
+    next ``n`` PATCH requests. This is the stale-keep-alive /
+    BadStatusLine shape from the server side."""
+    handler_cls = server.httpd.RequestHandlerClass
+    orig = handler_cls.do_PATCH
+    remaining = {"n": n}
+    lock = threading.Lock()
+
+    def do_PATCH(self):
+        with lock:
+            kill = remaining["n"] > 0
+            if kill:
+                remaining["n"] -= 1
+        if kill:
+            self.close_connection = True
+            self.connection.close()  # no status line, nothing executed
+            return
+        orig(self)
+
+    handler_cls.do_PATCH = do_PATCH
+    return remaining
+
+
+# --------------------------------------------------------------- basics
+
+
+def test_facade_node_roundtrip_and_errors(server):
+    server.store.add_node(make_node("n0", labels={"a": "1"}))
+    kube = _facade(server)
+    assert kube.get_node("n0")["metadata"]["labels"]["a"] == "1"
+    kube.set_node_labels("n0", {"a": "2", "b": None})
+    assert kube.get_node("n0")["metadata"]["labels"] == {"a": "2"}
+    n = kube.get_node("n0")
+    kube.replace_node("n0", n)
+    with pytest.raises(ConflictError):
+        kube.replace_node("n0", n)
+    with pytest.raises(ApiException) as ei:
+        kube.get_node("missing")
+    assert ei.value.status == 404
+    kube.close()
+
+
+def test_facade_watch_streams_and_clean_timeout(server):
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server)
+    rv = server.store.latest_rv
+    got = []
+
+    def run():
+        for _etype, obj in kube.watch_nodes(
+            name="n0", resource_version=rv, timeout_s=3
+        ):
+            got.append(obj["metadata"]["labels"].get("m"))
+            if len(got) == 2:
+                return
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)
+    server.store.set_node_labels("n0", {"m": "on"})
+    time.sleep(0.3)
+    server.store.set_node_labels("n0", {"m": "off"})
+    t.join(timeout=10)
+    assert got == ["on", "off"]
+    # clean server-side timeout = clean iterator end, and a 410 on a
+    # compacted resume surfaces as ApiException exactly like the
+    # threaded client
+    assert list(kube.watch_nodes(
+        name="n0", resource_version=server.store.latest_rv, timeout_s=1
+    )) == []
+    stale_rv = server.store.latest_rv
+    server.store.set_node_labels("n0", {"x": "1"})
+    server.store.compact_watch_history()
+    with pytest.raises(ApiException) as ei:
+        list(kube.watch_nodes(name="n0", resource_version=stale_rv,
+                              timeout_s=2))
+    assert ei.value.status == 410
+    kube.close()
+
+
+# ------------------------------------------------- pool exhaustion pin
+
+
+def test_writers_beyond_conn_budget_queue_not_error(server):
+    """Satellite pin (ISSUE 13): concurrent writers exceeding
+    TPU_CC_KUBE_CONNS must QUEUE on the per-connection window — every
+    write lands, none errors, and the socket count stays at the
+    budget (no unbounded dials)."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, max_conns=3, window=2)
+    errors = []
+
+    def writer(i):
+        try:
+            for j in range(6):
+                kube.patch_node(
+                    "n0", {"metadata": {"labels": {f"w{i}": str(j)}}}
+                )
+        except Exception as e:  # pragma: no cover - the failure surface
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    stats = kube.stats()
+    # 24 writers x 6 writes multiplexed over at most 3 sockets
+    assert stats["requests"] >= 144
+    assert stats["dials"] <= 3
+    labels = server.store.get_node("n0")["metadata"]["labels"]
+    assert all(labels[f"w{i}"] == "5" for i in range(24))
+    kube.close()
+
+
+# ------------------------------------------- exactly-once replay pins
+
+
+def test_stale_close_replays_merge_patch_exactly_once(server):
+    """The BadStatusLine-analog on the async core: a reused pipelined
+    connection the server closed with ZERO response bytes replays its
+    merge patch exactly once on a fresh dial — the write lands one
+    time, never twice."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, max_conns=1, window=2)
+    kube.get_node("n0")  # the conn has served: replay is legal
+    _arm_kill_next_patch(server, 1)
+    w0 = server.store.node_write_stats()
+    out = kube.patch_node("n0", {"metadata": {"labels": {"k": "v"}}})
+    assert out["metadata"]["labels"]["k"] == "v"
+    w1 = server.store.node_write_stats()
+    assert w1["requests"] - w0["requests"] == 1  # once, not twice
+    assert kube.stats()["replays"] == 1
+    kube.close()
+
+
+def test_replay_holds_when_racing_a_pool_mate(server):
+    """Satellite pin (ISSUE 13): the exactly-once replay must hold
+    when the replayed request RACED other in-flight requests on the
+    shared pool — every write still lands exactly once (the store's
+    request accounting equals the number of issued writes)."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, max_conns=2, window=2)
+    # warm both conns so any victim connection has served >= 1
+    for _ in range(8):
+        kube.get_node("n0")
+    _arm_kill_next_patch(server, 1)
+    w0 = server.store.node_write_stats()
+    errors = []
+    n_writers, n_each = 6, 4
+
+    def writer(i):
+        try:
+            for j in range(n_each):
+                kube.patch_node(
+                    "n0", {"metadata": {"labels": {f"r{i}": str(j)}}}
+                )
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    w1 = server.store.node_write_stats()
+    # EXACTLY one server-side round trip per issued write: the killed
+    # request replayed once, no pool-mate was double-applied, no
+    # write was lost
+    assert w1["requests"] - w0["requests"] == n_writers * n_each
+    assert kube.stats()["replays"] >= 1
+    labels = server.store.get_node("n0")["metadata"]["labels"]
+    assert all(labels[f"r{i}"] == str(n_each - 1)
+               for i in range(n_writers))
+    kube.close()
+
+
+def test_fresh_connection_failure_is_terminal_not_replayed(server):
+    """A connection that never served a response may have executed the
+    request server-side — the sync client's rule, preserved: terminal
+    ApiException(0), zero silent replays."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, max_conns=1, window=1)
+    _arm_kill_next_patch(server, 1)
+    w0 = server.store.node_write_stats()
+    with pytest.raises(ApiException) as ei:
+        kube.patch_node("n0", {"metadata": {"labels": {"k": "v"}}})
+    assert ei.value.status == 0
+    assert kube.stats()["replays"] == 0
+    assert server.store.node_write_stats()["requests"] == w0["requests"]
+    kube.close()
+
+
+# -------------------------------------------------- bridge primitives
+
+
+def test_bridge_submit_and_gather_run_blocking_work():
+    bridge = get_bridge()
+    seen = []
+
+    def side(tag):
+        time.sleep(0.05)
+        seen.append(tag)
+        return tag
+
+    futs = [bridge.submit(side, i) for i in range(4)]
+    assert sorted(bridge.gather(futs)) == [0, 1, 2, 3]
+    assert sorted(seen) == [0, 1, 2, 3]
+
+
+def test_bridge_gather_joins_all_before_raising():
+    """The fail-secure join: gather must not abandon siblings when one
+    fails — everything settles first, then the first exception
+    surfaces."""
+    bridge = get_bridge()
+    done = []
+
+    def ok():
+        time.sleep(0.15)
+        done.append("ok")
+        return "ok"
+
+    def boom():
+        raise RuntimeError("side failure")
+
+    futs = [bridge.submit(boom), bridge.submit(ok)]
+    with pytest.raises(RuntimeError):
+        bridge.gather(futs)
+    assert done == ["ok"]  # the sibling ran to completion first
+
+
+def test_facade_throttle_surface_matches_threaded_client(server):
+    """set_qps/throttle accounting parity: the simlab runner and fault
+    injector drive either I/O core through the same attributes."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, qps=10, burst=1)
+    waits = []
+    kube.add_throttle_observer(waits.append)
+    t0 = time.monotonic()
+    for _ in range(4):
+        kube.get_node("n0")
+    assert time.monotonic() - t0 >= 0.25
+    assert kube.throttle_waits >= 2
+    assert kube.throttle_wait_s_total > 0
+    assert len(waits) == 4  # observed on EVERY flow-controlled request
+    kube.set_qps(0)  # limiter off: burst through instantly
+    t0 = time.monotonic()
+    for _ in range(5):
+        kube.get_node("n0")
+    assert time.monotonic() - t0 < 0.5
+    kube.close()
+
+
+def test_async_client_rtt_observer_sees_writes(server):
+    server.store.add_node(make_node("n0"))
+    aio = AsyncKubeClient(
+        KubeConfig("127.0.0.1", server.port, use_tls=False)
+    )
+    samples = []
+    aio.add_rtt_observer(
+        lambda method, path, rtt: samples.append((method, rtt))
+    )
+    kube = SyncKubeFacade(
+        KubeConfig("127.0.0.1", server.port, use_tls=False), aio=aio
+    )
+    kube.patch_node("n0", {"metadata": {"labels": {"a": "1"}}})
+    kube.get_node("n0")
+    methods = [m for m, _ in samples]
+    assert methods == ["PATCH", "GET"]
+    assert all(rtt > 0 for _, rtt in samples)
+    kube.close()
+
+
+def _arm_slow_serve_then_close(server, delay_s=0.4):
+    """The next PATCH is served slowly, then the connection closes
+    cleanly WITHOUT reading pipelined followers — the follower gets
+    zero response bytes and was never executed server-side."""
+    handler_cls = server.httpd.RequestHandlerClass
+    orig = handler_cls.do_PATCH
+    armed = {"on": True}
+
+    def do_PATCH(self):
+        fire = armed["on"]
+        armed["on"] = False
+        if fire:
+            time.sleep(delay_s)
+            orig(self)
+            self.close_connection = True
+            return
+        orig(self)
+
+    handler_cls.do_PATCH = do_PATCH
+
+
+def _paired_pipeline(kube, server):
+    """Issue PATCH A then (0.15s later, while A is still being served
+    slowly) PATCH B — max_conns=1 forces B to pipeline behind A on the
+    same connection. Returns (result_a, result_b) where each is the
+    response dict or the raised ApiException."""
+    results = {}
+
+    def do(idx, label):
+        try:
+            results[idx] = kube.patch_node(
+                "n0", {"metadata": {"labels": {label: "1"}}}
+            )
+        except ApiException as e:
+            results[idx] = e
+
+    ta = threading.Thread(target=do, args=(0, "a"))
+    ta.start()
+    time.sleep(0.15)
+    tb = threading.Thread(target=do, args=(1, "b"))
+    tb.start()
+    ta.join(timeout=10)
+    tb.join(timeout=10)
+    return results[0], results[1]
+
+
+def test_pipelined_follower_on_never_served_conn_is_terminal(server):
+    """Replay legality is judged AT WRITE TIME: a request pipelined
+    onto a connection that had never served a response when its bytes
+    went out must NOT become replayable just because a sibling's
+    response arrived before the close — the server may have executed
+    it (code-review finding, pinned)."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, max_conns=1, window=2)
+    _arm_slow_serve_then_close(server)
+    w0 = server.store.node_write_stats()
+    res_a, res_b = _paired_pipeline(kube, server)
+    # A (the head) was served; B had zero response bytes on a conn
+    # that had served NOTHING when B was written -> terminal
+    assert isinstance(res_a, dict)
+    assert isinstance(res_b, ApiException) and res_b.status == 0
+    assert kube.stats()["replays"] == 0
+    assert (server.store.node_write_stats()["requests"]
+            - w0["requests"]) == 1  # only A executed
+    kube.close()
+
+
+def test_pipelined_follower_on_served_conn_replays_once(server):
+    """The legal twin: the conn HAD served (a prior GET) before the
+    follower was written, so the zero-bytes close is the stale
+    keep-alive shape — the follower replays exactly once and both
+    writes land exactly once."""
+    server.store.add_node(make_node("n0"))
+    kube = _facade(server, max_conns=1, window=2)
+    kube.get_node("n0")  # served >= 1 before either PATCH is written
+    _arm_slow_serve_then_close(server)
+    w0 = server.store.node_write_stats()
+    res_a, res_b = _paired_pipeline(kube, server)
+    assert isinstance(res_a, dict)
+    assert isinstance(res_b, dict)  # replayed, landed
+    assert kube.stats()["replays"] == 1
+    assert (server.store.node_write_stats()["requests"]
+            - w0["requests"]) == 2  # each write exactly once
+    labels = server.store.get_node("n0")["metadata"]["labels"]
+    assert labels["a"] == "1" and labels["b"] == "1"
+    kube.close()

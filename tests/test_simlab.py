@@ -549,3 +549,35 @@ def test_live_sharded_run_survives_shard_kill(tmp_path):
     assert fo is not None and fo >= failover["handoff_s"] - 0.05
     # every partition is covered by the surviving host
     assert all(h == "host-1" for h in stats["coverage"].values()), stats
+
+
+def test_shared_loop_mode_multiplexes_one_connection_pool(monkeypatch):
+    """ISSUE 13: TPU_CC_SIMLAB_SHARED_LOOP=1 rehosts the fleet's data
+    plane onto the async I/O core — the run converges, the artifact
+    records the aio core, and the dial count proves multiplexing
+    (a bounded connection budget, not per-replica sockets)."""
+    from tpu_cc_manager.simlab.runner import SimLab
+
+    monkeypatch.setenv("TPU_CC_SIMLAB_SHARED_LOOP", "1")
+    doc = _minimal(
+        name="shared-loop-16", nodes=16, pools=2, workers=4,
+        watch_timeout_s=2,
+        actions=[{"at": 0.1, "action": "set_mode", "mode": "on"}],
+        converge={"mode": "on", "timeout_s": 60},
+    )
+    art = SimLab(validate_scenario(doc)).run()
+    assert art["ok"], art.get("notes")
+    io = art["metrics"]["kube_io"]
+    assert io["core"] == "aio"
+    assert io["requests"] >= 32  # 16 replicas x >= 2 writes each
+    assert io["dials"] <= 8  # the connection budget, not 16 sockets
+    assert io["replays"] == 0
+    # the threaded default still reports itself honestly
+    monkeypatch.delenv("TPU_CC_SIMLAB_SHARED_LOOP")
+    art2 = SimLab(validate_scenario(_minimal(
+        name="threaded-8", nodes=8, workers=4, watch_timeout_s=2,
+        actions=[{"at": 0.1, "action": "set_mode", "mode": "on"}],
+        converge={"mode": "on", "timeout_s": 60},
+    ))).run()
+    assert art2["ok"]
+    assert art2["metrics"]["kube_io"] == {"core": "threaded"}

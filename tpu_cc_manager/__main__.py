@@ -48,7 +48,18 @@ log = logging.getLogger("tpu-cc-manager")
 
 
 def _kube_client(cfg):
-    return HttpKubeClient(KubeConfig.load(cfg.kubeconfig))
+    config = KubeConfig.load(cfg.kubeconfig)
+    if os.environ.get("TPU_CC_KUBE_AIO", "").lower() in ("1", "true",
+                                                         "yes"):
+        # the asyncio I/O core (ISSUE 13, docs/io.md §async core): all
+        # of this process's node reads/writes/watches multiplex one
+        # event loop's pipelined connection pool behind a sync façade.
+        # Opt-in: exec-credential (401 invalidate-and-retry) auth flows
+        # are not implemented there and must stay on HttpKubeClient.
+        from tpu_cc_manager.k8s.aio_bridge import SyncKubeFacade
+
+        return SyncKubeFacade(config)
+    return HttpKubeClient(config)
 
 
 def _leader_elector(kube, lease_name: str):

@@ -1749,3 +1749,103 @@ def shard_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
                 )
             )
     return findings
+
+
+# ------------------------------------------------------- blocking in async
+
+
+#: The event-loop modules (ISSUE 13): everything in these files that is
+#: an ``async def`` runs ON the process's one kube I/O loop — a single
+#: blocking call there stalls every multiplexed request, watch pump,
+#: and overlapped flip side-task in the process at once. The analyzer
+#: can't see the loop, but it can see the call shapes that block it.
+ASYNC_CORE_MODULES = frozenset({
+    "tpu_cc_manager/k8s/aio.py",
+    "tpu_cc_manager/k8s/aio_bridge.py",
+})
+
+#: Resolved-dotted-path prefixes that block the loop: the clock
+#: (``time.sleep`` — ``asyncio.sleep`` is the loop-safe spelling),
+#: synchronous sockets, and the synchronous HTTP client stack.
+_ASYNC_BLOCKING_PREFIXES = (
+    "time.sleep",
+    "socket.",
+    "http.client.",
+)
+
+
+def _async_blocking_hit(node: ast.Call,
+                        imports: Dict[str, str]) -> Optional[str]:
+    """The human-readable violation for a call inside an ``async def``
+    body, or None."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr == "result":
+        # concurrent.futures.Future.result() parks the loop thread on
+        # another thread's progress — the deadlock shape the bridge
+        # exists to prevent (asyncio.wrap_future/await is the fix)
+        return (".result() blocks the event loop on another thread — "
+                "await asyncio.wrap_future(...) instead")
+    resolved = resolve_dotted(func, imports)
+    if resolved is None:
+        return None
+    for prefix in _ASYNC_BLOCKING_PREFIXES:
+        if resolved == prefix or resolved.startswith(prefix):
+            return (f"{resolved} is a synchronous blocking call — on "
+                    "the kube I/O loop it stalls every multiplexed "
+                    "request in the process (use the asyncio "
+                    "equivalent, or run_in_executor for genuinely "
+                    "blocking work)")
+    return None
+
+
+def _walk_async_body(fn: ast.AsyncFunctionDef):
+    """Yield nodes lexically inside an ``async def``, NOT descending
+    into nested synchronous ``def``s (those run wherever they're
+    called — usually an executor — and must not be flagged as loop
+    code). Nested ``async def``s are separate roots in the caller's
+    iteration, so they're skipped here too to avoid double-visits."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def blocking_in_async_findings(modules: Sequence[Module]) -> List[Finding]:
+    """Flag blocking calls inside ``async def`` bodies in the async
+    kube core (``blocking-in-async``): ``time.sleep``, synchronous
+    ``socket``/``http.client`` calls, and ``.result()`` waits. A
+    deliberate exception carries
+    ``# ccaudit: allow-blocking-in-async(reason)``."""
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.relpath not in ASYNC_CORE_MODULES:
+            continue
+        imports = collect_imports(mod.tree)
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_async_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _async_blocking_hit(node, imports)
+                if hit is None:
+                    continue
+                if mod.suppressed("blocking-in-async", node.lineno):
+                    continue
+                findings.append(
+                    Finding(
+                        file=mod.relpath,
+                        line=node.lineno,
+                        rule="blocking-in-async",
+                        message=(
+                            f"inside async def {fn.name}: {hit}; a "
+                            "deliberate exception needs an "
+                            "allow-blocking-in-async pragma naming why"
+                        ),
+                        text=mod.line_text(node.lineno),
+                    )
+                )
+    return findings

@@ -43,6 +43,13 @@ flag                      env                            default
 (none)                    TPU_CC_KUBE_QPS[/_BURST]       0 = off (client-side API flow
                                                         control; controllers set 50 —
                                                         client-go QPS/Burst parity)
+(none)                    TPU_CC_KUBE_AIO                unset (1 = the async I/O core:
+                                                        one event loop multiplexing
+                                                        pipelined connections behind a
+                                                        sync facade — docs/io.md; not
+                                                        for exec-plugin auth)
+(none)                    TPU_CC_KUBE_INFLIGHT           4 (per-connection pipelined
+                                                        in-flight window, async core)
 (none)                    TPU_CC_FLEET_MIN_SCAN_GAP_S    5 (coalescing gap between
                                                         watch-triggered fleet scans)
 (none)                    TPU_CC_POLICY_MIN_SCAN_GAP_S   2 (coalescing gap after any

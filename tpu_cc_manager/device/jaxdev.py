@@ -158,8 +158,19 @@ class JaxTpuChip(TpuChip):
         deadline; device.base.backoff_intervals, the same policy as the
         sysfs backend): a runtime that reinitializes quickly is detected
         in milliseconds instead of paying the old half-second floor per
-        device."""
+        device.
+
+        Early exit on a runtime-generation bump (ISSUE 13 satellite):
+        a teardown landing MID-WAIT (a chip of a newer plan resetting,
+        an operator restart) invalidates the session these probes are
+        trying to reach — the old loop busy-held its whole deadline
+        slice retrying into the void. The backend already knows (the
+        gen counter moved), so the wait fails fast with a message that
+        names the supersession instead of masquerading as a boot
+        timeout; the engine's failure path retries against the live
+        generation."""
         last_err: Optional[Exception] = None
+        start_gen = self._backend.runtime_gen
         pauses = backoff_intervals(time.monotonic() + timeout_s)
         while True:
             try:
@@ -167,6 +178,13 @@ class JaxTpuChip(TpuChip):
                 return
             except Exception as e:  # PJRT raises RuntimeError subclasses
                 last_err = e
+                if self._backend.runtime_gen != start_gen:
+                    raise DeviceError(
+                        f"{self.path}: runtime generation advanced "
+                        f"({start_gen} -> {self._backend.runtime_gen}) "
+                        f"during wait_ready; probing a superseded "
+                        f"session is futile: {e}"
+                    ) from e
                 pause = next(pauses, None)
                 if pause is None:
                     break

@@ -41,7 +41,9 @@ from tpu_cc_manager.flipexec import (
     FlipOutcome,
     flip_concurrency as resolve_flip_concurrency,
     flip_concurrency_knob,
+    join_overlapped,
     run_flips,
+    submit_overlapped,
 )
 from tpu_cc_manager.modes import CC_MODES, Mode, STATE_FAILED, parse_mode
 from tpu_cc_manager.trace import Tracer, get_tracer
@@ -567,26 +569,57 @@ class ModeEngine:
             # workload that could open the node observably cannot
             if not dev.is_ici_switch():
                 self._gate.lock_for_flip(dev.path)
-            # sub-phase spans: the flip's wall clock decomposes
-            # into stage/reset/wait_ready/verify so a hardware
-            # regression names its phase (the r05 real-chip
-            # 1.87->4.43s jump arrived opaque because this
-            # span was one block)
-            with self._tracer.span("stage", device=dev.path):
-                dev.discard_staged()
-                for domain, target in changes.items():
-                    if domain == "cc":
-                        dev.set_cc_mode(target)
-                    else:
-                        dev.set_ici_mode(target)
             # exclusive-hold guarantee (the reference's driver
             # unbind makes this impossible by construction,
             # scripts/cc-manager.sh:40-50): the gate above stops
             # NEW opens, this stops committing under fds that
             # were already open — running the configured runtime
-            # restart hook if needed
-            with self._tracer.span("holder_check", device=dev.path):
-                self._holder_check.ensure_free(dev.path)
+            # restart hook if needed. OVERLAPPED with the stage
+            # below (ISSUE 13): the holder scan reads /proc, the
+            # stage writes the per-device statefile — disjoint
+            # resources, so the scan's wall clock hides behind the
+            # stage's. Ordering pinned unchanged: the gate lock
+            # above precedes both, and reset only runs after BOTH
+            # landed (the join below) — a stage failure while the
+            # scan is in flight still joins it, then fails the
+            # device with the gate locked and the chip un-reset.
+            holder_fut = None
+            if self._holder_check.enabled:
+                holder_fut = submit_overlapped(
+                    lambda: self._holder_check.ensure_free(dev.path)
+                )
+            # sub-phase spans: the flip's wall clock decomposes
+            # into stage/reset/wait_ready/verify so a hardware
+            # regression names its phase (the r05 real-chip
+            # 1.87->4.43s jump arrived opaque because this
+            # span was one block)
+            try:
+                with self._tracer.span("stage", device=dev.path):
+                    dev.discard_staged()
+                    for domain, target in changes.items():
+                        if domain == "cc":
+                            dev.set_cc_mode(target)
+                        else:
+                            dev.set_ici_mode(target)
+            except BaseException:
+                # fail-secure under overlap: the scan must not be
+                # abandoned (its restart hook may be mid-flight),
+                # but the stage's error owns this device's outcome
+                if holder_fut is not None:
+                    join_overlapped(holder_fut, swallow=True)
+                raise
+            # holder_check keeps its historical span position (serial
+            # trace order is byte-identical); with the overlap on, the
+            # span measures the RESIDUAL wait after the stage, and the
+            # attr says so
+            with self._tracer.span(
+                "holder_check", device=dev.path
+            ) as holder_span:
+                if holder_fut is not None:
+                    holder_span.attrs["overlapped"] = True
+                    join_overlapped(holder_fut)
+                else:
+                    self._holder_check.ensure_free(dev.path)
             with self._tracer.span("reset", device=dev.path):
                 dev.reset()
             with self._tracer.span("wait_ready", device=dev.path):

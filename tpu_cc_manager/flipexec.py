@@ -45,7 +45,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from contextlib import ExitStack
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence, TypeVar
@@ -56,6 +56,7 @@ from tpu_cc_manager.trace import Tracer
 log = logging.getLogger("tpu-cc-manager.flipexec")
 
 T = TypeVar("T")
+S = TypeVar("S")
 
 #: Environment knob; ``1`` restores the serial per-device loop exactly.
 ENV_KNOB = "TPU_CC_FLIP_CONCURRENCY"
@@ -147,6 +148,34 @@ def _reraise_unexpected(outcomes: Sequence[FlipOutcome]) -> None:
     for o in outcomes:
         if o.exception is not None and not isinstance(o.exception, DeviceError):
             raise o.exception
+
+
+def submit_overlapped(side: Callable[[], S]) -> "Future[S]":
+    """Start ``side`` on the shared aio-bridge executor (ISSUE 13: the
+    flip path hides synchronous waits behind the same loop thread the
+    async kube core runs on). The caller MUST join via
+    :func:`join_overlapped` on every path — an abandoned side task
+    could outlive the flip whose ordering protected it."""
+    from tpu_cc_manager.k8s.aio_bridge import get_bridge
+
+    return get_bridge().submit(side)
+
+
+def join_overlapped(fut: "Future[S]", *, swallow: bool = False) -> Optional[S]:
+    """Join a :func:`submit_overlapped` side task. ``swallow=True`` is
+    the fail-secure path: the primary phase already failed and owns
+    the error surface, so the side's own failure is logged (never
+    silently lost) but not raised over the primary's."""
+    if not swallow:
+        return fut.result()
+    try:
+        return fut.result()
+    except Exception:
+        log.warning(
+            "overlapped side task failed under a primary-phase "
+            "failure; primary error wins", exc_info=True,
+        )
+        return None
 
 
 def run_flips(

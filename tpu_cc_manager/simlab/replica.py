@@ -15,6 +15,17 @@ Failure semantics mirror the real agent (agent.py reconcile): invalid
 modes reject cleanly with a ``failed`` state label; retryable failures
 re-enter the queue after a short delay (the self-repair analog) so a
 replica that lost a state-label write to a 429 storm still converges.
+
+Shared-loop mode (ISSUE 13, ``TPU_CC_SIMLAB_SHARED_LOOP=1``): the
+``kube`` every shell publishes through may be ONE
+:class:`~tpu_cc_manager.k8s.aio_bridge.SyncKubeFacade` — the whole
+fleet's writes then multiplex a single event loop's pipelined
+connection pool (k8s/aio.py) instead of checking thread-private
+sockets out of the threaded client. The shell is agnostic by design:
+both clients speak the same ``KubeClient``/throttle surface, so the
+runner swaps the transport without a scenario byte changing
+(docs/io.md §"The async core"; the artifact's ``metrics.kube_io``
+records which core served the run).
 """
 
 from __future__ import annotations

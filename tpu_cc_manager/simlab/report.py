@@ -55,6 +55,7 @@ def build_artifact(
     slo: Optional[dict] = None,
     shards: Optional[dict] = None,
     lifecycle: Optional[dict] = None,
+    kube_io: Optional[dict] = None,
     notes: Optional[str] = None,
 ) -> dict:
     metrics = {
@@ -94,6 +95,12 @@ def build_artifact(
         # the propgen invariants oracle judged, preserved for a
         # regression reader
         metrics["lifecycle"] = lifecycle
+    if kube_io is not None:
+        # which I/O core served the data plane (ISSUE 13): "aio" in
+        # shared-loop mode, with the async client's dials/requests/
+        # replays accounting — dials << requests is the multiplexing
+        # the mode exists to prove
+        metrics["kube_io"] = kube_io
     if slo is not None:
         # the fleet observatory's verdict (fleetobs.py, ISSUE 9):
         # per-objective burn rates + budget remaining, the alert log,

@@ -164,6 +164,14 @@ class SimLab:
         # cores than the scenario assumed"
         self.workers = _env_int("TPU_CC_SIMLAB_WORKERS",
                                 scenario.workers)
+        #: shared-loop replica I/O (ISSUE 13): when set, the fleet's
+        #: data-plane client is a SyncKubeFacade over ONE AsyncKubeClient
+        #: event loop instead of the threaded HttpKubeClient — env-keyed
+        #: (not scenario schema) so ANY committed scenario can run in
+        #: either I/O mode without a byte changing in scenarios/*.json
+        self.shared_loop = os.environ.get(
+            "TPU_CC_SIMLAB_SHARED_LOOP", ""
+        ).lower() in ("1", "true", "yes")
         self.server: Optional[FakeApiServer] = None
         self.node_names: List[str] = []
         self.replicas: Dict[str, ReplicaShell] = {}
@@ -501,7 +509,26 @@ class SimLab:
         notes = None
         faults: List[dict] = []
         try:
-            self.data_kube = self._client(qps=sc.qps)
+            if self.shared_loop:
+                # opt-in shared-loop mode (ISSUE 13,
+                # TPU_CC_SIMLAB_SHARED_LOOP): every replica's
+                # publish/state writes multiplex ONE event loop's
+                # pipelined connection pool (k8s/aio.py) through a
+                # sync façade, instead of checking thread-private
+                # sockets out of the threaded client's pool — the
+                # 1,024-replica fleet exercises the same I/O core the
+                # agent opts into with TPU_CC_KUBE_AIO. Same throttle
+                # surface, so faults' set_qps squeezes and the
+                # artifact's throttle block work unchanged.
+                from tpu_cc_manager.k8s.aio_bridge import SyncKubeFacade
+
+                self.data_kube = SyncKubeFacade(
+                    KubeConfig("127.0.0.1", self.server.port,
+                               use_tls=False),
+                    qps=sc.qps,
+                )
+            else:
+                self.data_kube = self._client(qps=sc.qps)
             self.data_kube.add_throttle_observer(self._observe_throttle)
             self.ops_kube = self._client(qps=0)
             self._build_fleet()
@@ -738,6 +765,12 @@ class SimLab:
             "wait_max_s": round(max(waits), 5) if waits else None,
             "histogram": self.throttle_hist.snapshot(),
         }
+        # which I/O core served the fleet's data plane — with the
+        # async core's own accounting (dials vs requests is the
+        # multiplexing win; replays prove the exactly-once path)
+        kube_io = {"core": "aio" if self.shared_loop else "threaded"}
+        if self.shared_loop:
+            kube_io.update(self.data_kube.stats())
         controllers = {"running": len(self._controllers)}
         for c in self._controllers:
             report = getattr(c, "last_report", None) or {}
@@ -865,11 +898,20 @@ class SimLab:
             slo=slo,
             shards=shards,
             lifecycle=lifecycle,
+            kube_io=kube_io,
             notes=notes,
         )
 
     def _teardown(self) -> None:
         get_tracer().remove_sink(self._ctrl_sink)
+        if self.shared_loop and getattr(self, "data_kube", None) is not None:
+            # reclaim the shared loop's pooled connections (and their
+            # reader tasks) — the bridge loop itself outlives the run
+            try:
+                self.data_kube.close()
+            except Exception:
+                log.warning("shared-loop client close failed",
+                            exc_info=True)
         if self.observer is not None:
             self.observer.stop()
         if self.injector is not None:
