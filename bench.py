@@ -1118,6 +1118,189 @@ def run_lifecycle_bench():
     }
 
 
+def run_rollout_bench(n_groups=12, agent_delay_s=0.03, poll_s=0.5):
+    """Reactive rollout economics (ISSUE 14): an ``n_groups``-group
+    serial rollout over FakeKube, judged off a NodeInformer delta
+    stream with watch-fed fake agents — the judge performs ZERO node
+    read round trips in steady state (``judge_node_reads`` pins it)
+    and the next group's desired writes launch from the terminal wake.
+    ``rollout_advance_p50_s`` (group terminal -> next group's first
+    desired write) joins the gated axes; the same rollout run WITHOUT
+    the feed gives the interval-judged baseline so the step-down is
+    visible in one round's extras."""
+    from tpu_cc_manager.k8s.fake import FakeKube
+    from tpu_cc_manager.rollout import Rollout
+    from tpu_cc_manager.watch import NodeInformer
+
+    def _pool():
+        kube = FakeKube()
+        for i in range(n_groups):
+            kube.add_node(make_node(
+                f"rb{i}",
+                labels={
+                    L.TPU_ACCELERATOR_LABEL: "tpu-v5e-slice",
+                    L.CC_MODE_LABEL: Mode.OFF.value,
+                    L.CC_MODE_STATE_LABEL: Mode.OFF.value,
+                },
+            ))
+        return kube
+
+    class _FeedAgents:
+        """Agents riding the same informer stream as the judge: the
+        whole steady state is watch events, no reads at all."""
+
+        def __init__(self, kube, informer):
+            self.kube = kube
+            self.timers = []
+            self.token = informer.subscribe(on_event=self._on_event)
+            self.informer = informer
+
+        def _on_event(self, etype, node):
+            if etype == "DELETED":
+                return
+            meta = node.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            desired = labels.get(L.CC_MODE_LABEL)
+            if not desired or labels.get(L.CC_MODE_STATE_LABEL) == desired:
+                return
+            name = meta.get("name")
+            t = threading.Timer(
+                agent_delay_s,
+                lambda: self.kube.set_node_labels(
+                    name, {L.CC_MODE_STATE_LABEL: desired}
+                ),
+            )
+            t.daemon = True
+            t.start()
+            self.timers.append(t)
+
+        def close(self):
+            self.informer.unsubscribe(self.token)
+            for t in self.timers:
+                t.cancel()
+
+    class _PollAgents(threading.Thread):
+        """Interval-judged baseline's agents: peek-poll the desired
+        label (peek is store-direct, not a counted read)."""
+
+        def __init__(self, kube, names):
+            super().__init__(daemon=True)
+            self.kube = kube
+            self.names = names
+            self.stop = threading.Event()
+
+        def run(self):
+            while not self.stop.is_set():
+                for n in self.names:
+                    desired = self.kube.peek_node_label(n, L.CC_MODE_LABEL)
+                    state = self.kube.peek_node_label(
+                        n, L.CC_MODE_STATE_LABEL)
+                    if desired and state != desired:
+                        time.sleep(agent_delay_s)
+                        self.kube.set_node_labels(
+                            n, {L.CC_MODE_STATE_LABEL: desired}
+                        )
+                time.sleep(0.005)
+
+    def _instrument(kube):
+        """Measure the advance OUTSIDE the rollout: the truth time a
+        group became terminal is its last state-label WRITE landing in
+        the store, the advance is that -> the NEXT group's first
+        desired-label patch. The judge's noticing lag (up to a full
+        poll tick for the interval judge) is inside the measured span
+        — exactly the latency the delta-fed judge removes."""
+        truth_times = {}
+        launches = []
+        orig_set = kube.set_node_labels
+        orig_patch = kube.patch_node
+
+        def rec_set(name, labels):
+            out = orig_set(name, labels)
+            if L.CC_MODE_STATE_LABEL in labels:
+                truth_times[name] = time.monotonic()
+            return out
+
+        def rec_patch(name, patch):
+            if L.CC_MODE_LABEL in (
+                    (patch.get("metadata") or {}).get("labels") or {}):
+                launches.append((name, time.monotonic()))
+            return orig_patch(name, patch)
+
+        kube.set_node_labels = rec_set
+        kube.patch_node = rec_patch
+        return truth_times, launches
+
+    def _advances(truth_times, launches):
+        """launch[i+1] - truth(launch[i].node): serial singleton
+        groups, so each launch's predecessor group is the previously
+        launched node."""
+        out = []
+        for (prev_node, _), (_, t_next) in zip(launches, launches[1:]):
+            t_truth = truth_times.get(prev_node)
+            if t_truth is not None:
+                out.append(max(t_next - t_truth, 0.0))
+        return sorted(out)
+
+    def _run(informer_on):
+        kube = _pool()
+        truth_times, launches = _instrument(kube)
+        informer = agents = None
+        if informer_on:
+            informer = NodeInformer(kube, name="bench-rollout")
+            informer.prime()
+            informer.start()
+            agents = _FeedAgents(kube, informer)
+        else:
+            agents = _PollAgents(
+                kube, [f"rb{i}" for i in range(n_groups)])
+            agents.start()
+        roll = Rollout(kube, Mode.ON.value, max_unavailable=1,
+                       poll_s=poll_s, group_timeout_s=60,
+                       informer=informer)
+        t0 = time.monotonic()
+        report = roll.run()
+        total = time.monotonic() - t0
+        if informer_on:
+            agents.close()
+            informer.stop()
+        else:
+            agents.stop.set()
+        if not report.ok:
+            print("FATAL: rollout bench did not converge "
+                  f"(informer={informer_on})", file=sys.stderr)
+            sys.exit(1)
+        adv = _advances(truth_times, launches)
+        if len(adv) < n_groups - 1:
+            print("FATAL: rollout bench lost advance samples "
+                  f"({len(adv)}/{n_groups - 1})", file=sys.stderr)
+            sys.exit(1)
+        return roll, adv, total
+
+    roll, adv, reactive_total = _run(informer_on=True)
+    roll2, adv2, interval_total = _run(informer_on=False)
+    return {
+        "rollout_advance_p50_s": round(statistics.median(adv), 5),
+        "rollout_reactive": {
+            "groups": n_groups,
+            "poll_s": poll_s,
+            "agent_delay_s": agent_delay_s,
+            # the zero-read pin CI asserts: steady-state judging off
+            # the delta stream paid no LIST round trips
+            "judge_node_reads": roll.stats["judge_node_reads"],
+            "judge_ticks": roll.stats["judge_ticks"],
+            "delta_judges": roll.stats["delta_judges"],
+            "advance_p95_s": round(adv[int(0.95 * len(adv))], 5),
+            "rollout_total_s": round(reactive_total, 4),
+            # the same rollout judged on the poll interval: what every
+            # round before r14 paid per window advance — the axis's
+            # step-down denominator, re-measured every round
+            "interval_advance_p50_s": round(statistics.median(adv2), 5),
+            "interval_judge_node_reads": roll2.stats["judge_node_reads"],
+            "interval_rollout_total_s": round(interval_total, 4),
+        },
+    }
+
+
 def bench_dep_versions():
     """The benched jax/jaxlib/libtpu/numpy versions, stamped into the
     bench output (ISSUE 6 satellite / ROADMAP item 1): the r02-r05
@@ -1226,6 +1409,10 @@ def main():
         # lifecycle scenario runs through the invariants oracle and
         # lifecycle_convergence_s joins the gated axes
         result["extras"].update(run_lifecycle_bench())
+        # reactive rollout (ISSUE 14): watch-driven group judging with
+        # pipelined window advancement — rollout_advance_p50_s joins
+        # the gated axes and the judge's steady-state node reads pin 0
+        result["extras"].update(run_rollout_bench())
     print(json.dumps(result))
 
 

@@ -44,6 +44,7 @@ AXIS_SOURCES = {
     "fleet_scan_warm_s": ("scale256",),
     "planner_tick_100k_s": (),
     "flip_write_rtt_p50_s": ("kube_io", "phase_p50_s"),
+    "rollout_advance_p50_s": ("rollout_reactive",),
     "p50": ("phase_p50_s",),
 }
 

@@ -87,6 +87,14 @@ GATED_EXTRA_AXES = {
     "shard_failover_convergence_s": "lower",
     "lifecycle_convergence_s": "lower",
     "flip_write_rtt_p50_s": "lower",
+    # joined in r14 (the reactive-rollout round, ISSUE 14): group
+    # terminal -> the NEXT group's first desired write, measured
+    # store-side around the event-driven rollout judge. This is the
+    # axis that regresses if the judge quietly falls back to interval
+    # clocking (it would jump from ~ms to ~poll_s/2); the interval
+    # baseline is re-measured every round in
+    # extras.rollout_reactive.interval_advance_p50_s.
+    "rollout_advance_p50_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are
@@ -123,6 +131,11 @@ LATENCY_CEILINGS = {
     # window (measured 0.027-0.034 s on the 2-core sandbox; the
     # ceiling allows a loaded CI host, not a re-serialized pipeline)
     "flip_write_rtt_p50_s": 0.25,
+    # the event-driven judge advances the window in ~1 ms (measured
+    # 0.0006 s sandbox); the interval judge it replaced paid ~poll/2
+    # (~0.47 s at the bench's 0.5 s poll). 0.2 allows a loaded CI
+    # host while still failing ANY fallback to interval clocking.
+    "rollout_advance_p50_s": 0.2,
 }
 #: relative bars WITHIN the newest round (ISSUE 11 acceptance):
 #: numerator axis must stay <= factor x denominator axis. Skipped when

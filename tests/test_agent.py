@@ -637,3 +637,24 @@ def test_failed_flip_publishes_failed_state_not_half_applied(tmp_path):
     assert agent._evidence_wanted_gen == 1
     assert agent._evidence_published_gen == 1
     assert not agent.batcher.has_pending()
+
+
+def test_prime_backoff_cut_by_shutdown_does_not_apply_default(tmp_path):
+    """ISSUE 14 satellite regression pin: the startup prime backoff is
+    now an event wait on the stop event — a shutdown arriving during
+    it must NOT read as 'node has no label' and reconcile the default
+    mode on the way out."""
+    kube = FakeKube()  # node n1 absent: every prime read 404s
+    agent = _agent(kube, tmp_path)
+    agent.watcher.backoff_s = 5.0  # the wait the stop must cut short
+    agent._stop.set()
+    t0 = time.monotonic()
+    assert agent._prime_with_retry() is None
+    assert time.monotonic() - t0 < 2.0, "stop did not cut the backoff"
+    # and run()'s guard: a stopping agent never runs the initial
+    # reconcile (which would drain + flip toward the default mode)
+    calls = []
+    agent._reconcile_current = lambda mode: calls.append(mode) or True
+    rc = agent.run()
+    assert calls == [], "shutting-down agent reconciled the default"
+    assert rc == 0

@@ -151,3 +151,72 @@ def test_node_drainer_pdb_timeout_warns_and_continues():
     d = NodeDrainer(kube, "n1", timeout_s=0.2, poll_s=0.05)
     d.evict()  # returns despite the PDB never unblocking
     assert len(kube.list_pods("default")) == 1
+
+
+def test_drain_wait_wakes_on_watch_event():
+    """ISSUE 14's wake treatment: with a wake event wired, the pod-wait
+    re-checks the moment the event pulses (the agent fires it from its
+    node-watch delta thread) instead of sleeping out a full poll — here
+    poll_s is 5s and the drain still finishes in well under one tick."""
+    kube = FakeKube()
+    _node_with_components(kube, components=(DP,))
+    kube.add_pod(
+        make_pod("dp-pod", "tpu-system",
+                 labels={"app": L.COMPONENT_APP_LABELS[DP]}, node_name="n1")
+    )
+    wake = threading.Event()
+    d = ComponentDrainer(kube, "n1", timeout_s=20, poll_s=5.0, wake=wake)
+
+    def delete_and_pulse():
+        time.sleep(0.2)
+        kube.delete_pod("tpu-system", "dp-pod")
+        wake.set()  # the watch delta the agent would deliver
+
+    t = threading.Thread(target=delete_and_pulse)
+    t.start()
+    start = time.monotonic()
+    d.evict()
+    t.join()
+    elapsed = time.monotonic() - start
+    assert 0.15 <= elapsed < 2.0, (
+        f"drain took {elapsed:.2f}s — the wake did not cut the 5s poll"
+    )
+
+
+def test_drain_wait_without_wake_keeps_interval_poll():
+    """A bare drainer (no wake source) keeps the historical poll: the
+    liveness fallback still converges the wait, one poll tick late."""
+    kube = FakeKube()
+    _node_with_components(kube, components=(DP,))
+    kube.add_pod(
+        make_pod("dp-pod", "tpu-system",
+                 labels={"app": L.COMPONENT_APP_LABELS[DP]}, node_name="n1")
+    )
+    d = ComponentDrainer(kube, "n1", timeout_s=5, poll_s=0.05)
+
+    def delete_later():
+        time.sleep(0.2)
+        kube.delete_pod("tpu-system", "dp-pod")
+
+    t = threading.Thread(target=delete_later)
+    t.start()
+    start = time.monotonic()
+    d.evict()
+    t.join()
+    assert 0.2 <= time.monotonic() - start < 5
+
+
+def test_build_drainer_threads_wake_through():
+    from tpu_cc_manager.drain import NodeDrainer, build_drainer
+
+    class Cfg:
+        node_name = "n1"
+        operator_namespace = "tpu-system"
+        drain_strategy = "node"
+
+    wake = threading.Event()
+    d = build_drainer(FakeKube(), Cfg(), wake=wake)
+    assert isinstance(d, NodeDrainer) and d.wake is wake
+    Cfg.drain_strategy = "components"
+    d = build_drainer(FakeKube(), Cfg(), wake=wake)
+    assert isinstance(d, ComponentDrainer) and d.wake is wake

@@ -2167,3 +2167,46 @@ def test_overlapping_unfinished_records_are_never_adopted():
         rec = json.loads(kube.get_node(node)["metadata"][
             "annotations"][L.ROLLOUT_ANNOTATION])
         assert rec["id"] == rid and rec["complete"] is False
+
+
+def test_controller_feeds_its_informer_to_rollouts_and_adoptions(
+        monkeypatch):
+    """ISSUE 14 wiring pin: the controller's shared informer reaches
+    every Rollout it constructs — fresh launches AND adoptions — so
+    policy-driven rollouts judge off the delta stream, not interval
+    LISTs."""
+    import tpu_cc_manager.policy as policy_mod
+    from tpu_cc_manager.rollout import Rollout
+    from tpu_cc_manager.watch import NodeInformer
+
+    captured = []
+
+    class _SpyRollout(Rollout):
+        def __init__(self, *a, **kw):
+            captured.append(("fresh", kw.get("informer")))
+            super().__init__(*a, **kw)
+
+        @classmethod
+        def resume(cls, *a, **kw):
+            captured.append(("resume", kw.get("informer")))
+            return Rollout.resume(*a, **kw)
+
+    monkeypatch.setattr(policy_mod, "Rollout", _SpyRollout)
+    kube = FakeKube()
+    kube.add_node(_node("n0", desired="off", state="off"))
+    kube.add_custom(G, P, make_policy("p"))
+    informer = NodeInformer(kube, name="test-policy")
+    informer.prime()
+    informer.start()
+    agents = _ReactiveAgents(kube, ["n0"])
+    agents.start()
+    ctrl = PolicyController(kube, interval_s=30, port=0, poll_s=0.02,
+                            verify_evidence=False, informer=informer)
+    try:
+        report = ctrl.scan_once()
+    finally:
+        agents.stop.set()
+        informer.stop()
+        ctrl.stop()
+    assert report["policies"]["p"]["phase"] == "Converged"
+    assert ("fresh", informer) in captured

@@ -523,17 +523,36 @@ class SimulatedCrash(Exception):
     pass
 
 
+class _CrashableWake:
+    """Wraps a Rollout's ``_wake`` event so the driving loop's wait —
+    the successor of its old poll-sleep — raises SimulatedCrash once
+    armed. Only the rollout's own driver thread crashes; judge threads
+    delegating set()/clear() are untouched."""
+
+    def __init__(self, inner, crash, thread_box):
+        self._inner = inner
+        self._crash = crash
+        self._thread_box = thread_box
+
+    def wait(self, timeout=None):
+        if (self._crash.is_set()
+                and threading.current_thread() is self._thread_box.get("t")):
+            raise SimulatedCrash()
+        return self._inner.wait(timeout)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def _crash_rollout_at(kube, monkeypatch, rollout, record_ready):
     """Run `rollout` in a thread and kill it (SimulatedCrash raised from
-    its own poll-sleep) once `record_ready(record)` is true. Returns the
-    record at crash time."""
-    import tpu_cc_manager.rollout as rollout_mod
+    its own wake wait — the poll-sleep's successor) once
+    `record_ready(record)` is true. Returns the record at crash time."""
     from tpu_cc_manager.rollout import load_rollout_record
 
     crash = threading.Event()
     died = threading.Event()
-    orig_sleep = time.sleep
-    box = {}
+    thread_box = {}
 
     def target():
         try:
@@ -542,25 +561,19 @@ def _crash_rollout_at(kube, monkeypatch, rollout, record_ready):
             died.set()
 
     t = threading.Thread(target=target, daemon=True)
-
-    def crashing_sleep(s):
-        if crash.is_set() and threading.current_thread() is t:
-            raise SimulatedCrash()
-        orig_sleep(s)
-
-    monkeypatch.setattr(rollout_mod.time, "sleep", crashing_sleep)
+    thread_box["t"] = t
+    rollout._wake = _CrashableWake(rollout._wake, crash, thread_box)
     t.start()
     deadline = time.monotonic() + 20
     while time.monotonic() < deadline:
         rec, _ = load_rollout_record(kube, kube.list_nodes(None))
         if rec is not None and record_ready(rec):
             break
-        orig_sleep(0.02)
+        time.sleep(0.02)
     else:
         raise AssertionError("crash precondition never reached")
     crash.set()
     assert died.wait(10), "rollout thread did not crash"
-    monkeypatch.setattr(rollout_mod.time, "sleep", orig_sleep)
     rec, _ = load_rollout_record(kube, kube.list_nodes(None))
     return rec
 
@@ -1327,3 +1340,264 @@ def test_launch_stamps_trace_context_in_the_same_write():
     # the annotation landed on the node object itself
     ann = kube.get_node("s1")["metadata"]["annotations"]
     assert ann[L.CC_TRACE_ANNOTATION] in contexts
+
+
+# ------------------------------------------------- event-driven judge (r14)
+
+
+class _InformerAgents:
+    """Watch-fed fake agents: converge state labels off the SAME
+    informer delta stream the judge rides, paying ZERO node read round
+    trips — so a test's read-count pin isolates the judge's reads."""
+
+    def __init__(self, kube, informer, delay_s=0.03, fail_nodes=()):
+        self.kube = kube
+        self.delay_s = delay_s
+        self.fail_nodes = set(fail_nodes)
+        self._timers = []
+        self.token = informer.subscribe(on_event=self._on_event)
+        self.informer = informer
+
+    def _on_event(self, etype, node):
+        if etype == "DELETED":
+            return
+        meta = node.get("metadata") or {}
+        name = meta.get("name")
+        labels = meta.get("labels") or {}
+        desired = labels.get(L.CC_MODE_LABEL)
+        state = labels.get(L.CC_MODE_STATE_LABEL)
+        if not desired or state == desired or state == "failed":
+            return
+        value = "failed" if name in self.fail_nodes else desired
+
+        t = threading.Timer(
+            self.delay_s,
+            lambda: self.kube.set_node_labels(
+                name, {L.CC_MODE_STATE_LABEL: value}
+            ),
+        )
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    def close(self):
+        self.informer.unsubscribe(self.token)
+        for t in self._timers:
+            t.cancel()
+
+
+def _informer_for(kube):
+    from tpu_cc_manager.watch import NodeInformer
+
+    inf = NodeInformer(kube, name="test-rollout")
+    inf.prime()
+    inf.start()
+    return inf
+
+
+def test_event_driven_judge_zero_steady_state_node_reads():
+    """ISSUE 14 acceptance: with a healthy informer feed, steady-state
+    group judging performs ZERO node read round trips — pinned against
+    FakeKube's node_read_requests over a judging window where nothing
+    terminal happens (the test_shard.py pattern)."""
+    kube = FakeKube()
+    names = [f"n{i}" for i in range(3)]
+    _pool(kube, *[_node(n, desired="off", state="off") for n in names])
+    informer = _informer_for(kube)
+    # slow agents: the first group stays in flight long enough to
+    # observe a pure judging window with several fallback ticks
+    agents = _InformerAgents(kube, informer, delay_s=0.9)
+    roll = Rollout(kube, "on", max_unavailable=1, poll_s=0.02,
+                   group_timeout_s=30, informer=informer)
+    box = {}
+
+    def target():
+        box["report"] = roll.run()
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not roll._in_flight:
+            time.sleep(0.005)
+        assert roll._in_flight, "first group never launched"
+        reads_before = kube.node_read_requests
+        ticks_before = roll.stats["judge_ticks"]
+        time.sleep(0.4)  # many poll-cadence judge ticks, no transitions
+        assert kube.node_read_requests == reads_before, (
+            "steady-state judging must not read nodes"
+        )
+        assert roll.stats["judge_ticks"] > ticks_before + 3, (
+            "the liveness fallback tick must keep running"
+        )
+        t.join(timeout=20)
+        assert not t.is_alive()
+    finally:
+        agents.close()
+        informer.stop()
+    report = box["report"]
+    assert report.ok
+    assert roll.stats["judge_node_reads"] == 0
+    assert roll.stats["delta_judges"] > 0
+
+
+def test_pipelined_window_advance_beats_the_poll_clock():
+    """The moment a group settles, the next group's desired writes
+    launch from the wake path: four serial groups with 30ms agents
+    complete well inside ONE 5s poll interval, and every recorded
+    advance latency sits far under poll_s."""
+    kube = FakeKube()
+    names = [f"n{i}" for i in range(4)]
+    _pool(kube, *[_node(n, desired="off", state="off") for n in names])
+    informer = _informer_for(kube)
+    agents = _InformerAgents(kube, informer, delay_s=0.03)
+    t0 = time.monotonic()
+    roll = Rollout(kube, "on", max_unavailable=1, poll_s=5.0,
+                   group_timeout_s=30, informer=informer)
+    try:
+        report = roll.run()
+    finally:
+        agents.close()
+        informer.stop()
+    elapsed = time.monotonic() - t0
+    assert report.ok
+    assert elapsed < 5.0, (
+        f"4 serial groups took {elapsed:.2f}s — window advancement is "
+        "waiting out the poll tick"
+    )
+    adv = list(roll.stats["advance_latencies_s"])
+    assert len(adv) == 3
+    assert max(adv) < 1.0
+
+
+def test_watch_drop_mid_rollout_falls_back_to_interval_judging():
+    """Degradation contract: a watch drop the informer cannot heal
+    mid-rollout flips the judge back to its own interval LISTs — the
+    rollout still converges, and the fallback reads are visible in
+    stats. The sabotage fires from the first group's settlement, so
+    the run crosses the healthy -> degraded boundary mid-flight."""
+    kube = FakeKube()
+    names = [f"n{i}" for i in range(3)]
+    _pool(kube, *[_node(n, desired="off", state="off") for n in names])
+    informer = _informer_for(kube)
+    # poll-based agents: they must keep converging nodes after the
+    # informer (and its delta-fed fake agents) is dead
+    agents = _ReactiveAgents(kube, names, delay_s=0.02)
+    agents.start()
+
+    dropped = threading.Event()
+
+    def sabotage(gname, outcome, done, total):
+        if not dropped.is_set():
+            dropped.set()
+            with informer._lock:
+                informer._watch_supported = False
+            informer.stop()
+
+    roll = Rollout(kube, "on", max_unavailable=1, poll_s=0.02,
+                   group_timeout_s=15, informer=informer,
+                   on_group=sabotage)
+    try:
+        report = roll.run()
+    finally:
+        agents.stop.set()
+        informer.stop()
+    assert report.ok
+    assert {g.outcome for g in report.groups} == {"succeeded"}
+    assert roll.stats["judge_node_reads"] > 0, (
+        "the degraded judge must have paid real LIST round trips"
+    )
+
+
+def test_resume_works_identically_under_event_driven_judge(monkeypatch):
+    """The crash/resume contract is feed-independent: kill an
+    event-driven rollout mid-window, resume WITH a feed, and get one
+    coherent report with every group exactly once — and the resumed
+    run's judge still performs zero node reads."""
+    kube = FakeKube()
+    names = [f"n{i}" for i in range(4)]
+    _pool(kube, *[_node(n, desired="off", state="off") for n in names])
+    informer = _informer_for(kube)
+    agents = _InformerAgents(kube, informer, delay_s=0.02)
+
+    class _OnlyN0(_InformerAgents):
+        def _on_event(self, etype, node):
+            if (node.get("metadata") or {}).get("name") == "n0":
+                super()._on_event(etype, node)
+
+    agents.close()
+    agents = _OnlyN0(kube, informer, delay_s=0.02)
+    roll = Rollout(kube, "on", max_unavailable=1, group_timeout_s=60,
+                   poll_s=0.05, informer=informer)
+
+    def ready(rec):
+        g = rec.get("groups", {})
+        return (g.get("node/n0", {}).get("outcome") == "succeeded"
+                and g.get("node/n1", {}).get("outcome") == "in_flight")
+
+    rec = _crash_rollout_at(kube, monkeypatch, roll, ready)
+    agents.close()
+    assert rec["complete"] is False
+
+    agents2 = _InformerAgents(kube, informer, delay_s=0.02)
+    try:
+        resumed = Rollout.resume(kube, poll_s=0.05, group_timeout_s=60,
+                                 informer=informer)
+        report = resumed.run()
+    finally:
+        agents2.close()
+        informer.stop()
+    assert report.ok
+    assert [g.name for g in report.groups] == sorted(
+        f"node/{n}" for n in names)
+    assert {g.outcome for g in report.groups} == {"succeeded"}
+    assert resumed.stats["judge_node_reads"] == 0
+
+
+def test_delta_judge_racing_group_timeout_picks_one_outcome():
+    """The exactly-once pin: a delta-fed judge (convergence) and the
+    fallback tick (expired deadline) racing over the same group must
+    produce exactly ONE terminal outcome — whichever wins, the loser
+    finds nothing in flight."""
+    kube = FakeKube()
+    _pool(kube, _node("n1", desired="off", state="off"))
+    roll = Rollout(kube, "on", poll_s=0.05, group_timeout_s=60)
+    # admit with the pre-flip snapshot (non-terminal — the admit-time
+    # judge must leave the group in flight); the racing delta carries
+    # the converged node
+    node_off = kube.get_node("n1")
+    node_on = kube.get_node("n1")
+    node_on["metadata"]["labels"][L.CC_MODE_LABEL] = "on"
+    node_on["metadata"]["labels"][L.CC_MODE_STATE_LABEL] = "on"
+    for _ in range(20):
+        roll._admit_group("node/n1", ["n1"], {"n1": node_off}, set())
+        with roll._judge_lock:
+            members, _, sf = roll._in_flight["node/n1"]
+            # force the deadline into the past: the tick path will
+            # judge timeout, the delta path judges convergence
+            roll._in_flight["node/n1"] = (
+                members, time.monotonic() - 1.0, sf,
+            )
+        barrier = threading.Barrier(2)
+
+        def delta():
+            barrier.wait()
+            roll._on_delta("MODIFIED", node_on)
+
+        def tick():
+            barrier.wait()
+            with roll._judge_lock:
+                roll._judge_locked("node/n1")
+
+        t1 = threading.Thread(target=delta)
+        t2 = threading.Thread(target=tick)
+        t1.start(); t2.start()
+        t1.join(5); t2.join(5)
+        with roll._judge_lock:
+            assert len(roll._ready) == 1, (
+                "a racing judge pair must settle exactly one outcome"
+            )
+            assert not roll._in_flight
+            roll._ready.clear()
+            roll._watched.clear()
+            roll._live.clear()

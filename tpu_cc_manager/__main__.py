@@ -159,35 +159,71 @@ def main(argv=None) -> int:
         return 0
 
     if args.command == "rollout":
-        from tpu_cc_manager.modes import InvalidModeError
+        from tpu_cc_manager.modes import InvalidModeError, parse_mode
         from tpu_cc_manager.rollout import Rollout, RolloutError
 
+        # the judge's event feed (ISSUE 14): one LIST-then-WATCH
+        # informer so steady-state group judging reads local memory
+        # instead of re-LISTing the pool every poll tick. A client
+        # without watch support degrades inside the informer, and the
+        # rollout's liveness fallback then pays its own interval LISTs
+        # — the historical behavior. Dry runs never judge, so they
+        # skip the stream entirely. The informer is cluster-wide (the
+        # cache layer has no selector scoping, and label-selector
+        # watches aren't in this client): for a rollout outliving a
+        # few poll ticks that is still LESS API load than the scoped
+        # LIST-per-tick it replaces, but a seconds-long rollout of a
+        # handful of nodes in a huge mixed cluster pays a fleet-wide
+        # prime for it.
+        # argument validation BEFORE any API traffic: a usage error
+        # must not cost a fleet-wide informer prime
+        if args.resume:
+            if args.mode:
+                log.error("--resume takes the mode from the durable "
+                          "record; do not pass --mode")
+                return 1
+            if (args.max_unavailable != 1 or args.failure_budget != 0
+                    or args.canary != 0):
+                log.error("--resume takes the window, budget, and "
+                          "canary from the durable record; do not "
+                          "pass --max-unavailable/--failure-budget/"
+                          "--canary")
+                return 1
+        elif not args.mode:
+            log.error("rollout requires -m/--mode (or --resume)")
+            return 1
+        else:
+            try:
+                parse_mode(args.mode)
+            except InvalidModeError as e:
+                log.error("rollout refused: %s", e)
+                return 1
+        informer = None
+        kube = _kube_client(cfg)
+        if not args.dry_run:
+            from tpu_cc_manager.watch import NodeInformer
+
+            try:
+                informer = NodeInformer(kube, name="rollout")
+                informer.prime()
+                informer.start()
+            except Exception as e:
+                log.warning("node informer unavailable (%s); judging "
+                            "on the poll interval", e)
+                informer = None
         try:
             if args.resume:
-                if args.mode:
-                    log.error("--resume takes the mode from the durable "
-                              "record; do not pass --mode")
-                    return 1
-                if (args.max_unavailable != 1 or args.failure_budget != 0
-                        or args.canary != 0):
-                    log.error("--resume takes the window, budget, and "
-                              "canary from the durable record; do not "
-                              "pass --max-unavailable/--failure-budget/"
-                              "--canary")
-                    return 1
                 rollout = Rollout.resume(
-                    _kube_client(cfg),
+                    kube,
                     selector=args.selector,
                     group_timeout_s=args.group_timeout,
                     dry_run=args.dry_run,
                     verify_evidence=not args.no_verify_evidence,
+                    informer=informer,
                 )
             else:
-                if not args.mode:
-                    log.error("rollout requires -m/--mode (or --resume)")
-                    return 1
                 rollout = Rollout(
-                    _kube_client(cfg),
+                    kube,
                     args.mode,
                     selector=args.selector or L.TPU_ACCELERATOR_LABEL,
                     max_unavailable=args.max_unavailable,
@@ -197,11 +233,15 @@ def main(argv=None) -> int:
                     force=args.force,
                     dry_run=args.dry_run,
                     verify_evidence=not args.no_verify_evidence,
+                    informer=informer,
                 )
             report = rollout.run()
         except (InvalidModeError, RolloutError) as e:
             log.error("rollout refused: %s", e)
             return 1
+        finally:
+            if informer is not None:
+                informer.stop()
         print(report.to_json())
         return 0 if report.ok else 1
 

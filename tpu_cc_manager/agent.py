@@ -106,12 +106,17 @@ class CCManagerAgent:
         self.config_mailbox = SyncableModeConfig(
             on_coalesced=lambda: self.metrics.coalesced_total.inc()
         )
+        #: pulsed by every node-watch delta: an in-flight drain wait
+        #: (drain.py's pod-wait loops) re-checks on the event instead
+        #: of the next poll boundary (ISSUE 14's wake treatment)
+        self._drain_wake = threading.Event()
         self.watcher = NodeWatcher(
             kube,
             cfg.node_name,
             self.config_mailbox,
             on_fatal=self._on_fatal_watch,
             on_error=lambda: self.metrics.watch_errors_total.inc(),
+            on_event=lambda etype, node: self._drain_wake.set(),
         )
         self.slice_coordinator = slice_coordinator
         if (
@@ -155,7 +160,7 @@ class CCManagerAgent:
         )
         self.engine = ModeEngine(
             set_state_label=self._set_state_label,
-            drainer=build_drainer(kube, cfg),
+            drainer=build_drainer(kube, cfg, wake=self._drain_wake),
             evict_components=cfg.evict_components and cfg.drain_strategy != "none",
             backend=backend,
             tracer=self.tracer,
@@ -472,7 +477,10 @@ class CCManagerAgent:
                     "startup node read failed (%d): %s; retrying in %.1fs",
                     attempts, e, self.watcher.backoff_s,
                 )
-                time.sleep(self.watcher.backoff_s)
+                # event wait, not a fixed sleep: shutdown (the only
+                # wake source at startup) cuts the backoff short
+                if self._stop.wait(self.watcher.backoff_s):
+                    return None
 
     # ----------------------------------------------------------- reconcile
     @contextmanager
@@ -714,12 +722,17 @@ class CCManagerAgent:
         self.batcher.flush()
         if self._event_worker is None or not self._event_worker.is_alive():
             return True
+        # queue-join with a deadline: ride the queue's own
+        # all_tasks_done condition (task_done() notifies it) instead
+        # of spinning a 10ms poll against the worker's progress
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._event_queue.unfinished_tasks == 0:
-                return True
-            time.sleep(0.01)
-        return False
+        with self._event_queue.all_tasks_done:
+            while self._event_queue.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._event_queue.all_tasks_done.wait(remaining)
+        return True
 
     # -------------------------------------------------------------- repair
     def _disarm_repair(self) -> None:
@@ -865,7 +878,10 @@ class CCManagerAgent:
             # same backoff treatment as the watch loop
             initial = self._prime_with_retry()
             mode = with_default(initial, cfg.default_mode)
-            if mode is not None:
+            # a prime cut short by shutdown returns None — that is NOT
+            # "no label, apply the default": a stopping agent must not
+            # drain and flip the node toward the default on its way out
+            if mode is not None and not self._stop.is_set():
                 ok = self._reconcile_current(mode)
                 if (not ok and initial is None
                         and self.last_outcome not in ("superseded",

@@ -256,6 +256,7 @@ def _analyze_modules(
     findings.extend(rules.planner_bypass_findings(modules))
     findings.extend(rules.shard_bypass_findings(modules))
     findings.extend(rules.blocking_in_async_findings(modules))
+    findings.extend(rules.poll_in_watch_path_findings(modules))
     return sorted(findings), audits
 
 

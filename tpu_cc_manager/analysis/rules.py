@@ -1751,6 +1751,79 @@ def shard_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
     return findings
 
 
+# -------------------------------------------------------- poll in watch path
+
+
+#: Reconcile-path modules where a wake primitive exists (ISSUE 14):
+#: the rollout judge's delta wake (``Rollout._wake`` off the shared
+#: informer stream), the drainers' watch-delta wake (``Drainer.wake``),
+#: and the agent's stop event / queue conditions. A ``time.sleep``-
+#: clocked loop in one of these modules re-introduces the interval tax
+#: on the desired-write -> converged critical path that the
+#: event-driven judge removed — wait on the wake primitive (with the
+#: poll interval as the TIMEOUT, the liveness fallback) instead. A
+#: deliberate poll carries ``# ccaudit: allow-poll(reason)``.
+POLL_PATH_MODULES = frozenset({
+    "tpu_cc_manager/rollout.py",
+    "tpu_cc_manager/drain.py",
+    "tpu_cc_manager/agent.py",
+})
+
+
+def poll_in_watch_path_findings(modules: Sequence[Module]) -> List[Finding]:
+    """Flag ``time.sleep`` calls lexically inside a ``for``/``while``
+    loop in the watch-fed reconcile-path modules
+    (``poll-in-watch-path``). Sleeps outside loops (one-shot backoffs)
+    are not polls and pass; loop waits must ride a wake primitive
+    (``Event.wait(timeout=poll_s)``) so the poll interval degrades to
+    a liveness fallback instead of clocking every iteration."""
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.relpath not in POLL_PATH_MODULES:
+            continue
+        imports = collect_imports(mod.tree)
+        # ast.walk visits a nested loop's body once per enclosing loop
+        # — dedupe by position or one sleep double-reports
+        seen: set = set()
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = resolve_dotted(node.func, imports)
+                if resolved != "time.sleep":
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if (mod.suppressed("poll", node.lineno)
+                        or mod.suppressed("poll-in-watch-path",
+                                          node.lineno)):
+                    continue
+                findings.append(
+                    Finding(
+                        file=mod.relpath,
+                        line=node.lineno,
+                        rule="poll-in-watch-path",
+                        message=(
+                            "time.sleep-clocked loop in a watch-fed "
+                            "reconcile-path module — a wake primitive "
+                            "is available here (the rollout judge's "
+                            "delta wake, the drainer's watch-delta "
+                            "wake, the agent's stop event): wait on "
+                            "it with the poll interval as the "
+                            "timeout, so the poll degrades to a "
+                            "liveness fallback; a deliberate poll "
+                            "needs an allow-poll pragma naming why"
+                        ),
+                        text=mod.line_text(node.lineno),
+                    )
+                )
+    return findings
+
+
 # ------------------------------------------------------- blocking in async
 
 

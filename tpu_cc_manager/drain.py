@@ -28,6 +28,7 @@ placement (gpu_operator_eviction.py:262-286).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -43,6 +44,22 @@ EVICTION_TIMEOUT_S = 300
 #: Poll interval while waiting for pods to go away
 #: (reference gpu_operator_eviction.py:200).
 EVICTION_POLL_S = 2
+
+
+def _drain_wait(wake: Optional[threading.Event], poll_s: float) -> None:
+    """One drain-wait interval, cut short by the wake event when the
+    caller wired one (the agent pulses it from its node-watch delta
+    thread, so a restore/taint/cordon change is noticed on the watch
+    event instead of the next poll boundary — ISSUE 14's wake
+    treatment). ``poll_s`` survives as the liveness fallback: pod
+    deletions produce no node event, so the re-check cadence is still
+    bounded. Without a wake source this is a plain interruptible
+    sleep."""
+    if wake is None:
+        time.sleep(poll_s)  # ccaudit: allow-poll(no wake source wired: a bare drainer — one-shot CLI without a watch — has nothing to pulse this wait)
+        return
+    if wake.wait(poll_s):
+        wake.clear()
 
 
 def set_cc_mode_state_label(kube: KubeClient, node_name: str, value: str) -> None:
@@ -328,6 +345,7 @@ class ComponentDrainer(Drainer):
         component_labels: Sequence[str] = L.COMPONENT_LABELS,
         timeout_s: float = EVICTION_TIMEOUT_S,
         poll_s: float = EVICTION_POLL_S,
+        wake: Optional[threading.Event] = None,
     ):
         self.kube = kube
         self.node_name = node_name
@@ -335,6 +353,10 @@ class ComponentDrainer(Drainer):
         self.component_labels = tuple(component_labels)
         self.timeout_s = timeout_s
         self.poll_s = poll_s
+        #: optional wake source for the pod-wait loops (see
+        #: engine.Drainer's wake contract): the agent wires its node
+        #: watcher's delta pulse here
+        self.wake = wake
 
     # -- reference gpu_operator_eviction.py:98-129 ----------------------
     def fetch_current_component_labels(self) -> Dict[str, str]:
@@ -389,7 +411,7 @@ class ComponentDrainer(Drainer):
                     self.node_name,
                 )
                 return
-            time.sleep(self.poll_s)
+            _drain_wait(self.wake, self.poll_s)
 
     # -- reference gpu_operator_eviction.py:217-260 ---------------------
     def reschedule(self) -> None:
@@ -419,6 +441,7 @@ class NodeDrainer(Drainer):
         pod_label_selector: Optional[str] = None,
         timeout_s: float = EVICTION_TIMEOUT_S,
         poll_s: float = EVICTION_POLL_S,
+        wake: Optional[threading.Event] = None,
     ):
         self.kube = kube
         self.node_name = node_name
@@ -426,6 +449,8 @@ class NodeDrainer(Drainer):
         self.pod_label_selector = pod_label_selector
         self.timeout_s = timeout_s
         self.poll_s = poll_s
+        #: optional wake source (see engine.Drainer's wake contract)
+        self.wake = wake
 
     def _cordon(self, value: bool) -> None:
         self.kube.patch_node(self.node_name, {"spec": {"unschedulable": value}})  # ccaudit: allow-direct-node-write(ordered drain step: cordon must precede the evictions issued right after it)
@@ -466,20 +491,25 @@ class NodeDrainer(Drainer):
                     "continuing anyway", self.node_name, blocked,
                 )
                 return
-            time.sleep(self.poll_s)
+            _drain_wait(self.wake, self.poll_s)
 
     def reschedule(self) -> None:
         log.info("uncordoning %s", self.node_name)
         self._cordon(False)
 
 
-def build_drainer(kube: KubeClient, cfg) -> Drainer:
+def build_drainer(kube: KubeClient, cfg,
+                  wake: Optional[threading.Event] = None) -> Drainer:
     """Map an AgentConfig's drain_strategy to a Drainer (single source of
-    truth for both the long-lived agent and the one-shot CLI)."""
+    truth for both the long-lived agent and the one-shot CLI).
+    ``wake``: optional watch-delta pulse for the pod-wait loops (the
+    agent wires its node watcher's event stream; one-shot CLIs pass
+    nothing and keep the plain poll)."""
     if cfg.drain_strategy == "node":
-        return NodeDrainer(kube, cfg.node_name)
+        return NodeDrainer(kube, cfg.node_name, wake=wake)
     if cfg.drain_strategy == "components":
         return ComponentDrainer(
-            kube, cfg.node_name, namespace=cfg.operator_namespace
+            kube, cfg.node_name, namespace=cfg.operator_namespace,
+            wake=wake,
         )
     return NullDrainer()

@@ -70,6 +70,14 @@ class Drainer:
     #: default: assume writes.
     wrote_node = True
 
+    #: optional wake source for the drainer's wait loops (ISSUE 14's
+    #: wake treatment): a ``threading.Event`` the caller pulses on
+    #: watch deltas so a restore/taint/cordon change is noticed on the
+    #: event, not the next poll boundary. ``poll_s`` stays the
+    #: liveness fallback. None = plain interval polling (one-shot
+    #: CLIs, tests).
+    wake = None
+
     def evict(self) -> None:  # pragma: no cover - interface
         raise NotImplementedError
 

@@ -1218,6 +1218,10 @@ class PolicyController:
                     self.kube, poll_s=self.poll_s,
                     verify_evidence=self.verify_evidence,
                     on_group=progress if wst is not None else None,
+                    # the shared informer's delta stream feeds the
+                    # resumed judge too: adoption keeps the zero-read
+                    # event-driven contract the fresh-launch path has
+                    informer=self.informer,
                     # pin the record (and its anchor, carried from the
                     # scheduling pass's listing): with several
                     # unfinished records in the cluster, resume's own
@@ -1386,6 +1390,11 @@ class PolicyController:
                 poll_s=self.poll_s,
                 verify_evidence=self.verify_evidence,
                 on_group=progress,
+                # event-driven judge (ISSUE 14): group completions are
+                # judged off the shared informer's delta stream and the
+                # next group launches from the wake path; poll_s stays
+                # as the liveness fallback + group-timeout clock
+                informer=self.informer,
             )
             self._arm_rollout(entry, rollout)
             report = rollout.run()

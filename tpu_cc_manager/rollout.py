@@ -288,6 +288,7 @@ class Rollout:
         dry_run: bool = False,
         verify_evidence: bool = True,
         on_group=None,
+        informer=None,
     ):
         #: optional progress hook called after every group reaches a
         #: terminal outcome: on_group(name, outcome, done, total).
@@ -360,6 +361,55 @@ class Rollout:
         #: canary groups still to prove (set by run(); persisted in the
         #: record so a resumed rollout keeps its canary discipline)
         self._canary_left = 0
+        #: optional watch.NodeInformer (or anything with subscribe/
+        #: unsubscribe/list_nodes/get_node/primed/stats): the judge's
+        #: event feed. When wired and healthy, in-flight groups are
+        #: judged INSIDE the delta callback off the shared watch
+        #: stream, the driving loop blocks on ``_wake`` instead of
+        #: sleeping out ``poll_s``, and the liveness-fallback judge
+        #: tick reads the informer's in-memory cache — steady-state
+        #: judging performs ZERO node read round trips (pinned by
+        #: tests/test_rollout.py against FakeKube.node_read_requests).
+        #: ``poll_s`` survives only as the liveness fallback cadence
+        #: and the group-timeout clock. See docs/rollout.md.
+        self.informer = informer
+        #: wakes the driving loop: set by the delta callback when a
+        #: group reaches a terminal outcome (so the next group's
+        #: desired writes launch from THIS wake, not the next tick)
+        #: and by request_stop
+        self._wake = threading.Event()
+        #: guards every judge-shared structure below — the delta
+        #: callback (informer delivery thread) and the driving loop
+        #: both judge; the lock is what makes a delta-fed judge racing
+        #: the group timeout pick exactly ONE terminal outcome
+        self._judge_lock = threading.Lock()
+        #: gname -> (members, monotonic deadline, stale_failed set)
+        self._in_flight: Dict[str, Tuple[List[str], float, set]] = {}
+        #: member node name -> its in-flight group
+        self._watched: Dict[str, str] = {}
+        #: member -> newest observed node object (seeded at launch,
+        #: updated by label-change deltas / cache refreshes)
+        self._live: Dict[str, dict] = {}
+        #: delta- or tick-judged terminal GroupResults awaiting the
+        #: driving loop's settlement (record persist, budget, canary)
+        self._ready: deque = deque()
+        self._feed_token = None
+        #: monotonic stamp of the last settled terminal outcome; the
+        #: next launch turns it into one advance-latency sample
+        self._last_terminal_at: Optional[float] = None
+        #: observable judge economics (the bench's rollout_advance_p50_s
+        #: and zero-read-pin source): judge_ticks = fallback passes
+        #: served from the informer cache; judge_node_reads = REAL
+        #: LIST round trips the judge paid (degraded/legacy mode
+        #: only); delta_judges = judgements run inside the delta
+        #: callback; advance_latencies_s = group terminal -> next
+        #: group's first desired write (bounded ring)
+        self.stats: Dict[str, object] = {
+            "judge_ticks": 0,
+            "judge_node_reads": 0,
+            "delta_judges": 0,
+            "advance_latencies_s": deque(maxlen=512),
+        }
 
     @classmethod
     def resume(
@@ -374,6 +424,7 @@ class Rollout:
         on_group=None,
         record: Optional[dict] = None,
         record_node: Optional[str] = None,
+        informer=None,
     ) -> "Rollout":
         """Rebuild a Rollout from the pool's unfinished durable record.
         Mode, window, budget, AND selector come from the record (the
@@ -436,7 +487,7 @@ class Rollout:
             failure_budget=int(record.get("failure_budget", 0)),
             group_timeout_s=group_timeout_s, poll_s=poll_s, force=True,
             dry_run=dry_run, verify_evidence=verify_evidence,
-            on_group=on_group,
+            on_group=on_group, informer=informer,
         )
         # a versionless (pre-versioning) record is adopted as v1: this
         # controller maintains a v1 shape from here on, and persists say
@@ -541,6 +592,275 @@ class Rollout:
                 self.on_group(gname, outcome, done, total)
             except Exception:
                 log.warning("rollout progress hook failed", exc_info=True)
+
+    # --------------------------------------------------- event-driven judge
+    def _subscribe_feed(self) -> None:
+        """Arm the delta feed for this run. Failure degrades to the
+        interval path — the feed is a latency/IO optimization, never a
+        correctness dependency."""
+        if self.informer is None or self.dry_run:
+            return
+        try:
+            self._feed_token = self.informer.subscribe(
+                on_event=self._on_delta, on_wake=self._on_feed_wake
+            )
+        except Exception:
+            log.warning("rollout judge feed subscription failed; "
+                        "falling back to interval judging",
+                        exc_info=True)
+            self._feed_token = None
+
+    def _unsubscribe_feed(self) -> None:
+        if self.informer is not None and self._feed_token is not None:
+            try:
+                self.informer.unsubscribe(self._feed_token)
+            except Exception:
+                log.debug("feed unsubscribe failed", exc_info=True)
+            self._feed_token = None
+
+    def _feed_healthy(self) -> bool:
+        """True when the informer cache may serve this judge tick:
+        subscribed, primed, and actually watch-fed. An informer
+        degraded to interval re-listing (no watch support) would serve
+        reads staler than the judge's own poll cadence, so the judge
+        falls back to its own LIST instead."""
+        if self._feed_token is None or self.informer is None:
+            return False
+        try:
+            if not self.informer.primed:
+                return False
+            stats = getattr(self.informer, "stats", None)
+            if callable(stats) and not stats().get(
+                    "watch_supported", True):
+                # permanent for this informer (it degrades to interval
+                # re-listing and never re-arms the watch): drop the
+                # subscription so the fan-out stops paying for us and
+                # every later tick goes straight to the legacy LIST
+                log.info("judge feed has no watch support; interval "
+                         "judging for the rest of this rollout")
+                self._unsubscribe_feed()
+                return False
+            return True
+        except Exception:
+            log.debug("informer health probe failed; treating the "
+                      "feed as degraded", exc_info=True)
+            return False
+
+    def _on_delta(self, etype: str, node: dict) -> None:
+        """Informer delta callback (delivery thread): update the
+        member's observed snapshot and judge its group IN PLACE. A
+        terminal outcome queues for the driving loop's settlement and
+        wakes it, so the next group's desired writes launch from this
+        wake instead of waiting out the poll tick.
+
+        Cost bound (this runs on the SHARED informer delivery
+        thread): deltas for unwatched nodes return after one dict
+        probe; a watched delta judges one group — label compares plus,
+        only in the label-converged-but-unproven window, per-member
+        evidence HMAC checks over in-hand annotations. No I/O ever
+        happens here; persists and launches stay on the driver."""
+        # never let an exception escape into the SHARED informer's
+        # delivery loop: it would tear down the watch and force a
+        # fleet-wide relist on every consumer. A failed judgement here
+        # is retried by the fallback tick.
+        try:
+            name = (node.get("metadata") or {}).get("name")
+            if not name:
+                return
+            # lock-free fast path keeps unwatched deltas (the vast
+            # majority on a big cluster) off the judge lock entirely;
+            # GIL-atomic dict probe, re-checked under the lock — the
+            # benign miss window is covered by the fallback tick
+            # ccaudit: allow-race-lockset(read-only probe; every _watched write is lock-guarded, a stale read only defers one judge to the poll tick)
+            if name not in self._watched:
+                return
+            with self._judge_lock:
+                gname = self._watched.get(name)
+                if gname is None:
+                    return
+                if etype == "DELETED":
+                    self._live.pop(name, None)
+                else:
+                    self._live[name] = node
+                self.stats["delta_judges"] += 1  # type: ignore[operator]
+                self._judge_locked(gname)
+        except Exception:
+            log.exception("delta judge failed; the fallback tick "
+                          "covers this group")
+
+    def _on_feed_wake(self) -> None:
+        """Informer relist (watch gap): anything may have changed —
+        refresh every watched member from the cache and re-judge.
+        Exception-proof for the same reason as :meth:`_on_delta`."""
+        if self.informer is None:
+            return
+        try:
+            with self._judge_lock:
+                self._refresh_watched_locked()
+                for gname in list(self._in_flight):
+                    self._judge_locked(gname)
+        except Exception:
+            log.exception("relist judge failed; the fallback tick "
+                          "covers the in-flight groups")
+
+    def _refresh_watched_locked(self) -> None:
+        """Refresh every watched member from the informer cache
+        (caller holds ``_judge_lock``): a member the cache no longer
+        knows drops from the live map, so the next judge fails its
+        group as vanished — the one place those semantics live."""
+        inf = self.informer
+        for m in list(self._watched):
+            try:
+                self._live[m] = inf.get_node(m)
+            except ApiException:
+                # gone from the (re)listed cache: vanished mid-flight
+                self._live.pop(m, None)
+            except Exception:
+                log.debug("cache refresh of %s failed", m,
+                          exc_info=True)
+
+    def _judge_locked(self, gname: str,
+                      deadline_only: bool = False) -> None:
+        """Judge one in-flight group against the live observed map
+        (caller holds ``_judge_lock``). A terminal outcome removes the
+        group from the in-flight window EXACTLY ONCE — whichever of
+        the delta callback, the relist refresh, or the fallback tick
+        gets here first wins, and the losers find nothing in flight."""
+        entry = self._in_flight.get(gname)
+        if entry is None:
+            return
+        members, deadline, stale_failed = entry
+        by_name = (
+            None if deadline_only
+            else {m: self._live[m] for m in members if m in self._live}
+        )
+        outcome = self._judge_group(
+            gname, members, deadline, stale_failed, by_name
+        )
+        if outcome is None:
+            return
+        del self._in_flight[gname]
+        for m in members:
+            self._watched.pop(m, None)
+            self._live.pop(m, None)
+        self._ready.append(outcome)
+        self._wake.set()
+
+    def _watch_group(self, gname: str, members: List[str],
+                     by_name: Dict[str, dict]) -> None:
+        """Register a group's members for delta tracking BEFORE its
+        desired labels are patched: a convergence delta landing in the
+        patch->admit gap (a very fast agent) must update the live map,
+        not vanish. Judging stays disarmed until :meth:`_admit_group`
+        enters the group into the in-flight window."""
+        with self._judge_lock:
+            for m in members:
+                self._watched[m] = gname
+                if m in by_name:
+                    self._live[m] = by_name[m]
+
+    def _unwatch_group(self, members: List[str]) -> None:
+        """Roll back :meth:`_watch_group` for a launch that failed."""
+        with self._judge_lock:
+            for m in members:
+                self._watched.pop(m, None)
+                self._live.pop(m, None)
+
+    def _admit_group(self, gname: str, members: List[str],
+                     by_name: Dict[str, dict], stale_failed: set) -> None:
+        """Enter one launched group into the judged window (members
+        registered by :meth:`_watch_group`, or seeded here for resume
+        drains), then judge it once immediately — deltas that landed
+        between the launch patches and this admit are already in the
+        live map and must not wait out a fallback tick."""
+        with self._judge_lock:
+            for m in members:
+                if m not in self._watched:
+                    # not pre-registered (a resume drain): seed from
+                    # the pool snapshot. A pre-registered member with
+                    # NO live entry was DELETED in the patch->admit
+                    # gap — re-seeding the stale snapshot would defer
+                    # its vanished fast-fail to the next tick.
+                    if m in by_name:
+                        self._live[m] = by_name[m]
+                self._watched[m] = gname
+            self._in_flight[gname] = (
+                members, time.monotonic() + self.group_timeout_s,
+                stale_failed,
+            )
+            self._judge_locked(gname)
+
+    def _has_ready(self) -> bool:
+        with self._judge_lock:
+            return bool(self._ready)
+
+    def _launch_slot_free(self) -> bool:
+        """ONE consistent snapshot of the launch gate: a window slot
+        is offered only when no judged-but-unsettled outcome is
+        queued. Both mutations (in-flight removal, ready enqueue)
+        happen inside ``_judge_locked``'s critical section, so reading
+        them under one acquisition cannot see a slot freed by an
+        outcome whose budget/canary consequences are still pending."""
+        with self._judge_lock:
+            if self._ready:
+                return False
+            return len(self._in_flight) < (
+                1 if self._canary_left > 0 else self.max_unavailable
+            )
+
+    def _judge_tick(self, fetch_pool: bool
+                    ) -> Optional[Dict[str, dict]]:
+        """The liveness fallback + group-timeout clock: refresh every
+        watched member and judge every in-flight group. Feed healthy:
+        served entirely from the informer's in-memory cache — ZERO
+        node read round trips. Degraded (watch drop the informer
+        cannot heal) or legacy (no feed): one real LIST per tick,
+        exactly the historical interval judge. Returns the fresh pool
+        map for launch bookkeeping (None when the poll failed)."""
+        fresh: Optional[Dict[str, dict]] = None
+        if self._feed_healthy():
+            try:
+                if fetch_pool:
+                    fresh = {
+                        n["metadata"]["name"]: n
+                        for n in self.informer.list_nodes(self.selector)
+                    }
+            except Exception:
+                log.debug("informer pool read failed; judging from "
+                          "deltas only", exc_info=True)
+            with self._judge_lock:
+                self.stats["judge_ticks"] += 1  # type: ignore[operator]
+                if fresh is not None:
+                    for m in list(self._watched):
+                        if m in fresh:
+                            self._live[m] = fresh[m]
+                        else:
+                            self._live.pop(m, None)
+                else:
+                    self._refresh_watched_locked()
+                for gname in list(self._in_flight):
+                    self._judge_locked(gname)
+            return fresh
+        # degraded/legacy: the historical one-LIST-per-tick judge
+        try:
+            fresh = {
+                n["metadata"]["name"]: n
+                for n in self.kube.list_nodes(self.selector)
+            }
+        except ApiException as e:
+            log.warning("pool poll failed: %s", e)
+            fresh = None
+        with self._judge_lock:
+            if fresh is not None:
+                self.stats["judge_node_reads"] += 1  # type: ignore[operator]
+                for m in list(self._watched):
+                    if m in fresh:
+                        self._live[m] = fresh[m]
+                    else:
+                        self._live.pop(m, None)
+            for gname in list(self._in_flight):
+                self._judge_locked(gname, deadline_only=fresh is None)
+        return fresh
 
     # ------------------------------------------------------------ planning
     def discover(self) -> List[dict]:
@@ -775,7 +1095,11 @@ class Rollout:
             len(pending), self.mode, self.max_unavailable,
             self.failure_budget,
         )
-        in_flight: Dict[str, Tuple[List[str], float, set]] = {}
+        with self._judge_lock:
+            self._in_flight.clear()
+            self._watched.clear()
+            self._live.clear()
+            self._ready.clear()
         for gname, members in in_flight_seed:
             # resumed drain of an aborted rollout's in-flight groups:
             # already patched pre-crash; judge only, with a fresh window
@@ -784,22 +1108,105 @@ class Rollout:
                 if by_name.get(m, {}).get("metadata", {}).get(
                     "labels", {}).get(L.CC_MODE_STATE_LABEL) == "failed"
             }
-            in_flight[gname] = (
-                members, time.monotonic() + self.group_timeout_s,
-                stale_failed,
-            )
+            self._admit_group(gname, members, by_name, stale_failed)
         canary_groups: set = set()
-        while pending or in_flight:
+        self._subscribe_feed()
+        try:
+            return self._drive(
+                pending, results, by_name, budget, report, canary_groups
+            )
+        finally:
+            self._unsubscribe_feed()
+
+    def _drive(self, pending, results: List[GroupResult],
+               by_name: Dict[str, dict], budget: int,
+               report: RolloutReport, canary_groups: set
+               ) -> RolloutReport:
+        """The wake-driven launch/judge/settle loop. Each turn: settle
+        terminal outcomes the judges queued (delta callback or tick),
+        apply budget/canary/abort consequences, refill the disruption
+        window from pending (pipelined: a freed slot relaunches in the
+        SAME turn its group settled), run the liveness/timeout judge
+        tick on the ``poll_s`` cadence, then block on the wake event.
+        With a healthy feed the block ends the instant a delta judges
+        a group terminal; without one it times out at ``poll_s`` — the
+        historical interval behavior, now interruptible."""
+        last_tick = 0.0
+        while True:
+            with self._judge_lock:
+                if not (pending or self._in_flight or self._ready):
+                    break
+            progress = False
+
+            # ---- settle judged outcomes FIRST: budget and canary
+            # state must be current before a launch fills the slot
+            while True:
+                with self._judge_lock:
+                    outcome = (self._ready.popleft()
+                               if self._ready else None)
+                if outcome is None:
+                    break
+                progress = True
+                gname = outcome.name
+                results.append(outcome)
+                if gname in canary_groups:
+                    canary_groups.discard(gname)
+                    self._canary_left = max(0, self._canary_left - 1)
+                    if self._record is not None:
+                        self._record["canary_left"] = self._canary_left
+                    if outcome.outcome != "succeeded":
+                        # set the abort flag BEFORE the outcome
+                        # persist below: one write carries both
+                        self._canary_failed(report, gname,
+                                            outcome.outcome,
+                                            persist=False)
+                self._record_group(
+                    gname, outcome.nodes, outcome.outcome,
+                    outcome.detail,
+                )
+                if outcome.outcome in _BUDGET_CONSUMING:
+                    budget -= 1
+                self._last_terminal_at = time.monotonic()
+
+            if budget < 0 and not report.aborted:
+                report.aborted = True
+                if self._record is not None:
+                    self._record["aborted"] = True
+                    self._persist()
+                with self._judge_lock:
+                    n_in_flight = len(self._in_flight)
+                log.error(
+                    "failure budget exhausted; draining %d in-flight "
+                    "group(s), %d pending group(s) not attempted",
+                    n_in_flight, len(pending),
+                )
+            if report.aborted and pending:
+                for gname, members in pending:
+                    results.append(
+                        GroupResult(gname, members, "not_attempted",
+                                    "rollout aborted")
+                    )
+                    self._record_group(gname, members, "not_attempted",
+                                       "rollout aborted")
+                pending.clear()
+
+            # ---- launch: refill the window. On a terminal wake this
+            # runs in the same turn the group settled, so the next
+            # group's desired writes go out immediately (pipelined
+            # window advancement) instead of after the next tick.
             while (
                 pending
                 and budget >= 0
                 and not report.aborted
-                # canary phase: serial (window 1) until every canary
-                # group has been judged, regardless of max_unavailable
-                and len(in_flight) < (
-                    1 if self._canary_left > 0 else self.max_unavailable
-                )
+                # atomic gate: a slot freed by a concurrent delta
+                # judgement must not be refilled before its budget and
+                # canary consequences settle (next turn settles first
+                # — the pre-wait check sees the ready queue), and the
+                # canary phase stays serial (window 1) regardless of
+                # max_unavailable
+                and self._launch_slot_free()
             ):
+                progress = True
                 was_canary = self._canary_left > 0
                 gname, members = pending.popleft()
                 # a member that vanished from the pool while the group sat
@@ -827,19 +1234,31 @@ class Rollout:
                         L.CC_MODE_STATE_LABEL
                     ) == "failed"
                 }
+                # one advance-latency sample: the previous terminal
+                # settlement -> THIS group's first desired write (the
+                # pipelining the bench's rollout_advance_p50_s gates)
+                if self._last_terminal_at is not None:
+                    with self._judge_lock:
+                        self.stats["advance_latencies_s"].append(
+                            round(time.monotonic()
+                                  - self._last_terminal_at, 6)
+                        )
+                    self._last_terminal_at = None
                 # persist INTENT before patching: a crash between the
                 # two leaves the group marked in_flight, and resume
                 # relaunches it (idempotent patch) instead of losing it
                 self._record_group(gname, members, "in_flight")
+                # track deltas from BEFORE the first patch: a
+                # convergence event in the patch->admit gap updates
+                # the live map and the admit-time judge sees it
+                self._watch_group(gname, members, by_name)
                 if self._launch(gname, members, by_name):
                     if was_canary:
                         canary_groups.add(gname)
-                    in_flight[gname] = (
-                        members,
-                        time.monotonic() + self.group_timeout_s,
-                        stale_failed,
-                    )
+                    self._admit_group(gname, members, by_name,
+                                      stale_failed)
                 else:
+                    self._unwatch_group(members)
                     detail = "desired-label patch failed"
                     results.append(
                         GroupResult(gname, members, "failed", detail)
@@ -850,66 +1269,15 @@ class Rollout:
                     self._record_group(gname, members, "failed", detail)
                     budget -= 1
 
-            if in_flight:
-                # ONE list per tick serves every in-flight group (and
-                # refreshes the snapshot used for launch bookkeeping)
-                try:
-                    by_name = {
-                        n["metadata"]["name"]: n
-                        for n in self.kube.list_nodes(self.selector)
-                    }
-                    fresh = True
-                except ApiException as e:
-                    log.warning("pool poll failed: %s", e)
-                    fresh = False
-                for gname in list(in_flight):
-                    members, deadline, stale_failed = in_flight[gname]
-                    outcome = self._judge_group(
-                        gname, members, deadline, stale_failed,
-                        by_name if fresh else None,
-                    )
-                    if outcome is None:
-                        continue
-                    del in_flight[gname]
-                    results.append(outcome)
-                    was_canary_group = gname in canary_groups
-                    if was_canary_group:
-                        canary_groups.discard(gname)
-                        self._canary_left = max(0, self._canary_left - 1)
-                        if self._record is not None:
-                            self._record["canary_left"] = self._canary_left
-                        if outcome.outcome != "succeeded":
-                            # set the abort flag BEFORE the outcome
-                            # persist below: one write carries both
-                            self._canary_failed(report, gname,
-                                                outcome.outcome,
-                                                persist=False)
-                    self._record_group(
-                        gname, outcome.nodes, outcome.outcome,
-                        outcome.detail,
-                    )
-                    if outcome.outcome in _BUDGET_CONSUMING:
-                        budget -= 1
+            # ---- liveness fallback + group-timeout clock, on the
+            # poll_s cadence regardless of how often deltas wake us
+            if (self._window_used()
+                    and time.monotonic() - last_tick >= self.poll_s):
+                last_tick = time.monotonic()
+                fresh = self._judge_tick(fetch_pool=bool(pending))
+                if fresh is not None:
+                    by_name = fresh
 
-            if budget < 0 and not report.aborted:
-                report.aborted = True
-                if self._record is not None:
-                    self._record["aborted"] = True
-                    self._persist()
-                log.error(
-                    "failure budget exhausted; draining %d in-flight "
-                    "group(s), %d pending group(s) not attempted",
-                    len(in_flight), len(pending),
-                )
-            if report.aborted and pending:
-                for gname, members in pending:
-                    results.append(
-                        GroupResult(gname, members, "not_attempted",
-                                    "rollout aborted")
-                    )
-                    self._record_group(gname, members, "not_attempted",
-                                       "rollout aborted")
-                pending.clear()
             if (
                 self._record is not None
                 and time.monotonic() - self._last_heartbeat
@@ -926,7 +1294,19 @@ class Rollout:
                 # In-flight desired labels are already patched; agents
                 # keep converging them; the adopter re-judges them.
                 reason = self._stop_reason or "stop requested"
-                for gname, members in list(in_flight.items()):
+                with self._judge_lock:
+                    stopped = {g: e[0]
+                               for g, e in self._in_flight.items()}
+                    # judged-but-unsettled outcomes are handed off too:
+                    # settling past the stop would persist state the
+                    # adopter is about to own (it re-judges them)
+                    for oc in self._ready:
+                        stopped.setdefault(oc.name, oc.nodes)
+                    self._in_flight.clear()
+                    self._watched.clear()
+                    self._live.clear()
+                    self._ready.clear()
+                for gname, members in stopped.items():
                     results.append(GroupResult(
                         gname, members, "stopped", reason
                     ))
@@ -948,17 +1328,38 @@ class Rollout:
                 log.warning(
                     "rollout stopped (%s): leaving record %s for "
                     "adoption (%d in-flight, %d pending)", reason,
-                    (self._record or {}).get("id"), len(in_flight),
+                    (self._record or {}).get("id"), len(stopped),
                     len(pending),
                 )
                 report.groups.sort(key=lambda g: g.name)
                 return report
-            if in_flight:
-                time.sleep(self.poll_s)
+            if not progress and self._window_used():
+                # quiet turn: block until a delta judges a group
+                # terminal (the wake) or the liveness tick is due.
+                # Clear-then-check orders against the judge threads:
+                # an outcome queued after the clear re-sets the event,
+                # so the wait never strands a ready settlement.
+                self._wake.clear()
+                with self._judge_lock:
+                    have_ready = bool(self._ready)
+                # re-check the stop too: request_stop() sets the wake
+                # AFTER this turn's stop check ran, and the clear
+                # above would otherwise swallow it for a full wait
+                if not have_ready and not self._stop_requested.is_set():
+                    # capped at the heartbeat period: a long poll_s
+                    # must slow the fallback judge, never liveness
+                    self._wake.wait(
+                        min(self.poll_s, HEARTBEAT_PERIOD_S)
+                        if self._record is not None else self.poll_s
+                    )
 
         self._finish_record(report)
         report.groups.sort(key=lambda g: g.name)
         return report
+
+    def _window_used(self) -> int:
+        with self._judge_lock:
+            return len(self._in_flight)
 
     def request_stop(self, reason: str = "stop requested") -> None:
         """Ask a running rollout to stop at its next loop turn without
@@ -967,6 +1368,7 @@ class Rollout:
         leader election mid-roll."""
         self._stop_reason = reason
         self._stop_requested.set()
+        self._wake.set()  # unblock the driving loop's event wait now
 
     def _canary_failed(self, report: RolloutReport, gname: str,
                        how: str, persist: bool = True) -> None:

@@ -185,6 +185,10 @@ class SimLab:
         self._controllers: List[object] = []
         #: tpu_cc_manager.shard.ShardManager when controllers.shards>0
         self.shard_manager = None
+        #: shared node informer feeding the (non-sharded) policy
+        #: controllers' scan wakes AND their rollouts' event-driven
+        #: judges (ISSUE 14); the sharded plane brings its own
+        self._policy_informer = None
         #: monotonic stamp of measured-convergence completion (the
         #: shard failover axis is kill -> this)
         self._conv_end_t: Optional[float] = None
@@ -340,6 +344,22 @@ class SimLab:
             self._controller_threads.append(t)
         if sc.controllers.policy:
             from tpu_cc_manager.policy import PolicyController
+            from tpu_cc_manager.watch import NodeInformer
+
+            # ONE shared informer for every policy replica (the shard
+            # plane has its own): feeds the controllers' node wakes
+            # and their rollouts' delta-judged windows (ISSUE 14), so
+            # in-scenario rollout judging adds zero LIST load to the
+            # faulted API server
+            informer = NodeInformer(self._client(qps=0),
+                                    name="simlab-policy")
+            try:
+                informer.prime()
+            except Exception:
+                log.warning("simlab policy informer prime failed; "
+                            "priming from the watch thread",
+                            exc_info=True)
+            self._policy_informer = informer.start()
 
             n = 2 if sc.controllers.leader_elect else 1
             for i in range(n):
@@ -365,6 +385,7 @@ class SimLab:
                     verify_evidence=sc.evidence,
                     leader_elector=elector,
                     adopt_after_s=2.0,
+                    informer=self._policy_informer,
                 )
                 self._controllers.append(ctrl)
                 t = threading.Thread(target=ctrl.run, daemon=True,
@@ -926,6 +947,11 @@ class SimLab:
                 self.shard_manager.stop()
             except Exception:
                 log.warning("shard manager stop failed", exc_info=True)
+        if self._policy_informer is not None:
+            try:
+                self._policy_informer.stop()
+            except Exception:
+                log.warning("policy informer stop failed", exc_info=True)
         for t in self._controller_threads:
             t.join(timeout=5)
         if self.pump is not None:

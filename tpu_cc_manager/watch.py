@@ -546,6 +546,7 @@ class NodeWatcher:
         max_consecutive_errors: int = MAX_CONSECUTIVE_ERRORS,
         on_fatal: Optional[Callable[[Exception], None]] = None,
         on_error: Optional[Callable[[], None]] = None,
+        on_event: Optional[Callable[[str, dict], None]] = None,
     ):
         self.kube = kube
         self.node_name = node_name
@@ -556,6 +557,12 @@ class NodeWatcher:
         self.max_consecutive_errors = max_consecutive_errors
         self.on_fatal = on_fatal
         self.on_error = on_error
+        #: fires on EVERY delivered node event (after the snapshot is
+        #: refreshed, before label dedup): the agent pulses its drain
+        #: wake from here so in-flight drain waits re-check on the
+        #: watch event (ISSUE 14). Must be cheap and never raise-prone
+        #: — it runs on the watch thread.
+        self.on_event = on_event
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: last label value pushed downstream (dedup at the watch layer,
@@ -630,6 +637,14 @@ class NodeWatcher:
         with self._snapshot_lock:
             return self._trace_ctx
 
+    def _fire_on_event(self, etype: str, node: dict) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(etype, node)
+        except Exception:
+            log.debug("on_event hook failed", exc_info=True)
+
     def _push(self, value: Optional[str]) -> None:
         if value != self._last_value:
             log.info(
@@ -666,11 +681,13 @@ class NodeWatcher:
                         # a reconcile triggered by this event must find
                         # a seed at least as fresh as its own trigger
                         self._remember_node(node)
+                        self._fire_on_event(etype, node)
                         self._push(
                             node["metadata"].get("labels", {}).get(self.label_key)
                         )
                     elif etype == "DELETED":
                         log.warning("node %s deleted from the API", self.node_name)
+                        self._fire_on_event(etype, node)
                     if self._stop.is_set():
                         return
                 # clean server-side timeout: reconnect immediately with rv
