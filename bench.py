@@ -948,6 +948,141 @@ def run_multichip_flip_bench(n_chips=8, reset_latency_s=0.2, concurrency=4):
     }
 
 
+def run_incident_bench(dump_dir, flip_rounds=600):
+    """Incident-autopsy extras (ISSUE 15). Two gated axes:
+
+    ``profiler_overhead_pct`` — the SAME fake-chip flip loop timed with
+    the sampling profiler disarmed vs armed at its default hz, as four
+    interleaved runs per arm with the MIN-based estimator
+    (min(armed)/min(disarmed) − 1): on the shared 2-core sandbox
+    scheduler noise swings individual runs by 10%+ — more than the
+    real sampling cost — and the minimum is the classic noise-robust
+    wall-clock estimator (the fastest run of each arm had the least
+    interference). Acceptance ceiling 5%.
+    ``incident_capture_s`` — anomaly fire → incident packet
+    complete (exemplar harvest + live profile capture + throttled
+    flight-recorder dump), measured through a REAL watchdog firing on
+    a synthetic latency excursion while a slow flip loop keeps real
+    work on a live thread for the profiler to catch."""
+    from tpu_cc_manager.device.gate import DeviceGate
+    from tpu_cc_manager.device.holders import HolderCheck
+    from tpu_cc_manager.engine import ModeEngine
+    from tpu_cc_manager.flightrec import FlightRecorder
+    from tpu_cc_manager.obs import Metrics
+    from tpu_cc_manager.profiler import SamplingProfiler
+    from tpu_cc_manager.trace import Tracer
+    from tpu_cc_manager.tsring import snapshot_metric_set
+    from tpu_cc_manager.watchdog import Watchdog
+
+    def make_engine(**chip_kwargs):
+        return ModeEngine(
+            set_state_label=lambda v: None,
+            evict_components=False,
+            backend=fake_backend(n_chips=2, **chip_kwargs),
+            tracer=Tracer(),
+            gate=DeviceGate(enabled=False),
+            holder_check=HolderCheck(enabled=False),
+        )
+
+    def flip_loop(rounds):
+        engine = make_engine()
+        mode = "on"
+        t0 = time.monotonic()
+        for _ in range(rounds):
+            if not engine.set_mode(mode):
+                print("FATAL: incident bench flip failed",
+                      file=sys.stderr)
+                sys.exit(1)
+            mode = "off" if mode == "on" else "on"
+        return time.monotonic() - t0
+
+    # ---- profiler_overhead_pct: interleaved disarmed/armed runs,
+    # min-based estimator (scheduler noise on the shared sandbox
+    # swings single runs more than the real sampling cost)
+    profiler = SamplingProfiler(name="bench")
+    flip_loop(8)  # warm the engine/gate code paths out of the timing
+    base_runs, armed_runs = [], []
+    for _ in range(4):
+        base_runs.append(flip_loop(flip_rounds))
+        profiler.reset()
+        profiler.arm()
+        try:
+            armed_runs.append(flip_loop(flip_rounds))
+        finally:
+            profiler.disarm()
+    overhead_pct = round(max(
+        0.0,
+        (min(armed_runs) - min(base_runs)) / min(base_runs) * 100.0,
+    ), 2)
+
+    # ---- incident_capture_s: a real watchdog firing on a synthetic
+    # excursion, with real work live for the capture burst
+    metrics = Metrics()
+    profiler.reset()
+    rec = FlightRecorder(
+        name="bench-incident", dump_dir=dump_dir,
+        min_dump_interval_s=0.0, profiler=profiler,
+    )
+    watchdog = Watchdog(
+        sources=[metrics], profiler=profiler, recorder=rec,
+        name="bench",
+    )
+    samples = []
+    t = time.time()
+    for i in range(6):
+        metrics.reconcile_duration.observe(0.02, trace_id=f"bench{i}")
+        samples.append((t + i, snapshot_metric_set(metrics)))
+        if watchdog.consume(samples):
+            print("FATAL: incident bench watchdog fired on baseline",
+                  file=sys.stderr)
+            sys.exit(1)
+    stop = threading.Event()
+
+    def slow_flips():
+        engine = make_engine(reset_latency_s=0.05)
+        mode = "on"
+        while not stop.is_set():
+            engine.set_mode(mode)
+            mode = "off" if mode == "on" else "on"
+
+    worker = threading.Thread(target=slow_flips, daemon=True)
+    worker.start()
+    try:
+        metrics.reconcile_duration.observe(1.2, trace_id="bench-slow")
+        samples.append((t + 7, snapshot_metric_set(metrics)))
+        fired = watchdog.consume(samples)
+    finally:
+        stop.set()
+        worker.join(timeout=5)
+    if not fired:
+        print("FATAL: incident bench anomaly did not fire",
+              file=sys.stderr)
+        sys.exit(1)
+    packet = fired[0]
+    if not any(e.get("trace_id") == "bench-slow"
+               for e in packet.get("exemplars") or []):
+        print("FATAL: incident packet lost the anomalous exemplar",
+              file=sys.stderr)
+        sys.exit(1)
+    profile = packet.get("profile") or {}
+    return {
+        "profiler_overhead_pct": overhead_pct,
+        "incident_capture_s": packet["capture_s"],
+        "incident_autopsy": {
+            "overhead_base_runs_s": [round(v, 4) for v in base_runs],
+            "overhead_armed_runs_s": [round(v, 4) for v in armed_runs],
+            "flip_rounds": flip_rounds,
+            "profiler_hz": profiler.hz,
+            "profile_samples": profile.get("samples"),
+            "profile_top_phase": (
+                (profile.get("phase_totals") or [[None]])[0][0]
+            ),
+            "exemplars": len(packet.get("exemplars") or []),
+            "flightrec_dumped": bool(packet.get("flightrec_dump")),
+        },
+    }
+
+
 def run_simlab_bench():
     """Fleet-scale LIVE-agent scenario (round 6, VERDICT r5 weak #4):
     256 reconciling replicas + fleet/policy controllers + scripted
@@ -1413,6 +1548,10 @@ def main():
         # pipelined window advancement — rollout_advance_p50_s joins
         # the gated axes and the judge's steady-state node reads pin 0
         result["extras"].update(run_rollout_bench())
+        # the incident autopsy pipeline (ISSUE 15): the armed
+        # profiler's flip-loop overhead (ceiling 5%) and the anomaly
+        # fire -> packet-complete latency join the gated axes
+        result["extras"].update(run_incident_bench(f"{d}/incident"))
     print(json.dumps(result))
 
 

@@ -45,6 +45,8 @@ AXIS_SOURCES = {
     "planner_tick_100k_s": (),
     "flip_write_rtt_p50_s": ("kube_io", "phase_p50_s"),
     "rollout_advance_p50_s": ("rollout_reactive",),
+    "profiler_overhead_pct": ("incident_autopsy",),
+    "incident_capture_s": ("incident_autopsy",),
     "p50": ("phase_p50_s",),
 }
 

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Incident-autopsy smoke (ISSUE 15, the incident-smoke CI job):
+prove the metrics → anomaly → exemplar → profile → dump chain end to
+end on live replicas, both directions —
+
+1. ``scenarios/incident-latency-64.json`` (a scripted flip_latency
+   fault injecting 0.4 s of device-reset latency mid-timeline) must
+   FIRE the watchdog: ≥1 incident packet whose exemplar trace id
+   resolves in the fleet-wide stitched timeline ACROSS processes
+   (driver desired-write ↔ replica reconcile), and whose live-captured
+   profile names the injected-latency phase (``reset``) as the hottest
+   span-tagged phase.
+2. ``scenarios/incident-clean-64.json`` (the same shape, no fault)
+   must fire NOTHING — zero incidents — while the per-replica
+   expositions (now carrying exemplar suffixes) and the merged fleet
+   aggregation both stay valid.
+
+An autopsy layer that can't demonstrate both halves is worse than
+none — blind on real anomalies or crying on clean runs. Exit 0 only
+when both hold.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# responsive scrape cadence for the short smoke scenarios (the lab
+# default is 1 s; the baseline + anomaly windows are a few seconds)
+os.environ.setdefault("TPU_CC_FLEETOBS_INTERVAL_S", "0.25")
+
+from tpu_cc_manager.obs import validate_exposition  # noqa: E402
+from tpu_cc_manager.simlab.runner import SimLab  # noqa: E402
+from tpu_cc_manager.simlab.scenario import load_scenario  # noqa: E402
+
+SCENARIO_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scenarios")
+
+checks = []
+
+
+def check(name, ok, detail=""):
+    checks.append(ok)
+    print(f"{'PASS' if ok else 'FAIL'} {name}"
+          + (f": {detail}" if detail else ""))
+
+
+def run(scenario):
+    lab = SimLab(load_scenario(os.path.join(SCENARIO_DIR, scenario)))
+    art = lab.run()
+    return lab, art
+
+
+def main():
+    # ---- the anomaly half
+    lab, art = run("incident-latency-64.json")
+    check("latency scenario converged", art["ok"], art.get("notes") or "")
+    inc = art["metrics"].get("incidents") or {}
+    packets = inc.get("packets") or []
+    check("watchdog fired >=1 incident", inc.get("count", 0) >= 1,
+          json.dumps(inc.get("count")))
+    if packets:
+        p = packets[0]
+        check(
+            "packet carries the anomalous series + window stats",
+            bool(p.get("series", {}).get("metric"))
+            and isinstance(p.get("window"), dict)
+            and isinstance(p.get("baseline"), dict),
+            json.dumps(p.get("series")),
+        )
+        check("exemplar trace id resolves in the stitched timeline",
+              bool(p.get("resolved_trace_ids")),
+              json.dumps(p.get("exemplars"))[:200])
+        check("exemplar trace stitches ACROSS processes",
+              bool(p.get("cross_process_trace_ids")),
+              json.dumps(p.get("resolved_trace_ids")))
+        prof = p.get("profile") or {}
+        phases = [ph for ph, _n in (prof.get("phase_totals") or [])]
+        check(
+            "profile names the injected-latency phase (reset hottest)",
+            bool(phases) and phases[0] == "reset",
+            json.dumps(prof.get("phase_totals"))[:160],
+        )
+        check("profile actually sampled", (prof.get("samples") or 0) > 0)
+        check("incident capture completed in bounded time",
+              0 <= (p.get("capture_s") or -1) <= 5.0,
+              str(p.get("capture_s")))
+    events = [e for e in lab.obs_rec.snapshot()["events"]
+              if e["kind"] == "incident"]
+    check("incident event landed in the flight recorder", bool(events))
+    slo = art["metrics"].get("slo") or {}
+    check("merged aggregation stayed valid under the anomaly",
+          not slo.get("aggregation_problems"),
+          str(slo.get("aggregation_problems"))[:160])
+
+    # ---- the quiet half
+    lab, art = run("incident-clean-64.json")
+    check("clean scenario converged", art["ok"], art.get("notes") or "")
+    inc = art["metrics"].get("incidents") or {}
+    check("clean run fired ZERO incidents", inc.get("count", 0) == 0,
+          json.dumps(inc)[:200])
+    slo = art["metrics"].get("slo") or {}
+    check("clean aggregation valid", not slo.get("aggregation_problems"))
+    # the per-replica expositions now carry exemplar suffixes — every
+    # one must still parse under the strict validator
+    bad = 0
+    for r in lab.replicas.values():
+        if validate_exposition(r.metrics.render()):
+            bad += 1
+    check("all per-replica expositions (with exemplars) valid",
+          bad == 0, f"{bad} invalid")
+
+    print(f"\nincident-smoke: {sum(checks)}/{len(checks)} checks passed")
+    return 0 if all(checks) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

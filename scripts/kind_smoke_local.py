@@ -256,6 +256,37 @@ def main():
                 else:
                     failures.append(
                         f"timeseries route shape: {sorted(ts)[:8]}")
+                # 2d-bis. the ?metric= family filter and the anomaly
+                # watchdog's incident route (ISSUE 15)
+                tsf = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/timeseries"
+                    "?metric=tpu_cc_reconciles_total",
+                    timeout=5,
+                ).read())
+                filtered_fams = set(
+                    (tsf.get("derived") or {}).get("counters") or {}
+                ) | set((tsf.get("derived") or {}).get("histograms")
+                        or {})
+                if (tsf.get("metric_prefix")
+                        == "tpu_cc_reconciles_total"
+                        and filtered_fams
+                        <= {"tpu_cc_reconciles_total"}):
+                    log("PASS /debug/timeseries?metric= narrows to "
+                        "the requested family")
+                else:
+                    failures.append(
+                        f"timeseries filter: {sorted(filtered_fams)[:4]}")
+                inc = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/incidents",
+                    timeout=5,
+                ).read())
+                if (inc.get("watchdog_version") == 1
+                        and "incidents" in inc and "series" in inc):
+                    log("PASS /debug/incidents serves the anomaly "
+                        "watchdog surface")
+                else:
+                    failures.append(
+                        f"incidents route shape: {sorted(inc)[:8]}")
                 # 2e. the fleet observatory over HTTP (fleetobs.py,
                 # ISSUE 9): scrape the agent's live /metrics as a real
                 # HTTP target, merge (fleet of one), and re-validate

@@ -632,6 +632,56 @@ def test_metric_pragma_suppresses():
     ) == []
 
 
+# ------------------------------------- metric-name: watchdog series
+# (ISSUE 15: every WatchSeries metric must be a declared family — an
+# anomaly detector over a metric nobody renders can never fire)
+
+
+def test_watchdog_series_over_declared_metric_passes():
+    assert run(
+        """
+        from tpu_cc_manager.obs import Histogram
+        from tpu_cc_manager.watchdog import WatchSeries
+        h = Histogram("tpu_cc_lat_seconds", "latency")
+        SERIES = (WatchSeries("tpu_cc_lat_seconds", "p99"),)
+        """
+    ) == []
+
+
+def test_watchdog_series_unknown_metric_flagged():
+    (f,) = run(
+        """
+        from tpu_cc_manager.watchdog import WatchSeries
+        SERIES = (WatchSeries("tpu_cc_nope_seconds", "p99"),)
+        """
+    )
+    assert f.rule == "metric-name"
+    assert "watchdog series" in f.message
+    assert "can never fire" in f.message
+
+
+def test_watchdog_series_non_prefixed_typo_flagged():
+    # the generic literal pass only sees tpu_cc_* strings; the
+    # watchdog check must catch a typo OUTSIDE the prefix too
+    (f,) = run(
+        """
+        from tpu_cc_manager.watchdog import WatchSeries
+        SERIES = (WatchSeries(metric="node_flips"),)
+        """
+    )
+    assert f.rule == "metric-name"
+    assert "watchdog series" in f.message
+
+
+def test_watchdog_series_pragma_suppresses():
+    assert run(
+        """
+        from tpu_cc_manager.watchdog import WatchSeries
+        SERIES = (WatchSeries("node_cpu_seconds"),)  # ccaudit: allow-metric-name(kubelet-scraped family)
+        """
+    ) == []
+
+
 # ------------------------------------------------------ baseline ratchet
 
 

@@ -1,0 +1,174 @@
+"""Trace exemplars (ISSUE 15): the histogram-side contract.
+
+Three surfaces under test: the Histogram/HistogramVec exemplar
+retention + OpenMetrics-style render, the validate_exposition exemplar
+GRAMMAR (well-formed bucket-line exemplars accepted; everything
+else — non-bucket lines, unescaped quotes, empty-bucket exemplars,
+values above the bucket bound — rejected), and the fleet-observatory
+merge policy (exemplars are STRIPPED deterministically: the merged
+exposition never carries them, pinned here)."""
+
+import pytest
+
+from tpu_cc_manager.fleetobs import (
+    merge_snapshots, parse_exposition, render_snapshot,
+)
+from tpu_cc_manager.obs import (
+    Histogram, HistogramVec, Metrics, split_exemplar,
+    validate_exposition,
+)
+
+
+def _hist(observations):
+    h = Histogram("tpu_cc_lat_seconds", "latency", buckets=(0.1, 1))
+    for value, tid in observations:
+        h.observe(value, trace_id=tid)
+    return h
+
+
+# ----------------------------------------------------------- rendering
+
+
+def test_histogram_retains_last_exemplar_per_bucket():
+    h = _hist([(0.05, "a1"), (0.07, "a2"), (0.5, "b1"), (5.0, "c1")])
+    exs = h.exemplars()
+    assert [(e["le"], e["trace_id"]) for e in exs] == [
+        ("0.1", "a2"),  # newest wins within the bucket
+        ("1", "b1"),
+        ("+Inf", "c1"),
+    ]
+    assert exs[0]["value"] == 0.07
+
+
+def test_render_carries_openmetrics_style_suffix():
+    h = _hist([(0.05, "abc")])
+    lines = h.render()
+    bucket = [l for l in lines if 'le="0.1"' in l][0]
+    assert ' # {trace_id="abc"} 0.05 ' in bucket
+    # untraced observations render no suffix
+    h2 = _hist([(0.05, None)])
+    assert all(" # " not in l for l in h2.render())
+    # the exemplar-carrying exposition is VALID under the strict
+    # validator (the whole point of teaching it the grammar)
+    assert validate_exposition("\n".join(lines) + "\n") == []
+
+
+def test_vec_exemplars_pass_through():
+    vec = HistogramVec("tpu_cc_phase_seconds", "p", "phase",
+                       buckets=(0.1, 1))
+    vec.observe("reset", 0.4, trace_id="t1")
+    vec.observe("stage", 0.05)
+    exs = vec.exemplars()
+    assert list(exs) == ["reset"]  # untraced child carries none
+    assert exs["reset"][0]["trace_id"] == "t1"
+    text = "\n".join(vec.render()) + "\n"
+    assert '# {trace_id="t1"}' in text
+    assert validate_exposition(text) == []
+
+
+def test_metrics_set_with_exemplars_validates():
+    m = Metrics()
+    m.reconcile_duration.observe(0.3, trace_id="deadbeef1")
+    m.phase_duration.observe("reset", 0.2, trace_id="deadbeef2")
+    assert validate_exposition(m.render()) == []
+
+
+# ------------------------------------------------------------- grammar
+
+
+HEAD = "# HELP x h\n# TYPE x histogram\n"
+
+
+def _problems(body):
+    return validate_exposition(HEAD + body)
+
+
+def test_wellformed_exemplar_accepted():
+    assert _problems(
+        'x_bucket{le="1"} 2 # {trace_id="ab12"} 0.5 1700000000.123\n'
+        'x_bucket{le="+Inf"} 2\nx_sum 1.0\nx_count 2\n'
+    ) == []
+
+
+def test_exemplar_timestamp_optional():
+    assert _problems(
+        'x_bucket{le="1"} 1 # {trace_id="ab12"} 0.5\n'
+        'x_bucket{le="+Inf"} 1\nx_sum 0.5\nx_count 1\n'
+    ) == []
+
+
+def test_exemplar_on_non_bucket_line_rejected():
+    probs = _problems(
+        'x_bucket{le="1"} 1\nx_bucket{le="+Inf"} 1\n'
+        'x_sum 0.5 # {trace_id="ab"} 0.5 1.0\nx_count 1\n'
+    )
+    assert any("non-bucket" in p for p in probs)
+
+
+def test_exemplar_unescaped_quote_rejected():
+    probs = _problems(
+        'x_bucket{le="1"} 1 # {trace_id="a"b"} 0.5 1.0\n'
+        'x_bucket{le="+Inf"} 1\nx_sum 0.5\nx_count 1\n'
+    )
+    assert any("exemplar" in p and "malformed" in p for p in probs)
+
+
+def test_exemplar_on_empty_bucket_rejected():
+    # an exemplar claims an observation; a zero cumulative count says
+    # there never was one — the "disagrees with no observation" case
+    probs = _problems(
+        'x_bucket{le="1"} 0 # {trace_id="ab"} 0.5 1.0\n'
+        'x_bucket{le="+Inf"} 0\nx_sum 0\nx_count 0\n'
+    )
+    assert any("empty bucket" in p for p in probs)
+
+
+def test_exemplar_value_above_bucket_bound_rejected():
+    probs = _problems(
+        'x_bucket{le="1"} 1 # {trace_id="ab"} 4.2 1.0\n'
+        'x_bucket{le="+Inf"} 1\nx_sum 0.5\nx_count 1\n'
+    )
+    assert any("above its bucket bound" in p for p in probs)
+
+
+@pytest.mark.parametrize("suffix", [
+    ' # {trace_id="ab"} notanumber 1.0',
+    ' # {trace_id="ab"} 0.5 notatime',
+])
+def test_exemplar_non_numeric_fields_rejected(suffix):
+    probs = _problems(
+        f'x_bucket{{le="1"}} 1{suffix}\n'
+        'x_bucket{le="+Inf"} 1\nx_sum 0.5\nx_count 1\n'
+    )
+    assert any("non-numeric exemplar" in p for p in probs)
+
+
+def test_split_exemplar_no_suffix_roundtrip():
+    line = 'x_bucket{le="1"} 3'
+    assert split_exemplar(line) == (line, None)
+
+
+# ------------------------------------------------- fleetobs merge policy
+
+
+def test_merge_strips_exemplars_deterministically():
+    """The pinned policy (ISSUE 15 satellite): the fleet merge STRIPS
+    exemplars — parse drops them, so the merged render can never emit
+    one, while bucket counts survive the strip intact."""
+    m1, m2 = Metrics(), Metrics()
+    m1.reconcile_duration.observe(0.3, trace_id="replica-one")
+    m2.reconcile_duration.observe(0.4, trace_id="replica-two")
+    snaps = []
+    for m in (m1, m2):
+        text = m.render()
+        assert "trace_id=" in text  # the inputs DO carry exemplars
+        snap, _helps = parse_exposition(text)
+        snaps.append(snap)
+    merged = merge_snapshots(snaps)
+    out = render_snapshot(merged)
+    assert "trace_id=" not in out
+    assert " # " not in out
+    assert validate_exposition(out) == []
+    # the strip lost no accounting: both observations merged
+    hist = merged["tpu_cc_reconcile_duration_seconds"]["hist"][""]
+    assert hist["count"] == 2

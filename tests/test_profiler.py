@@ -1,0 +1,121 @@
+"""The sampling profiler (tpu_cc_manager/profiler.py, ISSUE 15):
+span-keyed wall-clock stacks, bounded aggregation, arm/disarm."""
+
+import threading
+import time
+
+from tpu_cc_manager.profiler import SamplingProfiler
+from tpu_cc_manager.trace import Tracer, span_on_thread
+
+
+class _Busy:
+    """A worker parked inside a named span until released."""
+
+    def __init__(self, phase="reset"):
+        self.stop = threading.Event()
+        self.started = threading.Event()
+        self.phase = phase
+        self.tracer = Tracer()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self.tracer.span(self.phase):
+            self.started.set()
+            while not self.stop.is_set():
+                time.sleep(0.002)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.started.wait(5)
+        return self
+
+    def __exit__(self, *exc):
+        self.stop.set()
+        self.thread.join(timeout=5)
+
+
+def test_sample_keys_stack_to_active_span():
+    with _Busy("reset") as busy:
+        assert span_on_thread(busy.thread.ident).name == "reset"
+        p = SamplingProfiler(hz=200, name="t")
+        for _ in range(5):
+            p.sample_once()
+        folded = p.folded()
+        assert any(line.startswith("reset;") for line in folded), folded
+        # folded format: phase;root;...;leaf count
+        line = [l for l in folded if l.startswith("reset;")][0]
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) >= 1
+        assert "_run" in stack
+    assert span_on_thread(busy.thread.ident) is None  # span closed
+
+
+def test_phase_totals_exclude_untraced_threads():
+    with _Busy("verify"):
+        p = SamplingProfiler(hz=200)
+        for _ in range(4):
+            p.sample_once()
+    totals = dict(p.phase_totals())
+    assert "verify" in totals
+    assert "-" not in totals
+    # but untraced samples still count toward the total accounting
+    assert p.summary()["samples"] >= totals["verify"]
+
+
+def test_capture_is_synchronous_and_bounded():
+    p = SamplingProfiler(hz=100)
+    with _Busy("reset"):
+        t0 = time.monotonic()
+        s = p.capture(0.1)
+        elapsed = time.monotonic() - t0
+    assert 0.05 <= elapsed <= 2.0
+    assert s["samples"] >= 1
+    assert s["ticks"] >= 1
+    assert isinstance(s["folded"], list)
+    assert isinstance(s["phase_totals"], list)
+
+
+def test_arm_disarm_lifecycle():
+    p = SamplingProfiler(hz=100)
+    with _Busy("reset"):
+        assert not p.armed
+        p.arm()
+        assert p.armed
+        p.arm()  # idempotent
+        deadline = time.monotonic() + 5
+        while p.samples_total == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        p.disarm()
+    assert not p.armed
+    assert p.samples_total > 0
+    n = p.ticks_total
+    time.sleep(0.05)
+    assert p.ticks_total == n  # actually stopped
+
+
+def test_arm_with_duration_self_disarms():
+    p = SamplingProfiler(hz=200)
+    p.arm(duration_s=0.05)
+    deadline = time.monotonic() + 5
+    while p.armed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not p.armed
+
+
+def test_stack_table_is_bounded():
+    p = SamplingProfiler(hz=100, max_stacks=1)
+    with _Busy("reset"), _Busy("verify"):
+        for _ in range(4):
+            p.sample_once()
+    assert p.summary()["distinct_stacks"] == 1
+    assert p.overflow_dropped > 0
+
+
+def test_reset_clears_aggregate():
+    p = SamplingProfiler(hz=100)
+    with _Busy("reset"):
+        p.sample_once()
+    assert p.samples_total > 0
+    p.reset()
+    s = p.summary()
+    assert s["samples"] == 0 and s["folded"] == []

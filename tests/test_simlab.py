@@ -188,6 +188,36 @@ def test_named_lifecycle_scenarios_exercise_their_families():
                and a.params["fault"] == "evacuation_drain")
 
 
+def test_incident_scenarios_are_a_matched_pair():
+    """ISSUE 15: the incident-smoke scenarios must stay a true A/B —
+    the latency one injects flip_latency AFTER enough baseline waves
+    for the watchdog's min_windows, and the clean twin is the same
+    timeline minus the fault (so a firing there is a watchdog bug,
+    never a shape difference)."""
+    lat = load_scenario(
+        os.path.join(SCENARIO_DIR, "incident-latency-64.json"))
+    clean = load_scenario(
+        os.path.join(SCENARIO_DIR, "incident-clean-64.json"))
+    faults = [a for a in lat.actions if a.kind == "fault"]
+    assert [a.params["fault"] for a in faults] == ["flip_latency"]
+    fault_at = faults[0].at
+    # >= 4 baseline set_mode waves strictly before the fault (the
+    # watchdog's default min_windows)
+    baseline_waves = [a for a in lat.actions
+                     if a.kind == "set_mode" and a.at < fault_at]
+    assert len(baseline_waves) >= 4
+    # one anomalous wave after the fault, toward the converge mode
+    after = [a for a in lat.actions
+             if a.kind == "set_mode" and a.at > fault_at]
+    assert after and after[-1].params["mode"] == lat.converge.mode
+    # the clean twin: identical shape, no fault action
+    assert all(a.kind != "fault" for a in clean.actions)
+    assert clean.nodes == lat.nodes
+    assert [(a.at, a.params.get("mode")) for a in clean.actions] == [
+        (a.at, a.params.get("mode")) for a in lat.actions
+        if a.kind != "fault"]
+
+
 # ---------------------------------------------------- fault injector race
 def test_fault_injector_cancel_vs_inflight_timer():
     """ISSUE 12 satellite: a timer callback that fires AFTER cancel()

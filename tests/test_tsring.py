@@ -154,6 +154,72 @@ def test_ring_tick_and_doc():
     assert "points" not in ring.to_doc(include_points=False)
 
 
+def test_ring_metric_prefix_filter():
+    """?metric=<prefix> (ISSUE 15 satellite): the filtered doc carries
+    only matching families — in derived AND points — while the full
+    route stays byte-compatible with the historical shape."""
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=10.0, name="t")
+    m.reconciles_total.inc("success")
+    m.reconcile_duration.observe(0.05)
+    ring.tick(now=100.0)
+    ring.tick(now=130.0)
+    doc = ring.to_doc(metric_prefix="tpu_cc_reconcile_duration")
+    assert doc["metric_prefix"] == "tpu_cc_reconcile_duration"
+    assert list(doc["derived"]["histograms"]) == [
+        "tpu_cc_reconcile_duration_seconds"]
+    assert doc["derived"]["counters"] == {}
+    assert list(doc["points"]) == ["tpu_cc_reconcile_duration_seconds"]
+    # no match -> empty families, not an error
+    empty = ring.to_doc(metric_prefix="tpu_cc_nope")
+    assert empty["derived"]["counters"] == {}
+    assert empty["derived"]["histograms"] == {}
+    # the unfiltered doc is unchanged by the feature
+    full = ring.to_doc()
+    assert "metric_prefix" not in full
+    assert "tpu_cc_reconciles_total" in full["derived"]["counters"]
+
+
+def test_health_server_timeseries_metric_query():
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=10.0, name="agent")
+    m.reconciles_total.inc("success")
+    m.reconcile_duration.observe(0.05)
+    ring.tick(now=1.0)
+    ring.tick(now=11.0)
+    srv = HealthServer(m, port=0, tsring=ring).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/debug/timeseries"
+        with urllib.request.urlopen(
+            base + "?metric=tpu_cc_reconciles_total", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert list(doc["derived"]["counters"]) == [
+            "tpu_cc_reconciles_total"]
+        assert doc["derived"]["histograms"] == {}
+        # the unfiltered route still serves everything
+        with urllib.request.urlopen(base, timeout=5) as r:
+            full = json.loads(r.read())
+        assert "tpu_cc_reconcile_duration_seconds" in (
+            full["derived"]["histograms"])
+    finally:
+        srv.stop()
+
+
+def test_ring_listener_sees_every_tick():
+    m = Metrics()
+    ring = TimeSeriesRing(m, interval_s=10.0, name="t")
+    seen = []
+    ring.add_listener(lambda samples: seen.append(len(samples)))
+    ring.tick(now=1.0)
+    ring.tick(now=2.0)
+    assert seen == [1, 2]
+    # a broken listener costs itself, never the sampler
+    ring.add_listener(lambda samples: 1 / 0)
+    assert ring.tick(now=3.0) is not None
+    assert seen == [1, 2, 3]
+
+
 def test_ring_tick_never_raises():
     ring = TimeSeriesRing(lambda: 1 / 0, name="broken")
     assert ring.tick() is None

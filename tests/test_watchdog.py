@@ -1,0 +1,271 @@
+"""The online anomaly watchdog (tpu_cc_manager/watchdog.py, ISSUE 15):
+robust-z firing, cold-ring/restart hygiene, incident packet assembly."""
+
+import math
+
+from tpu_cc_manager.flightrec import FlightRecorder
+from tpu_cc_manager.obs import Metrics
+from tpu_cc_manager.profiler import SamplingProfiler
+from tpu_cc_manager.tsring import snapshot_metric_set
+from tpu_cc_manager.watchdog import (
+    DEFAULT_SERIES, WatchSeries, Watchdog,
+)
+
+
+def _latency_samples(metrics, values, start=1000.0, traced=True):
+    """One sample per observation — each window holds one value."""
+    samples = []
+    t = start
+    for i, v in enumerate(values):
+        metrics.reconcile_duration.observe(
+            v, trace_id=(f"tid{i}" if traced else None))
+        samples.append((t, snapshot_metric_set(metrics)))
+        t += 1.0
+    return samples
+
+
+def _feed(wd, samples):
+    fired = []
+    for i in range(1, len(samples) + 1):
+        fired.extend(wd.consume(samples[:i]))
+    return fired
+
+
+# ------------------------------------------------------------- firing
+
+
+def test_latency_excursion_fires_once():
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t")
+    samples = _latency_samples(m, [0.02] * 6 + [0.8])
+    fired = _feed(wd, samples)
+    assert len(fired) == 1
+    p = fired[0]
+    assert p["incident_version"] == 1
+    assert p["series"]["metric"] == "tpu_cc_reconcile_duration_seconds"
+    assert p["series"]["stat"] == "p99"
+    assert p["value"] > p["baseline"]["ewma"]
+    assert p["z"] >= wd.z_threshold
+    assert p["baseline"]["windows"] >= wd.min_windows
+    assert isinstance(p["window"], dict) and "window_count" in p["window"]
+    assert p["capture_s"] >= 0
+    assert wd.incidents_total == 1
+
+
+def test_exemplars_harvested_from_sources():
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t")
+    samples = _latency_samples(m, [0.02] * 6 + [0.8])
+    (p,) = _feed(wd, samples)
+    tids = [e["trace_id"] for e in p["exemplars"]]
+    assert "tid6" in tids  # the anomalous observation's trace id
+    assert len(tids) <= Watchdog.MAX_EXEMPLARS
+
+
+def test_profile_and_flightrec_ride_the_packet():
+    m = Metrics()
+    rec = FlightRecorder(name="t")
+    wd = Watchdog(
+        sources=[m], profiler=SamplingProfiler(hz=200),
+        recorder=rec, capture_s=0.02, name="t",
+    )
+    samples = _latency_samples(m, [0.02] * 6 + [0.8])
+    (p,) = _feed(wd, samples)
+    assert (p["profile"]["ticks"] or 0) >= 1
+    # no dump dir configured -> honest None, but the event landed
+    assert p["flightrec_dump"] is None
+    events = [e for e in rec.snapshot()["events"]
+              if e["kind"] == "incident"]
+    assert events and events[0]["metric"] == (
+        "tpu_cc_reconcile_duration_seconds")
+
+
+def test_incident_dump_written_when_dir_configured(tmp_path):
+    m = Metrics()
+    rec = FlightRecorder(name="t", dump_dir=str(tmp_path),
+                         min_dump_interval_s=0.0)
+    wd = Watchdog(sources=[m], recorder=rec, name="t")
+    samples = _latency_samples(m, [0.02] * 6 + [0.8])
+    (p,) = _feed(wd, samples)
+    assert p["flightrec_dump"] and "incident" in p["flightrec_dump"]
+
+
+def test_cooldown_throttles_refires():
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t", cooldown_s=3600.0)
+    samples = _latency_samples(m, [0.02] * 6 + [0.9, 0.9, 0.9])
+    fired = _feed(wd, samples)
+    assert len(fired) == 1  # the repeats landed inside the cooldown
+
+
+def test_one_sided_a_latency_drop_never_fires():
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t")
+    # high stable baseline, then a dramatic IMPROVEMENT
+    samples = _latency_samples(m, [2.0] * 6 + [0.005])
+    assert _feed(wd, samples) == []
+
+
+# ------------------------------------------------------ firing hygiene
+
+
+def test_cold_ring_stays_silent():
+    """Fewer than min_windows baseline windows -> silence, whatever
+    the values look like (ISSUE 15 satellite)."""
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t", min_windows=4)
+    # an immediate excursion with only 2 prior windows
+    samples = _latency_samples(m, [0.02, 0.02, 5.0])
+    assert _feed(wd, samples) == []
+    assert wd.incidents_total == 0
+
+
+def test_counter_restart_cannot_fire(monkeypatch):
+    """A process restart mid-window resets cumulative counters; the
+    window delta clamps to 0 (tsring.counter_delta), so the rate
+    series reads 0/min — never a negative, never a spike, NEVER an
+    incident on its own (ISSUE 15 satellite)."""
+    wd = Watchdog(
+        series=(WatchSeries("tpu_cc_publish_retries_total", "rate",
+                            min_scale=30.0),),
+        name="t",
+    )
+    fam = lambda total: {  # noqa: E731
+        "tpu_cc_publish_retries_total": {
+            "type": "counter", "series": {"": float(total)},
+        },
+    }
+    samples = [(float(t), fam(t * 5)) for t in range(8)]  # 300/min steady
+    assert _feed(wd, samples) == []
+    # restart: the counter falls back to (then climbs from) zero
+    samples.append((8.0, fam(0)))
+    samples.append((9.0, fam(3)))
+    fired = []
+    fired.extend(wd.consume(samples[:9]))
+    fired.extend(wd.consume(samples))
+    assert fired == []
+    assert wd.incidents_total == 0
+
+
+def test_empty_windows_do_not_feed_the_baseline():
+    """Windows with no observations yield p99=None: skipped entirely —
+    they neither advance min_windows nor dilute the EWMA."""
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t")
+    samples = _latency_samples(m, [0.02, 0.02, 0.02])
+    # idle ticks: snapshots advance, the histogram does not
+    t = samples[-1][0]
+    for i in range(5):
+        samples.append((t + 1.0 + i, snapshot_metric_set(m)))
+    for i in range(1, len(samples) + 1):
+        wd.consume(samples[:i])
+    key = ("tpu_cc_reconcile_duration_seconds", "", "p99")
+    # adjacent-sample windows: 3 observation samples -> 2 populated
+    # windows; the 5 idle windows contributed nothing
+    assert wd._state[key].n == 2
+
+
+def test_consume_never_raises():
+    wd = Watchdog(name="t")
+    assert wd.consume([(1.0, {"broken": None})]) == []
+    assert wd.consume([(1.0, {"broken": None}), (2.0, object())]) == []
+
+
+# ------------------------------------------------------------- surfaces
+
+
+def test_route_and_doc_shape():
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="box")
+    samples = _latency_samples(m, [0.02] * 6 + [0.8])
+    _feed(wd, samples)
+    doc = wd.to_doc()
+    assert doc["watchdog_version"] == 1
+    assert doc["name"] == "box"
+    assert doc["incidents_total"] == 1
+    assert len(doc["incidents"]) == 1
+    assert {s["metric"] for s in doc["series"]} == {
+        ws.metric for ws in DEFAULT_SERIES}
+    code, body, ctype = wd.route()
+    assert code == 200 and ctype == "application/json"
+    assert b"incidents" in body
+
+
+def test_health_server_serves_incidents():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from tpu_cc_manager.obs import HealthServer
+
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="agent")
+    srv = HealthServer(m, port=0, watchdog=wd).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/incidents", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["watchdog_version"] == 1
+        assert doc["incidents"] == []
+    finally:
+        srv.stop()
+    srv2 = HealthServer(m, port=0).start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv2.port}/debug/incidents",
+                timeout=5,
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv2.stop()
+
+
+def test_flightrec_embeds_profile_only_when_sampled():
+    import threading
+    import time as _time
+
+    p = SamplingProfiler(hz=200)
+    rec = FlightRecorder(name="t", profiler=p)
+    assert "profile" not in rec.snapshot("t")  # idle profiler: no bloat
+    stop = threading.Event()
+    worker = threading.Thread(
+        target=lambda: stop.wait(5), daemon=True)
+    worker.start()
+    try:
+        deadline = _time.monotonic() + 5
+        while p.samples_total == 0 and _time.monotonic() < deadline:
+            p.sample_once()
+    finally:
+        stop.set()
+        worker.join(timeout=5)
+    snap = rec.snapshot("t")
+    assert snap["profile"]["samples"] >= 1
+    assert "folded" in snap["profile"]
+
+
+def test_robust_scale_floor_blocks_constant_baseline_jitter():
+    """With a near-constant baseline the MAD collapses to ~0; the
+    min_scale floor keeps ordinary jitter from reading as infinite z."""
+    m = Metrics()
+    wd = Watchdog(sources=[m], name="t")
+    # identical windows, then a +20 ms wiggle: real but tiny
+    samples = _latency_samples(m, [0.02] * 8 + [0.04])
+    assert _feed(wd, samples) == []
+
+
+def test_math_stays_finite_on_zero_baseline():
+    wd = Watchdog(
+        series=(WatchSeries("tpu_cc_publish_retries_total", "rate",
+                            min_scale=30.0),),
+        name="t",
+    )
+    fam = {"tpu_cc_publish_retries_total": {
+        "type": "counter", "series": {"": 0.0}}}
+    samples = [(float(t), fam) for t in range(6)]
+    assert _feed(wd, samples) == []
+    state = wd._state[("tpu_cc_publish_retries_total", "", "rate")]
+    assert math.isfinite(state.ewma) and math.isfinite(state.mad)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import queue
 import threading
 import uuid
@@ -39,8 +40,10 @@ from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.modes import STATE_FAILED, InvalidModeError
 from tpu_cc_manager.slice_coord import SliceAbortError
 from tpu_cc_manager.obs import HealthServer, Metrics, create_readiness_file
+from tpu_cc_manager.profiler import SamplingProfiler
 from tpu_cc_manager.trace import JsonlSink, Tracer, get_tracer
 from tpu_cc_manager.tsring import TimeSeriesRing
+from tpu_cc_manager.watchdog import Watchdog
 from tpu_cc_manager.watch import FatalWatchError, NodeWatcher, SyncableModeConfig
 
 log = logging.getLogger("tpu-cc-manager.agent")
@@ -91,14 +94,29 @@ class CCManagerAgent:
         # rates and quantile estimates on /debug/timeseries and inside
         # flight-recorder dumps
         self.tsring = TimeSeriesRing(self.metrics, name=cfg.node_name)
+        # the sampling profiler (profiler.py, ISSUE 15): disarmed and
+        # free until an operator arms it (TPU_CC_PROFILER=1) or the
+        # watchdog auto-arms a capture burst on an anomaly
+        self.profiler = SamplingProfiler(name=cfg.node_name)
         # the per-process black box (flightrec.py, ISSUE 8): recent
         # spans + structured events + host-contention samples, dumped
         # on reconcile failure / SIGTERM / GET /debug/flightrec
         self.flightrec = FlightRecorder(
             name=cfg.node_name, metrics=self.metrics,
             dump_dir=cfg.flightrec_dir, tsring=self.tsring,
+            profiler=self.profiler,
         )
         self.tracer.add_sink(self.flightrec.observe_span)
+        # the online anomaly watchdog (watchdog.py, ISSUE 15): scores
+        # the declared flip/reconcile series on every tsring tick and
+        # assembles an incident packet — window stats + exemplar trace
+        # ids + a live profile + a throttled black-box dump — served
+        # at GET /debug/incidents
+        self.watchdog = Watchdog(
+            sources=[self.metrics], profiler=self.profiler,
+            recorder=self.flightrec, name=cfg.node_name,
+        )
+        self.tsring.add_listener(self.watchdog.consume)
         # modules that can't take an injected recorder (the batcher's
         # publish-loss accounting) note into the process-wide one:
         # point it at this agent's black box
@@ -581,7 +599,11 @@ class CCManagerAgent:
                 self._arm_repair(raw_mode, outcome)
                 self._emit_reconcile_event(raw_mode, outcome, dur)
                 root_span.attrs["outcome"] = outcome
-                self.metrics.reconcile_duration.observe(dur)
+                # the reconcile's trace id rides as the latency
+                # bucket's exemplar (ISSUE 15): a slow bucket on
+                # /metrics points at THIS reconcile's trace
+                self.metrics.reconcile_duration.observe(
+                    dur, trace_id=root_span.trace_id)
                 self.metrics.reconciles_total.inc(outcome)
                 self.reconcile_count += 1
                 log.info("reconcile finished: %s in %.3fs", outcome, dur)
@@ -866,11 +888,16 @@ class CCManagerAgent:
                 self.health = HealthServer(
                     self.metrics, port=cfg.health_port,
                     tracer=self.tracer, flightrec=self.flightrec,
-                    tsring=self.tsring,
+                    tsring=self.tsring, watchdog=self.watchdog,
                 ).start()
             except OSError as e:
                 log.warning("health server disabled: %s", e)
         self.tsring.start()
+        if os.environ.get("TPU_CC_PROFILER", "").lower() in (
+                "1", "true", "yes"):
+            # operator opt-in continuous sampling (the on-demand half
+            # of ISSUE 15; the watchdog's capture bursts need no arm)
+            self.profiler.arm()
 
         try:
             # initial read + reconcile (reference cmd/main.go:131-149,
@@ -948,6 +975,7 @@ class CCManagerAgent:
         if self.slice_coordinator is not None:
             self.slice_coordinator.stop()
         self.tsring.stop()
+        self.profiler.disarm()
         self.watcher.stop()
         # best-effort final flush of deferred publications, then release
         # the engine's persistent flip-executor threads

@@ -279,6 +279,15 @@ class ModuleAudit:
     )
     #: tpu_cc_* string literals used outside a declaration
     metric_uses: List[Tuple[str, str, int, str]] = field(default_factory=list)
+    #: watchdog WatchSeries(metric=...) declarations: (metric, line,
+    #: text) — every watched series must reference a declared metric
+    #: (ISSUE 15: an anomaly detector over a metric nobody renders can
+    #: never fire), checked cross-module like metric_uses but WITHOUT
+    #: the tpu_cc_ prefix gate: a watchdog typo outside the prefix
+    #: must not escape the liveness check
+    watch_series_refs: List[Tuple[str, int, str]] = field(
+        default_factory=list
+    )
     #: labels.py constant references: (constant name, use context) where
     #: context is "read" (.get/subscript/compare), "write" (dict key) or
     #: "other" — raw material for the protocol-liveness pass
@@ -1116,6 +1125,33 @@ class _Walker(ast.NodeVisitor):
                         self.module.line_text(node.lineno),
                     )
                 )
+
+        # watchdog series declarations (ISSUE 15): WatchSeries("...")
+        # or WatchSeries(metric="...") — the referenced family must be
+        # a declared metric (checked in metric_findings). The literal
+        # is exempted from the generic tpu_cc_* use pass so a typo
+        # yields ONE watchdog-flavored finding, not two.
+        if term == "WatchSeries":
+            metric_arg: Optional[ast.expr] = None
+            if node.args:
+                metric_arg = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "metric":
+                        metric_arg = kw.value
+                        break
+            if (
+                isinstance(metric_arg, ast.Constant)
+                and isinstance(metric_arg.value, str)
+            ):
+                self._decl_nodes.add(id(metric_arg))
+                self.audit.watch_series_refs.append(
+                    (
+                        metric_arg.value,
+                        node.lineno,
+                        self.module.line_text(node.lineno),
+                    )
+                )
         self.generic_visit(node)
 
     # ------------------------------------------------- mode exhaustiveness
@@ -1509,6 +1545,25 @@ def metric_findings(audits: Sequence[ModuleAudit]) -> List[Finding]:
                     f"metric name {name!r} matches no "
                     "Counter/Gauge/Histogram/HistogramVec declaration — "
                     "declare it once or fix the typo",
+                )
+
+    # watchdog-declared series (ISSUE 15, the metric-name rule
+    # extended): every WatchSeries metric must be a declared family —
+    # whole-family watch, so no _bucket/_sum/_count leniency, and no
+    # tpu_cc_ prefix gate (a typo outside the prefix must still fail).
+    # Escape hatch: `# ccaudit: allow-metric-name(reason)` for series
+    # aimed at externally-scraped metrics (same pragma the SLO
+    # objective check honors).
+    for a in audits:
+        for name, line, text in a.watch_series_refs:
+            if name not in decls:
+                emit(
+                    "metric-name", a.module.relpath, line, text,
+                    f"watchdog series {name!r} matches no "
+                    "Counter/Gauge/Histogram/HistogramVec declaration "
+                    "— an anomaly detector over a metric nobody "
+                    "renders can never fire; fix the name or pragma "
+                    "an externally-scraped series",
                 )
     return findings
 

@@ -101,6 +101,12 @@ class FakeChip(TpuChip):
             self._staged_cc = self._cc_mode
             self._staged_ici = self._ici_mode
 
+    def set_reset_latency(self, seconds: float) -> None:
+        """Simulated reset wall-clock (simlab's flip_latency fault and
+        the multichip bench): the next reset sleeps this long. A plain
+        attribute write — GIL-atomic, safe to flip mid-run."""
+        self._reset_latency_s = seconds
+
     def reset(self) -> None:
         if self.fail_reset:
             raise DeviceError(f"{self.path}: reset failed (injected)")

@@ -567,8 +567,14 @@ class ModeEngine:
         node-wide action, the holder check's runtime restart hook, is
         serialized-and-deduped inside HolderCheck (device/holders.py),
         so sibling flips never race on mutable state."""
+        # the reconcile span adopted onto this worker thread owns the
+        # flip: its trace id rides both bracket host samples (ISSUE
+        # 15), so an incident reader joins "host was loaded" to THIS
+        # flip's stitched trace instead of eyeballing timestamps
+        parent = self._tracer.current_span()
         with self._flip_recorder().bracket(
-            f"flip:{dev.path}"
+            f"flip:{dev.path}",
+            trace_id=parent.trace_id if parent is not None else None,
         ), self._tracer.span(
             "flip", device=dev.path, changes=dict(changes)
         ) as flip_span:

@@ -378,12 +378,34 @@ class FleetController:
         self._stop = threading.Event()
         #: the controller's own metric history (tsring.py, ISSUE 9)
         self.tsring = TimeSeriesRing(self.metrics, name="fleet")
+        #: the controller-side anomaly watchdog (watchdog.py, ISSUE
+        #: 15): its own scan-path series — a scan-duration excursion
+        #: or an API flow-control stall fires an incident with the
+        #: offending window's stats on /debug/incidents
+        from tpu_cc_manager.watchdog import WatchSeries, Watchdog
+
+        self.watchdog = Watchdog(
+            series=(
+                WatchSeries(
+                    "tpu_cc_fleet_scan_duration_seconds", "p99",
+                    description="fleet scan duration",
+                ),
+                WatchSeries(
+                    "tpu_cc_kube_throttle_wait_seconds", "p99",
+                    min_scale=0.1,
+                    description="API client flow-control waits",
+                ),
+            ),
+            sources=[self.metrics], name="fleet",
+        )
+        self.tsring.add_listener(self.watchdog.consume)
         self._server = RouteServer(port, name="fleet-http")
         self._server.add_route("/healthz", self._healthz)
         self._server.add_route("/readyz", self._readyz)
         self._server.add_route("/metrics", self._metrics_route)
         self._server.add_route("/report", self._report_route)
         self._server.add_route("/debug/timeseries", self._timeseries_route)
+        self._server.add_route("/debug/incidents", self._incidents_route)
         self._server.add_route("/fleet/metrics", self._fleet_metrics_route)
 
     @property
@@ -457,7 +479,13 @@ class FleetController:
                 # gate fails — surface it in the same digest
                 report["problems"].extend(self.observer.problems())
                 report["slo"] = self.observer.status()
-            self.metrics.scan_duration.observe(time.monotonic() - t0)
+            from tpu_cc_manager.trace import current_trace_ids
+
+            # the active trace (if any) rides as the scan-latency
+            # bucket's exemplar (ISSUE 15)
+            self.metrics.scan_duration.observe(
+                time.monotonic() - t0,
+                trace_id=current_trace_ids()[0])
             self.metrics.update(report)
             self.last_report = report
         except Exception:
@@ -540,10 +568,22 @@ class FleetController:
         return 200, b"ok", "text/plain"
 
     def _metrics_route(self):
-        return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
+        # scan-histogram exemplars ride this render: OpenMetrics type
+        # (obs.OPENMETRICS_CONTENT_TYPE rationale); the merged
+        # /fleet/metrics below stays classic — the merge strips
+        # exemplars by policy
+        from tpu_cc_manager.obs import OPENMETRICS_CONTENT_TYPE
 
-    def _timeseries_route(self):
-        return self.tsring.route()
+        return (200, self.metrics.render().encode(),
+                OPENMETRICS_CONTENT_TYPE)
+
+    def _timeseries_route(self, query=None):
+        # ?metric=<prefix> narrows to one family (ISSUE 15 satellite)
+        return self.tsring.route(
+            metric_prefix=(query or {}).get("metric"))
+
+    def _incidents_route(self):
+        return self.watchdog.route()
 
     def _fleet_metrics_route(self):
         """The fleet ROLLUP exposition (fleetobs.py): replica series

@@ -49,7 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from tpu_cc_manager.obs import (
     Counter, Gauge, _LABEL_RE, _SAMPLE_RE, _fmt as _num,
-    validate_exposition,
+    split_exemplar, validate_exposition,
 )
 from tpu_cc_manager.tsring import (
     Sample, Snapshot, _le_value, counter_delta, window_pair,
@@ -82,7 +82,17 @@ def parse_exposition(
     tsring :data:`Snapshot` shape plus the HELP text per family (the
     merged render re-emits it). Histogram families are reassembled
     from their ``_bucket``/``_sum``/``_count`` series keyed by the
-    non-``le`` labelset."""
+    non-``le`` labelset.
+
+    **Exemplars are STRIPPED here, deterministically** (ISSUE 15
+    satellite, the pinned merge policy): a per-replica exemplar names
+    ONE process's trace — summing N replicas' buckets has no honest
+    single exemplar to carry, and forwarding an arbitrary replica's
+    would point a fleet-level bucket at a non-representative trace.
+    The merged ``/fleet/metrics`` therefore never emits exemplar
+    suffixes; per-trace evidence stays on the replica surfaces (their
+    own ``/metrics``) and in the watchdog's incident packets, which
+    harvest exemplars from the live per-replica histograms."""
     snap: Snapshot = {}
     helps: Dict[str, str] = {}
     types: Dict[str, str] = {}
@@ -97,6 +107,7 @@ def parse_exposition(
             continue
         if not line or line.startswith("#"):
             continue
+        line, _exemplar = split_exemplar(line)  # strip: merge policy
         m = _SAMPLE_RE.match(line)
         if m is None:
             continue  # validate_exposition already reported it
@@ -557,6 +568,23 @@ class FleetObserver:
         self.aggregation_problems: List[str] = []
         #: last merged snapshot (for render())
         self._last_merged: Optional[Snapshot] = None
+        #: post-sample listeners (the fleet-level anomaly watchdog,
+        #: ISSUE 15): fn(samples) after every observe() pass — the
+        #: fleet-merged series ride the same window machinery a
+        #: per-process tsring feeds
+        self._listeners: List[Callable[[List[Sample]], Any]] = []
+
+    def add_listener(
+        self, fn: Callable[[List[Sample]], Any],
+    ) -> "FleetObserver":
+        self._listeners.append(fn)
+        return self
+
+    def samples(self) -> List[Sample]:
+        """The retained (ts, merged snapshot) history — tsring sample
+        shape, so window math and the watchdog consume it as-is."""
+        with self._lock:
+            return list(self._samples)
 
     # ------------------------------------------------------------ scraping
     def _fetch(self, source: Source) -> str:
@@ -616,6 +644,11 @@ class FleetObserver:
             self._samples.append((ts, merged))
             samples = list(self._samples)
         self._evaluate(samples, ts)
+        for fn in self._listeners:
+            try:
+                fn(samples)
+            except Exception:  # ccaudit: allow-swallow(a broken listener must cost itself, never the scrape loop; the warning names it)
+                log.warning("fleetobs listener failed", exc_info=True)
         return merged
 
     # ---------------------------------------------------------- SLO engine

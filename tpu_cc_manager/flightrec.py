@@ -102,6 +102,7 @@ class FlightRecorder:
         event_ring: int = EVENT_RING,
         sample_ring: int = SAMPLE_RING,
         tsring: Optional[Any] = None,
+        profiler: Optional[Any] = None,
     ):
         #: identity stamped into every dump (node name for agents,
         #: replica name in simlab)
@@ -114,6 +115,12 @@ class FlightRecorder:
         #: the windowed rate/quantile history LEADING UP TO the crash,
         #: not just the instant of it (points elided — dumps stay small)
         self.tsring = tsring
+        #: optional profiler.SamplingProfiler (ISSUE 15): when it holds
+        #: samples at dump time (armed by an operator or the watchdog),
+        #: the dump embeds the folded-stack summary — the black box
+        #: then says what the interpreter was EXECUTING, not only what
+        #: the process did
+        self.profiler = profiler
         self.dump_dir = dump_dir or os.environ.get(
             "TPU_CC_FLIGHTREC_DIR") or None
         self.min_dump_interval_s = min_dump_interval_s
@@ -143,25 +150,33 @@ class FlightRecorder:
         with self._lock:
             self._events.append(entry)
 
-    def sample(self, tag: str) -> Dict[str, Any]:
-        """Take one host-contention sample, tagged."""
+    def sample(self, tag: str,
+               trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """Take one host-contention sample, tagged. ``trace_id``
+        (ISSUE 15) joins the sample to the trace it brackets, so an
+        incident reader correlates "host was loaded" with "THIS flip
+        was slow" instead of eyeballing timestamps."""
         s = sample_host()
         s["tag"] = tag
+        if trace_id:
+            s["trace"] = trace_id
         with self._lock:
             self._samples.append(s)
         return s
 
     @contextmanager
-    def bracket(self, tag: str) -> Iterator[None]:
+    def bracket(self, tag: str,
+                trace_id: Optional[str] = None) -> Iterator[None]:
         """Host samples BRACKETING a critical section — the engine
         wraps every device flip, so a slow real-chip flip carries the
         host-contention evidence ROADMAP item 1 needs (was the 4.43 s
-        flip the chip, or a noisy neighbor?)."""
-        self.sample(f"{tag}:pre")
+        flip the chip, or a noisy neighbor?). The engine passes the
+        flip's trace id so both samples carry the stitch key."""
+        self.sample(f"{tag}:pre", trace_id=trace_id)
         try:
             yield
         finally:
-            self.sample(f"{tag}:post")
+            self.sample(f"{tag}:post", trace_id=trace_id)
 
     # ------------------------------------------------------------ reading
     def _metrics_snapshot(self) -> Any:
@@ -200,6 +215,16 @@ class FlightRecorder:
                     include_points=False)
             except Exception:  # ccaudit: allow-swallow(black-box contract: a broken time-series ring must cost the dump one section, never the dump itself — the warning names the loss)
                 log.warning("flightrec timeseries embed failed",
+                            exc_info=True)
+        if self.profiler is not None:
+            try:
+                if getattr(self.profiler, "samples_total", 0):
+                    # only when something was actually sampled: an
+                    # idle (never-armed) profiler must not bloat every
+                    # dump with an empty section
+                    doc["profile"] = self.profiler.summary()
+            except Exception:  # ccaudit: allow-swallow(black-box contract: a broken profiler must cost the dump one section, never the dump itself — the warning names the loss)
+                log.warning("flightrec profile embed failed",
                             exc_info=True)
         return doc
 

@@ -30,6 +30,17 @@ from tpu_cc_manager.modes import STATE_FAILED, VALID_MODES
 #: canonical vocabulary so new modes can't drift out of the metrics.
 OBSERVED_MODE_VALUES = VALID_MODES + (STATE_FAILED, "unknown")
 
+#: Content type for exemplar-capable metric surfaces (ISSUE 15):
+#: exemplar suffixes are ILLEGAL in the classic
+#: ``text/plain; version=0.0.4`` exposition — a strict classic parser
+#: fails the whole scrape on the first mid-line ``#`` — so every route
+#: whose render may carry them advertises the OpenMetrics type instead
+#: (scrapers negotiate by content type; OpenMetrics parsers accept the
+#: exemplar syntax natively).
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
 
 class JsonLogFormatter(logging.Formatter):
     """One JSON object per log record, carrying the ACTIVE trace/span
@@ -157,6 +168,14 @@ class Histogram:
     window of the most recent ``WINDOW`` observations — on a long-running
     agent it is "the pXX over the last 10k reconciles", never a mix of
     arbitrary retention epochs.
+
+    **Trace exemplars** (ISSUE 15): ``observe(value, trace_id=...)``
+    retains the LAST exemplified observation per bucket — (trace id,
+    value, unix ts) — and the render appends it to that bucket's series
+    line in OpenMetrics-style ``# {trace_id="..."} value ts`` syntax, so
+    any latency bucket on ``/metrics`` points at one concrete trace a
+    collector (or ``flightrec.stitch_by_trace``) can resolve. Bounded by
+    construction: one exemplar per bucket, newest wins.
     """
 
     DEFAULT_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
@@ -171,8 +190,11 @@ class Histogram:
         self._lock = threading.Lock()
         # exact sliding window for quantile queries (deque drops oldest)
         self._samples = deque(maxlen=self.WINDOW)
+        #: bucket index -> (trace_id, observed value, unix ts); the +Inf
+        #: bucket is index len(self.buckets)
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         with self._lock:
             self._sum += value
             self._total += 1
@@ -180,8 +202,28 @@ class Histogram:
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._counts[i] += 1
+                    if trace_id:
+                        self._exemplars[i] = (trace_id, value, time.time())
                     return
             self._counts[-1] += 1
+            if trace_id:
+                self._exemplars[len(self.buckets)] = (
+                    trace_id, value, time.time()
+                )
+
+    def exemplars(self) -> List[Dict[str, object]]:
+        """The retained per-bucket exemplars, ``le`` order — what the
+        incident packet builder (watchdog.py) harvests when this
+        histogram's windowed stats go anomalous."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        out: List[Dict[str, object]] = []
+        for i, (tid, value, ts) in items:
+            le = ("+Inf" if i == len(self.buckets)
+                  else _fmt(self.buckets[i]))
+            out.append({"le": le, "trace_id": tid,
+                        "value": value, "ts": ts})
+        return out
 
     def quantile(self, q: float) -> Optional[float]:
         """q-quantile over the last ``WINDOW`` observations (exact)."""
@@ -217,7 +259,9 @@ class Histogram:
     def render_series(self, name: str, label_prefix: str = "") -> List[str]:
         """Exposition series lines only (no HELP/TYPE). ``label_prefix`` is
         a ``key="value",``-style fragment prepended inside every brace set
-        (used by HistogramVec for its family label)."""
+        (used by HistogramVec for its family label). Bucket lines carry
+        their retained exemplar as an OpenMetrics-style
+        ``# {trace_id="..."} value ts`` suffix."""
         suffix = "{" + label_prefix.rstrip(",") + "}" if label_prefix else ""
         out = []
         with self._lock:
@@ -226,9 +270,14 @@ class Histogram:
                 cum += self._counts[i]
                 out.append(
                     f'{name}_bucket{{{label_prefix}le="{_fmt(b)}"}} {cum}'
+                    + _render_exemplar(self._exemplars.get(i))
                 )
             cum += self._counts[-1]
-            out.append(f'{name}_bucket{{{label_prefix}le="+Inf"}} {cum}')
+            out.append(
+                f'{name}_bucket{{{label_prefix}le="+Inf"}} {cum}'
+                + _render_exemplar(
+                    self._exemplars.get(len(self.buckets)))
+            )
             out.append(f"{name}_sum{suffix} {_fmt(self._sum)}")
             out.append(f"{name}_count{suffix} {self._total}")
         return out
@@ -304,6 +353,17 @@ def _fmt(v: float) -> str:
     return repr(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+def _render_exemplar(
+    ex: "Optional[Tuple[str, float, float]]",
+) -> str:
+    """One bucket line's exemplar suffix: `` # {trace_id="..."} value
+    ts`` (empty string when the bucket holds none)."""
+    if ex is None:
+        return ""
+    tid, value, ts = ex
+    return f' # {{trace_id="{tid}"}} {_fmt(value)} {ts:.3f}'
+
+
 def _labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
     if not names:
         return ""
@@ -333,8 +393,20 @@ class HistogramVec:
                 )
             return h
 
-    def observe(self, label_value: str, value: float) -> None:
-        self.labels(label_value).observe(value)
+    def observe(self, label_value: str, value: float,
+                trace_id: Optional[str] = None) -> None:
+        self.labels(label_value).observe(value, trace_id=trace_id)
+
+    def exemplars(self) -> Dict[str, List[Dict[str, object]]]:
+        """Per-child exemplars keyed by the family label's value."""
+        with self._lock:
+            children = sorted(self._children.items())
+        out: Dict[str, List[Dict[str, object]]] = {}
+        for label_value, h in children:
+            ex = h.exemplars()
+            if ex:
+                out[label_value] = ex
+        return out
 
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -413,8 +485,11 @@ class Metrics:
         )
 
     def observe_span(self, span) -> None:
-        """Trace sink: fold completed spans into the per-phase histogram."""
-        self.phase_duration.observe(span.name, span.dur_s)
+        """Trace sink: fold completed spans into the per-phase histogram
+        — the span's trace id rides along as the bucket's exemplar, so
+        a slow phase on /metrics names a concrete trace."""
+        self.phase_duration.observe(span.name, span.dur_s,
+                                    trace_id=span.trace_id)
 
     def set_current_mode(self, mode: str) -> None:
         for m in OBSERVED_MODE_VALUES:
@@ -440,6 +515,23 @@ _SAMPLE_RE = re.compile(
 _LABEL_RE = re.compile(
     r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\["\\n])*)"'
 )
+#: OpenMetrics-style exemplar suffix on a series line:
+#: `` # {labels} value [ts]``. Anchored at end of line; anything
+#: ``# {``-shaped that does NOT match falls through to the sample
+#: regex, which rejects the whole line (malformed exemplar = invalid).
+_EXEMPLAR_RE = re.compile(
+    r" # \{(?P<labels>[^{}]*)\} (?P<value>[^ ]+)(?: (?P<ts>[^ ]+))?$"
+)
+
+
+def split_exemplar(line: str) -> "Tuple[str, Optional[re.Match]]":
+    """Split a series line into (sample part, exemplar match or None).
+    The one splitter shared by the validator and the fleet-observatory
+    parse path, so both always agree on where a sample ends."""
+    m = _EXEMPLAR_RE.search(line)
+    if m is None:
+        return line, None
+    return line[: m.start()], m
 
 
 def _base_name(name: str) -> str:
@@ -460,7 +552,16 @@ def validate_exposition(text: str) -> List[str]:
     buckets (cumulative counts must never decrease with rising ``le``
     and ``+Inf`` must equal ``_count``). CI runs this against every
     live /metrics surface in the process smoke; the unit tests run it
-    against each metric set's render."""
+    against each metric set's render.
+
+    **Exemplar grammar** (ISSUE 15 satellite): a histogram bucket line
+    may carry one OpenMetrics-style ``# {trace_id="..."} value ts``
+    suffix. Accepted only there — an exemplar on any non-bucket line
+    is a problem, as are malformed/unescaped exemplar labels, a
+    non-numeric exemplar value/timestamp, an exemplar whose value
+    exceeds its bucket's ``le`` bound, and an exemplar on a bucket
+    whose cumulative count is 0 (it claims an observation that never
+    happened)."""
     problems: List[str] = []
     helps: Dict[str, int] = {}
     types: Dict[str, str] = {}
@@ -497,7 +598,8 @@ def validate_exposition(text: str) -> List[str]:
             continue
         if line.startswith("#"):
             continue  # plain comment
-        m = _SAMPLE_RE.match(line)
+        sample_part, exemplar = split_exemplar(line)
+        m = _SAMPLE_RE.match(sample_part)
         if m is None:
             problems.append(f"line {i}: unparseable sample {line!r}")
             continue
@@ -534,6 +636,57 @@ def validate_exposition(text: str) -> List[str]:
                 f"(first at line {series_seen[key]})"
             )
         series_seen[key] = i
+        if exemplar is not None:
+            if not (name.endswith("_bucket") and "le" in labels):
+                problems.append(
+                    f"line {i}: exemplar on a non-bucket line ({name})"
+                )
+            else:
+                raw_ex = exemplar.group("labels")
+                ex_labels: Dict[str, str] = {}
+                for lm in _LABEL_RE.finditer(raw_ex):
+                    ex_labels[lm.group("key")] = lm.group("value")
+                leftover = _LABEL_RE.sub(
+                    "", raw_ex).replace(",", "").strip()
+                if leftover or (raw_ex and not ex_labels):
+                    problems.append(
+                        f"line {i}: malformed/unescaped exemplar "
+                        f"labels {raw_ex!r}"
+                    )
+                try:
+                    ex_value: Optional[float] = float(
+                        exemplar.group("value"))
+                except ValueError:
+                    ex_value = None
+                    problems.append(
+                        f"line {i}: non-numeric exemplar value "
+                        f"{exemplar.group('value')!r}"
+                    )
+                ts_raw = exemplar.group("ts")
+                if ts_raw is not None:
+                    try:
+                        float(ts_raw)
+                    except ValueError:
+                        problems.append(
+                            f"line {i}: non-numeric exemplar "
+                            f"timestamp {ts_raw!r}"
+                        )
+                if value_f == 0:
+                    problems.append(
+                        f"line {i}: exemplar on an empty bucket "
+                        "(cumulative count 0 — no observation to "
+                        "exemplify)"
+                    )
+                if ex_value is not None and labels["le"] != "+Inf":
+                    try:
+                        le_bound: Optional[float] = float(labels["le"])
+                    except ValueError:
+                        le_bound = None  # reported by the bucket pass
+                    if le_bound is not None and ex_value > le_bound:
+                        problems.append(
+                            f"line {i}: exemplar value {ex_value} "
+                            f"above its bucket bound le={labels['le']}"
+                        )
         if value_f is None:
             continue  # already reported; nothing numeric to account
         if name.endswith("_bucket") and "le" in labels:
@@ -584,10 +737,17 @@ class RouteServer:
     """Minimal threaded HTTP GET server over a route table — the one
     serving scaffold shared by the agent's HealthServer and the fleet
     controller (exact-path match, HTTP/1.1 + Content-Length, silent
-    access log, idempotent stop)."""
+    access log, idempotent stop).
+
+    Query strings: the path is matched WITHOUT its ``?query`` part; a
+    handler that declares a parameter (``def h(query)``) receives the
+    parsed query as a ``{key: last value}`` dict, zero-arg handlers are
+    called as before — existing routes need no change to coexist with
+    filtered ones like ``/debug/timeseries?metric=<prefix>``."""
 
     def __init__(self, port: int = 0, name: str = "http-server"):
-        self._routes: Dict[str, object] = {}
+        #: path -> (handler, wants_query)
+        self._routes: Dict[str, Tuple[object, bool]] = {}
         self._name = name
         self._port = port
         self.httpd: Optional[ThreadingHTTPServer] = None
@@ -595,7 +755,13 @@ class RouteServer:
         self._stop_lock = threading.Lock()  # stop() may race from 2 threads
 
     def add_route(self, path: str, fn) -> None:
-        self._routes[path] = fn
+        import inspect
+
+        try:
+            wants_query = bool(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            wants_query = False
+        self._routes[path] = (fn, wants_query)
 
     @property
     def port(self) -> int:
@@ -613,12 +779,23 @@ class RouteServer:
                 pass
 
             def do_GET(self):
-                fn = outer._routes.get(self.path)
-                if fn is None:
+                path, _, rawq = self.path.partition("?")
+                route = outer._routes.get(path)
+                if route is None:
                     code, body, ctype = 404, b"not found", "text/plain"
                 else:
+                    fn, wants_query = route
                     try:
-                        code, body, ctype = fn()
+                        if wants_query:
+                            from urllib.parse import parse_qs
+
+                            query = {
+                                k: v[-1]
+                                for k, v in parse_qs(rawq).items()
+                            }
+                            code, body, ctype = fn(query)
+                        else:
+                            code, body, ctype = fn()
                     except Exception:  # degrade to 500, not a dropped socket
                         logging.getLogger(outer._name).exception(
                             "route handler %s failed", self.path
@@ -653,12 +830,13 @@ class RouteServer:
 
 class HealthServer(RouteServer):
     def __init__(self, metrics: Metrics, port: int = 0, tracer=None,
-                 flightrec=None, tsring=None):
+                 flightrec=None, tsring=None, watchdog=None):
         super().__init__(port, name="health-server")
         self.metrics = metrics
         self.tracer = tracer
         self.flightrec = flightrec
         self.tsring = tsring
+        self.watchdog = watchdog
         self.live = True
         self.ready = False
         self.add_route("/healthz", self._healthz)
@@ -667,6 +845,7 @@ class HealthServer(RouteServer):
         self.add_route("/debug/traces", self._traces)
         self.add_route("/debug/flightrec", self._flightrec)
         self.add_route("/debug/timeseries", self._timeseries)
+        self.add_route("/debug/incidents", self._incidents)
 
     def _healthz(self):
         return ((200, b"ok", "text/plain") if self.live
@@ -677,7 +856,10 @@ class HealthServer(RouteServer):
                 else (503, b"not ready", "text/plain"))
 
     def _metrics(self):
-        return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
+        # exemplar-capable exposition: OpenMetrics content type (the
+        # classic text/plain format has no exemplar grammar)
+        return (200, self.metrics.render().encode(),
+                OPENMETRICS_CONTENT_TYPE)
 
     def _traces(self):
         if self.tracer is None:
@@ -697,13 +879,27 @@ class HealthServer(RouteServer):
         ).encode()
         return 200, body, "application/json"
 
-    def _timeseries(self):
+    def _timeseries(self, query=None):
         """The in-process time-series ring (tsring.py, ISSUE 9): the
         windowed rates/quantiles plus the raw ring points — what two
-        hand-diffed /metrics scrapes used to approximate."""
+        hand-diffed /metrics scrapes used to approximate.
+        ``?metric=<prefix>`` (ISSUE 15 satellite) narrows the document
+        to matching metric families, so an operator — or the incident
+        packet builder — pulls one series without the whole ring."""
         if self.tsring is None:
             return 404, b"timeseries ring not wired", "text/plain"
-        return self.tsring.route()
+        return self.tsring.route(
+            metric_prefix=(query or {}).get("metric"))
+
+    def _incidents(self):
+        """The anomaly watchdog's incident packets (watchdog.py, ISSUE
+        15): the autopsy artifacts an operator reads AFTER the page —
+        anomalous series + window stats, exemplar trace ids, a profile
+        captured while the anomaly was live, and the flight-recorder
+        dump path."""
+        if self.watchdog is None:
+            return 404, b"watchdog not wired", "text/plain"
+        return self.watchdog.route()
 
 
 def create_readiness_file(path: str) -> None:

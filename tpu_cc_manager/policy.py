@@ -438,7 +438,13 @@ class PolicyController:
                 name for name, st in report["policies"].items()
                 if st.get("phase") in UNHEALTHY_PHASES
             )
-            self.metrics.scan_duration.observe(time.monotonic() - t0)
+            from tpu_cc_manager.trace import current_trace_ids
+
+            # the active trace (if any) rides as the scan-latency
+            # bucket's exemplar (ISSUE 15)
+            self.metrics.scan_duration.observe(
+                time.monotonic() - t0,
+                trace_id=current_trace_ids()[0])
             self.metrics.update(report["policies"])
             self.last_report = report
         except Exception:
@@ -1546,10 +1552,17 @@ class PolicyController:
         return 200, b"ok", "text/plain"
 
     def _metrics_route(self):
-        return 200, self.metrics.render().encode(), "text/plain; version=0.0.4"
+        # scan-histogram exemplars ride this render: OpenMetrics type
+        # (obs.OPENMETRICS_CONTENT_TYPE rationale)
+        from tpu_cc_manager.obs import OPENMETRICS_CONTENT_TYPE
 
-    def _timeseries_route(self):
-        return self.tsring.route()
+        return (200, self.metrics.render().encode(),
+                OPENMETRICS_CONTENT_TYPE)
+
+    def _timeseries_route(self, query=None):
+        # ?metric=<prefix> narrows to one family (ISSUE 15 satellite)
+        return self.tsring.route(
+            metric_prefix=(query or {}).get("metric"))
 
     def _report_route(self):
         if self.last_report is None:

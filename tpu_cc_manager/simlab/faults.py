@@ -474,6 +474,38 @@ class FaultInjector:
                 "rival": rival["policy"],
                 "rival_mode": params["rival_mode"], "pool": pool}
 
+    def _flip_latency(self, params: dict) -> dict:
+        """Inject device-reset latency into the scoped replicas' fake
+        chips (ISSUE 15): flips still SUCCEED, just slowly — the
+        scripted anomaly the watchdog must catch live, with the guilty
+        phase (``reset``) on the worker threads' stacks for the
+        profiler and the slow reconciles' trace ids in the histogram
+        exemplars. ``duration_s`` restores the original latency via a
+        restorative timer (settle() runs it early on a fast run)."""
+        delay_s = float(params["delay_s"])
+        names = self._scoped(params.get("pool"))
+        count = min(int(params.get("count", len(names))), len(names))
+        victims = names[:count]
+        # capture each chip's PRIOR latency before clobbering it, so
+        # the restore puts back what was there — not a hardcoded 0
+        # that would cancel an overlapping flip_latency fault (or a
+        # scenario-configured baseline) early
+        prior: List[tuple] = []
+        for name in victims:
+            for chip in self.replicas[name].backend.chips:
+                prior.append((chip, chip._reset_latency_s))
+                chip.set_reset_latency(delay_s)
+        duration_s = params.get("duration_s")
+        entry = {"nodes": len(victims), "delay_s": delay_s}
+        if duration_s is not None:
+            def restore() -> None:
+                for chip, was in prior:
+                    chip.set_reset_latency(was)
+
+            self._timer(float(duration_s), restore, restore=True)
+            entry["duration_s"] = float(duration_s)
+        return entry
+
     def _evacuation_drain(self, params: dict) -> dict:
         """Region-evacuation drain racing in-flight flips: cordon N
         nodes through the REAL write path (spec.unschedulable — the

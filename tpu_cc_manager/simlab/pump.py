@@ -93,12 +93,15 @@ class WatchPump:
         self._lag_lock = threading.Lock()
 
     # ------------------------------------------------------------ plumbing
-    def _observe_lag(self, node: str, value) -> Optional[float]:
+    def _observe_lag(self, node: str, value,
+                     trace_id: Optional[str] = None) -> Optional[float]:
         t = self.stamps.take(node, value)
         if t is None:
             return None
         lag = time.monotonic() - t
-        self.lag_hist.observe(lag)
+        # the desired write's trace id exemplifies the lag bucket
+        # (ISSUE 15): a slow pump bucket names a concrete fleet trace
+        self.lag_hist.observe(lag, trace_id=trace_id)
         with self._lag_lock:
             self.lag_samples.append(lag)
         return lag
@@ -111,7 +114,12 @@ class WatchPump:
         fresh = trace if trace != self._last_ctx.get(node) else None
         # ccaudit: allow-race-lockset(_deliver runs only on the pump thread after start(); prime() writes happen-before — same single-writer contract as _last)
         self._last_ctx[node] = trace
-        lag = self._observe_lag(node, value)
+        from tpu_cc_manager.trace import parse_traceparent
+
+        ctx = parse_traceparent(fresh)
+        lag = self._observe_lag(
+            node, value,
+            trace_id=ctx.trace_id if ctx is not None else None)
         if value is None:
             return  # label removed: nothing to reconcile (no default)
         self.delivered_total += 1

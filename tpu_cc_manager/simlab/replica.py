@@ -271,7 +271,12 @@ class ReplicaShell:
         self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
         if self.metrics is not None:
             self.metrics.reconciles_total.inc(outcome)
-            self.metrics.reconcile_duration.observe(root.dur_s)
+            # the (possibly adopted) reconcile trace id rides as the
+            # latency bucket's exemplar (ISSUE 15): a slow bucket in
+            # this replica's exposition names a trace the fleet-wide
+            # stitch resolves back to the desired write that caused it
+            self.metrics.reconcile_duration.observe(
+                root.dur_s, trace_id=root.trace_id)
         if ok:
             self.applied = mode
             if self.evidence:

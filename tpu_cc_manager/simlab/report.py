@@ -53,6 +53,7 @@ def build_artifact(
     controllers: dict,
     trace_stitch: Optional[dict] = None,
     slo: Optional[dict] = None,
+    incidents: Optional[dict] = None,
     shards: Optional[dict] = None,
     lifecycle: Optional[dict] = None,
     kube_io: Optional[dict] = None,
@@ -107,6 +108,13 @@ def build_artifact(
         # and the scrape/aggregation-validity accounting — or an
         # honest {"skipped": reason} when the engine couldn't run
         metrics["slo"] = slo
+    if incidents is not None:
+        # the anomaly watchdog's autopsy record (watchdog.py, ISSUE
+        # 15): incident packets with window stats, exemplar trace ids
+        # (resolved against the fleet-wide trace stitch), the live
+        # profile, and capture latency — the in-run proof the
+        # metrics → anomaly → exemplar → profile chain closed
+        metrics["incidents"] = incidents
     artifact = {
         "artifact_version": ARTIFACT_VERSION,
         "scenario": scenario.name,

@@ -227,6 +227,14 @@ class SimLab:
         self.obs_rec = FlightRecorder(
             name="fleetobs", span_ring=8, event_ring=64, sample_ring=8,
         )
+        # the fleet-level anomaly watchdog (watchdog.py, ISSUE 15):
+        # rides the observer's merged sample history — one detector
+        # over the whole fleet's windowed series instead of N per-
+        # replica sampling threads. Incidents (exemplar trace ids +
+        # live profile + black-box note) land in the artifact, with
+        # each exemplar resolved against the fleet-wide trace stitch.
+        self.watchdog = None
+        self.profiler = None
         self.lag_hist = watch_pump_lag_histogram()
         self.throttle_hist = kube_throttle_wait_histogram()
         self._throttle_samples: List[float] = []
@@ -298,6 +306,18 @@ class SimLab:
         self.observer = fleetobs.FleetObserver(
             objectives, name=self.scenario.name, recorder=self.obs_rec,
         )
+        if os.environ.get("TPU_CC_SIMLAB_WATCHDOG", "1").lower() not in (
+                "0", "false", "no"):
+            from tpu_cc_manager.profiler import SamplingProfiler
+            from tpu_cc_manager.watchdog import Watchdog
+
+            self.profiler = SamplingProfiler(name="simlab")
+            self.watchdog = Watchdog(
+                sources=[r.metrics for r in self.replicas.values()],
+                profiler=self.profiler, recorder=self.obs_rec,
+                name=self.scenario.name,
+            )
+            self.observer.add_listener(self.watchdog.consume)
         self.observer.start(
             [r.metrics.render for r in self.replicas.values()]
         )
@@ -695,7 +715,7 @@ class SimLab:
                                 exc_info=True)
 
     # ------------------------------------------------------ trace stitch
-    def _stitch_traces(self) -> dict:
+    def _stitch_traces(self) -> "tuple[dict, dict]":
         """Collect every process-local flight recording (driver +
         controllers + all replicas), stitch spans fleet-wide by trace
         id, and derive the end-to-end convergence distribution: for
@@ -703,7 +723,9 @@ class SimLab:
         (``desired_write`` span start) → that node's LAST adopted
         ``reconcile`` span end (the state publish happens inside it).
         This is the cross-process latency ROADMAP item 2 asks for —
-        measured from causal traces, not from the driver's poll."""
+        measured from causal traces, not from the driver's poll.
+        Returns (summary block, the full stitched map) — the incident
+        packets' exemplar trace ids are resolved against the map."""
         recordings = [self.driver_rec.snapshot("run_end"),
                       self.ctrl_rec.snapshot("run_end"),
                       self.obs_rec.snapshot("run_end")]
@@ -747,6 +769,37 @@ class SimLab:
             # one stitched fleet timeline as evidence the propagation
             # works end to end (capped: the artifact must stay small)
             "timeline_example": example[:12],
+        }, stitched
+
+    def _incidents_block(self, stitched: dict) -> Optional[dict]:
+        """The watchdog's autopsy record for the artifact (ISSUE 15):
+        each packet's exemplar trace ids resolved against the
+        fleet-wide stitch — ``resolved_trace_ids`` are ids present in
+        the stitched map at all, ``cross_process_trace_ids`` the
+        subset whose span bucket spans more than one recorder (the
+        incident demonstrably joins a controller's desired write to a
+        replica's slow reconcile)."""
+        if self.watchdog is None:
+            return None
+        cross_ids = {
+            tid for tid, spans in stitched.items()
+            if len({s.get("recorder") for s in spans
+                    if s.get("recorder")}) > 1
+        }
+        packets = []
+        for p in self.watchdog.incidents():
+            p = dict(p)
+            tids = {
+                e.get("trace_id") for e in (p.get("exemplars") or [])
+                if e.get("trace_id")
+            }
+            p["resolved_trace_ids"] = sorted(tids & set(stitched))
+            p["cross_process_trace_ids"] = sorted(tids & cross_ids)
+            packets.append(p)
+        return {
+            "count": self.watchdog.incidents_total,
+            "last_capture_s": self.watchdog.last_capture_s,
+            "packets": packets[-8:],
         }
 
     def _finish(self, ok, initial_s, conv_s, pending, faults, notes):
@@ -903,6 +956,7 @@ class SimLab:
             phase_durations = {
                 k: list(v) for k, v in self._phase_durations.items()
             }
+        trace_stitch, stitched = self._stitch_traces()
         return build_artifact(
             self.scenario,
             ok=ok,
@@ -915,8 +969,9 @@ class SimLab:
             replica_stats=replica_stats,
             faults=faults,
             controllers=controllers,
-            trace_stitch=self._stitch_traces(),
+            trace_stitch=trace_stitch,
             slo=slo,
+            incidents=self._incidents_block(stitched),
             shards=shards,
             lifecycle=lifecycle,
             kube_io=kube_io,

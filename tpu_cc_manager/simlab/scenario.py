@@ -100,6 +100,15 @@ FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
     "evacuation_drain": {"count": (True, int),
                          "pool": (False, int),
                          "duration_s": (False, (int, float))},
+    # inject delay_s of device-reset latency into the scoped replicas'
+    # fake chips (the scripted slow-flip, ISSUE 15): reconciles still
+    # SUCCEED, just slowly — the fault the anomaly watchdog must
+    # notice live, name the guilty phase for, and autopsy. Optional
+    # duration_s restores the original latency (restorative timer)
+    "flip_latency": {"delay_s": (True, (int, float)),
+                     "count": (False, int),
+                     "pool": (False, int),
+                     "duration_s": (False, (int, float))},
 }
 
 #: action kind -> {param: (required, type(s))}; "fault" params are

@@ -66,12 +66,48 @@ TRACEPARENT_VERSION = "00"
 # tracer opened it. Maintained by Tracer.span/adopt/adopt_remote.
 _active = threading.local()
 
+# The same per-thread stacks, readable from OTHER threads: the sampling
+# profiler (profiler.py, ISSUE 15) keys each wall-clock sample to the
+# span active on the sampled thread, and a thread-local is invisible
+# across threads. Each thread's stack LIST is registered here once (on
+# its first span); registration and pruning happen under _registry_lock,
+# while the sampler reads bare dict lookups + list[-1] — both atomic
+# under the GIL, and a sampler that sees a stale entry (a reused ident
+# whose new thread has not opened a span yet) reads an empty stack.
+_registry_lock = threading.Lock()
+_thread_stacks: Dict[int, List["Span"]] = {}
+_REGISTRY_PRUNE_AT = 512
+
 
 def _active_stack() -> List["Span"]:
     stack = getattr(_active, "stack", None)
     if stack is None:
         stack = _active.stack = []
+        with _registry_lock:
+            if len(_thread_stacks) >= _REGISTRY_PRUNE_AT:
+                live = {t.ident for t in threading.enumerate()}
+                for dead in [i for i in _thread_stacks
+                             if i not in live]:
+                    del _thread_stacks[dead]
+            _thread_stacks[threading.get_ident()] = stack
     return stack
+
+
+def span_on_thread(ident: int) -> Optional["Span"]:
+    """The innermost open span on the thread with OS ident ``ident``
+    (None when that thread is outside any span, or has never opened
+    one). Sampling-grade by design: the read is lock-free and a span
+    closing concurrently may still be returned for one sample — fine
+    for a profiler, wrong for anything that needs a fence."""
+    stack = _thread_stacks.get(ident)  # ccaudit: allow-race-lockset(sampler-grade read: dict get + list[-1] are GIL-atomic; registration is lock-guarded and a stale/racing entry costs one mis-keyed sample, never a crash)
+    try:
+        return stack[-1] if stack else None
+    except IndexError:
+        # the owning thread popped its last span between the check and
+        # the index — the span just closed, so "no active span" is the
+        # true answer (and an escaped IndexError would kill the armed
+        # sampler thread permanently)
+        return None
 
 
 def active_span() -> Optional["Span"]:

@@ -292,6 +292,19 @@ class TimeSeriesRing:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: post-sample listeners (the anomaly watchdog, ISSUE 15):
+        #: fn(samples) called after every appended tick, exceptions
+        #: contained — a broken listener costs itself, not the sampler
+        self._listeners: List[Callable[[List[Sample]], Any]] = []
+
+    def add_listener(
+        self, fn: Callable[[List[Sample]], Any],
+    ) -> "TimeSeriesRing":
+        """Register a post-tick listener: called with the full sample
+        list after each successful snapshot — how the watchdog sees
+        every new window without owning a second sampling thread."""
+        self._listeners.append(fn)
+        return self
 
     # ------------------------------------------------------------ sampling
     def _snapshot(self) -> Snapshot:
@@ -311,6 +324,13 @@ class TimeSeriesRing:
             return None
         with self._lock:
             self._samples.append(sample)
+            samples = list(self._samples)
+        for fn in self._listeners:
+            try:
+                fn(samples)
+            except Exception:  # ccaudit: allow-swallow(a broken listener must cost itself, never the sampling loop; the warning names it)
+                log.warning("tsring %s listener failed", self.name,
+                            exc_info=True)
         return sample
 
     def start(self) -> "TimeSeriesRing":
@@ -341,14 +361,19 @@ class TimeSeriesRing:
         with self._lock:
             return list(self._samples)
 
-    def route(self) -> Tuple[int, bytes, str]:
+    def route(
+        self, metric_prefix: Optional[str] = None,
+    ) -> Tuple[int, bytes, str]:
         """The ``GET /debug/timeseries`` handler body — one shared
         implementation for every route server (agent HealthServer,
-        fleet + policy controllers)."""
+        fleet + policy controllers). ``metric_prefix`` (the
+        ``?metric=<prefix>`` query, ISSUE 15 satellite) narrows the
+        document to metric families whose name starts with it."""
         import json
 
         body = json.dumps(
-            self.to_doc(), indent=1, sort_keys=True,
+            self.to_doc(metric_prefix=metric_prefix),
+            indent=1, sort_keys=True,
         ).encode()
         return 200, body, "application/json"
 
@@ -356,12 +381,24 @@ class TimeSeriesRing:
         self,
         window_s: Optional[float] = None,
         include_points: bool = True,
+        metric_prefix: Optional[str] = None,
     ) -> Dict[str, Any]:
         """The ``/debug/timeseries`` response body (and, with
         ``include_points=False``, the flight-recorder embed): ring
         metadata, the windowed derivation over the newest samples, and
-        optionally the raw ring as per-series point lists."""
+        optionally the raw ring as per-series point lists.
+        ``metric_prefix`` filters families by name prefix BEFORE the
+        derivation, so a filtered pull costs proportionally less, not
+        just ships less."""
         samples = self.samples()
+        if metric_prefix:
+            samples = [
+                (ts, {
+                    name: fam for name, fam in snap.items()
+                    if name.startswith(metric_prefix)
+                })
+                for ts, snap in samples
+            ]
         window = window_s or self.window_s
         doc: Dict[str, Any] = {
             "tsring_version": SCHEMA_VERSION,
@@ -374,6 +411,8 @@ class TimeSeriesRing:
                 if len(samples) > 1 else 0.0
             ),
         }
+        if metric_prefix:
+            doc["metric_prefix"] = metric_prefix
         if samples:
             pair = window_pair(samples, window)
             doc["derived"] = derive_window(
