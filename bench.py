@@ -1253,6 +1253,75 @@ def run_lifecycle_bench():
     }
 
 
+def run_federation_bench():
+    """Multi-region federation at 2x512 LIVE replicas (ISSUE 16 /
+    ROADMAP item 2): the federation-2x512 scenario runs TWO FakeApi-
+    Servers — one per region — under one FederationManager, scripts a
+    region partition against the still-waiting window AND a region
+    evacuation racing the in-flight posture rollout, and must converge
+    with the surviving region absorbing. Two gated axes come out:
+    ``region_evac_convergence_s`` (region_evacuate injection -> the
+    fleet stable again: evacuated region fully cordoned AND every
+    other region converged) and ``federation_e2e_convergence_p99_s``
+    (the CROSS-REGION desired-write -> state-published latency,
+    stitched over flight-recorder trace ids spanning both API
+    servers — namespaced because the single-server scale-256 run
+    already owns the plain e2e axis)."""
+    import os as _os
+
+    from tpu_cc_manager.simlab.federation import FederationLab
+    from tpu_cc_manager.simlab.invariants import check_run
+    from tpu_cc_manager.simlab.scenario import load_scenario
+
+    path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "scenarios", "federation-2x512.json",
+    )
+    lab = FederationLab(load_scenario(path))
+    art = lab.run()
+    violations = check_run(lab, art)
+    if violations:
+        for v in violations:
+            print(f"FATAL: federation-2x512 invariant violated: "
+                  f"{v.invariant}: {v.detail}", file=sys.stderr)
+        sys.exit(1)
+    m = art["metrics"]
+    fed = m.get("federation") or {}
+    stitch = m.get("trace_stitch") or {}
+    if m.get("region_evac_convergence_s") is None:
+        # the scenario scripts a region_evacuate: a converged run with
+        # no evac number means the drill never stabilized (or the
+        # measurement broke) — the axis would silently fall out of the
+        # trend gate, so fail HERE, loudly, at the source
+        print("FATAL: federation-2x512 converged but produced no "
+              f"region_evac_convergence_s (federation={fed!r})",
+              file=sys.stderr)
+        sys.exit(1)
+    if m.get("e2e_convergence_p99_s") is None:
+        print("FATAL: federation-2x512 converged but produced no "
+              f"stitched cross-region e2e samples "
+              f"(trace_stitch={stitch!r})", file=sys.stderr)
+        sys.exit(1)
+    reads = {name: r.get("node_read_requests")
+             for name, r in (fed.get("regions") or {}).items()}
+    return {
+        "region_evac_convergence_s": m["region_evac_convergence_s"],
+        "federation_e2e_convergence_p99_s": m["e2e_convergence_p99_s"],
+        "federation2x512": {
+            "scenario": art["scenario"],
+            "regions": sorted(fed.get("regions") or {}),
+            "evacuations": fed.get("evacuations"),
+            # the zero-cross-region-reads ledger: per-region API-server
+            # node read totals for the WHOLE run (informer primes only)
+            "node_read_requests": reads,
+            "cross_process_traces": stitch.get("cross_process_traces"),
+            "e2e_samples": stitch.get("e2e_samples"),
+            "reconciles": m["reconciles"]["total"],
+            "invariants_checked": True,
+        },
+    }
+
+
 def run_rollout_bench(n_groups=12, agent_delay_s=0.03, poll_s=0.5):
     """Reactive rollout economics (ISSUE 14): an ``n_groups``-group
     serial rollout over FakeKube, judged off a NodeInformer delta
@@ -1552,6 +1621,10 @@ def main():
         # profiler's flip-loop overhead (ceiling 5%) and the anomaly
         # fire -> packet-complete latency join the gated axes
         result["extras"].update(run_incident_bench(f"{d}/incident"))
+        # multi-region federation (ISSUE 16): 2x512 live replicas over
+        # two API servers — region partition + evac-races-rollout; the
+        # evac-stabilization and cross-region e2e axes join the gate
+        result["extras"].update(run_federation_bench())
     print(json.dumps(result))
 
 

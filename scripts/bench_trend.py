@@ -107,6 +107,18 @@ GATED_EXTRA_AXES = {
     # loop it runs on).
     "profiler_overhead_pct": "lower",
     "incident_capture_s": "lower",
+    # joined in r16 (the multi-region federation round, ISSUE 16):
+    # region_evacuate injection -> the fleet stable again (evacuated
+    # region fully cordoned through its own API server AND every other
+    # region converged after its window collapsed to absorb) on the
+    # federation-2x512 scenario — the axis that regresses if the
+    # absorb signal stops collapsing sibling windows or the cordon
+    # loop starts serializing behind posture retries; and the
+    # CROSS-REGION desired-write -> state-published p99 stitched over
+    # trace ids spanning both API servers (namespaced: the plain
+    # e2e_convergence_p99_s axis is the single-server scale-256 run's).
+    "region_evac_convergence_s": "lower",
+    "federation_e2e_convergence_p99_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are

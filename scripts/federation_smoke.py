@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Federation smoke (ISSUE 16, the federation-smoke CI job): prove the
+multi-region plane end to end on live FakeApiServers, both directions —
+
+1. ``scenarios/federation-2x128.json`` — two regions (64 nodes each),
+   a region partition racing the posture windows, then us-east
+   evacuated mid-rollout (evac-races-upgrade). The run must CONVERGE
+   with eu-west absorbing: the evacuation collapses eu-west's 30 s
+   window to NOW, so fleet convergence lands far inside that window;
+   us-east ends fully cordoned; the stitched cross-region trace axes
+   and the region_evac_convergence_s axis are measured; each region's
+   API server saw only its informer-priming node reads (the
+   zero-cross-region-reads ledger); and the convergence-and-invariants
+   oracle reports ZERO violations.
+2. ``scenarios/federation-clean-2x128.json`` — the same fleet, no
+   faults: ZERO evacuations, no region partitioned, no
+   region_evac_convergence_s axis (nothing was evacuated), and the
+   same zero-violation oracle.
+
+A federation layer that can't demonstrate both halves is worse than
+none — blind on real drains or evacuating healthy regions. Exit 0
+only when both hold.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpu_cc_manager.simlab import invariants  # noqa: E402
+from tpu_cc_manager.simlab.federation import FederationLab  # noqa: E402
+from tpu_cc_manager.simlab.report import convergence_key  # noqa: E402
+from tpu_cc_manager.simlab.scenario import load_scenario  # noqa: E402
+
+SCENARIO_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scenarios")
+
+#: the informer/pump priming LISTs are the only sanctioned node reads;
+#: anything past this bound means a judge fell off its informer cache
+MAX_PRIMING_READS_PER_REGION = 8
+
+checks = []
+
+
+def check(name, ok, detail=""):
+    checks.append(ok)
+    print(f"{'PASS' if ok else 'FAIL'} {name}"
+          + (f": {detail}" if detail else ""))
+
+
+def run(scenario):
+    lab = FederationLab(load_scenario(
+        os.path.join(SCENARIO_DIR, scenario)))
+    art = lab.run()
+    violations = invariants.check_run(lab, art)
+    return lab, art, violations
+
+
+def main():
+    # ---- the drill half: partition + evacuation, eu-west absorbs
+    lab, art, violations = run("federation-2x128.json")
+    check("drill scenario converged", art["ok"], art.get("notes") or "")
+    check("zero invariant violations (drill)", not violations,
+          "; ".join(f"{v.invariant}: {v.detail[:90]}"
+                    for v in violations[:3]))
+    fed = art["metrics"].get("federation") or {}
+    evacuated = [e["region"] for e in fed.get("evacuations") or []]
+    check("us-east was evacuated", evacuated == ["us-east"],
+          json.dumps(evacuated))
+    check("eu-west stayed in service (absorbing, not evacuated)",
+          not fed.get("regions", {}).get("eu-west", {}).get("evacuated"))
+    # the absorb proof: the scenario grants eu-west a 30 s window, so
+    # a convergence far inside it means the evacuation collapsed the
+    # window to NOW rather than waiting it out
+    conv = art["metrics"].get(convergence_key(128))
+    check("convergence landed inside eu-west's 30s window (absorb)",
+          conv is not None and conv < 25.0, str(conv))
+    evac_s = art["metrics"].get("region_evac_convergence_s")
+    check("region_evac_convergence_s measured", evac_s is not None,
+          str(evac_s))
+    e2e = art["metrics"].get("trace_stitch") or {}
+    check("cross-region traces stitched across processes",
+          (e2e.get("cross_process_traces") or 0) >= 1
+          and (e2e.get("e2e_samples") or 0) >= 128,
+          json.dumps({k: e2e.get(k) for k in
+                      ("cross_process_traces", "e2e_samples")}))
+    reads = {name: r.get("node_read_requests")
+             for name, r in (fed.get("regions") or {}).items()}
+    check("zero steady-state node reads per region (priming only)",
+          bool(reads) and all(
+              isinstance(n, int) and n <= MAX_PRIMING_READS_PER_REGION
+              for n in reads.values()),
+          json.dumps(reads))
+
+    # ---- the quiet half: no faults, nothing evacuates
+    lab, art, violations = run("federation-clean-2x128.json")
+    check("clean scenario converged", art["ok"], art.get("notes") or "")
+    check("zero invariant violations (clean)", not violations,
+          "; ".join(f"{v.invariant}: {v.detail[:90]}"
+                    for v in violations[:3]))
+    fed = art["metrics"].get("federation") or {}
+    check("clean run evacuated NOTHING",
+          not fed.get("evacuations")
+          and not any(r.get("evacuated")
+                      for r in (fed.get("regions") or {}).values()),
+          json.dumps(fed.get("evacuations")))
+    check("clean run partitioned nothing", not fed.get("partitioned"),
+          json.dumps(fed.get("partitioned")))
+    check("no evac axis on a run with no evacuation",
+          "region_evac_convergence_s" not in art["metrics"])
+
+    print(f"\nfederation-smoke: {sum(checks)}/{len(checks)} "
+          "checks passed")
+    return 0 if all(checks) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,11 @@ EPISODES = [
     ("policy-conflict", 2, ["policy"], {"policy_conflict"}),
     ("evacuation", 4, ["evacuation"], {"evacuation_drain"}),
     ("shards", 5, ["shards"], {"shard_kill"}),
+    # federation (ISSUE 16): seed 2 draws the region-scoped
+    # revoked-root drill (the region_attestation_latch invariant's
+    # live exercise), seed 6 a region partition racing the windows
+    ("federation-revoked-root", 2, ["federation"], {"root_revoked"}),
+    ("federation-partition", 6, ["federation"], {"region_partition"}),
     ("free-101", 101, None, set()),
     ("free-202", 202, None, set()),
 ]

@@ -1004,6 +1004,60 @@ def test_shard_bypass_pragma_allows_deliberate_access():
     assert not [f for f in findings if f.rule == "shard-bypass"]
 
 
+# ---------------------------------------------------------- region-bypass
+def test_region_bypass_flags_region_table_subscript_without_lookup():
+    """ISSUE 16: indexing the pool->region table with anything but the
+    sanctioned federation lookup couples a controller to a sibling
+    region's API server — the cross-region writer the federation
+    boundary exists to prevent."""
+    src = """
+    class M:
+        def steal(self, pool):
+            return self._pool_region[pool]
+
+        def hardcode(self):
+            return self.fed.region_of_pool("pool-7")
+    """
+    findings = run(src, relpath="tpu_cc_manager/federation.py")
+    hits = [f for f in findings if f.rule == "region-bypass"]
+    assert len(hits) == 2
+    assert "owner_of" in hits[0].message
+    assert "hard-coded" in hits[1].message
+
+
+def test_region_bypass_sanctioned_lookup_and_other_modules_pass():
+    ok_src = """
+    class M:
+        def route(self, pool):
+            return self.region_pools[self.fed.region_of_pool(pool)]
+
+        def place(self, pool):
+            region, member = self.fed.owner_of(pool)
+            return region
+    """
+    findings = run(ok_src, relpath="tpu_cc_manager/federation.py")
+    assert not [f for f in findings if f.rule == "region-bypass"]
+    # the rule scopes to region-aware modules: a dict named
+    # region_pools elsewhere is someone else's business
+    naked = """
+    def f(d):
+        return d["region_pools"] or region_pools["us-east"]
+    """
+    for relpath in ("tpu_cc_manager/shard.py", "snippet.py"):
+        findings = run(naked, relpath=relpath)
+        assert not [f for f in findings if f.rule == "region-bypass"], relpath
+
+
+def test_region_bypass_pragma_allows_deliberate_access():
+    src = """
+    class M:
+        def debug_dump(self):
+            return self._pool_region["p0"]  # ccaudit: allow-region-bypass(read-only debug surface enumerates every region)
+    """
+    findings = run(src, relpath="tpu_cc_manager/federation.py")
+    assert not [f for f in findings if f.rule == "region-bypass"]
+
+
 def test_shard_module_joins_write_and_planner_rule_scopes():
     """ISSUE 11 satellite: shard.py is covered by the direct-node-write
     and planner-bypass module sets — the shard layer hosts controllers,

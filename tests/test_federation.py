@@ -1,0 +1,407 @@
+"""Multi-region federation (tpu_cc_manager.federation, ISSUE 16): the
+region-affine hash ring's determinism + movement bounds, the ONE
+sanctioned owner lookup, one-posture/per-region-windows rollout with
+evacuation absorb, partition deferral through the FakeKube fault gate,
+the zero-cross-region-reads judging contract pinned against per-region
+``node_read_requests``, per-region trust domains (revoked root latches
+attestation_outage in THAT region only), and the schema-2 scenario
+surface the federation labs consume."""
+
+import time
+
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager.federation import (
+    FederationError, FederationManager, FleetPosture, RegionSpec,
+    RegionTrustDomain, posture_from_policy,
+)
+from tpu_cc_manager.k8s.fake import FakeKube
+from tpu_cc_manager.k8s.objects import make_node
+from tpu_cc_manager.shard import HashRing
+from tpu_cc_manager.simlab.scenario import (
+    ScenarioError, validate_scenario,
+)
+
+POOL_LABEL = "simlab.pool"
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _region_ring(per_region=3, regions=("us-east", "eu-west")):
+    members, tags = [], {}
+    for r in regions:
+        for k in range(per_region):
+            m = f"{r}/shard-{k}"
+            members.append(m)
+            tags[m] = r
+    return HashRing(members, regions=tags)
+
+
+# ----------------------------------------------------- region-affine ring
+def test_region_ring_deterministic_and_home_region_pinned():
+    """Region-constrained walks are a pure function of the member set:
+    two independently constructed rings agree on every placement, and a
+    region-pinned lookup always lands in the home region."""
+    a, b = _region_ring(), _region_ring()
+    keys = [f"p{i}" for i in range(128)]
+    for region in ("us-east", "eu-west"):
+        owners_a = [a.owner_of(k, region=region) for k in keys]
+        owners_b = [b.owner_of(k, region=region) for k in keys]
+        assert owners_a == owners_b
+        assert all(a.regions[m] == region for m in owners_a)
+
+
+def test_region_ring_without_moves_about_one_nth_within_region():
+    """Consistent hashing survives the region constraint: dropping one
+    of a region's N members moves ONLY that member's keys, and they
+    redistribute among the region's survivors — the other region's
+    placements do not move at all."""
+    ring = _region_ring(per_region=4)
+    keys = [f"p{i}" for i in range(256)]
+    before_home = {k: ring.owner_of(k, region="us-east") for k in keys}
+    before_away = {k: ring.owner_of(k, region="eu-west") for k in keys}
+    smaller = ring.without("us-east/shard-1")
+    moved = 0
+    for k in keys:
+        after = smaller.owner_of(k, region="us-east")
+        assert smaller.regions[after] == "us-east"
+        if before_home[k] == "us-east/shard-1":
+            moved += 1
+            assert after != "us-east/shard-1"
+        else:
+            assert after == before_home[k], k
+        # the sibling region is untouched by us-east's membership churn
+        assert smaller.owner_of(k, region="eu-west") == before_away[k]
+    # ~1/4 of the region's keys lived on the removed member (loose
+    # bounds: vnode placement is hash-distributed, not exact)
+    assert 256 * 0.08 < moved < 256 * 0.45
+
+
+def test_region_ring_fails_over_out_of_region_only_when_region_empty():
+    ring = _region_ring(per_region=1)
+    keys = [f"p{i}" for i in range(32)]
+    # one member left in the region: every key stays home
+    assert all(ring.owner_of(k, region="us-east") == "us-east/shard-0"
+               for k in keys)
+    # the WHOLE region gone: the walk falls back to the global ring —
+    # failover leaves the home region only when the region is down
+    drained = ring.without("us-east/shard-0")
+    for k in keys:
+        owner = drained.owner_of(k, region="us-east")
+        assert drained.regions[owner] == "eu-west"
+
+
+def test_members_in_and_unknown_region_falls_back_to_global():
+    ring = _region_ring(per_region=2)
+    assert ring.members_in("us-east") == [
+        "us-east/shard-0", "us-east/shard-1"]
+    assert ring.members_in("mars") == []
+    # an unknown region pin degrades to the plain deterministic walk
+    assert ring.owner_of("p0", region="mars") == ring.owner_of("p0")
+
+
+# ------------------------------------------------------ federation manager
+def _region_kube(region, n=4, pools=2, state=None):
+    kube = FakeKube()
+    for i in range(n):
+        labels = {
+            L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+            POOL_LABEL: f"{region}-p{i % pools}",
+            L.CC_MODE_LABEL: "off",
+        }
+        if state is not None:
+            labels[L.CC_MODE_STATE_LABEL] = state
+        kube.add_node(make_node(f"{region}-{i:03d}", labels=labels))
+    return kube
+
+
+def _federation(kubes, **kw):
+    specs = [
+        RegionSpec(
+            name=region,
+            client_factory=(lambda k=kube: k),
+            pools=[f"{region}-p0", f"{region}-p1"],
+            trust_domain=kw.pop(f"_domain_{region}", None),
+        )
+        for region, kube in kubes.items()
+    ]
+    kw.setdefault("pool_label", POOL_LABEL)
+    kw.setdefault("fleet_interval_s", 0.2)
+    kw.setdefault("lease_duration_s", 0.4)
+    kw.setdefault("renew_period_s", 0.1)
+    kw.setdefault("retry_period_s", 0.05)
+    return FederationManager(specs, **kw)
+
+
+def test_owner_of_is_region_aware_and_rejects_strays():
+    kubes = {"us-east": _region_kube("us-east"),
+             "eu-west": _region_kube("eu-west")}
+    fed = _federation(kubes, shards_per_region=2)
+    for pool in ("us-east-p0", "us-east-p1"):
+        region, member = fed.owner_of(pool)
+        assert region == "us-east"
+        assert member.startswith("us-east/")
+    region, member = fed.owner_of("eu-west-p1")
+    assert (region, member[:8]) == ("eu-west", "eu-west/")
+    with pytest.raises(FederationError, match="belongs to no region"):
+        fed.owner_of("nobody-p0")
+    with pytest.raises(FederationError, match="unknown region"):
+        fed.pools_in_region("mars")
+    # a pool claimed twice is a spec bug, caught at construction
+    with pytest.raises(FederationError, match="claimed by both"):
+        FederationManager([
+            RegionSpec("a", lambda: None, ["p0"]),
+            RegionSpec("b", lambda: None, ["p0"]),
+        ], pool_label=POOL_LABEL)
+
+
+def test_posture_windows_absorb_on_evacuation_and_zero_region_reads():
+    """THE tentpole flow in one live federation: region windows stagger
+    the ONE posture; evacuating us-east parks its write, cordons its
+    nodes, and collapses eu-west's still-waiting window to NOW; and
+    once converged, the per-region judges run entirely from informer
+    caches — both FakeKubes' node_read_requests counters freeze."""
+    kubes = {"us-east": _region_kube("us-east"),
+             "eu-west": _region_kube("eu-west")}
+    fed = _federation(kubes).start()
+    try:
+        assert fed.wait_covered(timeout_s=15)
+        # eu-west's window is far away: only us-east opens immediately
+        fed.apply_posture(FleetPosture(
+            "on", windows={"eu-west": 60.0}, source="test"))
+        assert _wait(lambda: all(
+            kubes["us-east"].peek_node_label(n, L.CC_MODE_LABEL) == "on"
+            for n in ("us-east-000", "us-east-003")))
+        assert kubes["eu-west"].peek_node_label(
+            "eu-west-000", L.CC_MODE_LABEL) == "off"
+        # evacuate us-east: eu-west absorbs NOW, 60s window be damned
+        entry = fed.evacuate("us-east")
+        assert entry["region"] == "us-east"
+        assert _wait(lambda: all(
+            kubes["eu-west"].peek_node_label(n, L.CC_MODE_LABEL) == "on"
+            for n in ("eu-west-000", "eu-west-003")))
+        assert _wait(lambda: fed.region_cordoned("us-east"))
+        stats = fed.stats()
+        assert stats["evacuated"] == ["us-east"]
+        (evac,) = stats["evacuations"]
+        assert evac["cordoned"] == 4 and evac["cordon_s"] is not None
+        # agents "apply" the flip: state labels land via the watch
+        for n in range(4):
+            kubes["eu-west"].set_node_labels(
+                f"eu-west-{n:03d}", {L.CC_MODE_STATE_LABEL: "on"})
+        assert _wait(lambda: fed.region_converged("eu-west", "on"))
+        assert fed.wait_posture(timeout_s=10)
+        # the zero-read pin: steady-state judging is informer-fed on
+        # BOTH sides — neither region's API server sees another node
+        # GET/LIST, from its own judge or a sibling's
+        reads = {r: kubes[r].node_read_requests for r in kubes}
+        for _ in range(5):
+            assert fed.region_converged("eu-west", "on")
+            assert fed.region_cordoned("us-east")
+            assert not fed.region_converged("us-east", "on")
+        for r in kubes:
+            assert kubes[r].node_read_requests == reads[r], r
+    finally:
+        fed.stop()
+
+
+def test_partitioned_region_defers_posture_write_until_heal():
+    """A partitioned region's desired-state write DEFERS (the window
+    worker retries through ApiException) and lands when the region
+    heals — it never half-lands and never reroutes to a sibling."""
+    kubes = {"us-east": _region_kube("us-east"),
+             "eu-west": _region_kube("eu-west")}
+    fed = _federation(kubes).start()
+    try:
+        assert fed.wait_covered(timeout_s=15)
+        kubes["eu-west"].blackout = True
+        fed.set_partitioned("eu-west", True)
+        fed.apply_posture(FleetPosture("on", source="test"))
+        assert _wait(lambda: kubes["us-east"].peek_node_label(
+            "us-east-000", L.CC_MODE_LABEL) == "on")
+        time.sleep(0.4)  # retries are running; nothing may land
+        assert kubes["eu-west"].peek_node_label(
+            "eu-west-000", L.CC_MODE_LABEL) == "off"
+        assert fed.stats()["partitioned"] == ["eu-west"]
+        kubes["eu-west"].blackout = False
+        fed.set_partitioned("eu-west", False)
+        assert _wait(lambda: kubes["eu-west"].peek_node_label(
+            "eu-west-000", L.CC_MODE_LABEL) == "on")
+    finally:
+        fed.stop()
+
+
+def test_posture_from_policy_reads_region_windows():
+    posture = posture_from_policy({
+        "metadata": {"name": "fleet-posture"},
+        "spec": {"mode": "on",
+                 "nodeSelector": f"{L.TPU_ACCELERATOR_LABEL}",
+                 "regionWindows": {"us-east": 0, "eu-west": 30}},
+    })
+    assert posture.mode == "on"
+    assert posture.windows == {"us-east": 0.0, "eu-west": 30.0}
+    assert posture.source == "fleet-posture"
+    from tpu_cc_manager.policy import PolicySpecError
+
+    with pytest.raises(PolicySpecError, match="regionWindows"):
+        posture_from_policy({
+            "metadata": {"name": "bad"},
+            "spec": {"mode": "on",
+                     "nodeSelector": f"{L.TPU_ACCELERATOR_LABEL}",
+                     "regionWindows": {"eu-west": -1}},
+        })
+
+
+# --------------------------------------------------- per-region trust roots
+def test_trust_domain_rotate_revoke_restore():
+    d = RegionTrustDomain("us-east", (b"root-0",))
+    assert d.keys() == (b"root-0",)
+    d.rotate(b"root-1")
+    # new primary first, old key kept as the rotation tail
+    assert d.keys() == (b"root-1", b"root-0")
+    d.revoke()
+    # revoked = EXPLICITLY keyless (never None/env-fallback): every
+    # quote judges 'unverifiable' and the outage latch can fire
+    assert d.revoked and d.keys() == ()
+    d.restore()
+    assert d.keys() == (b"root-1", b"root-0")
+
+
+def test_revoked_root_latches_outage_in_that_region_only(tmp_path):
+    """THE region_attestation_latch pin at the audit layer: two regions
+    whose quotes verify under their OWN trust domains; revoking region
+    A's root drops A to explicitly-keyless — attestation_outage latches
+    there — while region B's verified count is untouched. The same
+    boundary the federation-2x512 drill exercises live."""
+    import json
+
+    from tpu_cc_manager.attest import FakeTpm
+    from tpu_cc_manager.device.fake import fake_backend
+    from tpu_cc_manager.evidence import audit_evidence, build_evidence
+
+    domains = {r: RegionTrustDomain(r, (f"{r}-root".encode(),))
+               for r in ("us-east", "eu-west")}
+    fleets = {}
+    for region, domain in domains.items():
+        nodes = []
+        for i in range(3):
+            name = f"{region}-{i}"
+            tpm = FakeTpm(state_dir=str(tmp_path / name),
+                          key=domain.keys()[0])
+            doc = build_evidence(name, fake_backend(n_chips=1),
+                                 key=None, identity_provider=None,
+                                 attestor=tpm)
+            nodes.append(make_node(name, labels={
+                L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+                L.CC_MODE_LABEL: "off", L.CC_MODE_STATE_LABEL: "off",
+            }, annotations={L.EVIDENCE_ANNOTATION: json.dumps(doc)}))
+        fleets[region] = nodes
+
+    def audit(region):
+        return audit_evidence(
+            fleets[region], key=None, attestation_seen_before=True,
+            attest_key=domains[region].keys(),
+        )
+
+    for region in domains:  # both regions verify under their own root
+        a = audit(region)
+        assert a["attestation_verified"] == 3, region
+        assert a["attestation_outage"] == [], region
+    # a region's quotes do NOT verify under the sibling's root — the
+    # domains really are separate trust boundaries, not shared keys
+    crossed = audit_evidence(
+        fleets["us-east"], key=None,
+        attest_key=domains["eu-west"].keys(),
+    )
+    assert crossed["attestation_verified"] == 0
+
+    domains["us-east"].revoke()
+    a = audit("us-east")
+    assert a["attestation_verified"] == 0
+    assert a["attestation_outage"] == sorted(
+        n["metadata"]["name"] for n in fleets["us-east"])
+    b = audit("eu-west")  # no spill: the sibling's posture is untouched
+    assert b["attestation_verified"] == 3
+    assert b["attestation_outage"] == []
+
+
+# ------------------------------------------------------- schema-2 scenarios
+def _fed_doc(**over):
+    doc = {
+        "version": 1,
+        "schema": 2,
+        "name": "fed-test",
+        "nodes": 8,
+        "pools": 2,
+        "chips_per_node": 1,
+        "initial_mode": "off",
+        "workers": 4,
+        "qps": 0,
+        "evidence": False,
+        "watch_timeout_s": 2,
+        "regions": [
+            {"name": "region-a", "nodes": 4, "pools": 1},
+            {"name": "region-b", "nodes": 4, "pools": 1},
+        ],
+        "controllers": {"fleet": True},
+        "actions": [
+            {"at": 0.1, "action": "set_mode", "mode": "on"},
+        ],
+        "converge": {"mode": "on", "timeout_s": 30},
+    }
+    doc.update(over)
+    return doc
+
+
+def test_schema2_regions_validate_and_v1_documents_still_parse():
+    sc = validate_scenario(_fed_doc())
+    assert sc.schema == 2
+    assert [r.name for r in sc.regions] == ["region-a", "region-b"]
+    # schema-1 documents (no "schema" key) parse exactly as before
+    v1 = _fed_doc()
+    del v1["schema"], v1["regions"], v1["controllers"]
+    sc1 = validate_scenario(v1)
+    assert sc1.schema == 1 and sc1.regions == ()
+
+
+def test_regions_require_schema_2_and_errors_name_the_source():
+    doc = _fed_doc(schema=1)
+    with pytest.raises(ScenarioError, match='"schema": 2'):
+        validate_scenario(doc)
+    # the strict error carries the offending FILE when source is given
+    with pytest.raises(ScenarioError, match="scenarios/broken.json"):
+        validate_scenario(doc, source="scenarios/broken.json")
+
+
+def test_region_faults_and_windows_are_schema2_gated_and_checked():
+    # a region fault naming an undeclared region is refused
+    with pytest.raises(ScenarioError, match="region"):
+        validate_scenario(_fed_doc(actions=[
+            {"at": 0.1, "action": "fault", "fault": "region_partition",
+             "region": "mars", "heal_after_s": 1.0},
+            {"at": 0.2, "action": "set_mode", "mode": "on"},
+        ]))
+    # region sums must equal the top-level totals every derived knob
+    # (worker split, convergence targets) is computed from
+    with pytest.raises(ScenarioError, match="nodes"):
+        validate_scenario(_fed_doc(nodes=9))
+    # per-region set_mode windows validate region names too
+    with pytest.raises(ScenarioError, match="region"):
+        validate_scenario(_fed_doc(actions=[
+            {"at": 0.1, "action": "set_mode", "mode": "on",
+             "windows": {"mars": 5.0}},
+        ]))
+    ok = validate_scenario(_fed_doc(actions=[
+        {"at": 0.1, "action": "set_mode", "mode": "on",
+         "windows": {"region-a": 0.0, "region-b": 10.0}},
+    ]))
+    assert ok.actions[0].params["windows"] == {
+        "region-a": 0.0, "region-b": 10.0}

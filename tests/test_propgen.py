@@ -52,6 +52,12 @@ def test_generator_covers_every_family():
         doc = generate_episode(seed)
         kinds = {a.get("fault") for a in doc["actions"]
                  if a["action"] == "fault"}
+        if doc.get("regions"):
+            # the exclusive multi-region family (ISSUE 16): its
+            # root_revoked drill is region-scoped, not the attestation
+            # family's env-global one
+            seen.add("federation")
+            continue
         if kinds & {"key_rotation", "root_revoked"}:
             seen.add("attestation")
         if "agent_upgrade" in kinds:

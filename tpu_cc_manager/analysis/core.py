@@ -255,6 +255,7 @@ def _analyze_modules(
     findings.extend(rules.direct_write_findings(modules))
     findings.extend(rules.planner_bypass_findings(modules))
     findings.extend(rules.shard_bypass_findings(modules))
+    findings.extend(rules.region_bypass_findings(modules))
     findings.extend(rules.blocking_in_async_findings(modules))
     findings.extend(rules.poll_in_watch_path_findings(modules))
     return sorted(findings), audits

@@ -1806,6 +1806,105 @@ def shard_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
     return findings
 
 
+# ----------------------------------------------------------- region bypass
+
+
+#: Modules that may hold region-placement state (ISSUE 16):
+#: federation.py itself plus the multi-region simlab lab that embeds
+#: it. Pool->region resolution must go through the ONE sanctioned
+#: lookup (``FederationManager.owner_of`` / ``region_of_pool``, both
+#: riding the region-affine ring walk); subscripting the spec-derived
+#: region table with any other key silently couples a controller to a
+#: sibling region's API server — the cross-region writer the
+#: federation boundary exists to prevent. A deliberate exception
+#: carries ``# ccaudit: allow-region-bypass(reason)``.
+REGION_AWARE_MODULES = frozenset({
+    "tpu_cc_manager/federation.py",
+    "tpu_cc_manager/simlab/federation.py",
+})
+
+#: attribute names that hold the pool->region (or region->pools) table
+_REGION_TABLES = frozenset({
+    "_pool_region", "region_pools",
+})
+
+#: the sanctioned region lookups whose presence in a subscript key
+#: makes the access derived, not hard-coded
+_REGION_LOOKUPS = frozenset({"owner_of", "region_of_pool"})
+
+
+def _uses_region_lookup(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute) and func.attr in _REGION_LOOKUPS:
+            return True
+        if isinstance(func, ast.Name) and func.id in _REGION_LOOKUPS:
+            return True
+    return False
+
+
+def region_bypass_findings(modules: Sequence[Module]) -> List[Finding]:
+    """Flag cross-region placement access outside the sanctioned
+    lookup (``region-bypass``, the shard-bypass rule's federation
+    mirror): subscripting a region table with a key not derived from
+    ``owner_of()`` / ``region_of_pool()`` on the same expression, or
+    calling ``region_of_pool`` with a hard-coded pool literal."""
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.relpath not in REGION_AWARE_MODULES:
+            continue
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Subscript):
+                val = node.value
+                name = None
+                if isinstance(val, ast.Attribute):
+                    name = val.attr
+                elif isinstance(val, ast.Name):
+                    name = val.id
+                if (name in _REGION_TABLES
+                        and not _uses_region_lookup(node.slice)):
+                    hit = (
+                        f"region table {name!r} subscripted without the "
+                        "sanctioned lookup — resolve placement with "
+                        "FederationManager.owner_of(pool) / "
+                        "region_of_pool(pool) (or pragma a deliberate "
+                        "cross-region read with allow-region-bypass "
+                        "naming why)"
+                    )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr == "region_of_pool"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and not _uses_region_lookup(node.args[0])):
+                    hit = (
+                        ".region_of_pool() called with a hard-coded "
+                        "pool literal — the pool->region mapping "
+                        "belongs to the federation spec resolved at "
+                        "runtime, not a constant; a deliberate "
+                        "exception needs an allow-region-bypass "
+                        "pragma naming why"
+                    )
+            if hit is None:
+                continue
+            if mod.suppressed("region-bypass", node.lineno):
+                continue
+            findings.append(
+                Finding(
+                    file=mod.relpath,
+                    line=node.lineno,
+                    rule="region-bypass",
+                    message=hit,
+                    text=mod.line_text(node.lineno),
+                )
+            )
+    return findings
+
+
 # -------------------------------------------------------- poll in watch path
 
 

@@ -423,8 +423,17 @@ def verify_quote(att: dict, expected_nonce: str, *,
         return "mismatch", "event log does not replay to the quoted PCR"
     # key=None resolves the env posture INCLUDING the rotation tail
     # (tpm_keys): during a key rotation the fleet's still-old quotes
-    # must verify under a retired key instead of reading as forgery
-    keys: Tuple[bytes, ...] = tpm_keys() if key is None else (key,)
+    # must verify under a retired key instead of reading as forgery.
+    # A tuple/list is an EXPLICIT posture (per-region trust roots,
+    # federation): its keys verbatim, and an empty one means an
+    # explicitly keyless verifier — 'unverifiable', never env fallback
+    # (a revoked region must not inherit the process-global root).
+    if key is None:
+        keys: Tuple[bytes, ...] = tpm_keys()
+    elif isinstance(key, (tuple, list)):
+        keys = tuple(key)
+    else:
+        keys = (key,)
     if not keys:
         return "unverifiable", (
             "no attestation key provisioned (TPU_CC_TPM_KEY[_FILE]) — "

@@ -514,7 +514,8 @@ EVIDENCE_ISSUE_KEYS = (
 
 def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
                    identity_seen_before: bool = False,
-                   attestation_seen_before: bool = False) -> dict:
+                   attestation_seen_before: bool = False,
+                   attest_key=None) -> dict:
     """Fleet-wide evidence-vs-label audit (run by the fleet controller):
     every node whose ``cc.mode.state`` label claims a successfully
     applied mode must carry evidence that (a) passes integrity
@@ -587,6 +588,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
     att_missing: List[str] = []
     att_mismatch: List[str] = []
     att_unverifiable: List[str] = []
+    att_verified = 0
     saw_identity = False
     saw_verified_identity = False
     saw_attestation = False
@@ -655,8 +657,13 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
         # carry a verified identity and a forged device claim — the
         # TEE quote's measured-history check is what catches the
         # node-root statefile rewrite identity cannot see
+        # attest_key=None keeps the env posture (tpm_keys); an explicit
+        # value scopes this audit to ONE trust domain — a per-region
+        # fleet controller judging quotes against its region's roots,
+        # where an empty tuple is a revoked domain (everything reads
+        # 'unverifiable', feeding the outage latch for THAT region only)
         try:
-            averdict, _ = judge_attestation(doc, name)
+            averdict, _ = judge_attestation(doc, name, key=attest_key)
         except Exception:
             log.debug("attestation judge crashed for %s; counting invalid",
                       name, exc_info=True)
@@ -670,6 +677,7 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
                 # latch (identity's rule: the annotation is hostile
                 # input; a forged quote must not weaponize the alarm)
                 saw_verified_attestation = True
+                att_verified += 1
             if averdict in ("mismatch", "invalid"):
                 att_mismatch.append(name)
             elif averdict == "expired":
@@ -708,6 +716,10 @@ def audit_evidence(nodes: List[dict], key=_RESOLVE_KEY,
     return {
         "identity_seen": saw_verified_identity,  # bool, not a bucket
         "attestation_seen": saw_verified_attestation,  # latch feed
+        # int, not a bucket: the per-scan verified-quote count the
+        # federation invariant reads — a revoked root in region A must
+        # leave region B's number untouched
+        "attestation_verified": att_verified,
         "missing": sorted(missing),
         "unsigned": sorted(unsigned),
         "unverifiable": sorted(unverifiable),

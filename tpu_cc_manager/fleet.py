@@ -278,9 +278,19 @@ class FleetController:
         observer=None,
         informer=None,
         node_filter=None,
+        attest_key=None,
     ):
         self.kube = kube
         self.selector = selector
+        #: optional attestation trust root override (federation.py):
+        #: None keeps the env posture (tpm_keys); a bytes/tuple value —
+        #: or a zero-arg callable returning one, so a region's trust
+        #: domain can rotate/revoke without rebuilding the controller —
+        #: scopes this controller's quote judging to ONE trust domain.
+        #: An empty tuple is a revoked domain: every quote reads
+        #: 'unverifiable' and the outage latch fires for THIS
+        #: controller only, never its siblings in other regions.
+        self.attest_key = attest_key
         #: optional watch.NodeInformer (ISSUE 11): when set, this
         #: controller does NOT open its own node watch — it subscribes
         #: to the shared informer's delta/wake feed instead, and the
@@ -442,9 +452,14 @@ class FleetController:
             # label-vs-device truth: the JAX planner trusts label text;
             # the evidence audit cross-checks it against what each
             # node's agent independently attested (VERDICT r2 item 7)
+            # resolve a callable trust root per scan (federation: the
+            # region's domain may have been revoked since last tick)
+            ak = (self.attest_key() if callable(self.attest_key)
+                  else self.attest_key)
             audit = audit_evidence(
                 nodes, identity_seen_before=self._identity_ever_seen,
                 attestation_seen_before=self._attestation_ever_verified,
+                attest_key=ak,
             )
             self._identity_ever_seen = (
                 self._identity_ever_seen or audit.get("identity_seen", False)

@@ -103,6 +103,17 @@ class FakeKube(KubeClient):
         #: without losing its newest generation (ISSUE 6)
         self.fail_next_node_writes = 0
         self.patch_delay_s = 0.0  # simulated API latency
+        #: regional API blackout (federation, ISSUE 16): while set,
+        #: every API verb answers 503 and in-flight watches sever —
+        #: the whole control plane of ONE region going dark. Driver
+        #: out-of-band surfaces (peek_node_label, add_node,
+        #: set_node_labels_direct) stay up: measurement and scenario
+        #: input must survive the fault they script.
+        self.blackout = False
+        #: inter-region latency skew (federation): a flat per-request
+        #: delay on every API verb, slept OUTSIDE the store lock so a
+        #: slow region slows its callers, never its own event fan-out
+        self.response_delay_s = 0.0
         # Write accounting (ISSUE 6 satellite): batching merges several
         # LOGICAL mutations into one HTTP round trip, so "requests" and
         # "mutations" are now different numbers — counting only requests
@@ -221,6 +232,17 @@ class FakeKube(KubeClient):
                 raise ApiException(404, f"node {name} not found")
             return (node["metadata"].get("labels") or {}).get(key)
 
+    def _fault_gate(self) -> None:
+        """Region-fault front door, called at the ENTRY of every API
+        verb BEFORE the lock: latency skew sleeps here (out of lock —
+        a slow region must not serialize its own watchers), then a
+        blackout answers 503 like a dead regional control plane."""
+        delay = self.response_delay_s
+        if delay:
+            time.sleep(delay)
+        if self.blackout:
+            raise ApiException(503, "injected regional API blackout")
+
     def _check_node_write_fault(self) -> None:
         """429 the next N node writes when armed (caller holds _lock)."""
         self.node_write_requests += 1
@@ -248,6 +270,7 @@ class FakeKube(KubeClient):
 
     # ------------------------------------------------------------- nodes
     def get_node(self, name: str) -> dict:
+        self._fault_gate()
         with self._lock:
             self.node_read_requests += 1
             node = self._nodes.get(name)
@@ -256,6 +279,7 @@ class FakeKube(KubeClient):
             return copy.deepcopy(node)
 
     def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
+        self._fault_gate()
         with self._lock:
             self.node_read_requests += 1
             if self.fail_next_lists > 0:
@@ -306,6 +330,7 @@ class FakeKube(KubeClient):
             return copy.deepcopy(merged)
 
     def patch_node(self, name: str, patch: dict) -> dict:
+        self._fault_gate()
         if self.patch_delay_s:
             time.sleep(self.patch_delay_s)
         with self._lock:
@@ -322,6 +347,7 @@ class FakeKube(KubeClient):
             return copy.deepcopy(merged)
 
     def replace_node(self, name: str, node: dict) -> dict:
+        self._fault_gate()
         with self._lock:
             cur = self._nodes.get(name)
             if cur is None:
@@ -342,6 +368,7 @@ class FakeKube(KubeClient):
 
     # ------------------------------------------------------------- leases
     def get_lease(self, namespace: str, name: str) -> dict:
+        self._fault_gate()
         with self._lock:
             lease = self._leases.get((namespace, name))
             if lease is None:
@@ -349,6 +376,7 @@ class FakeKube(KubeClient):
             return copy.deepcopy(lease)
 
     def create_lease(self, namespace: str, lease: dict) -> dict:
+        self._fault_gate()
         with self._lock:
             name = lease["metadata"]["name"]
             if (namespace, name) in self._leases:
@@ -363,6 +391,7 @@ class FakeKube(KubeClient):
 
     def replace_lease(self, namespace: str, name: str,
                       lease: dict) -> dict:
+        self._fault_gate()
         with self._lock:
             cur = self._leases.get((namespace, name))
             if cur is None:
@@ -426,6 +455,7 @@ class FakeKube(KubeClient):
             self._lock.notify_all()
 
     def evict_pod(self, namespace: str, name: str) -> None:
+        self._fault_gate()
         with self._lock:
             if (namespace, name) in self.pdb_blocked:
                 raise ApiException(429, "Cannot evict pod: PodDisruptionBudget")
@@ -435,6 +465,7 @@ class FakeKube(KubeClient):
             self._lock.notify_all()
 
     def create_event(self, namespace: str, event: dict) -> dict:
+        self._fault_gate()
         with self._lock:
             stored = copy.deepcopy(event)
             body_ns = stored.get("metadata", {}).get("namespace")
@@ -482,6 +513,7 @@ class FakeKube(KubeClient):
     def list_cluster_custom(
         self, group: str, version: str, plural: str
     ) -> List[dict]:
+        self._fault_gate()
         with self._lock:
             return sorted(
                 (
@@ -495,6 +527,7 @@ class FakeKube(KubeClient):
     def get_cluster_custom(
         self, group: str, version: str, plural: str, name: str
     ) -> dict:
+        self._fault_gate()
         with self._lock:
             obj = self._customs.get((group, plural, name))
             if obj is None:
@@ -512,6 +545,7 @@ class FakeKube(KubeClient):
         patch: dict,
         subresource: Optional[str] = None,
     ) -> dict:
+        self._fault_gate()
         with self._lock:
             cur = self._customs.get((group, plural, name))
             if cur is None:
@@ -566,9 +600,14 @@ class FakeKube(KubeClient):
         / server-timeout semantics as watch_nodes. No 410 compaction
         model here (policy objects are few and slow-moving); a caller
         that falls behind simply re-lists."""
+        self._fault_gate()
         deadline = time.monotonic() + timeout_s
         last_rv = int(resource_version) if resource_version is not None else None
         while True:
+            if self.blackout:
+                # sever in-flight CR watches too: a blacked-out region
+                # streams nothing
+                raise ApiException(503, "injected regional API blackout")
             with self._lock:
                 if last_rv is None:
                     last_rv = self._rv
@@ -601,6 +640,7 @@ class FakeKube(KubeClient):
         shape) and :meth:`watch_nodes_wire` (pre-encoded apiserver fan
         out) are thin views over it, so the rv/410/timeout semantics
         cannot drift between the two."""
+        self._fault_gate()
         with self._lock:
             if self.fail_next_watches > 0:
                 self.fail_next_watches -= 1
@@ -611,6 +651,10 @@ class FakeKube(KubeClient):
         establishing = True
 
         while True:
+            if self.blackout:
+                # sever the in-flight stream: a blacked-out region's
+                # watchers see a broken watch and retry into the 503s
+                raise ApiException(503, "injected regional API blackout")
             bookmark = None
             with self._lock:
                 if last_rv is None:

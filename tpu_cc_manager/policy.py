@@ -207,6 +207,34 @@ def parse_policy_spec(policy: dict) -> dict:
             _parse_hhmm(raw_window.get("end"),
                         "spec.strategy.window.end"),
         )
+    # federation (ISSUE 16): ONE policy CR can stagger its rollout per
+    # region — {region: offset-seconds}. Regions absent from the map
+    # open immediately (offset 0); federation.py consumes this as the
+    # posture's window schedule. Orthogonal to strategy.window, which
+    # stays the wall-clock maintenance gate.
+    region_windows: dict = {}
+    raw_rw = spec.get("regionWindows")
+    if raw_rw is not None:
+        if not isinstance(raw_rw, dict):
+            raise PolicySpecError(
+                "spec.regionWindows must be {region: offsetSeconds}"
+            )
+        for region, offset in raw_rw.items():
+            if not isinstance(region, str) or not region:
+                raise PolicySpecError(
+                    "spec.regionWindows keys must be region names"
+                )
+            if isinstance(offset, bool) or not isinstance(
+                    offset, (int, float)):
+                raise PolicySpecError(
+                    f"spec.regionWindows[{region!r}] must be a number "
+                    "of seconds"
+                )
+            if offset < 0:
+                raise PolicySpecError(
+                    f"spec.regionWindows[{region!r}] must be >= 0"
+                )
+            region_windows[region] = float(offset)
     return {
         "mode": mode,
         "selector": selector,
@@ -217,6 +245,7 @@ def parse_policy_spec(policy: dict) -> dict:
         "canary": canary,
         "window": window,
         "window_raw": raw_window,
+        "region_windows": region_windows,
     }
 
 

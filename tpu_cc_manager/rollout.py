@@ -206,6 +206,21 @@ def record_node_names(record: dict) -> set:
     return names
 
 
+def desired_patch_body(mode: str, traceparent: Optional[str]) -> dict:
+    """The canonical desired-write patch: desired-mode label plus the
+    trace annotation IN THE SAME WRITE (zero extra round trips; the
+    agent's reconcile adopts the trace id from the patch that caused
+    it). Every code path that sets desired state — the rollout engine's
+    group launch, federation's per-region posture writes — must build
+    its patch here, or the flight-recorder stitch loses the
+    desired-write → state-publish edge. ``traceparent=None`` clears a
+    stale annotation (rollback paths)."""
+    return {"metadata": {
+        "labels": {L.CC_MODE_LABEL: mode},
+        "annotations": {L.CC_TRACE_ANNOTATION: traceparent},
+    }}
+
+
 @dataclasses.dataclass
 class GroupResult:
     name: str
@@ -1425,10 +1440,9 @@ class Rollout:
             context = format_traceparent(span)
             for m in members:
                 try:
-                    self.kube.patch_node(m, {"metadata": {
-                        "labels": {L.CC_MODE_LABEL: self.mode},
-                        "annotations": {L.CC_TRACE_ANNOTATION: context},
-                    }})
+                    self.kube.patch_node(
+                        m, desired_patch_body(self.mode, context)
+                    )
                     patched.append(m)
                 except ApiException as e:
                     log.error("could not label %s: %s", m, e)

@@ -83,16 +83,31 @@ class HashRing:
     ``owner_of(key)`` walks clockwise from the key's hash to the first
     virtual node; removing a member reassigns ONLY that member's arcs
     (``without()`` — the failover/scale-down movement bound the tests
-    pin). Construction is deterministic across processes."""
+    pin). Construction is deterministic across processes.
+
+    Region affinity (federation, ISSUE 16): members may carry a region
+    tag (``regions={member: region}``). ``owner_of(key, region=r)``
+    walks clockwise to the first virtual node belonging to a member OF
+    THAT REGION — the global vnode order is untouched, so the walk is
+    still deterministic across processes, removing one member still
+    moves only ~1/N of the region's keys (its arcs redistribute among
+    the region's survivors), and a region with no members left falls
+    back to the plain global walk: failover leaves the home region
+    ONLY when the whole region is down."""
 
     def __init__(self, members: Sequence[str],
-                 vnodes: int = DEFAULT_VNODES) -> None:
+                 vnodes: int = DEFAULT_VNODES,
+                 regions: Optional[Dict[str, str]] = None) -> None:
         if not members:
             raise ValueError("a hash ring needs at least one member")
         if len(set(members)) != len(members):
             raise ValueError(f"duplicate ring members: {sorted(members)}")
         self.members = tuple(members)
         self.vnodes = vnodes
+        self.regions: Dict[str, str] = dict(regions or {})
+        stray = sorted(set(self.regions) - set(self.members))
+        if stray:
+            raise ValueError(f"region tags for non-members: {stray}")
         points = []
         for m in members:
             for v in range(vnodes):
@@ -100,33 +115,64 @@ class HashRing:
         points.sort()
         self._points = points
         self._hashes = [h for h, _ in points]
+        self._region_members: Dict[str, frozenset] = {}
+        by_region: Dict[str, set] = {}
+        for m in self.members:
+            r = self.regions.get(m)
+            if r is not None:
+                by_region.setdefault(r, set()).add(m)
+        self._region_members = {
+            r: frozenset(ms) for r, ms in by_region.items()
+        }
 
-    def owner_of(self, key: str) -> str:
+    def owner_of(self, key: str, region: Optional[str] = None) -> str:
         """The member owning ``key`` — the one true pool->shard lookup
-        (ccaudit's shard-bypass rule treats partition access without it
-        as a finding)."""
+        (ccaudit's shard-bypass and region-bypass rules treat partition
+        access without it as a finding). With ``region``, the walk is
+        constrained to that region's members (home-region placement);
+        an empty/unknown region falls back to the global walk."""
         h = _hash64(key)
         i = bisect.bisect_right(self._hashes, h)
         if i == len(self._points):
             i = 0
-        return self._points[i][1]
+        if region is None or region not in self._region_members:
+            return self._points[i][1]
+        n = len(self._points)
+        for step in range(n):
+            m = self._points[(i + step) % n][1]
+            if self.regions.get(m) == region:
+                return m
+        return self._points[i][1]  # unreachable: region set non-empty
 
-    def partition(self, keys: Sequence[str]) -> Dict[str, List[str]]:
+    def partition(self, keys: Sequence[str],
+                  region_of: Optional[Callable[[str], Optional[str]]]
+                  = None) -> Dict[str, List[str]]:
         """All members' partitions at once: member -> sorted keys
-        (members owning nothing map to an empty list)."""
+        (members owning nothing map to an empty list). ``region_of``
+        maps a key to its home region for region-affine placement."""
         out: Dict[str, List[str]] = {m: [] for m in self.members}
         for key in keys:
-            out[self.owner_of(key)].append(key)
+            region = region_of(key) if region_of is not None else None
+            out[self.owner_of(key, region=region)].append(key)
         for v in out.values():
             v.sort()
         return out
 
+    def members_in(self, region: str) -> List[str]:
+        """The ring members tagged with ``region`` (sorted)."""
+        return sorted(self._region_members.get(region, ()))
+
+    def region_of(self, member: str) -> Optional[str]:
+        return self.regions.get(member)
+
     def without(self, member: str) -> "HashRing":
         """The ring minus one member (scale-down / permanent loss):
         only the removed member's keys move — the consistent-hash
-        property the partition layer exists for."""
+        property the partition layer exists for. Region tags survive."""
         rest = [m for m in self.members if m != member]
-        return HashRing(rest, vnodes=self.vnodes)
+        return HashRing(rest, vnodes=self.vnodes, regions={
+            m: r for m, r in self.regions.items() if m != member
+        })
 
 
 class ShardScopedClient:
@@ -193,6 +239,11 @@ class ControllerShard:
             port=0,
             informer=manager.informer,
             node_filter=in_partition,
+            # per-region attestation trust root (federation, ISSUE 16):
+            # the audit verifies quotes under THIS manager's explicit
+            # key posture instead of the process-global env — a revoked
+            # root in one region latches only that region's shards
+            attest_key=manager.attest_key,
         )
         self.policy = None
         if manager.policy:
@@ -407,7 +458,7 @@ class ShardManager:
         self,
         client_factory: Callable[[], object],
         *,
-        shards: int,
+        shards: Optional[int] = None,
         pools: Sequence[str],
         pool_label: str,
         hosts: Optional[int] = None,
@@ -423,12 +474,33 @@ class ShardManager:
         renew_period_s: float = 0.5,
         retry_period_s: float = 0.25,
         port: int = 0,
+        shard_ids: Optional[Sequence[str]] = None,
+        ring: Optional[HashRing] = None,
+        attest_key=None,
+        region: Optional[str] = None,
     ) -> None:
-        if shards < 1:
-            raise ValueError(f"shards must be >= 1, got {shards}")
+        if shard_ids is not None:
+            if not shard_ids:
+                raise ValueError("shard_ids must be non-empty")
+            self.shard_ids = list(shard_ids)
+            shards = len(self.shard_ids)
+        else:
+            if shards is None or shards < 1:
+                raise ValueError(f"shards must be >= 1, got {shards}")
+            self.shard_ids = [f"shard-{k}" for k in range(shards)]
         self.client_factory = client_factory
-        self.shard_ids = [f"shard-{k}" for k in range(shards)]
-        self.ring = HashRing(self.shard_ids)
+        #: ring injection (federation, ISSUE 16): the federation layer
+        #: hands each region's manager a region-scoped view of ONE
+        #: federation-wide region-affine ring, so every host agrees on
+        #: placement without a second hashing scheme. Default: a plain
+        #: private ring over this manager's shard ids.
+        self.ring = ring if ring is not None else HashRing(self.shard_ids)
+        #: explicit attestation verifier posture for this manager's
+        #: fleet controllers (None = process-global env resolution) —
+        #: the per-region trust-root boundary
+        self.attest_key = attest_key
+        #: region tag (stats/logs only; placement is the ring's job)
+        self.region = region
         self.pools = list(pools)
         self.pool_label = pool_label
         self.selector = selector
@@ -446,8 +518,14 @@ class ShardManager:
         self.renew_period_s = renew_period_s
         self.retry_period_s = retry_period_s
         #: the ONE watch stream + read cache every shard's controllers
-        #: share (ISSUE 11: informer-fed scans, zero node read RPCs)
-        self.informer = NodeInformer(client_factory(), name="shards")
+        #: share (ISSUE 11: informer-fed scans, zero node read RPCs).
+        #: In a federation each region's manager owns its own informer
+        #: against its home API server — the per-region judge reads
+        #: this cache and never crosses a region boundary.
+        self.informer = NodeInformer(
+            client_factory(),
+            name=f"shards-{region}" if region else "shards",
+        )
         self._partition = self.ring.partition(self.pools)
         self.hosts = [ShardHost(self, i) for i in range(self.n_hosts)]
         self.metrics = ShardMetrics()
@@ -633,6 +711,7 @@ class ShardManager:
         with self._lock:
             failovers = [dict(f) for f in self._failovers]
         return {
+            "region": self.region,
             "shards": len(self.shard_ids),
             "hosts": self.n_hosts,
             "hosts_live": sum(1 for h in self.hosts if h.alive),

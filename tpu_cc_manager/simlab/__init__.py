@@ -70,7 +70,13 @@ def main_from_args(args) -> int:
             sc = sc.scaled_to(args.nodes)
         if args.workers:
             sc = sc.with_workers(args.workers)
-        artifact = SimLab(sc).run()
+        if sc.regions:
+            # schema-2 multi-region scenario: N API servers, one fleet
+            from tpu_cc_manager.simlab.federation import FederationLab
+
+            artifact = FederationLab(sc).run()
+        else:
+            artifact = SimLab(sc).run()
         if args.out:
             write_artifact(args.out, artifact)
         print(json.dumps(artifact, sort_keys=True))

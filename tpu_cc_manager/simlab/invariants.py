@@ -27,6 +27,10 @@ The catalog (stable ids — shrink targets and reports key on them):
   attestation_outage problem and the fleet never reads verified again
 - ``attestation_rotation`` — after a key rotation every node's settled
   evidence re-verifies under the NEW primary alone (no mismatch tail)
+- ``region_attestation_latch`` — a region-scoped root revocation
+  (federation) latches attestation_outage in the revoked region ONLY:
+  sibling regions keep verifying, and the revoked region never reads
+  verified again
 - ``policy_conflict``  — the rival overlapping policy is parked in
   phase Conflicted; the owner is healthy
 - ``upgrade_completeness`` — every upgraded replica is alive and its
@@ -66,6 +70,9 @@ INVARIANTS: Dict[str, str] = {
                           "attestation_outage problem",
     "attestation_rotation": "rotated-key evidence re-verifies under "
                             "the new primary alone",
+    "region_attestation_latch": "a revoked region trust root latches "
+                                "attestation_outage in THAT region "
+                                "only — no spill, no spare",
     "policy_conflict": "the rival overlapping policy parks in phase "
                        "Conflicted; the owner stays healthy",
     "upgrade_completeness": "every upgraded replica is alive and "
@@ -386,6 +393,64 @@ def _check_attestation_rotation(lab, artifact,
         ))
 
 
+def _check_region_attestation(lab, artifact,
+                              out: List[Violation]) -> None:
+    """The federation trust-domain boundary (ISSUE 16): judged from
+    the artifact's ``metrics.federation.attestation`` block — the
+    FederationLab has no single store or env-global attest lab, so the
+    per-region audits ARE the evidence surface."""
+    fed = (artifact.get("metrics") or {}).get("federation") or {}
+    att = fed.get("attestation") or {}
+    revokes = [f for f in _fault_entries(artifact, "root_revoked")
+               if f.get("regions_revoked")]
+    if not att or not revokes:
+        return
+    if not any(f.get("armed_before_revoke") for f in revokes):
+        out.append(Violation(
+            "region_attestation_latch",
+            "the region root was revoked before any of its fleet scans "
+            "had verified a quote — the latch never armed, so the "
+            "drill proved nothing (schedule the revocation later)",
+        ))
+        return
+    revoked_regions = set()
+    for f in revokes:
+        revoked_regions.update(f["regions_revoked"])
+    for region, a in sorted(att.items()):
+        if region in revoked_regions:
+            if not a.get("revoked"):
+                out.append(Violation(
+                    "region_attestation_latch",
+                    f"region {region}: root_revoked fired but the "
+                    "region's trust domain reads unrevoked",
+                ))
+            if not a.get("attestation_outage"):
+                out.append(Violation(
+                    "region_attestation_latch",
+                    f"region {region}: trust root revoked on a "
+                    "once-verified region but its final audit filled "
+                    "no attestation_outage bucket",
+                ))
+        else:
+            # the non-spill half: a sibling's revocation must never
+            # reach this region's verifier or its verified count
+            if a.get("attestation_outage"):
+                out.append(Violation(
+                    "region_attestation_latch",
+                    f"region {region}: attestation_outage latched "
+                    "without a revocation — a sibling region's revoked "
+                    "root spilled across the trust-domain boundary",
+                    tuple(a.get("attestation_outage") or ()),
+                ))
+            if a.get("attestation_seen") and not a.get(
+                    "attestation_verified"):
+                out.append(Violation(
+                    "region_attestation_latch",
+                    f"region {region}: lost all quote verification "
+                    "though its own root was never revoked",
+                ))
+
+
 def _check_policy_conflict(lab, artifact, out: List[Violation]) -> None:
     conflicts = _fault_entries(artifact, "policy_conflict")
     if not conflicts:
@@ -512,6 +577,7 @@ def check_run(lab, artifact,
     _check_forged_evidence(lab, artifact, out)
     _check_attestation_outage(lab, artifact, out)
     _check_attestation_rotation(lab, artifact, out)
+    _check_region_attestation(lab, artifact, out)
     _check_policy_conflict(lab, artifact, out)
     _check_upgrade(lab, artifact, out)
     _check_evacuation(lab, artifact, out)

@@ -53,8 +53,12 @@ from tpu_cc_manager.simlab.scenario import (
 log = logging.getLogger("tpu-cc-manager.simlab.propgen")
 
 #: the lifecycle fault families the generator composes (ISSUE 12);
-#: "attestation" covers both the key_rotation and root_revoked drills
-FAMILIES = ("upgrade", "attestation", "policy", "evacuation", "shards")
+#: "attestation" covers both the key_rotation and root_revoked drills.
+#: "federation" (ISSUE 16) generates schema-2 multi-region episodes —
+#: region partitions/blackouts/latency skews, region evacuations, and
+#: the region-scoped revoked-root drill — run through FederationLab.
+FAMILIES = ("upgrade", "attestation", "policy", "evacuation", "shards",
+            "federation")
 
 #: desired modes the generator draws from (never "ici": slice
 #: semantics need multi-host topology the generated fleets don't have)
@@ -117,6 +121,10 @@ def generate_episode(seed: int,
         unknown = chosen - set(FAMILIES)
         if unknown:
             raise ValueError(f"unknown families: {sorted(unknown)}")
+    if "federation" in chosen:
+        # exclusive family: the multi-region lab drives region faults
+        # and postures only — single-server fault kinds don't compose
+        chosen = {"federation"}
     wave_mode, converge_mode = _pick_modes(rng)
     nodes = rng.choice((8, 10, 12, 16))
     pools = rng.choice((2, 4)) if nodes >= 8 else 1
@@ -136,7 +144,53 @@ def generate_episode(seed: int,
     actions: List[dict] = []
     controllers: dict = {}
 
-    if "attestation" in chosen:
+    if "federation" in chosen:
+        # schema-2 multi-region episode (ISSUE 16): two regions, ONE
+        # posture with per-region windows, plus either a region fault
+        # racing the rollout or the region-scoped revoked-root drill
+        nodes = rng.choice((8, 12, 16))
+        half = nodes // 2
+        doc.update({
+            "schema": 2,
+            "nodes": nodes,
+            "pools": 2,
+            "regions": [
+                {"name": "region-a", "nodes": half, "pools": 1},
+                {"name": "region-b", "nodes": nodes - half, "pools": 1},
+            ],
+        })
+        controllers["fleet"] = True
+        if rng.random() < 0.4:
+            # region latch drill: converge first (the fault waits for
+            # THAT region's fleet scans to verify a quote), then pull
+            # ONE region's trust root — the oracle pins the non-spill
+            doc["evidence"] = True
+            doc["attestation"] = True
+            actions.append({"at": 0.2, "action": "set_mode",
+                            "mode": converge_mode})
+            actions.append({"at": 2.0, "action": "fault",
+                            "fault": "root_revoked",
+                            "region": "region-a"})
+        else:
+            actions.append({
+                "at": 0.2, "action": "set_mode", "mode": converge_mode,
+                "windows": {"region-a": 0,
+                            "region-b": rng.choice((0.3, 0.6))},
+            })
+            fault = rng.choice((
+                {"fault": "region_partition", "region": "region-b",
+                 "duration_s": rng.choice((0.5, 1.0))},
+                {"fault": "region_blackout", "region": "region-b",
+                 "duration_s": rng.choice((0.5, 1.0))},
+                {"fault": "region_latency_skew", "region": "region-b",
+                 "delay_s": 0.05,
+                 "duration_s": rng.choice((0.5, 1.0))},
+                {"fault": "region_evacuate", "region": "region-a"},
+            ))
+            fault.update({"at": round(rng.uniform(0.3, 0.7), 2),
+                          "action": "fault"})
+            actions.append(fault)
+    elif "attestation" in chosen:
         doc["evidence"] = True
         doc["attestation"] = True
         controllers["fleet"] = True
@@ -197,7 +251,7 @@ def generate_episode(seed: int,
             "count": max(1, nodes // 3),
             "duration_s": rng.choice((0.8, 1.5)),
         })
-    if "attestation" not in chosen and "policy" not in chosen:
+    if not chosen & {"attestation", "policy", "federation"}:
         actions.extend(_infra_extras(rng, nodes))
 
     if controllers:
@@ -229,12 +283,15 @@ def run_episode(doc: dict, *,
     during the run (post-hoc state can't see a transient split brain);
     fleet scans are accelerated (TPU_CC_FLEET_MIN_SCAN_GAP_S) so the
     attestation latch arms inside episode time."""
+    from tpu_cc_manager.simlab.federation import FederationLab
     from tpu_cc_manager.simlab.runner import SimLab
 
     sc = validate_scenario(doc)
     prior_gap = os.environ.get("TPU_CC_FLEET_MIN_SCAN_GAP_S")
     os.environ["TPU_CC_FLEET_MIN_SCAN_GAP_S"] = "0.5"
-    lab = SimLab(sc)
+    # schema-2 regions episodes run the multi-region lab (its artifact
+    # carries the metrics.federation block the region invariants read)
+    lab = FederationLab(sc) if sc.regions else SimLab(sc)
     stop = threading.Event()
     probe_hits: List[Violation] = []
 

@@ -57,6 +57,7 @@ def build_artifact(
     shards: Optional[dict] = None,
     lifecycle: Optional[dict] = None,
     kube_io: Optional[dict] = None,
+    federation: Optional[dict] = None,
     notes: Optional[str] = None,
 ) -> dict:
     metrics = {
@@ -102,6 +103,17 @@ def build_artifact(
         # replays accounting — dials << requests is the multiplexing
         # the mode exists to prove
         metrics["kube_io"] = kube_io
+    if federation is not None:
+        # the multi-region block (federation.py, ISSUE 16): per-region
+        # node-read ledgers (the zero-cross-region-reads evidence),
+        # posture + evacuation record, per-region attestation audit,
+        # and — when a region_evacuate fault fired AND the fleet
+        # stabilized — the region_evac_convergence_s axis the bench
+        # trend gate compares (absent on a failed drill, never a lie)
+        metrics["federation"] = federation
+        if "region_evac_convergence_s" in federation:
+            metrics["region_evac_convergence_s"] = federation[
+                "region_evac_convergence_s"]
     if slo is not None:
         # the fleet observatory's verdict (fleetobs.py, ISSUE 9):
         # per-objective burn rates + budget remaining, the alert log,

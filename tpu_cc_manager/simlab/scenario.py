@@ -27,6 +27,20 @@ from tpu_cc_manager.modes import InvalidModeError, Mode, parse_mode
 #: a future reader can refuse documents it does not understand
 SCENARIO_VERSION = 1
 
+#: additive schema revision (ISSUE 16): ``"schema": 2`` unlocks the
+#: federation surface (``regions``, region faults, per-region set_mode
+#: windows). Deliberately a SEPARATE key from ``version`` — version
+#: stays the breaking-change gate pinned at 1 (a v2 *version* must
+#: still be refused), while schema is the opt-in for additions a v1
+#: reader would reject as unknown keys.
+SCENARIO_SCHEMA_MAX = 2
+
+#: fault kinds that only exist under schema 2 + ``regions``
+REGION_FAULTS = frozenset({
+    "region_partition", "region_blackout", "region_latency_skew",
+    "region_evacuate",
+})
+
 #: fault kind -> {param: (required, type(s))}
 FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
     # crash N replicas; they stop reconciling and restart (re-reading
@@ -84,7 +98,11 @@ FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
     # (statefile-rewrite analog) on one already-converged node, which
     # must land in attestation_mismatch, never be accepted, and never
     # flip a chip (requires `attestation` + a fleet audit plane)
-    "root_revoked": {"forge": (False, bool)},
+    "root_revoked": {"forge": (False, bool),
+                     # schema 2: revoke ONE region's trust domain
+                     # instead of the process-global root — the
+                     # region_attestation_latch invariant's input
+                     "region": (False, str)},
     # two policies claiming overlapping pools: an owner policy (first
     # in name order) selecting the whole fleet and a rival selecting
     # one pool. The name-ordered conflict rule must park the rival in
@@ -109,13 +127,36 @@ FAULT_PARAMS: Dict[str, Dict[str, tuple]] = {
                      "count": (False, int),
                      "pool": (False, int),
                      "duration_s": (False, (int, float))},
+    # ---- federation fault family (ISSUE 16, schema 2 + regions) ------
+    # region partition: the region's API server refuses every verb
+    # (503) for duration_s — posture writes must defer and land when
+    # it heals; the other regions keep converging
+    "region_partition": {"region": (True, str),
+                         "duration_s": (False, (int, float))},
+    # regional API blackout: same 503 front door, scripted as the
+    # total-control-plane-outage variant (in-flight watches sever too)
+    "region_blackout": {"region": (True, str),
+                        "duration_s": (False, (int, float))},
+    # inter-region latency skew: every API verb in the region pays
+    # delay_s before answering (slept outside the store lock)
+    "region_latency_skew": {"region": (True, str),
+                            "delay_s": (True, (int, float)),
+                            "duration_s": (False, (int, float))},
+    # first-class region evacuation: park the region's posture writes,
+    # cordon its nodes, collapse every other region's window to NOW —
+    # the evac-races-upgrade interleaving is this at mid-rollout
+    "region_evacuate": {"region": (True, str)},
 }
 
 #: action kind -> {param: (required, type(s))}; "fault" params are
 #: validated separately against FAULT_PARAMS
 ACTION_PARAMS: Dict[str, Dict[str, tuple]] = {
-    # patch the desired-mode label on every node (or one pool)
-    "set_mode": {"mode": (True, str), "pool": (False, int)},
+    # patch the desired-mode label on every node (or one pool).
+    # ``windows`` (schema 2 + regions): {region: offset seconds} —
+    # per-region rollout windows for ONE posture, federation.py's
+    # FleetPosture.windows verbatim
+    "set_mode": {"mode": (True, str), "pool": (False, int),
+                 "windows": (False, dict)},
     # create a TPUCCPolicy covering every node (or one pool); requires
     # controllers.policy
     "create_policy": {"mode": (True, str), "pool": (False, int),
@@ -155,6 +196,20 @@ class Converge:
 
 
 @dataclasses.dataclass(frozen=True)
+class RegionDef:
+    """One federation region (schema 2): its own FakeApiServer, its
+    slice of the fleet's nodes and pools, its own attestation trust
+    domain. The top-level ``nodes``/``pools`` stay the fleet totals
+    (and must equal the region sums) so every nodes-derived knob —
+    CLI overrides, bench axes, fault count clamps — keeps meaning
+    what it always meant."""
+
+    name: str
+    nodes: int
+    pools: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class Scenario:
     name: str
     nodes: int
@@ -174,11 +229,20 @@ class Scenario:
     attestation: bool = False
     watch_timeout_s: float = 10.0
     controllers: Controllers = Controllers()
+    #: schema revision the document declared (1 when absent)
+    schema: int = 1
+    #: federation regions (schema 2); empty = the classic one-server lab
+    regions: tuple = ()
 
     def scaled_to(self, nodes: int) -> "Scenario":
         """CLI --nodes override (fault counts are clamped at runtime)."""
         if nodes < 1:
             raise ScenarioError(f"nodes override must be >= 1, got {nodes}")
+        if self.regions:
+            raise ScenarioError(
+                "--nodes cannot override a regions scenario (the "
+                "per-region node split is part of the document)"
+            )
         return dataclasses.replace(self, nodes=nodes)
 
     def with_workers(self, workers: int) -> "Scenario":
@@ -279,16 +343,28 @@ def _validate_action(raw: dict, idx: int, pools: int) -> Action:
     return Action(at=float(at), kind=kind, params=params)
 
 
-def validate_scenario(doc: dict) -> Scenario:
+def validate_scenario(doc: dict, source: str = None) -> Scenario:
     """Validate one parsed scenario document -> :class:`Scenario`.
     Raises :class:`ScenarioError` with a precise message on the first
-    violation."""
+    violation; ``source`` (the scenario file's path) prefixes every
+    message so a CI sweep over scenarios/ names the offending FILE,
+    not just the offending key."""
+    try:
+        return _validate_scenario(doc)
+    except ScenarioError as e:
+        if source:
+            raise ScenarioError(f"{source}: {e}") from None
+        raise
+
+
+def _validate_scenario(doc: dict) -> Scenario:
     if not isinstance(doc, dict):
         raise ScenarioError("scenario must be a JSON object")
     allowed = {
-        "version", "name", "nodes", "pools", "chips_per_node",
+        "version", "schema", "name", "nodes", "pools", "chips_per_node",
         "initial_mode", "workers", "qps", "evidence", "attestation",
         "watch_timeout_s", "controllers", "actions", "converge",
+        "regions",
     }
     _reject_unknown(doc, allowed, "scenario")
     if doc.get("version") != SCENARIO_VERSION:
@@ -296,6 +372,21 @@ def validate_scenario(doc: dict) -> Scenario:
             f"version must be {SCENARIO_VERSION}, got "
             f"{doc.get('version')!r} (refusing a schema this reader "
             "does not understand)"
+        )
+    # 'schema' is the ADDITIVE revision: absent = 1 (pre-federation
+    # documents), 2 unlocks 'regions' and the region fault family.
+    # Anything else is a document from the future — refuse it.
+    schema = doc.get("schema", 1)
+    if isinstance(schema, bool) or not isinstance(schema, int) or \
+            not (1 <= schema <= SCENARIO_SCHEMA_MAX):
+        raise ScenarioError(
+            f"schema must be an int in [1, {SCENARIO_SCHEMA_MAX}], got "
+            f"{schema!r}"
+        )
+    if "regions" in doc and schema < 2:
+        raise ScenarioError(
+            "regions requires \"schema\": 2 (a schema-1 reader would "
+            "reject the key)"
         )
     _typed(doc, {
         "name": (True, str),
@@ -320,6 +411,47 @@ def validate_scenario(doc: dict) -> Scenario:
     if not (1 <= chips <= 8):
         raise ScenarioError(
             f"chips_per_node must be in [1, 8], got {chips}")
+    regions: List[RegionDef] = []
+    raw_regions = doc.get("regions")
+    if raw_regions is not None:
+        if not isinstance(raw_regions, list) or not raw_regions:
+            raise ScenarioError("regions must be a non-empty array")
+        for i, raw in enumerate(raw_regions):
+            where = f"regions[{i}]"
+            if not isinstance(raw, dict):
+                raise ScenarioError(f"{where}: must be an object")
+            _reject_unknown(raw, {"name", "nodes", "pools"}, where)
+            _typed(raw, {"name": (True, str), "nodes": (True, int),
+                         "pools": (False, int)}, where)
+            if not raw["name"]:
+                raise ScenarioError(f"{where}: name must be non-empty")
+            if raw["nodes"] < 1:
+                raise ScenarioError(f"{where}: nodes must be >= 1")
+            rpools = raw.get("pools", 1)
+            if not (1 <= rpools <= raw["nodes"]):
+                raise ScenarioError(
+                    f"{where}: pools must be in [1, nodes="
+                    f"{raw['nodes']}], got {rpools}"
+                )
+            regions.append(RegionDef(name=raw["name"],
+                                     nodes=raw["nodes"], pools=rpools))
+        names = [r.name for r in regions]
+        if len(set(names)) != len(names):
+            raise ScenarioError(
+                f"regions: duplicate region names {sorted(names)}"
+            )
+        if sum(r.nodes for r in regions) != nodes:
+            raise ScenarioError(
+                f"regions: per-region nodes sum to "
+                f"{sum(r.nodes for r in regions)}, but nodes={nodes} "
+                "(the top-level total must stay truthful)"
+            )
+        if sum(r.pools for r in regions) != pools:
+            raise ScenarioError(
+                f"regions: per-region pools sum to "
+                f"{sum(r.pools for r in regions)}, but pools={pools}"
+            )
+    region_names = {r.name for r in regions}
     workers = doc.get("workers", 8)
     if not (1 <= workers <= 64):
         raise ScenarioError(f"workers must be in [1, 64], got {workers}")
@@ -433,6 +565,52 @@ def validate_scenario(doc: dict) -> Scenario:
                     f"shard_kill host {host} out of range "
                     f"[0, {controllers.shards})"
                 )
+        # federation cross-checks: region faults / per-region windows /
+        # region-scoped revocation only mean something with regions,
+        # and every named region must exist
+        if a.kind == "fault" and a.params["fault"] in REGION_FAULTS:
+            if not regions:
+                raise ScenarioError(
+                    f"{a.params['fault']} fault requires 'regions' "
+                    "(\"schema\": 2)"
+                )
+            if a.params["region"] not in region_names:
+                raise ScenarioError(
+                    f"{a.params['fault']}: unknown region "
+                    f"{a.params['region']!r}; known: "
+                    f"{sorted(region_names)}"
+                )
+        if (a.kind == "fault" and a.params["fault"] == "root_revoked"
+                and "region" in a.params):
+            if not regions:
+                raise ScenarioError(
+                    "root_revoked 'region' requires 'regions' "
+                    "(\"schema\": 2)"
+                )
+            if a.params["region"] not in region_names:
+                raise ScenarioError(
+                    f"root_revoked: unknown region "
+                    f"{a.params['region']!r}; known: "
+                    f"{sorted(region_names)}"
+                )
+        if a.kind == "set_mode" and "windows" in a.params:
+            if not regions:
+                raise ScenarioError(
+                    "set_mode 'windows' requires 'regions' "
+                    "(\"schema\": 2)"
+                )
+            for rname, offset in a.params["windows"].items():
+                if rname not in region_names:
+                    raise ScenarioError(
+                        f"set_mode windows: unknown region {rname!r}; "
+                        f"known: {sorted(region_names)}"
+                    )
+                if isinstance(offset, bool) or not isinstance(
+                        offset, (int, float)) or offset < 0:
+                    raise ScenarioError(
+                        f"set_mode windows[{rname!r}] must be a "
+                        "number of seconds >= 0"
+                    )
     return Scenario(
         name=doc["name"],
         nodes=nodes,
@@ -447,6 +625,8 @@ def validate_scenario(doc: dict) -> Scenario:
         controllers=controllers,
         actions=sorted(actions, key=lambda a: a.at),
         converge=converge,
+        schema=schema,
+        regions=tuple(regions),
     )
 
 
@@ -458,7 +638,7 @@ def load_scenario(path: str) -> Scenario:
         raise ScenarioError(f"cannot read {path}: {e}") from e
     except ValueError as e:
         raise ScenarioError(f"{path}: not valid JSON: {e}") from e
-    return validate_scenario(doc)
+    return validate_scenario(doc, source=path)
 
 
 def canonical_scenario_text(doc: dict) -> str:
