@@ -6,7 +6,7 @@ include versions.mk
 
 PYTHON ?= python3
 
-.PHONY: all build native test test-fast bench lint typecheck clean image kind-smoke
+.PHONY: all build native test test-fast bench lint lint-fast typecheck clean image kind-smoke
 
 all: build
 
@@ -42,6 +42,20 @@ lint:
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
 	else echo "lint: ruff not installed; skipping (pip install -r requirements-dev.txt)"; fi
 	$(PYTHON) -m tpu_cc_manager.analysis
+
+# Changed-files analyzer pass (ISSUE 17): ccaudit reporting only on
+# the .py files your branch touches vs origin/main (falls back to HEAD
+# for a detached/CI checkout). The analysis still runs whole-program
+# over the default surface — whole-program facts computed on a slice
+# would diverge from the gate's — so this reports exactly what `make
+# lint` would flag in YOUR files, minus the manifest cross-check. The
+# full run stays the merge gate (and is itself wall-time gated by the
+# bench's ccaudit_wall_s ceiling).
+lint-fast:
+	@base=$$(git merge-base origin/main HEAD 2>/dev/null || git rev-parse HEAD); \
+	changed=$$(git diff --name-only $$base -- '*.py'); \
+	if [ -z "$$changed" ]; then echo "lint-fast: no .py changes vs $$base"; \
+	else $(PYTHON) -m tpu_cc_manager.analysis --files $$changed; fi
 
 # Static types over the typed-core subset (mypy.ini `files`): the
 # protocol surface, planner, tracing, watch layer, and the analyzer

@@ -1322,6 +1322,21 @@ def run_federation_bench():
     }
 
 
+def run_ccaudit_bench():
+    """Analyzer cost gate (ISSUE 17): wall seconds for one full-repo
+    ccaudit run in-process — the default surface including manifests,
+    i.e. exactly what ``make lint`` pays. The v4 asyncflow families
+    ride the same parse + call graph the v3 passes built, so the
+    marginal cost is the fixpoints, not a re-walk; ``ccaudit_wall_s``
+    is ceiling-gated in bench_trend so whole-program growth can't
+    silently make lint crawl."""
+    from tpu_cc_manager.analysis import analyze_paths
+
+    t0 = time.monotonic()
+    analyze_paths()
+    return {"ccaudit_wall_s": round(time.monotonic() - t0, 3)}
+
+
 def run_rollout_bench(n_groups=12, agent_delay_s=0.03, poll_s=0.5):
     """Reactive rollout economics (ISSUE 14): an ``n_groups``-group
     serial rollout over FakeKube, judged off a NodeInformer delta
@@ -1625,6 +1640,10 @@ def main():
         # two API servers — region partition + evac-races-rollout; the
         # evac-stabilization and cross-region e2e axes join the gate
         result["extras"].update(run_federation_bench())
+        # analyzer cost (ISSUE 17): one full-repo ccaudit run, gated by
+        # an absolute wall ceiling so the v4 whole-program passes can't
+        # silently make `make lint` crawl
+        result["extras"].update(run_ccaudit_bench())
     print(json.dumps(result))
 
 

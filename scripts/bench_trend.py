@@ -119,6 +119,14 @@ GATED_EXTRA_AXES = {
     # e2e_convergence_p99_s axis is the single-server scale-256 run's).
     "region_evac_convergence_s": "lower",
     "federation_e2e_convergence_p99_s": "lower",
+    # joined in r17 (the async-aware-analyzer round, ISSUE 17): wall
+    # seconds for one full-repo ccaudit run — the cost `make lint`
+    # pays on every invocation. v4's whole-program passes (call-graph
+    # fixpoints, loop-confinement, caller-held locksets) all ride one
+    # parse; this is the axis that regresses if a new rule family
+    # starts re-walking the tree or a fixpoint loses termination
+    # sharpness.
+    "ccaudit_wall_s": "lower",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are
@@ -169,6 +177,12 @@ LATENCY_CEILINGS = {
     # 0.25 s profile capture burst; 2.0 allows a slow disk's
     # flight-recorder dump, not a wedged assembly path.
     "incident_capture_s": 2.0,
+    # ISSUE 17 acceptance: a full-repo ccaudit run (v4 async families
+    # included) measured ~6.6 s on the 2-core sandbox; 20 allows a
+    # loaded CI host, not an analyzer that quietly went quadratic.
+    # The `--files` changed-files path in `make lint-fast` is the
+    # interactive escape hatch; THIS bar keeps the full run honest.
+    "ccaudit_wall_s": 20.0,
 }
 #: relative bars WITHIN the newest round (ISSUE 11 acceptance):
 #: numerator axis must stay <= factor x denominator axis. Skipped when

@@ -1,0 +1,122 @@
+"""AioBridge.gather's fail-secure join (ISSUE 17 satellite).
+
+The static ``async-exception`` rule checks the gather-settles-everything
+contract (docs/io.md §"The async core") over the call graph; these
+tests pin it dynamically: an exception raised ON the loop thread
+mid-gather must not abandon the other in-flight futures — every future
+settles BEFORE the first exception propagates to the joining thread,
+so no write is left in an unknown state behind the caller's back (the
+flip path's join depends on exactly this).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.k8s.aio_bridge import AioBridge
+
+
+@pytest.fixture()
+def bridge():
+    # a dedicated loop per test: the process-wide get_bridge() singleton
+    # must not inherit test wreckage
+    b = AioBridge(name="test-gather-loop")
+    yield b
+    b.shutdown()
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def test_gather_settles_everything_before_raising(bridge):
+    """A mid-gather loop-thread exception: the slow sibling still runs
+    to completion before gather re-raises — nothing is abandoned."""
+    import asyncio
+
+    release = threading.Event()
+    slow_done = threading.Event()
+
+    async def fast():
+        return "fast"
+
+    async def boom():
+        raise _Boom("mid-gather failure on the loop thread")
+
+    async def slow():
+        while not release.is_set():
+            await asyncio.sleep(0.005)
+        slow_done.set()
+        return "slow"
+
+    futs = [bridge.submit(fast), bridge.submit(boom), bridge.submit(slow)]
+
+    # let fast+boom settle while slow is genuinely still in flight,
+    # then release it from a side thread mid-join
+    t = threading.Timer(0.15, release.set)
+    t.start()
+    try:
+        with pytest.raises(_Boom):
+            bridge.gather(futs, timeout=10)
+    finally:
+        t.cancel()
+        release.set()
+
+    # the contract: by the time gather raised, EVERY future had settled
+    assert all(f.done() for f in futs)
+    assert slow_done.is_set()
+    assert futs[2].result(timeout=0) == "slow"
+
+
+def test_gather_first_exception_wins_after_all_settle(bridge):
+    """Two failures: the one earliest in list order propagates, and the
+    other is still retrievable from its (settled) future."""
+
+    async def boom_a():
+        raise _Boom("a")
+
+    async def boom_b():
+        raise ValueError("b")
+
+    async def ok():
+        return 42
+
+    futs = [bridge.submit(boom_a), bridge.submit(boom_b), bridge.submit(ok)]
+    with pytest.raises(_Boom, match="a"):
+        bridge.gather(futs, timeout=10)
+    assert all(f.done() for f in futs)
+    with pytest.raises(ValueError, match="b"):
+        futs[1].result(timeout=0)
+    assert futs[2].result(timeout=0) == 42
+
+
+def test_gather_empty_and_all_success(bridge):
+    assert bridge.gather([], timeout=1) == []
+
+    async def ok(n):
+        return n
+
+    futs = [bridge.submit(ok, n) for n in range(5)]
+    assert bridge.gather(futs, timeout=10) == list(range(5))
+
+
+def test_gather_blocking_callable_mixed_with_coroutines(bridge):
+    """submit() routes plain callables to the loop's executor; gather
+    joins the mixed batch under the same settle-first contract."""
+    started = threading.Event()
+
+    def blocking_side():
+        started.set()
+        time.sleep(0.05)
+        return "side"
+
+    async def boom():
+        raise _Boom("coroutine failed while the side callable ran")
+
+    futs = [bridge.submit(blocking_side), bridge.submit(boom)]
+    with pytest.raises(_Boom):
+        bridge.gather(futs, timeout=10)
+    assert started.is_set()
+    assert all(f.done() for f in futs)
+    assert futs[0].result(timeout=0) == "side"

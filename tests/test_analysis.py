@@ -822,6 +822,45 @@ def test_cli_errors_on_target_matching_no_files(tmp_path):
     assert "no_such_dir" in proc.stderr
 
 
+def test_files_mode_surface_filter():
+    """--files drops paths the merge gate never scans: tests/ fixtures
+    hard-code protocol literals by design, docs and deletions ride
+    every diff."""
+    from tpu_cc_manager.analysis.core import on_default_surface
+
+    assert on_default_surface("tpu_cc_manager/policy.py")
+    assert on_default_surface("scripts/bench_trend.py")
+    assert on_default_surface("bench.py")
+    assert not on_default_surface("tests/test_federation.py")
+    assert not on_default_surface("docs/analysis.md")
+    assert not on_default_surface("tpu_cc_manager/native/foo.py")
+
+
+def test_cli_files_mode_nothing_to_scan_exits_zero():
+    """A diff of only docs/tests/deletions must pass without running
+    the analysis at all."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.analysis", "--files",
+         "README.md", "tests/test_federation.py", "no/such/file.py"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+    assert "nothing to scan" in proc.stderr
+
+
+def test_files_mode_keeps_whole_program_context():
+    """The soundness contract of --files: the report is restricted to
+    the slice, but the ANALYSIS is whole-program. policy.py is the
+    regression case — under slice-only analysis its guarded writes
+    false-fired race-lockset because the callers holding the lock (and
+    the thread roots) live outside the slice."""
+    findings = analyze_paths(
+        targets=["tpu_cc_manager/policy.py"], subset=True
+    )
+    assert [f for f in findings if f.file != "tpu_cc_manager/policy.py"] == []
+    assert findings == []
+
+
 def test_cli_exits_nonzero_on_stale_baseline(tmp_path):
     root = tmp_path / "repo"
     (root / "pkg").mkdir(parents=True)

@@ -83,13 +83,43 @@ on it (docs/analysis.md §v3):
     convention) are recognized; deliberate benign races carry
     ``# ccaudit: allow-race-lockset(reason)``.
 
+v4 taught the analyzer the event-loop concurrency model
+(``asyncflow.py`` over the same call graph — docs/analysis.md §v4),
+because since ISSUE 13 the coordination substrate is an asyncio core
+the thread passes could not see into:
+
+``await-atomicity``
+    An ``await`` in an ``async def`` is a visible interleaving point:
+    read-check-write of a ``self.``-attribute or module global spanning
+    an await without a common *asyncio* lock (caller-held ⋂-fixpoint
+    included) fires; ``allow-await-atomicity(reason)`` documents a
+    single-loop invariant.
+``lock-across-await``
+    A *threading* lock held at an await parks the entire loop.
+``loop-affinity`` / ``loop-self-deadlock``
+    Loop-owned state (attrs of the async-core classes written in
+    coroutines, or holding asyncio queues/futures/tasks) touched from
+    sync land — a sync method not provably loop-confined via the call
+    graph, or an attribute chain through a typed reference anywhere in
+    the tree — fires ``loop-affinity``; ``bridge.call``/``gather`` or a
+    bridge future's ``.result()`` from INSIDE a coroutine is
+    ``loop-self-deadlock`` at error severity.
+``orphan-task`` / ``async-exception``
+    Dropped ``create_task``/``ensure_future`` handles and discarded
+    coroutine calls fire; in the async core, an ``except`` that exits a
+    request path without settling/propagating pending entries (the
+    gather-settles-everything contract, docs/io.md) is flagged via a
+    settle-sink summary over the call graph.
+
 Findings are gated against ``analysis/baseline.json`` so CI fails only on
 *new* findings; stale baseline entries (the code they suppressed moved or
 was fixed) also fail, so the baseline can only burn down.
 
 Run it: ``python -m tpu_cc_manager.analysis`` (wired into ``make lint``);
 ``--sarif PATH`` writes a SARIF 2.1.0 log CI uploads for inline PR
-annotations.
+annotations; ``--files a.py b.py`` is the changed-files mode
+(``make lint-fast``): the analysis stays whole-program but the report
+is restricted to the named files, and manifests are skipped.
 """
 
 from tpu_cc_manager.analysis.core import (  # noqa: F401
@@ -120,4 +150,11 @@ RULES = (
     "manifest-drift",
     # v3 — the whole-program concurrency pass
     "race-lockset",
+    # v4 — the async-aware families (asyncflow.py)
+    "await-atomicity",
+    "lock-across-await",
+    "loop-affinity",
+    "loop-self-deadlock",
+    "orphan-task",
+    "async-exception",
 )

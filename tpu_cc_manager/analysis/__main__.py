@@ -17,6 +17,7 @@ from tpu_cc_manager.analysis import baseline as baseline_mod
 from tpu_cc_manager.analysis.core import (
     DEFAULT_TARGETS,
     analyze_paths,
+    on_default_surface,
     repo_root,
 )
 
@@ -30,8 +31,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "race-lockset over thread-shared state, label hygiene, "
         "exception discipline, metric-name consistency, protocol-literal "
         "confinement, unvalidated-mode taint, Mode exhaustiveness, "
-        "protocol liveness, code<->manifest drift). "
-        "docs/analysis.md has the rule contract.",
+        "protocol liveness, code<->manifest drift, and the v4 async "
+        "families: await-atomicity, lock-across-await, loop-affinity "
+        "typestate, loop self-deadlock, orphan tasks, async-exception "
+        "fail-secure). docs/analysis.md has the rule contract.",
     )
     parser.add_argument(
         "targets", nargs="*", default=list(DEFAULT_TARGETS),
@@ -83,6 +86,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="skip the code<->manifest cross-check (it runs by default "
         "on the default scan surface)",
     )
+    parser.add_argument(
+        "--files", action="store_true",
+        help="changed-files mode: treat targets as an explicit file "
+        "list and report ONLY findings in those files. Non-Python, "
+        "missing, and off-surface paths (tests/ — the merge gate never "
+        "scans them) are silently skipped: a diff includes deletions "
+        "and docs. The analysis itself still runs whole-program over "
+        "the default surface, so the report is exactly the full run's "
+        "findings restricted to the slice — only the manifest "
+        "cross-check is skipped, and stale baseline entries are "
+        "ignored (entries for out-of-slice files are out of scope, "
+        "not stale). `make lint-fast` wires this to the git diff.",
+    )
     args = parser.parse_args(argv)
 
     with_manifests: Optional[bool] = None
@@ -96,9 +112,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         root, baseline_mod.BASELINE_PATH
     )
 
+    targets = list(args.targets)
+    if args.files:
+        targets = [
+            t for t in targets
+            if t.endswith(".py")
+            and os.path.isfile(os.path.join(root, t))
+            and on_default_surface(t)
+        ]
+        if not targets:
+            print("ccaudit: --files: nothing to scan", file=sys.stderr)
+            return 0
+
     try:
         findings = analyze_paths(
-            root, args.targets, with_manifests, call_depth=args.call_depth
+            root, targets, with_manifests, call_depth=args.call_depth,
+            subset=args.files,
         )
     except FileNotFoundError as e:
         print(f"ccaudit: {e}", file=sys.stderr)
@@ -117,6 +146,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     new, suppressed, stale = baseline_mod.diff_against_baseline(
         findings, entries
     )
+    if args.files:
+        # the report covers only the changed slice: baseline entries
+        # for files outside it are out of scope, not stale
+        stale = []
 
     if args.sarif:
         from tpu_cc_manager.analysis import sarif as sarif_mod

@@ -378,6 +378,25 @@ def build(
     return CallGraph(audits, depth)
 
 
+def callers_map(
+    audits: Sequence[ModuleAudit], graph: CallGraph
+) -> Dict[str, Set[str]]:
+    """Reverse edge map: callee qual → quals of every function with a
+    resolved call to it. The v4 asyncflow pass runs its loop-confinement
+    fixpoint over this (a sync function is loop-confined iff every
+    resolved caller is a coroutine or itself loop-confined); unresolved
+    dynamic calls simply contribute no edge, which errs MIXED — the
+    conservative side for loop-affinity."""
+    out: Dict[str, Set[str]] = {}
+    for audit in audits:
+        for fn in audit.functions:
+            for call in fn.calls:
+                callee = graph.resolve_call(audit, fn, call)
+                if callee is not None:
+                    out.setdefault(callee, set()).add(fn.qual)
+    return out
+
+
 def blocking_findings(
     audits: Sequence[ModuleAudit], graph: CallGraph
 ) -> List[Finding]:

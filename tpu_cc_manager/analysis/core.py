@@ -34,6 +34,13 @@ class Finding:
     rule: str
     message: str
     text: str  #: stripped source line — the baseline's drift detector
+    #: SARIF level for a NEW finding: "error" (the historical default —
+    #: every pre-v4 rule gates hard) or "warning" (the v4 asyncflow
+    #: advisory families; ``loop-self-deadlock`` stays "error": a
+    #: ``.result()`` on the loop thread is a guaranteed deadlock, not a
+    #: judgement call). The baseline gate ignores severity — any new
+    #: finding fails the ratchet either way.
+    severity: str = "error"
 
     def key(self) -> Tuple[str, str, int, str]:
         return (self.rule, self.file, self.line, self.text)
@@ -190,6 +197,20 @@ def iter_python_files(root: str, targets: Sequence[str]) -> List[str]:
     return sorted(set(out))
 
 
+def on_default_surface(relpath: str) -> bool:
+    """Whether a repo-relative path belongs to the default scan surface.
+    The ``--files`` mode uses this to drop changed files the merge gate
+    never scans (tests hard-code wire-protocol strings to assert them;
+    flagging a fixture the full run would never see is pure noise)."""
+    rel = relpath.replace(os.sep, "/")
+    if any(part in _EXCLUDE_DIRS for part in rel.split("/")[:-1]):
+        return False
+    return any(
+        rel == target or rel.startswith(target + "/")
+        for target in DEFAULT_TARGETS
+    )
+
+
 def load_module(root: str, relpath: str) -> Optional[Module]:
     with open(os.path.join(root, relpath), "r", encoding="utf-8") as f:
         src = f.read()
@@ -205,26 +226,28 @@ def load_module(root: str, relpath: str) -> Optional[Module]:
 
 
 def analyze_modules(
-    modules: Sequence[Module], call_depth: Optional[int] = None
+    modules: Sequence[Module], call_depth: Optional[int] = None,
 ) -> List[Finding]:
     """Run every rule over already-parsed modules (the seam the fixture
     tests use: build Modules from inline snippets, skip the filesystem).
 
-    v3 pipeline: parse → per-module rules → whole-program call graph →
+    v4 pipeline: parse → per-module rules → whole-program call graph →
     thread roots → transitive lock-order/blocking + lockset race pass →
-    findings (the baseline gate is the caller's job).
+    asyncflow (await-atomicity, loop-affinity, task-lifecycle,
+    async-exception) → findings (the baseline gate is the caller's job).
     """
     findings, _ = _analyze_modules(modules, call_depth)
     return findings
 
 
 def _analyze_modules(
-    modules: Sequence[Module], call_depth: Optional[int] = None
+    modules: Sequence[Module], call_depth: Optional[int] = None,
 ) -> Tuple[List[Finding], list]:
     """analyze_modules plus the per-module audits — analyze_paths
     feeds the audits' metric-declaration registry to the slo
     cross-check (analysis/slo.py)."""
     from tpu_cc_manager.analysis import (
+        asyncflow,
         callgraph,
         dataflow,
         lockgraph,
@@ -249,7 +272,13 @@ def _analyze_modules(
     findings.extend(lockgraph.order_findings(audits, graph))
     findings.extend(callgraph.blocking_findings(audits, graph))
     roots = threads.infer_roots(audits, graph)
-    findings.extend(lockset.race_findings(audits, graph, roots))
+    async_lock_quals = frozenset(
+        q for a in audits for q in a.async_lock_quals
+    )
+    findings.extend(
+        lockset.race_findings(audits, graph, roots, async_lock_quals)
+    )
+    findings.extend(asyncflow.async_findings(audits, graph, roots))
     findings.extend(rules.metric_findings(audits))
     findings.extend(rules.liveness_findings(audits))
     findings.extend(rules.direct_write_findings(modules))
@@ -266,11 +295,29 @@ def analyze_paths(
     targets: Sequence[str] = DEFAULT_TARGETS,
     with_manifests: Optional[bool] = None,
     call_depth: Optional[int] = None,
+    subset: bool = False,
 ) -> List[Finding]:
     """Full repo scan: the AST rules over ``targets`` plus — when scanning
     the default surface (or when ``with_manifests`` forces it) — the
-    code↔manifest cross-check over the deploy/scenario trees."""
+    code↔manifest cross-check over the deploy/scenario trees.
+
+    ``subset=True`` (the CLI's ``--files`` mode) marks ``targets`` as a
+    changed-files slice — but the ANALYSIS still runs over the full
+    default surface, and only the REPORT is restricted to the slice.
+    Whole-program facts (caller-held locksets, thread roots,
+    loop-confinement, settle closures) computed over a slice would
+    diverge from the merge gate's: a write is unguarded or a function
+    mixed-context only relative to every caller, and most callers live
+    outside any given diff. Filtering the report instead guarantees a
+    subset run flags exactly the full run's findings for those files.
+    Only the manifest/slo cross-checks are skipped — their findings
+    land on manifest files a Python slice can never contain."""
     root = root or repo_root()
+    report_only: Optional[Set[str]] = None
+    if subset:
+        report_only = set(iter_python_files(root, targets))
+        targets = DEFAULT_TARGETS
+        with_manifests = False
     if with_manifests is None:
         with_manifests = tuple(targets) == DEFAULT_TARGETS
     modules = []
@@ -290,6 +337,8 @@ def analyze_paths(
             name for a in audits for name in a.metric_decls
         }
         findings.extend(slo.slo_findings(root, declared))
+    if report_only is not None:
+        findings = [f for f in findings if f.file in report_only]
     return sorted(findings)
 
 

@@ -250,7 +250,7 @@ def collect_sink_summaries(
     summaries: Dict[str, SinkSummary] = {}
     passes: Dict[str, List[_ParamPass]] = {}
     for audit in audits:
-        imports = collect_imports(audit.module.tree)
+        imports = audit.imports
         for fn in audit.functions:
             if fn.node is None:
                 continue

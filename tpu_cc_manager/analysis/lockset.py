@@ -112,10 +112,18 @@ def _caller_held(
     return held
 
 
+#: Public seam for the v4 asyncflow pass (``analysis/asyncflow.py``):
+#: the await-atomicity family widens coroutine locksets with the same
+#: caller-held ⋂-fixpoint, so the ``_locked``-suffix convention means
+#: one thing across both concurrency models.
+caller_held_locks = _caller_held
+
+
 def race_findings(
     audits: Sequence[ModuleAudit],
     graph: CallGraph,
     roots: Dict[str, ThreadRoot],
+    async_lock_quals: FrozenSet[str] = frozenset(),
 ) -> List[Finding]:
     fn_ctx = contexts(graph, roots)
     caller_held = _caller_held(audits, graph, roots)
@@ -186,23 +194,27 @@ def race_findings(
         )
         if len(write_ctx) < 2 and not write_self_concurrent:
             continue
-        # the lockset lattice: ∩ of write locksets
-        write_locksets: List[FrozenSet[str]] = [a.locks for a, _ in writes]
+        # the lockset lattice: ∩ of write locksets. An asyncio lock
+        # excludes coroutines on ONE loop, not threads — so v4 passes
+        # the async-lock quals in and they are discounted here: a write
+        # "guarded" only by an asyncio.Lock is unguarded thread-wise.
+        write_locksets: List[FrozenSet[str]] = [
+            a.locks - async_lock_quals for a, _ in writes
+        ]
         common: FrozenSet[str] = write_locksets[0]
         for ls in write_locksets[1:]:
             common = common & ls
         consistent = bool(common)
-        for access, _ in writes:
-            if access.locks and consistent:
+        for (access, _), eff in zip(writes, write_locksets):
+            if eff and consistent:
                 continue
-            if access.locks:
+            if eff:
                 others = sorted(
-                    set().union(*(ls for ls in write_locksets))
-                    - access.locks
+                    set().union(*(ls for ls in write_locksets)) - eff
                 )
                 message = (
                     f"{_display(key)} is written under "
-                    f"{{{', '.join(sorted(access.locks))}}} here but "
+                    f"{{{', '.join(sorted(eff))}}} here but "
                     f"under {{{', '.join(others)}}} elsewhere — the write "
                     "locksets share no common lock, so the location is "
                     "unprotected (shared across: "

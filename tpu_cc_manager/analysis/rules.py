@@ -50,6 +50,14 @@ _THREADING_LOCK_CTORS = {
 #: taken while already held) is legal for these, a deadlock for Lock.
 _REENTRANT_CTORS = {"RLock", "Condition"}
 
+#: asyncio synchronization ctors (v4): same ordering/blocking shape as
+#: thread locks, but they exclude COROUTINES, not threads — the v4
+#: asyncflow pass needs the two identities kept apart (an asyncio.Lock
+#: is a valid guard across an await; it guards nothing across threads).
+_ASYNCIO_LOCK_CTORS = {
+    "Lock", "Semaphore", "BoundedSemaphore", "Condition"
+}
+
 # -- blocking-call identification -------------------------------------------
 
 #: Dotted-path prefixes that block on I/O or the clock. Matching is done
@@ -115,6 +123,11 @@ class LockSite:
     line: int
     text: str
     reentrant: bool = False
+    #: lock identity (v4): "thread" (threading.* ctor seen), "async"
+    #: (asyncio.* ctor seen), or "unknown" (name-matched only). The
+    #: asyncflow pass treats only "async" quals as await-safe guards
+    #: and only "thread" quals as loop-blocking when held at an await.
+    kind: str = "unknown"
 
 
 @dataclass
@@ -222,6 +235,23 @@ class AccessSite:
 
 
 @dataclass
+class AwaitSite:
+    """One suspension point inside an ``async def`` (v4): a lexical
+    ``await``, or the implicit awaits of ``async for`` / ``async with``
+    entry. Every other coroutine on the loop may run here — the
+    interleaving point the await-atomicity lattice is built around."""
+
+    line: int
+    text: str
+    #: quals of every lock held lexically at the suspension point
+    locks: FrozenSet[str]
+    #: the subset of held locks with confirmed *threading* identity —
+    #: holding one across an await parks the whole event loop behind
+    #: whatever thread owns it (the lock-across-await rule's material)
+    thread_locks: Tuple[LockSite, ...] = ()
+
+
+@dataclass
 class FnAudit:
     """Everything one function/method contributes to the call graph and
     the thread/lockset passes."""
@@ -257,6 +287,11 @@ class FnAudit:
     #: ``do_*`` method of a ``*RequestHandler`` subclass — runs on a
     #: per-request thread of a ThreadingHTTPServer
     handler_root: bool = False
+    #: ``async def`` (v4) — the body runs as a coroutine on the event
+    #: loop; the asyncflow pass keys its whole analysis off this
+    is_async: bool = False
+    #: suspension points in source order (empty for sync functions)
+    awaits: List[AwaitSite] = field(default_factory=list)
 
 
 @dataclass
@@ -292,6 +327,14 @@ class ModuleAudit:
     #: context is "read" (.get/subscript/compare), "write" (dict key) or
     #: "other" — raw material for the protocol-liveness pass
     label_uses: List[Tuple[str, str]] = field(default_factory=list)
+    #: lock quals acquired in this module whose ctor was asyncio.* (v4)
+    #: — the race pass discounts these as cross-THREAD guards, and the
+    #: await-atomicity pass accepts only these as cross-AWAIT guards
+    async_lock_quals: Set[str] = field(default_factory=set)
+    #: the module's import fold (core.collect_imports), computed once by
+    #: the walker and shared — the asyncflow/dataflow passes re-resolve
+    #: names per module and must not re-walk the tree to do it
+    imports: Dict[str, str] = field(default_factory=dict)
 
     def add(self, rule: str, node: ast.AST, message: str) -> None:
         line = getattr(node, "lineno", 1)
@@ -376,6 +419,8 @@ class _Walker(ast.NodeVisitor):
         #: local names known to be locks via `x = threading.Lock()` style
         #: assignment, keyed by terminal name; value: reentrant?
         self.known_locks: Dict[str, bool] = {}
+        #: the subset whose ctor was asyncio.* (v4 lock identity)
+        self.known_async_locks: Set[str] = set()
         #: import alias -> real dotted prefix, pre-collected with the
         #: package-shared fold (core.collect_imports)
         self.imports: Dict[str, str] = collect_imports(self.module.tree)
@@ -475,13 +520,32 @@ class _Walker(ast.NodeVisitor):
             return term
         return None
 
+    def _async_lock_ctor(self, value: ast.AST) -> Optional[str]:
+        """Return the asyncio ctor name when ``value`` constructs an
+        asyncio synchronization primitive (v4 lock identity). The
+        explicit ``asyncio.`` prefix is required: a bare ``Lock()`` with
+        no import evidence stays a thread lock — the conservative
+        default for the race pass."""
+        if not isinstance(value, ast.Call):
+            return None
+        resolved = self._resolve(value.func) or ""
+        term = resolved.rsplit(".", 1)[-1]
+        if term in _ASYNCIO_LOCK_CTORS and resolved.startswith("asyncio."):
+            return term
+        return None
+
     def visit_Assign(self, node: ast.Assign) -> None:
         ctor = self._lock_ctor(node.value)
-        if ctor:
+        async_ctor = None if ctor else self._async_lock_ctor(node.value)
+        if ctor or async_ctor:
             for tgt in node.targets:
                 name = _terminal_name(tgt)
                 if name:
                     self.known_locks[name] = ctor in _REENTRANT_CTORS
+                    if async_ctor:
+                        self.known_async_locks.add(name)
+                    else:
+                        self.known_async_locks.discard(name)
         # `outer = self` inside a class method: attribute accesses on
         # `outer` (typically from a nested handler class) are accesses
         # on THIS class's instance — the webhook/RouteServer idiom.
@@ -553,6 +617,14 @@ class _Walker(ast.NodeVisitor):
             qual = f"{self.modbase}.{self.class_stack[-1]}.{display[5:]}"
         else:
             qual = f"{self.modbase}.{display}"
+        if name in self.known_async_locks:
+            kind = "async"
+        elif name in self.known_locks:
+            kind = "thread"
+        else:
+            kind = "unknown"
+        if kind == "async":
+            self.audit.async_lock_quals.add(qual)
         return LockSite(
             qual=qual,
             display=display,
@@ -560,6 +632,7 @@ class _Walker(ast.NodeVisitor):
             line=node.lineno,
             text=self.module.line_text(node.lineno),
             reentrant=self.known_locks.get(name, False),
+            kind=kind,
         )
 
     # ------------------------------------------------------------- with
@@ -591,8 +664,12 @@ class _Walker(ast.NodeVisitor):
         del self.lock_stack[len(self.lock_stack) - pushed:]
 
     # same shape (withitems + body); async lock types differ but the
-    # ordering/blocking invariants don't
-    visit_AsyncWith = visit_With
+    # ordering/blocking invariants don't. Entering an ``async with``
+    # awaits (``__aenter__``) — a suspension point under whatever locks
+    # are held OUTSIDE the new acquisitions, recorded before delegating.
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._record_await(node)
+        self.visit_With(node)  # type: ignore[arg-type]
 
     # ------------------------------------------------------- scope resets
 
@@ -636,6 +713,7 @@ class _Walker(ast.NodeVisitor):
             line=node.lineno,
             node=node,
             handler_root=self._is_handler_method(name),
+            is_async=isinstance(node, ast.AsyncFunctionDef),
         )
         self.audit.functions.append(fn)
         self.fn_stack.append(fn)
@@ -744,7 +822,31 @@ class _Walker(ast.NodeVisitor):
         self.generic_visit(node)
         self.loop_depth -= 1
 
-    visit_AsyncFor = visit_For
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        # every iteration awaits ``__anext__`` — one recorded
+        # suspension point stands in for all of them
+        self._record_await(node)
+        self.visit_For(node)  # type: ignore[arg-type]
+
+    # ------------------------------------------------- v4 await tracking
+
+    def _record_await(self, node: ast.AST) -> None:
+        fn = self.fn_stack[-1]
+        if not fn.is_async:
+            return
+        line = getattr(node, "lineno", 1)
+        fn.awaits.append(AwaitSite(
+            line=line,
+            text=self.module.line_text(line),
+            locks=frozenset(s.qual for s in self.lock_stack),
+            thread_locks=tuple(
+                s for s in self.lock_stack if s.kind == "thread"
+            ),
+        ))
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._record_await(node)
+        self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:
         self.loop_depth += 1
@@ -1349,6 +1451,7 @@ def audit_module(module: Module) -> ModuleAudit:
     audit = ModuleAudit(module=module)
     walker = _Walker(audit)
     walker.visit(module.tree)
+    audit.imports = walker.imports
     _collect_label_uses(module, walker.imports, audit)
     return audit
 

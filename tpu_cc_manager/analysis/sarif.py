@@ -10,8 +10,10 @@ The emitted subset is deliberately small and stable:
 
 - one ``run`` with the ``ccaudit`` tool driver and one ``rule`` entry
   per rule id seen in the scan;
-- one ``result`` per finding — ``level`` is ``error`` for *new*
-  findings and ``note`` for baselined ones, which additionally carry a
+- one ``result`` per finding — ``level`` for *new* findings is the
+  finding's severity (``error`` for every pre-v4 rule and
+  ``loop-self-deadlock``; ``warning`` for the v4 asyncflow advisory
+  families) and ``note`` for baselined ones, which additionally carry a
   ``suppressions`` entry (``kind: external``) so code-scanning UIs show
   them as suppressed rather than open;
 - physical locations are repo-relative with ``uriBaseId: SRCROOT``.
@@ -59,15 +61,35 @@ _RULE_HELP = {
     "different protocol than labels.py/modes.py.",
     "race-lockset": "Shared location written with an empty or "
     "inconsistent guarding lockset across thread contexts.",
+    # v4 — the asyncflow families
+    "await-atomicity": "Read-check-write of shared state spans an "
+    "await without a common asyncio.Lock — other coroutines interleave "
+    "at the suspension point.",
+    "lock-across-await": "A threading lock is held at an await — the "
+    "whole event loop queues behind the lock's next owner.",
+    "loop-affinity": "Loop-owned state (conn pool, futures, queues) "
+    "touched from sync land outside the bridge's sanctioned routes.",
+    "loop-self-deadlock": "bridge.call/gather or a bridge future's "
+    ".result() from the loop thread — the loop waits on work only the "
+    "loop can run.",
+    "orphan-task": "create_task/ensure_future handle dropped, or a "
+    "coroutine-valued call discarded without ever being awaited.",
+    "async-exception": "An except exits an async request path without "
+    "settling or propagating its pending entries "
+    "(gather-settles-everything contract).",
     "stale-baseline": "Baseline entry matching no current finding — "
     "delete it (the ratchet only burns down).",
 }
 
 
 def _result(finding: Finding, suppressed: bool) -> dict:
+    # a NEW finding surfaces at the finding's own severity ("error" for
+    # every pre-v4 rule and loop-self-deadlock; "warning" for the v4
+    # advisory families) — baselined findings demote to "note" with a
+    # suppression either way
     out: dict = {
         "ruleId": finding.rule,
-        "level": "note" if suppressed else "error",
+        "level": "note" if suppressed else finding.severity,
         "message": {"text": finding.message},
         "locations": [
             {

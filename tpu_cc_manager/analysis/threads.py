@@ -40,6 +40,11 @@ from tpu_cc_manager.analysis.rules import ModuleAudit
 #: the spawning/main thread's context.
 MAIN = "<main>"
 
+#: Pseudo-root id for the bridge event-loop thread: every ``async def``
+#: executes here (the process runs ONE loop — aio_bridge's singleton).
+#: The v4 asyncflow pass seeds its loop-confinement fixpoint from these.
+LOOP = "<loop>"
+
 #: kinds in confidence order (kept on merge)
 _KIND_RANK = {"thread": 0, "submit": 1, "handler": 2}
 
@@ -152,6 +157,20 @@ def contexts(
         for q in reach[root_qual]:
             ctx.setdefault(q, set()).add(root_qual)
     return ctx
+
+
+def async_roots(audits: Sequence[ModuleAudit]) -> Set[str]:
+    """Quals of every ``async def`` — each is an entry point onto the
+    process's one event loop (the ``LOOP`` pseudo-context). The v4
+    asyncflow pass seeds loop-confinement from this set: a sync
+    function all of whose resolved callers live here (transitively) is
+    provably loop-confined."""
+    return {
+        fn.qual
+        for audit in audits
+        for fn in audit.functions
+        if fn.is_async
+    }
 
 
 def shared_functions(

@@ -61,6 +61,7 @@ import time
 import urllib.parse
 from collections import deque
 from typing import (
+    TYPE_CHECKING,
     AsyncIterator,
     Callable,
     Dict,
@@ -70,6 +71,11 @@ from typing import (
 )
 
 from tpu_cc_manager.k8s.client import ApiException, ConflictError, KubeConfig
+
+if TYPE_CHECKING:
+    # runtime keeps the lazy in-function import (_build_ssl_ctx): ssl
+    # loads certs/ciphers at import time and only TLS configs need it
+    import ssl
 
 log = logging.getLogger("tpu-cc-manager.k8s.aio")
 
@@ -109,7 +115,7 @@ class _AsyncTokenBucket:
     until a token frees. Single-threaded by construction — only loop
     coroutines touch it."""
 
-    def __init__(self, qps: float, burst: int):
+    def __init__(self, qps: float, burst: int) -> None:
         self.qps = float(qps)
         self.burst = max(1, int(burst))
         self._tokens = float(self.burst)
@@ -138,7 +144,7 @@ class _Pending:
     __slots__ = ("method", "path", "future", "got_bytes", "replayed",
                  "sent_on_served")
 
-    def __init__(self, method: str, path: str, replayed: bool):
+    def __init__(self, method: str, path: str, replayed: bool) -> None:
         self.method = method
         self.path = path
         self.future: "asyncio.Future[Tuple[int, bytes]]" = (
@@ -161,7 +167,7 @@ class _Conn:
     bounding the pipeline depth, and a reader task matching responses
     back in order."""
 
-    def __init__(self, client: "AsyncKubeClient", window: int):
+    def __init__(self, client: "AsyncKubeClient", window: int) -> None:
         self.client = client
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
@@ -328,13 +334,18 @@ class AsyncKubeClient:
                  window: Optional[int] = None,
                  qps: Optional[float] = None,
                  burst: Optional[int] = None,
-                 list_page_limit: Optional[int] = None):
+                 list_page_limit: Optional[int] = None) -> None:
         self.config = config
         self.max_conns = max_conns or _env_int(ENV_CONNS, DEFAULT_CONNS)
         self.window = window or _env_int(ENV_WINDOW, DEFAULT_WINDOW)
         self.list_page_limit = list_page_limit or self.LIST_PAGE_LIMIT
         self._conns: List[_Conn] = []
         self._ssl_ctx = None
+        # serializes first-use context construction: without it two
+        # concurrent first requests both see None, both build, and the
+        # loser's dial binds a context the winner never sees
+        # (ccaudit await-atomicity would flag exactly that shape)
+        self._ssl_lock = asyncio.Lock()
         if qps is None:
             try:
                 qps = float(os.environ.get("TPU_CC_KUBE_QPS", "") or 0)
@@ -375,12 +386,16 @@ class AsyncKubeClient:
             self._bucket = None
 
     def stats(self) -> dict:
+        # callable from any thread by design (the facade exposes it
+        # without a bridge hop): every value is a single GIL-atomic
+        # load of a monotonic counter — a stale snapshot is fine for
+        # metrics, and nothing here is mutated
         return {
-            "conns": len(self._conns),
-            "dials": self.dials_total,
-            "replays": self.replays_total,
-            "requests": self.requests_total,
-            "watches": self.watches_total,
+            "conns": len(self._conns),  # ccaudit: allow-loop-affinity(GIL-atomic len of a loop-written list; snapshot staleness is fine for metrics)
+            "dials": self.dials_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
+            "replays": self.replays_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
+            "requests": self.requests_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
+            "watches": self.watches_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
         }
 
     async def aclose(self) -> None:
@@ -398,17 +413,22 @@ class AsyncKubeClient:
             self.config.host, self.config.port, ssl=ssl_ctx
         )
 
-    async def _ensure_ssl_ctx(self):
-        if self._ssl_ctx is None:
-            # context construction reads CA/cert files off disk: off the
-            # loop (our own blocking-in-async rule polices this module)
-            loop = asyncio.get_running_loop()
-            self._ssl_ctx = await loop.run_in_executor(
-                None, self._build_ssl_ctx
-            )
+    async def _ensure_ssl_ctx(self) -> "ssl.SSLContext":
+        # double-checked under an asyncio.Lock: the executor hop below
+        # is an interleaving point, so check-then-build must be atomic
+        # across coroutines or concurrent first dials build twice
+        async with self._ssl_lock:
+            if self._ssl_ctx is None:
+                # context construction reads CA/cert files off disk: off
+                # the loop (our own blocking-in-async rule polices this
+                # module)
+                loop = asyncio.get_running_loop()
+                self._ssl_ctx = await loop.run_in_executor(
+                    None, self._build_ssl_ctx
+                )
         return self._ssl_ctx
 
-    def _build_ssl_ctx(self):
+    def _build_ssl_ctx(self) -> "ssl.SSLContext":
         import ssl
 
         c = self.config
@@ -503,7 +523,7 @@ class AsyncKubeClient:
         for fn in self._throttle_observers:
             try:
                 fn(waited)
-            except Exception:
+            except Exception:  # ccaudit: allow-async-exception(observer isolation: a broken metrics hook must not fail the request; nothing is in flight here)
                 log.debug("throttle observer failed", exc_info=True)
 
     async def _request(self, method: str, path: str,
@@ -524,7 +544,7 @@ class AsyncKubeClient:
             for fn in self._rtt_observers:
                 try:
                     fn(method, path, rtt)
-                except Exception:
+                except Exception:  # ccaudit: allow-async-exception(observer isolation: the finally re-raises the round-trip's own failure; the hook must not mask it)
                     log.debug("rtt observer failed", exc_info=True)
         if status == 409:
             raise ConflictError(data.decode("utf-8", "replace")[:200])
@@ -829,7 +849,7 @@ class AsyncKubeClient:
                 writer.close()
                 if conn_alive:
                     await writer.wait_closed()
-            except Exception:  # ccaudit: allow-swallow(watch teardown: the socket may already be gone)
+            except Exception:  # ccaudit: allow-swallow(watch teardown: the socket may already be gone) # ccaudit: allow-async-exception(teardown in a finally after the transport error already re-raised; no futures pending on a dedicated watch conn)
                 pass
 
     async def _watch_payload(self, reader: asyncio.StreamReader,
