@@ -50,12 +50,14 @@ lint:
 # would diverge from the gate's — so this reports exactly what `make
 # lint` would flag in YOUR files, minus the manifest cross-check. The
 # full run stays the merge gate (and is itself wall-time gated by the
-# bench's ccaudit_wall_s ceiling).
+# bench's ccaudit_wall_s ceiling). --cache (ISSUE 18) reloads pickled
+# per-module facts from .ccaudit_cache/ for unchanged modules, so the
+# inner loop re-parses only what you edited.
 lint-fast:
 	@base=$$(git merge-base origin/main HEAD 2>/dev/null || git rev-parse HEAD); \
 	changed=$$(git diff --name-only $$base -- '*.py'); \
 	if [ -z "$$changed" ]; then echo "lint-fast: no .py changes vs $$base"; \
-	else $(PYTHON) -m tpu_cc_manager.analysis --files $$changed; fi
+	else $(PYTHON) -m tpu_cc_manager.analysis --files --cache $$changed; fi
 
 # Static types over the typed-core subset (mypy.ini `files`): the
 # protocol surface, planner, tracing, watch layer, and the analyzer

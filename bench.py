@@ -1325,16 +1325,23 @@ def run_federation_bench():
 def run_ccaudit_bench():
     """Analyzer cost gate (ISSUE 17): wall seconds for one full-repo
     ccaudit run in-process — the default surface including manifests,
-    i.e. exactly what ``make lint`` pays. The v4 asyncflow families
-    ride the same parse + call graph the v3 passes built, so the
-    marginal cost is the fixpoints, not a re-walk; ``ccaudit_wall_s``
-    is ceiling-gated in bench_trend so whole-program growth can't
-    silently make lint crawl."""
-    from tpu_cc_manager.analysis import analyze_paths
+    i.e. exactly what ``make lint`` pays. The v4 asyncflow and v5
+    jitflow families ride the same parse + call graph the v3 passes
+    built, so the marginal cost is the fixpoints, not a re-walk;
+    ``ccaudit_wall_s`` is ceiling-gated in bench_trend so
+    whole-program growth can't silently make lint crawl. The rule
+    counts are stamped so bench-smoke can assert the passes actually
+    ran (a silently-skipped analyzer would otherwise look FAST)."""
+    from tpu_cc_manager.analysis import RULES, analyze_paths
+    from tpu_cc_manager.analysis.jitflow import JITFLOW_RULES
 
     t0 = time.monotonic()
     analyze_paths()
-    return {"ccaudit_wall_s": round(time.monotonic() - t0, 3)}
+    return {
+        "ccaudit_wall_s": round(time.monotonic() - t0, 3),
+        "ccaudit_rules": len(RULES),
+        "ccaudit_jitflow_rules": len(JITFLOW_RULES),
+    }
 
 
 def run_rollout_bench(n_groups=12, agent_delay_s=0.03, poll_s=0.5):

@@ -1,0 +1,491 @@
+"""ccaudit v5 jitflow families (ISSUE 18): retrace-hazard,
+host-sync-in-hot-path, unserialized-dispatch, donation-violation,
+tracer-leak. Positive/negative/pragma fixtures per family, severity
+pins, the fact-cache contract, the ``--files`` slicing soundness pin,
+and the live-surface cleanliness pin (the shipped tree passes its own
+v5 rules)."""
+
+import os
+
+import pytest
+
+from tpu_cc_manager.analysis.core import (
+    CACHE_DIR_NAME,
+    analyze_paths,
+    analyze_source,
+    analyzer_version_hash,
+    load_audit_cached,
+)
+from tpu_cc_manager.analysis.jitflow import (
+    DISPATCH_RULE,
+    DONATION_RULE,
+    JITFLOW_RULES,
+    RETRACE_RULE,
+    SYNC_RULE,
+    TRACER_RULE,
+)
+
+#: in-scope module path for fixtures (jitflow only arms under the
+#: package tree; bench/scripts/simlab are exempt)
+MOD = "tpu_cc_manager/jitfix.py"
+
+
+def _hits(src, rule, relpath=MOD):
+    return [f for f in analyze_source(src, relpath) if f.rule == rule]
+
+
+# ------------------------------------------------------ retrace-hazard
+
+JIT_HEADER = (
+    "import jax\n"
+    "def _plan(state, num):\n"
+    "    return state\n"
+    "plan_jit = jax.jit(_plan, static_argnames=('num',))\n"
+)
+
+
+def test_retrace_dynamic_static_argname_flagged():
+    src = JIT_HEADER + (
+        "def tick(state, n):\n"
+        "    return plan_jit(state, num=n)\n"
+    )
+    hits = _hits(src, RETRACE_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 6
+    assert "num" in hits[0].message
+    assert hits[0].severity == "warning"
+
+
+def test_retrace_bucketed_and_constant_static_args_clean():
+    src = JIT_HEADER + (
+        "from tpu_cc_manager.plan import bucket_nodes\n"
+        "MAX_NODES = 256\n"
+        "def tick(state, n, snap):\n"
+        "    nb = bucket_nodes(n)\n"
+        "    a = plan_jit(state, num=nb)\n"       # bucket ladder
+        "    b = plan_jit(state, num=8)\n"        # literal
+        "    c = plan_jit(state, num=MAX_NODES)\n"  # module constant
+        "    d = plan_jit(state, num=snap.bucket)\n"  # snapshot bucket
+        "    return a, b, c, d\n"
+    )
+    assert _hits(src, RETRACE_RULE) == []
+
+
+def test_retrace_static_argnums_positional_flagged():
+    src = (
+        "import jax\n"
+        "def _plan(state, num):\n"
+        "    return state\n"
+        "plan_jit = jax.jit(_plan, static_argnums=(1,))\n"
+        "def tick(state, n):\n"
+        "    return plan_jit(state, n)\n"
+    )
+    hits = _hits(src, RETRACE_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 6
+
+
+FACTORY = (
+    "import jax\n"
+    "def make_step(nb):\n"
+    "    def f(x):\n"
+    "        return x\n"
+    "    return jax.jit(f)\n"
+)
+
+
+def test_retrace_factory_called_with_dynamic_geometry_flagged():
+    src = FACTORY + (
+        "def tick(n):\n"
+        "    return make_step(n)(0)\n"
+    )
+    hits = _hits(src, RETRACE_RULE)
+    assert len(hits) == 1
+    assert "make_step" in hits[0].message
+
+
+def test_retrace_factory_called_with_bucketed_geometry_clean():
+    src = FACTORY + (
+        "from tpu_cc_manager.plan import bucket_nodes\n"
+        "def tick(n):\n"
+        "    nb = bucket_nodes(n)\n"
+        "    return make_step(nb)(0)\n"
+    )
+    assert _hits(src, RETRACE_RULE) == []
+
+
+def test_retrace_pragma_alias_suppresses():
+    src = JIT_HEADER + (
+        "def tick(state, n):\n"
+        "    return plan_jit(state, num=n)"
+        "  # ccaudit: allow-retrace(one-shot admin path)\n"
+    )
+    assert _hits(src, RETRACE_RULE) == []
+
+
+def test_retrace_exempt_under_simlab():
+    src = JIT_HEADER + (
+        "def tick(state, n):\n"
+        "    return plan_jit(state, num=n)\n"
+    )
+    assert _hits(src, RETRACE_RULE,
+                 relpath="tpu_cc_manager/simlab/drive.py") == []
+
+
+# ---------------------------------------------- host-sync-in-hot-path
+
+HOT_HEADER = (
+    "import jax\n"
+    "def _plan(x):\n"
+    "    return x\n"
+    "step = jax.jit(_plan)\n"
+)
+
+
+def test_host_sync_float_on_jit_output_in_hot_path_flagged():
+    src = HOT_HEADER + (
+        "def scan_once():\n"
+        "    out = step(1)\n"
+        "    return float(out)\n"
+    )
+    hits = _hits(src, SYNC_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 7
+    assert hits[0].severity == "warning"
+
+
+def test_host_sync_block_until_ready_in_hot_path_flagged():
+    src = HOT_HEADER + (
+        "def scan_once():\n"
+        "    out = step(1)\n"
+        "    out.block_until_ready()\n"
+    )
+    assert len(_hits(src, SYNC_RULE)) == 1
+
+
+def test_host_sync_device_get_is_the_sanctioned_path():
+    src = HOT_HEADER + (
+        "def scan_once():\n"
+        "    out = step(1)\n"
+        "    host = jax.device_get(out)\n"
+        "    return float(host)\n"
+    )
+    assert _hits(src, SYNC_RULE) == []
+
+
+def test_host_sync_silent_off_the_hot_path():
+    src = HOT_HEADER + (
+        "def helper():\n"
+        "    out = step(1)\n"
+        "    return float(out)\n"
+    )
+    assert _hits(src, SYNC_RULE) == []
+
+
+def test_host_sync_pragma_alias_suppresses():
+    src = HOT_HEADER + (
+        "def scan_once():\n"
+        "    out = step(1)\n"
+        "    return float(out)"
+        "  # ccaudit: allow-host-sync(single scalar, measured cheap)\n"
+    )
+    assert _hits(src, SYNC_RULE) == []
+
+
+# --------------------------------------------- unserialized-dispatch
+
+COLLECTIVE = (
+    "import threading\n"
+    "import jax\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "_DISPATCH_LOCK = threading.Lock()\n"
+    "def _tick(x):\n"
+    "    return x\n"
+    "sharded = shard_map(_tick)\n"
+    "jitted = jax.jit(sharded)\n"
+)
+
+
+def test_dispatch_without_lock_flagged_as_error():
+    src = COLLECTIVE + (
+        "def go(x):\n"
+        "    return jitted(x)\n"
+    )
+    hits = _hits(src, DISPATCH_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 10
+    assert hits[0].severity == "error"
+    assert "_DISPATCH_LOCK" in hits[0].message
+
+
+def test_dispatch_under_lexical_lock_clean():
+    src = COLLECTIVE + (
+        "def go(x):\n"
+        "    with _DISPATCH_LOCK:\n"
+        "        return jitted(x)\n"
+    )
+    assert _hits(src, DISPATCH_RULE) == []
+
+
+def test_dispatch_under_caller_held_lock_clean():
+    # the ⋂-fixpoint: every resolved path into `inner` holds the lock
+    src = COLLECTIVE + (
+        "def outer(x):\n"
+        "    with _DISPATCH_LOCK:\n"
+        "        return inner(x)\n"
+        "def inner(x):\n"
+        "    return jitted(x)\n"
+    )
+    assert _hits(src, DISPATCH_RULE) == []
+
+
+def test_dispatch_pragma_suppresses():
+    src = COLLECTIVE + (
+        "def go(x):\n"
+        "    return jitted(x)"
+        "  # ccaudit: allow-unserialized-dispatch(single-threaded tool)\n"
+    )
+    assert _hits(src, DISPATCH_RULE) == []
+
+
+def test_non_collective_jit_needs_no_lock():
+    src = (
+        "import jax\n"
+        "def _plan(x):\n"
+        "    return x\n"
+        "plain = jax.jit(_plan)\n"
+        "def go(x):\n"
+        "    return plain(x)\n"
+    )
+    assert _hits(src, DISPATCH_RULE) == []
+
+
+# ------------------------------------------------- donation-violation
+
+DONATE = (
+    "import jax\n"
+    "def _upd(buf):\n"
+    "    return buf\n"
+    "upd = jax.jit(_upd, donate_argnums=(0,))\n"
+)
+
+
+def test_donated_buffer_read_after_call_flagged():
+    src = DONATE + (
+        "def apply(buf):\n"
+        "    out = upd(buf)\n"
+        "    return buf + out\n"
+    )
+    hits = _hits(src, DONATION_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 7
+    assert "donate" in hits[0].message
+
+
+def test_donated_name_rebound_before_read_clean():
+    src = DONATE + (
+        "def apply(buf):\n"
+        "    out = upd(buf)\n"
+        "    buf = out\n"
+        "    return buf\n"
+    )
+    assert _hits(src, DONATION_RULE) == []
+
+
+def test_donation_pragma_alias_suppresses():
+    src = DONATE + (
+        "def apply(buf):\n"
+        "    out = upd(buf)\n"
+        "    return buf + out"
+        "  # ccaudit: allow-donation(aliasing checked upstream)\n"
+    )
+    assert _hits(src, DONATION_RULE) == []
+
+
+# ------------------------------------------------------- tracer-leak
+
+def test_tracer_global_store_in_jitted_body_flagged():
+    src = (
+        "import jax\n"
+        "LAST = None\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    global LAST\n"
+        "    LAST = x\n"
+        "    return x\n"
+    )
+    hits = _hits(src, TRACER_RULE)
+    assert len(hits) == 1
+    assert "LAST" in hits[0].message
+
+
+def test_tracer_store_in_function_reachable_from_target_flagged():
+    src = (
+        "import jax\n"
+        "LAST = None\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    return helper(x)\n"
+        "def helper(x):\n"
+        "    global LAST\n"
+        "    LAST = x\n"
+        "    return x\n"
+    )
+    assert len(_hits(src, TRACER_RULE)) == 1
+
+
+def test_tracer_condition_on_traced_param_flagged():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    if x:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    hits = _hits(src, TRACER_RULE)
+    assert len(hits) == 1
+    assert "TracerBoolConversionError" in hits[0].message
+
+
+def test_tracer_python_level_tests_are_clean():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def kernel(x, *, debug=False):\n"
+        "    if x is None:\n"
+        "        return x\n"
+        "    if debug:\n"          # kwonly: config, not an array
+        "        return -x\n"
+        "    if isinstance(x, tuple):\n"
+        "        return x[0]\n"
+        "    return x\n"
+    )
+    assert _hits(src, TRACER_RULE) == []
+
+
+def test_tracer_condition_on_static_argname_clean():
+    src = (
+        "import jax\n"
+        "def _plan(x, n):\n"
+        "    if n:\n"
+        "        return x\n"
+        "    return x\n"
+        "plan2 = jax.jit(_plan, static_argnames=('n',))\n"
+    )
+    assert _hits(src, TRACER_RULE) == []
+
+
+def test_tracer_pragma_suppresses():
+    src = (
+        "import jax\n"
+        "LAST = None\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    global LAST\n"
+        "    # ccaudit: allow-tracer-leak(stores a python int, not a tracer)\n"
+        "    LAST = 1\n"
+        "    return x\n"
+    )
+    assert _hits(src, TRACER_RULE) == []
+
+
+# --------------------------------------------------------- fact cache
+
+CACHED_SRC = (
+    "def f():\n"
+    "    try:\n"
+    "        pass\n"
+    "    except Exception:\n"
+    "        pass\n"
+)
+
+
+def _audit_keys(audit):
+    return sorted(f.key() for f in audit.findings)
+
+
+def test_cache_hit_returns_identical_facts(tmp_path):
+    pkg = tmp_path / "tpu_cc_manager"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(CACHED_SRC)
+    cache = tmp_path / CACHE_DIR_NAME
+    cache.mkdir()
+    v = analyzer_version_hash()
+    rel = "tpu_cc_manager/m.py"
+    a1 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    assert len(list(cache.iterdir())) == 1  # entry written
+    a2 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    assert a2.module.relpath == rel
+    assert _audit_keys(a1) == _audit_keys(a2)
+    assert any(f.rule == "swallow" for f in a2.findings)
+
+
+def test_cache_content_change_invalidates(tmp_path):
+    pkg = tmp_path / "tpu_cc_manager"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(CACHED_SRC)
+    cache = tmp_path / CACHE_DIR_NAME
+    cache.mkdir()
+    v = analyzer_version_hash()
+    rel = "tpu_cc_manager/m.py"
+    load_audit_cached(str(tmp_path), rel, str(cache), v)
+    (pkg / "m.py").write_text("def f():\n    return 1\n")
+    a2 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    # fresh facts for the new content, under a new key
+    assert a2.findings == []
+    assert len(list(cache.iterdir())) == 2
+
+
+def test_cache_corrupt_entry_falls_back_to_fresh_parse(tmp_path):
+    pkg = tmp_path / "tpu_cc_manager"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(CACHED_SRC)
+    cache = tmp_path / CACHE_DIR_NAME
+    cache.mkdir()
+    v = analyzer_version_hash()
+    rel = "tpu_cc_manager/m.py"
+    a1 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    (entry,) = cache.iterdir()
+    entry.write_bytes(b"not a pickle")
+    a2 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    assert _audit_keys(a1) == _audit_keys(a2)
+
+
+def test_version_hash_self_invalidates_on_analyzer_change():
+    v = analyzer_version_hash()
+    assert len(v) == 16
+    assert v == analyzer_version_hash()  # stable within a tree
+    # the digest covers every analysis/*.py source, so editing any rule
+    # module yields a different key prefix-set; pinned structurally:
+    import tpu_cc_manager.analysis as pkg
+
+    pkg_dir = os.path.dirname(pkg.__file__)
+    assert any(f == "jitflow.py" for f in os.listdir(pkg_dir))
+
+
+# -------------------------------------------- live surface + slicing
+
+
+@pytest.fixture(scope="module")
+def full_scan():
+    return analyze_paths()
+
+
+def test_live_tree_passes_v5_clean(full_scan):
+    # the shipped tree passes its own jitflow rules: every deliberate
+    # sync/dispatch/trace-time effect carries an in-source pragma, and
+    # nothing rides the baseline (zero new entries — the ratchet only
+    # burns down)
+    assert [f for f in full_scan if f.rule in JITFLOW_RULES] == []
+
+
+def test_files_subset_reports_exactly_the_full_runs_slice(full_scan):
+    # --files runs the ANALYSIS whole-program and slices only the
+    # REPORT, so jitflow facts (hot set, caller-held locksets, the jit
+    # inventory) never degrade on a changed-files pass
+    target = "tpu_cc_manager/plan.py"
+    sub = analyze_paths(targets=[target], subset=True)
+    assert sorted(sub) == sorted(
+        f for f in full_scan if f.file == target
+    )

@@ -111,6 +111,38 @@ the thread passes could not see into:
     gather-settles-everything contract, docs/io.md) is flagged via a
     settle-sink summary over the call graph.
 
+v5 taught the analyzer the JAX dispatch model (``jitflow.py`` over the
+same call graph and caller-held ⋂-fixpoint — docs/analysis.md §v5),
+ahead of the multi-host planner refactor (ROADMAP item 1) that
+multiplies the dispatch surface:
+
+``retrace-hazard``
+    Shape/static arguments of jitted callables and jit factories are
+    classified on a CONST ⊑ BUCKETED ⊑ DYNAMIC provenance lattice;
+    anything not derived from the sanctioned bucket ladder
+    (``bucket_nodes``/``bucket_pools``, a snapshot's ``.bucket``)
+    at a geometry/static position is a silent multi-second recompile
+    in the tick path. ``allow-retrace(reason)`` suppresses.
+``host-sync-in-hot-path``
+    Implicit device→host transfers (``float()``/``int()``/``bool()``/
+    ``np.asarray``/``.item()``/iteration on jit outputs) and
+    ``.block_until_ready()`` reachable from reconcile/scan call paths
+    stall the controller thread; ``jax.device_get`` is the sanctioned
+    explicit transfer. bench/scripts/simlab exempt;
+    ``allow-host-sync(reason)`` suppresses.
+``unserialized-dispatch``
+    Every dispatch of a ``shard_map``-wrapped collective program must
+    hold ``_DISPATCH_LOCK`` (plan.py:746 — PR 7's rendezvous stalls),
+    lexically or via the caller-held ⋂-fixpoint. Error severity.
+``donation-violation``
+    An argument at a ``donate_argnums`` position read after the
+    donating call sees freed device memory (statement-order).
+``tracer-leak``
+    Writes to ``self.``/module globals inside traced bodies run once
+    per (re)trace, not per call; ``if``/``while`` on a traced
+    parameter is a trace-time TypeError. Static/keyword-only config
+    parameters and ``is None`` defaulting are exempt.
+
 Findings are gated against ``analysis/baseline.json`` so CI fails only on
 *new* findings; stale baseline entries (the code they suppressed moved or
 was fixed) also fail, so the baseline can only burn down.
@@ -157,4 +189,10 @@ RULES = (
     "loop-self-deadlock",
     "orphan-task",
     "async-exception",
+    # v5 — the JAX-dispatch families (jitflow.py)
+    "retrace-hazard",
+    "host-sync-in-hot-path",
+    "unserialized-dispatch",
+    "donation-violation",
+    "tracer-leak",
 )

@@ -31,10 +31,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "race-lockset over thread-shared state, label hygiene, "
         "exception discipline, metric-name consistency, protocol-literal "
         "confinement, unvalidated-mode taint, Mode exhaustiveness, "
-        "protocol liveness, code<->manifest drift, and the v4 async "
+        "protocol liveness, code<->manifest drift, the v4 async "
         "families: await-atomicity, lock-across-await, loop-affinity "
         "typestate, loop self-deadlock, orphan tasks, async-exception "
-        "fail-secure). docs/analysis.md has the rule contract.",
+        "fail-secure, and the v5 jitflow families over the JAX "
+        "dispatch surface: retrace hazards vs the bucket ladder, "
+        "host-sync stalls in hot paths, unserialized collective "
+        "dispatch, donated-buffer reuse, tracer leaks). "
+        "docs/analysis.md has the rule contract.",
     )
     parser.add_argument(
         "targets", nargs="*", default=list(DEFAULT_TARGETS),
@@ -99,6 +103,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "ignored (entries for out-of-slice files are out of scope, "
         "not stale). `make lint-fast` wires this to the git diff.",
     )
+    parser.add_argument(
+        "--cache", action="store_true",
+        help="route the per-module parse stage through the "
+        "content-hash fact cache (<root>/.ccaudit_cache/): unchanged "
+        "modules reload pickled facts, only edited ones re-parse. The "
+        "whole-program passes still run fresh over every module, so a "
+        "cached scan reports exactly what an uncached one would; keys "
+        "embed an analyzer-source digest, so rule edits self-"
+        "invalidate. `make lint-fast` turns this on.",
+    )
     args = parser.parse_args(argv)
 
     with_manifests: Optional[bool] = None
@@ -127,7 +141,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         findings = analyze_paths(
             root, targets, with_manifests, call_depth=args.call_depth,
-            subset=args.files,
+            subset=args.files, cache=args.cache,
         )
     except FileNotFoundError as e:
         print(f"ccaudit: {e}", file=sys.stderr)

@@ -8,8 +8,10 @@ here so a single ``analyze_paths()`` call yields one flat finding list.
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import os
+import pickle
 import re
 import tokenize
 from dataclasses import dataclass
@@ -211,6 +213,70 @@ def on_default_surface(relpath: str) -> bool:
     )
 
 
+#: where the opt-in per-module fact cache lives, relative to the repo
+#: root (gitignored; ``--cache`` / ``make lint-fast`` turn it on)
+CACHE_DIR_NAME = ".ccaudit_cache"
+
+
+def analyzer_version_hash() -> str:
+    """Digest of the analyzer's own sources. Cache keys embed it, so
+    editing ANY rule module invalidates every cached fact — the cache
+    can never serve facts a different analyzer computed."""
+    h = hashlib.sha256()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            h.update(fn.encode("utf-8"))
+            with open(os.path.join(pkg, fn), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def load_audit_cached(root: str, relpath: str, cache_dir: str,
+                      version: str):
+    """Per-module parse + audit through the fact cache.
+
+    Key = sha256(relpath + source) + analyzer version: an unchanged
+    module re-loads its pickled ModuleAudit (AST, accesses, calls,
+    locks, per-module findings — everything the whole-program passes
+    consume) instead of re-walking; any source or analyzer change
+    misses and re-parses. Corrupt or unreadable entries fall back to a
+    fresh parse — the cache can slow a scan down, never change it.
+    Returns None for unparseable modules (same contract as
+    ``load_module``)."""
+    from tpu_cc_manager.analysis import rules
+
+    with open(os.path.join(root, relpath), "r", encoding="utf-8") as f:
+        src = f.read()
+    digest = hashlib.sha256(
+        (relpath + "\0" + src).encode("utf-8")
+    ).hexdigest()[:32]
+    path = os.path.join(cache_dir, f"{digest}-{version}.pkl")
+    try:
+        with open(path, "rb") as f:
+            audit = pickle.load(f)
+        if getattr(audit, "module", None) is not None \
+                and audit.module.relpath == relpath:
+            return audit
+    except Exception:
+        # ccaudit: allow-swallow(cache miss / corrupt / stale-format entry: the contract is fall back to a fresh parse — a cache can slow a scan down, never break it)
+        pass
+    try:
+        mod = Module(relpath, src)
+    except SyntaxError:
+        return None
+    audit = rules.audit_module(mod)
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(audit, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)  # atomic: concurrent scans never see halves
+    except Exception:
+        # ccaudit: allow-swallow(cache write failure — read-only checkout, full disk: the scan already has the fresh audit in hand and proceeds uncached)
+        pass
+    return audit
+
+
 def load_module(root: str, relpath: str) -> Optional[Module]:
     with open(os.path.join(root, relpath), "r", encoding="utf-8") as f:
         src = f.read()
@@ -242,14 +308,20 @@ def analyze_modules(
 
 def _analyze_modules(
     modules: Sequence[Module], call_depth: Optional[int] = None,
+    audits: Optional[list] = None,
 ) -> Tuple[List[Finding], list]:
     """analyze_modules plus the per-module audits — analyze_paths
     feeds the audits' metric-declaration registry to the slo
-    cross-check (analysis/slo.py)."""
+    cross-check (analysis/slo.py). ``audits`` short-circuits the
+    per-module stage with already-computed (possibly cache-loaded)
+    ModuleAudits, aligned 1:1 with ``modules`` — the whole-program
+    passes below always run fresh over the full fact set, so a cache
+    hit can never change what a scan reports."""
     from tpu_cc_manager.analysis import (
         asyncflow,
         callgraph,
         dataflow,
+        jitflow,
         lockgraph,
         lockset,
         rules,
@@ -257,11 +329,10 @@ def _analyze_modules(
     )
 
     findings: List[Finding] = []
-    audits = []
-    for mod in modules:
-        result = rules.audit_module(mod)
+    if audits is None:
+        audits = [rules.audit_module(mod) for mod in modules]
+    for result in audits:
         findings.extend(result.findings)
-        audits.append(result)
     depth = callgraph.DEPTH_LIMIT if call_depth is None else call_depth
     graph = callgraph.build(audits, depth)
     sink_summaries = dataflow.collect_sink_summaries(audits, graph)
@@ -279,6 +350,7 @@ def _analyze_modules(
         lockset.race_findings(audits, graph, roots, async_lock_quals)
     )
     findings.extend(asyncflow.async_findings(audits, graph, roots))
+    findings.extend(jitflow.jitflow_findings(audits, graph, roots))
     findings.extend(rules.metric_findings(audits))
     findings.extend(rules.liveness_findings(audits))
     findings.extend(rules.direct_write_findings(modules))
@@ -296,6 +368,7 @@ def analyze_paths(
     with_manifests: Optional[bool] = None,
     call_depth: Optional[int] = None,
     subset: bool = False,
+    cache: bool = False,
 ) -> List[Finding]:
     """Full repo scan: the AST rules over ``targets`` plus — when scanning
     the default surface (or when ``with_manifests`` forces it) — the
@@ -311,7 +384,14 @@ def analyze_paths(
     outside any given diff. Filtering the report instead guarantees a
     subset run flags exactly the full run's findings for those files.
     Only the manifest/slo cross-checks are skipped — their findings
-    land on manifest files a Python slice can never contain."""
+    land on manifest files a Python slice can never contain.
+
+    ``cache=True`` (the CLI's ``--cache``) routes the per-module parse
+    stage through the content-hash fact cache under
+    ``<root>/.ccaudit_cache/`` — only changed modules re-parse, while
+    the whole-program passes still run fresh over every module's
+    facts, so a cached scan reports exactly what an uncached one
+    would."""
     root = root or repo_root()
     report_only: Optional[Set[str]] = None
     if subset:
@@ -321,11 +401,23 @@ def analyze_paths(
     if with_manifests is None:
         with_manifests = tuple(targets) == DEFAULT_TARGETS
     modules = []
-    for rel in iter_python_files(root, targets):
-        mod = load_module(root, rel)
-        if mod is not None:
-            modules.append(mod)
-    findings, audits = _analyze_modules(modules, call_depth)
+    audits_in: Optional[list] = None
+    if cache:
+        cache_dir = os.path.join(root, CACHE_DIR_NAME)
+        os.makedirs(cache_dir, exist_ok=True)
+        version = analyzer_version_hash()
+        audits_in = []
+        for rel in iter_python_files(root, targets):
+            audit = load_audit_cached(root, rel, cache_dir, version)
+            if audit is not None:
+                modules.append(audit.module)
+                audits_in.append(audit)
+    else:
+        for rel in iter_python_files(root, targets):
+            mod = load_module(root, rel)
+            if mod is not None:
+                modules.append(mod)
+    findings, audits = _analyze_modules(modules, call_depth, audits_in)
     if with_manifests:
         from tpu_cc_manager.analysis import manifests, slo
 

@@ -77,6 +77,23 @@ _RULE_HELP = {
     "async-exception": "An except exits an async request path without "
     "settling or propagating its pending entries "
     "(gather-settles-everything contract).",
+    "retrace-hazard": "Jitted callable or jit factory invoked with a "
+    "shape/static argument not derived from the bucket ladder "
+    "(bucket_nodes/bucket_pools) — each distinct value is a silent "
+    "multi-second XLA recompile in the tick path.",
+    "host-sync-in-hot-path": "Implicit device-to-host transfer "
+    "(float()/int()/np.asarray/.item()/iteration on a jit output) or "
+    "block_until_ready() on a reconcile/scan hot path — stalls the "
+    "controller thread; batch through one explicit jax.device_get.",
+    "unserialized-dispatch": "A shard_map collective dispatched without "
+    "holding _DISPATCH_LOCK (plan.py's contract): concurrent dispatch "
+    "interleaves XLA's all-reduce rendezvous and parks participants in "
+    "multi-second stalls.",
+    "donation-violation": "Argument at a donate_argnums position read "
+    "after the donating call — its device buffer now belongs to XLA.",
+    "tracer-leak": "Traced value stored to self./module globals (runs "
+    "once per retrace, not per call) or used in a Python if/while "
+    "inside a jitted body (TracerBoolConversionError).",
     "stale-baseline": "Baseline entry matching no current finding — "
     "delete it (the ratchet only burns down).",
 }

@@ -46,7 +46,7 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException
@@ -116,7 +116,7 @@ class RegionSpec:
     the process-global env posture — single-region compatibility)."""
 
     name: str
-    client_factory: Callable[[], object]
+    client_factory: Callable[[], Any]
     pools: Sequence[str]
     trust_domain: Optional[RegionTrustDomain] = None
 
@@ -168,8 +168,10 @@ class RegionRingView:
     def owner_of(self, key: str, region: Optional[str] = None) -> str:
         return self.ring.owner_of(key, region=self.region)
 
-    def partition(self, keys: Sequence[str],
-                  region_of=None) -> Dict[str, List[str]]:
+    def partition(
+        self, keys: Sequence[str],
+        region_of: Optional[Callable[[str], Optional[str]]] = None,
+    ) -> Dict[str, List[str]]:
         out: Dict[str, List[str]] = {m: [] for m in self.members}
         for key in keys:
             out[self.owner_of(key)].append(key)
@@ -254,14 +256,14 @@ class FederationManager:
             )
         #: per-region write clients (posture patches, cordons): every
         #: region's writes go through ITS API server, never a sibling's
-        self._clients = {
+        self._clients: Dict[str, Any] = {
             r.name: r.client_factory() for r in regions
         }
         self._lock = threading.Lock()
         self._posture: Optional[FleetPosture] = None
         self._generation = 0
-        self._evacuated: set = set()
-        self._partitioned: set = set()
+        self._evacuated: Set[str] = set()
+        self._partitioned: Set[str] = set()
         self._evacuations: List[dict] = []
         #: set by evacuate(): every still-waiting region window
         #: collapses to NOW (absorb). Re-created per posture.

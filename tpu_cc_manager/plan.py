@@ -426,21 +426,34 @@ class FleetEncoding:
                 doctor_details=dict(self._doctor_details),
                 columns=cols,
                 pool_names=[],
+                bucket=nb,
             )
 
 
 class FleetSnapshot:
-    """Immutable bucket-padded view of one encoding instant."""
+    """Immutable bucket-padded view of one encoding instant.
+
+    ``bucket`` is the node bucket the columns were padded to — THE
+    sanctioned geometry for dispatching the tick on this snapshot.
+    Kernel call sites must size ``_tick_fn`` from it, never from
+    ``len(columns[...])``: the length happens to equal the bucket
+    today, but deriving geometry from data shape is exactly the
+    provenance ccaudit's retrace-hazard rule rejects (a non-ladder
+    shape is a silent multi-second recompile per distinct value)."""
 
     def __init__(self, names: List[str], slice_index: Dict[str, int],
                  doctor_details: Dict[str, dict],
                  columns: Dict[str, np.ndarray],
-                 pool_names: List[str]) -> None:
+                 pool_names: List[str],
+                 bucket: Optional[int] = None) -> None:
         self.names = names
         self.slice_index = slice_index
         self.doctor_details = doctor_details
         self.columns = columns
         self.pool_names = pool_names
+        self.bucket = (
+            bucket if bucket is not None else bucket_nodes(len(names))
+        )
 
     @property
     def n_nodes(self) -> int:
@@ -554,6 +567,7 @@ TRACE_COUNTS: Dict[str, int] = {}
 
 
 def _count_trace(name: str) -> None:
+    # ccaudit: allow-tracer-leak(deliberate trace-time side effect: counting (re)traces is the POINT — tests/test_plan_cache pins "drift within a bucket compiles exactly once" on this counter, and only an int is stored, never a tracer)
     TRACE_COUNTS[name] = TRACE_COUNTS.get(name, 0) + 1
 
 
@@ -809,6 +823,14 @@ def _tick_fn(nb: int, pb: int) -> Callable[..., Any]:
 
         def run(columns: Dict[str, np.ndarray],
                 pool_target: np.ndarray) -> Dict[str, np.ndarray]:
+            # host-side prep BEFORE the lock: dtype coercion, clock
+            # reads, and env parsing don't touch the device, and every
+            # instruction inside the critical section extends the
+            # window in which a racing scan's rendezvous is parked —
+            # _DISPATCH_LOCK is held for dispatch only
+            pt_host = np.asarray(pool_target, np.int32)
+            now_host = np.int32(int(time.time()))
+            stale_host = np.int32(int(_stale_after_s()))
             with _DISPATCH_LOCK:
                 args = [
                     jax.device_put(columns[k], node_shard)
@@ -816,12 +838,9 @@ def _tick_fn(nb: int, pb: int) -> Callable[..., Any]:
                               "pool_ids", "taint", "doctor", "ev_ts",
                               "valid")
                 ]
-                args.append(jax.device_put(
-                    np.asarray(pool_target, np.int32), rep_shard))
-                args.append(jax.device_put(
-                    np.int32(int(time.time())), rep_shard))
-                args.append(jax.device_put(
-                    np.int32(int(_stale_after_s())), rep_shard))
+                args.append(jax.device_put(pt_host, rep_shard))
+                args.append(jax.device_put(now_host, rep_shard))
+                args.append(jax.device_put(stale_host, rep_shard))
                 return jax.device_get(jitted(*args))
 
         run.lower = lambda: jitted.lower(  # type: ignore[attr-defined]
@@ -967,7 +986,7 @@ def analyze_encoding(enc: FleetEncoding) -> dict:
     n = snap.n_nodes
     if n == 0:
         return _empty_report()
-    nb = len(snap.columns["desired"])
+    nb = snap.bucket
     out = _tick_fn(nb, BUCKET_MIN_POOLS)(
         snap.columns, np.zeros(BUCKET_MIN_POOLS, np.int32)
     )
@@ -1067,7 +1086,7 @@ def analyze_pools(
     pool_ids[n:] = pb - 1
     pool_target = np.zeros(pb, np.int32)
     pool_target[: len(targets)] = targets
-    nb = len(snap.columns["desired"])
+    nb = snap.bucket
     out = _tick_fn(nb, pb)(snap.columns, pool_target)
     result: Dict[str, Dict[str, int]] = {}
     for pid, (pname, _, _) in enumerate(pools):

@@ -49,7 +49,10 @@ import json
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import (
+    TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence, Set,
+    Tuple,
+)
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.leader import LeaderElector
@@ -57,6 +60,9 @@ from tpu_cc_manager.obs import (
     Counter, Gauge, RouteServer, render_metric_set, validate_exposition,
 )
 from tpu_cc_manager.watch import NodeInformer
+
+if TYPE_CHECKING:  # runtime imports stay lazy (fleet/policy import shard-adjacent modules)
+    from tpu_cc_manager.policy import PolicyController
 
 log = logging.getLogger("tpu-cc-manager.shard")
 
@@ -108,7 +114,7 @@ class HashRing:
         stray = sorted(set(self.regions) - set(self.members))
         if stray:
             raise ValueError(f"region tags for non-members: {stray}")
-        points = []
+        points: List[Tuple[int, str]] = []
         for m in members:
             for v in range(vnodes):
                 points.append((_hash64(f"{m}#{v}"), m))
@@ -181,20 +187,22 @@ class ShardScopedClient:
     predicate; every other verb — all writes included — passes through
     untouched. Controllers stay completely unaware they are sharded."""
 
-    def __init__(self, base, *,
+    def __init__(self, base: Any, *,
                  node_filter: Optional[Callable[[dict], bool]] = None,
-                 custom_filter: Optional[Callable[[str], bool]] = None):
+                 custom_filter: Optional[Callable[[str], bool]] = None,
+                 ) -> None:
         self.base = base
         self.node_filter = node_filter
         self.custom_filter = custom_filter
 
-    def list_nodes(self, label_selector=None):
+    def list_nodes(self, label_selector: Optional[str] = None) -> List[dict]:
         nodes = self.base.list_nodes(label_selector)
         if self.node_filter is None:
             return nodes
         return [n for n in nodes if self.node_filter(n)]
 
-    def list_cluster_custom(self, group, version, plural):
+    def list_cluster_custom(self, group: str, version: str,
+                            plural: str) -> List[dict]:
         objs = self.base.list_cluster_custom(group, version, plural)
         if self.custom_filter is None:
             return objs
@@ -203,7 +211,7 @@ class ShardScopedClient:
             if self.custom_filter((o.get("metadata") or {}).get("name", ""))
         ]
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         return getattr(self.base, name)
 
 
@@ -245,7 +253,7 @@ class ControllerShard:
             # root in one region latches only that region's shards
             attest_key=manager.attest_key,
         )
-        self.policy = None
+        self.policy: Optional["PolicyController"] = None
         if manager.policy:
             from tpu_cc_manager.policy import PolicyController
 
@@ -309,7 +317,7 @@ class ShardHost:
     # ---------------------------------------------------------- promotion
     def _on_promoted(self, shard_id: str) -> None:
         bundle = ControllerShard(self.manager, shard_id)
-        stale = None
+        stale: Optional[ControllerShard] = None
         with self._lock:
             if not self._alive:
                 stale = bundle  # crashed while the callback was in flight
@@ -456,7 +464,7 @@ class ShardManager:
 
     def __init__(
         self,
-        client_factory: Callable[[], object],
+        client_factory: Callable[[], Any],
         *,
         shards: Optional[int] = None,
         pools: Sequence[str],
@@ -476,7 +484,7 @@ class ShardManager:
         port: int = 0,
         shard_ids: Optional[Sequence[str]] = None,
         ring: Optional[HashRing] = None,
-        attest_key=None,
+        attest_key: Any = None,
         region: Optional[str] = None,
     ) -> None:
         if shard_ids is not None:
@@ -620,7 +628,7 @@ class ShardManager:
 
     # -------------------------------------------------------------- reading
     def _covered_shards(self) -> int:
-        held = set()
+        held: Set[str] = set()
         for host in self.hosts:
             if host.alive:
                 held.update(host.covered_shards())
@@ -687,7 +695,7 @@ class ShardManager:
         from tpu_cc_manager import fleetobs
 
         self._refresh_gauges()
-        snaps = []
+        snaps: List[Any] = []
         helps: Dict[str, str] = {}
         for bundle in self.bundles():
             text = bundle.metrics_text()
@@ -729,11 +737,11 @@ class ShardManager:
         self.metrics.partitions_covered.set(self._covered_shards())
 
     # --------------------------------------------------------------- routes
-    def _fleet_metrics_route(self):
+    def _fleet_metrics_route(self) -> Tuple[int, bytes, str]:
         return (200, self.merged_fleet_metrics().encode(),
                 "text/plain; version=0.0.4")
 
-    def _shards_route(self):
+    def _shards_route(self) -> Tuple[int, bytes, str]:
         body = json.dumps(self.stats(), indent=2, sort_keys=True).encode()
         return 200, body, "application/json"
 
