@@ -740,6 +740,149 @@ def run_planner_tick_bench(n_nodes=100_000, n_pools=8, slice_hosts=16):
     }
 
 
+def _synthetic_encoding(n_nodes, slice_hosts=16):
+    """A populated FleetEncoding at bench scale WITHOUT paying a
+    million apply() calls: the columns, row map and slice bookkeeping
+    are stuffed directly (same layout apply() would produce — the
+    realistic mode mix of run_planner_tick_bench), fingerprints left
+    empty so the bench's delta applies always re-encode. ``_dirty_all``
+    stays latched: the session's first tick is the rebuild, exactly
+    like a controller adopting a live encoding."""
+    import numpy as np
+
+    from tpu_cc_manager import plan
+
+    enc = plan.FleetEncoding()
+    nb = plan.bucket_nodes(n_nodes)
+    rng = np.random.default_rng(7)
+    on = plan.MODE_CODES["on"]
+    names = [f"n{i:07d}" for i in range(n_nodes)]
+    enc._names = names
+    enc._row = {name: i for i, name in enumerate(names)}
+    enc._cap = nb
+    enc._desired = np.full(nb, on, np.int32)
+    enc._desired[n_nodes:] = 0
+    observed = np.full(nb, on, np.int32)
+    observed[n_nodes:] = 0
+    div = rng.random(n_nodes) < 0.03
+    observed[:n_nodes][div] = plan.MODE_CODES["off"]
+    observed[:n_nodes][rng.random(n_nodes) < 0.002] = (
+        plan.MODE_CODES["failed"]
+    )
+    enc._observed = observed
+    slice_of = np.arange(n_nodes, dtype=np.int64) // slice_hosts
+    n_slices = int(slice_of[-1]) + 1
+    sl = np.zeros(nb, np.int32)
+    sl[:n_nodes] = slice_of
+    enc._slice = sl
+    enc._slice_index = {f"s{j}": j for j in range(n_slices)}
+    enc._slice_key_of = {j: f"s{j}" for j in range(n_slices)}
+    counts = np.bincount(slice_of, minlength=n_slices)
+    enc._slice_refs = {j: int(counts[j]) for j in range(n_slices)}
+    enc._slice_rows = {
+        j: set(range(j * slice_hosts,
+                     min((j + 1) * slice_hosts, n_nodes)))
+        for j in range(n_slices)
+    }
+    enc._next_slice = n_slices
+    taint = np.zeros(nb, np.int32)
+    taint[:n_nodes] = (rng.random(n_nodes) < 0.01).astype(np.int32)
+    enc._taint = taint
+    doctor = np.zeros(nb, np.int32)
+    doctor[:n_nodes] = np.where(
+        rng.random(n_nodes) < 0.005, plan.DOCTOR_FAILING, plan.DOCTOR_OK
+    )
+    enc._doctor = doctor
+    ev_ts = np.full(nb, -1, np.int32)
+    ev_ts[:n_nodes] = int(time.time()) - rng.integers(
+        0, 7200, n_nodes
+    ).astype(np.int32)
+    enc._ev_ts = ev_ts
+    return enc
+
+
+def run_planner_incr_bench(n_nodes=None, slice_hosts=16,
+                           delta_rate=0.01, ticks=4):
+    """The 10^6-node incremental axis (ISSUE 19 / ROADMAP item 1): a
+    synthetic million-node encoding adopted by a TickSession, then
+    steady-state incremental ticks at a realistic ~1% delta rate —
+    each round re-encodes only the flipped nodes and scatters them
+    into the device-resident sharded block. planner_tick_1m_s is the
+    min steady incremental tick (the first round additionally pays
+    the one-per-bucket scatter compile and is excluded by the min);
+    planner_tick_incr_speedup compares it against the legacy
+    full-tick path (snapshot + device_put + fused kernel — what a
+    controller paid per scan before the session existed).
+    TPU_CC_BENCH_PLANNER_NODES shrinks the fleet for the CI 2-core
+    sandbox (bench-smoke runs 250k so the axis never rots)."""
+    import os as _os
+
+    import numpy as np
+
+    from tpu_cc_manager import plan
+
+    if n_nodes is None:
+        n_nodes = int(_os.environ.get(
+            "TPU_CC_BENCH_PLANNER_NODES", "1000000"))
+    label = "1m" if n_nodes >= 1_000_000 else f"{n_nodes // 1000}k"
+    enc = _synthetic_encoding(n_nodes, slice_hosts)
+    rng = np.random.default_rng(11)
+    names = enc._names
+
+    def _delta_node(i, flip_round):
+        # alternate the observed state so every round's fingerprint
+        # differs and the apply really re-encodes the row
+        state = "off" if (flip_round % 2 == 0) else "on"
+        return {"metadata": {"name": names[i], "labels": {
+            L.CC_MODE_LABEL: "on",
+            L.CC_MODE_STATE_LABEL: state,
+            L.TPU_SLICE_LABEL: f"s{i // slice_hosts}",
+        }}}
+
+    # full_every=0: the cadence full tick is the controller's drift
+    # net, not a steady-state cost — the bench times pure incremental
+    # rounds and then one explicit legacy-style full tick to compare
+    sess = plan.TickSession(full_every=0)
+    t0 = time.monotonic()
+    res = sess.tick(enc)
+    first_s = time.monotonic() - t0
+    k = max(1, int(n_nodes * delta_rate))
+    incr_times = []
+    for r in range(ticks):
+        hit = rng.choice(n_nodes, size=k, replace=False)
+        for i in hit:
+            enc.apply(_delta_node(int(i), r))
+        t0 = time.monotonic()
+        res = sess.tick(enc)
+        incr_times.append(time.monotonic() - t0)
+    incr_s = min(incr_times)
+    # sanity: the incremental state still accounts for every node
+    if int(res.outputs["mode_counts"].sum()) != n_nodes:
+        print("FATAL: planner incr bench lost nodes", file=sys.stderr)
+        sys.exit(1)
+    # legacy full tick at the same scale: snapshot + upload + fused
+    # kernel (the pre-session per-scan cost). Warm once untimed so the
+    # comparison is steady-vs-steady, not compile-vs-steady.
+    nb = plan.bucket_nodes(n_nodes)
+    pb = sess.pool_bucket
+    pool_target = np.zeros(pb, np.int32)
+    fn = plan._tick_fn(nb, pb)
+    fn(enc.snapshot().columns, pool_target)
+    t0 = time.monotonic()
+    fn(enc.snapshot().columns, pool_target)
+    full_s = time.monotonic() - t0
+    return {
+        f"planner_tick_{label}_s": round(incr_s, 4),
+        f"planner_tick_{label}_first_s": round(first_s, 4),
+        f"planner_tick_{label}_full_s": round(full_s, 4),
+        "planner_tick_incr_speedup": round(full_s / max(incr_s, 1e-9), 2),
+        f"planner_tick_{label}_topology": (
+            f"{n_nodes}n/{slice_hosts}-host-slices@b{nb}"
+            f"/delta{delta_rate:g}x{ticks}"
+        ),
+    }
+
+
 def _phase_fallback_cycle(state_dir: str):
     """CPU-PJRT phase decomposition (ISSUE 13 satellite): BENCH_NOTES
     r10 records that the r06-r08 real-chip phase data was NEVER
@@ -1619,6 +1762,11 @@ def main():
         )
         # 100k-node planner tick (ROADMAP item 3's scale proof)
         result["extras"].update(run_planner_tick_bench())
+        # 1M-node INCREMENTAL tick + incremental-vs-full speedup
+        # (ISSUE 19 / ROADMAP item 1): steady-state delta ticks on the
+        # device-resident session; TPU_CC_BENCH_PLANNER_NODES shrinks
+        # it for bench-smoke (250k on the 2-core sandbox)
+        result["extras"].update(run_planner_incr_bench())
         # the parallel flip pipeline (ISSUE 4): 8 fake chips with
         # simulated reset latency, serial loop vs bounded executor —
         # multichip_flip_s joins the trend-gated axes

@@ -127,6 +127,18 @@ GATED_EXTRA_AXES = {
     # starts re-walking the tree or a fixpoint loses termination
     # sharpness.
     "ccaudit_wall_s": "lower",
+    # joined in r19 (the incremental-planner round, ISSUE 19): the
+    # steady-state INCREMENTAL tick over a synthetic million-node
+    # fleet at a 1% delta rate (device-resident sharded columns,
+    # delta scatter instead of re-upload) — the axis that regresses
+    # if the session quietly falls back to rebuild-per-tick; and the
+    # incremental-vs-full speedup ratio, which collapses toward 1.0
+    # on the same failure even when absolute wall time hides it on a
+    # fast host. bench-smoke runs the same code path at 250k
+    # (TPU_CC_BENCH_PLANNER_NODES) so the nightly-tier 1M axis never
+    # rots unexercised.
+    "planner_tick_1m_s": "lower",
+    "planner_tick_incr_speedup": "higher",
 }
 
 #: absolute bars on the newest round (ISSUE 6 acceptance): floors are
@@ -142,6 +154,11 @@ THROUGHPUT_FLOORS = {
     # the same sandbox (BENCH_NOTES ## r13 pre-explains the step and
     # carries the r07 degraded-host acknowledgment convention forward)
     "flips_per_min_windowed": 25000.0,
+    # ISSUE 19 acceptance: incremental ticks at a 1% delta rate must
+    # beat full ticks by >= 5x (measured ~10-13x on the 2-core
+    # sandbox at 250k-1M nodes; the margin absorbs host noise, the
+    # floor fails any fallback to whole-fleet re-evaluation)
+    "planner_tick_incr_speedup": 5.0,
 }
 #: node_writes_per_flip: the coalescing contract is <= 2 writes per
 #: flip on the hot path; 2.5 allows the idle-tick flush tail without
@@ -159,6 +176,11 @@ WRITE_CEILINGS = {
 LATENCY_CEILINGS = {
     "fleet_scan_warm_s": 0.5,
     "planner_tick_100k_s": 9.0,
+    # ISSUE 19 acceptance: a steady incremental tick at 10^6 nodes
+    # must sit in the same latency decade as today's 100k full tick —
+    # measured 0.09 s on the 2-core sandbox; 0.5 allows a loaded CI
+    # host, not a session that re-uploads the block every tick.
+    "planner_tick_1m_s": 0.5,
     # a flip write under offered load must stay well inside the flush
     # window (measured 0.027-0.034 s on the 2-core sandbox; the
     # ceiling allows a loaded CI host, not a re-serialized pipeline)

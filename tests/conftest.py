@@ -65,6 +65,17 @@ def _prewarm_planner():
     plan._tick_fn(plan.BUCKET_MIN_NODES, plan.BUCKET_MIN_POOLS)(
         cols, np.zeros(plan.BUCKET_MIN_POOLS, np.int32)
     )
+    # the incremental session's two kernels at the same smallest
+    # geometry (ISSUE 19): rebuild eval + delta scatter, so
+    # wall-clock-sensitive tests don't pay their first compile either
+    sess = plan.TickSession(full_every=0)
+    enc = plan.FleetEncoding()
+    enc.apply({"metadata": {"name": "_prewarm", "labels": {}}})
+    sess.tick(enc)                      # _eval_fn compile (rebuild)
+    enc.apply({"metadata": {"name": "_prewarm", "labels": {
+        "tpu.google.com/cc.mode": "on"}}})
+    sess.tick(enc)                      # _scatter_fn compile (delta)
+    sess.tick(enc, force_full=True)     # verify path
 
 
 @pytest.fixture(autouse=True)

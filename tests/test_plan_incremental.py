@@ -1,0 +1,403 @@
+"""The incremental delta-tick planner (ISSUE 19): dirty-row/slice
+masks, the device-resident sharded session, incremental == full
+checksum pins, transfer-count pins, and the delta paths the tentpole
+leans on (remove-swap x compaction, doctor-details cleanup, sync's
+fingerprint-skip counts, the events-dropped counter)."""
+
+import copy
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_cc_manager import labels as L
+from tpu_cc_manager import plan
+from tpu_cc_manager.k8s.objects import make_node
+
+
+def _node(name, desired="on", observed="on", slice_id=None, taint=False,
+          doctor=None, ev=None):
+    labels = {L.CC_MODE_LABEL: desired, L.CC_MODE_STATE_LABEL: observed}
+    if slice_id:
+        labels[L.TPU_SLICE_LABEL] = slice_id
+    node = make_node(name, labels=labels)
+    ann = node["metadata"].setdefault("annotations", {})
+    if doctor is not None:
+        ann[L.DOCTOR_ANNOTATION] = json.dumps(doctor)
+    if ev is not None:
+        ann[L.EVIDENCE_ANNOTATION] = json.dumps({"timestamp": ev})
+    if taint:
+        node.setdefault("spec", {})["taints"] = [
+            {"key": L.FLIP_TAINT_KEY, "effect": "NoSchedule"}
+        ]
+    return node
+
+
+def _mixed_nodes(n=40):
+    nodes = {}
+    for i in range(n):
+        nodes[f"n{i:03d}"] = _node(
+            f"n{i:03d}", slice_id=f"s{i // 4}",
+            desired="on", observed="on" if i % 5 else "off",
+            taint=(i % 7 == 0),
+            doctor=({"ok": False, "fail": ["hw"]} if i % 11 == 0
+                    else {"ok": True}),
+        )
+    return nodes
+
+
+def _norm(report):
+    """Order-insensitive report compare (name lists are set-like)."""
+    r = copy.deepcopy(report)
+    for key in ("needs_flip", "failed", "flipping", "stale_evidence",
+                "incoherent_slices", "half_flipped_slices"):
+        r[key] = sorted(r[key])
+    r["doctor"] = {
+        k: (sorted(v, key=lambda x: json.dumps(x, sort_keys=True))
+            if isinstance(v, list) else v)
+        for k, v in r["doctor"].items()
+    }
+    return r
+
+
+def _encode_all(nodes):
+    enc = plan.FleetEncoding()
+    for nd in nodes.values():
+        enc.apply(copy.deepcopy(nd))
+    return enc
+
+
+def _legacy_report(nodes):
+    return plan.analyze_encoding(_encode_all(nodes))
+
+
+def test_incremental_matches_full_after_mixed_deltas():
+    """The core pin: a session report after mode flips, an add, a
+    swap-remove and a slice move equals a from-scratch legacy tick over
+    the same fleet — and the rebuild only happened once."""
+    nodes = _mixed_nodes()
+    enc = _encode_all(nodes)
+    sess = plan.TickSession(full_every=0)
+    assert _norm(plan.analyze_encoding(enc, sess)) == _norm(
+        _legacy_report(nodes))
+    assert sess.stats["rebuilds"] == 1
+
+    for i in (3, 8, 21):
+        nd = copy.deepcopy(nodes[f"n{i:03d}"])
+        nd["metadata"]["labels"][L.CC_MODE_STATE_LABEL] = "off"
+        nodes[f"n{i:03d}"] = nd
+        enc.apply(nd)
+    nodes["n100"] = _node("n100", slice_id="s2", desired="off",
+                          observed="on")
+    enc.apply(nodes["n100"])
+    enc.remove("n005")
+    del nodes["n005"]
+    moved = copy.deepcopy(nodes["n012"])
+    moved["metadata"]["labels"][L.TPU_SLICE_LABEL] = "s9"
+    nodes["n012"] = moved
+    enc.apply(moved)
+
+    assert _norm(plan.analyze_encoding(enc, sess)) == _norm(
+        _legacy_report(nodes))
+    assert sess.stats["rebuilds"] == 1
+    assert sess.stats["incr_ticks"] == 1
+
+
+def test_forced_full_tick_checksum_pin():
+    """The tier-1 incremental == full pin: a forced full tick runs the
+    whole device kernel over the resident block and compares EVERY
+    output array against the incrementally maintained state — it
+    returning (instead of raising IncrementalDriftError) IS the
+    checksum pin, and the report still matches legacy."""
+    nodes = _mixed_nodes()
+    enc = _encode_all(nodes)
+    sess = plan.TickSession(full_every=0)
+    plan.analyze_encoding(enc, sess)
+    nd = copy.deepcopy(nodes["n002"])
+    nd["metadata"]["labels"][L.CC_MODE_STATE_LABEL] = "failed"
+    nodes["n002"] = nd
+    enc.apply(nd)
+    report = plan.analyze_encoding(enc, sess, force_full=True)
+    assert _norm(report) == _norm(_legacy_report(nodes))
+    assert sess.stats["verifies"] == 1
+    assert sess.last_checksum is not None
+    res = sess.tick(enc, force_full=True)
+    assert res.kind == "full"
+    assert res.checksum == sess.last_checksum
+
+
+def test_drift_raises_and_next_tick_rebuilds():
+    """Divergence between the incremental state and the full kernel is
+    a HARD failure, and the session recovers by rebuilding from
+    encoding truth on the next tick."""
+    nodes = _mixed_nodes()
+    enc = _encode_all(nodes)
+    sess = plan.TickSession(full_every=0)
+    plan.analyze_encoding(enc, sess)
+    sess._state["mode_counts"][0] += 1  # inject drift
+    with pytest.raises(plan.IncrementalDriftError):
+        plan.analyze_encoding(enc, sess, force_full=True)
+    assert _norm(plan.analyze_encoding(enc, sess)) == _norm(
+        _legacy_report(nodes))
+    assert sess.stats["rebuilds"] == 2
+
+
+def test_zero_column_round_trips_between_ticks():
+    """The donation contract's observable: node columns are uploaded
+    ONCE per rebuild (8 device_puts) and never again — steady-state
+    incremental ticks (including verifying full ticks) move only the
+    kb-sized delta operands, and a tick with nothing dirty dispatches
+    nothing at all."""
+    nodes = _mixed_nodes()
+    enc = _encode_all(nodes)
+    sess = plan.TickSession(full_every=0)
+    plan.analyze_encoding(enc, sess)
+    assert sess.stats["column_puts"] == 8
+    for round_ in range(3):
+        for i in (1, 6, 17):
+            nd = copy.deepcopy(nodes[f"n{i:03d}"])
+            nd["metadata"]["labels"][L.CC_MODE_STATE_LABEL] = (
+                "on" if round_ % 2 else "off")
+            nodes[f"n{i:03d}"] = nd
+            enc.apply(nd)
+        plan.analyze_encoding(enc, sess)
+    plan.analyze_encoding(enc, sess, force_full=True)
+    assert sess.stats["column_puts"] == 8, sess.stats
+    assert sess.stats["delta_puts"] > 0
+    assert sess.stats["delta_rows"] >= 9
+    # nothing dirty -> the cached report, no dispatch, no transfers
+    before = dict(sess.stats)
+    plan.analyze_encoding(enc, sess)
+    assert sess.stats["cached_ticks"] == before["cached_ticks"] + 1
+    assert sess.stats["delta_puts"] == before["delta_puts"]
+    assert sess.stats["column_puts"] == 8
+
+
+def test_bucket_growth_triggers_rebuild_and_stays_correct():
+    """Crossing a node-bucket boundary is compile geometry: the session
+    must rebuild (new block, new kernels) and keep report parity."""
+    nodes = _mixed_nodes(40)
+    enc = _encode_all(nodes)
+    sess = plan.TickSession(full_every=0)
+    plan.analyze_encoding(enc, sess)
+    for i in range(40, 70):  # bucket 64 -> 128
+        nodes[f"n{i:03d}"] = _node(f"n{i:03d}", slice_id=f"s{i // 4}",
+                                   observed="off" if i % 3 else "on")
+        enc.apply(nodes[f"n{i:03d}"])
+    assert _norm(plan.analyze_encoding(enc, sess)) == _norm(
+        _legacy_report(nodes))
+    assert sess.stats["rebuilds"] == 2
+    assert sess.node_bucket == plan.bucket_nodes(70)
+
+
+def test_single_device_mesh_parity(monkeypatch):
+    """The 1-device CPU path runs the same sharded program and must
+    produce the identical report (the psum/pmin/pmax combines make
+    1-device == multi-chip — no Python fallback path to drift)."""
+    monkeypatch.setenv("TPU_CC_PLANNER_MESH", "1")
+    nodes = _mixed_nodes(24)
+    enc = _encode_all(nodes)
+    sess = plan.TickSession(full_every=0)
+    r1 = plan.analyze_encoding(enc, sess)
+    nd = copy.deepcopy(nodes["n003"])
+    nd["metadata"]["labels"][L.CC_MODE_STATE_LABEL] = "off"
+    nodes["n003"] = nd
+    enc.apply(nd)
+    r2 = plan.analyze_encoding(enc, sess, force_full=True)
+    assert _norm(r1) == _norm(_legacy_report(
+        {k: v for k, v in nodes.items() if k != "n003"}
+        | {"n003": _mixed_nodes(24)["n003"]}))
+    assert _norm(r2) == _norm(_legacy_report(nodes))
+
+
+def test_remove_swap_with_last_interacts_with_compaction():
+    """Satellite: swap-with-last removal while slice-id compaction
+    fires. A churn of ephemeral solo slices drives dead slots past the
+    compaction threshold; removing rows mid-churn exercises the
+    released-sid-then-swap path, and the session must stay in lockstep
+    the whole way."""
+    nodes = {}
+    enc = plan.FleetEncoding()
+    sess = plan.TickSession(full_every=0)
+    for i in range(30):
+        nodes[f"n{i:03d}"] = _node(f"n{i:03d}", slice_id=f"s{i // 3}",
+                                   observed="off" if i % 4 else "on")
+        enc.apply(nodes[f"n{i:03d}"])
+    plan.analyze_encoding(enc, sess)
+    for round_ in range(25):
+        # ephemeral slice churn on one node drives dead-slot growth
+        nd = _node("churn", slice_id=f"eph-{round_}", observed="off")
+        nodes["churn"] = nd
+        enc.apply(nd)
+        if round_ % 5 == 2:
+            victim = f"n{round_:03d}"
+            enc.remove(victim)  # swaps the LAST row into the hole
+            nodes.pop(victim, None)
+        assert _norm(plan.analyze_encoding(enc, sess)) == _norm(
+            _legacy_report(nodes))
+    # internal invariants survived: membership sets mirror the column
+    n = len(enc._names)
+    for sid, rows in enc._slice_rows.items():
+        for row in rows:
+            assert row < n and int(enc._slice[row]) == sid
+    assert all(v < plan.bucket_nodes(n)
+               for v in enc._slice_index.values())
+
+
+def test_doctor_details_cleanup_on_remove():
+    """Satellite: removing a node drops its _doctor_details entry —
+    a stale entry would resurrect a dead node's verdict in the next
+    report's doctor details."""
+    enc = plan.FleetEncoding()
+    enc.apply(_node("sick", doctor={"ok": False, "fail": ["iommu"]}))
+    enc.apply(_node("fine", doctor={"ok": True}))
+    assert "sick" in enc._doctor_details
+    assert enc.remove("sick")
+    assert "sick" not in enc._doctor_details
+    report = plan.analyze_encoding(enc)
+    assert report["doctor"]["failing"] == []
+
+
+def test_sync_changed_count_under_fingerprint_skips():
+    """Satellite: sync() returns how many rows actually changed —
+    unchanged nodes fingerprint-skip, removals count."""
+    enc = plan.FleetEncoding()
+    nodes = [_node(f"n{i}", observed="on") for i in range(6)]
+    assert enc.sync(nodes) == 6
+    assert enc.sync(nodes) == 0  # pure fingerprint compares
+    nodes[2] = _node("n2", observed="off")
+    assert enc.sync(nodes) == 1
+    assert enc.sync(nodes[:-1]) == 1  # n5 vanished -> one removal
+    assert len(enc) == 5
+
+
+def test_apply_event_drop_counts():
+    """Satellite: malformed watch events are dropped (never thrown in
+    a watch thread) AND counted — silent drops are observable."""
+    enc = plan.FleetEncoding()
+    enc.apply_event("ADDED", {"metadata": {}})  # no name -> KeyError
+    enc.apply_event("ADDED", {"metadata": {"name": "ok", "labels": {}}})
+    assert enc.events_dropped == 1
+    assert len(enc) == 1
+
+
+def test_events_dropped_total_rendered_by_fleet_metrics():
+    """The counter reaches /metrics through the reflection path: the
+    scan mirrors the encoding's total onto the FleetMetrics counter."""
+    from tpu_cc_manager.fleet import FleetController
+    from tpu_cc_manager.k8s.fake import FakeKube
+
+    kube = FakeKube()
+    kube.add_node(make_node("n1", labels={
+        L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+        L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on",
+    }))
+    ctrl = FleetController(kube, port=0)
+    ctrl._encoding.apply_event("ADDED", {"metadata": {}})  # dropped
+    ctrl.scan_once()
+    text = ctrl.metrics.render()
+    assert "tpu_cc_planner_events_dropped_total 1" in text, text
+
+
+def test_policy_scratch_reuses_device_buffers():
+    """Satellite: analyze_pools with a PoolScanScratch matches the
+    throwaway-encoding path exactly, and repeated scans allocate NO
+    new device buffers (column_puts flat after the first rebuild) —
+    even when a policy's target mode changes."""
+    nodes = _mixed_nodes(16)
+    pools = [
+        ("pool-a", "on",
+         [copy.deepcopy(nodes[f"n{i:03d}"]) for i in (1, 2, 3, 4)]),
+        ("pool-b", "off",
+         [copy.deepcopy(nodes[f"n{i:03d}"]) for i in (8, 9)]),
+    ]
+    scratch = plan.PoolScanScratch()
+    assert plan.analyze_pools(pools, scratch=scratch) == \
+        plan.analyze_pools(pools)
+    puts = scratch.session.stats["column_puts"]
+    assert puts == 8
+    assert plan.analyze_pools(pools, scratch=scratch) == \
+        plan.analyze_pools(pools)
+    assert scratch.session.stats["column_puts"] == puts
+    retarget = [("pool-a", "off", pools[0][2]), pools[1]]
+    assert plan.analyze_pools(retarget, scratch=scratch) == \
+        plan.analyze_pools(retarget)
+    assert scratch.session.stats["column_puts"] == puts
+
+
+def test_fleet_scan_skips_sync_behind_live_delta_feed():
+    """With a live delta feed the scan trusts apply_event and skips the
+    per-scan list reconcile; a feed gap (or cadence) forces the next
+    scan to sync again."""
+    from tpu_cc_manager.fleet import FleetController
+    from tpu_cc_manager.k8s.fake import FakeKube
+
+    def fleet_node(name):
+        return make_node(name, labels={
+            L.TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+            L.CC_MODE_LABEL: "on", L.CC_MODE_STATE_LABEL: "on",
+        })
+
+    kube = FakeKube()
+    kube.add_node(fleet_node("n1"))
+    ctrl = FleetController(kube, port=0)
+    assert ctrl.scan_once()["nodes"] == 1
+    # live feed: a list-only change is invisible until resync
+    ctrl._delta_feed_active = True
+    kube.add_node(fleet_node("n2"))
+    assert ctrl.scan_once()["nodes"] == 1  # sync skipped
+    # the same change via the delta feed IS visible
+    ctrl._on_watch_event("ADDED", kube.get_node("n2"))
+    assert ctrl.scan_once()["nodes"] == 2
+    # a feed gap forces the next scan to list-reconcile
+    kube.add_node(fleet_node("n3"))
+    assert ctrl.scan_once()["nodes"] == 2
+    ctrl._watch_gap()
+    assert ctrl.scan_once()["nodes"] == 3
+    # cadence resync: the Nth skipped scan reconciles regardless
+    ctrl.sync_every = 1
+    kube.add_node(fleet_node("n4"))
+    reports = [ctrl.scan_once()["nodes"] for _ in range(2)]
+    assert reports[-1] == 4
+
+
+def test_run_node_watch_fires_on_gap_per_fresh_connect():
+    """on_gap fires at every from-scratch (re)connect — initial
+    establishment and after a stream failure — before the gap-covering
+    wake, so the woken scan already knows to resync."""
+    import logging
+
+    from tpu_cc_manager.k8s.client import ApiException
+    from tpu_cc_manager.watch import run_node_watch
+
+    stop = threading.Event()
+    gaps = []
+    wakes = []
+
+    class GapKube:
+        calls = 0
+
+        def watch_nodes(self, resource_version=None, timeout_s=None):
+            GapKube.calls += 1
+            if GapKube.calls == 1:
+                def gen():
+                    yield "ADDED", {"metadata": {
+                        "name": "a", "resourceVersion": "5"}}
+                    raise ApiException(500, "stream broke")
+                return gen()
+            stop.set()
+            return iter(())
+
+    def on_gap():
+        gaps.append(len(wakes))  # records wakes-at-gap-time
+
+    run_node_watch(
+        GapKube(), stop, lambda: wakes.append(1),
+        timeout_s=1, backoff_s=0.01,
+        logger=logging.getLogger("test"), who="test",
+        on_gap=on_gap,
+    )
+    assert len(gaps) == 2  # initial connect + post-failure reconnect
+    # each gap preceded its wake (on_gap fires first)
+    assert gaps[0] == 0 and gaps[1] <= len(wakes)

@@ -124,12 +124,14 @@ PRAGMA_ALIASES = {
 
 #: terminal names of the sanctioned bucket-ladder functions — their
 #: results are BUCKETED by definition
-_BUCKET_FNS = frozenset({"bucket_nodes", "bucket_pools"})
+_BUCKET_FNS = frozenset({"bucket_nodes", "bucket_pools",
+                         "bucket_deltas"})
 
 #: attribute names that carry a bucket by convention: a snapshot that
 #: computed its own bucket exposes it under ``.bucket`` (FleetSnapshot),
 #: the same way the ``_locked`` suffix carries a lockset contract
-_BUCKET_ATTRS = frozenset({"bucket", "node_bucket", "pool_bucket"})
+_BUCKET_ATTRS = frozenset({"bucket", "node_bucket", "pool_bucket",
+                           "delta_bucket"})
 
 #: function names that anchor the hot host paths: the controllers'
 #: reconcile/scan bodies and the planner's host API. Name-matched under

@@ -38,7 +38,7 @@ from tpu_cc_manager.obs import (
     wire_throttle_observer,
 )
 from tpu_cc_manager.plan import (
-    FleetEncoding, analyze_encoding, compile_stats,
+    FleetEncoding, TickSession, analyze_encoding, compile_stats,
 )
 from tpu_cc_manager.tsring import TimeSeriesRing
 
@@ -227,6 +227,13 @@ class FleetMetrics:
             "Planner compiles that missed the persistent compile "
             "cache (cold XLA paid; a warmed restart should add zero)",
         )
+        self.planner_events_dropped = Counter(
+            "tpu_cc_planner_events_dropped_total",
+            "Malformed node-watch events dropped by the planner's "
+            "feature block (FleetEncoding.apply_event) instead of "
+            "thrown in a watch thread — nonzero means the API server "
+            "is emitting node objects the encoder can't read",
+        )
 
     def update(self, report: dict) -> None:
         self.nodes.set(report["nodes"])
@@ -378,6 +385,23 @@ class FleetController:
         #: fingerprint-diff-synced against each scan's list, so the
         #: per-scan encode cost tracks what CHANGED, not fleet size
         self._encoding = FleetEncoding()
+        #: the planner's incremental tick state (ISSUE 19): device-
+        #: resident sharded columns + the host mirror that lets a scan
+        #: re-evaluate only the rows the watch feed dirtied. One
+        #: session per controller; analyze_encoding(session=...) owns
+        #: its rebuild/verify cadence.
+        self._tick_session = TickSession()
+        #: delta-feed trust (ISSUE 19): while a watch/informer feed is
+        #: live, scans may SKIP the full list reconcile (`sync`) —
+        #: apply_event already wrote every delta — and only resync on
+        #: cadence or after a feed gap (reconnect / informer relist)
+        #: flags that deltas may have been missed.
+        #: guards the three feed flags below — written from the watch/
+        #: informer threads, test-and-reset atomically by the scan
+        self._feed_lock = threading.Lock()
+        self._delta_feed_active = False
+        self._resync_needed = True
+        self._scans_since_sync = 0
         self.watch_timeout_s = 300
         self.watch_backoff_s = 5.0
         from tpu_cc_manager.config import _env_float
@@ -385,6 +409,9 @@ class FleetController:
         self.min_scan_gap_s = _env_float(
             "TPU_CC_FLEET_MIN_SCAN_GAP_S", 5.0
         )
+        self.sync_every = int(os.environ.get(
+            "TPU_CC_FLEET_SYNC_EVERY", "8"
+        ))
         self._stop = threading.Event()
         #: the controller's own metric history (tsring.py, ISSUE 9)
         self.tsring = TimeSeriesRing(self.metrics, name="fleet")
@@ -446,9 +473,27 @@ class FleetController:
             # list truth reconciles the watch-fed feature block
             # (unchanged nodes cost a fingerprint compare, not a
             # re-encode), then ONE jitted planner tick answers the
-            # divergence/slice/doctor questions in a batch
-            self._encoding.sync(nodes)
-            report = analyze_encoding(self._encoding)
+            # divergence/slice/doctor questions in a batch. With a
+            # live delta feed the fingerprint sweep itself is skipped
+            # between cadence resyncs — apply_event already wrote
+            # every delta — but a feed gap forces the next scan to
+            # reconcile (ISSUE 19).
+            with self._feed_lock:
+                do_sync = (not self._delta_feed_active
+                           or self._resync_needed
+                           or self._scans_since_sync >= self.sync_every)
+                if do_sync:
+                    # reset BEFORE the sync runs: a gap landing while
+                    # we reconcile re-arms the flag for the next scan
+                    self._resync_needed = False
+                    self._scans_since_sync = 0
+                else:
+                    self._scans_since_sync += 1
+            if do_sync:
+                self._encoding.sync(nodes)
+            report = analyze_encoding(
+                self._encoding, session=self._tick_session
+            )
             # label-vs-device truth: the JAX planner trusts label text;
             # the evidence audit cross-checks it against what each
             # node's agent independently attested (VERDICT r2 item 7)
@@ -502,6 +547,12 @@ class FleetController:
                 time.monotonic() - t0,
                 trace_id=current_trace_ids()[0])
             self.metrics.update(report)
+            # encoder-side drop total lives on the encoding (update()
+            # never sees it — reports carry analysis, not ingest
+            # health), mirrored here via the external-total pattern
+            self.metrics.planner_events_dropped.set_total(
+                float(self._encoding.events_dropped)
+            )
             self.last_report = report
         except Exception:
             self.metrics.scans_total.inc("error")
@@ -640,6 +691,22 @@ class FleetController:
     def _wake_scan(self) -> None:
         self._wake.set()
 
+    def _watch_gap(self) -> None:
+        """The private watch (re)connected: any deltas between streams
+        may have been lost, so the next scan must list-reconcile before
+        the planner trusts the feed again (ISSUE 19)."""
+        with self._feed_lock:
+            self._resync_needed = True
+
+    def _informer_gap_wake(self) -> None:
+        """Informer wake doubles as its gap signal: the shared informer
+        calls on_wake after every relist/reconnect storm as well as on
+        deltas, and a spurious resync costs one fingerprint sweep —
+        cheap insurance against a silently stale encoding."""
+        with self._feed_lock:
+            self._resync_needed = True
+        self._wake.set()
+
     def _on_informer_event(self, etype: str, node: dict) -> None:
         """Shared-informer delta: feed the encoding exactly like the
         private watch did, and wake the scan loop on report-relevant
@@ -662,13 +729,22 @@ class FleetController:
         report-relevant changes wake the scan loop, and every delta
         feeds the planner's feature block so the next scan encodes
         only what moved."""
-        run_node_watch(
-            self.kube, self._stop, self._wake.set,
-            timeout_s=self.watch_timeout_s,
-            backoff_s=self.watch_backoff_s,
-            logger=log, who="fleet",
-            on_event=self._on_watch_event,
-        )
+        with self._feed_lock:
+            self._delta_feed_active = True
+        try:
+            run_node_watch(
+                self.kube, self._stop, self._wake.set,
+                timeout_s=self.watch_timeout_s,
+                backoff_s=self.watch_backoff_s,
+                logger=log, who="fleet",
+                on_event=self._on_watch_event,
+                on_gap=self._watch_gap,
+            )
+        finally:
+            # pump returned (no watch support, or stop): scans fall
+            # back to list-reconciling every time
+            with self._feed_lock:
+                self._delta_feed_active = False
 
     # ---------------------------------------------------------------- run
     def run(self) -> int:
@@ -687,8 +763,13 @@ class FleetController:
             # shared informer (ISSUE 11): its single watch stream feeds
             # this controller's encoding and wake — no private watch
             self._informer_token = self.informer.subscribe(
-                on_event=self._on_informer_event, on_wake=self._wake.set,
+                on_event=self._on_informer_event,
+                # on_wake fires once per informer relist — exactly the
+                # delta-feed gap the sync-skip path must resync over
+                on_wake=self._informer_gap_wake,
             )
+            with self._feed_lock:
+                self._delta_feed_active = True
         else:
             watcher = threading.Thread(
                 target=self._watch_loop, name="fleet-watch", daemon=True

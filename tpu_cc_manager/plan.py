@@ -49,6 +49,7 @@ import logging
 import os
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -84,6 +85,9 @@ DOCTOR_FAILING = 2
 BUCKET_MIN_NODES = 64
 #: smallest pool-slot bucket: up to 7 pools + the padding slot
 BUCKET_MIN_POOLS = 8
+#: smallest delta-scatter block (incremental tick): delta counts from
+#: 1 to 64 rows share one compiled scatter program
+BUCKET_MIN_DELTAS = 64
 
 #: evidence older than this (seconds) is reported stale; the planner
 #: flags, the evidence audit judges (fleet.py)
@@ -101,6 +105,15 @@ def bucket_nodes(n: int) -> int:
 def bucket_pools(p: int) -> int:
     """Power-of-two pool-slot bucket holding ``p`` pools + padding."""
     need = max(p + 1, BUCKET_MIN_POOLS)
+    return 1 << (need - 1).bit_length()
+
+
+def bucket_deltas(k: int) -> int:
+    """Power-of-two delta-block bucket for the incremental tick's
+    scatter operands: distinct delta counts inside a bucket share one
+    compiled scatter program — the same no-recompile ladder as
+    :func:`bucket_nodes`."""
+    need = max(k, BUCKET_MIN_DELTAS)
     return 1 << (need - 1).bit_length()
 
 
@@ -188,6 +201,25 @@ class FleetEncoding:
         self._slice_refs: Dict[int, int] = {}
         self._next_slice = 0
         self._doctor_details: Dict[str, dict] = {}
+        #: incremental-tick dirty state (docs/planner.md "incremental
+        #: tick contract"): positional row indices whose contents
+        #: changed since the last begin_tick drain, slice slot ids
+        #: whose membership or member values changed, and the
+        #: everything-moved latch (growth, slice-id compaction — the
+        #: compactor rewrites the whole slice column, so no per-row
+        #: delta can describe it)
+        self._dirty_rows: set = set()
+        self._dirty_slices: set = set()
+        self._dirty_all = True
+        #: slice slot id → member row indices, kept in lock-step with
+        #: _slice/_slice_refs so an incremental tick can re-evaluate
+        #: exactly the dirty slices' member rows
+        self._slice_rows: Dict[int, set] = {}
+        #: apply_event drops malformed watch events instead of throwing
+        #: in a watch thread; this makes the drops observable
+        #: (fleet.FleetMetrics mirrors it onto /metrics as
+        #: tpu_cc_planner_events_dropped_total)
+        self.events_dropped = 0
 
     # ------------------------------------------------------------ internals
     def _grow(self, need: int) -> None:
@@ -203,6 +235,10 @@ class FleetEncoding:
             arr[: len(old)] = old
             setattr(self, attr, arr)
         self._cap = cap
+        # a capacity crossing is also a bucket crossing — the session
+        # rebuilds on bucket change anyway, but latch it explicitly so
+        # the invariant doesn't depend on that coincidence
+        self._dirty_all = True
 
     def _slice_id(self, key: str) -> int:
         sid = self._slice_index.get(key)
@@ -214,7 +250,12 @@ class FleetEncoding:
         self._slice_refs[sid] = self._slice_refs.get(sid, 0) + 1
         return sid
 
-    def _release_slice(self, sid: int) -> None:
+    def _release_slice(self, sid: int, row: int) -> None:
+        rows = self._slice_rows.get(sid)
+        if rows is not None:
+            rows.discard(row)
+            if not rows:
+                self._slice_rows.pop(sid, None)
         n = self._slice_refs.get(sid, 0) - 1
         if n <= 0:
             self._slice_refs.pop(sid, None)
@@ -251,7 +292,12 @@ class FleetEncoding:
         self._slice_refs = {
             remap[s]: c for s, c in self._slice_refs.items()
         }
+        self._slice_rows = {
+            remap[s]: r for s, r in self._slice_rows.items()
+            if s in remap
+        }
         self._next_slice = len(self._slice_index)
+        self._dirty_all = True
 
     @staticmethod
     def _fingerprint(node: dict) -> tuple:
@@ -285,7 +331,9 @@ class FleetEncoding:
         self._desired[i] = encode_mode(desired)
         self._observed[i] = encode_mode(observed)
         if slice_key is not None:
-            self._slice[i] = self._slice_id(slice_key)
+            sid = self._slice_id(slice_key)
+            self._slice[i] = sid
+            self._slice_rows.setdefault(sid, set()).add(i)
         self._taint[i] = 1 if tainted else 0
         code, details = _encode_doctor(doctor_raw)
         self._doctor[i] = code
@@ -324,9 +372,13 @@ class FleetEncoding:
                 # doctor updates must not churn the slice slot space
                 slice_key = None  # type: ignore[assignment]
             else:
-                self._release_slice(int(self._slice[i]))
+                old_sid = int(self._slice[i])
+                self._dirty_slices.add(old_sid)
+                self._release_slice(old_sid, i)
             self._fp[name] = fp
             self._write_row(i, name, fp, doctor_raw, slice_key)
+            self._dirty_rows.add(i)
+            self._dirty_slices.add(int(self._slice[i]))
             return True
 
     def remove(self, name: str) -> bool:
@@ -337,7 +389,9 @@ class FleetEncoding:
                 return False
             self._fp.pop(name, None)
             self._doctor_details.pop(name, None)
-            self._release_slice(int(self._slice[i]))
+            sid = int(self._slice[i])
+            self._dirty_slices.add(sid)
+            self._release_slice(sid, i)
             last = len(self._names) - 1
             if i != last:
                 moved = self._names[last]
@@ -346,11 +400,20 @@ class FleetEncoding:
                 for arr in (self._desired, self._observed, self._slice,
                             self._taint, self._doctor, self._ev_ts):
                     arr[i] = arr[last]
+                # the moved node changed position, not value: its slice
+                # membership follows the row, the slot aggregates don't
+                # move
+                moved_rows = self._slice_rows.get(int(self._slice[i]))
+                if moved_rows is not None:
+                    moved_rows.discard(last)
+                    moved_rows.add(i)
             self._names.pop()
             for arr, fill in ((self._desired, 0), (self._observed, 0),
                               (self._slice, 0), (self._taint, 0),
                               (self._doctor, 0), (self._ev_ts, -1)):
                 arr[last] = fill
+            self._dirty_rows.add(i)
+            self._dirty_rows.add(last)
             return True
 
     def apply_event(self, etype: str, node: dict) -> None:
@@ -366,6 +429,8 @@ class FleetEncoding:
             elif etype in ("ADDED", "MODIFIED"):
                 self.apply(node)
         except Exception:
+            with self._lock:
+                self.events_dropped += 1
             log.debug("unappliable node event dropped", exc_info=True)
 
     def sync(self, nodes: List[dict]) -> int:
@@ -394,17 +459,94 @@ class FleetEncoding:
         """Bucket-padded copies for one tick (padding rows: unknown
         modes, the reserved last slice slot, pool slot 0)."""
         with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> "FleetSnapshot":
+        n = len(self._names)
+        nb = bucket_nodes(n)
+        # the bucket reserves n+1 slice slots (live slices ≤ rows,
+        # plus the padding slot), but id ASSIGNMENT is monotonic and
+        # the release-side compaction is amortized — a relabel churn
+        # can push live ids past nb before its threshold trips. The
+        # kernel scatters by slot id, so every live id must be < nb:
+        # compact now if any isn't (cheap, and rare by construction)
+        if self._next_slice >= nb:
+            self._compact_slices()
+        cols = {}
+        for key, arr, pad in (
+            ("desired", self._desired, 0),
+            ("observed", self._observed, 0),
+            ("slice_ids", self._slice, nb - 1),
+            ("taint", self._taint, 0),
+            ("doctor", self._doctor, 0),
+            ("ev_ts", self._ev_ts, -1),
+        ):
+            out = np.full(nb, pad, np.int32)
+            out[:n] = arr[:n]
+            cols[key] = out
+        valid = np.zeros(nb, np.int32)
+        valid[:n] = 1
+        cols["valid"] = valid
+        cols["pool_ids"] = np.zeros(nb, np.int32)
+        return FleetSnapshot(
+            names=list(self._names),
+            slice_index=dict(self._slice_index),
+            doctor_details=dict(self._doctor_details),
+            columns=cols,
+            pool_names=[],
+            bucket=nb,
+        )
+
+    def tracked_names(self) -> List[str]:
+        with self._lock:
+            return list(self._names)
+
+    def row_map(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._row)
+
+    def begin_tick(self, *, session_bucket: Optional[int],
+                   with_meta: bool = False) -> "TickDelta":
+        """Atomically drain the dirty state for one incremental tick.
+
+        Returns a full rebuild package (``snapshot`` set — the session
+        must re-upload the block: geometry changed vs
+        ``session_bucket``, slice ids were compacted, or the delta
+        covers a large fraction of the rows) or a delta package: dirty
+        row indices with their current column values (snapshot padding
+        semantics for rows that shrank away), plus the member rows of
+        every dirty slice slot. Dirty state clears in the same critical
+        section — deltas applied after this call land in the NEXT
+        tick."""
+        with self._lock:
             n = len(self._names)
             nb = bucket_nodes(n)
-            # the bucket reserves n+1 slice slots (live slices ≤ rows,
-            # plus the padding slot), but id ASSIGNMENT is monotonic and
-            # the release-side compaction is amortized — a relabel churn
-            # can push live ids past nb before its threshold trips. The
-            # kernel scatters by slot id, so every live id must be < nb:
-            # compact now if any isn't (cheap, and rare by construction)
             if self._next_slice >= nb:
                 self._compact_slices()
-            cols = {}
+            k = len(self._dirty_rows)
+            rebuild = (
+                self._dirty_all or session_bucket != nb
+                # a delta touching a quarter of the block is cheaper
+                # re-uploaded whole than scattered row by row
+                or (k > 256 and 4 * k >= n)
+            )
+            meta = (
+                (list(self._names), dict(self._slice_index),
+                 dict(self._doctor_details))
+                if with_meta else None
+            )
+            if rebuild:
+                self._dirty_rows.clear()
+                self._dirty_slices.clear()
+                self._dirty_all = False
+                return TickDelta(n=n, bucket=nb,
+                                 snapshot=self._snapshot_locked(),
+                                 meta=meta)
+            rows = np.fromiter(self._dirty_rows, np.int64, count=k)
+            rows.sort()
+            live = rows < n
+            rl = rows[live]
+            vals: Dict[str, np.ndarray] = {}
             for key, arr, pad in (
                 ("desired", self._desired, 0),
                 ("observed", self._observed, 0),
@@ -413,21 +555,19 @@ class FleetEncoding:
                 ("doctor", self._doctor, 0),
                 ("ev_ts", self._ev_ts, -1),
             ):
-                out = np.full(nb, pad, np.int32)
-                out[:n] = arr[:n]
-                cols[key] = out
-            valid = np.zeros(nb, np.int32)
-            valid[:n] = 1
-            cols["valid"] = valid
-            cols["pool_ids"] = np.zeros(nb, np.int32)
-            return FleetSnapshot(
-                names=list(self._names),
-                slice_index=dict(self._slice_index),
-                doctor_details=dict(self._doctor_details),
-                columns=cols,
-                pool_names=[],
-                bucket=nb,
-            )
+                v = np.full(k, pad, np.int32)
+                v[live] = arr[rl]
+                vals[key] = v
+            vals["valid"] = live.astype(np.int32)
+            slices = [
+                (sid, np.fromiter(self._slice_rows.get(sid, ()),
+                                  np.int64))
+                for sid in sorted(self._dirty_slices) if sid < nb
+            ]
+            self._dirty_rows.clear()
+            self._dirty_slices.clear()
+            return TickDelta(n=n, bucket=nb, rows=rows, vals=vals,
+                             slices=slices, meta=meta)
 
 
 class FleetSnapshot:
@@ -458,6 +598,36 @@ class FleetSnapshot:
     @property
     def n_nodes(self) -> int:
         return len(self.names)
+
+
+class TickDelta:
+    """One drained increment of FleetEncoding dirty state
+    (:meth:`FleetEncoding.begin_tick`). Either ``snapshot`` is set
+    (full rebuild — re-upload the block) or ``rows``/``vals``/
+    ``slices`` are (scatter the delta into the resident block).
+
+    ``rows`` are sorted positional row indices; ``vals`` maps the
+    seven encoding columns to per-row values at those indices with
+    snapshot padding semantics for rows ≥ ``n``; ``slices`` pairs each
+    dirty slice slot id with its member row indices (empty for slots
+    that died)."""
+
+    __slots__ = ("n", "bucket", "snapshot", "rows", "vals", "slices",
+                 "meta")
+
+    def __init__(self, n: int, bucket: int,
+                 snapshot: Optional["FleetSnapshot"] = None,
+                 rows: Optional[np.ndarray] = None,
+                 vals: Optional[Dict[str, np.ndarray]] = None,
+                 slices: Optional[List[Tuple[int, np.ndarray]]] = None,
+                 meta: Optional[tuple] = None) -> None:
+        self.n = n
+        self.bucket = bucket
+        self.snapshot = snapshot
+        self.rows = rows
+        self.vals = vals
+        self.slices = slices
+        self.meta = meta
 
 
 def encode_fleet(nodes: List[dict]) -> Tuple[
@@ -760,35 +930,58 @@ _TICK_LOCK = threading.Lock()
 _DISPATCH_LOCK = threading.Lock()
 
 
+#: the fleet_tick outputs that are per-row (sharded row-wise); the rest
+#: are replicated aggregates
+_NODE_OUT_KEYS = ("needs_flip", "failed", "flipping", "doctor_failing",
+                  "doctor_unreported", "stale_evidence", "eligible")
+
+#: device-resident column order — fleet_tick's positional order; the
+#: TickSession block, the scatter operands, and the host mirror all
+#: index by it
+COLS_ORDER = ("desired", "observed", "slice_ids", "pool_ids", "taint",
+              "doctor", "ev_ts", "valid")
+
+
+def _mesh_env() -> tuple:
+    """Shared mesh/sharding plumbing for every planner kernel factory:
+    ``(mesh, row_spec, rep_spec, shard_map, shard_map_extra_kwargs,
+    node_sharding, rep_sharding, n_devices)``."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = _planner_devices()
+    mesh = Mesh(np.array(devices), axis_names=("pool",))
+    row = P("pool")
+    rep = P()
+    try:
+        from jax import shard_map as _shard_map  # jax >= 0.7
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    import inspect
+
+    params = inspect.signature(_shard_map).parameters
+    check_kw = next(
+        (k for k in ("check_vma", "check_rep") if k in params), None
+    )
+    extra = {check_kw: False} if check_kw else {}
+    return (mesh, row, rep, _shard_map, extra,
+            NamedSharding(mesh, row), NamedSharding(mesh, rep),
+            len(devices))
+
+
 def _tick_fn(nb: int, pb: int) -> Callable[..., Any]:
     """The jitted, mesh-sharded tick for one (node-bucket, pool-bucket)
     geometry — built once, cached, reused by every scan in the bucket
     (the reuse IS the no-recompile guarantee)."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
     devices = _planner_devices()
     key = (nb, pb, len(devices))
     with _TICK_LOCK:
         fn = _TICK_CACHE.get(key)
         if fn is not None:
             return fn
-        mesh = Mesh(np.array(devices), axis_names=("pool",))
-        row = P("pool")
-        rep = P()
-        node_keys = ("needs_flip", "failed", "flipping", "doctor_failing",
-                     "doctor_unreported", "stale_evidence", "eligible")
-        try:
-            from jax import shard_map as _shard_map  # jax >= 0.7
-        except ImportError:
-            from jax.experimental.shard_map import shard_map as _shard_map
-
-        import inspect
-
-        params = inspect.signature(_shard_map).parameters
-        check_kw = next(
-            (k for k in ("check_vma", "check_rep") if k in params), None
-        )
-        extra = {check_kw: False} if check_kw else {}
+        (mesh, row, rep, _shard_map, extra, node_shard, rep_shard,
+         _ndev) = _mesh_env()
+        node_keys = _NODE_OUT_KEYS
 
         def tick(desired: jnp.ndarray, observed: jnp.ndarray,
                  slice_ids: jnp.ndarray, pool_ids: jnp.ndarray,
@@ -818,8 +1011,6 @@ def _tick_fn(nb: int, pb: int) -> Callable[..., Any]:
             **extra,
         )
         jitted = jax.jit(sharded)
-        node_shard = NamedSharding(mesh, row)
-        rep_shard = NamedSharding(mesh, rep)
 
         def run(columns: Dict[str, np.ndarray],
                 pool_target: np.ndarray) -> Dict[str, np.ndarray]:
@@ -859,12 +1050,624 @@ def _tick_fn(nb: int, pb: int) -> Callable[..., Any]:
         return run
 
 
+_SCATTER_CACHE: Dict[Tuple[int, int, int], Callable[..., Any]] = {}
+_EVAL_CACHE: Dict[Tuple[int, int, int], Callable[..., Any]] = {}
+
+
+def _scatter_fn(nb: int, kb: int) -> Callable[..., Any]:
+    """The donated delta-scatter for one (node-bucket, delta-bucket)
+    geometry: writes up to ``kb`` updated rows into the device-resident
+    column block in place — ``donate_argnums`` aliases the input
+    buffers to the outputs, so the block never round-trips host↔device
+    between ticks. Padding entries carry global index ``nb`` (beyond
+    every shard's range — kept as-is). Built once per geometry and
+    cached, like :func:`_tick_fn`."""
+    devices = _planner_devices()
+    key = (nb, kb, len(devices))
+    with _TICK_LOCK:
+        fn = _SCATTER_CACHE.get(key)
+        if fn is not None:
+            return fn
+        (mesh, row, rep, _shard_map, extra, _node_shard, rep_shard,
+         ndev) = _mesh_env()
+        rows_local = nb // ndev
+
+        def scatter(desired: jnp.ndarray, observed: jnp.ndarray,
+                    slice_ids: jnp.ndarray, pool_ids: jnp.ndarray,
+                    taint: jnp.ndarray, doctor: jnp.ndarray,
+                    ev_ts: jnp.ndarray, valid: jnp.ndarray,
+                    idx: jnp.ndarray, vals: jnp.ndarray) -> tuple:
+            _count_trace("delta_scatter")
+            cols = (desired, observed, slice_ids, pool_ids, taint,
+                    doctor, ev_ts, valid)
+            local = idx - jax.lax.axis_index("pool") * rows_local
+            ok = (local >= 0) & (local < rows_local)
+            safe = jnp.clip(local, 0, rows_local - 1)
+            out = []
+            for j, col in enumerate(cols):
+                # rows owned by another shard (and padding) keep their
+                # current value — gather-then-where, so correctness
+                # doesn't hinge on scatter out-of-bounds semantics;
+                # idx is unique, so duplicate-index order is moot
+                upd = jnp.where(ok, vals[j], col[safe])
+                out.append(col.at[safe].set(upd))
+            return tuple(out)
+
+        sharded = _shard_map(
+            scatter, mesh=mesh,
+            in_specs=(row,) * 8 + (rep, rep),
+            out_specs=(row,) * 8,
+            **extra,
+        )
+        jitted = jax.jit(sharded, donate_argnums=tuple(range(8)))
+
+        def run(cols: tuple, idx: np.ndarray,
+                vals: np.ndarray) -> tuple:
+            idx_host = np.asarray(idx, np.int32)
+            vals_host = np.asarray(vals, np.int32)
+            with _DISPATCH_LOCK:
+                idx_dev = jax.device_put(idx_host, rep_shard)
+                vals_dev = jax.device_put(vals_host, rep_shard)
+                return jitted(*cols, idx_dev, vals_dev)
+
+        _SCATTER_CACHE[key] = run
+        return run
+
+
+def _eval_fn(nb: int, pb: int) -> Callable[..., Any]:
+    """The device-resident tick for one geometry: evaluates
+    :func:`fleet_tick` over columns that already live on the mesh and
+    returns them pass-through under ``donate_argnums`` — XLA aliases
+    each input buffer to its identical output, so the block stays
+    resident with zero copies — plus the host-fetched outputs.
+    Companion to :func:`_tick_fn`, which owns the upload-per-call
+    path."""
+    devices = _planner_devices()
+    key = (nb, pb, len(devices))
+    with _TICK_LOCK:
+        fn = _EVAL_CACHE.get(key)
+        if fn is not None:
+            return fn
+        (mesh, row, rep, _shard_map, extra, node_shard, rep_shard,
+         _ndev) = _mesh_env()
+
+        def tick(desired: jnp.ndarray, observed: jnp.ndarray,
+                 slice_ids: jnp.ndarray, pool_ids: jnp.ndarray,
+                 taint: jnp.ndarray, doctor: jnp.ndarray,
+                 ev_ts: jnp.ndarray, valid: jnp.ndarray,
+                 pool_target: jnp.ndarray, now_s: jnp.ndarray,
+                 stale_after_s: jnp.ndarray) -> tuple:
+            out = fleet_tick(
+                desired, observed, slice_ids, pool_ids, taint, doctor,
+                ev_ts, valid, pool_target, now_s, stale_after_s,
+                num_pools=pb, num_slices=nb, combine="pool",
+            )
+            cols = (desired, observed, slice_ids, pool_ids, taint,
+                    doctor, ev_ts, valid)
+            return cols, out
+
+        out_specs_out = {k: row for k in _NODE_OUT_KEYS}
+        out_specs_out.update({
+            k: rep for k in (
+                "mode_counts", "desired_counts", "pool_nodes",
+                "pool_converged", "pool_failed", "pool_eligible",
+                "pool_skew", "pool_divergent", "slice_coherent",
+                "slice_half_flipped",
+            )
+        })
+        sharded = _shard_map(
+            tick, mesh=mesh,
+            in_specs=(row,) * 8 + (rep, rep, rep),
+            out_specs=((row,) * 8, out_specs_out),
+            **extra,
+        )
+        jitted = jax.jit(sharded, donate_argnums=tuple(range(8)))
+
+        def run(cols: tuple, pool_target: np.ndarray, now_s: int,
+                stale_s: int) -> Tuple[tuple, Dict[str, np.ndarray]]:
+            pt_host = np.asarray(pool_target, np.int32)
+            now_host = np.int32(now_s)
+            stale_host = np.int32(stale_s)
+            with _DISPATCH_LOCK:
+                scalars = [jax.device_put(pt_host, rep_shard),
+                           jax.device_put(now_host, rep_shard),
+                           jax.device_put(stale_host, rep_shard)]
+                new_cols, out = jitted(*cols, *scalars)
+                return new_cols, jax.device_get(out)
+
+        run.node_sharding = node_shard  # type: ignore[attr-defined]
+        _EVAL_CACHE[key] = run
+        return run
+
+
 def _stale_after_s() -> float:
     try:
         return float(os.environ.get(
             "TPU_CC_EVIDENCE_STALE_S", EVIDENCE_STALE_S_DEFAULT))
     except ValueError:
         return EVIDENCE_STALE_S_DEFAULT
+
+
+# --------------------------------------------- incremental tick session
+
+
+class IncrementalDriftError(RuntimeError):
+    """The incremental tick state diverged from a full kernel
+    evaluation — the dirty-mask bookkeeping missed a delta. Hard
+    failure by design (docs/planner.md): a planner that silently
+    drifts is worse than one that crashes and rebuilds. The raising
+    session invalidates itself, so its next tick rebuilds from host
+    truth."""
+
+
+def _outputs_checksum(out: Dict[str, np.ndarray]) -> int:
+    """Order-stable CRC over every output array. The incremental ==
+    full pin compares the arrays themselves; the checksum is the
+    loggable/assertable digest of the same state."""
+    crc = 0
+    for key in sorted(out):
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(out[key]).tobytes(), crc)
+    return crc
+
+
+def _row_outputs(vals: Dict[str, np.ndarray], pool: np.ndarray,
+                 pool_target: np.ndarray, now_s: int,
+                 stale_s: int) -> Dict[str, np.ndarray]:
+    """fleet_tick's per-row booleans, host-side, for an arbitrary row
+    subset. MUST mirror the kernel exactly — the forced full tick
+    cross-checks every output array, so a divergence here is an
+    IncrementalDriftError crash, not a silent skew."""
+    valid = vals["valid"]
+    is_valid = valid > 0
+    desired = vals["desired"]
+    observed = vals["observed"]
+    known = (desired != MODE_CODES["unknown"]) & is_valid
+    target = pool_target[pool]
+    converged = (observed == target) & (desired == target) & known
+    flipping = (vals["taint"] > 0) & is_valid
+    doctor_failing = (vals["doctor"] == DOCTOR_FAILING) & is_valid
+    ev = vals["ev_ts"]
+    return {
+        "needs_flip": (desired != observed) & known,
+        "failed": (observed == MODE_CODES["failed"]) & is_valid,
+        "flipping": flipping,
+        "doctor_failing": doctor_failing,
+        "doctor_unreported": (
+            (vals["doctor"] == DOCTOR_UNREPORTED) & is_valid),
+        "stale_evidence": (
+            (ev >= 0)
+            & ((np.int32(now_s) - ev) > np.int32(stale_s))
+            & is_valid),
+        "eligible": (
+            ~converged & is_valid & ~flipping & ~doctor_failing),
+        "converged": converged,
+    }
+
+
+class TickResult:
+    """One TickSession tick: the host outputs (the fleet_tick dict,
+    bucket-padded) plus report-formatting metadata when requested.
+    ``checksum`` is the digest from the most recent full (verified)
+    tick — incremental ticks carry it forward."""
+
+    __slots__ = ("n", "bucket", "kind", "outputs", "checksum", "names",
+                 "slice_index", "doctor_details")
+
+    def __init__(self, n: int, bucket: Optional[int], kind: str,
+                 outputs: Optional[Dict[str, np.ndarray]],
+                 checksum: Optional[int],
+                 meta: Optional[tuple] = None) -> None:
+        self.n = n
+        self.bucket = bucket
+        self.kind = kind
+        self.outputs = outputs
+        self.checksum = checksum
+        self.names, self.slice_index, self.doctor_details = (
+            meta if meta is not None else (None, None, None))
+
+
+class TickSession:
+    """Delta-driven, device-resident planner tick state
+    (docs/planner.md "incremental tick contract").
+
+    Owns the sharded device block (the eight fleet_tick columns) plus
+    a host mirror and incrementally maintained outputs. Per tick:
+
+    - drain the encoding's dirty state
+      (:meth:`FleetEncoding.begin_tick`),
+    - scatter the changed rows into the device block (:func:`_scatter_fn`,
+      donated — the columns never round-trip host↔device between
+      ticks),
+    - fold the changed rows' old→new contributions into the cached
+      aggregates and re-evaluate exactly the dirty slice slots against
+      the host mirror,
+    - every ``full_every`` ticks (and on ``force_full``) ALSO run the
+      full device kernel (:func:`_eval_fn`) over the resident block
+      and compare every output array against the incremental state —
+      any divergence raises :class:`IncrementalDriftError`.
+
+    ``now`` is frozen between full ticks so unchanged rows'
+    stale_evidence masks stay consistent with changed rows'; each full
+    tick refreshes the clock and recomputes the mask. Rebuild
+    triggers: bucket change, slice-id compaction, a delta covering a
+    quarter of the block, a dispatch error, an empty fleet."""
+
+    def __init__(self, *, full_every: Optional[int] = None) -> None:
+        if full_every is None:
+            try:
+                full_every = int(os.environ.get(
+                    "TPU_CC_PLANNER_FULL_TICK_EVERY", "16"))
+            except ValueError:
+                full_every = 16
+        #: verify cadence: every Nth tick is a checksummed full tick
+        #: (≤ 0 disables the cadence; force_full still verifies)
+        self.full_every = full_every
+        self._lock = threading.Lock()
+        #: session geometry — .node_bucket/.pool_bucket/.delta-bucket
+        #: family attributes are blessed shape provenance (the jitflow
+        #: lattice, docs/analysis.md)
+        self.node_bucket: Optional[int] = None
+        self.pool_bucket = BUCKET_MIN_POOLS
+        self._cols: Optional[tuple] = None
+        self._mirror: Optional[Dict[str, np.ndarray]] = None
+        self._state: Optional[Dict[str, np.ndarray]] = None
+        self._pool_hist: Optional[np.ndarray] = None
+        self._n = 0
+        self._now_s = 0
+        self._stale_s = 0
+        self._ticks_since_full = 0
+        self._pool_rows = np.zeros(0, np.int32)
+        self._pool_target = np.zeros(BUCKET_MIN_POOLS, np.int32)
+        self._pool_target_applied = np.zeros(BUCKET_MIN_POOLS, np.int32)
+        self._pools_assigned = False
+        self._pool_dirty: set = set()
+        self.last_checksum: Optional[int] = None
+        #: transfer/tick accounting, pinned by tests: column_puts only
+        #: moves on rebuild — steady-state incremental ticks move
+        #: delta_puts (the kb-sized scatter operands) and nothing else
+        self.stats: Dict[str, int] = {
+            "rebuilds": 0, "incr_ticks": 0, "full_ticks": 0,
+            "cached_ticks": 0, "column_puts": 0, "delta_puts": 0,
+            "delta_rows": 0, "verifies": 0,
+        }
+
+    # -------------------------------------------------------- lifecycle
+    def invalidate(self) -> None:
+        """Drop the device block; the next tick rebuilds from truth."""
+        with self._lock:
+            self._invalidate_locked()
+
+    def _invalidate_locked(self) -> None:
+        self._cols = None
+        self._mirror = None
+        self._state = None
+        self._pool_hist = None
+        self._ticks_since_full = 0
+
+    # ------------------------------------------------- pool assignment
+    def assign_pools(self, pool_rows: np.ndarray,
+                     pool_target: np.ndarray) -> None:
+        """Set the per-row pool assignment ``[n]`` and bucket-padded
+        pool targets ``[pool_bucket]`` for subsequent ticks
+        (analyze_pools' scratch path; the fleet path leaves everything
+        zero, matching the legacy snapshot). Rows whose assignment —
+        or whose old/new pool's target — changed are marked dirty for
+        the next tick; a pool-bucket change is compile geometry and
+        invalidates the block."""
+        pool_rows = np.asarray(pool_rows, np.int32)
+        pool_target = np.asarray(pool_target, np.int32)
+        with self._lock:
+            pb = int(pool_target.shape[0])
+            if pb != self.pool_bucket:
+                self.pool_bucket = pb
+                self._invalidate_locked()
+            elif self._cols is not None:
+                old_rows = self._pool_rows
+                m = min(old_rows.size, pool_rows.size)
+                if m:
+                    moved = np.nonzero(old_rows[:m] != pool_rows[:m])[0]
+                    self._pool_dirty.update(moved.tolist())
+                # rows beyond the shorter array are add/remove churn —
+                # the encoding already marked those rows dirty
+                changed_pids = np.nonzero(
+                    self._pool_target != pool_target)[0]
+                if changed_pids.size:
+                    hit = np.isin(pool_rows, changed_pids)
+                    if m:
+                        hit[:m] |= np.isin(old_rows[:m], changed_pids)
+                    self._pool_dirty.update(np.nonzero(hit)[0].tolist())
+            self._pool_rows = pool_rows
+            self._pool_target = pool_target
+            self._pools_assigned = True
+
+    def _pool_padded(self, nb: int, n: int) -> np.ndarray:
+        """The pool_ids column for the current assignment (zeros and
+        zero padding on the fleet path — byte-identical to the legacy
+        snapshot; assignment + last-slot padding on the policy path)."""
+        pad = (self.pool_bucket - 1) if self._pools_assigned else 0
+        out = np.full(nb, pad, np.int32)
+        if self._pools_assigned:
+            m = min(n, self._pool_rows.size)
+            out[:m] = self._pool_rows[:m]
+            out[m:n] = 0
+        else:
+            out[:n] = 0
+        return out
+
+    # ------------------------------------------------------------ tick
+    def tick(self, enc: FleetEncoding, *, force_full: bool = False,
+             with_meta: bool = False) -> TickResult:
+        """One planner tick over ``enc``'s current state. Thread-safe:
+        one tick per session at a time (dispatch itself additionally
+        serializes process-wide under _DISPATCH_LOCK)."""
+        with self._lock:
+            return self._tick_locked(enc, force_full, with_meta)
+
+    def _tick_locked(self, enc: FleetEncoding, force_full: bool,
+                     with_meta: bool) -> TickResult:
+        delta = enc.begin_tick(
+            session_bucket=(self.node_bucket
+                            if self._cols is not None else None),
+            with_meta=with_meta,
+        )
+        meta = delta.meta
+        if delta.n == 0:
+            # empty fleets skip the kernel entirely (analyze_encoding
+            # returns the empty report); drop the block so a regrown
+            # fleet rebuilds from truth
+            self._invalidate_locked()
+            self._pool_dirty.clear()
+            return TickResult(0, delta.bucket, "empty", None, None,
+                              meta)
+        if delta.snapshot is not None:
+            return self._rebuild_locked(delta, meta)
+        want_full = force_full or (
+            self.full_every > 0
+            and self._ticks_since_full + 1 >= self.full_every
+        )
+        rows = delta.rows
+        extra = self._pool_dirty
+        self._pool_dirty = set()
+        if extra:
+            extra_rows = np.fromiter(
+                (r for r in extra if r < delta.n), np.int64)
+            rows = np.union1d(rows, extra_rows)
+        k = int(rows.size)
+        if k == 0 and not delta.slices and not want_full:
+            self.stats["cached_ticks"] += 1
+            return self._result_locked("cached", meta)
+        if k:
+            self._apply_delta_locked(rows, delta)
+        self._refresh_slices_locked(delta.slices)
+        self._n = delta.n
+        if want_full:
+            self._verify_locked()
+            self.stats["full_ticks"] += 1
+            self._ticks_since_full = 0
+        else:
+            self.stats["incr_ticks"] += 1
+            self._ticks_since_full += 1
+        return self._result_locked(
+            "full" if want_full else "incremental", meta)
+
+    def _result_locked(self, kind: str,
+                       meta: Optional[tuple]) -> TickResult:
+        return TickResult(self._n, self.node_bucket, kind, self._state,
+                          self.last_checksum, meta)
+
+    # --------------------------------------------------- rebuild (slow)
+    def _rebuild_locked(self, delta: TickDelta,
+                        meta: Optional[tuple]) -> TickResult:
+        snap = delta.snapshot
+        nb = snap.bucket
+        pb = self.pool_bucket
+        n = delta.n
+        cols_host = {key: snap.columns[key] for key in COLS_ORDER}
+        cols_host["pool_ids"] = self._pool_padded(nb, n)
+        evalf = _eval_fn(nb, pb)
+        now_s = int(time.time())
+        stale_s = int(_stale_after_s())
+        with _DISPATCH_LOCK:
+            cols = tuple(
+                jax.device_put(cols_host[key], evalf.node_sharding)
+                for key in COLS_ORDER
+            )
+        self.stats["column_puts"] += len(COLS_ORDER)
+        try:
+            cols, out = evalf(cols, self._pool_target, now_s, stale_s)
+        except Exception:
+            self._invalidate_locked()
+            raise
+        self._cols = cols
+        self.node_bucket = nb
+        self._n = n
+        self._now_s = now_s
+        self._stale_s = stale_s
+        self._mirror = cols_host
+        self._state = {key: np.array(v) for key, v in out.items()}
+        self._pool_hist = self._hist_from_mirror_locked()
+        self.last_checksum = _outputs_checksum(self._state)
+        self._pool_target_applied = self._pool_target.copy()
+        self._pool_dirty.clear()
+        self._ticks_since_full = 0
+        self.stats["rebuilds"] += 1
+        return self._result_locked("rebuild", meta)
+
+    def _hist_from_mirror_locked(self) -> np.ndarray:
+        pool = self._mirror["pool_ids"].astype(np.int64)
+        obs = self._mirror["observed"].astype(np.int64)
+        live = self._mirror["valid"] > 0
+        flat = np.bincount((pool * N_MODES + obs)[live],
+                           minlength=self.pool_bucket * N_MODES)
+        return flat.reshape(self.pool_bucket, N_MODES).astype(np.int32)
+
+    # ------------------------------------------------ incremental (hot)
+    def _apply_delta_locked(self, rows: np.ndarray,
+                            delta: TickDelta) -> None:
+        mirror = self._mirror
+        state = self._state
+        k = int(rows.size)
+        old_vals = {key: mirror[key][rows] for key in COLS_ORDER}
+        new_vals: Dict[str, np.ndarray] = {}
+        pos = np.searchsorted(rows, delta.rows)
+        for key in ("desired", "observed", "slice_ids", "taint",
+                    "doctor", "ev_ts", "valid"):
+            v = old_vals[key].copy()
+            v[pos] = delta.vals[key]
+            new_vals[key] = v
+        pad_pool = (self.pool_bucket - 1) if self._pools_assigned else 0
+        new_pool = np.full(k, pad_pool, np.int32)
+        live = rows < delta.n
+        if self._pools_assigned:
+            m = min(delta.n, self._pool_rows.size)
+            in_assign = rows < m
+            new_pool[in_assign] = self._pool_rows[rows[in_assign]]
+            new_pool[live & ~in_assign] = 0
+        else:
+            new_pool[live] = 0
+        new_vals["pool_ids"] = new_pool
+
+        old_out = _row_outputs(old_vals, old_vals["pool_ids"],
+                               self._pool_target_applied, self._now_s,
+                               self._stale_s)
+        new_out = _row_outputs(new_vals, new_vals["pool_ids"],
+                               self._pool_target, self._now_s,
+                               self._stale_s)
+        ovi = old_vals["valid"]
+        nvi = new_vals["valid"]
+        op = old_vals["pool_ids"]
+        npid = new_vals["pool_ids"]
+        np.add.at(state["mode_counts"], old_vals["observed"], -ovi)
+        np.add.at(state["mode_counts"], new_vals["observed"], nvi)
+        np.add.at(state["desired_counts"], old_vals["desired"], -ovi)
+        np.add.at(state["desired_counts"], new_vals["desired"], nvi)
+        np.add.at(state["pool_nodes"], op, -ovi)
+        np.add.at(state["pool_nodes"], npid, nvi)
+        for skey, okey in (("pool_converged", "converged"),
+                           ("pool_failed", "failed"),
+                           ("pool_eligible", "eligible")):
+            np.add.at(state[skey], op, -old_out[okey].astype(np.int32))
+            np.add.at(state[skey], npid,
+                      new_out[okey].astype(np.int32))
+        np.add.at(self._pool_hist, (op, old_vals["observed"]), -ovi)
+        np.add.at(self._pool_hist, (npid, new_vals["observed"]), nvi)
+        for key in _NODE_OUT_KEYS:
+            state[key][rows] = new_out[key]
+        for key in COLS_ORDER:
+            mirror[key][rows] = new_vals[key]
+        state["pool_skew"] = (
+            state["pool_nodes"] - self._pool_hist.max(axis=1))
+        state["pool_divergent"] = (
+            state["pool_nodes"] - state["pool_converged"])
+        self._pool_target_applied = self._pool_target.copy()
+
+        nb = self.node_bucket
+        kb = bucket_deltas(k)
+        idx = np.full(kb, nb, np.int32)
+        idx[:k] = rows
+        vals8 = np.zeros((8, kb), np.int32)
+        for j, key in enumerate(COLS_ORDER):
+            vals8[j, :k] = new_vals[key]
+        scatter = _scatter_fn(nb, kb)
+        try:
+            self._cols = scatter(self._cols, idx, vals8)
+        except Exception:
+            self._invalidate_locked()
+            raise
+        self.stats["delta_puts"] += 2
+        self.stats["delta_rows"] += k
+
+    def _refresh_slices_locked(
+            self, slices: Optional[List[Tuple[int, np.ndarray]]]
+    ) -> None:
+        if not slices:
+            return
+        state = self._state
+        mirror = self._mirror
+        nsl = len(slices)
+        imax = np.iinfo(np.int32).max
+        imin = np.iinfo(np.int32).min
+        d_mn = np.full(nsl, imax, np.int32)
+        d_mx = np.full(nsl, imin, np.int32)
+        o_mn = np.full(nsl, imax, np.int32)
+        o_mx = np.full(nsl, imin, np.int32)
+        at_mn = np.ones(nsl, np.int32)
+        at_mx = np.zeros(nsl, np.int32)
+        sids = np.fromiter((s for s, _ in slices), np.int64, count=nsl)
+        counts = [r.size for _, r in slices]
+        if any(counts):
+            members = np.concatenate([r for _, r in slices])
+            seg = np.repeat(np.arange(nsl), counts)
+            d = mirror["desired"][members]
+            o = mirror["observed"][members]
+            valid_m = mirror["valid"][members] > 0
+            known = (d != MODE_CODES["unknown"]) & valid_m
+            at = ((o == d) & known).astype(np.int32)
+            np.minimum.at(d_mn, seg, d)
+            np.maximum.at(d_mx, seg, d)
+            np.minimum.at(o_mn, seg, o)
+            np.maximum.at(o_mx, seg, o)
+            np.minimum.at(at_mn, seg, at)
+            np.maximum.at(at_mx, seg, at)
+        # dead slots land on the init values — coherent False, half
+        # False — exactly the kernel's empty-slot semantics
+        state["slice_coherent"][sids] = (d_mn == d_mx) & (o_mn == o_mx)
+        state["slice_half_flipped"][sids] = (
+            (d_mn == d_mx) & (at_mn == 0) & (at_mx == 1))
+
+    # ----------------------------------------------- full tick (verify)
+    def _verify_locked(self) -> None:
+        nb = self.node_bucket
+        pb = self.pool_bucket
+        evalf = _eval_fn(nb, pb)
+        try:
+            cols, out = evalf(self._cols, self._pool_target,
+                              self._now_s, self._stale_s)
+        except Exception:
+            self._invalidate_locked()
+            raise
+        self._cols = cols
+        self.stats["verifies"] += 1
+        bad = [
+            key for key in sorted(out)
+            if not np.array_equal(np.asarray(out[key]),
+                                  self._state[key])
+        ]
+        if bad:
+            incr_crc = _outputs_checksum(self._state)
+            full_crc = _outputs_checksum(
+                {key: np.asarray(v) for key, v in out.items()})
+            self._invalidate_locked()
+            raise IncrementalDriftError(
+                "incremental tick diverged from full kernel "
+                f"evaluation on {bad} (incremental checksum "
+                f"{incr_crc:#010x} != full {full_crc:#010x}); session "
+                "invalidated — next tick rebuilds from host truth")
+        # the pin held: refresh the frozen clock and advance the
+        # stale_evidence mask (it moves at full-tick cadence)
+        now_s = int(time.time())
+        stale_s = int(_stale_after_s())
+        self._now_s = now_s
+        self._stale_s = stale_s
+        ev = self._mirror["ev_ts"]
+        self._state["stale_evidence"] = (
+            (ev >= 0)
+            & ((np.int32(now_s) - ev) > np.int32(stale_s))
+            & (self._mirror["valid"] > 0))
+        self.last_checksum = _outputs_checksum(self._state)
+
+
+class PoolScanScratch:
+    """PolicyController's persistent analyze_pools state: one
+    FleetEncoding + one TickSession reused across scans, so a repeat
+    scan re-encodes only churn and re-uploads nothing (the satellite
+    pin: ``session.stats["column_puts"]`` is flat across unchanged
+    scans)."""
+
+    def __init__(self) -> None:
+        self.encoding = FleetEncoding()
+        self.session = TickSession()
 
 
 # ----------------------------------------------- compile cache + warmup
@@ -979,22 +1782,17 @@ def _empty_report() -> dict:
     }
 
 
-def analyze_encoding(enc: FleetEncoding) -> dict:
-    """One planner tick over a live feature block → JSON-ready report
-    (the fleet controller's scan body)."""
-    snap = enc.snapshot()
-    n = snap.n_nodes
-    if n == 0:
-        return _empty_report()
-    nb = snap.bucket
-    out = _tick_fn(nb, BUCKET_MIN_POOLS)(
-        snap.columns, np.zeros(BUCKET_MIN_POOLS, np.int32)
-    )
-    names = snap.names
-    slice_names = {v: k for k, v in snap.slice_index.items()}
+def _format_report(n: int, names: List[str],
+                   slice_index: Dict[str, int],
+                   doctor_details: Dict[str, dict],
+                   out: Dict[str, np.ndarray]) -> dict:
+    """fleet_tick outputs → the JSON-ready fleet report. Shared by the
+    legacy upload-per-call path and the incremental session path, so
+    the two can never drift in shape."""
+    slice_names = {v: k for k, v in slice_index.items()}
     real_slice = {
         v: not k.startswith("__solo__/")
-        for k, v in snap.slice_index.items()
+        for k, v in slice_index.items()
     }
     unreported = sorted(_mask_names(names, out["doctor_unreported"]))
     failing_names = _mask_names(names, out["doctor_failing"])
@@ -1002,9 +1800,9 @@ def analyze_encoding(enc: FleetEncoding) -> dict:
         (
             {
                 "node": name,
-                "fail": snap.doctor_details.get(name, {}).get(
+                "fail": doctor_details.get(name, {}).get(
                     "fail", ["unparseable"]),
-                "at": snap.doctor_details.get(name, {}).get("at"),
+                "at": doctor_details.get(name, {}).get("at"),
             }
             for name in failing_names
         ),
@@ -1039,6 +1837,32 @@ def analyze_encoding(enc: FleetEncoding) -> dict:
     }
 
 
+def analyze_encoding(enc: FleetEncoding,
+                     session: Optional[TickSession] = None,
+                     *, force_full: bool = False) -> dict:
+    """One planner tick over a live feature block → JSON-ready report
+    (the fleet controller's scan body). With a ``session``, the tick
+    is delta-driven and device-resident (docs/planner.md
+    incremental-tick contract); without one, every call snapshots and
+    uploads — the legacy path. Same report either way."""
+    if session is not None:
+        res = session.tick(enc, force_full=force_full, with_meta=True)
+        if res.n == 0:
+            return _empty_report()
+        return _format_report(res.n, res.names, res.slice_index,
+                              res.doctor_details, res.outputs)
+    snap = enc.snapshot()
+    n = snap.n_nodes
+    if n == 0:
+        return _empty_report()
+    nb = snap.bucket
+    out = _tick_fn(nb, BUCKET_MIN_POOLS)(
+        snap.columns, np.zeros(BUCKET_MIN_POOLS, np.int32)
+    )
+    return _format_report(n, snap.names, snap.slice_index,
+                          snap.doctor_details, out)
+
+
 def analyze_fleet(nodes: List[dict]) -> dict:
     """End-to-end host API: node objects in, JSON-ready report out.
     Builds a throwaway feature block; long-lived controllers keep a
@@ -1050,14 +1874,48 @@ def analyze_fleet(nodes: List[dict]) -> dict:
     return analyze_encoding(enc)
 
 
+def _pool_result(pools: Sequence[Tuple[str, str, List[dict]]],
+                 out: Dict[str, np.ndarray]) -> Dict[str, Dict[str, int]]:
+    result: Dict[str, Dict[str, int]] = {}
+    for pid, (pname, _, _) in enumerate(pools):
+        result[pname] = {
+            "nodes": int(out["pool_nodes"][pid]),
+            "converged": int(out["pool_converged"][pid]),
+            "failed": int(out["pool_failed"][pid]),
+            "divergent": int(out["pool_divergent"][pid]),
+            "skew": int(out["pool_skew"][pid]),
+            "eligible": int(out["pool_eligible"][pid]),
+        }
+    return result
+
+
+def _pool_empty(
+        pools: Sequence[Tuple[str, str, List[dict]]],
+) -> Dict[str, Dict[str, int]]:
+    return {
+        pname: {"nodes": 0, "converged": 0, "failed": 0,
+                "divergent": 0, "skew": 0, "eligible": 0}
+        for pname, _, _ in pools
+    }
+
+
 def analyze_pools(
     pools: Sequence[Tuple[str, str, List[dict]]],
+    *, scratch: Optional[PoolScanScratch] = None,
 ) -> Dict[str, Dict[str, int]]:
     """The policy controller's batched question: for each
     ``(pool_name, target_mode, nodes)``, per-pool convergence, failure,
     divergence, skew, and rollout-eligibility counts — one kernel call
     for every policy in the scan, replacing the per-node Python loops
-    ``_derive_status`` used to run."""
+    ``_derive_status`` used to run.
+
+    With ``scratch`` (PolicyController keeps one per controller), the
+    encoding and the device-resident tick session persist across
+    scans: a repeat scan re-encodes only churn, scatters only deltas,
+    and allocates no new device buffers — the same deltas-not-size
+    contract the fleet side has."""
+    if scratch is not None:
+        return _analyze_pools_session(pools, scratch)
     enc = FleetEncoding()
     pool_of: Dict[str, int] = {}
     targets: List[int] = []
@@ -1075,11 +1933,7 @@ def analyze_pools(
     n = snap.n_nodes
     pb = bucket_pools(len(pools))
     if n == 0:
-        return {
-            pname: {"nodes": 0, "converged": 0, "failed": 0,
-                    "divergent": 0, "skew": 0, "eligible": 0}
-            for pname, _, _ in pools
-        }
+        return _pool_empty(pools)
     pool_ids = snap.columns["pool_ids"]
     for i, name in enumerate(snap.names):
         pool_ids[i] = pool_of[name]
@@ -1088,17 +1942,45 @@ def analyze_pools(
     pool_target[: len(targets)] = targets
     nb = snap.bucket
     out = _tick_fn(nb, pb)(snap.columns, pool_target)
-    result: Dict[str, Dict[str, int]] = {}
-    for pid, (pname, _, _) in enumerate(pools):
-        result[pname] = {
-            "nodes": int(out["pool_nodes"][pid]),
-            "converged": int(out["pool_converged"][pid]),
-            "failed": int(out["pool_failed"][pid]),
-            "divergent": int(out["pool_divergent"][pid]),
-            "skew": int(out["pool_skew"][pid]),
-            "eligible": int(out["pool_eligible"][pid]),
-        }
-    return result
+    return _pool_result(pools, out)
+
+
+def _analyze_pools_session(
+    pools: Sequence[Tuple[str, str, List[dict]]],
+    scratch: PoolScanScratch,
+) -> Dict[str, Dict[str, int]]:
+    """analyze_pools over persistent scratch: sync the scan's pool
+    membership into the long-lived encoding (apply + remove-vanished,
+    like the fleet side's sync), diff the pool assignment/targets into
+    the session, tick."""
+    enc = scratch.encoding
+    session = scratch.session
+    pool_of: Dict[str, int] = {}
+    targets: List[int] = []
+    for pid, (pname, mode, nodes) in enumerate(pools):
+        targets.append(encode_mode(mode))
+        for node in nodes:
+            name = node["metadata"]["name"]
+            if name not in pool_of:
+                pool_of[name] = pid
+            enc.apply(node)
+    for name in enc.tracked_names():
+        if name not in pool_of:
+            enc.remove(name)
+    n = len(enc)
+    if n == 0:
+        return _pool_empty(pools)
+    pb = bucket_pools(len(pools))
+    rows = enc.row_map()
+    pool_rows = np.zeros(n, np.int32)
+    for name, pid in pool_of.items():
+        r = rows.get(name)
+        if r is not None:
+            pool_rows[r] = pid
+    pool_target = np.zeros(pb, np.int32)
+    pool_target[: len(targets)] = targets
+    session.assign_pools(pool_rows, pool_target)
+    return _pool_result(pools, session.tick(enc).outputs)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -70,7 +70,7 @@ from tpu_cc_manager.obs import (
     Counter, Gauge, Histogram, RouteServer, kube_throttle_wait_histogram,
     render_metric_set, wire_throttle_observer,
 )
-from tpu_cc_manager.plan import analyze_pools
+from tpu_cc_manager.plan import PoolScanScratch, analyze_pools
 from tpu_cc_manager.rollout import (
     HEARTBEAT_STALE_S, ROLLOUT_RECORD_VERSION, Rollout, RolloutError,
     load_rollout_records, record_node_names, rollout_record_version,
@@ -323,6 +323,12 @@ class PolicyController:
         self.metrics = PolicyMetrics()
         # flow-control waits surface on this controller's /metrics
         wire_throttle_observer(kube, self.metrics.kube_throttle_wait)
+        #: reusable pool-scan planner state (ISSUE 19): the encoding
+        #: and device-resident tick session persist across scans, so a
+        #: steady-state policy scan re-encodes only the nodes that
+        #: changed and allocates NO new device buffers (pinned by
+        #: tests/test_plan_incremental.py)
+        self._pool_scratch = PoolScanScratch()
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
         self._warned_no_crd = False
@@ -565,7 +571,7 @@ class PolicyController:
         pool_stats = analyze_pools([
             (pol["metadata"]["name"], spec["mode"], own)
             for pol, spec, own, _ in derivable
-        ]) if derivable else {}
+        ], scratch=self._pool_scratch) if derivable else {}
         for pol, spec, own, conflicted in derivable:
             name = pol["metadata"]["name"]
             st = self._derive_status(

@@ -179,7 +179,9 @@ def run_node_watch(kube: Any, stop: threading.Event,
                    *, timeout_s: int, backoff_s: float,
                    logger: logging.Logger, who: str,
                    on_event: Optional[
-                       Callable[[str, dict], None]] = None) -> None:
+                       Callable[[str, dict], None]] = None,
+                   on_gap: Optional[
+                       Callable[[], None]] = None) -> None:
     """Shared node-watch pump for both controllers: stream node events,
     call ``wake()`` for report-relevant changes (fingerprint-filtered —
     see :func:`node_report_fingerprint`), wake once per from-scratch
@@ -193,13 +195,22 @@ def run_node_watch(kube: Any, stop: threading.Event,
     BEFORE the wake filter — the feed the fleet controller's
     incremental :class:`~tpu_cc_manager.plan.FleetEncoding` rides, so
     the planner's feature block tracks deltas instead of re-encoding
-    the fleet each scan. The callee dedups; this pump only delivers."""
+    the fleet each scan. The callee dedups; this pump only delivers.
+
+    ``on_gap`` fires at every from-scratch (re)connect, BEFORE the
+    gap-covering wake: deltas between streams are unreplayable, so a
+    delta-trusting consumer (the fleet controller's sync-skip path,
+    ISSUE 19) must list-reconcile before trusting the feed again."""
     rv = None
     relevant = FingerprintWakeFilter(wake)
     while not stop.is_set():
         if rv is None:
             # a fresh watch starts at "now" and cannot replay what
             # happened before it: wake one scan to cover the gap
+            # (on_gap first — the woken scan must already know its
+            # delta feed has a hole)
+            if on_gap is not None:
+                on_gap()
             wake()
         try:
             # the no-watch probe is scoped to the CALL alone: a
