@@ -1468,15 +1468,17 @@ def run_federation_bench():
 def run_ccaudit_bench():
     """Analyzer cost gate (ISSUE 17): wall seconds for one full-repo
     ccaudit run in-process — the default surface including manifests,
-    i.e. exactly what ``make lint`` pays. The v4 asyncflow and v5
-    jitflow families ride the same parse + call graph the v3 passes
-    built, so the marginal cost is the fixpoints, not a re-walk;
+    i.e. exactly what ``make lint`` pays. The v4 asyncflow, v5
+    jitflow, and v6 resourceflow families ride the same parse + call
+    graph the v3 passes built, so the marginal cost is the fixpoints,
+    not a re-walk;
     ``ccaudit_wall_s`` is ceiling-gated in bench_trend so
     whole-program growth can't silently make lint crawl. The rule
     counts are stamped so bench-smoke can assert the passes actually
     ran (a silently-skipped analyzer would otherwise look FAST)."""
     from tpu_cc_manager.analysis import RULES, analyze_paths
     from tpu_cc_manager.analysis.jitflow import JITFLOW_RULES
+    from tpu_cc_manager.analysis.resourceflow import RESOURCEFLOW_RULES
 
     t0 = time.monotonic()
     analyze_paths()
@@ -1484,6 +1486,7 @@ def run_ccaudit_bench():
         "ccaudit_wall_s": round(time.monotonic() - t0, 3),
         "ccaudit_rules": len(RULES),
         "ccaudit_jitflow_rules": len(JITFLOW_RULES),
+        "ccaudit_resourceflow_rules": len(RESOURCEFLOW_RULES),
     }
 
 

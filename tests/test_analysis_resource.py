@@ -1,0 +1,765 @@
+"""ccaudit v6 — the resource & overload-discipline families.
+
+Positive/negative/pragma coverage per family (unbounded-queue,
+missing-deadline, retry-discipline, resource-leak, stop-aware-wait),
+plus the cross-cutting pins: the caller-path ⋂-fixpoint for forwarded
+deadline parameters, the live tree passing its own v6 rules, SARIF
+severity mapping, ``--files`` slice soundness, and the fact cache.
+"""
+
+import os
+
+import pytest
+
+from tpu_cc_manager.analysis import RULES
+from tpu_cc_manager.analysis.core import (
+    CACHE_DIR_NAME,
+    analyze_paths,
+    analyze_source,
+    analyzer_version_hash,
+    load_audit_cached,
+)
+from tpu_cc_manager.analysis.resourceflow import (
+    DEADLINE_RULE,
+    LEAK_RULE,
+    QUEUE_RULE,
+    RESOURCEFLOW_RULES,
+    RETRY_RULE,
+    STOP_RULE,
+)
+from tpu_cc_manager.analysis.sarif import to_sarif, validate_sarif
+
+#: a non-exempt module OUTSIDE the stop surface and the I/O core
+MOD = "tpu_cc_manager/misc.py"
+#: a stop-surface controller module (fixed frozenset in resourceflow)
+STOP_MOD = "tpu_cc_manager/fleet.py"
+#: an I/O-core module — every function there roots the deadline closure
+IO_MOD = "tpu_cc_manager/k8s/aio.py"
+
+
+def _hits(src, rule, relpath=MOD):
+    return [f for f in analyze_source(src, relpath) if f.rule == rule]
+
+
+# ------------------------------------------------------- rule registry
+
+
+def test_v6_families_registered():
+    assert RESOURCEFLOW_RULES == (
+        QUEUE_RULE, DEADLINE_RULE, RETRY_RULE, LEAK_RULE, STOP_RULE,
+    )
+    for rule in RESOURCEFLOW_RULES:
+        assert rule in RULES
+
+
+# --------------------------------------------------- unbounded-queue
+
+
+def test_module_level_queue_without_maxsize_flagged():
+    src = (
+        "import queue\n"
+        "BACKLOG = queue.Queue()\n"
+    )
+    hits = _hits(src, QUEUE_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 2
+    assert hits[0].severity == "error"
+
+
+def test_bounded_queue_passes():
+    src = (
+        "import queue\n"
+        "BACKLOG = queue.Queue(maxsize=64)\n"
+    )
+    assert _hits(src, QUEUE_RULE) == []
+
+
+def test_maxsize_zero_means_unbounded():
+    # queue.Queue(0) is the stdlib's "infinite" spelling — still a
+    # backlog with no bound
+    src = (
+        "import queue\n"
+        "BACKLOG = queue.Queue(0)\n"
+    )
+    assert len(_hits(src, QUEUE_RULE)) == 1
+
+
+def test_asyncio_queue_on_self_flagged():
+    src = (
+        "import asyncio\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._q = asyncio.Queue()\n"
+    )
+    hits = _hits(src, QUEUE_RULE)
+    assert len(hits) == 1
+    assert hits[0].line == 4
+
+
+def test_simplequeue_never_boundable():
+    src = (
+        "import queue\n"
+        "EVENTS = queue.SimpleQueue()\n"
+    )
+    hits = _hits(src, QUEUE_RULE)
+    assert len(hits) == 1
+    assert "no bound at all" in hits[0].message
+
+
+def test_local_scratch_deque_exempt_but_self_deque_flagged():
+    # a function-local deque is a scratch working set; one stored on
+    # self crosses contexts and is a real backlog
+    src = (
+        "from collections import deque\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._ready = deque()\n"
+        "    def scan(self, items):\n"
+        "        work = deque()\n"
+        "        work.extend(items)\n"
+    )
+    hits = _hits(src, QUEUE_RULE)
+    assert [f.line for f in hits] == [4]
+
+
+def test_deque_maxlen_none_is_no_bound_but_positional_bound_is():
+    src = (
+        "from collections import deque\n"
+        "A = deque(maxlen=None)\n"
+        "B = deque([], 128)\n"
+        "C = deque(maxlen=128)\n"
+    )
+    assert [f.line for f in _hits(src, QUEUE_RULE)] == [2]
+
+
+def test_queue_pragma_suppresses():
+    src = (
+        "import queue\n"
+        "# ccaudit: allow-unbounded-queue(drained every tick by design)\n"
+        "BACKLOG = queue.Queue()\n"
+    )
+    assert _hits(src, QUEUE_RULE) == []
+
+
+def test_queue_exempt_prefixes_pass():
+    src = (
+        "import queue\n"
+        "BACKLOG = queue.Queue()\n"
+    )
+    assert _hits(src, QUEUE_RULE, relpath="scripts/oneshot.py") == []
+    assert _hits(src, QUEUE_RULE,
+                 relpath="tpu_cc_manager/simlab/run.py") == []
+
+
+# --------------------------------------------------- stop-aware-wait
+
+
+def test_sleep_in_controller_loop_is_error():
+    src = (
+        "import time\n"
+        "class F:\n"
+        "    def run(self):\n"
+        "        while True:\n"
+        "            time.sleep(5)\n"
+    )
+    hits = _hits(src, STOP_RULE, relpath=STOP_MOD)
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+
+
+def test_one_shot_sleep_is_warning():
+    src = (
+        "import time\n"
+        "def settle():\n"
+        "    time.sleep(0.5)\n"
+    )
+    hits = _hits(src, STOP_RULE, relpath=STOP_MOD)
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"
+
+
+def test_stop_event_wait_is_the_convention():
+    src = (
+        "class F:\n"
+        "    def run(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            self._stop.wait(5.0)\n"
+    )
+    assert _hits(src, STOP_RULE, relpath=STOP_MOD) == []
+
+
+def test_untimed_event_wait_flagged():
+    src = (
+        "class F:\n"
+        "    def run(self, ready):\n"
+        "        ready.wait()\n"
+    )
+    hits = _hits(src, STOP_RULE, relpath=STOP_MOD)
+    assert len(hits) == 1
+    assert "no timeout" in hits[0].message
+
+
+def test_timed_wait_in_stop_checking_loop_passes():
+    src = (
+        "class F:\n"
+        "    def run(self, ready):\n"
+        "        while not self._stop.is_set():\n"
+        "            ready.wait(1.0)\n"
+    )
+    assert _hits(src, STOP_RULE, relpath=STOP_MOD) == []
+
+
+def test_timed_wait_in_blind_loop_is_error():
+    src = (
+        "class F:\n"
+        "    def run(self, ready):\n"
+        "        while True:\n"
+        "            ready.wait(1.0)\n"
+    )
+    hits = _hits(src, STOP_RULE, relpath=STOP_MOD)
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "without consulting the stop signal" in hits[0].message
+
+
+def test_deadline_clamped_wait_in_blind_loop_passes():
+    # waiting out `remaining` is bounded overall even when the loop
+    # test is blind — the deadline, not the stop event, ends it
+    src = (
+        "import time\n"
+        "class F:\n"
+        "    def join(self, ready, deadline):\n"
+        "        while True:\n"
+        "            remaining = deadline - time.monotonic()\n"
+        "            ready.wait(remaining)\n"
+    )
+    assert _hits(src, STOP_RULE, relpath=STOP_MOD) == []
+
+
+def test_blocking_queue_get_flagged_and_timeout_passes():
+    src = (
+        "class F:\n"
+        "    def pump(self, queue):\n"
+        "        item = queue.get()\n"
+        "    def pump2(self, queue):\n"
+        "        item = queue.get(timeout=1.0)\n"
+    )
+    hits = _hits(src, STOP_RULE, relpath=STOP_MOD)
+    assert [f.line for f in hits] == [3]
+
+
+def test_stop_rule_only_on_surface_modules():
+    src = (
+        "import time\n"
+        "def run():\n"
+        "    while True:\n"
+        "        time.sleep(5)\n"
+    )
+    assert _hits(src, STOP_RULE, relpath=MOD) == []
+
+
+def test_stop_pragma_suppresses():
+    src = (
+        "import time\n"
+        "def capture():\n"
+        "    # ccaudit: allow-stop-aware-wait(bounded burst, <=2s)\n"
+        "    time.sleep(2.0)\n"
+    )
+    assert _hits(src, STOP_RULE, relpath=STOP_MOD) == []
+
+
+# ----------------------------------------------------- resource-leak
+
+
+def test_never_released_socket_flagged():
+    src = (
+        "import socket\n"
+        "def probe(host):\n"
+        "    s = socket.socket()\n"
+        "    s.connect((host, 80))\n"
+    )
+    hits = _hits(src, LEAK_RULE)
+    assert len(hits) == 1
+    assert "never" in hits[0].message
+
+
+def test_success_only_close_flagged():
+    src = (
+        "def dump(path):\n"
+        "    f = open(path)\n"
+        "    f.seek(0)\n"
+        "    f.close()\n"
+    )
+    hits = _hits(src, LEAK_RULE)
+    assert len(hits) == 1
+    assert "straight-line" in hits[0].message
+
+
+def test_close_in_finally_passes():
+    src = (
+        "def dump(path):\n"
+        "    f = open(path)\n"
+        "    try:\n"
+        "        f.seek(0)\n"
+        "    finally:\n"
+        "        f.close()\n"
+    )
+    assert _hits(src, LEAK_RULE) == []
+
+
+def test_with_statement_on_handle_passes():
+    src = (
+        "import socket\n"
+        "def probe(host):\n"
+        "    s = socket.socket()\n"
+        "    with s:\n"
+        "        s.connect((host, 80))\n"
+    )
+    assert _hits(src, LEAK_RULE) == []
+
+
+def test_returned_handle_is_a_transfer():
+    src = (
+        "import socket\n"
+        "def dial(host):\n"
+        "    s = socket.socket()\n"
+        "    return s\n"
+    )
+    assert _hits(src, LEAK_RULE) == []
+
+
+def test_self_attr_acquire_without_module_close_flagged():
+    src = (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class E:\n"
+        "    def start(self):\n"
+        "        self._pool = ThreadPoolExecutor(max_workers=4)\n"
+    )
+    hits = _hits(src, LEAK_RULE)
+    assert len(hits) == 1
+    assert "self._pool" in hits[0].message
+
+
+def test_self_attr_with_close_elsewhere_passes():
+    src = (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class E:\n"
+        "    def start(self):\n"
+        "        self._pool = ThreadPoolExecutor(max_workers=4)\n"
+        "    def stop(self):\n"
+        "        self._pool.shutdown(wait=False)\n"
+    )
+    assert _hits(src, LEAK_RULE) == []
+
+
+def test_swap_out_then_shutdown_idiom_passes():
+    # `pool, self._pool = self._pool, None` visibly hands the handle to
+    # managing code — the engine.py release idiom
+    src = (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class E:\n"
+        "    def start(self):\n"
+        "        self._pool = ThreadPoolExecutor(max_workers=4)\n"
+        "    def stop(self):\n"
+        "        pool, self._pool = self._pool, None\n"
+        "        pool.shutdown(wait=False)\n"
+    )
+    assert _hits(src, LEAK_RULE) == []
+
+
+def test_leak_pragma_suppresses():
+    src = (
+        "import socket\n"
+        "def probe(host):\n"
+        "    # ccaudit: allow-resource-leak(process-lifetime handle)\n"
+        "    s = socket.socket()\n"
+        "    s.connect((host, 80))\n"
+    )
+    assert _hits(src, LEAK_RULE) == []
+
+
+# ------------------------------------------------- retry-discipline
+
+
+def test_naked_while_true_retry_missing_all_three_legs():
+    src = (
+        "import time\n"
+        "def push(kube):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            kube.patch_node('a', {})\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            time.sleep(1)\n"
+    )
+    hits = _hits(src, RETRY_RULE)
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"
+    for leg in ("cap", "backoff growth", "jitter"):
+        assert leg in hits[0].message
+
+
+def test_capped_jittered_backoff_loop_passes():
+    src = (
+        "import random\n"
+        "import time\n"
+        "def push(kube):\n"
+        "    delay = 0.1\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            kube.patch_node('a', {})\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            time.sleep(delay * random.random())\n"
+        "            delay = delay * 2\n"
+    )
+    assert _hits(src, RETRY_RULE) == []
+
+
+def test_missing_jitter_named_specifically():
+    src = (
+        "import time\n"
+        "def push(kube):\n"
+        "    delay = 0.1\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            kube.patch_node('a', {})\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            time.sleep(delay)\n"
+        "            delay = delay * 2\n"
+    )
+    hits = _hits(src, RETRY_RULE)
+    assert len(hits) == 1
+    assert "is missing jitter:" in hits[0].message
+
+
+def test_for_over_collection_is_a_scan_not_a_retry():
+    # `except: continue` in a per-item loop skips the item; it never
+    # re-attempts the same work, so retry discipline does not apply
+    src = (
+        "def sweep(kube, nodes):\n"
+        "    for n in nodes:\n"
+        "        try:\n"
+        "            kube.patch_node(n, {})\n"
+        "        except Exception:\n"
+        "            continue\n"
+    )
+    assert _hits(src, RETRY_RULE) == []
+
+
+def test_two_attempt_replay_loop_exempt():
+    src = (
+        "def flush(sock):\n"
+        "    for attempt in (0, 1):\n"
+        "        try:\n"
+        "            sock.send(b'x')\n"
+        "            return\n"
+        "        except OSError:\n"
+        "            sock = reconnect()\n"
+    )
+    assert _hits(src, RETRY_RULE) == []
+
+
+def test_transitive_backoff_helper_satisfies_the_legs():
+    # the loop itself shows no growth or randomness — both legs live in
+    # the called helper, found through the call-graph closure
+    src = (
+        "import random\n"
+        "def jittered_backoff(base, attempt):\n"
+        "    return min(60.0, base * 2 ** attempt) * random.random()\n"
+        "def watch(kube, stop):\n"
+        "    failures = 0\n"
+        "    while not stop.is_set():\n"
+        "        try:\n"
+        "            kube.list_nodes()\n"
+        "        except Exception:\n"
+        "            failures = failures + 1\n"
+        "            stop.wait(jittered_backoff(0.2, failures))\n"
+    )
+    assert _hits(src, RETRY_RULE) == []
+
+
+def test_handler_ending_in_raise_is_not_a_retry():
+    src = (
+        "def push(kube):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            kube.patch_node('a', {})\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            raise\n"
+    )
+    assert _hits(src, RETRY_RULE) == []
+
+
+def test_retry_pragma_suppresses():
+    src = (
+        "import time\n"
+        "def push(kube):\n"
+        "    # ccaudit: allow-retry-discipline(supersession follow-up)\n"
+        "    while True:\n"
+        "        try:\n"
+        "            kube.patch_node('a', {})\n"
+        "            return\n"
+        "        except Exception:\n"
+        "            time.sleep(1)\n"
+    )
+    assert _hits(src, RETRY_RULE) == []
+
+
+# ------------------------------------------------- missing-deadline
+
+
+def test_bare_awaited_readline_in_io_core_flagged():
+    src = (
+        "async def head(reader):\n"
+        "    return await reader.readline()\n"
+    )
+    hits = _hits(src, DEADLINE_RULE, relpath=IO_MOD)
+    assert len(hits) == 1
+    assert "reader.readline()" in hits[0].message
+
+
+def test_wait_for_wrapped_read_passes():
+    src = (
+        "import asyncio\n"
+        "async def head(reader):\n"
+        "    return await asyncio.wait_for(reader.readline(), 5.0)\n"
+    )
+    assert _hits(src, DEADLINE_RULE, relpath=IO_MOD) == []
+
+
+def test_wait_for_with_none_timeout_flagged():
+    src = (
+        "import asyncio\n"
+        "async def head(reader):\n"
+        "    return await asyncio.wait_for(reader.readline(), None)\n"
+    )
+    assert len(_hits(src, DEADLINE_RULE, relpath=IO_MOD)) == 1
+
+
+def test_deadline_clamp_expression_is_bounded():
+    src = (
+        "import asyncio\n"
+        "import time\n"
+        "async def head(reader, deadline):\n"
+        "    t = min(5.0, deadline - time.monotonic())\n"
+        "    return await asyncio.wait_for(reader.readline(), t)\n"
+    )
+    assert _hits(src, DEADLINE_RULE, relpath=IO_MOD) == []
+
+
+def test_sync_sink_in_reconcile_root_flagged():
+    # `reconcile` roots the closure by name in any non-exempt module
+    src = (
+        "import requests\n"
+        "def reconcile(url):\n"
+        "    return requests.get(url)\n"
+    )
+    hits = _hits(src, DEADLINE_RULE)
+    assert len(hits) == 1
+    assert "requests.get" in hits[0].message
+
+
+def test_sync_sink_with_timeout_passes():
+    src = (
+        "import requests\n"
+        "def reconcile(url):\n"
+        "    return requests.get(url, timeout=5.0)\n"
+    )
+    assert _hits(src, DEADLINE_RULE) == []
+
+
+def test_future_result_without_timeout_flagged_in_closure():
+    src = (
+        "def run_flips(futures):\n"
+        "    return [f.result() for f in futures]\n"
+    )
+    assert len(_hits(src, DEADLINE_RULE)) == 1
+    src_ok = (
+        "def run_flips(futures):\n"
+        "    return [f.result(30.0) for f in futures]\n"
+    )
+    assert _hits(src_ok, DEADLINE_RULE) == []
+
+
+def test_sinks_outside_the_closure_pass():
+    # not a root name, not I/O core, no path from a root: out of scope
+    src = (
+        "import requests\n"
+        "def helper(url):\n"
+        "    return requests.get(url)\n"
+    )
+    assert _hits(src, DEADLINE_RULE) == []
+
+
+def test_stop_governed_await_wait_passes():
+    src = (
+        "class K:\n"
+        "    async def pump(self):\n"
+        "        await self._stop.wait()\n"
+    )
+    assert _hits(src, DEADLINE_RULE, relpath=IO_MOD) == []
+
+
+def test_forwarded_param_unbounded_on_one_caller_path():
+    # the ⋂-fixpoint pin: the sink's timeout rides `timeout_s`, and ONE
+    # caller path passes an explicit None — the parameter is unbounded
+    # and the finding names it
+    src = (
+        "import asyncio\n"
+        "async def _round(reader, timeout_s):\n"
+        "    return await asyncio.wait_for(reader.readline(), timeout_s)\n"
+        "async def fast(reader):\n"
+        "    return await _round(reader, 5.0)\n"
+        "async def forever(reader):\n"
+        "    return await _round(reader, None)\n"
+    )
+    hits = _hits(src, DEADLINE_RULE, relpath=IO_MOD)
+    assert len(hits) == 1
+    assert hits[0].line == 3
+    assert "timeout_s" in hits[0].message
+
+
+def test_forwarded_param_bounded_on_every_caller_path():
+    src = (
+        "import asyncio\n"
+        "async def _round(reader, timeout_s):\n"
+        "    return await asyncio.wait_for(reader.readline(), timeout_s)\n"
+        "async def fast(reader):\n"
+        "    return await _round(reader, 5.0)\n"
+        "async def slow(reader):\n"
+        "    return await _round(reader, 60.0)\n"
+    )
+    assert _hits(src, DEADLINE_RULE, relpath=IO_MOD) == []
+
+
+def test_unbounded_default_with_bounded_caller_passes():
+    # the caller supplies the bound, so the None default never binds
+    src = (
+        "import asyncio\n"
+        "async def _round(reader, timeout_s=None):\n"
+        "    return await asyncio.wait_for(reader.readline(), timeout_s)\n"
+        "async def fast(reader):\n"
+        "    return await _round(reader, 5.0)\n"
+    )
+    assert _hits(src, DEADLINE_RULE, relpath=IO_MOD) == []
+
+
+def test_unbounded_default_rides_an_omitting_caller():
+    # a caller that omits the argument contributes the None default to
+    # the parameter's site set — that path is unbounded
+    src = (
+        "import asyncio\n"
+        "async def _round(reader, timeout_s=None):\n"
+        "    return await asyncio.wait_for(reader.readline(), timeout_s)\n"
+        "async def fast(reader):\n"
+        "    return await _round(reader)\n"
+    )
+    hits = _hits(src, DEADLINE_RULE, relpath=IO_MOD)
+    assert len(hits) == 1
+    assert "timeout_s" in hits[0].message
+
+
+def test_deadline_pragma_suppresses():
+    src = (
+        "async def head(reader):\n"
+        "    # ccaudit: allow-missing-deadline(owner task is cancelled)\n"
+        "    return await reader.readline()\n"
+    )
+    assert _hits(src, DEADLINE_RULE, relpath=IO_MOD) == []
+
+
+# ------------------------------------------------------------ SARIF
+
+
+def test_sarif_levels_track_v6_severities():
+    queue_hit = _hits(
+        "import queue\nBACKLOG = queue.Queue()\n", QUEUE_RULE)
+    leak_hit = _hits(
+        "import socket\ndef probe(h):\n    s = socket.socket()\n"
+        "    s.connect((h, 80))\n", LEAK_RULE)
+    doc = to_sarif(queue_hit + leak_hit, [], [])
+    assert validate_sarif(doc) == []
+    levels = {r["ruleId"]: r["level"] for r in doc["runs"][0]["results"]}
+    assert levels[QUEUE_RULE] == "error"
+    assert levels[LEAK_RULE] == "warning"
+
+
+# --------------------------------------------------------- fact cache
+
+
+CACHED_SRC = (
+    "import queue\n"
+    "BACKLOG = queue.Queue()\n"
+)
+
+
+def _tree(tmp_path):
+    pkg = tmp_path / "tpu_cc_manager"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(CACHED_SRC)
+    return pkg
+
+
+def test_cached_scan_reports_exactly_the_cold_scan(tmp_path):
+    _tree(tmp_path)
+    target = ["tpu_cc_manager/m.py"]
+    cold = analyze_paths(root=str(tmp_path), targets=target, cache=True)
+    assert os.path.isdir(tmp_path / CACHE_DIR_NAME)
+    warm = analyze_paths(root=str(tmp_path), targets=target, cache=True)
+    assert cold == warm
+    assert any(f.rule == QUEUE_RULE for f in warm)
+
+
+def test_cache_content_change_reflects_in_v6_report(tmp_path):
+    pkg = _tree(tmp_path)
+    target = ["tpu_cc_manager/m.py"]
+    cold = analyze_paths(root=str(tmp_path), targets=target, cache=True)
+    assert any(f.rule == QUEUE_RULE for f in cold)
+    (pkg / "m.py").write_text(
+        "import queue\nBACKLOG = queue.Queue(maxsize=64)\n")
+    warm = analyze_paths(root=str(tmp_path), targets=target, cache=True)
+    assert not any(f.rule == QUEUE_RULE for f in warm)
+
+
+def test_cache_round_trip_preserves_module_facts(tmp_path):
+    _tree(tmp_path)
+    cache = tmp_path / CACHE_DIR_NAME
+    cache.mkdir()
+    v = analyzer_version_hash()
+    rel = "tpu_cc_manager/m.py"
+    a1 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    a2 = load_audit_cached(str(tmp_path), rel, str(cache), v)
+    assert a2.module.relpath == rel
+    assert a1.module.source == a2.module.source
+    # v6 runs over the cached facts: same findings either way
+    assert len(list(cache.iterdir())) == 1
+
+
+# -------------------------------------------- live surface + slicing
+
+
+@pytest.fixture(scope="module")
+def full_scan():
+    return analyze_paths()
+
+
+def test_live_tree_passes_v6_clean(full_scan):
+    # the shipped tree passes its own resource rules: the aio writer
+    # backlog is bounded (TPU_CC_KUBE_QUEUE), every retry loop carries
+    # cap+backoff+jitter or a pragma, and nothing new rides the
+    # baseline (the ratchet only burns down)
+    assert [f for f in full_scan if f.rule in RESOURCEFLOW_RULES] == []
+
+
+def test_files_subset_reports_exactly_the_full_runs_slice(full_scan):
+    # --files runs the ANALYSIS whole-program and slices only the
+    # REPORT, so v6 facts (the deadline closure, the ⋂-fixpoint over
+    # caller paths) never degrade on a changed-files pass
+    target = "tpu_cc_manager/k8s/aio.py"
+    sub = analyze_paths(targets=[target], subset=True)
+    assert sorted(sub) == sorted(
+        f for f in full_scan if f.file == target
+    )

@@ -605,7 +605,7 @@ def test_queue_linked_callback_inherits_recorder_context():
         import threading, queue
         class Rec:
             def __init__(self):
-                self._q = queue.Queue()
+                self._q = queue.Queue(maxsize=64)
                 threading.Thread(target=self._loop).start()
             def push(self, task):
                 self._q.put(task)

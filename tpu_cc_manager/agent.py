@@ -290,6 +290,7 @@ class CCManagerAgent:
         round's ack was retracted, so it must re-run either way). Without
         this, an X->Y->X flap observed mid-round would abort the X round
         and then block on the mailbox forever with X unapplied."""
+        # ccaudit: allow-retry-discipline(supersession follow-up, not congestion retry: each turn consumes an already-DELIVERED newer mode from the mailbox (or one label re-read) — pacing it would just hold the freshest desired state unapplied; the stop check bounds it)
         while True:
             ok = self.reconcile(mode)
             if self.last_outcome != "superseded" or self._stop.is_set():
@@ -716,6 +717,7 @@ class CCManagerAgent:
         validation) warns once so a misconfigured deployment doesn't
         silently lose the whole feature."""
         while True:
+            # ccaudit: allow-stop-aware-wait(the _EVENT_STOP sentinel IS the wakeup: stop() enqueues it, so this blocking get returns immediately on shutdown — a timeout would only add idle churn to a daemon drain thread)
             event = self._event_queue.get()
             try:
                 if event is _EVENT_STOP:

@@ -143,6 +143,44 @@ multiplies the dispatch surface:
     parameter is a trace-time TypeError. Static/keyword-only config
     parameters and ``is None`` defaulting are exempt.
 
+v6 taught the analyzer overload discipline (``resourceflow.py`` over
+the same parse + call graph — docs/analysis.md §v6), the down-payment
+on ROADMAP item 3: a saturated control plane must degrade deliberately,
+and these five families make the disciplines un-regressable:
+
+``unbounded-queue``
+    Queue/asyncio.Queue family constructors without a positive
+    ``maxsize``, ``queue.SimpleQueue`` anywhere, and cross-context
+    deques (``self.``/module/class stores) without ``maxlen``. The aio
+    writer backlog was the seeded true positive — now bounded behind
+    ``TPU_CC_KUBE_QUEUE`` with ``tpu_cc_kube_queue_rejected_total``
+    accounting. Error severity; ``allow-unbounded-queue(reason)``.
+``missing-deadline``
+    A BOUNDED/UNBOUNDED timeout lattice over the reconcile/scan/flip
+    closure (widened with the k8s I/O core): ``.result()``,
+    ``concurrent.futures.wait``, subprocess, requests, ``select`` and
+    awaited stream/semaphore/queue suspensions must carry a deadline on
+    every caller path — ``wait_for`` wrapping, deadline-clamp
+    arithmetic, and timeout-forwarding parameters resolved through a
+    caller-path ⋂-fixpoint all count.
+``retry-discipline``
+    A retry loop around an I/O sink must show all three legs — an
+    attempt/deadline cap, backoff growth, jitter — lexically or via the
+    called helper's call-graph closure; the two-attempt replay shape is
+    exempt.
+``resource-leak``
+    Acquire/release path check over sockets, files, executors,
+    tempfiles, subprocesses: close under ``try/finally`` or a context
+    manager on all exception paths, or a visible ownership transfer;
+    ``self.``-attribute acquisitions need a close site somewhere in the
+    module.
+``stop-aware-wait``
+    Blocking waits on controller threads must ride the ``_stop``-Event
+    convention (SIGTERM must never hang a flip): ``time.sleep``,
+    stopless no-timeout ``.wait()``/queue ``.get()``, and timed waits
+    in loops that never consult the stop signal all fire — error
+    severity when the wait sits in a loop.
+
 Findings are gated against ``analysis/baseline.json`` so CI fails only on
 *new* findings; stale baseline entries (the code they suppressed moved or
 was fixed) also fail, so the baseline can only burn down.
@@ -195,4 +233,10 @@ RULES = (
     "unserialized-dispatch",
     "donation-violation",
     "tracer-leak",
+    # v6 — the resource & overload-discipline families (resourceflow.py)
+    "unbounded-queue",
+    "missing-deadline",
+    "retry-discipline",
+    "resource-leak",
+    "stop-aware-wait",
 )

@@ -37,7 +37,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "fail-secure, and the v5 jitflow families over the JAX "
         "dispatch surface: retrace hazards vs the bucket ladder, "
         "host-sync stalls in hot paths, unserialized collective "
-        "dispatch, donated-buffer reuse, tracer leaks). "
+        "dispatch, donated-buffer reuse, tracer leaks, and the v6 "
+        "resourceflow families: unbounded queues, missing deadlines on "
+        "the reconcile closure, retry discipline, resource leak paths, "
+        "stop-aware waits). "
         "docs/analysis.md has the rule contract.",
     )
     parser.add_argument(

@@ -324,6 +324,7 @@ def _analyze_modules(
         jitflow,
         lockgraph,
         lockset,
+        resourceflow,
         rules,
         threads,
     )
@@ -351,6 +352,7 @@ def _analyze_modules(
     )
     findings.extend(asyncflow.async_findings(audits, graph, roots))
     findings.extend(jitflow.jitflow_findings(audits, graph, roots))
+    findings.extend(resourceflow.resource_findings(audits, graph))
     findings.extend(rules.metric_findings(audits))
     findings.extend(rules.liveness_findings(audits))
     findings.extend(rules.direct_write_findings(modules))

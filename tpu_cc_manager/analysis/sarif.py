@@ -94,6 +94,24 @@ _RULE_HELP = {
     "tracer-leak": "Traced value stored to self./module globals (runs "
     "once per retrace, not per call) or used in a Python if/while "
     "inside a jitted body (TracerBoolConversionError).",
+    "unbounded-queue": "Queue/deque/asyncio.Queue constructed without "
+    "a positive bound on the package surface — overload becomes memory "
+    "growth and unbounded latency instead of an honest rejection "
+    "(the aio writer backlog rides TPU_CC_KUBE_QUEUE).",
+    "missing-deadline": "Blocking call or await on the reconcile/scan/"
+    "flip closure with no timeout/deadline on some caller path — a "
+    "wedged peer stalls the drain-flip-verify loop forever; wrap in "
+    "wait_for, pass a timeout, or clamp against a deadline.",
+    "retry-discipline": "Retry loop around an I/O sink missing backoff "
+    "growth, jitter, or an attempt/deadline cap — uncapped immediate "
+    "retries synchronize into a thundering herd exactly when the "
+    "server is saturated.",
+    "resource-leak": "Acquired socket/file/executor/tempfile/process "
+    "not released on all exception paths — close it under try/finally, "
+    "use a context manager, or visibly transfer ownership.",
+    "stop-aware-wait": "Blocking wait on a controller thread that no "
+    "stop/shutdown signal can interrupt — ride the _stop Event "
+    "(self._stop.wait(t)) so SIGTERM never hangs a flip.",
     "stale-baseline": "Baseline entry matching no current finding — "
     "delete it (the ratchet only burns down).",
 }

@@ -56,7 +56,7 @@ def _drain_wait(wake: Optional[threading.Event], poll_s: float) -> None:
     bounded. Without a wake source this is a plain interruptible
     sleep."""
     if wake is None:
-        time.sleep(poll_s)  # ccaudit: allow-poll(no wake source wired: a bare drainer — one-shot CLI without a watch — has nothing to pulse this wait)
+        time.sleep(poll_s)  # ccaudit: allow-poll(no wake source wired: a bare drainer — one-shot CLI without a watch — has nothing to pulse this wait) # ccaudit: allow-stop-aware-wait(same CLI path: there is no stop event either — the agent path always wires the wake, which stop() pulses)
         return
     if wake.wait(poll_s):
         wake.clear()
@@ -235,6 +235,7 @@ class NodeFlipTaint(FlipTaint):
         from tpu_cc_manager.k8s.client import ConflictError
 
         seed = self._seed(hint_ok)
+        # ccaudit: allow-retry-discipline(optimistic CAS, not congestion retry: every attempt starts from a FRESH read, contention is at most one other writer per node (the agent), and MAX_CAS_ATTEMPTS caps it — pacing would stretch the flip's critical path for no herd reduction)
         for _ in range(self.MAX_CAS_ATTEMPTS):
             seeded = seed is not None
             node = seed if seeded else self.kube.get_node(self.node_name)

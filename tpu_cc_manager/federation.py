@@ -511,9 +511,12 @@ class FederationManager:
         return dict(entry)
 
     def _cordon_region(self, region: str, entry: dict, t0: float) -> None:
+        from tpu_cc_manager.watch import jittered_backoff
+
         client = self._clients[region]
         pending = self._region_node_names(region)
         done = 0
+        rounds = 0
         while pending and not self._stop.is_set():
             still: List[str] = []
             for name in pending:
@@ -525,7 +528,13 @@ class FederationManager:
                 except ApiException:
                     still.append(name)
             pending = still
-            if pending and self._stop.wait(0.2):
+            # nodes that failed this round retry on a growing jittered
+            # pause: a partitioned region's API server comes back to a
+            # paced trickle, not a per-200ms full-region patch storm
+            rounds += 1
+            if pending and self._stop.wait(
+                jittered_backoff(0.2, rounds, cap_s=5.0)
+            ):
                 break
         with self._lock:
             entry["cordoned"] = done

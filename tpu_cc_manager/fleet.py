@@ -34,8 +34,8 @@ from tpu_cc_manager.k8s.client import KubeClient
 from tpu_cc_manager.k8s.objects import match_selector
 from tpu_cc_manager.obs import (
     OBSERVED_MODE_VALUES, Counter, Gauge, Histogram, RouteServer,
-    kube_throttle_wait_histogram, render_metric_set,
-    wire_throttle_observer,
+    kube_queue_rejected_counter, kube_throttle_wait_histogram,
+    render_metric_set, wire_queue_reject_observer, wire_throttle_observer,
 )
 from tpu_cc_manager.plan import (
     FleetEncoding, TickSession, analyze_encoding, compile_stats,
@@ -207,6 +207,7 @@ class FleetMetrics:
             "Wall-clock duration of one fleet scan",
         )
         self.kube_throttle_wait = kube_throttle_wait_histogram()
+        self.kube_queue_rejected = kube_queue_rejected_counter()
         # planner compile economics (ISSUE 8 satellite): mirrors of
         # plan.py's monotonic trace/compile-cache counters, refreshed
         # every scan — the PR-7 "restart = zero cache misses" claim
@@ -351,6 +352,9 @@ class FleetController:
         # controller's /metrics — "is the limiter throttling us at
         # fleet scale?" must be a histogram, not a guess
         wire_throttle_observer(kube, self.metrics.kube_throttle_wait)
+        # overload honesty: writes the aio admission gate refuses are
+        # this controller's saturation signal (TPU_CC_KUBE_QUEUE)
+        wire_queue_reject_observer(kube, self.metrics.kube_queue_rejected)
         self.last_report: Optional[dict] = None
         self.consecutive_errors = 0
         #: sticky across scans: once any scan sees an identity-bearing

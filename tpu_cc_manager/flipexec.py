@@ -258,6 +258,7 @@ def run_flips(
         )
         futures = [pool.submit(worker, item) for item in items]
         # .result() outside any lock by design — see the module docstring
+        # ccaudit: allow-missing-deadline(a flip worker past the abort gate is mid-device-reset and must NEVER be abandoned: timing out this join would orphan a live firmware transition — the per-step device timeouts inside the worker bound it instead)
         outcomes = [f.result() for f in futures]
     _note_failures(outcomes, recorder)
     _reraise_unexpected(outcomes)

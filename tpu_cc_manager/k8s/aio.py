@@ -90,6 +90,25 @@ DEFAULT_CONNS = 8
 ENV_WINDOW = "TPU_CC_KUBE_INFLIGHT"
 DEFAULT_WINDOW = 4
 
+#: writer backlog admission bound: once every connection's window is
+#: full, at most this many writers may QUEUE for a slot; the next one
+#: gets an honest 429 and ``queue_rejected_total`` ticks
+#: (``tpu_cc_kube_queue_rejected_total`` via obs.py). Unbounded was the
+#: overload failure mode docs/io.md §"In-flight window contract" used
+#: to admit to — saturation became memory growth and unbounded latency
+#: instead of a rejection the caller can pace against (ROADMAP item 3).
+ENV_QUEUE = "TPU_CC_KUBE_QUEUE"
+DEFAULT_QUEUE = 256
+
+#: socket-level write deadline: ``drain()`` on a wedged peer (zero TCP
+#: window) would otherwise park the writer forever — before the
+#: request's own read deadline is even armed
+DRAIN_TIMEOUT_S = 30.0
+
+#: TCP+TLS dial deadline (a blackholed endpoint fails the dial path's
+#: fresh-connection contract instead of hanging it)
+CONNECT_TIMEOUT_S = 10.0
+
 
 def _env_int(name: str, default: int) -> int:
     try:
@@ -171,7 +190,7 @@ class _Conn:
         self.client = client
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
-        self._inflight: "deque[_Pending]" = deque()
+        self._inflight: "deque[_Pending]" = deque()  # ccaudit: allow-unbounded-queue(per-conn FIFO holds at most `window` entries: every append happens under a window-semaphore slot, and admission past the windows is bounded by TPU_CC_KUBE_QUEUE)
         self.window = asyncio.Semaphore(window)
         self.write_lock = asyncio.Lock()
         self.served = 0  # complete responses received on this conn
@@ -229,7 +248,10 @@ class _Conn:
                 # request must already be in the reader's FIFO for the
                 # EOF policy to judge (never silently lost)
                 self._inflight.append(pending)
-                await self.writer.drain()
+                # TimeoutError ⊂ OSError: a wedged-peer drain lands in
+                # the same bytes-may-be-on-the-wire branch below
+                await asyncio.wait_for(self.writer.drain(),
+                                       DRAIN_TIMEOUT_S)
             except (OSError, asyncio.IncompleteReadError) as e:
                 self.abort()
                 if pending not in self._inflight:
@@ -249,6 +271,7 @@ class _Conn:
         try:
             assert self.reader is not None
             while True:
+                # ccaudit: allow-missing-deadline(reader-task idle read: between responses this SHOULD park indefinitely; every pending request carries its own wait_for deadline, and a wedged socket times those out and retires the conn)
                 line = await self.reader.readline()
                 if not line:
                     break  # EOF (idle close or mid-pipeline death)
@@ -278,6 +301,7 @@ class _Conn:
         headers: Dict[str, str] = {}
         assert self.reader is not None
         while True:
+            # ccaudit: allow-missing-deadline(header read on the reader task: the request it serves carries its own wait_for deadline — a wedged mid-header socket times that request out and the conn is retired)
             line = await self.reader.readline()
             if not line:
                 raise asyncio.IncompleteReadError(b"", None)
@@ -334,10 +358,16 @@ class AsyncKubeClient:
                  window: Optional[int] = None,
                  qps: Optional[float] = None,
                  burst: Optional[int] = None,
-                 list_page_limit: Optional[int] = None) -> None:
+                 list_page_limit: Optional[int] = None,
+                 max_queue: Optional[int] = None) -> None:
         self.config = config
         self.max_conns = max_conns or _env_int(ENV_CONNS, DEFAULT_CONNS)
         self.window = window or _env_int(ENV_WINDOW, DEFAULT_WINDOW)
+        #: writer backlog admission bound (docs/io.md): the count of
+        #: writers parked waiting for a window slot may never exceed
+        #: this — the next writer past it gets an honest 429
+        self.max_queue = max_queue or _env_int(ENV_QUEUE, DEFAULT_QUEUE)
+        self._queued = 0
         self.list_page_limit = list_page_limit or self.LIST_PAGE_LIMIT
         self._conns: List[_Conn] = []
         self._ssl_ctx = None
@@ -367,6 +397,11 @@ class AsyncKubeClient:
         self.replays_total = 0
         self.requests_total = 0
         self.watches_total = 0
+        #: writes refused at the admission gate (backlog full or the
+        #: queue wait outliving the request's own deadline) — the
+        #: overflow half of the TPU_CC_KUBE_QUEUE contract
+        self.queue_rejected_total = 0
+        self._queue_reject_observers: List[Callable[[], None]] = []
 
     # ------------------------------------------------------------- wiring
     def add_throttle_observer(self, fn: Callable[[float], None]) -> None:
@@ -378,6 +413,12 @@ class AsyncKubeClient:
         the number (that is the point: it is the latency a flip WRITE
         actually experiences)."""
         self._rtt_observers.append(fn)
+
+    def add_queue_reject_observer(self, fn: Callable[[], None]) -> None:
+        """``fn()`` on every write refused at the backlog admission
+        gate — obs.py's ``wire_queue_reject_observer`` hooks the
+        ``tpu_cc_kube_queue_rejected_total`` counter here."""
+        self._queue_reject_observers.append(fn)
 
     def set_qps(self, qps: float, burst: Optional[int] = None) -> None:
         if qps and qps > 0:
@@ -396,6 +437,7 @@ class AsyncKubeClient:
             "replays": self.replays_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
             "requests": self.requests_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
             "watches": self.watches_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
+            "queue_rejected": self.queue_rejected_total,  # ccaudit: allow-loop-affinity(GIL-atomic read of a monotonic counter)
         }
 
     async def aclose(self) -> None:
@@ -409,8 +451,13 @@ class AsyncKubeClient:
         ssl_ctx = None
         if self.config.use_tls:
             ssl_ctx = await self._ensure_ssl_ctx()
-        return await asyncio.open_connection(
-            self.config.host, self.config.port, ssl=ssl_ctx
+        # TimeoutError ⊂ OSError: a blackholed endpoint takes the same
+        # terminal fresh-dial-failure path as a refused connection
+        return await asyncio.wait_for(
+            asyncio.open_connection(
+                self.config.host, self.config.port, ssl=ssl_ctx
+            ),
+            CONNECT_TIMEOUT_S,
         )
 
     async def _ensure_ssl_ctx(self) -> "ssl.SSLContext":
@@ -479,20 +526,24 @@ class AsyncKubeClient:
         length = int(headers.get("content-length", "0") or 0)
         if length == 0:
             return b""
+        # ccaudit: allow-missing-deadline(body read on the reader task/watch stream: bounded by the owning request's wait_for deadline or the watch's server-side timeoutSeconds)
         return await reader.readexactly(length)
 
     @staticmethod
     async def _iter_chunks(reader: asyncio.StreamReader) -> AsyncIterator[bytes]:
         while True:
+            # ccaudit: allow-missing-deadline(chunk framing on the reader task: bounded by the owning request's wait_for deadline — the watch path wraps its own frame reads in wait_for separately)
             size_line = await reader.readline()
             if not size_line:
                 raise asyncio.IncompleteReadError(b"", None)
             size = int(size_line.strip().split(b";")[0], 16)
             if size == 0:
+                # ccaudit: allow-missing-deadline(trailing-CRLF read, same deadline ownership as the frame reads above)
                 await reader.readline()  # trailing CRLF
                 return
+            # ccaudit: allow-missing-deadline(chunk payload read, same deadline ownership as the frame reads above)
             data = await reader.readexactly(size)
-            await reader.readexactly(2)  # chunk CRLF
+            await reader.readexactly(2)  # chunk CRLF  # ccaudit: allow-missing-deadline(chunk-CRLF read, same deadline ownership as the frame reads above)
             yield data
 
     # ---------------------------------------------------------- dispatch
@@ -516,6 +567,7 @@ class AsyncKubeClient:
         bucket = self._bucket
         waited = 0.0
         if bucket is not None:
+            # ccaudit: allow-missing-deadline(token-bucket pacing: acquire sleeps exactly the computed refill interval — bounded by the bucket's own rate arithmetic, not by a peer)
             waited = await bucket.acquire()
             if waited > 0:
                 self.throttle_waits += 1
@@ -552,14 +604,50 @@ class AsyncKubeClient:
             raise ApiException(status, data.decode("utf-8", "replace")[:200])
         return json.loads(data) if data else {}
 
+    def _reject_write(self, reason: str) -> None:
+        self.queue_rejected_total += 1
+        for fn in self._queue_reject_observers:
+            try:
+                fn()
+            except Exception:  # ccaudit: allow-async-exception(observer isolation: a broken metrics hook must not mask the rejection being raised right below) # ccaudit: allow-swallow(observer isolation: the rejection itself is raised right below; the hook failure is logged)
+                log.debug("queue reject observer failed", exc_info=True)
+        raise ApiException(429, f"backlog full: {reason}")
+
+    async def _admit(self, conn: _Conn, read_timeout: float) -> None:
+        """Take a window slot, honestly. Past the windows at most
+        ``max_queue`` writers may park; the next one — and any whose
+        queue wait outlives its own read deadline — gets a 429 instead
+        of an unbounded spot in line (the TPU_CC_KUBE_QUEUE contract,
+        docs/io.md)."""
+        if conn.window.locked() and self._queued >= self.max_queue:
+            self._reject_write(
+                f"{self._queued} writers already queued past the "
+                f"window budget (TPU_CC_KUBE_QUEUE={self.max_queue})"
+            )
+        self._queued += 1
+        try:
+            # ccaudit: allow-raw-acquire(the admission gate acquires, _round_trip's finally releases: splitting them is what lets the queue wait carry a deadline while the slot spans the whole round trip)
+            await asyncio.wait_for(conn.window.acquire(), read_timeout)
+        except asyncio.TimeoutError:  # ccaudit: allow-async-exception(_reject_write unconditionally raises ApiException: this handler always propagates, it can never swallow the request path)
+            # never acquired: wait_for cancelled the acquire (no slot
+            # to release) — the wait itself outlived the deadline the
+            # caller gave the whole request
+            self._reject_write(
+                f"no window slot freed in {read_timeout}s"
+            )
+        finally:
+            # ccaudit: allow-await-atomicity(exact ticket count on one loop: the admission check runs atomically with the increment (no await between them), and each coroutine pairs exactly one increment with this one decrement — interleavings at the acquire await cannot tear it)
+            self._queued -= 1
+
     async def _round_trip(self, method: str, path: str,
                           payload: Optional[bytes], content_type: str,
                           read_timeout: float) -> Tuple[int, bytes]:
+        # ccaudit: allow-retry-discipline(_RedialNeeded re-dispatch: each turn retires a provably-stale pooled conn on which NOTHING reached the server; the pool holds at most max_conns stale conns, so this converges without pacing — it is dispatch, not congestion retry)
         while True:  # _RedialNeeded = never-written, re-dispatch freely
             conn = self._pick_conn()
             conn.depth += 1
             try:
-                await conn.window.acquire()
+                await self._admit(conn, read_timeout)
                 try:
                     pending = await conn.send(
                         method, path, payload, content_type,
@@ -804,7 +892,7 @@ class AsyncKubeClient:
                 "GET", path, None, "application/json",
                 await self._auth_header(),
             ))
-            await writer.drain()
+            await asyncio.wait_for(writer.drain(), DRAIN_TIMEOUT_S)
             line = await asyncio.wait_for(
                 reader.readline(), timeout_s + 30
             )
@@ -814,7 +902,11 @@ class AsyncKubeClient:
             status = int(line.split(None, 2)[1])
             headers: Dict[str, str] = {}
             while True:
-                hline = await reader.readline()
+                # a peer that wedges mid-header is as dead as one that
+                # never sent the status line: same deadline
+                hline = await asyncio.wait_for(
+                    reader.readline(), timeout_s + 30
+                )
                 if not hline or hline in (b"\r\n", b"\n"):
                     break
                 k, _, v = hline.decode("latin-1").partition(":")

@@ -131,6 +131,7 @@ class AioBridge:
                 elif f.exception() is not None:
                     out.set_exception(f.exception())
                 else:
+                    # ccaudit: allow-missing-deadline(done-callback: f has already settled — this result() returns immediately, it never waits)
                     out.set_result(f.result())
 
             exec_fut.add_done_callback(_done)
@@ -202,6 +203,9 @@ class SyncKubeFacade(KubeClient):
 
     def add_rtt_observer(self, fn: Callable[[str, str, float], None]) -> None:
         self.aio.add_rtt_observer(fn)
+
+    def add_queue_reject_observer(self, fn: Callable[[], None]) -> None:
+        self.aio.add_queue_reject_observer(fn)
 
     def set_qps(self, qps: float, burst: Optional[int] = None) -> None:
         # swap the bucket ON the loop: bucket state is loop-confined
@@ -308,6 +312,7 @@ class SyncKubeFacade(KubeClient):
         loop task pumps into a queue; the consuming thread blocks on
         it. Abandoning the iterator (watcher stop, GC) cancels the
         pump task so the dedicated watch connection is reclaimed."""
+        # ccaudit: allow-unbounded-queue(cross-thread hand-off for ONE watch stream: a bounded put would stall the shared loop thread behind a slow consumer, wedging every other bridge user; the stream itself is bounded by the server-side watch timeoutSeconds)
         q: "queue.Queue" = queue.Queue()
 
         async def pump() -> None:

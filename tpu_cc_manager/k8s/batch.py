@@ -52,7 +52,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_cc_manager import labels as L
 from tpu_cc_manager.flightrec import get_recorder
@@ -76,7 +76,7 @@ class _Pending:
         labels: Optional[Dict[str, Optional[str]]],
         annotations: Optional[Dict[str, Optional[str]]],
         on_published: Optional[Callable[[int], None]],
-    ):
+    ) -> None:
         self.gen = gen
         self.labels = dict(labels or {})
         self.annotations = dict(annotations or {})
@@ -96,7 +96,7 @@ class NodePatchBatcher:
 
     def __init__(
         self,
-        kube,
+        kube: Any,
         node_name: str,
         *,
         flush_interval_s: float = 0.25,
@@ -104,8 +104,8 @@ class NodePatchBatcher:
         on_coalesced: Optional[Callable[[str], None]] = None,
         on_retry: Optional[Callable[[str], None]] = None,
         on_drop: Optional[Callable[[str], None]] = None,
-        recorder=None,
-    ):
+        recorder: Optional[Any] = None,
+    ) -> None:
         self.kube = kube
         self.node_name = node_name
         self.flush_interval_s = flush_interval_s
@@ -189,7 +189,7 @@ class NodePatchBatcher:
                 return key in self._pending
             return bool(self._pending)
 
-    def stats(self) -> dict:
+    def stats(self) -> Dict[str, int]:
         with self._lock:
             return {
                 "pending": len(self._pending),
@@ -409,7 +409,7 @@ class NodePatchBatcher:
                 self._notify(self._on_drop, key)
 
     @staticmethod
-    def _notify(cb: Callable, arg) -> None:
+    def _notify(cb: Callable[[Any], None], arg: Any) -> None:
         try:
             cb(arg)
         except Exception:

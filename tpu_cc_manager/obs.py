@@ -326,6 +326,29 @@ def wire_throttle_observer(kube, hist: Histogram) -> None:
         kube.add_throttle_observer(hist.observe)
 
 
+def kube_queue_rejected_counter() -> Counter:
+    """The one definition of ``tpu_cc_kube_queue_rejected_total`` —
+    writes refused at the aio core's backlog admission gate
+    (``TPU_CC_KUBE_QUEUE``, docs/io.md). A nonzero rate is the honest
+    overload signal the unbounded backlog used to hide: the control
+    plane is saturated and callers are being told so with a 429
+    instead of an ever-growing queue (ROADMAP item 3)."""
+    return Counter(
+        "tpu_cc_kube_queue_rejected_total",
+        "Kube writes rejected at the backlog admission gate "
+        "(TPU_CC_KUBE_QUEUE bound reached, or the queue wait outlived "
+        "the request deadline)",
+    )
+
+
+def wire_queue_reject_observer(kube, counter: Counter) -> None:
+    """Attach ``counter`` to the client's admission-gate rejections
+    when the client supports it (the aio core and its sync facade do;
+    the sync client and fakes have no admission queue)."""
+    if hasattr(kube, "add_queue_reject_observer"):
+        kube.add_queue_reject_observer(counter.inc)
+
+
 def registered_metrics(obj: object) -> List[object]:
     """Every metric-primitive attribute of a metric-set object, in
     definition (``__init__`` assignment) order — the registry

@@ -67,8 +67,9 @@ from tpu_cc_manager import labels as L
 from tpu_cc_manager.k8s.client import ApiException, KubeClient
 from tpu_cc_manager.modes import InvalidModeError, parse_mode
 from tpu_cc_manager.obs import (
-    Counter, Gauge, Histogram, RouteServer, kube_throttle_wait_histogram,
-    render_metric_set, wire_throttle_observer,
+    Counter, Gauge, Histogram, RouteServer, kube_queue_rejected_counter,
+    kube_throttle_wait_histogram, render_metric_set,
+    wire_queue_reject_observer, wire_throttle_observer,
 )
 from tpu_cc_manager.plan import PoolScanScratch, analyze_pools
 from tpu_cc_manager.rollout import (
@@ -276,6 +277,7 @@ class PolicyMetrics:
             "Wall-clock duration of one policy scan",
         )
         self.kube_throttle_wait = kube_throttle_wait_histogram()
+        self.kube_queue_rejected = kube_queue_rejected_counter()
 
     def update(self, statuses: Dict[str, dict]) -> None:
         self.policies.set(len(statuses))
@@ -323,6 +325,7 @@ class PolicyController:
         self.metrics = PolicyMetrics()
         # flow-control waits surface on this controller's /metrics
         wire_throttle_observer(kube, self.metrics.kube_throttle_wait)
+        wire_queue_reject_observer(kube, self.metrics.kube_queue_rejected)
         #: reusable pool-scan planner state (ISSUE 19): the encoding
         #: and device-resident tick session persist across scans, so a
         #: steady-state policy scan re-encodes only the nodes that
@@ -1612,9 +1615,12 @@ class PolicyController:
         client doesn't support CR watches (501) — and keeps retrying
         through CRD-not-installed (404) and transient errors, since
         both are expected deployment states."""
+        from tpu_cc_manager.watch import jittered_backoff
+
         rv = None
         gens: Dict[str, object] = {}  # name -> last generation seen
         crd_absent = False
+        failures = 0
         while not self._stop.is_set():
             if crd_absent:
                 # CRD not installed: probe with a cheap list instead of
@@ -1633,14 +1639,19 @@ class PolicyController:
                         log.info("client has no CR watch support; "
                                  "interval polling only")
                         return
-                    self._stop.wait(self.watch_backoff_s)
+                    failures += 1
+                    self._stop.wait(jittered_backoff(
+                        self.watch_backoff_s, failures))
                     continue
                 except Exception:
                     log.warning("policy CR watch failed; retrying",
                                 exc_info=True)
-                    self._stop.wait(self.watch_backoff_s)
+                    failures += 1
+                    self._stop.wait(jittered_backoff(
+                        self.watch_backoff_s, failures))
                     continue
                 crd_absent = False
+                failures = 0
             if rv is None:
                 # a from-scratch watch (startup, or reconnect after an
                 # outage/410/CRD install) starts at "now" and cannot
@@ -1672,6 +1683,7 @@ class PolicyController:
                         self._wake.set()
                     if self._stop.is_set():
                         return
+                failures = 0  # clean server-side timeout
             except ApiException as e:
                 if e.status == 501:
                     log.info("client has no CR watch support; "
@@ -1683,12 +1695,16 @@ class PolicyController:
                 # installed: switch to the quiet probe loop above
                 rv = None
                 crd_absent = e.status == 404
-                self._stop.wait(self.watch_backoff_s)
+                failures += 1
+                self._stop.wait(jittered_backoff(
+                    self.watch_backoff_s, failures))
             except Exception:
                 log.warning("policy watch failed; retrying",
                             exc_info=True)
                 rv = None
-                self._stop.wait(self.watch_backoff_s)
+                failures += 1
+                self._stop.wait(jittered_backoff(
+                    self.watch_backoff_s, failures))
 
     def _node_wake(self) -> None:
         """Wake from the NODE watch: marks the wake as coalescable —

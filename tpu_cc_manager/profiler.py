@@ -152,6 +152,7 @@ class SamplingProfiler:
             self.sample_once()
             rest = period - (time.monotonic() - t0)
             if rest > 0:
+                # ccaudit: allow-stop-aware-wait(synchronous burst on the CALLER's thread, clamped to the session deadline `end` — at most one sample period outlives a shutdown; the background sampler path rides _stop.wait already)
                 time.sleep(min(rest, max(end - time.monotonic(), 0.0)))
         return self.summary()
 

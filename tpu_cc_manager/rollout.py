@@ -407,7 +407,7 @@ class Rollout:
         self._live: Dict[str, dict] = {}
         #: delta- or tick-judged terminal GroupResults awaiting the
         #: driving loop's settlement (record persist, budget, canary)
-        self._ready: deque = deque()
+        self._ready: deque = deque()  # ccaudit: allow-unbounded-queue(holds at most the in-flight group cohort: a group enters once, on its terminal judgement, and max_in_flight bounds the cohort)
         self._feed_token = None
         #: monotonic stamp of the last settled terminal outcome; the
         #: next launch turns it into one advance-latency sample

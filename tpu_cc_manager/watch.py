@@ -20,6 +20,7 @@ from __future__ import annotations
 import copy
 import json
 import logging
+import random
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -34,6 +35,27 @@ WATCH_TIMEOUT_S = 300
 RECONNECT_BACKOFF_S = 5
 #: reference main.py:102,665-673
 MAX_CONSECUTIVE_ERRORS = 10
+
+#: growth ceiling for :func:`jittered_backoff` — one failed reconnect
+#: waits ~base, a long outage converges to roughly a minute between
+#: attempts instead of the whole fleet knocking every 5 s
+BACKOFF_CAP_S = 60.0
+
+
+def jittered_backoff(base_s: float, attempt: int,
+                     cap_s: float = BACKOFF_CAP_S) -> float:
+    """Capped exponential backoff with multiplicative jitter: the wait
+    before retry ``attempt`` (1-based; 0 is treated as 1). The fixed
+    5 s reconnect pause the reference agents shipped synchronizes every
+    watcher in the fleet onto the same retry cadence — after an API
+    server blip, N agents reconnect in one wave, and the wave is
+    exactly what a recovering server cannot absorb. Growth spreads
+    attempts over time, jitter (uniform ×[0.5, 1.5)) spreads them
+    across agents; every retry loop on the watch path shares this one
+    arithmetic so the discipline can't drift per-loop (the ccaudit
+    retry-discipline contract, docs/analysis.md §v6)."""
+    growth = min(cap_s, base_s * (2 ** max(0, attempt - 1)))
+    return growth * (0.5 + random.random())
 
 
 class SyncableModeConfig:
@@ -202,6 +224,7 @@ def run_node_watch(kube: Any, stop: threading.Event,
     delta-trusting consumer (the fleet controller's sync-skip path,
     ISSUE 19) must list-reconcile before trusting the feed again."""
     rv = None
+    failures = 0
     relevant = FingerprintWakeFilter(wake)
     while not stop.is_set():
         if rv is None:
@@ -235,18 +258,21 @@ def run_node_watch(kube: Any, stop: threading.Event,
                 relevant(etype, obj)
                 if stop.is_set():
                     return
+            failures = 0  # clean server-side timeout
         except ApiException as e:
             if e.status == 501:
                 logger.info("%s: client has no node-watch support; "
                             "interval polling only", who)
                 return
             rv = None
-            stop.wait(backoff_s)
+            failures += 1
+            stop.wait(jittered_backoff(backoff_s, failures))
         except Exception:
             logger.warning("%s: node watch failed; retrying", who,
                            exc_info=True)
             rv = None
-            stop.wait(backoff_s)
+            failures += 1
+            stop.wait(jittered_backoff(backoff_s, failures))
 
 
 class NodeInformer:
@@ -429,6 +455,7 @@ class NodeInformer:
 
     # ------------------------------------------------------------ main loop
     def _run(self) -> None:
+        failures = 0
         while not self._stop.is_set():
             try:
                 if not self.primed:
@@ -463,6 +490,7 @@ class NodeInformer:
                     if self._stop.is_set():
                         return
                 # clean server-side timeout: reconnect from current rv
+                failures = 0
             except ApiException as e:
                 if e.status == 501:
                     with self._lock:
@@ -473,19 +501,22 @@ class NodeInformer:
                     while not self._stop.wait(self.resync_s):
                         self._relist()
                     return
+                failures += 1
                 if e.status == 410:
                     log.warning("%s: watch history expired (410); "
                                 "re-listing", self.name)
                 else:
+                    pause = jittered_backoff(self.backoff_s, failures)
                     log.warning("%s: watch failed (%s); re-listing in "
-                                "%.1fs", self.name, e, self.backoff_s)
-                    self._stop.wait(self.backoff_s)
+                                "%.1fs", self.name, e, pause)
+                    self._stop.wait(pause)
                 with self._lock:
                     self._primed = False  # next loop turn re-lists
             except Exception:
+                failures += 1
                 log.warning("%s: unexpected informer error; re-listing",
                             self.name, exc_info=True)
-                self._stop.wait(self.backoff_s)
+                self._stop.wait(jittered_backoff(self.backoff_s, failures))
                 with self._lock:
                     self._primed = False
 
@@ -726,11 +757,14 @@ class NodeWatcher:
                         continue  # no backoff after successful resync
                     except ApiException as e2:
                         log.error("re-list after 410 failed: %s", e2)
+                pause = jittered_backoff(
+                    self.backoff_s, self.consecutive_errors
+                )
                 log.warning(
                     "watch error (%d consecutive): %s; reconnecting in %.1fs",
-                    self.consecutive_errors, e, self.backoff_s,
+                    self.consecutive_errors, e, pause,
                 )
-                self._stop.wait(self.backoff_s)
+                self._stop.wait(pause)
             except Exception as e:  # defensive: never kill silently
                 self.consecutive_errors += 1
                 log.exception("unexpected watcher error")
@@ -739,7 +773,9 @@ class NodeWatcher:
                         self.on_fatal(e)
                         return
                     raise
-                self._stop.wait(self.backoff_s)
+                self._stop.wait(jittered_backoff(
+                    self.backoff_s, self.consecutive_errors
+                ))
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "NodeWatcher":
